@@ -1,0 +1,25 @@
+"""kubernetes_tpu — a TPU-native cluster orchestrator.
+
+A brand-new framework with the capabilities of the reference Kubernetes
+(v1.1-era) tree: declarative cluster-state API with list/watch, pluggable pod
+scheduler, controllers, hollow-node agents and a kubemark-style scale harness —
+with the control-plane *compute* (scheduler predicates/priorities) re-founded
+on JAX/XLA as dense pods x nodes tensor math.
+
+Package layout (see SURVEY.md section 7):
+  core/      object schema, quantities, label/field selectors, codec,
+             revisioned KV store with CAS + watch  (ref: pkg/api, pkg/runtime,
+             pkg/labels, pkg/fields, pkg/storage)
+  api/       REST server + clients + reflector/informer cache (ref:
+             pkg/apiserver, pkg/registry, pkg/client)
+  sched/     serial oracle scheduler (parity reference) + batch TPU engine
+             (ref: plugin/pkg/scheduler)
+  ops/       JAX predicate masks and priority scores (the device kernels)
+  parallel/  mesh/sharding helpers, ICI-reduced argmax
+  agents/    hollow node, controllers (ref: pkg/kubelet hollow mode,
+             pkg/controller)
+  cli/       kubectl-style CLI (ref: pkg/kubectl)
+  utils/     trace, workqueue, backoff, rate limit, clock (ref: pkg/util)
+"""
+
+__version__ = "0.1.0"

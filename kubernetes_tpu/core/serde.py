@@ -1,0 +1,119 @@
+"""Generic dataclass <-> JSON-dict serde.
+
+The reference generates conversion/deep-copy code per type
+(pkg/api/deep_copy_generated.go, pkg/api/v1/conversion_generated.go); here a
+single reflective codec handles all API types: snake_case python fields map to
+camelCase wire keys, nested dataclasses / lists / dicts / Quantity recurse,
+and unset (None / empty) fields are omitted on the wire like Go's
+`json:",omitempty"` tags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional, Type, TypeVar, get_args, get_origin, get_type_hints
+
+from .quantity import Quantity, parse_quantity
+
+T = TypeVar("T")
+
+_hints_cache: Dict[type, Dict[str, Any]] = {}
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    out = parts[0] + "".join(p[:1].upper() + p[1:] for p in parts[1:])
+    # Wire names like hostIP / podIP / clusterIP / externalID / podCIDR.
+    for suf, rep in (("Ip", "IP"), ("Id", "ID"), ("Cidr", "CIDR"), ("Uid", "UID"),
+                     ("Url", "URL"), ("Tcp", "TCP"), ("Udp", "UDP")):
+        if out.endswith(suf):
+            out = out[: -len(suf)] + rep
+    return out
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    h = _hints_cache.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _hints_cache[cls] = h
+    return h
+
+
+def _unwrap_optional(tp: Any) -> Any:
+    if get_origin(tp) is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def to_wire(obj: Any) -> Any:
+    """Dataclass instance -> plain JSON-able structure, omitting empties."""
+    if obj is None:
+        return None
+    if isinstance(obj, Quantity):
+        return str(obj)
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            # omitempty relative to the declared default: a field at its
+            # default decodes back identically, so dropping it is lossless
+            # (and `replicas=0` still serializes, since its default is 1).
+            if f.default is not dataclasses.MISSING and v == f.default:
+                continue
+            w = to_wire(v)
+            if w is None or w == {} or w == []:
+                continue
+            out[_camel(f.name)] = w
+        return out
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    if isinstance(obj, bool) or isinstance(obj, (int, float, str)):
+        return obj
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def from_wire(cls: Type[T], data: Any) -> T:
+    """Plain JSON structure -> typed dataclass instance (lenient: unknown
+    wire keys are ignored, missing keys take dataclass defaults)."""
+    return _from_wire(cls, data)
+
+
+def _from_wire(tp: Any, data: Any) -> Any:
+    tp = _unwrap_optional(tp)
+    if data is None:
+        return None
+    if tp is Quantity:
+        return parse_quantity(data)
+    if tp is Any:
+        return data
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        vals = [_from_wire(elem, v) for v in data]
+        return tuple(vals) if origin is tuple else vals
+    if origin is dict:
+        args = get_args(tp)
+        vtp = args[1] if len(args) == 2 else Any
+        return {k: _from_wire(vtp, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(tp):
+        hints = _hints(tp)
+        kwargs: Dict[str, Any] = {}
+        wire_map = {_camel(f.name): f.name for f in dataclasses.fields(tp)}
+        for wk, wv in (data or {}).items():
+            fname = wire_map.get(wk)
+            if fname is None:
+                continue
+            kwargs[fname] = _from_wire(hints[fname], wv)
+        return tp(**kwargs)
+    if tp is float and isinstance(data, int):
+        return float(data)
+    if tp is int and isinstance(data, float) and data == int(data):
+        return int(data)
+    return data

@@ -1,0 +1,269 @@
+"""Revisioned KV store with CAS and windowed watch — the cluster's etcd.
+
+Reference mapping:
+  - storage.Interface (pkg/storage/interfaces.go:74): Create / Set / Delete /
+    Get / List / GuaranteedUpdate / Watch / WatchList — all here.
+  - etcd CAS semantics (pkg/storage/etcd/etcd_helper.go:449 GuaranteedUpdate):
+    optimistic-concurrency via resourceVersion; `guaranteed_update` retries
+    the caller's update function on conflict.
+  - watch cache (pkg/storage/cacher.go:109): a sliding in-memory window of
+    (revision, event) so watchers can resume from any recent resourceVersion
+    without replaying from scratch; too-old versions raise Expired (the
+    HTTP layer maps this to 410 Gone, prompting a client re-list).
+
+Being in-process (etcd is an external process in the reference), storage and
+watch cache collapse into one component guarded by one lock. Concurrency
+contract: stored objects are logically FROZEN — readers get the stored object
+without copying (list/watch fan-out to thousands of agents must not deep-copy
+per reader); writers hand ownership of the written object to the store and
+must not mutate it afterwards. Updates build new objects (dataclasses.replace
+or codec round-trip), never mutate in place. This is the same contract Go
+client caches impose informally.
+
+A single global revision counter doubles as resourceVersion (stringified),
+exactly like etcd's modifiedIndex in the reference.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import replace
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from . import watch as watchpkg
+from .errors import AlreadyExists, Conflict, Expired, NotFound
+
+
+def _with_rv(obj: Any, rev: int) -> Any:
+    meta = replace(obj.metadata, resource_version=str(rev))
+    return replace(obj, metadata=meta)
+
+
+class Store:
+    def __init__(self, window: int = 100_000):
+        self._lock = threading.RLock()
+        self._rev = 0
+        # key -> (object, mod_rev, expiry_ts|None); insertion-ordered so list
+        # output is stable (etcd returns key order; we sort on list anyway).
+        self._data: Dict[str, Tuple[Any, int, Optional[float]]] = {}
+        # sliding watch window: deque of (rev, event_type, key, obj, prev_obj)
+        self._history: deque = deque(maxlen=window)
+        self._oldest_rev = 0  # smallest rev still replayable + its predecessor
+        self._watchers: List[Tuple[str, "watchpkg.Watcher"]] = []
+
+    # ------------------------------------------------------------- helpers
+
+    @property
+    def current_revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def _bump(self) -> int:
+        self._rev += 1
+        return self._rev
+
+    def _expired(self, entry, now: float) -> bool:
+        return entry[2] is not None and entry[2] <= now
+
+    def _emit(self, rev: int, etype: str, key: str, obj: Any, prev: Any) -> None:
+        if len(self._history) == self._history.maxlen:
+            self._oldest_rev = self._history[0][0]
+        self._history.append((rev, etype, key, obj, prev))
+        ev = watchpkg.Event(etype, obj)
+        dead = []
+        for i, (prefix, w) in enumerate(self._watchers):
+            if w.stopped:
+                dead.append(i)
+                continue
+            if key.startswith(prefix):
+                if not w.send(ev):
+                    w.stop()
+                    dead.append(i)
+        for i in reversed(dead):
+            del self._watchers[i]
+
+    def _gc_expired(self, now: Optional[float] = None) -> None:
+        """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
+        now = time.time() if now is None else now
+        dead = [k for k, e in self._data.items() if self._expired(e, now)]
+        for k in dead:
+            obj, _, _ = self._data.pop(k)
+            self._emit(self._bump(), watchpkg.DELETED, k, obj, obj)
+
+    # ------------------------------------------------------------ writes
+
+    def create(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        with self._lock:
+            self._gc_expired()
+            if key in self._data:
+                raise AlreadyExists(kind=key.split("/")[2] if key.count("/") >= 2 else "",
+                                    name=key.rsplit("/", 1)[-1])
+            rev = self._bump()
+            obj = _with_rv(obj, rev)
+            expiry = time.time() + ttl if ttl else None
+            self._data[key] = (obj, rev, expiry)
+            self._emit(rev, watchpkg.ADDED, key, obj, None)
+            return obj
+
+    def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        """Unconditional write (ref: etcd_helper Set)."""
+        with self._lock:
+            self._gc_expired()
+            rev = self._bump()
+            obj = _with_rv(obj, rev)
+            expiry = time.time() + ttl if ttl else None
+            prev = self._data.get(key)
+            self._data[key] = (obj, rev, expiry)
+            etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
+            self._emit(rev, etype, key, obj, prev[0] if prev else None)
+            return obj
+
+    def update(self, key: str, obj: Any) -> Any:
+        """Conditional write: obj.metadata.resource_version must match the
+        stored revision (the optimistic-concurrency check every PUT gets,
+        ref: pkg/registry/generic/etcd/etcd.go:270-316)."""
+        with self._lock:
+            self._gc_expired()
+            entry = self._data.get(key)
+            if entry is None:
+                raise NotFound(name=key)
+            stored, mod_rev, expiry = entry
+            rv = obj.metadata.resource_version
+            if rv and int(rv) != mod_rev:
+                raise Conflict(
+                    f"operation on {key} failed: object was modified "
+                    f"(have {rv}, current {mod_rev})")
+            rev = self._bump()
+            obj = _with_rv(obj, rev)
+            self._data[key] = (obj, rev, expiry)
+            self._emit(rev, watchpkg.MODIFIED, key, obj, stored)
+            return obj
+
+    def guaranteed_update(self, key: str, fn: Callable[[Any], Any],
+                          retries: int = 10) -> Any:
+        """Read-modify-write loop with CAS semantics
+        (ref: etcd_helper.go:449). `fn` receives the current object and
+        returns the new one (never mutate the input). In-process the lock
+        makes one pass sufficient, but the retry structure is kept so `fn`
+        may be called outside the lock in future remote-store backends."""
+        for _ in range(retries):
+            with self._lock:
+                entry = self._data.get(key)
+                if entry is None:
+                    raise NotFound(name=key)
+                stored, mod_rev, expiry = entry
+                new_obj = fn(stored)
+                if self._data.get(key, (None, -1, None))[1] != mod_rev:
+                    continue  # concurrent write between read and write
+                rev = self._bump()
+                new_obj = _with_rv(new_obj, rev)
+                self._data[key] = (new_obj, rev, expiry)
+                self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
+                return new_obj
+        raise Conflict(f"guaranteed_update on {key}: too many retries")
+
+    def delete(self, key: str, expect_rv: Optional[str] = None) -> Any:
+        with self._lock:
+            self._gc_expired()
+            entry = self._data.get(key)
+            if entry is None:
+                raise NotFound(name=key)
+            stored, mod_rev, _ = entry
+            if expect_rv and int(expect_rv) != mod_rev:
+                raise Conflict(f"delete {key}: revision mismatch")
+            del self._data[key]
+            rev = self._bump()
+            self._emit(rev, watchpkg.DELETED, key, stored, stored)
+            return stored
+
+    def batch(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]) -> List[Any]:
+        """Apply many guaranteed-updates under ONE lock acquisition with one
+        revision bump per object. This is the binding-commit fast path the
+        north star needs (30k CAS writes in <1s; see SURVEY.md section 7 hard
+        part 2): same per-key conflict semantics as guaranteed_update, but the
+        scheduler commits a whole tile of bindings per call."""
+        out = []
+        with self._lock:
+            # Two-phase: run every update function first, then commit.  A
+            # mid-batch failure therefore commits nothing (all-or-nothing),
+            # so the scheduler always knows whether a tile of bindings is
+            # durable.
+            staged = []
+            for key, fn in ops:
+                entry = self._data.get(key)
+                if entry is None:
+                    raise NotFound(name=key)
+                stored, _mod_rev, expiry = entry
+                staged.append((key, fn(stored), stored, expiry))
+            for key, new_obj, stored, expiry in staged:
+                rev = self._bump()
+                new_obj = _with_rv(new_obj, rev)
+                self._data[key] = (new_obj, rev, expiry)
+                self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
+                out.append(new_obj)
+        return out
+
+    # ------------------------------------------------------------- reads
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            entry = self._data.get(key)
+            if entry is None or self._expired(entry, time.time()):
+                raise NotFound(name=key)
+            return entry[0]
+
+    def list(self, prefix: str,
+             predicate: Optional[Callable[[Any], bool]] = None
+             ) -> Tuple[List[Any], int]:
+        """All live objects under prefix, with the store revision at read
+        time (the List + resourceVersion pair reflectors rely on,
+        ref: pkg/client/cache/reflector.go:225)."""
+        with self._lock:
+            now = time.time()
+            items = [
+                e[0] for k, e in self._data.items()
+                if k.startswith(prefix) and not self._expired(e, now)
+            ]
+            if predicate is not None:
+                items = [o for o in items if predicate(o)]
+            items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return items, self._rev
+
+    # ------------------------------------------------------------- watch
+
+    def watch(self, prefix: str, since_rev: int = 0,
+              capacity: int = 100_000) -> watchpkg.Watcher:
+        """Stream events for keys under prefix with rev > since_rev.
+
+        since_rev=0 means "from now" (no replay). A nonzero since_rev replays
+        from the watch window; if the window no longer covers it, Expired is
+        raised and the client must re-list (ref: cacher.go 'too old resource
+        version').
+        """
+        with self._lock:
+            replay = []
+            if since_rev:
+                if since_rev < self._oldest_rev:
+                    raise Expired(
+                        f"resourceVersion {since_rev} is too old "
+                        f"(oldest available {self._oldest_rev})")
+                replay = [
+                    watchpkg.Event(etype, obj)
+                    for rev, etype, key, obj, _prev in self._history
+                    if rev > since_rev and key.startswith(prefix)
+                ]
+            # Size the queue to hold the whole replay: a blocking send here
+            # would deadlock the store (no consumer can run until we return).
+            w = watchpkg.Watcher(max(capacity, len(replay) + 16))
+            for ev in replay:
+                w.send(ev)
+            self._watchers.append((prefix, w))
+            return w
+
+    def watcher_count(self) -> int:
+        with self._lock:
+            self._watchers = [(p, w) for p, w in self._watchers if not w.stopped]
+            return len(self._watchers)

@@ -1,0 +1,521 @@
+"""API object schema — the v1.1 subset the control plane operates on.
+
+Reference: pkg/api/types.go (2161 LoC internal types) and pkg/api/v1/types.go
+(wire form). We keep the same object model (ObjectMeta / Spec / Status,
+camelCase wire names via serde) for the resources the scheduler, controllers,
+agents and CLI need: Pod, Node, Service, Endpoints, ReplicationController,
+Binding, Event, Namespace, plus small config resources.
+
+All types are plain dataclasses; serialization is handled reflectively by
+core.serde. Mutability is deliberate (controllers patch objects in place and
+write them back through the store's CAS loop).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .quantity import Quantity
+
+# Resource names (ref: pkg/api/types.go ResourceCPU/ResourceMemory/ResourcePods)
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+
+# Pod phases (ref: pkg/api/types.go PodPhase)
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+POD_UNKNOWN = "Unknown"
+
+# Condition types / statuses
+POD_READY = "Ready"
+NODE_READY = "Ready"
+NODE_OUT_OF_DISK = "OutOfDisk"
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+CONDITION_UNKNOWN = "Unknown"
+
+
+def now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    generation: int = 0
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    api_version: str = ""
+    resource_version: str = ""
+    field_path: str = ""
+
+
+@dataclass
+class LocalObjectReference:
+    name: str = ""
+
+
+# ---------------------------------------------------------------- volumes
+
+@dataclass
+class GCEPersistentDiskVolumeSource:
+    pd_name: str = ""
+    fs_type: str = ""
+    partition: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class AWSElasticBlockStoreVolumeSource:
+    volume_id: str = ""
+    fs_type: str = ""
+    partition: int = 0
+    read_only: bool = False
+
+
+@dataclass
+class RBDVolumeSource:
+    ceph_monitors: List[str] = field(default_factory=list)
+    rbd_image: str = ""
+    rbd_pool: str = ""
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class EmptyDirVolumeSource:
+    medium: str = ""
+
+
+@dataclass
+class HostPathVolumeSource:
+    path: str = ""
+
+
+@dataclass
+class NFSVolumeSource:
+    server: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class SecretVolumeSource:
+    secret_name: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    rbd: Optional[RBDVolumeSource] = None
+    empty_dir: Optional[EmptyDirVolumeSource] = None
+    host_path: Optional[HostPathVolumeSource] = None
+    nfs: Optional[NFSVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+
+
+# ---------------------------------------------------------------- containers
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    limits: Dict[str, Quantity] = field(default_factory=dict)
+    requests: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    image_pull_policy: str = ""
+
+
+@dataclass
+class ContainerStateRunning:
+    started_at: str = ""
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    started_at: str = ""
+    finished_at: str = ""
+
+
+@dataclass
+class ContainerStateWaiting:
+    reason: str = ""
+
+
+@dataclass
+class ContainerState:
+    waiting: Optional[ContainerStateWaiting] = None
+    running: Optional[ContainerStateRunning] = None
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    state: ContainerState = field(default_factory=ContainerState)
+    ready: bool = False
+    restart_count: int = 0
+    image: str = ""
+    image_id: str = ""
+    container_id: str = ""
+
+
+# ---------------------------------------------------------------- pods
+
+@dataclass
+class PodSpec:
+    volumes: List[Volume] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    dns_policy: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    service_account_name: str = ""
+    node_name: str = ""
+    host_network: bool = False
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodStatus:
+    phase: str = ""
+    conditions: List[PodCondition] = field(default_factory=list)
+    message: str = ""
+    reason: str = ""
+    host_ip: str = ""
+    pod_ip: str = ""
+    start_time: Optional[str] = None
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+# ---------------------------------------------------------------- nodes
+
+@dataclass
+class NodeSpec:
+    pod_cidr: str = ""
+    external_id: str = ""
+    provider_id: str = ""
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = ""
+    last_heartbeat_time: str = ""
+    last_transition_time: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class NodeAddress:
+    type: str = ""
+    address: str = ""
+
+
+@dataclass
+class NodeSystemInfo:
+    machine_id: str = ""
+    kernel_version: str = ""
+    os_image: str = ""
+    container_runtime_version: str = ""
+    kubelet_version: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    allocatable: Dict[str, Quantity] = field(default_factory=dict)
+    phase: str = ""
+    conditions: List[NodeCondition] = field(default_factory=list)
+    addresses: List[NodeAddress] = field(default_factory=list)
+    node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+# ---------------------------------------------------------------- services
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0
+    target_port: Any = None
+    node_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    ports: List[ServicePort] = field(default_factory=list)
+    selector: Dict[str, str] = field(default_factory=dict)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"
+    session_affinity: str = "None"
+
+
+@dataclass
+class ServiceStatus:
+    pass
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: ServiceStatus = field(default_factory=ServiceStatus)
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    target_ref: Optional[ObjectReference] = None
+
+
+@dataclass
+class EndpointPort:
+    name: str = ""
+    port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[EndpointPort] = field(default_factory=list)
+
+
+@dataclass
+class Endpoints:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+
+# ------------------------------------------------- replication controllers
+
+@dataclass
+class ReplicationControllerSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: Optional[PodTemplateSpec] = None
+
+
+@dataclass
+class ReplicationControllerStatus:
+    replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicationController:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicationControllerSpec = field(default_factory=ReplicationControllerSpec)
+    status: ReplicationControllerStatus = field(default_factory=ReplicationControllerStatus)
+
+
+# ---------------------------------------------------------------- binding
+
+@dataclass
+class Binding:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    target: ObjectReference = field(default_factory=ObjectReference)
+
+
+# ---------------------------------------------------------------- events
+
+@dataclass
+class EventSource:
+    component: str = ""
+    host: str = ""
+
+
+@dataclass
+class Event:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    source: EventSource = field(default_factory=EventSource)
+    first_timestamp: str = ""
+    last_timestamp: str = ""
+    count: int = 0
+    type: str = ""
+
+
+# ---------------------------------------------------------------- namespaces
+
+@dataclass
+class NamespaceSpec:
+    finalizers: List[str] = field(default_factory=list)
+
+
+@dataclass
+class NamespaceStatus:
+    phase: str = "Active"
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NamespaceSpec = field(default_factory=NamespaceSpec)
+    status: NamespaceStatus = field(default_factory=NamespaceStatus)
+
+
+# ------------------------------------------------------- config resources
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+
+
+@dataclass
+class ConfigEntry:  # helper for LimitRange items
+    type: str = ""
+    max: Dict[str, Quantity] = field(default_factory=dict)
+    min: Dict[str, Quantity] = field(default_factory=dict)
+    default: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRangeSpec:
+    limits: List[ConfigEntry] = field(default_factory=list)
+
+
+@dataclass
+class LimitRange:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LimitRangeSpec = field(default_factory=LimitRangeSpec)
+
+
+@dataclass
+class ResourceQuotaSpec:
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuotaStatus:
+    hard: Dict[str, Quantity] = field(default_factory=dict)
+    used: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceQuota:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceQuotaSpec = field(default_factory=ResourceQuotaSpec)
+    status: ResourceQuotaStatus = field(default_factory=ResourceQuotaStatus)
+
+
+@dataclass
+class ServiceAccount:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[ObjectReference] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------- helpers
+
+def pod_resource_fields(pod: Pod) -> Dict[str, str]:
+    """Flat field map for field selectors (ref: pkg/registry/pod PodToSelectableFields)."""
+    return {
+        "metadata.name": pod.metadata.name,
+        "metadata.namespace": pod.metadata.namespace,
+        "spec.nodeName": pod.spec.node_name,
+        "status.phase": pod.status.phase,
+    }
+
+
+def node_resource_fields(node: Node) -> Dict[str, str]:
+    return {
+        "metadata.name": node.metadata.name,
+        "spec.unschedulable": "true" if node.spec.unschedulable else "false",
+    }
+
+
+def generic_resource_fields(obj: Any) -> Dict[str, str]:
+    meta = getattr(obj, "metadata", None)
+    if meta is None:
+        return {}
+    return {"metadata.name": meta.name, "metadata.namespace": meta.namespace}

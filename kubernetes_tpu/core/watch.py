@@ -1,0 +1,121 @@
+"""Watch primitives: typed event stream with bounded-queue fan-out.
+
+Reference: pkg/watch (Interface, Event, Mux/Broadcaster). A watcher is an
+iterator of (event_type, object); the broadcaster fans a stream out to many
+watchers, dropping slow ones rather than blocking the writer (the reference's
+Mux uses a full-channel policy; we mirror "stop the laggard" which is also
+what the apiserver Cacher does).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+ERROR = "ERROR"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str
+    object: Any
+
+
+_SENTINEL = object()
+
+
+class Watcher:
+    """A single watch stream. Iterate to receive events; `stop()` ends it."""
+
+    def __init__(self, capacity: int = 1000):
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._stopped = threading.Event()
+
+    def send(self, event: Event) -> bool:
+        """Enqueue an event without blocking. Returns False if the watcher is
+        stopped or its queue is full (laggard — callers drop such watchers)."""
+        if self._stopped.is_set():
+            return False
+        try:
+            self._q.put_nowait(event)
+            return True
+        except queue.Full:
+            return False
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        # The sentinel must land even if the queue is full (the laggard-drop
+        # path stops exactly such watchers): evict buffered events until it
+        # fits — the consumer is being cut off anyway.
+        for _ in range(3):
+            try:
+                self._q.put_nowait(_SENTINEL)
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                # Drain-to-sentinel: deliver nothing after stop.
+                return
+            yield item
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Blocking pop with timeout; None on timeout or stop."""
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is _SENTINEL:
+            return None
+        return item
+
+
+class Broadcaster:
+    """Fan one event stream out to many watchers (ref: pkg/watch/mux.go)."""
+
+    def __init__(self, queue_len: int = 1000):
+        self._watchers: List[Watcher] = []
+        self._lock = threading.Lock()
+        self._queue_len = queue_len
+
+    def watch(self) -> Watcher:
+        w = Watcher(self._queue_len)
+        with self._lock:
+            self._watchers.append(w)
+        return w
+
+    def action(self, event_type: str, obj: Any) -> None:
+        ev = Event(event_type, obj)
+        with self._lock:
+            alive = []
+            for w in self._watchers:
+                if w.stopped:
+                    continue
+                if w.send(ev):
+                    alive.append(w)
+                else:
+                    w.stop()  # drop the laggard
+            self._watchers = alive
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for w in self._watchers:
+                w.stop()
+            self._watchers = []

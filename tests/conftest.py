@@ -1,0 +1,15 @@
+"""Test harness config.
+
+Force JAX onto the host CPU platform with 8 virtual devices BEFORE any jax
+import, so sharding/pjit tests exercise a multi-chip mesh without TPU hardware
+(the kubemark move: test master-plane scale with hollow resources;
+ref: pkg/kubemark)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,373 @@
+"""Core substrate tests: quantities, selectors, serde, scheme, store.
+
+Mirrors the reference's table-driven unit style (pkg/labels/selector_test.go,
+pkg/api/serialization_test.go round-trip, pkg/storage tests)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.core import fields, labels
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core import watch as watchpkg
+from kubernetes_tpu.core.errors import AlreadyExists, Conflict, NotFound
+from kubernetes_tpu.core.quantity import Quantity, parse_quantity
+from kubernetes_tpu.core.scheme import default_scheme
+from kubernetes_tpu.core.store import Expired, Store
+
+
+# ------------------------------------------------------------- quantities
+
+@pytest.mark.parametrize("text,milli,value", [
+    ("100m", 100, 1),
+    ("1", 1000, 1),
+    ("4", 4000, 4),
+    ("2.5", 2500, 3),          # Value() rounds up like resource.Quantity
+    ("1Ki", 1024 * 1000, 1024),
+    ("32Gi", 32 * 1024**3 * 1000, 32 * 1024**3),
+    ("200Mi", 200 * 1024**2 * 1000, 200 * 1024**2),
+    ("5k", 5_000_000, 5000),
+    ("0", 0, 0),
+])
+def test_parse_quantity(text, milli, value):
+    q = parse_quantity(text)
+    assert q.milli == milli
+    assert q.value == value
+    assert str(q) == text
+
+
+def test_quantity_add_and_bool():
+    assert (parse_quantity("100m") + parse_quantity("900m")).milli == 1000
+    assert not Quantity(0)
+    assert Quantity(1)
+
+
+def test_quantity_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+# ---------------------------------------------------------------- labels
+
+def test_selector_from_set():
+    sel = labels.selector_from_set({"app": "web", "tier": "fe"})
+    assert sel.matches({"app": "web", "tier": "fe", "extra": "x"})
+    assert not sel.matches({"app": "web"})
+    assert labels.selector_from_set({}).matches({"anything": "yes"})
+
+
+@pytest.mark.parametrize("expr,lbls,want", [
+    ("a=b", {"a": "b"}, True),
+    ("a=b", {"a": "c"}, False),
+    ("a==b", {"a": "b"}, True),
+    ("a!=b", {"a": "c"}, True),
+    ("a!=b", {}, True),              # absent key satisfies !=
+    ("a!=b", {"a": "b"}, False),
+    ("env in (prod,dev)", {"env": "dev"}, True),
+    ("env in (prod,dev)", {"env": "qa"}, False),
+    ("env notin (prod)", {"env": "qa"}, True),
+    ("env notin (prod)", {}, True),
+    ("a", {"a": "anything"}, True),
+    ("a", {}, False),
+    ("!a", {}, True),
+    ("!a", {"a": "x"}, False),
+    ("a=b,c=d", {"a": "b", "c": "d"}, True),
+    ("a=b,c=d", {"a": "b"}, False),
+    ("", {"a": "b"}, True),
+])
+def test_selector_parse(expr, lbls, want):
+    assert labels.parse(expr).matches(lbls) is want
+
+
+def test_selector_parse_invalid():
+    with pytest.raises(ValueError):
+        labels.parse("a=")
+    with pytest.raises(ValueError):
+        labels.parse("env in (a,b")
+
+
+# ---------------------------------------------------------------- fields
+
+def test_field_selector_node_name():
+    sel = fields.parse("spec.nodeName=")
+    assert sel.matches({"spec.nodeName": ""})
+    assert not sel.matches({"spec.nodeName": "node1"})
+    sel2 = fields.parse("spec.unschedulable=false")
+    assert sel2.matches({"spec.unschedulable": "false"})
+    sel3 = fields.parse("metadata.name!=x,status.phase=Running")
+    assert sel3.matches({"metadata.name": "y", "status.phase": "Running"})
+    assert not sel3.matches({"metadata.name": "x", "status.phase": "Running"})
+
+
+# ------------------------------------------------------------------ serde
+
+def make_pod(name="p1", ns="default", cpu="100m", mem="200Mi", node="") -> api.Pod:
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels={"app": name}),
+        spec=api.PodSpec(
+            node_name=node,
+            containers=[api.Container(
+                name="c1", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": parse_quantity(cpu),
+                              "memory": parse_quantity(mem)}),
+                ports=[api.ContainerPort(host_port=8080, container_port=80)],
+            )],
+            node_selector={"disk": "ssd"},
+        ),
+        status=api.PodStatus(phase=api.POD_PENDING),
+    )
+
+
+def test_pod_round_trip():
+    pod = make_pod()
+    wire = default_scheme.encode_dict(pod)
+    assert wire["kind"] == "Pod"
+    assert wire["apiVersion"] == "v1"
+    assert wire["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "100m"
+    assert wire["spec"]["containers"][0]["ports"][0]["hostPort"] == 8080
+    assert wire["spec"]["nodeSelector"] == {"disk": "ssd"}
+    back = default_scheme.decode_dict(wire)
+    assert back == pod
+
+
+def test_node_round_trip():
+    node = api.Node(
+        metadata=api.ObjectMeta(name="n1", labels={"zone": "us-a"}),
+        status=api.NodeStatus(
+            capacity={"cpu": parse_quantity("4"),
+                      "memory": parse_quantity("32Gi"),
+                      "pods": parse_quantity("110")},
+            conditions=[api.NodeCondition(type="Ready", status="True")],
+        ),
+    )
+    back = default_scheme.decode_dict(default_scheme.encode_dict(node))
+    assert back == node
+    assert back.status.capacity["cpu"].milli == 4000
+
+
+def test_unknown_wire_fields_ignored():
+    wire = default_scheme.encode_dict(make_pod())
+    wire["spec"]["bogusField"] = {"x": 1}
+    back = default_scheme.decode_dict(wire)
+    assert back.spec.containers[0].name == "c1"
+
+
+def test_omitempty():
+    wire = default_scheme.encode_dict(api.Pod(metadata=api.ObjectMeta(name="p")))
+    assert "labels" not in wire["metadata"]
+    assert "nodeName" not in wire.get("spec", {})
+
+
+def test_deep_copy_independent():
+    pod = make_pod()
+    cp = default_scheme.deep_copy(pod)
+    assert cp == pod
+    cp.metadata.labels["app"] = "other"
+    assert pod.metadata.labels["app"] == "p1"
+
+
+# ------------------------------------------------------------------ store
+
+def pod_key(ns, name):
+    return f"/registry/pods/{ns}/{name}"
+
+
+def test_store_crud():
+    s = Store()
+    created = s.create(pod_key("default", "p1"), make_pod())
+    assert created.metadata.resource_version == "1"
+    got = s.get(pod_key("default", "p1"))
+    assert got.metadata.name == "p1"
+    with pytest.raises(AlreadyExists):
+        s.create(pod_key("default", "p1"), make_pod())
+    items, rev = s.list("/registry/pods/")
+    assert len(items) == 1 and rev >= 1
+    s.delete(pod_key("default", "p1"))
+    with pytest.raises(NotFound):
+        s.get(pod_key("default", "p1"))
+
+
+def test_store_update_conflict():
+    s = Store()
+    obj = s.create(pod_key("default", "p1"), make_pod())
+    stale = default_scheme.deep_copy(obj)
+    fresh = s.update(pod_key("default", "p1"), obj)
+    assert int(fresh.metadata.resource_version) > int(obj.metadata.resource_version)
+    with pytest.raises(Conflict):
+        s.update(pod_key("default", "p1"), stale)
+
+
+def test_guaranteed_update_bind_semantics():
+    """Bind-only-if-unbound, the reference's assignPod CAS
+    (pkg/registry/pod/etcd/etcd.go:152-189)."""
+    from dataclasses import replace
+    s = Store()
+    s.create(pod_key("default", "p1"), make_pod())
+
+    def bind_to(host):
+        def fn(pod):
+            if pod.spec.node_name:
+                raise Conflict("pod is already assigned to node")
+            return replace(pod, spec=replace(pod.spec, node_name=host))
+        return fn
+
+    out = s.guaranteed_update(pod_key("default", "p1"), bind_to("n1"))
+    assert out.spec.node_name == "n1"
+    with pytest.raises(Conflict):
+        s.guaranteed_update(pod_key("default", "p1"), bind_to("n2"))
+
+
+def test_store_watch_stream_and_replay():
+    s = Store()
+    w0 = s.watch("/registry/pods/")
+    s.create(pod_key("default", "p1"), make_pod("p1"))
+    rev_after_p1 = s.current_revision
+    s.create(pod_key("default", "p2"), make_pod("p2"))
+    s.delete(pod_key("default", "p1"))
+    evs = [w0.next(timeout=1) for _ in range(3)]
+    assert [e.type for e in evs] == [watchpkg.ADDED, watchpkg.ADDED, watchpkg.DELETED]
+    # replay from a historical revision
+    w1 = s.watch("/registry/pods/", since_rev=rev_after_p1)
+    evs = [w1.next(timeout=1) for _ in range(2)]
+    assert [e.type for e in evs] == [watchpkg.ADDED, watchpkg.DELETED]
+    assert evs[0].object.metadata.name == "p2"
+    w0.stop(); w1.stop()
+
+
+def test_store_watch_prefix_isolation():
+    s = Store()
+    w = s.watch("/registry/nodes/")
+    s.create(pod_key("default", "p1"), make_pod())
+    s.create("/registry/nodes//n1", api.Node(metadata=api.ObjectMeta(name="n1")))
+    ev = w.next(timeout=1)
+    assert ev.object.metadata.name == "n1"
+    w.stop()
+
+
+def test_store_watch_window_expiry():
+    s = Store(window=4)
+    for i in range(10):
+        s.create(pod_key("default", f"p{i}"), make_pod(f"p{i}"))
+    with pytest.raises(Expired):
+        s.watch("/registry/pods/", since_rev=1)
+
+
+def test_store_ttl_expiry():
+    s = Store()
+    s.create("/registry/events/default/e1",
+             api.Event(metadata=api.ObjectMeta(name="e1")), ttl=0.05)
+    assert s.get("/registry/events/default/e1").metadata.name == "e1"
+    time.sleep(0.08)
+    with pytest.raises(NotFound):
+        s.get("/registry/events/default/e1")
+    items, _ = s.list("/registry/events/")
+    assert items == []
+
+
+def test_store_batch_bind_throughput_shape():
+    """batch() commits many bindings under one lock pass and bumps one
+    revision each, preserving per-key conflict detection."""
+    from dataclasses import replace
+    s = Store()
+    n = 100
+    for i in range(n):
+        s.create(pod_key("default", f"p{i}"), make_pod(f"p{i}"))
+    rev0 = s.current_revision
+
+    def bind(host):
+        def fn(pod):
+            if pod.spec.node_name:
+                raise Conflict("already bound")
+            return replace(pod, spec=replace(pod.spec, node_name=host))
+        return fn
+
+    out = s.batch([(pod_key("default", f"p{i}"), bind(f"n{i % 7}")) for i in range(n)])
+    assert len(out) == n
+    assert s.current_revision == rev0 + n
+    assert s.get(pod_key("default", "p3")).spec.node_name == "n3"
+
+
+def test_store_concurrent_writers():
+    s = Store()
+    s.create("/registry/counters//c", api.Pod(metadata=api.ObjectMeta(name="c")))
+    from dataclasses import replace
+    def worker():
+        for _ in range(50):
+            s.guaranteed_update(
+                "/registry/counters//c",
+                lambda p: replace(p, metadata=replace(
+                    p.metadata, generation=p.metadata.generation + 1)))
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert s.get("/registry/counters//c").metadata.generation == 200
+
+
+# --------------------------------------------- review-finding regressions
+
+def test_quantity_semantic_equality():
+    assert parse_quantity("100m") + parse_quantity("100m") == parse_quantity("200m")
+    assert parse_quantity("1000m") == parse_quantity("1")
+    assert hash(parse_quantity("1000m")) == hash(parse_quantity("1"))
+
+
+def test_quantity_exact_large_values():
+    assert parse_quantity("9007199254740993").value == 9007199254740993
+    assert parse_quantity("8Ei").value == 8 * 1024**6
+    assert parse_quantity("1E").value == 10**18
+
+
+def test_watch_replay_exceeding_capacity_does_not_deadlock():
+    s = Store()
+    for i in range(50):
+        s.create(pod_key("default", f"p{i}"), make_pod(f"p{i}"))
+    w = s.watch("/registry/pods/", since_rev=1, capacity=2)
+    got = [w.next(timeout=1) for _ in range(49)]
+    assert all(e is not None for e in got)
+    assert s.get(pod_key("default", "p0")).metadata.name == "p0"  # store alive
+    w.stop()
+
+
+def test_laggard_watcher_gets_sentinel_when_full():
+    w = watchpkg.Watcher(capacity=2)
+    assert w.send(watchpkg.Event(watchpkg.ADDED, 1))
+    assert w.send(watchpkg.Event(watchpkg.ADDED, 2))
+    assert not w.send(watchpkg.Event(watchpkg.ADDED, 3))  # full -> laggard
+    w.stop()
+    evs = list(w)  # must terminate
+    assert len(evs) <= 2
+
+
+def test_batch_is_all_or_nothing():
+    from dataclasses import replace
+    s = Store()
+    for i in range(3):
+        s.create(pod_key("default", f"p{i}"), make_pod(f"p{i}"))
+    rev0 = s.current_revision
+
+    def ok(p):
+        return replace(p, spec=replace(p.spec, node_name="n1"))
+
+    def boom(p):
+        raise Conflict("nope")
+
+    with pytest.raises(Conflict):
+        s.batch([(pod_key("default", "p0"), ok),
+                 (pod_key("default", "p1"), boom),
+                 (pod_key("default", "p2"), ok)])
+    assert s.current_revision == rev0
+    assert s.get(pod_key("default", "p0")).spec.node_name == ""
+
+
+def test_expired_round_trips_over_wire():
+    from kubernetes_tpu.core.errors import from_status, Expired as Exp
+    err = from_status(Exp("too old").status())
+    assert isinstance(err, Exp) and err.code == 410
+
+
+def test_notfound_message_includes_name():
+    s = Store()
+    with pytest.raises(NotFound, match="missing-key"):
+        s.get("/registry/pods/default/missing-key")

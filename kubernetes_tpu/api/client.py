@@ -1,0 +1,249 @@
+"""Clients: one interface, in-process and HTTP transports.
+
+Reference: pkg/client/unversioned (fluent REST client, request.go). Agents,
+controllers and the scheduler are written against `Client`; the kubemark-style
+in-process harness wires them straight to the Registry (zero serialization),
+while real deployments go over HTTP with identical semantics — mirroring how
+the reference's integration tests wire components directly to an in-process
+master (test/integration/framework/master_utils.go:92).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Iterator, List, Optional, Tuple
+
+from ..core import types as api
+from ..core.errors import ApiError, from_status
+from ..core.scheme import Scheme, default_scheme
+from ..core.watch import Event, Watcher
+from .registry import Registry
+
+
+class Client:
+    """Verb interface over resources. Implementations: InProcClient,
+    HttpClient."""
+
+    def create(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def get(self, resource: str, name: str, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def list(self, resource: str, namespace: str = "",
+             label_selector: str = "", field_selector: str = ""
+             ) -> Tuple[List[Any], int]:
+        raise NotImplementedError
+
+    def update(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def delete(self, resource: str, name: str, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def watch(self, resource: str, namespace: str = "",
+              since_rev: Optional[int] = None) -> Watcher:
+        raise NotImplementedError
+
+    def bind(self, binding: api.Binding, namespace: str = "") -> Any:
+        raise NotImplementedError
+
+    def bind_batch(self, bindings: List[api.Binding],
+                   namespace: str = "") -> List[Any]:
+        # Default: sequential binds (HTTP transport can't batch in the
+        # reference wire protocol; the in-proc client overrides this).
+        return [self.bind(b, namespace) for b in bindings]
+
+
+class InProcClient(Client):
+    def __init__(self, registry: Registry):
+        self.registry = registry
+
+    def create(self, resource, obj, namespace=""):
+        return self.registry.create(resource, obj, namespace)
+
+    def get(self, resource, name, namespace=""):
+        return self.registry.get(resource, name, namespace)
+
+    def list(self, resource, namespace="", label_selector="", field_selector=""):
+        return self.registry.list(resource, namespace, label_selector,
+                                  field_selector)
+
+    def update(self, resource, obj, namespace=""):
+        return self.registry.update(resource, obj, namespace)
+
+    def update_status(self, resource, obj, namespace=""):
+        return self.registry.update_status(resource, obj, namespace)
+
+    def delete(self, resource, name, namespace=""):
+        return self.registry.delete(resource, name, namespace)
+
+    def watch(self, resource, namespace="", since_rev=None):
+        return self.registry.watch(resource, namespace, since_rev)
+
+    def bind(self, binding, namespace=""):
+        return self.registry.bind(binding, namespace)
+
+    def bind_batch(self, bindings, namespace=""):
+        return self.registry.bind_batch(bindings, namespace)
+
+
+class _HttpWatcher(Watcher):
+    """Adapts a chunked HTTP watch stream to the Watcher interface by
+    pumping parsed events from a reader thread. Holds the raw connection so
+    stop() can shutdown() the socket — closing the buffered response instead
+    would block on the reader's buffer lock until the next heartbeat."""
+
+    def __init__(self, conn, resp, scheme: Scheme, capacity: int = 100_000):
+        super().__init__(capacity)
+        self._conn = conn
+        self._resp = resp
+        self._scheme = scheme
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
+        try:
+            for raw in self._resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                data = json.loads(line)
+                obj = data["object"]
+                if data["type"] == "ERROR":
+                    self.send(Event("ERROR", from_status(obj)))
+                    break
+                self.send(Event(data["type"], self._scheme.decode_dict(obj)))
+        except Exception:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self):
+        try:
+            if self._conn.sock is not None:
+                self._conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        super().stop()
+
+
+class HttpClient(Client):
+    def __init__(self, base_url: str, scheme: Scheme = default_scheme,
+                 timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.scheme = scheme
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ plumbing
+
+    def _url(self, resource: str, namespace: str = "", name: str = "",
+             sub: str = "", query: Optional[dict] = None) -> str:
+        info = Registry.info(resource)
+        parts = [self.base_url, "api/v1"]
+        if info.namespaced and namespace:
+            parts += ["namespaces", namespace]
+        parts.append(resource)
+        if name:
+            parts.append(name)
+        if sub:
+            parts.append(sub)
+        url = "/".join(parts)
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v})
+        return url
+
+    def _do(self, method: str, url: str, body: Any = None,
+            stream: bool = False):
+        data = None
+        headers = {"Accept": "application/json"}
+        if body is not None:
+            data = self.scheme.encode(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if stream else self.timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                status = json.loads(e.read().decode())
+            except Exception:
+                raise ApiError(f"HTTP {e.code} from {url}")
+            raise from_status(status)
+        if stream:
+            return resp
+        payload = resp.read().decode()
+        resp.close()
+        return json.loads(payload) if payload else None
+
+    def _decode(self, data: dict) -> Any:
+        return self.scheme.decode_dict(data)
+
+    # --------------------------------------------------------------- verbs
+
+    def create(self, resource, obj, namespace=""):
+        ns = namespace or getattr(obj.metadata, "namespace", "") or "default"
+        return self._decode(self._do("POST", self._url(resource, ns), obj))
+
+    def get(self, resource, name, namespace=""):
+        ns = namespace or "default"
+        return self._decode(self._do("GET", self._url(resource, ns, name)))
+
+    def list(self, resource, namespace="", label_selector="", field_selector=""):
+        data = self._do("GET", self._url(resource, namespace, query={
+            "labelSelector": label_selector, "fieldSelector": field_selector}))
+        items = [self._decode({**i, "kind": data["kind"][:-4]})
+                 for i in data["items"]]
+        rev = int(data["metadata"].get("resourceVersion") or 0)
+        return items, rev
+
+    def update(self, resource, obj, namespace=""):
+        ns = namespace or obj.metadata.namespace
+        return self._decode(self._do(
+            "PUT", self._url(resource, ns, obj.metadata.name), obj))
+
+    def update_status(self, resource, obj, namespace=""):
+        ns = namespace or obj.metadata.namespace
+        return self._decode(self._do(
+            "PUT", self._url(resource, ns, obj.metadata.name, "status"), obj))
+
+    def delete(self, resource, name, namespace=""):
+        ns = namespace or "default"
+        return self._decode(self._do("DELETE", self._url(resource, ns, name)))
+
+    def watch(self, resource, namespace="", since_rev=None):
+        url = self._url(resource, namespace, query={
+            "watch": "true",
+            "resourceVersion": "" if since_rev is None else str(since_rev)})
+        split = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(split.hostname, split.port)
+        path = split.path + ("?" + split.query if split.query else "")
+        conn.request("GET", path, headers={"Accept": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            body = resp.read().decode()
+            conn.close()
+            try:
+                raise from_status(json.loads(body))
+            except json.JSONDecodeError:
+                raise ApiError(f"HTTP {resp.status} from {url}")
+        return _HttpWatcher(conn, resp, self.scheme)
+
+    def bind(self, binding, namespace=""):
+        ns = namespace or binding.metadata.namespace or "default"
+        return self._decode(self._do(
+            "POST", self._url("bindings", ns), binding))

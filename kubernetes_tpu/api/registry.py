@@ -1,0 +1,312 @@
+"""Per-resource REST strategies over the store — the registry layer.
+
+Reference: pkg/registry/* (19,217 LoC of per-resource strategies over one
+generic etcd store, pkg/registry/generic/etcd/etcd.go:152-527). Here each
+resource is described by a ResourceInfo (kind, scope, field extractor, TTL,
+validation/defaulting hooks) and one Registry executes the generic verbs:
+create (name generation, uid, timestamps, validation), get, list (label +
+field selectors), update, update-status, delete, watch, plus the pod
+`binding` subresource with its bind-only-if-unbound CAS
+(ref: pkg/registry/pod/etcd/etcd.go:121-189 BindingREST/assignPod).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, replace, field as dc_field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import fields as fieldspkg
+from ..core import labels as labelspkg
+from ..core import types as api
+from ..core.errors import BadRequest, Conflict, Invalid, NotFound
+from ..core.scheme import Scheme, default_scheme
+from ..core.store import Store
+from ..core.watch import Watcher
+
+DEFAULT_EVENT_TTL = 60 * 60.0  # ref: --event-ttl default 1h (cmd/kube-apiserver)
+
+
+def _dns1123(name: str) -> bool:
+    if not name or len(name) > 253:
+        return False
+    return all(c.islower() or c.isdigit() or c in ".-" for c in name) and \
+        name[0].isalnum() and name[-1].isalnum()
+
+
+def validate_object_meta(meta: api.ObjectMeta, namespaced: bool) -> None:
+    if not meta.name and not meta.generate_name:
+        raise Invalid("metadata.name: required value")
+    if meta.name and not _dns1123(meta.name):
+        raise Invalid(f"metadata.name: invalid value {meta.name!r}")
+    if namespaced and meta.namespace and not _dns1123(meta.namespace):
+        raise Invalid(f"metadata.namespace: invalid value {meta.namespace!r}")
+
+
+def validate_pod(pod: api.Pod) -> None:
+    validate_object_meta(pod.metadata, True)
+    if not pod.spec.containers:
+        raise Invalid("spec.containers: required value")
+    names = set()
+    for c in pod.spec.containers:
+        if not c.name:
+            raise Invalid("spec.containers[].name: required value")
+        if c.name in names:
+            raise Invalid(f"spec.containers[].name: duplicate {c.name!r}")
+        names.add(c.name)
+    vol_names = {v.name for v in pod.spec.volumes}
+    if len(vol_names) != len(pod.spec.volumes):
+        raise Invalid("spec.volumes[].name: duplicate volume name")
+
+
+def validate_node(node: api.Node) -> None:
+    validate_object_meta(node.metadata, False)
+
+
+@dataclass
+class ResourceInfo:
+    name: str                      # plural resource name, e.g. "pods"
+    kind: str
+    cls: type
+    namespaced: bool = True
+    fields_fn: Callable[[Any], Dict[str, str]] = api.generic_resource_fields
+    validate: Optional[Callable[[Any], None]] = None
+    ttl: Optional[float] = None    # fixed TTL (events)
+    has_status: bool = True
+
+
+RESOURCES: Dict[str, ResourceInfo] = {}
+
+
+def _register(info: ResourceInfo) -> None:
+    RESOURCES[info.name] = info
+
+
+_register(ResourceInfo("pods", "Pod", api.Pod, True, api.pod_resource_fields,
+                       validate_pod))
+_register(ResourceInfo("nodes", "Node", api.Node, False,
+                       api.node_resource_fields, validate_node))
+_register(ResourceInfo("services", "Service", api.Service, True))
+_register(ResourceInfo("endpoints", "Endpoints", api.Endpoints, True,
+                       has_status=False))
+_register(ResourceInfo("replicationcontrollers", "ReplicationController",
+                       api.ReplicationController, True))
+_register(ResourceInfo("events", "Event", api.Event, True,
+                       ttl=DEFAULT_EVENT_TTL, has_status=False))
+_register(ResourceInfo("namespaces", "Namespace", api.Namespace, False))
+_register(ResourceInfo("secrets", "Secret", api.Secret, True, has_status=False))
+_register(ResourceInfo("limitranges", "LimitRange", api.LimitRange, True,
+                       has_status=False))
+_register(ResourceInfo("resourcequotas", "ResourceQuota", api.ResourceQuota, True))
+_register(ResourceInfo("serviceaccounts", "ServiceAccount", api.ServiceAccount,
+                       True, has_status=False))
+# Virtual resource: POST /bindings assigns a pod to a node (no storage of its
+# own; ref: pkg/registry/pod/etcd BindingREST).
+_register(ResourceInfo("bindings", "Binding", api.Binding, True,
+                       has_status=False))
+
+
+class Registry:
+    """Generic REST verbs for every registered resource over one Store."""
+
+    def __init__(self, store: Optional[Store] = None,
+                 scheme: Scheme = default_scheme,
+                 admission: Optional[Callable[[str, str, Any], Any]] = None):
+        self.store = store or Store()
+        self.scheme = scheme
+        # admission(operation, resource, obj) -> obj; raises to reject
+        # (ref: pkg/admission chain invoked from resthandler createHandler)
+        self.admission = admission
+
+    # ------------------------------------------------------------- keys
+
+    @staticmethod
+    def info(resource: str) -> ResourceInfo:
+        try:
+            return RESOURCES[resource]
+        except KeyError:
+            raise NotFound(f'the server could not find resource "{resource}"')
+
+    @staticmethod
+    def key(resource: str, namespace: str, name: str) -> str:
+        return f"/registry/{resource}/{namespace}/{name}"
+
+    @staticmethod
+    def prefix(resource: str, namespace: str = "") -> str:
+        if namespace:
+            return f"/registry/{resource}/{namespace}/"
+        return f"/registry/{resource}/"
+
+    def _namespace_for(self, info: ResourceInfo, obj: Any,
+                       namespace: str) -> str:
+        if not info.namespaced:
+            return ""
+        ns = obj.metadata.namespace or namespace or "default"
+        if namespace and obj.metadata.namespace and namespace != obj.metadata.namespace:
+            raise BadRequest(
+                f"namespace in URL ({namespace}) differs from object "
+                f"({obj.metadata.namespace})")
+        return ns
+
+    # ------------------------------------------------------------ verbs
+
+    def create(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        if resource == "bindings":
+            return self.bind(obj, namespace)
+        info = self.info(resource)
+        if not isinstance(obj, info.cls):
+            raise BadRequest(f"expected {info.kind}, got {type(obj).__name__}")
+        ns = self._namespace_for(info, obj, namespace)
+        meta = obj.metadata
+        name = meta.name
+        if not name and meta.generate_name:
+            # ref: pkg/api/rest names.SimpleNameGenerator (5 random chars)
+            name = meta.generate_name + uuid.uuid4().hex[:5]
+        meta = replace(
+            meta, name=name, namespace=ns,
+            uid=meta.uid or str(uuid.uuid4()),
+            creation_timestamp=meta.creation_timestamp or api.now_rfc3339(),
+            resource_version="")
+        obj = replace(obj, metadata=meta)
+        if info.validate:
+            info.validate(obj)
+        if self.admission:
+            obj = self.admission("CREATE", resource, obj)
+        return self.store.create(self.key(resource, ns, name), obj, ttl=info.ttl)
+
+    def get(self, resource: str, name: str, namespace: str = "") -> Any:
+        info = self.info(resource)
+        ns = namespace or ("default" if info.namespaced else "")
+        try:
+            return self.store.get(self.key(resource, ns, name))
+        except NotFound:
+            raise NotFound(kind=resource, name=name)
+
+    def list(self, resource: str, namespace: str = "",
+             label_selector: str = "", field_selector: str = ""
+             ) -> Tuple[List[Any], int]:
+        info = self.info(resource)
+        lsel = labelspkg.parse(label_selector) if label_selector else None
+        fsel = fieldspkg.parse(field_selector) if field_selector else None
+
+        def pred(o: Any) -> bool:
+            if lsel is not None and not lsel.matches(o.metadata.labels):
+                return False
+            if fsel is not None and not fsel.matches(info.fields_fn(o)):
+                return False
+            return True
+
+        use_pred = pred if (lsel is not None or fsel is not None) else None
+        return self.store.list(self.prefix(resource, namespace), use_pred)
+
+    def update(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        info = self.info(resource)
+        ns = self._namespace_for(info, obj, namespace)
+        if not obj.metadata.name:
+            raise Invalid("metadata.name: required value")
+        if info.validate:
+            info.validate(obj)
+        if self.admission:
+            obj = self.admission("UPDATE", resource, obj)
+        key = self.key(resource, ns, obj.metadata.name)
+        if not obj.metadata.resource_version:
+            # Unconditional update requires the object to exist
+            # (PUT never creates in the reference's generic store).
+            self.store.get(key)
+            return self.store.set(key, obj, ttl=info.ttl)
+        return self.store.update(key, obj)
+
+    def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        """Status subresource: replace only .status, keep spec/meta
+        (ref: pkg/registry/pod/etcd statusStrategy)."""
+        info = self.info(resource)
+        if not info.has_status:
+            raise BadRequest(f"{resource} has no status subresource")
+        ns = self._namespace_for(info, obj, namespace)
+        key = self.key(resource, ns, obj.metadata.name)
+        new_status = obj.status
+
+        def apply(cur: Any) -> Any:
+            return replace(cur, status=new_status)
+
+        return self.store.guaranteed_update(key, apply)
+
+    def delete(self, resource: str, name: str, namespace: str = "") -> Any:
+        info = self.info(resource)
+        ns = namespace or ("default" if info.namespaced else "")
+        try:
+            return self.store.delete(self.key(resource, ns, name))
+        except NotFound:
+            raise NotFound(kind=resource, name=name)
+
+    def delete_collection(self, resource: str, namespace: str = "",
+                          label_selector: str = "",
+                          field_selector: str = "") -> List[Any]:
+        items, _ = self.list(resource, namespace, label_selector, field_selector)
+        out = []
+        for o in items:
+            try:
+                out.append(self.delete(resource, o.metadata.name,
+                                       o.metadata.namespace))
+            except NotFound:
+                pass
+        return out
+
+    def watch(self, resource: str, namespace: str = "",
+              since_rev: Optional[int] = None) -> Watcher:
+        return self.store.watch(self.prefix(resource, namespace), since_rev)
+
+    # ------------------------------------------------- binding subresource
+
+    def bind(self, binding: api.Binding, namespace: str = "") -> api.Pod:
+        """POST bindings: set pod.spec.nodeName iff currently unset, merging
+        binding annotations (ref: pkg/registry/pod/etcd/etcd.go:121
+        BindingREST.Create -> assignPod -> setPodHostAndAnnotations CAS)."""
+        ns = binding.metadata.namespace or namespace or "default"
+        name = binding.metadata.name
+        if not name:
+            raise Invalid("binding.metadata.name: required value")
+        host = binding.target.name
+        if not host:
+            raise Invalid("binding.target.name: required value")
+        annotations = dict(binding.metadata.annotations)
+
+        def assign(pod: api.Pod) -> api.Pod:
+            if pod.spec.node_name:
+                raise Conflict("pod is already assigned to a node")
+            meta = pod.metadata
+            if annotations:
+                meta = replace(meta,
+                               annotations={**meta.annotations, **annotations})
+            return replace(pod, metadata=meta,
+                           spec=replace(pod.spec, node_name=host))
+
+        key = self.key("pods", ns, name)
+        try:
+            return self.store.guaranteed_update(key, assign)
+        except NotFound:
+            raise NotFound(kind="pods", name=name)
+
+    def bind_batch(self, bindings: List[api.Binding],
+                   namespace: str = "") -> List[api.Pod]:
+        """Commit a tile of bindings in one store pass (all-or-nothing) —
+        the batched-commit path the <1s/30k-pod north star requires
+        (SURVEY.md section 7 hard part 2). Conflict semantics per pod are
+        identical to bind()."""
+        ops = []
+        for b in bindings:
+            ns = b.metadata.namespace or namespace or "default"
+            host = b.target.name
+
+            def make_assign(host=host):
+                def assign(pod: api.Pod) -> api.Pod:
+                    if pod.spec.node_name:
+                        raise Conflict(
+                            f"pod {pod.metadata.name} is already assigned")
+                    return replace(pod, spec=replace(pod.spec, node_name=host))
+                return assign
+
+            ops.append((self.key("pods", ns, b.metadata.name), make_assign()))
+        return self.store.batch(ops)

@@ -1,0 +1,288 @@
+"""The API server: REST + watch streaming over the registry.
+
+Reference: pkg/apiserver (route install api_installer.go:64, REST dispatch
+resthandler.go, watch-over-HTTP watch.go:81, MaxInFlightLimit handlers.go:76)
+composed by pkg/master/master.go:279. Routes:
+
+    GET    /healthz | /metrics | /api | /api/v1
+    GET    /api/v1/{resource}                      (cluster-scoped or all-ns)
+    GET    /api/v1/namespaces/{ns}/{resource}      [?labelSelector=&fieldSelector=
+                                                    &watch=true&resourceVersion=]
+    POST   /api/v1[/namespaces/{ns}]/{resource}
+    GET    /api/v1[/namespaces/{ns}]/{resource}/{name}
+    PUT    /api/v1[/namespaces/{ns}]/{resource}/{name}[/status]
+    DELETE /api/v1[/namespaces/{ns}]/{resource}/{name}
+    POST   /api/v1/namespaces/{ns}/bindings        (pod binding subresource)
+    POST   /api/v1/namespaces/{ns}/pods/{name}/binding
+
+Watch responses stream one JSON object per line:
+    {"type": "ADDED|MODIFIED|DELETED|ERROR", "object": {...}}
+matching the reference's watch/json wire format (pkg/watch/json).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+from ..core.errors import ApiError, BadRequest, MethodNotSupported, NotFound, TooManyRequests
+from ..core.scheme import Scheme, default_scheme
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .registry import RESOURCES, Registry
+
+WATCH_HEARTBEAT_SECONDS = 30.0
+
+
+class ApiServer:
+    def __init__(self, registry: Registry, host: str = "127.0.0.1",
+                 port: int = 0, max_in_flight: int = 400,
+                 scheme: Scheme = default_scheme,
+                 metrics: Optional[MetricsRegistry] = None,
+                 authenticator=None, authorizer=None, request_log=None):
+        self.registry = registry
+        self.scheme = scheme
+        self.metrics = metrics or global_metrics
+        # ref: --max-requests-inflight (cmd/kube-apiserver/app/server.go),
+        # MaxInFlightLimit pkg/apiserver/handlers.go:76
+        self._inflight = threading.BoundedSemaphore(max_in_flight)
+        self.authenticator = authenticator
+        self.authorizer = authorizer
+        self.request_log = request_log
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet; httplog is opt-in
+                if server.request_log:
+                    server.request_log(fmt % args)
+
+            def do_GET(self):
+                server.handle(self, "GET")
+
+            def do_POST(self):
+                server.handle(self, "POST")
+
+            def do_PUT(self):
+                server.handle(self, "PUT")
+
+            def do_DELETE(self):
+                server.handle(self, "DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        start = time.monotonic()
+        parsed = urllib.parse.urlsplit(h.path)
+        path = parsed.path.rstrip("/")
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        # Long-running requests (watches) are exempt from the in-flight
+        # limit, or thousands of agents' watches would starve every other
+        # request (ref: pkg/apiserver/handlers.go longRunningRequestRE).
+        long_running = (query.get("watch") in ("true", "1")
+                        or "/watch/" in path or path.endswith("/watch"))
+        if not long_running and not self._inflight.acquire(blocking=False):
+            self._send_error(h, TooManyRequests("too many requests in flight"))
+            return
+        try:
+            self._route(h, method, path, query)
+        except ApiError as e:
+            self._send_error(h, e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # crash-only server, but report the request
+            self._send_error(h, ApiError(f"internal error: {e!r}"))
+        finally:
+            if not long_running:
+                self._inflight.release()
+            self.metrics.observe("apiserver_request_latencies_microseconds",
+                                 (time.monotonic() - start) * 1e6,
+                                 {"verb": method})
+            self.metrics.inc("apiserver_request_count", {"verb": method})
+
+    def _route(self, h, method: str, path: str, query: dict) -> None:
+        if path == "/healthz" or path == "/healthz/ping":
+            return self._send_raw(h, 200, b"ok", "text/plain")
+        if path == "/metrics":
+            return self._send_raw(h, 200, self.metrics.render().encode(),
+                                  "text/plain; version=0.0.4")
+        if path == "/api":
+            return self._send_json(h, 200, {"kind": "APIVersions",
+                                            "versions": ["v1"]})
+        if path in ("/api/v1", ""):
+            return self._send_json(h, 200, {
+                "kind": "APIResourceList", "groupVersion": "v1",
+                "resources": [
+                    {"name": n, "namespaced": i.namespaced, "kind": i.kind}
+                    for n, i in sorted(RESOURCES.items())]})
+
+        parts = [p for p in path.split("/") if p]
+        # strip "api/v1"
+        if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+            raise NotFound(f"path {path!r} not found")
+        parts = parts[2:]
+
+        namespace = ""
+        if parts[0] == "namespaces" and len(parts) >= 3:
+            # /namespaces/{ns}/{resource}...
+            namespace, parts = parts[1], parts[2:]
+        elif parts[0] == "namespaces":
+            # the namespaces resource itself: /api/v1/namespaces[/{name}]
+            pass
+        # also accept the legacy /api/v1/watch/... prefix
+        is_watch_path = parts[0] == "watch"
+        if is_watch_path:
+            parts = parts[1:]
+            if parts and parts[0] == "namespaces" and len(parts) >= 2:
+                namespace, parts = parts[1], parts[2:]
+
+        if not parts:
+            raise NotFound(f"path {path!r} not found")
+        resource = parts[0]
+        name = parts[1] if len(parts) > 1 else ""
+        sub = parts[2] if len(parts) > 2 else ""
+        watching = is_watch_path or query.get("watch") in ("true", "1")
+
+        if method == "GET":
+            if watching and not name:
+                return self._serve_watch(h, resource, namespace, query)
+            if not name:
+                items, rev = self.registry.list(
+                    resource, namespace,
+                    query.get("labelSelector", ""),
+                    query.get("fieldSelector", ""))
+                info = Registry.info(resource)
+                return self._send_json(h, 200, self.scheme.encode_list(
+                    info.kind, items, str(rev)))
+            obj = self.registry.get(resource, name, namespace)
+            return self._send_json(h, 200, self.scheme.encode_dict(obj))
+
+        if method == "POST":
+            body = self._read_body(h)
+            obj = self.scheme.decode_dict(body)
+            if resource == "pods" and sub == "binding":
+                created = self.registry.bind(obj, namespace)
+            else:
+                created = self.registry.create(resource, obj, namespace)
+            return self._send_json(h, 201, self.scheme.encode_dict(created))
+
+        if method == "PUT":
+            if not name:
+                raise MethodNotSupported("PUT requires a resource name")
+            body = self._read_body(h)
+            obj = self.scheme.decode_dict(body)
+            if sub == "status":
+                updated = self.registry.update_status(resource, obj, namespace)
+            elif sub:
+                raise NotFound(f"subresource {sub!r} not found")
+            else:
+                updated = self.registry.update(resource, obj, namespace)
+            return self._send_json(h, 200, self.scheme.encode_dict(updated))
+
+        if method == "DELETE":
+            if not name:
+                deleted = self.registry.delete_collection(
+                    resource, namespace,
+                    query.get("labelSelector", ""),
+                    query.get("fieldSelector", ""))
+                info = Registry.info(resource)
+                return self._send_json(h, 200, self.scheme.encode_list(
+                    info.kind, deleted))
+            obj = self.registry.delete(resource, name, namespace)
+            return self._send_json(h, 200, self.scheme.encode_dict(obj))
+
+        raise MethodNotSupported(f"method {method} not supported")
+
+    # -------------------------------------------------------------- watch
+
+    def _serve_watch(self, h, resource: str, namespace: str, query: dict) -> None:
+        rv = query.get("resourceVersion")
+        since_rev = int(rv) if rv not in (None, "") else None
+        watcher = self.registry.watch(resource, namespace, since_rev)
+        self.metrics.inc("apiserver_watch_count", {"resource": resource})
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def write_chunk(payload: bytes) -> None:
+                h.wfile.write(f"{len(payload):x}\r\n".encode())
+                h.wfile.write(payload + b"\r\n")
+                h.wfile.flush()
+
+            while True:
+                ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
+                if ev is None:
+                    if watcher.stopped:
+                        break
+                    write_chunk(b"\n")  # keep-alive blank line
+                    continue
+                line = json.dumps({
+                    "type": ev.type,
+                    "object": self.scheme.encode_dict(ev.object),
+                }).encode() + b"\n"
+                write_chunk(line)
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watcher.stop()
+
+    # ------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _read_body(h) -> dict:
+        length = int(h.headers.get("Content-Length") or 0)
+        if not length:
+            raise BadRequest("empty request body")
+        raw = h.rfile.read(length)
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise BadRequest(f"invalid JSON body: {e}")
+
+    def _send_json(self, h, code: int, payload: dict) -> None:
+        self._send_raw(h, code, json.dumps(payload).encode(),
+                       "application/json")
+
+    def _send_error(self, h, err: ApiError) -> None:
+        try:
+            self._send_json(h, err.code, err.status())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+    @staticmethod
+    def _send_raw(h, code: int, payload: bytes, ctype: str) -> None:
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)

@@ -151,6 +151,7 @@ class Store:
         may be called outside the lock in future remote-store backends."""
         for _ in range(retries):
             with self._lock:
+                self._gc_expired()
                 entry = self._data.get(key)
                 if entry is None:
                     raise NotFound(name=key)
@@ -187,6 +188,7 @@ class Store:
         scheduler commits a whole tile of bindings per call."""
         out = []
         with self._lock:
+            self._gc_expired()
             # Two-phase: run every update function first, then commit.  A
             # mid-batch failure therefore commits nothing (all-or-nothing),
             # so the scheduler always knows whether a tile of bindings is
@@ -234,18 +236,20 @@ class Store:
 
     # ------------------------------------------------------------- watch
 
-    def watch(self, prefix: str, since_rev: int = 0,
+    def watch(self, prefix: str, since_rev: Optional[int] = None,
               capacity: int = 100_000) -> watchpkg.Watcher:
         """Stream events for keys under prefix with rev > since_rev.
 
-        since_rev=0 means "from now" (no replay). A nonzero since_rev replays
-        from the watch window; if the window no longer covers it, Expired is
-        raised and the client must re-list (ref: cacher.go 'too old resource
-        version').
+        since_rev=None means "from now" (no replay). Any integer — including
+        0, the revision an empty store reports to list() — replays from the
+        watch window, so the list-then-watch sequence is race-free from the
+        very first write. If the window no longer covers since_rev, Expired
+        is raised and the client must re-list (ref: cacher.go 'too old
+        resource version').
         """
         with self._lock:
             replay = []
-            if since_rev:
+            if since_rev is not None:
                 if since_rev < self._oldest_rev:
                     raise Expired(
                         f"resourceVersion {since_rev} is too old "

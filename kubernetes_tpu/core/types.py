@@ -7,8 +7,11 @@ agents and CLI need: Pod, Node, Service, Endpoints, ReplicationController,
 Binding, Event, Namespace, plus small config resources.
 
 All types are plain dataclasses; serialization is handled reflectively by
-core.serde. Mutability is deliberate (controllers patch objects in place and
-write them back through the store's CAS loop).
+core.serde. Although the dataclasses are technically mutable, objects that
+have passed through the store are FROZEN by contract (core.store docstring):
+never mutate one in place — build modified copies with dataclasses.replace
+(cheap shallow copies are safe under the same contract) or scheme.deep_copy,
+and write them back through the store's CAS loop.
 """
 
 from __future__ import annotations

@@ -1,0 +1,117 @@
+"""Minimal Prometheus-style metrics: counters, gauges, summaries.
+
+Reference: the per-binary prometheus registries (pkg/apiserver/metrics,
+plugin/pkg/scheduler/metrics/metrics.go:30-80, pkg/kubelet/metrics) exposed
+on /metrics. We keep the same metric names so dashboards line up.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+
+def _key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(k: Tuple[Tuple[str, str], ...]) -> str:
+    if not k:
+        return ""
+    inner = ",".join(f'{name}="{val}"' for name, val in k)
+    return "{" + inner + "}"
+
+
+class _Summary:
+    """Sliding-window summary: count, sum, and quantiles over the last N
+    observations (enough for the 50th/90th/99th the SLO checks read)."""
+
+    def __init__(self, max_samples: int = 10_000):
+        self.count = 0
+        self.total = 0.0
+        self._samples: List[float] = []   # kept sorted for quantiles
+        self._order: deque = deque()      # insertion order for eviction
+        self._max = max_samples
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if len(self._order) >= self._max:
+            oldest = self._order.popleft()
+            idx = bisect.bisect_left(self._samples, oldest)
+            del self._samples[idx]
+        self._order.append(v)
+        bisect.insort(self._samples, v)
+
+    def quantile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        idx = min(len(self._samples) - 1, int(q * len(self._samples)))
+        return self._samples[idx]
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Dict[tuple, float]] = defaultdict(dict)
+        self._gauges: Dict[str, Dict[tuple, float]] = defaultdict(dict)
+        self._summaries: Dict[str, Dict[tuple, _Summary]] = defaultdict(dict)
+
+    def inc(self, name: str, labels: Optional[Dict[str, str]] = None,
+            by: float = 1.0) -> None:
+        k = _key(labels)
+        with self._lock:
+            self._counters[name][k] = self._counters[name].get(k, 0.0) + by
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[name][_key(labels)] = value
+
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        k = _key(labels)
+        with self._lock:
+            s = self._summaries[name].get(k)
+            if s is None:
+                s = self._summaries[name][k] = _Summary()
+            s.observe(value)
+
+    # ---------------------------------------------------------------- read
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._counters.get(name, {}).get(_key(labels), 0.0)
+
+    def summary(self, name: str,
+                labels: Optional[Dict[str, str]] = None) -> Optional[_Summary]:
+        with self._lock:
+            return self._summaries.get(name, {}).get(_key(labels))
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: List[str] = []
+        with self._lock:
+            for name in sorted(self._counters):
+                out.append(f"# TYPE {name} counter")
+                for k, v in sorted(self._counters[name].items()):
+                    out.append(f"{name}{_fmt_labels(k)} {v}")
+            for name in sorted(self._gauges):
+                out.append(f"# TYPE {name} gauge")
+                for k, v in sorted(self._gauges[name].items()):
+                    out.append(f"{name}{_fmt_labels(k)} {v}")
+            for name in sorted(self._summaries):
+                out.append(f"# TYPE {name} summary")
+                for k, s in sorted(self._summaries[name].items()):
+                    for q in (0.5, 0.9, 0.99):
+                        lbl = dict(k); lbl["quantile"] = str(q)
+                        out.append(f"{name}{_fmt_labels(_key(lbl))} {s.quantile(q)}")
+                    out.append(f"{name}_sum{_fmt_labels(k)} {s.total}")
+                    out.append(f"{name}_count{_fmt_labels(k)} {s.count}")
+        return "\n".join(out) + "\n"
+
+
+#: shared default registry (each binary may still make its own)
+global_metrics = MetricsRegistry()

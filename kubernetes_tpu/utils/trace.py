@@ -1,0 +1,38 @@
+"""Span-with-steps trace logger (ref: pkg/util/trace.go:17-60): record named
+steps; log the whole span only if it exceeded a threshold. Used around REST
+handlers and the scheduler's batch compile/execute path, like the reference
+uses it in resthandler.go and etcd_helper.go."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    def __init__(self, name: str):
+        self.name = name
+        self.start = time.monotonic()
+        self.steps: List[Tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.monotonic(), msg))
+
+    def total_seconds(self) -> float:
+        return time.monotonic() - self.start
+
+    def log_if_long(self, threshold_seconds: float) -> None:
+        if self.total_seconds() >= threshold_seconds:
+            self.log()
+
+    def log(self) -> None:
+        total = self.total_seconds()
+        lines = [f'Trace "{self.name}" (total {total*1000:.1f}ms):']
+        prev = self.start
+        for ts, msg in self.steps:
+            lines.append(f"  [{(ts - prev)*1000:8.1f}ms] {msg}")
+            prev = ts
+        logger.info("\n".join(lines))

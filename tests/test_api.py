@@ -1,0 +1,344 @@
+"""API layer tests: registry strategies, HTTP server round-trips, watch
+streaming, reflector/FIFO/informer (ref test style: pkg/apiserver tests with
+in-process servers, pkg/client/cache/reflector_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.cache import (
+    FIFO, Informer, ObjectCache, Reflector, StoreToServiceLister,
+    meta_namespace_key)
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import (AlreadyExists, Conflict, Invalid,
+                                        NotFound, TooManyRequests)
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.core import watch as watchpkg
+
+
+def mk_pod(name="p1", ns="default", labels=None, node=""):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=api.PodSpec(node_name=node, containers=[api.Container(name="c")]),
+        status=api.PodStatus(phase="Pending"))
+
+
+def mk_node(name="n1"):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(capacity={"cpu": parse_quantity("4"),
+                                        "memory": parse_quantity("8Gi"),
+                                        "pods": parse_quantity("110")}))
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_create_defaults():
+    r = Registry()
+    pod = r.create("pods", mk_pod())
+    assert pod.metadata.uid and pod.metadata.creation_timestamp
+    assert pod.metadata.resource_version == "1"
+    assert pod.metadata.namespace == "default"
+
+
+def test_registry_generate_name():
+    r = Registry()
+    pod = r.create("pods", api.Pod(
+        metadata=api.ObjectMeta(generate_name="web-"),
+        spec=api.PodSpec(containers=[api.Container(name="c")])))
+    assert pod.metadata.name.startswith("web-")
+    assert len(pod.metadata.name) > len("web-")
+
+
+def test_registry_validation():
+    r = Registry()
+    with pytest.raises(Invalid):
+        r.create("pods", api.Pod(metadata=api.ObjectMeta(name="p")))  # no containers
+    with pytest.raises(Invalid):
+        r.create("pods", mk_pod(name="Bad_Name"))
+    with pytest.raises(NotFound):
+        r.get("pods", "nope")
+    with pytest.raises(NotFound):
+        r.info("widgets")
+
+
+def test_registry_field_and_label_selectors():
+    r = Registry()
+    r.create("pods", mk_pod("a", labels={"app": "web"}))
+    r.create("pods", mk_pod("b", labels={"app": "db"}, node="n1"))
+    unassigned, _ = r.list("pods", field_selector="spec.nodeName=")
+    assert [p.metadata.name for p in unassigned] == ["a"]
+    web, _ = r.list("pods", label_selector="app=web")
+    assert [p.metadata.name for p in web] == ["a"]
+
+
+def test_registry_binding_subresource():
+    r = Registry()
+    r.create("pods", mk_pod("p1"))
+    binding = api.Binding(metadata=api.ObjectMeta(name="p1", namespace="default"),
+                          target=api.ObjectReference(kind="Node", name="n1"))
+    pod = r.bind(binding)
+    assert pod.spec.node_name == "n1"
+    with pytest.raises(Conflict):
+        r.bind(binding)
+    with pytest.raises(NotFound):
+        r.bind(api.Binding(metadata=api.ObjectMeta(name="ghost"),
+                           target=api.ObjectReference(name="n1")))
+
+
+def test_registry_bind_batch_all_or_nothing():
+    r = Registry()
+    for i in range(4):
+        r.create("pods", mk_pod(f"p{i}"))
+    bindings = [api.Binding(metadata=api.ObjectMeta(name=f"p{i}", namespace="default"),
+                            target=api.ObjectReference(name=f"n{i}"))
+                for i in range(4)]
+    pods = r.bind_batch(bindings)
+    assert [p.spec.node_name for p in pods] == ["n0", "n1", "n2", "n3"]
+    with pytest.raises(Conflict):
+        r.bind_batch([bindings[0]])
+
+
+def test_registry_update_status_preserves_spec():
+    r = Registry()
+    r.create("pods", mk_pod("p1"))
+    stale = mk_pod("p1")
+    stale.status = api.PodStatus(phase="Running")
+    updated = r.update_status("pods", stale)
+    assert updated.status.phase == "Running"
+    assert updated.spec.containers[0].name == "c"
+
+
+def test_registry_event_ttl_configured():
+    r = Registry()
+    ev = r.create("events", api.Event(
+        metadata=api.ObjectMeta(name="e1"), reason="Scheduled"))
+    assert ev.metadata.resource_version  # stored fine; TTL is 1h default
+
+
+# ---------------------------------------------------------- http server
+
+@pytest.fixture()
+def server():
+    srv = ApiServer(Registry(), port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_http_crud_roundtrip(server):
+    c = HttpClient(server.url)
+    pod = c.create("pods", mk_pod("web-1", labels={"app": "web"}))
+    assert pod.metadata.uid
+    got = c.get("pods", "web-1")
+    assert got.metadata.labels == {"app": "web"}
+    items, rev = c.list("pods")
+    assert len(items) == 1 and rev > 0
+    node = c.create("nodes", mk_node("n1"))
+    assert node.metadata.name == "n1"
+    # bind over HTTP (the extender/binder wire path)
+    c.bind(api.Binding(metadata=api.ObjectMeta(name="web-1", namespace="default"),
+                       target=api.ObjectReference(kind="Node", name="n1")))
+    assert c.get("pods", "web-1").spec.node_name == "n1"
+    # status subresource
+    got = c.get("pods", "web-1")
+    got.status.phase = "Running"
+    updated = c.update_status("pods", got)
+    assert updated.status.phase == "Running"
+    c.delete("pods", "web-1")
+    with pytest.raises(NotFound):
+        c.get("pods", "web-1")
+
+
+def test_http_errors(server):
+    c = HttpClient(server.url)
+    with pytest.raises(NotFound):
+        c.get("pods", "ghost")
+    c.create("pods", mk_pod("dup"))
+    with pytest.raises(AlreadyExists):
+        c.create("pods", mk_pod("dup"))
+    with pytest.raises(Invalid):
+        c.create("pods", api.Pod(metadata=api.ObjectMeta(name="x")))
+
+
+def test_http_list_field_selector(server):
+    c = HttpClient(server.url)
+    c.create("pods", mk_pod("a"))
+    c.create("pods", mk_pod("b", node="n1"))
+    items, _ = c.list("pods", field_selector="spec.nodeName=")
+    assert [p.metadata.name for p in items] == ["a"]
+
+
+def test_http_watch_stream(server):
+    c = HttpClient(server.url)
+    w = c.watch("pods")
+    time.sleep(0.1)  # let the watch connect
+    c.create("pods", mk_pod("w1"))
+    ev = w.next(timeout=5)
+    assert ev is not None and ev.type == watchpkg.ADDED
+    assert ev.object.metadata.name == "w1"
+    c.delete("pods", "w1")
+    ev2 = w.next(timeout=5)
+    assert ev2.type == watchpkg.DELETED
+    w.stop()
+
+
+def test_http_watch_with_resource_version(server):
+    c = HttpClient(server.url)
+    c.create("pods", mk_pod("early"))
+    _, rev = c.list("pods")
+    c.create("pods", mk_pod("late"))
+    w = c.watch("pods", since_rev=rev)
+    ev = w.next(timeout=5)
+    assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "late"
+    w.stop()
+
+
+def test_http_healthz_and_metrics(server):
+    import urllib.request
+    assert urllib.request.urlopen(server.url + "/healthz").read() == b"ok"
+    body = urllib.request.urlopen(server.url + "/metrics").read().decode()
+    assert "apiserver_request_count" in body
+    discovery = urllib.request.urlopen(server.url + "/api/v1").read().decode()
+    assert "pods" in discovery
+
+
+# ------------------------------------------------------------- reflectors
+
+def test_reflector_and_fifo_inproc():
+    r = Registry()
+    client = InProcClient(r)
+    fifo = FIFO()
+    refl = Reflector(client, "pods", field_selector="spec.nodeName=",
+                     store=fifo)
+    r.create("pods", mk_pod("pre"))
+    refl.start()
+    deadline = time.time() + 5
+    popped = fifo.pop(timeout=5)
+    assert popped.metadata.name == "pre"
+    r.create("pods", mk_pod("live"))
+    popped = fifo.pop(timeout=5)
+    assert popped.metadata.name == "live"
+    # bound pods must leave / never enter the unassigned queue
+    r.create("pods", mk_pod("bound", node="n9"))
+    assert fifo.pop(timeout=0.3) is None
+    refl.stop()
+
+
+def test_informer_updates_cache_http():
+    srv = ApiServer(Registry(), port=0).start()
+    try:
+        c = HttpClient(srv.url)
+        inf = Informer(c, "pods").start()
+        assert inf.cache.wait_for_sync(5)
+        c.create("pods", mk_pod("x"))
+        deadline = time.time() + 5
+        while time.time() < deadline and len(inf.cache) < 1:
+            time.sleep(0.02)
+        assert inf.cache.get_by_key("default/x") is not None
+        c.delete("pods", "x")
+        while time.time() < deadline and len(inf.cache) > 0:
+            time.sleep(0.02)
+        assert len(inf.cache) == 0
+        inf.stop()
+    finally:
+        srv.stop()
+
+
+def test_service_lister_matches_pods():
+    cache = ObjectCache()
+    cache.replace([
+        api.Service(metadata=api.ObjectMeta(name="svc", namespace="default"),
+                    spec=api.ServiceSpec(selector={"app": "web"})),
+        api.Service(metadata=api.ObjectMeta(name="none", namespace="default"),
+                    spec=api.ServiceSpec(selector={})),
+    ])
+    lister = StoreToServiceLister(cache)
+    svcs = lister.get_pod_services(mk_pod("p", labels={"app": "web"}))
+    assert [s.metadata.name for s in svcs] == ["svc"]
+    assert lister.get_pod_services(mk_pod("p2", labels={"app": "db"})) == []
+
+
+def test_fifo_coalesces():
+    f = FIFO()
+    f.add(mk_pod("a"))
+    f.add(mk_pod("a", labels={"v": "2"}))
+    got = f.pop(timeout=1)
+    assert got.metadata.labels == {"v": "2"}
+    assert f.pop(timeout=0.05) is None
+
+
+# --------------------------------------------- review-finding regressions
+
+def test_reflector_relist_emits_deletes():
+    """Objects deleted while the watch was down must produce on_delete on
+    re-list, and surviving objects must not re-fire on_add."""
+    r = Registry()
+    client = InProcClient(r)
+    r.create("pods", mk_pod("keep"))
+    r.create("pods", mk_pod("gone"))
+    events = []
+    refl = Reflector(client, "pods",
+                     on_add=lambda o: events.append(("add", o.metadata.name)),
+                     on_update=lambda o, n: events.append(("upd", n.metadata.name)),
+                     on_delete=lambda o: events.append(("del", o.metadata.name)))
+    refl._list_and_watch.__wrapped__ if False else None
+    # first list+watch pass (run the list portion then stop the watch quickly)
+    refl._stop.set()  # make the watch loop exit immediately after setup
+    refl._list_and_watch()
+    assert ("add", "keep") in events and ("add", "gone") in events
+    events.clear()
+    r.delete("pods", "gone")
+    refl._list_and_watch()  # simulates re-list after watch death
+    assert events == [("del", "gone")]  # no duplicate add for "keep"
+
+
+def test_watches_exempt_from_max_in_flight():
+    srv = ApiServer(Registry(), port=0, max_in_flight=2).start()
+    try:
+        c = HttpClient(srv.url)
+        watchers = [c.watch("pods") for _ in range(5)]  # > max_in_flight
+        time.sleep(0.2)
+        # normal requests must still succeed
+        c.create("pods", mk_pod("alive"))
+        items, _ = c.list("pods")
+        assert len(items) == 1
+        for w in watchers:
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.object.metadata.name == "alive"
+            w.stop()
+    finally:
+        srv.stop()
+
+
+def test_summary_quantiles_age_out():
+    from kubernetes_tpu.utils.metrics import _Summary
+    s = _Summary(max_samples=100)
+    for _ in range(100):
+        s.observe(100.0)
+    for _ in range(100):
+        s.observe(1.0)
+    assert s.quantile(0.5) == 1.0  # old slow samples evicted by age
+
+
+def test_guaranteed_update_on_expired_entry_is_notfound():
+    from kubernetes_tpu.core.store import Store
+    s = Store()
+    s.create("/registry/events/default/e", api.Event(
+        metadata=api.ObjectMeta(name="e")), ttl=0.03)
+    time.sleep(0.05)
+    with pytest.raises(NotFound):
+        s.guaranteed_update("/registry/events/default/e", lambda o: o)
+
+
+def test_fifo_len_no_double_count():
+    f = FIFO()
+    f.add(mk_pod("a"))
+    f.delete(mk_pod("a"))
+    f.add(mk_pod("a"))
+    assert len(f) == 1
+    assert f.pop(timeout=1).metadata.name == "a"
+    assert len(f) == 0

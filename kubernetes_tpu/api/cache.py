@@ -129,6 +129,20 @@ class FIFO:
             self._closed = True
             self._cond.notify_all()
 
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def list(self) -> List[Any]:
+        """Snapshot of pending objects (does not consume them)."""
+        with self._cond:
+            return list(self._items.values())
+
+    def contains(self, key: str) -> bool:
+        with self._cond:
+            return key in self._items
+
     def __len__(self) -> int:
         # _items holds exactly the pending objects (popped/deleted keys are
         # removed), so this never double-counts re-added keys.

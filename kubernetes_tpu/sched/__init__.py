@@ -1,0 +1,2 @@
+from .api import HostPriority, Policy
+from .generic import FitError, GenericScheduler, NoNodesAvailable

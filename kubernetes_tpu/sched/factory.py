@@ -1,0 +1,233 @@
+"""Scheduler config factory: watch wiring + algorithm assembly.
+
+Reference: plugin/pkg/scheduler/factory/factory.go:47-452 —
+  - unassigned pods (spec.nodeName= field selector, :260-262) -> FIFO queue
+  - assigned pods (spec.nodeName!=) -> ScheduledPodLister; informer handlers
+    forget modeler assumptions (:92-115)
+  - nodes with spec.unschedulable=false (:281-285) further filtered by the
+    readiness condition predicate (Ready==True && OutOfDisk==False,
+    :241-256)
+  - services + RCs for the spreading priorities
+  - binder POSTs Bindings (:353-364)
+  - default error func: 1s->60s exponential pod backoff + requeue
+    (:376-452)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..api.cache import (FIFO, Informer, ObjectCache, Reflector,
+                         StoreToPodLister, StoreToReplicationControllerLister,
+                         StoreToServiceLister, meta_namespace_key)
+from ..core import types as api
+from ..utils.backoff import Backoff
+from ..utils.ratelimit import TokenBucketRateLimiter
+from . import plugins
+from .api import Policy
+from .extender import HTTPExtender
+from .generic import GenericScheduler, NoNodesAvailable
+from .modeler import SimpleModeler
+from .scheduler import Scheduler, SchedulerConfig
+
+DEFAULT_BIND_PODS_QPS = 50.0   # ref: plugin/cmd/kube-scheduler/app/server.go:69
+DEFAULT_BIND_PODS_BURST = 100  # ref: server.go:70
+
+
+def node_condition_predicate(node: api.Node) -> bool:
+    """(ref: factory.go:241 getNodeConditionPredicate)"""
+    for cond in node.status.conditions:
+        if cond.type == api.NODE_READY and cond.status != api.CONDITION_TRUE:
+            return False
+        if cond.type == api.NODE_OUT_OF_DISK and \
+                cond.status != api.CONDITION_FALSE:
+            return False
+    return True
+
+
+class ReadyNodeLister:
+    """Node lister filtered to schedulable+ready nodes; get() looks up any
+    cached node by name (the NodeInfo role for ServiceAffinity)."""
+
+    def __init__(self, cache: ObjectCache):
+        self.cache = cache
+
+    def list(self) -> List[api.Node]:
+        return [n for n in self.cache.list() if node_condition_predicate(n)]
+
+    def get(self, name: str) -> Optional[api.Node]:
+        return self.cache.get_by_key(name)
+
+
+class Binder:
+    """(ref: factory.go:353 binder — POST bindings)"""
+
+    def __init__(self, client):
+        self.client = client
+
+    def bind(self, binding: api.Binding):
+        return self.client.bind(binding)
+
+
+class PodQueueLister:
+    """Lister view over the pending FIFO (modeler's queuedPods)."""
+
+    def __init__(self, fifo: FIFO):
+        self.fifo = fifo
+
+    def list(self, selector=None) -> List[api.Pod]:
+        pods = self.fifo.list()
+        if selector is not None and not selector.empty():
+            pods = [p for p in pods if selector.matches(p.metadata.labels)]
+        return pods
+
+    def exists(self, pod: api.Pod) -> bool:
+        return self.fifo.contains(meta_namespace_key(pod))
+
+
+class ConfigFactory:
+    """(ref: factory.go:72 NewConfigFactory)"""
+
+    def __init__(self, client, bind_qps: float = DEFAULT_BIND_PODS_QPS,
+                 bind_burst: int = DEFAULT_BIND_PODS_BURST,
+                 rate_limit: bool = True, recorder=None):
+        self.client = client
+        self.pod_queue = FIFO()
+        self.recorder = recorder
+
+        # unassigned pods -> FIFO (ref: createUnassignedPodLW :260)
+        self.unassigned_reflector = Reflector(
+            client, "pods", field_selector="spec.nodeName=",
+            store=self.pod_queue)
+
+        # assigned pods -> ScheduledPodLister; forget modeler assumptions on
+        # add/delete (ref: factory.go:92-115 scheduledPodPopulator)
+        self.scheduled_cache = ObjectCache()
+        self.scheduled_reflector = Reflector(
+            client, "pods", field_selector="spec.nodeName!=",
+            store=self.scheduled_cache,
+            on_add=self._forget, on_delete=self._forget)
+        self.scheduled_pod_lister = StoreToPodLister(self.scheduled_cache)
+
+        # nodes (ref: createNodeLW :281 — spec.unschedulable=false)
+        self.node_informer = Informer(client, "nodes",
+                                      field_selector="spec.unschedulable=false")
+        self.node_lister = ReadyNodeLister(self.node_informer.cache)
+
+        # services + RCs (ref: createServiceLW/createControllerLW :288-295)
+        self.service_informer = Informer(client, "services")
+        self.service_lister = StoreToServiceLister(self.service_informer.cache)
+        self.controller_informer = Informer(client, "replicationcontrollers")
+        self.controller_lister = StoreToReplicationControllerLister(
+            self.controller_informer.cache)
+
+        self.modeler = SimpleModeler(PodQueueLister(self.pod_queue),
+                                     self.scheduled_pod_lister)
+        self.pod_lister = self.modeler  # the merged view the algorithm sees
+        self.backoff = Backoff(1.0, 60.0)  # ref: factory.go podBackoff
+        self.rate_limiter = TokenBucketRateLimiter(bind_qps, bind_burst) \
+            if rate_limit else None
+        self._started = False
+
+    def _forget(self, pod: api.Pod) -> None:
+        self.modeler.locked_action(lambda: self.modeler.forget_pod(pod))
+
+    # ------------------------------------------------------------- wiring
+
+    def start(self) -> "ConfigFactory":
+        if not self._started:
+            self.unassigned_reflector.start()
+            self.scheduled_reflector.start()
+            self.node_informer.start()
+            self.service_informer.start()
+            self.controller_informer.start()
+            self._started = True
+        return self
+
+    def stop(self) -> None:
+        self.pod_queue.close()
+        self.unassigned_reflector.stop()
+        self.scheduled_reflector.stop()
+        self.node_informer.stop()
+        self.service_informer.stop()
+        self.controller_informer.stop()
+
+    def plugin_args(self) -> plugins.PluginFactoryArgs:
+        return plugins.PluginFactoryArgs(
+            pod_lister=self.pod_lister,
+            service_lister=self.service_lister,
+            controller_lister=self.controller_lister,
+            node_lister=self.node_lister)
+
+    # ----------------------------------------------------------- assembly
+
+    def create(self) -> SchedulerConfig:
+        """Default algorithm provider (ref: factory.go Create)."""
+        return self.create_from_provider(plugins.DEFAULT_PROVIDER)
+
+    def create_from_provider(self, provider_name: str) -> SchedulerConfig:
+        predicate_keys, priority_keys = plugins.get_algorithm_provider(
+            provider_name)
+        args = self.plugin_args()
+        return self._create(
+            plugins.get_fit_predicates(predicate_keys, args),
+            plugins.get_priority_configs(priority_keys, args),
+            extenders=[])
+
+    def create_from_config(self, policy: Policy) -> SchedulerConfig:
+        """(ref: factory.go:137 CreateFromConfig — empty lists fall back to
+        the provider defaults)."""
+        args = self.plugin_args()
+        if policy.predicates:
+            predicates = {p.name: plugins.predicate_from_policy(p, args)
+                          for p in policy.predicates}
+        else:
+            keys, _ = plugins.get_algorithm_provider(plugins.DEFAULT_PROVIDER)
+            predicates = plugins.get_fit_predicates(keys, args)
+        if policy.priorities:
+            priorities = [plugins.priority_from_policy(p, args)
+                          for p in policy.priorities]
+        else:
+            _, keys = plugins.get_algorithm_provider(plugins.DEFAULT_PROVIDER)
+            priorities = plugins.get_priority_configs(keys, args)
+        extenders = [HTTPExtender(cfg) for cfg in policy.extenders]
+        return self._create(predicates, priorities, extenders)
+
+    def _create(self, predicates, priorities, extenders) -> SchedulerConfig:
+        algorithm = GenericScheduler(predicates, priorities,
+                                     self.pod_lister, extenders)
+        return SchedulerConfig(
+            algorithm=algorithm,
+            next_pod=self._next_pod,
+            binder=Binder(self.client),
+            node_lister=self.node_lister,
+            modeler=self.modeler,
+            error=self.make_default_error_func(),
+            recorder=self.recorder,
+            bind_pods_rate_limiter=self.rate_limiter)
+
+    def _next_pod(self) -> Optional[api.Pod]:
+        """(ref: factory.go:230 NextPod — blocking FIFO pop)"""
+        return self.pod_queue.pop(timeout=0.5)
+
+    def make_default_error_func(self) -> Callable:
+        """(ref: factory.go:297 makeDefaultErrorFunc — backoff + requeue)"""
+        def error_func(pod: api.Pod, err: Exception) -> None:
+            if isinstance(err, NoNodesAvailable):
+                return  # ref: just wait for nodes
+            key = meta_namespace_key(pod)
+
+            def requeue():
+                self.backoff.wait(key)
+                self.backoff.gc()
+                try:
+                    fresh = self.client.get("pods", pod.metadata.name,
+                                            pod.metadata.namespace)
+                except Exception:
+                    return
+                if not fresh.spec.node_name:
+                    self.pod_queue.add(fresh)
+
+            threading.Thread(target=requeue, daemon=True).start()
+        return error_func

@@ -1,0 +1,85 @@
+"""Algorithm-facing listers + in-memory fakes for tests.
+
+Reference: plugin/pkg/scheduler/algorithm/listers.go (FakePodLister,
+FakeNodeLister, FakeServiceLister, FakeControllerLister). The live
+implementations are api.cache.StoreTo*Lister; these fakes mirror the
+reference's fake-per-boundary test pattern (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core import labels as labelspkg
+from ..core import types as api
+
+
+class FakePodLister:
+    def __init__(self, pods: Sequence[api.Pod] = ()):
+        self.pods = list(pods)
+
+    def list(self, selector: Optional[labelspkg.Selector] = None) -> List[api.Pod]:
+        if selector is None or selector.empty():
+            return list(self.pods)
+        return [p for p in self.pods if selector.matches(p.metadata.labels)]
+
+    def exists(self, pod: api.Pod) -> bool:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        return any((p.metadata.namespace, p.metadata.name) == key
+                   for p in self.pods)
+
+
+class FakeNodeLister:
+    def __init__(self, nodes: Sequence[api.Node] = ()):
+        self.nodes = list(nodes)
+
+    def list(self) -> List[api.Node]:
+        return list(self.nodes)
+
+    def get(self, name: str) -> Optional[api.Node]:
+        for n in self.nodes:
+            if n.metadata.name == name:
+                return n
+        return None
+
+
+class FakeServiceLister:
+    def __init__(self, services: Sequence[api.Service] = ()):
+        self.services = list(services)
+
+    def list(self) -> List[api.Service]:
+        return list(self.services)
+
+    def get_pod_services(self, pod: api.Pod) -> List[api.Service]:
+        out = []
+        for svc in self.services:
+            if svc.metadata.namespace and \
+                    svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.spec.selector
+            if not sel:
+                continue
+            if labelspkg.selector_from_set(sel).matches(pod.metadata.labels):
+                out.append(svc)
+        return out
+
+
+class FakeControllerLister:
+    def __init__(self, controllers: Sequence[api.ReplicationController] = ()):
+        self.controllers = list(controllers)
+
+    def list(self) -> List[api.ReplicationController]:
+        return list(self.controllers)
+
+    def get_pod_controllers(self, pod: api.Pod) -> List[api.ReplicationController]:
+        out = []
+        for rc in self.controllers:
+            if rc.metadata.namespace and \
+                    rc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = rc.spec.selector
+            if not sel:
+                continue
+            if labelspkg.selector_from_set(sel).matches(pod.metadata.labels):
+                out.append(rc)
+        return out

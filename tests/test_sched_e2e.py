@@ -1,0 +1,124 @@
+"""End-to-end scheduler slice: factory wiring + control loop against the
+registry (the reference's integration-test pattern: in-process master +
+components wired directly, test/integration/scheduler_test.go:55)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.sched.factory import ConfigFactory
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+
+def ready_node(name, cpu="4", mem="32Gi", pods="110", labels=None,
+               unschedulable=False):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        spec=api.NodeSpec(unschedulable=unschedulable),
+        status=api.NodeStatus(
+            capacity={"cpu": parse_quantity(cpu),
+                      "memory": parse_quantity(mem),
+                      "pods": parse_quantity(pods)},
+            conditions=[api.NodeCondition(type="Ready", status="True"),
+                        api.NodeCondition(type="OutOfDisk", status="False")]))
+
+
+def pending_pod(name, cpu="100m", mem="200Mi", labels=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity(cpu),
+                          "memory": parse_quantity(mem)}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+def wait_until(cond, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture()
+def cluster():
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    config = factory.create()
+    sched = Scheduler(config).run()
+    yield registry, client
+    sched.stop()
+    factory.stop()
+
+
+def test_single_pod_binds(cluster):
+    registry, client = cluster
+    client.create("nodes", ready_node("n1"))
+    client.create("pods", pending_pod("p1"))
+    assert wait_until(
+        lambda: client.get("pods", "p1").spec.node_name == "n1")
+
+
+def test_unschedulable_and_notready_nodes_excluded(cluster):
+    registry, client = cluster
+    client.create("nodes", ready_node("cordoned", unschedulable=True))
+    bad = ready_node("notready")
+    bad.status.conditions[0].status = "False"
+    client.create("nodes", bad)
+    client.create("nodes", ready_node("good"))
+    client.create("pods", pending_pod("p1"))
+    assert wait_until(
+        lambda: client.get("pods", "p1").spec.node_name == "good")
+
+
+def test_no_fit_stays_pending_then_schedules_after_capacity_arrives(cluster):
+    registry, client = cluster
+    client.create("nodes", ready_node("tiny", cpu="100m", mem="64Mi"))
+    client.create("pods", pending_pod("big", cpu="2", mem="4Gi"))
+    time.sleep(0.4)
+    assert client.get("pods", "big").spec.node_name == ""
+    client.create("nodes", ready_node("roomy"))
+    # backoff starts at 1s; the retry should land within a few seconds
+    assert wait_until(
+        lambda: client.get("pods", "big").spec.node_name == "roomy",
+        timeout=10)
+
+
+def test_hundred_pods_ten_nodes_spread(cluster):
+    """SURVEY.md section 7 milestone 3: 100 pods / 10 nodes, all bound,
+    and the modeler keeps in-flight bindings visible so load spreads."""
+    registry, client = cluster
+    for i in range(10):
+        client.create("nodes", ready_node(f"node-{i:02d}"))
+    for i in range(100):
+        client.create("pods", pending_pod(f"pod-{i:03d}",
+                                          labels={"app": "web"}))
+    assert wait_until(
+        lambda: all(p.spec.node_name
+                    for p in client.list("pods")[0]), timeout=30)
+    per_node = {}
+    pods, _ = client.list("pods")
+    for p in pods:
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    # perfect balance is 10/node; the modeler + least-requested should keep
+    # it tight (the serial reference achieves the same)
+    assert len(per_node) == 10
+    assert max(per_node.values()) <= 14
+
+
+def test_binding_emits_scheduled_pods_into_scheduled_lister(cluster):
+    registry, client = cluster
+    client.create("nodes", ready_node("n1"))
+    client.create("pods", pending_pod("p1"))
+    wait_until(lambda: client.get("pods", "p1").spec.node_name == "n1")
+    unassigned, _ = client.list("pods", field_selector="spec.nodeName=")
+    assert unassigned == []

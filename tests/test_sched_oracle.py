@@ -1,0 +1,481 @@
+"""Oracle scheduler tests, mirroring the reference's table-driven suites
+(predicates_test.go 773 LoC, priorities_test.go 720, selector_spreading_test
+418, generic_scheduler_test.go 358). Expected scores are hand-computed from
+the documented math, not from running either implementation."""
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.sched import predicates as preds
+from kubernetes_tpu.sched import priorities as prios
+from kubernetes_tpu.sched.api import HostPriority
+from kubernetes_tpu.sched.generic import (
+    FitError, GenericScheduler, NoNodesAvailable, find_nodes_that_fit,
+    get_best_hosts, prioritize_nodes, sort_host_priorities)
+from kubernetes_tpu.sched.listers import (FakeControllerLister,
+                                          FakeNodeLister, FakePodLister,
+                                          FakeServiceLister)
+
+
+def rr(cpu=None, mem=None):
+    req = {}
+    if cpu is not None:
+        req["cpu"] = parse_quantity(cpu)
+    if mem is not None:
+        req["memory"] = parse_quantity(mem)
+    return api.ResourceRequirements(requests=req)
+
+
+def cpod(name="p", ns="default", cpu=None, mem=None, labels=None, ports=(),
+         node="", phase="Running", containers=1, node_selector=None,
+         volumes=()):
+    cs = []
+    for i in range(containers):
+        cs.append(api.Container(
+            name=f"c{i}", resources=rr(cpu, mem),
+            ports=[api.ContainerPort(host_port=p) for p in ports]))
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=api.PodSpec(containers=cs, node_name=node,
+                         node_selector=node_selector or {},
+                         volumes=list(volumes)),
+        status=api.PodStatus(phase=phase))
+
+
+def cnode(name="n1", cpu="4", mem="32Gi", pods="110", labels=None,
+          conditions=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        status=api.NodeStatus(
+            capacity={"cpu": parse_quantity(cpu),
+                      "memory": parse_quantity(mem),
+                      "pods": parse_quantity(pods)},
+            conditions=conditions or []))
+
+
+# ------------------------------------------------------------- predicates
+
+class TestPodFitsResources:
+    def test_fits(self):
+        node = cnode(cpu="2", mem="2Gi", pods="10")
+        existing = [cpod("e1", cpu="1", mem="1Gi")]
+        fit, _ = preds.pod_fits_resources(cpod(cpu="1", mem="1Gi"),
+                                          existing, node)
+        assert fit
+
+    def test_exceeds_cpu(self):
+        node = cnode(cpu="2", mem="2Gi", pods="10")
+        existing = [cpod("e1", cpu="1500m", mem="1Gi")]
+        fit, reason = preds.pod_fits_resources(cpod(cpu="1", mem="128Mi"),
+                                               existing, node)
+        assert not fit and reason == preds.POD_EXCEEDS_FREE_CPU
+
+    def test_exceeds_memory(self):
+        node = cnode(cpu="2", mem="2Gi", pods="10")
+        existing = [cpod("e1", cpu="500m", mem="1500Mi")]
+        fit, reason = preds.pod_fits_resources(cpod(cpu="1", mem="1Gi"),
+                                               existing, node)
+        assert not fit and reason == preds.POD_EXCEEDS_FREE_MEMORY
+
+    def test_pod_count_cap(self):
+        node = cnode(cpu="100", mem="100Gi", pods="2")
+        existing = [cpod("e1", cpu="1"), cpod("e2", cpu="1")]
+        fit, reason = preds.pod_fits_resources(cpod(cpu="1"), existing, node)
+        assert not fit and reason == preds.POD_EXCEEDS_MAX_POD_NUMBER
+
+    def test_zero_request_pod_only_counts_pods(self):
+        node = cnode(cpu="1", mem="1Gi", pods="3")
+        # node is cpu-saturated, but a zero-request pod still fits
+        existing = [cpod("e1", cpu="1", mem="1Gi")]
+        fit, _ = preds.pod_fits_resources(cpod(), existing, node)
+        assert fit
+        full = [cpod(f"e{i}") for i in range(3)]
+        fit, reason = preds.pod_fits_resources(cpod(), full, node)
+        assert not fit and reason == preds.POD_EXCEEDS_MAX_POD_NUMBER
+
+    def test_overcommitted_existing_pod_fails_new_pod(self):
+        """Reference quirk: CheckPodsExceedingFreeResources flags ANY
+        non-fitting pod in the list, so an over-capacity existing pod fails
+        the predicate for the incoming pod too (predicates.go:192-222)."""
+        node = cnode(cpu="1", mem="1Gi", pods="10")
+        existing = [cpod("hog", cpu="2")]  # already exceeds capacity
+        fit, reason = preds.pod_fits_resources(cpod("new", cpu="100m"),
+                                               existing, node)
+        assert not fit and reason == preds.POD_EXCEEDS_FREE_CPU
+
+    def test_zero_capacity_means_unlimited(self):
+        node = api.Node(metadata=api.ObjectMeta(name="n"),
+                        status=api.NodeStatus(
+                            capacity={"pods": parse_quantity("10")}))
+        fit, _ = preds.pod_fits_resources(cpod(cpu="1000"), [], node)
+        assert fit
+
+
+class TestPodFitsHostPorts:
+    def test_no_conflict(self):
+        fit, _ = preds.pod_fits_host_ports(cpod(ports=[8080]),
+                                           [cpod("e", ports=[9090])], cnode())
+        assert fit
+
+    def test_conflict(self):
+        fit, _ = preds.pod_fits_host_ports(cpod(ports=[8080]),
+                                           [cpod("e", ports=[8080])], cnode())
+        assert not fit
+
+    def test_port_zero_never_conflicts(self):
+        fit, _ = preds.pod_fits_host_ports(cpod(ports=[0]),
+                                           [cpod("e", ports=[0])], cnode())
+        assert fit
+
+
+class TestHostAndSelector:
+    def test_pod_fits_host(self):
+        p = cpod()
+        p.spec.node_name = "n1"
+        assert preds.pod_fits_host(p, [], cnode("n1"))[0]
+        assert not preds.pod_fits_host(p, [], cnode("n2"))[0]
+        assert preds.pod_fits_host(cpod(), [], cnode("n2"))[0]
+
+    def test_node_selector(self):
+        p = cpod(node_selector={"disk": "ssd"})
+        assert preds.pod_selector_matches(
+            p, [], cnode(labels={"disk": "ssd", "zone": "a"}))[0]
+        assert not preds.pod_selector_matches(
+            p, [], cnode(labels={"disk": "hdd"}))[0]
+        assert preds.pod_selector_matches(cpod(), [], cnode())[0]
+
+    def test_node_label_presence(self):
+        check = preds.new_node_label_predicate(["retiring"], presence=False)
+        assert check(cpod(), [], cnode(labels={}))[0]
+        assert not check(cpod(), [], cnode(labels={"retiring": "soon"}))[0]
+        require = preds.new_node_label_predicate(["zone"], presence=True)
+        assert require(cpod(), [], cnode(labels={"zone": "a"}))[0]
+        assert not require(cpod(), [], cnode(labels={}))[0]
+
+
+def vol_gce(pd, ro=False):
+    return api.Volume(name=pd, gce_persistent_disk=
+                      api.GCEPersistentDiskVolumeSource(pd_name=pd, read_only=ro))
+
+
+def vol_ebs(vid):
+    return api.Volume(name=vid, aws_elastic_block_store=
+                      api.AWSElasticBlockStoreVolumeSource(volume_id=vid))
+
+
+def vol_rbd(mons, pool, image):
+    return api.Volume(name=image, rbd=api.RBDVolumeSource(
+        ceph_monitors=list(mons), rbd_pool=pool, rbd_image=image))
+
+
+class TestNoDiskConflict:
+    def test_gce_rw_conflicts(self):
+        new = cpod(volumes=[vol_gce("pd1")])
+        old = cpod("e", volumes=[vol_gce("pd1")])
+        assert not preds.no_disk_conflict(new, [old], cnode())[0]
+
+    def test_gce_both_ro_ok(self):
+        new = cpod(volumes=[vol_gce("pd1", ro=True)])
+        old = cpod("e", volumes=[vol_gce("pd1", ro=True)])
+        assert preds.no_disk_conflict(new, [old], cnode())[0]
+
+    def test_ebs_any_conflicts(self):
+        new = cpod(volumes=[vol_ebs("vol-1")])
+        old = cpod("e", volumes=[vol_ebs("vol-1")])
+        assert not preds.no_disk_conflict(new, [old], cnode())[0]
+        assert preds.no_disk_conflict(
+            cpod(volumes=[vol_ebs("vol-2")]), [old], cnode())[0]
+
+    def test_rbd_shared_monitor_pool_image(self):
+        new = cpod(volumes=[vol_rbd(["m1", "m2"], "p", "img")])
+        old = cpod("e", volumes=[vol_rbd(["m2", "m3"], "p", "img")])
+        assert not preds.no_disk_conflict(new, [old], cnode())[0]
+        other_pool = cpod("e2", volumes=[vol_rbd(["m2"], "q", "img")])
+        assert preds.no_disk_conflict(new, [other_pool], cnode())[0]
+
+
+# ------------------------------------------------------------- priorities
+
+class TestCalculateScore:
+    @pytest.mark.parametrize("req,cap,want", [
+        (0, 4000, 10),
+        (2000, 4000, 5),
+        (1000, 4000, 7),      # 3000*10/4000 = 7.5 -> 7 (int division)
+        (4000, 4000, 0),
+        (5000, 4000, 0),      # over capacity
+        (100, 0, 0),          # zero capacity
+        (3333, 10000, 6),     # 6667*10/10000 = 6.667 -> 6
+    ])
+    def test_table(self, req, cap, want):
+        assert prios.calculate_score(req, cap) == want
+
+
+class TestLeastRequested:
+    def test_nonzero_defaults(self):
+        # request-less container counts as 100m CPU / 200MB memory
+        assert prios.get_nonzero_requests({}) == (100, 200 * 1024 * 1024)
+        explicit_zero = {"cpu": parse_quantity("0"),
+                         "memory": parse_quantity("0")}
+        assert prios.get_nonzero_requests(explicit_zero) == (0, 0)
+
+    def test_occupancy_math(self):
+        # capacity 4000m / 10000 MB-units; existing 1000m+5000, new 1000m+5000
+        node = api.Node(metadata=api.ObjectMeta(name="n"),
+                        status=api.NodeStatus(capacity={
+                            "cpu": parse_quantity("4"),
+                            "memory": parse_quantity("10000")}))
+        existing = [cpod("e", cpu="1", mem="5000")]
+        new = cpod("new", cpu="1", mem="5000")
+        hp = prios.calculate_resource_occupancy(new, node, existing)
+        # cpu: (4000-2000)*10/4000 = 5 ; mem: (10000-10000)*10/10000 = 0
+        assert hp.score == (5 + 0) // 2 == 2
+
+    def test_least_requested_prefers_empty_node(self):
+        nodes = FakeNodeLister([cnode("busy", cpu="4", mem="8Gi"),
+                                cnode("idle", cpu="4", mem="8Gi")])
+        pods = FakePodLister([cpod("e1", cpu="2", mem="4Gi", node="busy")])
+        out = {h.host: h.score for h in prios.least_requested_priority(
+            cpod("new", cpu="1", mem="1Gi"), pods, nodes)}
+        assert out["idle"] > out["busy"]
+
+    def test_succeeded_pods_ignored(self):
+        nodes = FakeNodeLister([cnode("n1", cpu="4", mem="8Gi")])
+        pods = FakePodLister([
+            cpod("done", cpu="4", mem="8Gi", node="n1", phase="Succeeded")])
+        out = prios.least_requested_priority(cpod("new", cpu="1", mem="1Gi"),
+                                             pods, nodes)
+        # terminal pod freed its resources: (4000-1000)*10/4000=7,
+        # mem (8Gi-1Gi)*10/8Gi = 8.75 -> 8 => (7+8)//2 = 7
+        assert out[0].score == 7
+
+
+class TestBalancedResourceAllocation:
+    def test_balanced_beats_skewed(self):
+        node = cnode("n", cpu="10", mem="10000Mi")
+        balanced = cpod("b", cpu="5", mem="5000Mi")
+        hp = prios.calculate_balanced_resource_allocation(balanced, node, [])
+        assert hp.score == 10  # fractions equal
+        skewed = cpod("s", cpu="9", mem="1000Mi")
+        hp2 = prios.calculate_balanced_resource_allocation(skewed, node, [])
+        # |0.9 - 0.1| = 0.8 -> 10 - 8 = 2
+        assert hp2.score == 2
+
+    def test_over_capacity_scores_zero(self):
+        node = cnode("n", cpu="1", mem="1Gi")
+        hp = prios.calculate_balanced_resource_allocation(
+            cpod("x", cpu="2", mem="512Mi"), node, [])
+        assert hp.score == 0
+
+
+class TestSelectorSpread:
+    def svc(self, name="s", selector=None, ns="default"):
+        return api.Service(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            spec=api.ServiceSpec(selector=selector or {"app": "web"}))
+
+    def test_no_services_all_ten(self):
+        sp = prios.SelectorSpread(FakeServiceLister([]),
+                                  FakeControllerLister([]))
+        out = sp.calculate_spread_priority(
+            cpod(labels={"app": "web"}), FakePodLister([]),
+            FakeNodeLister([cnode("n1"), cnode("n2")]))
+        assert {h.score for h in out} == {10}
+
+    def test_spread_scores(self):
+        sp = prios.SelectorSpread(FakeServiceLister([self.svc()]), None)
+        pods = FakePodLister([
+            cpod("a", labels={"app": "web"}, node="n1"),
+            cpod("b", labels={"app": "web"}, node="n1"),
+            cpod("c", labels={"app": "web"}, node="n2"),
+        ])
+        out = {h.host: h.score for h in sp.calculate_spread_priority(
+            cpod("new", labels={"app": "web"}), pods,
+            FakeNodeLister([cnode("n1"), cnode("n2"), cnode("n3")]))}
+        # maxCount=2: n1 -> 10*(2-2)/2=0, n2 -> 10*(2-1)/2=5, n3 -> 10
+        assert out == {"n1": 0, "n2": 5, "n3": 10}
+
+    def test_unassigned_matching_pod_feeds_max_count(self):
+        """Reference quirk: unassigned matching pods count under host ""
+        and can raise maxCount (selector_spreading.go:84-97)."""
+        sp = prios.SelectorSpread(FakeServiceLister([self.svc()]), None)
+        pods = FakePodLister([
+            cpod("u1", labels={"app": "web"}, node=""),
+            cpod("u2", labels={"app": "web"}, node=""),
+            cpod("a", labels={"app": "web"}, node="n1"),
+        ])
+        out = {h.host: h.score for h in sp.calculate_spread_priority(
+            cpod("new", labels={"app": "web"}), pods,
+            FakeNodeLister([cnode("n1"), cnode("n2")]))}
+        # counts: ""->2 (maxCount=2), n1->1 ; n1: 10*(2-1)/2=5, n2: 10
+        assert out == {"n1": 5, "n2": 10}
+
+    def test_rc_selector_counts(self):
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc", namespace="default"),
+            spec=api.ReplicationControllerSpec(selector={"app": "web"}))
+        sp = prios.SelectorSpread(FakeServiceLister([]),
+                                  FakeControllerLister([rc]))
+        pods = FakePodLister([cpod("a", labels={"app": "web"}, node="n1")])
+        out = {h.host: h.score for h in sp.calculate_spread_priority(
+            cpod("new", labels={"app": "web"}), pods,
+            FakeNodeLister([cnode("n1"), cnode("n2")]))}
+        assert out == {"n1": 0, "n2": 10}
+
+
+class TestServiceAntiAffinity:
+    def test_zone_spread(self):
+        svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                          spec=api.ServiceSpec(selector={"app": "web"}))
+        aa = prios.ServiceAntiAffinity(FakeServiceLister([svc]), "zone")
+        nodes = FakeNodeLister([
+            cnode("a1", labels={"zone": "a"}),
+            cnode("a2", labels={"zone": "a"}),
+            cnode("b1", labels={"zone": "b"}),
+            cnode("nolabel"),
+        ])
+        pods = FakePodLister([
+            cpod("p1", labels={"app": "web"}, node="a1"),
+            cpod("p2", labels={"app": "web"}, node="b1"),
+        ])
+        out = {h.host: h.score for h in aa.calculate_anti_affinity_priority(
+            cpod("new", labels={"app": "web"}), pods, nodes)}
+        # 2 service pods; zone a has 1, zone b has 1: 10*(2-1)/2 = 5 each;
+        # unlabeled nodes score 0
+        assert out == {"a1": 5, "a2": 5, "b1": 5, "nolabel": 0}
+
+
+# ------------------------------------------------------- generic scheduler
+
+def default_predicates():
+    return {"PodFitsResources": preds.pod_fits_resources,
+            "PodFitsHostPorts": preds.pod_fits_host_ports,
+            "MatchNodeSelector": preds.pod_selector_matches,
+            "HostName": preds.pod_fits_host,
+            "NoDiskConflict": preds.no_disk_conflict}
+
+
+class TestGenericScheduler:
+    def test_schedules_to_least_loaded(self):
+        nodes = FakeNodeLister([cnode("busy", cpu="4", mem="8Gi"),
+                                cnode("idle", cpu="4", mem="8Gi")])
+        pods = FakePodLister([cpod("e1", cpu="3", mem="6Gi", node="busy")])
+        gs = GenericScheduler(
+            default_predicates(),
+            [(prios.least_requested_priority, 1)], pods)
+        assert gs.schedule(cpod("new", cpu="1", mem="1Gi"), nodes) == "idle"
+
+    def test_no_nodes(self):
+        gs = GenericScheduler(default_predicates(), [], FakePodLister([]))
+        with pytest.raises(NoNodesAvailable):
+            gs.schedule(cpod(), FakeNodeLister([]))
+
+    def test_fit_error_reports_reasons(self):
+        nodes = FakeNodeLister([cnode("small", cpu="1", mem="1Gi", pods="10")])
+        gs = GenericScheduler(default_predicates(),
+                              [(prios.least_requested_priority, 1)],
+                              FakePodLister([]))
+        with pytest.raises(FitError) as exc:
+            gs.schedule(cpod("big", cpu="8", mem="64Mi"), nodes)
+        assert preds.POD_EXCEEDS_FREE_CPU in str(exc.value)
+
+    def test_equal_priority_when_no_prioritizers(self):
+        nodes = FakeNodeLister([cnode("n1"), cnode("n2")])
+        gs = GenericScheduler(default_predicates(), [], FakePodLister([]))
+        host = gs.schedule(cpod("p", cpu="1"), nodes)
+        assert host in ("n1", "n2")
+
+    def test_deterministic_tie_break_is_reference_sort_head(self):
+        # equal scores -> reference sorts host names DESCENDING after
+        # sort.Reverse; our deterministic pick is that sorted head
+        pl = [HostPriority("a", 5), HostPriority("c", 5), HostPriority("b", 5)]
+        assert get_best_hosts(pl) == ["c", "b", "a"]
+        gs = GenericScheduler({}, [], FakePodLister([]))
+        assert gs.select_host(pl) == "c"
+
+    def test_tie_set_membership(self):
+        nodes = FakeNodeLister([cnode("n1"), cnode("n2"), cnode("n3")])
+        gs = GenericScheduler(default_predicates(),
+                              [(prios.least_requested_priority, 1)],
+                              FakePodLister([]))
+        ties = gs.tie_set(cpod("p", cpu="1", mem="1Gi"), nodes)
+        assert set(ties) == {"n1", "n2", "n3"}  # identical empty nodes
+
+    def test_weighted_priorities_sum(self):
+        nodes = FakeNodeLister([cnode("lab", labels={"pref": "y"}),
+                                cnode("plain")])
+        label_prio = prios.new_node_label_priority("pref", True)
+        gs = GenericScheduler(default_predicates(),
+                              [(label_prio, 3),
+                               (prios.least_requested_priority, 1)],
+                              FakePodLister([]))
+        assert gs.schedule(cpod("p", cpu="1", mem="1Gi"), nodes) == "lab"
+
+    def test_rng_tie_break_stays_in_tie_set(self):
+        import random
+        nodes = FakeNodeLister([cnode(f"n{i}") for i in range(5)])
+        gs = GenericScheduler(default_predicates(),
+                              [(prios.least_requested_priority, 1)],
+                              FakePodLister([]), rng=random.Random(42))
+        ties = set(gs.tie_set(cpod("p", cpu="1"), nodes))
+        for _ in range(20):
+            assert gs.schedule(cpod("p", cpu="1"), nodes) in ties
+
+
+# --------------------------------------------- review-finding regressions
+
+def test_policy_validation_matches_reference():
+    """ref: api/validation/validation.go — priority weight must be positive,
+    extender weight must be non-negative (0 is allowed)."""
+    from kubernetes_tpu.core.errors import Invalid
+    from kubernetes_tpu.sched.api import policy_from_json
+    with pytest.raises(Invalid):
+        policy_from_json('{"priorities":[{"name":"EqualPriority","weight":0}]}')
+    with pytest.raises(Invalid):
+        policy_from_json(
+            '{"extenders":[{"urlPrefix":"http://x","weight":-1}]}')
+    pol = policy_from_json(
+        '{"extenders":[{"urlPrefix":"http://x","prioritizeVerb":"p","weight":0}]}')
+    assert pol.extenders[0].weight == 0
+
+
+def test_service_affinity_inherits_peer_node_labels():
+    """The implicit-affinity path: a pod without the region selector must be
+    restricted to the region of its service peers (predicates.go:334)."""
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(selector={"app": "web"}))
+    peer = cpod("peer", labels={"app": "web"}, node="r1-node")
+    nodes = {
+        "r1-node": cnode("r1-node", labels={"region": "r1"}),
+        "r2-node": cnode("r2-node", labels={"region": "r2"}),
+    }
+    check = preds.new_service_affinity_predicate(
+        FakePodLister([peer]), FakeServiceLister([svc]), ["region"],
+        node_by_name=nodes.get)
+    new = cpod("new", labels={"app": "web"})
+    assert check(new, [], nodes["r1-node"])[0]
+    assert not check(new, [], nodes["r2-node"])[0]
+    # pod that pins the label itself is honored without peer lookup
+    pinned = cpod("pinned", labels={"app": "web"},
+                  node_selector={"region": "r2"})
+    assert check(pinned, [], nodes["r2-node"])[0]
+    assert not check(pinned, [], nodes["r1-node"])[0]
+
+
+def test_scheduler_loop_idles_when_queue_closed():
+    import time as _time
+    from kubernetes_tpu.api.cache import FIFO
+    from kubernetes_tpu.sched.modeler import SimpleModeler
+    from kubernetes_tpu.sched.scheduler import Scheduler, SchedulerConfig
+    fifo = FIFO()
+    fifo.close()
+    calls = []
+    cfg = SchedulerConfig(
+        algorithm=None, next_pod=lambda: (calls.append(1), None)[1],
+        binder=None, node_lister=None,
+        modeler=SimpleModeler(FakePodLister([]), FakePodLister([])),
+        error=lambda p, e: None)
+    s = Scheduler(cfg).run()
+    _time.sleep(0.2)
+    s.stop()
+    assert len(calls) < 100  # ~20 iterations at 10ms backoff, not millions

@@ -1,0 +1,31 @@
+"""TPU batch scheduling engine.
+
+The serial hot loop the reference runs per pod
+(plugin/pkg/scheduler/generic_scheduler.go:111 findNodesThatFit,
+:164 PrioritizeNodes — O(nodes x predicates x pods) of pointer-chasing Go)
+is re-founded here as dense array math on device:
+
+  - host-side encoder (tables.py): api objects -> Struct-of-Arrays cluster
+    state (label/port/disk-key interning into bitsets, integer resource
+    vectors, initial per-node aggregates),
+  - device kernel (engine.py): a jitted `lax.scan` over the pending-pod
+    batch; each step is O(nodes) vector work — predicate masks, integer
+    0..10 priority scores, masked argmax host selection with a
+    deterministic tie-break — with the node axis shardable across a
+    `jax.sharding.Mesh` so the argmax reduces over ICI.
+
+Bit-exactness contract: given the same snapshot, the engine's assignments
+equal the serial oracle's (GenericScheduler with deterministic tie-break)
+pod for pod. Sequential-commit semantics (pod k consumes capacity seen by
+pod k+1) are preserved by the scan carry. Pods using features outside the
+default provider's predicate/priority set take the serial fallback path
+(SURVEY.md section 7 hard part 3: provable fallback).
+"""
+
+from .tables import ClusterSnapshot, EncodeResult, encode_snapshot
+from .engine import BatchEngine, schedule_batch
+
+__all__ = [
+    "ClusterSnapshot", "EncodeResult", "encode_snapshot",
+    "BatchEngine", "schedule_batch",
+]

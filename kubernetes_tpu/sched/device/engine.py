@@ -1,0 +1,273 @@
+"""Device kernel: batch scheduling as a jitted `lax.scan` over pending pods.
+
+Replaces the reference's per-pod serial hot loop
+(plugin/pkg/scheduler/generic_scheduler.go:111 findNodesThatFit,
+:164 PrioritizeNodes, :95 selectHost) with one compiled program:
+
+  per scan step (one pod)           reference equivalent
+  -------------------------------   -----------------------------------
+  predicate masks over [N] vectors  for node { for predicate { ... } }
+  int 0..10 score vectors           for priority { for node { ... } }
+  masked argmax + tie-rank argmax   sort + rand tie-break (selectHost)
+  one-hot state update              Modeler.AssumePod (modeler.go:113)
+
+Sequential-commit semantics (pod k consumes the capacity pod k+1 sees —
+the reference serializes scheduleOne for exactly this reason,
+scheduler.go:120) live in the scan carry: per-node running sums, port and
+volume-conflict bitsets, and selector-spread counts.
+
+Numerics are bit-exact with the serial oracle: resource sums in int64,
+score integer division via floor (all operands non-negative), and the two
+float formulas (BalancedResourceAllocation priorities.go:198,
+SelectorSpread selector_spreading.go:80-114) in float64 exactly as the
+oracle computes them (TPU runs f64/s64 via XLA emulation; the per-step
+vectors are small so the emulation cost is noise).
+
+Multi-chip: the node axis shards across a `jax.sharding.Mesh` — every
+per-step op is node-local except the score max / tie-rank argmax, which
+XLA lowers to ICI all-reduces (the "argmax-reduced over ICI" design from
+BASELINE.json).
+
+Deliberate divergence from the reference (documented, SURVEY.md section 7
+step 4): ties break deterministically to the lexicographically largest
+node name instead of rand.Int()%len (generic_scheduler.go:105); the chosen
+host is always a member of the reference's max-score set.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # exact int64/f64 parity math
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tables import ClusterSnapshot, EncodeResult, encode_snapshot
+
+DEFAULT_WEIGHTS = (1, 1, 1)  # LeastRequested, Balanced, SelectorSpread
+                             # (algorithmprovider/defaults/defaults.go:54-96)
+
+
+class NodeConst(NamedTuple):
+    valid: jax.Array       # bool[N]
+    cpu_cap: jax.Array     # i64[N]
+    mem_cap: jax.Array     # i64[N]
+    pod_cap: jax.Array     # i32[N]
+    labels: jax.Array      # u32[N, L]
+    tie_rank: jax.Array    # i32[N]
+    exceed_cpu: jax.Array  # bool[N]
+    exceed_mem: jax.Array  # bool[N]
+    offgrid_max: jax.Array  # i32[G]
+
+
+class PodXs(NamedTuple):
+    valid: jax.Array       # bool[P]
+    req_cpu: jax.Array     # i64[P]
+    req_mem: jax.Array     # i64[P]
+    zero_req: jax.Array    # bool[P]
+    nz_cpu: jax.Array      # i64[P]
+    nz_mem: jax.Array      # i64[P]
+    sel: jax.Array         # u32[P, L]
+    ports: jax.Array       # u32[P, PW]
+    qany: jax.Array        # u32[P, K]
+    qrw: jax.Array         # u32[P, K]
+    sany: jax.Array        # u32[P, K]
+    srw: jax.Array         # u32[P, K]
+    host_idx: jax.Array    # i32[P]
+    group_id: jax.Array    # i32[P]
+    member: jax.Array      # i32[P, G]
+
+
+class State(NamedTuple):
+    cpu_used: jax.Array    # i64[N]
+    mem_used: jax.Array    # i64[N]
+    nz_cpu: jax.Array      # i64[N]
+    nz_mem: jax.Array      # i64[N]
+    pod_count: jax.Array   # i32[N]
+    port_bits: jax.Array   # u32[N, PW]
+    disk_any: jax.Array    # u32[N, K]
+    disk_rw: jax.Array     # u32[N, K]
+    spread: jax.Array      # i32[G, N]
+
+
+def _step(node: NodeConst, weights: Tuple[int, int, int],
+          state: State, pod) -> Tuple[State, jax.Array]:
+    n = node.valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    # ---- predicate masks (predicates.go:127,192,250,258,403) ----
+    fits_count = state.pod_count < node.pod_cap
+    free_cpu = (node.cpu_cap == 0) | \
+        (node.cpu_cap - state.cpu_used >= pod.req_cpu)
+    free_mem = (node.mem_cap == 0) | \
+        (node.mem_cap - state.mem_used >= pod.req_mem)
+    res_ok = jnp.where(
+        pod.zero_req, fits_count,
+        fits_count & ~node.exceed_cpu & ~node.exceed_mem & free_cpu & free_mem)
+    port_conflict = jnp.any((state.port_bits & pod.ports[None, :]) != 0,
+                            axis=1)
+    sel_ok = jnp.all((pod.sel[None, :] & ~node.labels) == 0, axis=1)
+    host_ok = jnp.where(pod.host_idx == -1, jnp.ones(n, bool),
+                        iota == pod.host_idx)
+    disk_conflict = jnp.any(
+        ((state.disk_any & pod.qany[None, :])
+         | (state.disk_rw & pod.qrw[None, :])) != 0, axis=1)
+    mask = (node.valid & pod.valid & res_ok & ~port_conflict & sel_ok
+            & host_ok & ~disk_conflict)
+
+    # ---- priorities (priorities.go:33,77,198; selector_spreading.go:80) ----
+    safe_cpu = jnp.maximum(node.cpu_cap, 1)
+    safe_mem = jnp.maximum(node.mem_cap, 1)
+    tc = state.nz_cpu + pod.nz_cpu
+    tm = state.nz_mem + pod.nz_mem
+    cpu_score = jnp.where((node.cpu_cap == 0) | (tc > node.cpu_cap),
+                          0, ((node.cpu_cap - tc) * 10) // safe_cpu)
+    mem_score = jnp.where((node.mem_cap == 0) | (tm > node.mem_cap),
+                          0, ((node.mem_cap - tm) * 10) // safe_mem)
+    least_requested = (cpu_score + mem_score) // 2
+
+    cpu_frac = jnp.where(node.cpu_cap == 0, jnp.float64(1.0),
+                         tc.astype(jnp.float64) / safe_cpu.astype(jnp.float64))
+    mem_frac = jnp.where(node.mem_cap == 0, jnp.float64(1.0),
+                         tm.astype(jnp.float64) / safe_mem.astype(jnp.float64))
+    diff = jnp.abs(cpu_frac - mem_frac)
+    balanced = jnp.where(
+        (cpu_frac >= 1.0) | (mem_frac >= 1.0), jnp.int64(0),
+        jnp.floor(jnp.float64(10.0) - diff * 10.0).astype(jnp.int64))
+
+    gid = jnp.maximum(pod.group_id, 0)
+    counts = state.spread[gid]
+    max_count = jnp.maximum(jnp.max(counts), node.offgrid_max[gid])
+    spread_f = (10.0 * (max_count - counts).astype(jnp.float64)
+                / jnp.maximum(max_count, 1).astype(jnp.float64))
+    spread = jnp.where((pod.group_id < 0) | (max_count == 0),
+                       jnp.int64(10), jnp.floor(spread_f).astype(jnp.int64))
+
+    total = (weights[0] * least_requested + weights[1] * balanced
+             + weights[2] * spread)
+
+    # ---- selection (generic_scheduler.go:95 selectHost) ----
+    masked = jnp.where(mask, total, jnp.int64(-1))
+    best = jnp.max(masked)
+    fit_any = best >= 0
+    cand = mask & (masked == best)
+    pick = jnp.argmax(jnp.where(cand, node.tie_rank, -1)).astype(jnp.int32)
+    assigned = jnp.where(fit_any, pick, jnp.int32(-1))
+
+    # ---- assume-pod state update (modeler.go:113) ----
+    oh = (iota == pick) & fit_any
+    oh64 = oh.astype(jnp.int64)
+    ohc = oh[:, None]
+    new_state = State(
+        cpu_used=state.cpu_used + oh64 * pod.req_cpu,
+        mem_used=state.mem_used + oh64 * pod.req_mem,
+        nz_cpu=state.nz_cpu + oh64 * pod.nz_cpu,
+        nz_mem=state.nz_mem + oh64 * pod.nz_mem,
+        pod_count=state.pod_count + oh.astype(jnp.int32),
+        port_bits=jnp.where(ohc, state.port_bits | pod.ports[None, :],
+                            state.port_bits),
+        disk_any=jnp.where(ohc, state.disk_any | pod.sany[None, :],
+                           state.disk_any),
+        disk_rw=jnp.where(ohc, state.disk_rw | pod.srw[None, :],
+                          state.disk_rw),
+        spread=state.spread
+        + pod.member[:, None] * oh.astype(jnp.int32)[None, :])
+    return new_state, assigned
+
+
+def _make_run(weights: Tuple[int, int, int]):
+    def run(node: NodeConst, state: State, pods: PodXs):
+        def step(carry, x):
+            return _step(node, weights, carry, x)
+        return jax.lax.scan(step, state, pods)
+    return run
+
+
+def _node_shardings(mesh: Mesh, axis: str):
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+    node = NodeConst(valid=s(axis), cpu_cap=s(axis), mem_cap=s(axis),
+                     pod_cap=s(axis), labels=s(axis, None), tie_rank=s(axis),
+                     exceed_cpu=s(axis), exceed_mem=s(axis), offgrid_max=s())
+    state = State(cpu_used=s(axis), mem_used=s(axis), nz_cpu=s(axis),
+                  nz_mem=s(axis), pod_count=s(axis), port_bits=s(axis, None),
+                  disk_any=s(axis, None), disk_rw=s(axis, None),
+                  spread=s(None, axis))
+    pods = PodXs(valid=s(), req_cpu=s(), req_mem=s(), zero_req=s(),
+                 nz_cpu=s(), nz_mem=s(), sel=s(), ports=s(), qany=s(),
+                 qrw=s(), sany=s(), srw=s(), host_idx=s(), group_id=s(),
+                 member=s())
+    return node, state, pods
+
+
+class BatchEngine:
+    """Compiled batch scheduler. With a mesh, the node axis shards across
+    devices and the per-step argmax reduces over ICI; without, single-chip.
+    jit caches per (N, P, word-count) shape signature."""
+
+    def __init__(self, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+                 mesh: Optional[Mesh] = None, node_axis: str = "nodes"):
+        self.weights = tuple(int(w) for w in weights)
+        self.mesh = mesh
+        self.node_axis = node_axis
+        run = _make_run(self.weights)
+        if mesh is not None:
+            shardings = _node_shardings(mesh, node_axis)
+            self._run = jax.jit(
+                run, in_shardings=shardings,
+                out_shardings=(shardings[1], NamedSharding(mesh, P())))
+        else:
+            self._run = jax.jit(run)
+
+    @property
+    def n_shards(self) -> int:
+        return 1 if self.mesh is None else self.mesh.devices.size
+
+    def device_args(self, enc: EncodeResult):
+        nt, st, pb = enc.node_tab, enc.init_state, enc.pod_batch
+        node = NodeConst(
+            valid=nt.valid, cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,
+            pod_cap=nt.pod_cap, labels=nt.label_words, tie_rank=nt.tie_rank,
+            exceed_cpu=nt.exceed_cpu, exceed_mem=nt.exceed_mem,
+            offgrid_max=enc.offgrid_max)
+        state = State(cpu_used=st.cpu_used, mem_used=st.mem_used,
+                      nz_cpu=st.nz_cpu, nz_mem=st.nz_mem,
+                      pod_count=st.pod_count, port_bits=st.port_bits,
+                      disk_any=st.disk_any, disk_rw=st.disk_rw,
+                      spread=st.spread)
+        pods = PodXs(valid=pb.valid, req_cpu=pb.req_cpu, req_mem=pb.req_mem,
+                     zero_req=pb.zero_req, nz_cpu=pb.nz_cpu,
+                     nz_mem=pb.nz_mem, sel=pb.sel_words, ports=pb.port_words,
+                     qany=pb.disk_qany, qrw=pb.disk_qrw, sany=pb.disk_sany,
+                     srw=pb.disk_srw, host_idx=pb.host_idx,
+                     group_id=pb.group_id, member=pb.member)
+        return node, state, pods
+
+    def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
+        """-> (assigned node indices i32[P] (-1 = no fit), final state)."""
+        node, state, pods = self.device_args(enc)
+        final_state, assigned = self._run(node, state, pods)
+        return np.asarray(assigned), final_state
+
+    def schedule(self, snap: ClusterSnapshot
+                 ) -> Tuple[List[Optional[str]], EncodeResult]:
+        """Encode + run + decode: one host name (or None) per pending pod."""
+        enc = encode_snapshot(snap, node_pad_to=self.n_shards)
+        assigned, _ = self.run(enc)
+        out: List[Optional[str]] = []
+        for j in range(enc.n_pods):
+            idx = int(assigned[j])
+            out.append(enc.node_names[idx] if idx >= 0 else None)
+        return out, enc
+
+
+def schedule_batch(snap: ClusterSnapshot,
+                   weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
+                   mesh: Optional[Mesh] = None) -> List[Optional[str]]:
+    """One-shot helper (tests, extender sidecar)."""
+    return BatchEngine(weights, mesh).schedule(snap)[0]

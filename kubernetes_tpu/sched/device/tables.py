@@ -1,0 +1,394 @@
+"""Host-side snapshot encoder: api objects -> Struct-of-Arrays device tables.
+
+This is the strings->tensors boundary (SURVEY.md section 7 hard part 3).
+Label key=value pairs, host ports, and volume conflict keys are interned
+into per-batch dictionaries and become bitset words — exact (dictionary
+interning, not hashing), so there is no collision fallback to reason about.
+
+Semantics mirrored bit-for-bit from the serial oracle (and therefore from
+the reference, plugin/pkg/scheduler/algorithm):
+
+  - initial per-node resource sums replay CheckPodsExceedingFreeResources'
+    order-dependent skip-on-misfit accounting (predicates.go:160-185) over
+    the snapshot's pod list order;
+  - nonzero-request default sums (100 milliCPU / 200MiB per container,
+    priorities.go:53-54) are kept separately for the priority math;
+  - selector-spread groups replicate SelectorSpread.calculate_spread_priority
+    (selector_spreading.go:43-114): per (namespace, selector-set) group,
+    per-node match counts over ALL namespace pods (no phase filter — the
+    reference lists everything), plus the max count over hosts outside the
+    node table (unassigned "" bucket and unknown nodes);
+  - volume conflict keys encode NoDiskConflict (predicates.go:75-137):
+    GCE PD read-only nuance via a separate rw bitset, AWS EBS by volume id,
+    Ceph RBD one key per (monitor, pool, image).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...core import types as api
+from ..predicates import get_resource_request
+from ..priorities import get_nonzero_requests
+
+WORD = 32
+
+
+def _words(nbits: int) -> int:
+    return max(1, (nbits + WORD - 1) // WORD)
+
+
+class _Interner:
+    """Exact string->bit-index dictionary."""
+
+    def __init__(self):
+        self.ids: Dict[object, int] = {}
+
+    def intern(self, key: object) -> int:
+        idx = self.ids.get(key)
+        if idx is None:
+            idx = len(self.ids)
+            self.ids[key] = idx
+        return idx
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+
+def _set_bit(row: np.ndarray, idx: int) -> None:
+    row[idx // WORD] |= np.uint32(1 << (idx % WORD))
+
+
+@dataclass
+class ClusterSnapshot:
+    """What the algorithm would see through its listers at batch start.
+
+    `existing_pods` must be in the merged pod lister's list order (scheduled
+    pods then assumed pods — modeler.py list()); the order matters for the
+    exceeding-resources replay. `pending_pods` are the pods to place, in
+    FIFO order, and must not appear in `existing_pods`.
+    """
+    nodes: List[api.Node]
+    existing_pods: List[api.Pod] = field(default_factory=list)
+    services: List[api.Service] = field(default_factory=list)
+    controllers: List[api.ReplicationController] = field(default_factory=list)
+    pending_pods: List[api.Pod] = field(default_factory=list)
+
+
+@dataclass
+class NodeArrays:
+    valid: np.ndarray       # bool[N]
+    cpu_cap: np.ndarray     # i64[N] (milli)
+    mem_cap: np.ndarray     # i64[N] (bytes)
+    pod_cap: np.ndarray     # i32[N]
+    label_words: np.ndarray  # u32[N, L]
+    tie_rank: np.ndarray    # i32[N] — higher wins ties (name-descending pick)
+    exceed_cpu: np.ndarray  # bool[N] — snapshot had a cpu-misfit pod
+    exceed_mem: np.ndarray  # bool[N]
+
+
+@dataclass
+class PodArrays:
+    valid: np.ndarray       # bool[P]
+    req_cpu: np.ndarray     # i64[P]
+    req_mem: np.ndarray     # i64[P]
+    zero_req: np.ndarray    # bool[P]
+    nz_cpu: np.ndarray      # i64[P]
+    nz_mem: np.ndarray      # i64[P]
+    sel_words: np.ndarray   # u32[P, L]
+    port_words: np.ndarray  # u32[P, PW]  (query == set for host ports)
+    disk_qany: np.ndarray   # u32[P, K]
+    disk_qrw: np.ndarray    # u32[P, K]
+    disk_sany: np.ndarray   # u32[P, K]
+    disk_srw: np.ndarray    # u32[P, K]
+    host_idx: np.ndarray    # i32[P] (-1 unpinned, -2 pinned off-table)
+    group_id: np.ndarray    # i32[P] (-1 = no spread selectors)
+    member: np.ndarray      # i32[P, G]
+
+
+@dataclass
+class StateArrays:
+    cpu_used: np.ndarray    # i64[N]
+    mem_used: np.ndarray    # i64[N]
+    nz_cpu: np.ndarray      # i64[N]
+    nz_mem: np.ndarray      # i64[N]
+    pod_count: np.ndarray   # i32[N]
+    port_bits: np.ndarray   # u32[N, PW]
+    disk_any: np.ndarray    # u32[N, K]
+    disk_rw: np.ndarray     # u32[N, K]
+    spread: np.ndarray      # i32[G, N]
+
+
+@dataclass
+class EncodeResult:
+    node_tab: NodeArrays
+    pod_batch: PodArrays
+    init_state: StateArrays
+    offgrid_max: np.ndarray      # i32[G]
+    node_names: List[str]        # index -> name (padded entries "")
+    n_nodes: int                 # valid (unpadded) node count
+    n_pods: int                  # valid (unpadded) pod count
+
+
+def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _pod_spread_selectors(pod: api.Pod,
+                          services: Sequence[api.Service],
+                          controllers: Sequence[api.ReplicationController]
+                          ) -> List[Dict[str, str]]:
+    """Selectors SelectorSpread derives for a pod (selector_spreading.go:50-64
+    via the service/controller listers; an empty lister namespace matches any
+    pod namespace, matching the lister implementations)."""
+    out: List[Dict[str, str]] = []
+    for svc in services:
+        if svc.metadata.namespace and \
+                svc.metadata.namespace != pod.metadata.namespace:
+            continue
+        if svc.spec.selector and \
+                _selector_matches(svc.spec.selector, pod.metadata.labels):
+            out.append(dict(svc.spec.selector))
+    for rc in controllers:
+        if rc.metadata.namespace and \
+                rc.metadata.namespace != pod.metadata.namespace:
+            continue
+        if rc.spec.selector and \
+                _selector_matches(rc.spec.selector, pod.metadata.labels):
+            out.append(dict(rc.spec.selector))
+    return out
+
+
+def _disk_keys(volume: api.Volume) -> Tuple[List[object], bool]:
+    """(conflict keys, gce_read_only). Keys are hashable tuples; RBD yields
+    one key per monitor so a shared monitor is a shared bit
+    (predicates.go:75-117 isVolumeConflict)."""
+    if volume.gce_persistent_disk is not None:
+        return ([("gce", volume.gce_persistent_disk.pd_name)],
+                volume.gce_persistent_disk.read_only)
+    if volume.aws_elastic_block_store is not None:
+        return [("ebs", volume.aws_elastic_block_store.volume_id)], False
+    if volume.rbd is not None:
+        return ([("rbd", mon, volume.rbd.rbd_pool, volume.rbd.rbd_image)
+                 for mon in volume.rbd.ceph_monitors], False)
+    return [], False
+
+
+def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1
+                    ) -> EncodeResult:
+    """Encode a cluster snapshot into device-ready arrays.
+
+    `node_pad_to`: pad the node axis to a multiple of this (shard count);
+    padded nodes have valid=False and never receive assignments.
+    """
+    nodes = snap.nodes
+    n_real = len(nodes)
+    n_pad = max(1, -(-max(n_real, 1) // node_pad_to) * node_pad_to)
+    p = len(snap.pending_pods)
+    p_pad = max(1, p)
+
+    node_idx: Dict[str, int] = {n.metadata.name: i for i, n in enumerate(nodes)}
+
+    # ------------------------------------------------------ dictionaries
+    labels_dict = _Interner()
+    for n in nodes:
+        for kv in n.metadata.labels.items():
+            labels_dict.intern(kv)
+    for pod in snap.pending_pods:
+        for kv in pod.spec.node_selector.items():
+            labels_dict.intern(kv)
+
+    ports_dict = _Interner()
+    disk_dict = _Interner()
+    for pod in list(snap.existing_pods) + list(snap.pending_pods):
+        for c in pod.spec.containers:
+            for cp in c.ports:
+                if cp.host_port != 0:
+                    ports_dict.intern(cp.host_port)
+        for v in pod.spec.volumes:
+            for key in _disk_keys(v)[0]:
+                disk_dict.intern(key)
+
+    L = _words(len(labels_dict))
+    PW = _words(len(ports_dict))
+    K = _words(len(disk_dict))
+
+    # ------------------------------------------------------ node table
+    nt = NodeArrays(
+        valid=np.zeros(n_pad, bool),
+        cpu_cap=np.zeros(n_pad, np.int64),
+        mem_cap=np.zeros(n_pad, np.int64),
+        pod_cap=np.zeros(n_pad, np.int32),
+        label_words=np.zeros((n_pad, L), np.uint32),
+        tie_rank=np.full(n_pad, -1, np.int32),
+        exceed_cpu=np.zeros(n_pad, bool),
+        exceed_mem=np.zeros(n_pad, bool))
+    for i, n in enumerate(nodes):
+        nt.valid[i] = True
+        cap = n.status.capacity
+        nt.cpu_cap[i] = cap["cpu"].milli if "cpu" in cap else 0
+        nt.mem_cap[i] = cap["memory"].value if "memory" in cap else 0
+        nt.pod_cap[i] = cap["pods"].value if "pods" in cap else 0
+        for kv in n.metadata.labels.items():
+            _set_bit(nt.label_words[i], labels_dict.intern(kv))
+    # deterministic tie-break = lexicographically largest name among the
+    # max-score set (reference sort order: score desc then name desc,
+    # api/types.go:164-169 + sort.Reverse) -> rank by name ascending
+    for rank, name in enumerate(sorted(node_idx)):
+        nt.tie_rank[node_idx[name]] = rank
+
+    # ------------------------------------------------------ initial state
+    # group pending pods by spread selector set first so G is known
+    group_ids: Dict[object, int] = {}
+    group_meta: List[Tuple[str, List[Dict[str, str]]]] = []
+    pod_groups: List[int] = []
+    for pod in snap.pending_pods:
+        sels = _pod_spread_selectors(pod, snap.services, snap.controllers)
+        if not sels:
+            pod_groups.append(-1)
+            continue
+        key = (pod.metadata.namespace,
+               frozenset(frozenset(s.items()) for s in sels))
+        gid = group_ids.get(key)
+        if gid is None:
+            gid = len(group_meta)
+            group_ids[key] = gid
+            group_meta.append((pod.metadata.namespace, sels))
+        pod_groups.append(gid)
+    G = max(1, len(group_meta))
+
+    st = StateArrays(
+        cpu_used=np.zeros(n_pad, np.int64),
+        mem_used=np.zeros(n_pad, np.int64),
+        nz_cpu=np.zeros(n_pad, np.int64),
+        nz_mem=np.zeros(n_pad, np.int64),
+        pod_count=np.zeros(n_pad, np.int32),
+        port_bits=np.zeros((n_pad, PW), np.uint32),
+        disk_any=np.zeros((n_pad, K), np.uint32),
+        disk_rw=np.zeros((n_pad, K), np.uint32),
+        spread=np.zeros((G, n_pad), np.int32))
+    offgrid: List[Dict[str, int]] = [dict() for _ in range(G)]
+
+    by_node: Dict[int, List[api.Pod]] = {}
+    for pod in snap.existing_pods:
+        # spread counts use the UNfiltered pod list (selector_spreading.go)
+        for gid, (ns, sels) in enumerate(group_meta):
+            if pod.metadata.namespace != ns:
+                continue
+            if any(_selector_matches(s, pod.metadata.labels) for s in sels):
+                host = pod.spec.node_name
+                i = node_idx.get(host)
+                if i is None:
+                    offgrid[gid][host] = offgrid[gid].get(host, 0) + 1
+                else:
+                    st.spread[gid, i] += 1
+        # everything below mirrors MapPodsToMachines' phase filter
+        # (predicates.go:429,445)
+        if pod.status.phase in (api.POD_SUCCEEDED, api.POD_FAILED):
+            continue
+        i = node_idx.get(pod.spec.node_name)
+        if i is None:
+            continue
+        by_node.setdefault(i, []).append(pod)
+
+    for i, pods in by_node.items():
+        cpu_cap = int(nt.cpu_cap[i])
+        mem_cap = int(nt.mem_cap[i])
+        cpu_used = 0
+        mem_used = 0
+        for pod in pods:
+            # order-dependent skip-on-misfit replay (predicates.go:160-185)
+            req_cpu, req_mem = get_resource_request(pod)
+            fits_cpu = cpu_cap == 0 or (cpu_cap - cpu_used) >= req_cpu
+            fits_mem = mem_cap == 0 or (mem_cap - mem_used) >= req_mem
+            if not fits_cpu:
+                nt.exceed_cpu[i] = True
+            elif not fits_mem:
+                nt.exceed_mem[i] = True
+            else:
+                cpu_used += req_cpu
+                mem_used += req_mem
+            for c in pod.spec.containers:
+                nz_c, nz_m = get_nonzero_requests(c.resources.requests)
+                st.nz_cpu[i] += nz_c
+                st.nz_mem[i] += nz_m
+                for cp in c.ports:
+                    if cp.host_port != 0:
+                        _set_bit(st.port_bits[i],
+                                 ports_dict.intern(cp.host_port))
+            for v in pod.spec.volumes:
+                keys, gce_ro = _disk_keys(v)
+                for key in keys:
+                    bit = disk_dict.intern(key)
+                    _set_bit(st.disk_any[i], bit)
+                    if v.gce_persistent_disk is not None and not gce_ro:
+                        _set_bit(st.disk_rw[i], bit)
+        st.cpu_used[i] = cpu_used
+        st.mem_used[i] = mem_used
+        st.pod_count[i] = len(pods)
+
+    offgrid_max = np.zeros(G, np.int32)
+    for gid, buckets in enumerate(offgrid):
+        if buckets:
+            offgrid_max[gid] = max(buckets.values())
+
+    # ------------------------------------------------------ pod batch
+    pb = PodArrays(
+        valid=np.zeros(p_pad, bool),
+        req_cpu=np.zeros(p_pad, np.int64),
+        req_mem=np.zeros(p_pad, np.int64),
+        zero_req=np.zeros(p_pad, bool),
+        nz_cpu=np.zeros(p_pad, np.int64),
+        nz_mem=np.zeros(p_pad, np.int64),
+        sel_words=np.zeros((p_pad, L), np.uint32),
+        port_words=np.zeros((p_pad, PW), np.uint32),
+        disk_qany=np.zeros((p_pad, K), np.uint32),
+        disk_qrw=np.zeros((p_pad, K), np.uint32),
+        disk_sany=np.zeros((p_pad, K), np.uint32),
+        disk_srw=np.zeros((p_pad, K), np.uint32),
+        host_idx=np.full(p_pad, -1, np.int32),
+        group_id=np.full(p_pad, -1, np.int32),
+        member=np.zeros((p_pad, G), np.int32))
+    for j, pod in enumerate(snap.pending_pods):
+        pb.valid[j] = True
+        req_cpu, req_mem = get_resource_request(pod)
+        pb.req_cpu[j] = req_cpu
+        pb.req_mem[j] = req_mem
+        pb.zero_req[j] = req_cpu == 0 and req_mem == 0
+        for c in pod.spec.containers:
+            nz_c, nz_m = get_nonzero_requests(c.resources.requests)
+            pb.nz_cpu[j] += nz_c
+            pb.nz_mem[j] += nz_m
+            for cp in c.ports:
+                if cp.host_port != 0:
+                    _set_bit(pb.port_words[j], ports_dict.intern(cp.host_port))
+        for kv in pod.spec.node_selector.items():
+            _set_bit(pb.sel_words[j], labels_dict.intern(kv))
+        for v in pod.spec.volumes:
+            keys, gce_ro = _disk_keys(v)
+            is_gce = v.gce_persistent_disk is not None
+            for key in keys:
+                bit = disk_dict.intern(key)
+                _set_bit(pb.disk_sany[j], bit)
+                if is_gce and gce_ro:
+                    _set_bit(pb.disk_qrw[j], bit)
+                else:
+                    _set_bit(pb.disk_qany[j], bit)
+                if is_gce and not gce_ro:
+                    _set_bit(pb.disk_srw[j], bit)
+        if pod.spec.node_name:
+            pb.host_idx[j] = node_idx.get(pod.spec.node_name, -2)
+        pb.group_id[j] = pod_groups[j]
+        for gid, (ns, sels) in enumerate(group_meta):
+            if pod.metadata.namespace != ns:
+                continue
+            if any(_selector_matches(s, pod.metadata.labels) for s in sels):
+                pb.member[j, gid] = 1
+
+    return EncodeResult(
+        node_tab=nt, pod_batch=pb, init_state=st, offgrid_max=offgrid_max,
+        node_names=[n.metadata.name for n in nodes] + [""] * (n_pad - n_real),
+        n_nodes=n_real, n_pods=p)

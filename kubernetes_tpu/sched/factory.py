@@ -214,8 +214,10 @@ class ConfigFactory:
     def make_default_error_func(self) -> Callable:
         """(ref: factory.go:297 makeDefaultErrorFunc — backoff + requeue)"""
         def error_func(pod: api.Pod, err: Exception) -> None:
-            if isinstance(err, NoNodesAvailable):
-                return  # ref: just wait for nodes
+            # ref requeues with backoff for ALL errors — including
+            # ErrNoNodesAvailable, which it only logs differently; the pod
+            # was consumed from the FIFO, so skipping the requeue would
+            # strand it Pending forever
             key = meta_namespace_key(pod)
 
             def requeue():

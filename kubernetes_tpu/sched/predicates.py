@@ -92,9 +92,11 @@ def pod_fits_resources(pod: api.Pod, existing_pods: Sequence[api.Pod],
     pod_cap = node.status.capacity.get("pods")
     pod_cap_value = pod_cap.value if pod_cap is not None else 0
     if req_cpu == 0 and req_mem == 0:
-        # zero-request pods are only limited by the pod-count capacity
-        return len(existing_pods) < pod_cap_value, POD_EXCEEDS_MAX_POD_NUMBER \
-            if len(existing_pods) >= pod_cap_value else None
+        # zero-request pods are only limited by the pod-count capacity;
+        # the reference leaves FailedResourceType unset on this path
+        # (predicates.go:198-199), so the failure map records the
+        # predicate name — reason None mirrors that
+        return len(existing_pods) < pod_cap_value, None
     pods = list(existing_pods) + [pod]
     _, exceeding_cpu, exceeding_mem = check_pods_exceeding_free_resources(pods, node)
     if len(pods) > pod_cap_value:

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..core import labels as labelspkg
 from ..core import types as api
 from .api import HostPriority
+from .predicates import _capacity as _cap_resource
 from .predicates import map_pods_to_machines
 
 DEFAULT_MILLI_CPU_REQUEST = 100                 # ref: priorities.go:53
@@ -66,10 +67,7 @@ def _nonzero_totals(pod: api.Pod, pods: Sequence[api.Pod]) -> Tuple[int, int]:
 
 
 def _cap(node: api.Node, resource: str) -> int:
-    q = node.status.capacity.get(resource)
-    if q is None:
-        return 0
-    return q.milli if resource == "cpu" else q.value
+    return _cap_resource(node, resource)
 
 
 def calculate_resource_occupancy(pod: api.Pod, node: api.Node,

@@ -1,0 +1,211 @@
+"""Parity gate: the TPU batch engine must produce bit-identical assignments
+to the serial oracle (GenericScheduler with deterministic tie-break) on the
+same snapshot — the SURVEY.md section 7 step 4 correctness contract.
+
+The oracle driver replays the live control flow: schedule one pod, assume
+it (append to the visible pod list, as the modeler does), schedule the
+next. Randomized clusters cover every default-provider predicate/priority:
+resource fit (incl. zero-request pods, over-subscribed nodes with the
+order-dependent skip accounting), host ports, node selectors, pinned
+hosts, disk conflicts (GCE ro/rw, EBS, RBD), least-requested, balanced
+allocation, and selector spreading over services/RCs."""
+
+import copy
+import random
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import Quantity
+from kubernetes_tpu.sched import predicates as preds
+from kubernetes_tpu.sched import priorities as prios
+from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
+                                         schedule_batch)
+from kubernetes_tpu.sched.generic import (FitError, GenericScheduler,
+                                          NoNodesAvailable)
+from kubernetes_tpu.sched.listers import (FakeControllerLister,
+                                          FakeNodeLister, FakePodLister,
+                                          FakeServiceLister)
+from kubernetes_tpu.sched.priorities import SelectorSpread
+
+DEFAULT_PREDICATES = {
+    "PodFitsHostPorts": preds.pod_fits_host_ports,
+    "PodFitsResources": preds.pod_fits_resources,
+    "NoDiskConflict": preds.no_disk_conflict,
+    "MatchNodeSelector": preds.pod_selector_matches,
+    "HostName": preds.pod_fits_host,
+}
+
+
+def oracle_schedule(snap: ClusterSnapshot):
+    """Serial reference loop with assume-pod semantics."""
+    existing = list(snap.existing_pods)
+    svc_lister = FakeServiceLister(snap.services)
+    rc_lister = FakeControllerLister(snap.controllers)
+    node_lister = FakeNodeLister(snap.nodes)
+    out = []
+    for pod in snap.pending_pods:
+        pod_lister = FakePodLister(existing)
+        spread = SelectorSpread(svc_lister, rc_lister)
+        gs = GenericScheduler(
+            DEFAULT_PREDICATES,
+            [(prios.least_requested_priority, 1),
+             (prios.balanced_resource_allocation, 1),
+             (spread.calculate_spread_priority, 1)],
+            pod_lister)
+        try:
+            host = gs.schedule(pod, node_lister)
+        except (FitError, NoNodesAvailable):
+            out.append(None)
+            continue
+        out.append(host)
+        bound = copy.deepcopy(pod)
+        bound.spec.node_name = host
+        existing.append(bound)
+    return out
+
+
+def mq(milli):
+    return Quantity(milli)
+
+
+def bq(value):  # whole units (bytes / pod counts)
+    return Quantity(value * 1000)
+
+
+def make_node(name, cpu_milli, mem, pod_cap, labels=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        status=api.NodeStatus(capacity={
+            "cpu": mq(cpu_milli), "memory": bq(mem), "pods": bq(pod_cap)}))
+
+
+MI = 1024 * 1024
+
+
+def rand_volume(rng):
+    kind = rng.randrange(3)
+    if kind == 0:
+        return api.Volume(name="v", gce_persistent_disk=
+                          api.GCEPersistentDiskVolumeSource(
+                              pd_name=f"pd-{rng.randrange(4)}",
+                              read_only=rng.random() < 0.5))
+    if kind == 1:
+        return api.Volume(name="v", aws_elastic_block_store=
+                          api.AWSElasticBlockStoreVolumeSource(
+                              volume_id=f"ebs-{rng.randrange(4)}"))
+    mons = rng.sample(["m1", "m2", "m3"], rng.randrange(1, 3))
+    return api.Volume(name="v", rbd=api.RBDVolumeSource(
+        ceph_monitors=mons, rbd_pool=f"p{rng.randrange(2)}",
+        rbd_image=f"i{rng.randrange(2)}"))
+
+
+def rand_pod(rng, name, ns, assigned_to=None, phase="Pending"):
+    requests = {}
+    r = rng.random()
+    if r < 0.15:
+        pass  # request-less -> nonzero defaults in priorities, zero in fit
+    elif r < 0.25:
+        requests = {"cpu": mq(0), "memory": bq(0)}  # explicit zero
+    else:
+        requests = {"cpu": mq(rng.choice([100, 250, 500, 1000, 2000])),
+                    "memory": bq(rng.choice([64, 128, 256, 512]) * MI)}
+    ports = []
+    if rng.random() < 0.3:
+        ports = [api.ContainerPort(host_port=rng.choice([80, 443, 8080]))]
+    volumes = []
+    if rng.random() < 0.25:
+        volumes = [rand_volume(rng)]
+    labels = {}
+    if rng.random() < 0.7:
+        labels = {"app": rng.choice(["web", "db", "cache"])}
+    node_selector = {}
+    if rng.random() < 0.2:
+        node_selector = {"zone": rng.choice(["a", "b"])}
+    spec = api.PodSpec(
+        containers=[api.Container(
+            name="c", image="img", ports=ports,
+            resources=api.ResourceRequirements(requests=requests))],
+        volumes=volumes, node_selector=node_selector)
+    if assigned_to is not None:
+        spec.node_name = assigned_to
+    elif rng.random() < 0.05:
+        spec.node_name = f"node-{rng.randrange(12)}"  # pinned (HostName)
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns,
+                                           labels=labels),
+                   spec=spec, status=api.PodStatus(phase=phase))
+
+
+def rand_cluster(seed, n_nodes=12, n_existing=20, n_pending=40):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n_nodes):
+        labels = {}
+        if rng.random() < 0.7:
+            labels["zone"] = rng.choice(["a", "b"])
+        if rng.random() < 0.3:
+            labels["disk"] = "ssd"
+        # small pod caps + tight nodes exercise every failure mode
+        nodes.append(make_node(
+            f"node-{i:02d}",
+            cpu_milli=rng.choice([500, 1000, 2000, 4000]),
+            mem=rng.choice([256, 512, 1024, 2048]) * MI,
+            pod_cap=rng.choice([3, 5, 8, 110]),
+            labels=labels))
+    existing = []
+    for i in range(n_existing):
+        ns = rng.choice(["default", "kube-system"])
+        phase = rng.choice(["Running"] * 8 + ["Succeeded", "Failed"])
+        target = rng.choice([n.metadata.name for n in nodes] + ["", "gone"])
+        existing.append(rand_pod(rng, f"ex-{i:03d}", ns,
+                                 assigned_to=target, phase=phase))
+    services = [
+        api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                    spec=api.ServiceSpec(selector={"app": "web"})),
+        api.Service(metadata=api.ObjectMeta(name="db", namespace="default"),
+                    spec=api.ServiceSpec(selector={"app": "db"})),
+    ]
+    controllers = [
+        api.ReplicationController(
+            metadata=api.ObjectMeta(name="cache-rc", namespace="default"),
+            spec=api.ReplicationControllerSpec(selector={"app": "cache"})),
+    ]
+    pending = [rand_pod(rng, f"pod-{i:03d}", rng.choice(["default",
+                                                         "kube-system"]))
+               for i in range(n_pending)]
+    return ClusterSnapshot(nodes=nodes, existing_pods=existing,
+                           services=services, controllers=controllers,
+                           pending_pods=pending)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_engine_matches_oracle(seed):
+    snap = rand_cluster(seed)
+    got = schedule_batch(snap)
+    want = oracle_schedule(snap)
+    assert got == want
+
+
+def test_engine_matches_oracle_tight_capacity():
+    # all pods race for few slots: exercises sequential-commit semantics
+    snap = rand_cluster(99, n_nodes=3, n_existing=5, n_pending=30)
+    assert schedule_batch(snap) == oracle_schedule(snap)
+
+
+def test_engine_empty_and_trivial():
+    empty = ClusterSnapshot(nodes=[], pending_pods=[
+        rand_pod(random.Random(0), "p", "default")])
+    assert schedule_batch(empty) == [None]
+    no_pods = ClusterSnapshot(nodes=[make_node("n", 1000, 512 * MI, 10)])
+    assert schedule_batch(no_pods) == []
+
+
+def test_engine_sharded_matches_unsharded():
+    import jax
+    from jax.sharding import Mesh
+    snap = rand_cluster(7, n_nodes=13, n_existing=15, n_pending=25)
+    devs = jax.devices()
+    mesh = Mesh(__import__("numpy").array(devs), ("nodes",))
+    sharded = BatchEngine(mesh=mesh).schedule(snap)[0]
+    assert sharded == schedule_batch(snap)
+    assert sharded == oracle_schedule(snap)

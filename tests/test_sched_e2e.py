@@ -115,6 +115,20 @@ def test_hundred_pods_ten_nodes_spread(cluster):
     assert max(per_node.values()) <= 14
 
 
+def test_pod_created_before_any_node_schedules_after_node_arrives(cluster):
+    """NoNodesAvailable must requeue with backoff like every other error
+    (ref factory.go:297 retries for all errors) — the pod was consumed
+    from the FIFO, so dropping it would strand it Pending forever."""
+    registry, client = cluster
+    client.create("pods", pending_pod("early"))
+    time.sleep(0.4)
+    assert client.get("pods", "early").spec.node_name == ""
+    client.create("nodes", ready_node("late-node"))
+    assert wait_until(
+        lambda: client.get("pods", "early").spec.node_name == "late-node",
+        timeout=10)
+
+
 def test_binding_emits_scheduled_pods_into_scheduled_lister(cluster):
     registry, client = cluster
     client.create("nodes", ready_node("n1"))

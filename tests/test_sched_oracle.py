@@ -92,7 +92,9 @@ class TestPodFitsResources:
         assert fit
         full = [cpod(f"e{i}") for i in range(3)]
         fit, reason = preds.pod_fits_resources(cpod(), full, node)
-        assert not fit and reason == preds.POD_EXCEEDS_MAX_POD_NUMBER
+        # reference leaves FailedResourceType unset on the zero-request
+        # path (predicates.go:198-199) -> the predicate NAME is recorded
+        assert not fit and reason is None
 
     def test_overcommitted_existing_pod_fails_new_pod(self):
         """Reference quirk: CheckPodsExceedingFreeResources flags ANY

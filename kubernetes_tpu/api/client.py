@@ -167,11 +167,12 @@ class HttpClient(Client):
         return url
 
     def _do(self, method: str, url: str, body: Any = None,
-            stream: bool = False):
-        data = None
+            stream: bool = False, raw_body: Optional[bytes] = None):
+        data = raw_body
         headers = {"Accept": "application/json"}
         if body is not None:
             data = self.scheme.encode(body).encode()
+        if data is not None:
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
@@ -247,3 +248,15 @@ class HttpClient(Client):
         ns = namespace or binding.metadata.namespace or "default"
         return self._decode(self._do(
             "POST", self._url("bindings", ns), binding))
+
+    def bind_batch(self, bindings, namespace=""):
+        """POST a JSON array to the bindings resource: one batched store
+        commit server-side (all-or-nothing; each binding carries its own
+        namespace)."""
+        if not bindings:
+            return []
+        payload = json.dumps(
+            [self.scheme.encode_dict(b) for b in bindings]).encode()
+        data = self._do("POST", self._url("bindings", namespace),
+                        raw_body=payload)
+        return [self._decode({**i, "kind": "Pod"}) for i in data["items"]]

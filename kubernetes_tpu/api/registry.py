@@ -260,10 +260,12 @@ class Registry:
 
     # ------------------------------------------------- binding subresource
 
-    def bind(self, binding: api.Binding, namespace: str = "") -> api.Pod:
-        """POST bindings: set pod.spec.nodeName iff currently unset, merging
-        binding annotations (ref: pkg/registry/pod/etcd/etcd.go:121
-        BindingREST.Create -> assignPod -> setPodHostAndAnnotations CAS)."""
+    @staticmethod
+    def _binding_op(binding: api.Binding, namespace: str):
+        """(store key, CAS update fn) for one binding — shared by bind and
+        bind_batch so validation + annotation-merge semantics can't drift
+        (ref: pkg/registry/pod/etcd/etcd.go:121 BindingREST.Create ->
+        assignPod -> setPodHostAndAnnotations)."""
         ns = binding.metadata.namespace or namespace or "default"
         name = binding.metadata.name
         if not name:
@@ -275,7 +277,8 @@ class Registry:
 
         def assign(pod: api.Pod) -> api.Pod:
             if pod.spec.node_name:
-                raise Conflict("pod is already assigned to a node")
+                raise Conflict(
+                    f"pod {pod.metadata.name} is already assigned to a node")
             meta = pod.metadata
             if annotations:
                 meta = replace(meta,
@@ -283,6 +286,12 @@ class Registry:
             return replace(pod, metadata=meta,
                            spec=replace(pod.spec, node_name=host))
 
+        return ns, name, assign
+
+    def bind(self, binding: api.Binding, namespace: str = "") -> api.Pod:
+        """POST bindings: set pod.spec.nodeName iff currently unset, merging
+        binding annotations."""
+        ns, name, assign = self._binding_op(binding, namespace)
         key = self.key("pods", ns, name)
         try:
             return self.store.guaranteed_update(key, assign)
@@ -293,20 +302,10 @@ class Registry:
                    namespace: str = "") -> List[api.Pod]:
         """Commit a tile of bindings in one store pass (all-or-nothing) —
         the batched-commit path the <1s/30k-pod north star requires
-        (SURVEY.md section 7 hard part 2). Conflict semantics per pod are
-        identical to bind()."""
+        (SURVEY.md section 7 hard part 2). Per-binding validation and
+        conflict semantics are identical to bind()."""
         ops = []
         for b in bindings:
-            ns = b.metadata.namespace or namespace or "default"
-            host = b.target.name
-
-            def make_assign(host=host):
-                def assign(pod: api.Pod) -> api.Pod:
-                    if pod.spec.node_name:
-                        raise Conflict(
-                            f"pod {pod.metadata.name} is already assigned")
-                    return replace(pod, spec=replace(pod.spec, node_name=host))
-                return assign
-
-            ops.append((self.key("pods", ns, b.metadata.name), make_assign()))
+            ns, name, assign = self._binding_op(b, namespace)
+            ops.append((self.key("pods", ns, name), assign))
         return self.store.batch(ops)

@@ -186,6 +186,13 @@ class ApiServer:
 
         if method == "POST":
             body = self._read_body(h)
+            if resource == "bindings" and isinstance(body, list):
+                # batched bindings tile: one store pass, per-pod conflict
+                # semantics (registry.bind_batch)
+                bindings = [self.scheme.decode_dict(b) for b in body]
+                pods = self.registry.bind_batch(bindings, namespace)
+                return self._send_json(h, 201, self.scheme.encode_list(
+                    "Pod", pods, "0"))
             obj = self.scheme.decode_dict(body)
             if resource == "pods" and sub == "binding":
                 created = self.registry.bind(obj, namespace)

@@ -1,0 +1,190 @@
+"""Batch scheduling control loop: the TPU fast path.
+
+Where the reference's scheduleOne is strictly serial (scheduler.go:120 —
+one pod, one Schedule() call, one binding POST), this loop drains the
+pending FIFO into a tile, schedules the whole tile on device in one
+compiled scan (sched.device), and commits the resulting bindings in one
+batched CAS pass (registry.bind_batch — single lock acquisition, per-pod
+conflict semantics; SURVEY.md section 7 hard part 2).
+
+Semantics parity: the engine carries assume-pod state inside the scan, so
+within a tile pod k+1 sees pod k's binding exactly as the serial
+scheduler's modeler would. Across tiles the modeler plays its usual role
+(bind -> assume -> watch confirms). Unschedulable pods take the same
+error path (backoff + requeue) as the serial loop.
+
+Fast-path eligibility is decided by the factory (create_batch): the
+default algorithm provider with no extenders maps onto the engine; any
+custom policy (service affinity, label presence, anti-affinity priority,
+HTTP extenders) falls back to the serial Scheduler — the provable
+fallback the BASELINE requires.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import replace
+from typing import List, Optional
+
+from ..core import types as api
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .device import BatchEngine, ClusterSnapshot
+from .generic import FitError
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class BatchSchedulerConfig:
+    def __init__(self, factory, engine: Optional[BatchEngine] = None,
+                 tile_size: int = 4096, min_pad: int = 64,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.factory = factory
+        self.engine = engine or BatchEngine()
+        self.tile_size = tile_size
+        self.min_pad = min_pad
+        self.metrics = metrics or global_metrics
+
+
+class BatchScheduler:
+    """Tile-at-a-time scheduler over the device engine."""
+
+    def __init__(self, config: BatchSchedulerConfig):
+        self.config = config
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self) -> "BatchScheduler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="batch-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                busy = self.schedule_tile()
+            except Exception:
+                # schedule_tile itself routes pod-level failures; anything
+                # escaping here would otherwise kill the daemon thread and
+                # stall scheduling cluster-wide
+                busy = True
+            if not busy:
+                self._stop.wait(0.01)
+
+    def _drain_tile(self) -> List[api.Pod]:
+        f = self.config.factory
+        pods: List[api.Pod] = []
+        pod = f.pod_queue.pop(timeout=0.5)
+        if pod is None:
+            return pods
+        pods.append(pod)
+        while len(pods) < self.config.tile_size:
+            pod = f.pod_queue.pop(timeout=0)
+            if pod is None:
+                break
+            pods.append(pod)
+        return pods
+
+    def schedule_tile(self) -> bool:
+        """Returns True if any pods were processed."""
+        c = self.config
+        f = c.factory
+        pods = self._drain_tile()
+        if not pods:
+            return False
+        if f.rate_limiter is not None:
+            for _ in pods:
+                f.rate_limiter.accept()
+        start = time.monotonic()
+
+        try:
+            snap = ClusterSnapshot(
+                nodes=f.node_lister.list(),
+                existing_pods=f.pod_lister.list(),
+                services=f.service_lister.list(),
+                controllers=f.controller_lister.list(),
+                pending_pods=pods)
+            # pad the pod axis to stable shapes -> XLA compiles once per tier
+            pad = min(max(_next_pow2(len(pods)), c.min_pad), c.tile_size)
+            hosts, _enc = self.config.engine.schedule(snap, pod_pad_to=pad)
+        except Exception as e:
+            # encode/device failure: the tile is already drained from the
+            # FIFO, so every pod must take the error path (backoff+requeue)
+            # like the serial loop's algorithm failures (scheduler.go:129)
+            for pod in pods:
+                if f.recorder is not None:
+                    f.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                      str(e))
+                self._error(pod, e)
+            return True
+        c.metrics.observe("scheduling_algorithm_latency_microseconds",
+                          (time.monotonic() - start) * 1e6)
+
+        scheduled = [(pod, host) for pod, host in zip(pods, hosts)
+                     if host is not None]
+        unscheduled = [pod for pod, host in zip(pods, hosts) if host is None]
+
+        def bind_and_assume():
+            bindings = [api.Binding(
+                metadata=api.ObjectMeta(namespace=p.metadata.namespace,
+                                        name=p.metadata.name),
+                target=api.ObjectReference(kind="Node", name=h))
+                for p, h in scheduled]
+            bind_start = time.monotonic()
+            committed: List[bool] = [False] * len(bindings)
+            try:
+                f.client.bind_batch(bindings)
+                committed = [True] * len(bindings)
+            except Exception:
+                # all-or-nothing tile failed (e.g. a pod got bound by
+                # another scheduler mid-flight): degrade to per-pod CAS so
+                # one conflict doesn't waste the whole tile
+                for i, b in enumerate(bindings):
+                    try:
+                        f.client.bind(b)
+                        committed[i] = True
+                    except Exception as e:
+                        pod = scheduled[i][0]
+                        if f.recorder is not None:
+                            f.recorder.eventf(pod, "Normal",
+                                              "FailedScheduling",
+                                              f"Binding rejected: {e}")
+                        self._error(pod, e)
+            c.metrics.observe("binding_latency_microseconds",
+                              (time.monotonic() - bind_start) * 1e6)
+            for ok, (pod, host) in zip(committed, scheduled):
+                if not ok:
+                    continue
+                if f.recorder is not None:
+                    f.recorder.eventf(
+                        pod, "Normal", "Scheduled",
+                        f"Successfully assigned {pod.metadata.name} to {host}")
+                assumed = replace(pod,
+                                  spec=replace(pod.spec, node_name=host))
+                f.modeler.assume_pod(assumed)
+
+        f.modeler.locked_action(bind_and_assume)
+
+        for pod in unscheduled:
+            err = FitError(pod, {})
+            if f.recorder is not None:
+                f.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                  str(err))
+            self._error(pod, err)
+        c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
+                          (time.monotonic() - start) * 1e6)
+        return True
+
+    def _error(self, pod: api.Pod, err: Exception) -> None:
+        self.config.factory.error_func(pod, err)

@@ -41,9 +41,6 @@ from typing import List, NamedTuple, Optional, Tuple
 import numpy as np
 
 import jax
-
-jax.config.update("jax_enable_x64", True)  # exact int64/f64 parity math
-
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -51,6 +48,18 @@ from .tables import ClusterSnapshot, EncodeResult, encode_snapshot
 
 DEFAULT_WEIGHTS = (1, 1, 1)  # LeastRequested, Balanced, SelectorSpread
                              # (algorithmprovider/defaults/defaults.go:54-96)
+
+
+def ensure_x64() -> None:
+    """The engine's parity contract needs int64 resource sums and float64
+    score formulas (the oracle — and the Go reference — compute in 64-bit).
+    JAX drops 64-bit types unless jax_enable_x64 is on, so the engine
+    requires it process-wide. Called at engine construction, not module
+    import, so merely importing the library never mutates global JAX
+    config; applications combining this engine with f32-default JAX code
+    in one process should pin dtypes explicitly in that code."""
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
 
 
 class NodeConst(NamedTuple):
@@ -212,6 +221,7 @@ class BatchEngine:
 
     def __init__(self, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
                  mesh: Optional[Mesh] = None, node_axis: str = "nodes"):
+        ensure_x64()
         self.weights = tuple(int(w) for w in weights)
         self.mesh = mesh
         self.node_axis = node_axis
@@ -254,10 +264,11 @@ class BatchEngine:
         final_state, assigned = self._run(node, state, pods)
         return np.asarray(assigned), final_state
 
-    def schedule(self, snap: ClusterSnapshot
+    def schedule(self, snap: ClusterSnapshot, pod_pad_to: Optional[int] = None
                  ) -> Tuple[List[Optional[str]], EncodeResult]:
         """Encode + run + decode: one host name (or None) per pending pod."""
-        enc = encode_snapshot(snap, node_pad_to=self.n_shards)
+        enc = encode_snapshot(snap, node_pad_to=self.n_shards,
+                              pod_pad_to=pod_pad_to)
         assigned, _ = self.run(enc)
         out: List[Optional[str]] = []
         for j in range(enc.n_pods):

@@ -177,18 +177,21 @@ def _disk_keys(volume: api.Volume) -> Tuple[List[object], bool]:
     return [], False
 
 
-def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1
-                    ) -> EncodeResult:
+def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
+                    pod_pad_to: Optional[int] = None) -> EncodeResult:
     """Encode a cluster snapshot into device-ready arrays.
 
     `node_pad_to`: pad the node axis to a multiple of this (shard count);
     padded nodes have valid=False and never receive assignments.
+    `pod_pad_to`: pad the pod axis to at least this many entries (stable
+    scan lengths -> stable XLA compile cache); padded pods are invalid and
+    never match or update state.
     """
     nodes = snap.nodes
     n_real = len(nodes)
     n_pad = max(1, -(-max(n_real, 1) // node_pad_to) * node_pad_to)
     p = len(snap.pending_pods)
-    p_pad = max(1, p)
+    p_pad = max(1, p, pod_pad_to or 0)
 
     node_idx: Dict[str, int] = {n.metadata.name: i for i, n in enumerate(nodes)}
 

@@ -27,7 +27,7 @@ from ..utils.ratelimit import TokenBucketRateLimiter
 from . import plugins
 from .api import Policy
 from .extender import HTTPExtender
-from .generic import GenericScheduler, NoNodesAvailable
+from .generic import GenericScheduler
 from .modeler import SimpleModeler
 from .scheduler import Scheduler, SchedulerConfig
 
@@ -129,6 +129,7 @@ class ConfigFactory:
         self.rate_limiter = TokenBucketRateLimiter(bind_qps, bind_burst) \
             if rate_limit else None
         self._started = False
+        self._error_func = None
 
     def _forget(self, pod: api.Pod) -> None:
         self.modeler.locked_action(lambda: self.modeler.forget_pod(pod))
@@ -210,6 +211,27 @@ class ConfigFactory:
     def _next_pod(self) -> Optional[api.Pod]:
         """(ref: factory.go:230 NextPod — blocking FIFO pop)"""
         return self.pod_queue.pop(timeout=0.5)
+
+    @property
+    def error_func(self) -> Callable:
+        """Shared backoff+requeue error handler (batch path)."""
+        if self._error_func is None:
+            self._error_func = self.make_default_error_func()
+        return self._error_func
+
+    def create_batch(self, policy: Optional[Policy] = None, **kw):
+        """TPU fast-path config, or None if the policy needs the serial
+        path. Eligible: the default provider's predicate/priority set with
+        no extenders — exactly what the device engine implements
+        (sched/device). Anything else (custom/service-affinity predicates,
+        label-preference or anti-affinity priorities, HTTP extenders)
+        must use create()/create_from_config() — the provable serial
+        fallback the BASELINE requires."""
+        from .batch import BatchSchedulerConfig
+        if policy is not None and (policy.predicates or policy.priorities
+                                   or policy.extenders):
+            return None
+        return BatchSchedulerConfig(self, **kw)
 
     def make_default_error_func(self) -> Callable:
         """(ref: factory.go:297 makeDefaultErrorFunc — backoff + requeue)"""

@@ -1,0 +1,12 @@
+"""Node-plane agents: hollow kubelet (kubemark-style), status manager.
+
+The reference proves master-plane parity with hollow nodes — real kubelet
+code against fake runtimes (pkg/kubemark/hollow_kubelet.go). We take the
+same stance: the node agent's contract with the control plane (register,
+heartbeat, watch assigned pods, report status) is implemented for real;
+the container runtime behind it is a fake that "runs" pods instantly.
+"""
+
+from .hollow_node import HollowKubelet, StatusManager, FakeRuntime
+
+__all__ = ["HollowKubelet", "StatusManager", "FakeRuntime"]

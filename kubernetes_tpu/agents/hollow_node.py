@@ -1,0 +1,255 @@
+"""Hollow kubelet: the node agent with a fake container runtime.
+
+Reference behavior being reproduced (not the Go structure):
+- register the Node object and heartbeat NodeStatus conditions
+  (pkg/kubelet/kubelet.go registerWithApiserver / tryUpdateNodeStatus;
+  conditions Ready + OutOfDisk are what the scheduler's node filter reads,
+  plugin/pkg/scheduler/factory/factory.go:241-256)
+- watch pods bound to this node via the spec.nodeName field selector
+  (kubelet's apiserver pod source, pkg/kubelet/config/apiserver.go)
+- a sync loop starts/stops "containers" through a Runtime interface
+  (pkg/kubelet/container Runtime); kubemark swaps in a fake that succeeds
+  instantly (pkg/kubemark/hollow_kubelet.go:35-80, FakeDockerClient)
+- a status manager syncs PodStatus to the apiserver in batches, skipping
+  no-op updates (pkg/kubelet/status/manager.go:117-146 syncBatch)
+
+MaxPods defaults to 40 per hollow node (hollow_kubelet.go:73).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import replace
+from typing import Dict, List, Optional
+
+from ..api.cache import Informer, meta_namespace_key
+from ..core import types as api
+from ..core.errors import NotFound
+from ..core.quantity import Quantity, parse_quantity
+from ..utils.clock import Clock, RealClock
+
+
+class FakeRuntime:
+    """Instant-success container runtime (kubemark's FakeDockerClient
+    analogue). Tracks which pods are "running" so tests can assert."""
+
+    def __init__(self):
+        self._running: Dict[str, List[api.ContainerStatus]] = {}
+        self._lock = threading.Lock()
+
+    def run_pod(self, pod: api.Pod) -> List[api.ContainerStatus]:
+        key = pod_key(pod)
+        with self._lock:
+            # already running: report the existing containers so started_at
+            # stays stable across resyncs (a real runtime wouldn't restart)
+            if key in self._running:
+                return list(self._running[key])
+            ts = api.now_rfc3339()
+            statuses = [api.ContainerStatus(
+                name=c.name, ready=True, image=c.image,
+                container_id=f"fake://{pod.metadata.uid}/{c.name}",
+                state=api.ContainerState(
+                    running=api.ContainerStateRunning(started_at=ts)))
+                for c in pod.spec.containers]
+            self._running[key] = statuses
+            return list(statuses)
+
+    def kill_pod(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._running.pop(pod_key(pod), None)
+
+    def running_pods(self) -> List[str]:
+        with self._lock:
+            return list(self._running)
+
+
+pod_key = meta_namespace_key
+
+
+class StatusManager:
+    """Batches PodStatus writes to the apiserver, dropping duplicates
+    (ref: pkg/kubelet/status/manager.go SetPodStatus :117 /
+    syncBatch :134)."""
+
+    def __init__(self, client):
+        self.client = client
+        self._statuses: Dict[str, api.PodStatus] = {}
+        self._queue: "queue.Queue[Optional[api.Pod]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_pod_status(self, pod: api.Pod, status: api.PodStatus) -> None:
+        key = pod_key(pod)
+        with self._lock:
+            if self._statuses.get(key) == status:
+                return  # no-op update elided (manager.go:127)
+            self._statuses[key] = status
+        self._queue.put(replace(pod, status=status))
+
+    def forget(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._statuses.pop(pod_key(pod), None)
+
+    def start(self) -> "StatusManager":
+        self._thread = threading.Thread(target=self._sync_loop, daemon=True,
+                                        name="status-manager")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._queue.put(None)
+
+    def _sync_loop(self) -> None:
+        while True:
+            pod = self._queue.get()
+            if pod is None:
+                return
+            try:
+                self.client.update_status("pods", pod,
+                                          pod.metadata.namespace)
+            except NotFound:
+                with self._lock:
+                    self._statuses.pop(pod_key(pod), None)
+            except Exception:
+                # transient apiserver failure: no watch event will re-drive
+                # an unchanged pod, so requeue until it lands or the pod
+                # disappears (manager.go retries on the next sync tick)
+                time.sleep(0.2)
+                with self._lock:
+                    still_wanted = pod_key(pod) in self._statuses
+                if still_wanted:
+                    self._queue.put(pod)
+
+
+class HollowKubelet:
+    """One hollow node: Node registration + heartbeat + pod sync loop."""
+
+    def __init__(self, client, node_name: str,
+                 cpu: str = "4", memory: str = "32Gi", max_pods: int = 40,
+                 heartbeat_interval: float = 10.0,
+                 clock: Optional[Clock] = None,
+                 runtime: Optional[FakeRuntime] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.client = client
+        self.node_name = node_name
+        self.cpu = cpu
+        self.memory = memory
+        self.max_pods = max_pods
+        self.heartbeat_interval = heartbeat_interval
+        self.clock = clock or RealClock()
+        self.runtime = runtime or FakeRuntime()
+        self.labels = dict(labels or {})
+        self.status_manager = StatusManager(client)
+        self._informer: Optional[Informer] = None
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- node object ------------------------------------------------------
+
+    def _capacity(self) -> Dict[str, Quantity]:
+        return {"cpu": parse_quantity(self.cpu),
+                "memory": parse_quantity(self.memory),
+                "pods": parse_quantity(str(self.max_pods))}
+
+    def _conditions(self) -> List[api.NodeCondition]:
+        ts = api.now_rfc3339()
+        return [
+            api.NodeCondition(type="Ready", status="True",
+                              reason="KubeletReady",
+                              last_heartbeat_time=ts),
+            api.NodeCondition(type="OutOfDisk", status="False",
+                              reason="KubeletHasSufficientDisk",
+                              last_heartbeat_time=ts),
+        ]
+
+    def _node_object(self) -> api.Node:
+        return api.Node(
+            metadata=api.ObjectMeta(name=self.node_name, labels=self.labels),
+            status=api.NodeStatus(
+                capacity=self._capacity(),
+                conditions=self._conditions(),
+                node_info=api.NodeSystemInfo(
+                    kubelet_version="hollow",
+                    container_runtime_version="fake://0")))
+
+    def register(self) -> None:
+        try:
+            self.client.create("nodes", self._node_object())
+        except Exception:
+            # already registered from a prior life (or transient failure —
+            # the heartbeat loop re-registers on NotFound): refresh status
+            self._heartbeat_once()
+
+    def _heartbeat_once(self) -> None:
+        try:
+            node = self.client.get("nodes", self.node_name)
+            # stored objects are frozen: build a new status, never mutate
+            # the store/cache-resident one in place (core/store.py contract)
+            updated = replace(node, status=replace(
+                node.status, capacity=self._capacity(),
+                conditions=self._conditions()))
+            self.client.update_status("nodes", updated)
+        except NotFound:
+            # node object deleted (e.g. by a node controller) or initial
+            # create never landed: re-register, like the real kubelet
+            try:
+                self.client.create("nodes", self._node_object())
+            except Exception:
+                pass
+        except Exception:
+            pass  # apiserver hiccup: next tick retries (crash-only)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.heartbeat_interval)
+            if self._stop.is_set():
+                return
+            self._heartbeat_once()
+
+    # -- pod sync ---------------------------------------------------------
+
+    def _sync_pod(self, pod: api.Pod) -> None:
+        if pod.status.phase in ("Succeeded", "Failed"):
+            return
+        statuses = self.runtime.run_pod(pod)
+        status = api.PodStatus(
+            phase="Running",
+            conditions=[api.PodCondition(type="Ready", status="True")],
+            host_ip="10.0.0.1", pod_ip="10.244.0.2",
+            start_time=pod.status.start_time or api.now_rfc3339(),
+            container_statuses=statuses)
+        self.status_manager.set_pod_status(pod, status)
+
+    def _on_pod_add(self, pod: api.Pod) -> None:
+        self._sync_pod(pod)
+
+    def _on_pod_update(self, old: api.Pod, pod: api.Pod) -> None:
+        self._sync_pod(pod)
+
+    def _on_pod_delete(self, pod: api.Pod) -> None:
+        self.runtime.kill_pod(pod)
+        self.status_manager.forget(pod)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def run(self) -> "HollowKubelet":
+        self.register()
+        self.status_manager.start()
+        self._informer = Informer(
+            self.client, "pods",
+            field_selector=f"spec.nodeName={self.node_name}",
+            on_add=self._on_pod_add, on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete).start()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name=f"hb-{self.node_name}")
+        self._hb_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._informer:
+            self._informer.stop()
+        self.status_manager.stop()

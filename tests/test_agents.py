@@ -1,0 +1,180 @@
+"""Event recording (dedup/aggregation) and hollow-node agent tests.
+
+Mirrors the reference's fake-per-boundary test pattern: in-proc client
+against the registry; fake clock where timing matters
+(pkg/client/record/event_test.go, events_cache_test.go,
+pkg/kubemark tests are implicit via integration)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.agents import FakeRuntime, HollowKubelet
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.record import (
+    ClientEventSink, EventAggregator, EventBroadcaster, EventCorrelator,
+    EventLogger, FakeRecorder, get_event_key)
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.utils.clock import FakeClock
+
+from tests.test_sched_e2e import pending_pod, wait_until
+
+
+def mk_event(reason="FailedScheduling", message="no nodes", name="p1"):
+    return api.Event(
+        metadata=api.ObjectMeta(name=f"{name}.1", namespace="default"),
+        involved_object=api.ObjectReference(
+            kind="Pod", namespace="default", name=name, uid="u1"),
+        reason=reason, message=message,
+        source=api.EventSource(component="scheduler"),
+        first_timestamp="t0", last_timestamp="t0", count=1, type="Warning")
+
+
+class TestCorrelation:
+    def test_dedup_increments_count(self):
+        logger = EventLogger()
+        e1, upd1 = logger.observe(mk_event())
+        assert not upd1 and e1.count == 1
+        e2, upd2 = logger.observe(mk_event())
+        assert upd2 and e2.count == 2
+        assert e2.first_timestamp == e1.first_timestamp
+
+    def test_distinct_messages_not_deduped(self):
+        logger = EventLogger()
+        _, upd1 = logger.observe(mk_event(message="a"))
+        _, upd2 = logger.observe(mk_event(message="b"))
+        assert not upd1 and not upd2
+
+    def test_aggregation_collapses_similar_flood(self):
+        # >10 events same reason, distinct messages within 600s
+        # -> aggregate message (events_cache.go:41,99)
+        agg = EventAggregator(FakeClock())
+        out = [agg.aggregate(mk_event(message=f"m{i}")) for i in range(12)]
+        assert out[8].message == "m8"
+        assert out[10].message == "(events with common reason combined)"
+
+    def test_aggregation_interval_expiry(self):
+        clock = FakeClock()
+        agg = EventAggregator(clock)
+        for i in range(9):
+            agg.aggregate(mk_event(message=f"m{i}"))
+        clock.step(601)
+        out = agg.aggregate(mk_event(message="fresh"))
+        assert out.message == "fresh"
+
+    def test_correlator_pipeline(self):
+        corr = EventCorrelator(FakeClock())
+        e, upd = corr.correlate(mk_event())
+        assert e is not None and not upd
+        e2, upd2 = corr.correlate(mk_event())
+        assert upd2 and e2.count == 2
+
+    def test_filter_drops(self):
+        corr = EventCorrelator(FakeClock(),
+                               filter_func=lambda e: e.reason == "Noise")
+        e, _ = corr.correlate(mk_event(reason="Noise"))
+        assert e is None
+
+
+class TestBroadcasterSink:
+    def test_events_reach_api_with_dedup(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        bc = EventBroadcaster(sleep_between_tries=0.01)
+        rec = bc.new_recorder(api.EventSource(component="scheduler"))
+        bc.start_recording_to_sink(ClientEventSink(client))
+        pod = pending_pod("p1")
+        for _ in range(3):
+            rec.event(pod, "Warning", "FailedScheduling", "no fit")
+        assert wait_until(
+            lambda: any(e.count == 3
+                        for e in client.list("events", "default")[0]))
+        events, _ = client.list("events", "default")
+        assert len(events) == 1  # deduped server-side to one object
+        bc.shutdown()
+
+    def test_fake_recorder(self):
+        rec = FakeRecorder()
+        rec.eventf(None, "Normal", "Scheduled", "bound to %s", "n1")
+        assert rec.events == ["Normal Scheduled bound to n1"]
+
+
+class TestHollowNode:
+    @pytest.fixture()
+    def cluster(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        yield registry, client
+
+    def test_register_and_ready(self, cluster):
+        _, client = cluster
+        kubelet = HollowKubelet(client, "hn-0",
+                                heartbeat_interval=0.05).run()
+        try:
+            node = client.get("nodes", "hn-0")
+            conds = {c.type: c.status for c in node.status.conditions}
+            assert conds == {"Ready": "True", "OutOfDisk": "False"}
+            assert int(node.status.capacity["pods"].value) == 40
+        finally:
+            kubelet.stop()
+
+    def test_heartbeat_refreshes_status(self, cluster):
+        _, client = cluster
+        kubelet = HollowKubelet(client, "hn-0",
+                                heartbeat_interval=0.05).run()
+        try:
+            t0 = client.get("nodes", "hn-0").metadata.resource_version
+            assert wait_until(
+                lambda: client.get("nodes",
+                                   "hn-0").metadata.resource_version != t0)
+        finally:
+            kubelet.stop()
+
+    def test_bound_pod_goes_running(self, cluster):
+        _, client = cluster
+        runtime = FakeRuntime()
+        kubelet = HollowKubelet(client, "hn-0", runtime=runtime,
+                                heartbeat_interval=5).run()
+        try:
+            pod = pending_pod("p1")
+            pod.spec.node_name = "hn-0"
+            client.create("pods", pod)
+            assert wait_until(
+                lambda: client.get("pods", "p1").status.phase == "Running")
+            got = client.get("pods", "p1")
+            assert got.status.container_statuses[0].ready
+            assert runtime.running_pods() == ["default/p1"]
+        finally:
+            kubelet.stop()
+
+    def test_other_nodes_pods_ignored(self, cluster):
+        _, client = cluster
+        kubelet = HollowKubelet(client, "hn-0", heartbeat_interval=5).run()
+        try:
+            pod = pending_pod("other")
+            pod.spec.node_name = "hn-1"
+            client.create("pods", pod)
+            mine = pending_pod("mine")
+            mine.spec.node_name = "hn-0"
+            client.create("pods", mine)
+            assert wait_until(
+                lambda: client.get("pods", "mine").status.phase == "Running")
+            assert client.get("pods", "other").status.phase == "Pending"
+        finally:
+            kubelet.stop()
+
+    def test_pod_delete_kills_container(self, cluster):
+        _, client = cluster
+        runtime = FakeRuntime()
+        kubelet = HollowKubelet(client, "hn-0", runtime=runtime,
+                                heartbeat_interval=5).run()
+        try:
+            pod = pending_pod("p1")
+            pod.spec.node_name = "hn-0"
+            client.create("pods", pod)
+            assert wait_until(lambda: runtime.running_pods())
+            client.delete("pods", "p1", "default")
+            assert wait_until(lambda: not runtime.running_pods())
+        finally:
+            kubelet.stop()

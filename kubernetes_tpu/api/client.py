@@ -62,6 +62,9 @@ class Client:
         # reference wire protocol; the in-proc client overrides this).
         return [self.bind(b, namespace) for b in bindings]
 
+    def finalize_namespace(self, obj: api.Namespace) -> Any:
+        raise NotImplementedError
+
 
 class InProcClient(Client):
     def __init__(self, registry: Registry):
@@ -94,6 +97,9 @@ class InProcClient(Client):
 
     def bind_batch(self, bindings, namespace=""):
         return self.registry.bind_batch(bindings, namespace)
+
+    def finalize_namespace(self, obj):
+        return self.registry.finalize_namespace(obj)
 
 
 class _HttpWatcher(Watcher):
@@ -248,6 +254,11 @@ class HttpClient(Client):
         ns = namespace or binding.metadata.namespace or "default"
         return self._decode(self._do(
             "POST", self._url("bindings", ns), binding))
+
+    def finalize_namespace(self, obj):
+        return self._decode(self._do(
+            "PUT", self._url("namespaces", "", obj.metadata.name,
+                             "finalize"), obj))
 
     def bind_batch(self, bindings, namespace=""):
         """POST a JSON array to the bindings resource: one batched store

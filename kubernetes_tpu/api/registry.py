@@ -170,6 +170,12 @@ class Registry:
             creation_timestamp=meta.creation_timestamp or api.now_rfc3339(),
             resource_version="")
         obj = replace(obj, metadata=meta)
+        if resource == "namespaces" and not obj.spec.finalizers:
+            # every namespace carries the kubernetes finalizer so deletion
+            # is two-phase (ref: pkg/registry/namespace/strategy.go
+            # PrepareForCreate)
+            obj = replace(obj, spec=replace(obj.spec,
+                                            finalizers=["kubernetes"]))
         if info.validate:
             info.validate(obj)
         if self.admission:
@@ -206,6 +212,18 @@ class Registry:
         ns = self._namespace_for(info, obj, namespace)
         if not obj.metadata.name:
             raise Invalid("metadata.name: required value")
+        if resource == "namespaces":
+            # finalizers/deletionTimestamp only move via DELETE and the
+            # finalize subresource (ref: pkg/registry/namespace/strategy.go
+            # PrepareForUpdate pins them on regular updates)
+            current = self.store.get(self.key(resource, "", obj.metadata.name))
+            obj = replace(
+                obj,
+                metadata=replace(obj.metadata,
+                                 deletion_timestamp=(
+                                     current.metadata.deletion_timestamp)),
+                spec=replace(obj.spec,
+                             finalizers=list(current.spec.finalizers)))
         if info.validate:
             info.validate(obj)
         if self.admission:
@@ -236,10 +254,58 @@ class Registry:
     def delete(self, resource: str, name: str, namespace: str = "") -> Any:
         info = self.info(resource)
         ns = namespace or ("default" if info.namespaced else "")
+        if resource == "namespaces":
+            return self._delete_namespace(name)
         try:
             return self.store.delete(self.key(resource, ns, name))
         except NotFound:
             raise NotFound(kind=resource, name=name)
+
+    # --------------------------------------------- namespace lifecycle
+
+    def _delete_namespace(self, name: str) -> Any:
+        """Two-phase: with finalizers present, DELETE only marks the
+        namespace Terminating; the namespace controller empties it and
+        finalizes, and the store drop happens once finalizers are gone
+        (ref: pkg/registry/namespace/etcd/etcd.go Delete +
+        namespace strategy)."""
+        key = self.key("namespaces", "", name)
+        try:
+            current = self.store.get(key)
+        except NotFound:
+            raise NotFound(kind="namespaces", name=name)
+        if not current.spec.finalizers:
+            return self.store.delete(key)
+
+        def mark(ns_obj):
+            return replace(
+                ns_obj,
+                metadata=replace(ns_obj.metadata,
+                                 deletion_timestamp=(
+                                     ns_obj.metadata.deletion_timestamp
+                                     or api.now_rfc3339())),
+                status=replace(ns_obj.status, phase="Terminating"))
+
+        return self.store.guaranteed_update(key, mark)
+
+    def finalize_namespace(self, obj: api.Namespace) -> Any:
+        """Replace spec.finalizers; if the namespace is terminating and no
+        finalizers remain, remove it from storage (ref:
+        pkg/registry/namespace/etcd FinalizeREST + etcd.go Delete)."""
+        key = self.key("namespaces", "", obj.metadata.name)
+
+        def swap(ns_obj):
+            return replace(ns_obj, spec=replace(
+                ns_obj.spec, finalizers=list(obj.spec.finalizers)))
+
+        updated = self.store.guaranteed_update(key, swap)
+        if (updated.metadata.deletion_timestamp is not None
+                and not updated.spec.finalizers):
+            try:
+                return self.store.delete(key)
+            except NotFound:
+                pass
+        return updated
 
     def delete_collection(self, resource: str, namespace: str = "",
                           label_selector: str = "",

@@ -150,11 +150,13 @@ class ApiServer:
         parts = parts[2:]
 
         namespace = ""
-        if parts[0] == "namespaces" and len(parts) >= 3:
+        if (parts[0] == "namespaces" and len(parts) >= 3
+                and parts[2] not in ("status", "finalize")):
             # /namespaces/{ns}/{resource}...
             namespace, parts = parts[1], parts[2:]
         elif parts[0] == "namespaces":
-            # the namespaces resource itself: /api/v1/namespaces[/{name}]
+            # the namespaces resource itself, incl. its own subresources:
+            # /api/v1/namespaces[/{name}[/status|/finalize]]
             pass
         # also accept the legacy /api/v1/watch/... prefix
         is_watch_path = parts[0] == "watch"
@@ -207,6 +209,8 @@ class ApiServer:
             obj = self.scheme.decode_dict(body)
             if sub == "status":
                 updated = self.registry.update_status(resource, obj, namespace)
+            elif sub == "finalize" and resource == "namespaces":
+                updated = self.registry.finalize_namespace(obj)
             elif sub:
                 raise NotFound(f"subresource {sub!r} not found")
             else:

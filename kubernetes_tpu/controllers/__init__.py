@@ -1,0 +1,19 @@
+"""Control-plane reconcilers (ref: pkg/controller/*).
+
+Each controller follows the reference pattern: informer caches feed a
+deduplicating work queue, worker threads sync one key at a time, all
+state re-derivable from the API (crash-only)."""
+
+from .framework import (ControllerExpectations, QueueWorkers,
+                        active_pods_sort_key, filter_active_pods)
+from .replication import ReplicationManager
+from .node import NodeController
+from .endpoint import EndpointsController
+from .gc import PodGCController
+from .namespace import NamespaceController
+
+__all__ = [
+    "ControllerExpectations", "QueueWorkers", "active_pods_sort_key",
+    "filter_active_pods", "ReplicationManager", "NodeController",
+    "EndpointsController", "PodGCController", "NamespaceController",
+]

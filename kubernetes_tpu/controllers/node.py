@@ -1,0 +1,199 @@
+"""Node lifecycle controller: heartbeat monitoring + rate-limited pod
+eviction.
+
+Reference: pkg/controller/node/nodecontroller.go — monitorNodeStatus
+(:380-460): per monitor tick, mark nodes whose heartbeat is older than the
+grace period Ready=Unknown; once a node has been not-ready/unknown longer
+than podEvictionTimeout, queue it on a rate-limited eviction queue
+(RateLimitedTimedQueue, pkg/controller/node/rate_limited_queue.go); a node
+going Ready again cancels its eviction; eviction deletes every pod bound
+to the node and records events. Nodes that vanish from the API get their
+pods evicted too (:378-382).
+
+Defaults mirror the reference flags (controllermanager.go):
+--node-monitor-period=5s, --node-monitor-grace-period=40s,
+--pod-eviction-timeout=5m, --deleting-pods-qps=0.1 burst 10.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+from typing import Dict, Optional, Set
+
+from ..core import types as api
+from ..utils.clock import Clock, RealClock
+from ..utils.ratelimit import TokenBucketRateLimiter
+
+
+class _NodeHealth:
+    __slots__ = ("probe_timestamp", "ready_transition_timestamp", "status",
+                 "last_heartbeat")
+
+    def __init__(self, probe: float, transition: float, status: str,
+                 heartbeat: Optional[str] = None):
+        self.probe_timestamp = probe
+        self.ready_transition_timestamp = transition
+        self.status = status
+        self.last_heartbeat = heartbeat
+
+
+class NodeController:
+    def __init__(self, client, monitor_period: float = 5.0,
+                 monitor_grace_period: float = 40.0,
+                 pod_eviction_timeout: float = 300.0,
+                 eviction_qps: float = 0.1, eviction_burst: int = 10,
+                 clock: Optional[Clock] = None, recorder=None):
+        self.client = client
+        self.monitor_period = monitor_period
+        self.monitor_grace_period = monitor_grace_period
+        self.pod_eviction_timeout = pod_eviction_timeout
+        self.clock = clock or RealClock()
+        self.recorder = recorder
+        self.eviction_limiter = TokenBucketRateLimiter(
+            eviction_qps, eviction_burst, self.clock)
+        # node name -> health bookkeeping (nodeStatusMap :95)
+        self._health: Dict[str, _NodeHealth] = {}
+        self._known_nodes: Set[str] = set()
+        self._eviction_queue: Set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- status monitoring ------------------------------------------------
+
+    @staticmethod
+    def _ready_condition(node: api.Node) -> Optional[api.NodeCondition]:
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                return c
+        return None
+
+    def _observe(self, node: api.Node) -> str:
+        """Update health bookkeeping; mark stale heartbeats Unknown.
+        Returns the effective ready status (tryUpdateNodeStatus)."""
+        name = node.metadata.name
+        now = self.clock.now()
+        ready = self._ready_condition(node)
+        status = ready.status if ready else "Unknown"
+        heartbeat = (ready.last_heartbeat_time if ready else "")
+        prior = self._health.get(name)
+        if prior is None:
+            self._health[name] = _NodeHealth(now, now, status, heartbeat)
+            return status
+        if status != prior.status:
+            prior.ready_transition_timestamp = now
+            prior.status = status
+        if heartbeat != prior.last_heartbeat:
+            prior.probe_timestamp = now
+            prior.last_heartbeat = heartbeat
+
+        if (status == "True"
+                and now - prior.probe_timestamp > self.monitor_grace_period):
+            # heartbeat went stale: the node agent is gone
+            status = "Unknown"
+            prior.ready_transition_timestamp = now
+            prior.status = status
+            self._mark_unknown(node)
+            if self.recorder:
+                self.recorder.eventf(node, "Normal", "NodeNotReady",
+                                     "Node %s status is now: NodeNotReady",
+                                     name)
+        return status
+
+    def _mark_unknown(self, node: api.Node) -> None:
+        conds = [replace(c, status="Unknown",
+                         reason="NodeStatusUnknown",
+                         message="Kubelet stopped posting node status.")
+                 if c.type in ("Ready", "OutOfDisk") else c
+                 for c in node.status.conditions]
+        try:
+            fresh = self.client.get("nodes", node.metadata.name)
+            self.client.update_status(
+                "nodes", replace(fresh, status=replace(fresh.status,
+                                                       conditions=conds)))
+        except Exception:
+            pass  # retried next tick (nodeStatusUpdateRetry)
+
+    # -- eviction ---------------------------------------------------------
+
+    def _queue_eviction(self, name: str) -> None:
+        with self._lock:
+            self._eviction_queue.add(name)
+
+    def _cancel_eviction(self, name: str) -> None:
+        with self._lock:
+            self._eviction_queue.discard(name)
+
+    def _drain_eviction_queue(self) -> None:
+        """Rate-limited: one node's pods per token. A still-dead node is
+        re-queued by the next monitor tick, so pods bound to it later are
+        evicted too — the reference's RateLimitedTimedQueue keeps
+        processing a node until it goes Ready."""
+        while True:
+            with self._lock:
+                if not self._eviction_queue:
+                    return
+                name = min(self._eviction_queue)  # deterministic order
+            if not self.eviction_limiter.try_accept():
+                return
+            self._evict_pods(name)
+            with self._lock:
+                self._eviction_queue.discard(name)
+
+    def _evict_pods(self, node_name: str) -> None:
+        try:
+            pods, _ = self.client.list(
+                "pods", field_selector=f"spec.nodeName={node_name}")
+        except Exception:
+            return
+        for pod in pods:
+            try:
+                self.client.delete("pods", pod.metadata.name,
+                                   pod.metadata.namespace)
+                if self.recorder:
+                    self.recorder.eventf(
+                        pod, "Normal", "NodeControllerEviction",
+                        "Marking for deletion Pod %s from Node %s",
+                        pod.metadata.name, node_name)
+            except Exception:
+                pass
+
+    # -- control loop -----------------------------------------------------
+
+    def monitor_once(self) -> None:
+        try:
+            nodes, _ = self.client.list("nodes")
+        except Exception:
+            return
+        now = self.clock.now()
+        names = {n.metadata.name for n in nodes}
+        # deleted nodes: evict their pods (monitorNodeStatus :378-382)
+        for gone in self._known_nodes - names:
+            self._queue_eviction(gone)
+            self._health.pop(gone, None)
+        self._known_nodes = names
+
+        for node in nodes:
+            status = self._observe(node)
+            health = self._health[node.metadata.name]
+            if status == "True":
+                self._cancel_eviction(node.metadata.name)
+            elif (now - health.ready_transition_timestamp
+                  > self.pod_eviction_timeout):
+                self._queue_eviction(node.metadata.name)
+        self._drain_eviction_queue()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.monitor_once()
+            self._stop.wait(self.monitor_period)
+
+    def run(self) -> "NodeController":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,445 @@
+"""Controller tests: RC manager, node lifecycle, endpoints, GC, namespace.
+
+Pattern per the reference: controllers against the in-proc registry with
+real informers; fake clock where eviction timing matters
+(replication_controller_test.go, nodecontroller_test.go,
+endpoints_controller_test.go, gc_controller_test.go)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.controllers import (
+    EndpointsController, NamespaceController, NodeController,
+    PodGCController, ReplicationManager)
+from kubernetes_tpu.controllers.endpoint import find_port, repack_subsets
+from kubernetes_tpu.controllers.framework import (ControllerExpectations,
+                                                  active_pods_sort_key)
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.utils.clock import FakeClock
+
+from tests.test_sched_e2e import pending_pod, ready_node, wait_until
+
+
+@pytest.fixture()
+def cluster():
+    registry = Registry()
+    yield registry, InProcClient(registry)
+
+
+def rc(name, replicas, labels=None, ns="default"):
+    labels = labels or {"app": name}
+    return api.ReplicationController(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ReplicationControllerSpec(
+            replicas=replicas, selector=dict(labels),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels=dict(labels)),
+                spec=api.PodSpec(containers=[
+                    api.Container(name="c", image="img")]))))
+
+
+class TestExpectations:
+    def test_satisfied_when_absent(self):
+        exp = ControllerExpectations()
+        assert exp.satisfied("ns/rc")
+
+    def test_unsatisfied_until_observed(self):
+        exp = ControllerExpectations()
+        exp.expect_creations("k", 2)
+        assert not exp.satisfied("k")
+        exp.creation_observed("k")
+        assert not exp.satisfied("k")
+        exp.creation_observed("k")
+        assert exp.satisfied("k")
+
+    def test_expired_expectations_satisfied(self):
+        clock = FakeClock()
+        exp = ControllerExpectations(clock)
+        exp.expect_deletions("k", 1)
+        assert not exp.satisfied("k")
+        clock.step(6 * 60)
+        assert exp.satisfied("k")
+
+
+class TestActivePodsSort:
+    def test_delete_preference_order(self):
+        unassigned = pending_pod("a")
+        assigned_pending = pending_pod("b")
+        assigned_pending.spec.node_name = "n1"
+        running = pending_pod("c")
+        running.spec.node_name = "n1"
+        running.status.phase = "Running"
+        ready = pending_pod("d")
+        ready.spec.node_name = "n1"
+        ready.status.phase = "Running"
+        ready.status.conditions = [
+            api.PodCondition(type="Ready", status="True")]
+        pods = [ready, running, assigned_pending, unassigned]
+        pods.sort(key=active_pods_sort_key)
+        assert [p.metadata.name for p in pods] == ["a", "b", "c", "d"]
+
+
+class TestReplicationManager:
+    def test_scales_up_from_zero(self, cluster):
+        _, client = cluster
+        rm = ReplicationManager(client).run()
+        try:
+            client.create("replicationcontrollers", rc("web", 3))
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 3)
+            pods, _ = client.list("pods", "default")
+            assert all(p.metadata.labels == {"app": "web"} for p in pods)
+            assert all(p.metadata.name.startswith("web-") for p in pods)
+            # status.replicas converges
+            assert wait_until(lambda: client.get(
+                "replicationcontrollers", "web",
+                "default").status.replicas == 3)
+        finally:
+            rm.stop()
+
+    def test_scales_down(self, cluster):
+        _, client = cluster
+        rm = ReplicationManager(client).run()
+        try:
+            client.create("replicationcontrollers", rc("web", 4))
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 4)
+            scaled = client.get("replicationcontrollers", "web", "default")
+            scaled.spec.replicas = 1
+            client.update("replicationcontrollers", scaled, "default")
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 1)
+        finally:
+            rm.stop()
+
+    def test_replaces_deleted_pod(self, cluster):
+        _, client = cluster
+        rm = ReplicationManager(client).run()
+        try:
+            client.create("replicationcontrollers", rc("web", 2))
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 2)
+            victim = client.list("pods", "default")[0][0]
+            client.delete("pods", victim.metadata.name, "default")
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 2)
+            names = {p.metadata.name
+                     for p in client.list("pods", "default")[0]}
+            assert victim.metadata.name not in names
+        finally:
+            rm.stop()
+
+    def test_ignores_terminated_pods(self, cluster):
+        _, client = cluster
+        rm = ReplicationManager(client).run()
+        try:
+            client.create("replicationcontrollers", rc("web", 1))
+            assert wait_until(lambda: len(
+                client.list("pods", "default")[0]) == 1)
+            pod = client.list("pods", "default")[0][0]
+            pod.status.phase = "Failed"
+            client.update_status("pods", pod, "default")
+            # a failed pod doesn't count: a replacement appears
+            assert wait_until(lambda: len([
+                p for p in client.list("pods", "default")[0]
+                if p.status.phase != "Failed"]) == 1)
+        finally:
+            rm.stop()
+
+    def test_overlapping_rcs_oldest_wins(self, cluster):
+        _, client = cluster
+        rm = ReplicationManager(client)
+        older = rc("old", 1)
+        older.metadata.creation_timestamp = "2026-01-01T00:00:00Z"
+        newer = rc("new", 1)
+        newer.metadata.creation_timestamp = "2026-06-01T00:00:00Z"
+        rm.rc_informer.cache.replace([older, newer])
+        pod = pending_pod("p", labels={"app": "old"})
+        pod.metadata.labels = {"app": "old", "extra": "x"}
+        older.spec.selector = {"app": "old"}
+        newer.spec.selector = {"extra": "x"}
+        got = rm._pod_controller(pod)
+        assert got.metadata.name == "old"
+
+
+class TestNodeController:
+    def _heartbeat_node(self, name, ts):
+        n = ready_node(name)
+        for c in n.status.conditions:
+            c.last_heartbeat_time = ts
+        return n
+
+    def test_stale_heartbeat_goes_unknown(self, cluster):
+        _, client = cluster
+        clock = FakeClock(start=1000.0)
+        nc = NodeController(client, clock=clock,
+                            monitor_grace_period=40,
+                            pod_eviction_timeout=300)
+        client.create("nodes", self._heartbeat_node("n1", "hb-1"))
+        nc.monitor_once()  # baseline observation
+        clock.step(41)
+        nc.monitor_once()  # heartbeat unchanged past grace -> Unknown
+        node = client.get("nodes", "n1")
+        conds = {c.type: c.status for c in node.status.conditions}
+        assert conds["Ready"] == "Unknown"
+
+    def test_fresh_heartbeat_stays_ready(self, cluster):
+        _, client = cluster
+        clock = FakeClock(start=1000.0)
+        nc = NodeController(client, clock=clock, monitor_grace_period=40)
+        client.create("nodes", self._heartbeat_node("n1", "hb-1"))
+        nc.monitor_once()
+        clock.step(30)
+        node = client.get("nodes", "n1")
+        node.status.conditions[0].last_heartbeat_time = "hb-2"
+        client.update_status("nodes", node)
+        clock.step(30)
+        nc.monitor_once()
+        got = client.get("nodes", "n1")
+        assert {c.type: c.status for c in got.status.conditions}[
+            "Ready"] == "True"
+
+    def test_eviction_after_timeout(self, cluster):
+        _, client = cluster
+        clock = FakeClock(start=1000.0)
+        nc = NodeController(client, clock=clock, monitor_grace_period=40,
+                            pod_eviction_timeout=300, eviction_qps=1000,
+                            eviction_burst=1000)
+        client.create("nodes", self._heartbeat_node("n1", "hb-1"))
+        pod = pending_pod("p1")
+        pod.spec.node_name = "n1"
+        client.create("pods", pod)
+        nc.monitor_once()
+        clock.step(41)
+        nc.monitor_once()  # goes Unknown, transition stamped
+        clock.step(301)
+        nc.monitor_once()  # eviction fires
+        assert wait_until(
+            lambda: len(client.list("pods", "default")[0]) == 0)
+
+    def test_recovered_node_cancels_eviction(self, cluster):
+        _, client = cluster
+        clock = FakeClock(start=1000.0)
+        nc = NodeController(client, clock=clock, monitor_grace_period=40,
+                            pod_eviction_timeout=300, eviction_qps=1000,
+                            eviction_burst=1000)
+        client.create("nodes", self._heartbeat_node("n1", "hb-1"))
+        pod = pending_pod("p1")
+        pod.spec.node_name = "n1"
+        client.create("pods", pod)
+        nc.monitor_once()
+        clock.step(41)
+        nc.monitor_once()  # Unknown
+        # node comes back before eviction timeout
+        node = client.get("nodes", "n1")
+        node.status.conditions = [
+            api.NodeCondition(type="Ready", status="True",
+                              last_heartbeat_time="hb-2")]
+        client.update_status("nodes", node)
+        clock.step(100)
+        nc.monitor_once()
+        clock.step(300)
+        nc.monitor_once()
+        assert len(client.list("pods", "default")[0]) == 1
+
+    def test_deleted_node_pods_evicted(self, cluster):
+        _, client = cluster
+        clock = FakeClock(start=1000.0)
+        nc = NodeController(client, clock=clock, eviction_qps=1000,
+                            eviction_burst=1000)
+        client.create("nodes", self._heartbeat_node("n1", "hb-1"))
+        pod = pending_pod("p1")
+        pod.spec.node_name = "n1"
+        client.create("pods", pod)
+        nc.monitor_once()
+        client.delete("nodes", "n1")
+        nc.monitor_once()
+        assert len(client.list("pods", "default")[0]) == 0
+
+
+def running_pod(name, ip, labels, ready=True, ns="default"):
+    p = pending_pod(name, labels=labels)
+    p.metadata.namespace = ns
+    p.spec.node_name = "n1"
+    p.spec.containers[0].ports = [
+        api.ContainerPort(name="http", container_port=8080)]
+    p.status.phase = "Running"
+    p.status.pod_ip = ip
+    if ready:
+        p.status.conditions = [api.PodCondition(type="Ready",
+                                                status="True")]
+    return p
+
+
+class TestEndpoints:
+    def test_find_port(self):
+        pod = running_pod("p", "10.0.0.1", {"app": "web"})
+        assert find_port(pod, api.ServicePort(target_port=9999)) == 9999
+        assert find_port(pod, api.ServicePort(target_port="http")) == 8080
+        assert find_port(pod, api.ServicePort(target_port="nope")) is None
+        assert find_port(pod, api.ServicePort(port=80)) == 80
+
+    def test_repack_merges_same_ports(self):
+        a1 = api.EndpointAddress(ip="10.0.0.1")
+        a2 = api.EndpointAddress(ip="10.0.0.2")
+        port = api.EndpointPort(name="", port=80, protocol="TCP")
+        subsets = repack_subsets([(a1, True, port), (a2, True, port)])
+        assert len(subsets) == 1
+        assert [a.ip for a in subsets[0].addresses] == ["10.0.0.1",
+                                                        "10.0.0.2"]
+
+    def test_sync_builds_endpoints(self, cluster):
+        _, client = cluster
+        ec = EndpointsController(client).run()
+        try:
+            client.create("services", api.Service(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ServiceSpec(
+                    selector={"app": "web"},
+                    ports=[api.ServicePort(port=80,
+                                           target_port="http")])))
+            client.create("pods",
+                          running_pod("p1", "10.0.0.1", {"app": "web"}))
+            client.create("pods",
+                          running_pod("p2", "10.0.0.2", {"app": "web"},
+                                      ready=False))
+
+            def check():
+                try:
+                    ep = client.get("endpoints", "web", "default")
+                except Exception:
+                    return False
+                if len(ep.subsets) != 1:
+                    return False
+                s = ep.subsets[0]
+                return ([a.ip for a in s.addresses] == ["10.0.0.1"]
+                        and [a.ip for a in s.not_ready_addresses]
+                        == ["10.0.0.2"]
+                        and s.ports[0].port == 8080)
+            assert wait_until(check)
+        finally:
+            ec.stop()
+
+    def test_service_delete_removes_endpoints(self, cluster):
+        _, client = cluster
+        ec = EndpointsController(client).run()
+        try:
+            client.create("services", api.Service(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ServiceSpec(selector={"app": "web"},
+                                     ports=[api.ServicePort(port=80)])))
+            client.create("pods",
+                          running_pod("p1", "10.0.0.1", {"app": "web"}))
+            assert wait_until(
+                lambda: client.list("endpoints", "default")[0])
+            client.delete("services", "web", "default")
+            assert wait_until(
+                lambda: not client.list("endpoints", "default")[0])
+        finally:
+            ec.stop()
+
+
+class TestPodGC:
+    def test_deletes_oldest_over_threshold(self, cluster):
+        _, client = cluster
+        gc = PodGCController(client, threshold=2)
+        for i, ts in enumerate(["2026-01-01T00:00:00Z",
+                                "2026-01-02T00:00:00Z",
+                                "2026-01-03T00:00:00Z",
+                                "2026-01-04T00:00:00Z"]):
+            p = pending_pod(f"p{i}")
+            p.metadata.creation_timestamp = ts
+            p.status.phase = "Failed"
+            client.create("pods", p)
+        live = pending_pod("live")
+        live.status.phase = "Running"
+        client.create("pods", live)
+        assert gc.gc_once() == 2
+        names = {p.metadata.name for p in client.list("pods",
+                                                      "default")[0]}
+        assert names == {"p2", "p3", "live"}
+
+    def test_disabled_when_threshold_nonpositive(self, cluster):
+        _, client = cluster
+        gc = PodGCController(client, threshold=0)
+        p = pending_pod("p")
+        p.status.phase = "Failed"
+        client.create("pods", p)
+        assert gc.gc_once() == 0
+
+
+class TestNamespaceLifecycle:
+    def test_cascade_delete_over_http(self):
+        from kubernetes_tpu.api.client import HttpClient
+        from kubernetes_tpu.api.server import ApiServer
+        registry = Registry()
+        server = ApiServer(registry)
+        server.start()
+        client = HttpClient(f"http://127.0.0.1:{server.port}")
+        ctrl = NamespaceController(client).run()
+        try:
+            client.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="doomed")))
+            pod = pending_pod("p1")
+            pod.metadata.namespace = "doomed"
+            client.create("pods", pod, "doomed")
+            client.delete("namespaces", "doomed")
+
+            def gone():
+                try:
+                    client.get("namespaces", "doomed")
+                    return False
+                except Exception:
+                    return True
+            assert wait_until(gone)
+            assert client.list("pods", "doomed")[0] == []
+        finally:
+            ctrl.stop()
+            server.stop()
+
+    def test_plain_update_cannot_clear_finalizers(self, cluster):
+        from dataclasses import replace
+        _, client = cluster
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="pinned")))
+        ns = client.get("namespaces", "pinned")
+        # a stale client copy with finalizers/deletionTimestamp wiped
+        stale = replace(
+            ns, spec=replace(ns.spec, finalizers=[]),
+            metadata=replace(ns.metadata, resource_version=""))
+        client.update("namespaces", stale)
+        assert client.get("namespaces",
+                          "pinned").spec.finalizers == ["kubernetes"]
+
+    def test_cascade_delete(self, cluster):
+        _, client = cluster
+        ctrl = NamespaceController(client).run()
+        try:
+            client.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="doomed")))
+            assert client.get(
+                "namespaces", "doomed").spec.finalizers == ["kubernetes"]
+            pod = pending_pod("p1")
+            pod.metadata.namespace = "doomed"
+            client.create("pods", pod, "doomed")
+            client.create("services", api.Service(
+                metadata=api.ObjectMeta(name="s1", namespace="doomed"),
+                spec=api.ServiceSpec(selector={"a": "b"})), "doomed")
+
+            client.delete("namespaces", "doomed")
+
+            def gone():
+                try:
+                    client.get("namespaces", "doomed")
+                    return False
+                except Exception:
+                    return True
+            assert wait_until(gone)
+            assert client.list("pods", "doomed")[0] == []
+            assert client.list("services", "doomed")[0] == []
+        finally:
+            ctrl.stop()

@@ -220,6 +220,38 @@ class ContainerStatus:
 # ---------------------------------------------------------------- pods
 
 @dataclass
+class PodAffinityTerm:
+    """One required co/anti-location constraint: pods matching
+    `label_selector` in `namespaces` (empty = the pod's own namespace),
+    within the topology domain named by the node label `topology_key`.
+
+    The v1.1 reference has no inter-pod affinity in-tree; this is the
+    BASELINE config-4 extension (the quadratic pod x pod term), modeled on
+    the scheduler's ServiceAffinity neighborhood semantics
+    (predicates.go:334 — implicit affinity inherited from peer pods'
+    node labels) generalized to explicit per-pod terms."""
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    namespaces: List[str] = field(default_factory=list)
+    topology_key: str = ""
+
+
+@dataclass
+class PodAffinity:
+    required_during_scheduling: List[PodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required_during_scheduling: List[PodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass
 class PodSpec:
     volumes: List[Volume] = field(default_factory=list)
     containers: List[Container] = field(default_factory=list)
@@ -231,6 +263,7 @@ class PodSpec:
     service_account_name: str = ""
     node_name: str = ""
     host_network: bool = False
+    affinity: Optional[Affinity] = None
 
 
 @dataclass

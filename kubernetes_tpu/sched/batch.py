@@ -109,12 +109,18 @@ class BatchScheduler:
         start = time.monotonic()
 
         try:
+            # the full node cache (not just ready nodes) resolves existing
+            # pods' topology domains for affinity terms, mirroring the
+            # serial predicate's node_by_name (ReadyNodeLister.get)
+            node_cache = getattr(f.node_lister, "cache", None)
             snap = ClusterSnapshot(
                 nodes=f.node_lister.list(),
                 existing_pods=f.pod_lister.list(),
                 services=f.service_lister.list(),
                 controllers=f.controller_lister.list(),
-                pending_pods=pods)
+                pending_pods=pods,
+                all_nodes=(node_cache.list()
+                           if node_cache is not None else None))
             # pad the pod axis to stable shapes -> XLA compiles once per tier
             pad = min(max(_next_pow2(len(pods)), c.min_pad), c.tile_size)
             hosts, _enc = self.config.engine.schedule(snap, pod_pad_to=pad)

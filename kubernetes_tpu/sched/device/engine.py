@@ -72,6 +72,7 @@ class NodeConst(NamedTuple):
     exceed_cpu: jax.Array  # bool[N]
     exceed_mem: jax.Array  # bool[N]
     offgrid_max: jax.Array  # i32[G]
+    aff_dom: jax.Array     # i32[T, N]
 
 
 class PodXs(NamedTuple):
@@ -90,6 +91,9 @@ class PodXs(NamedTuple):
     host_idx: jax.Array    # i32[P]
     group_id: jax.Array    # i32[P]
     member: jax.Array      # i32[P, G]
+    aff_req: jax.Array     # bool[P, T]
+    anti_req: jax.Array    # bool[P, T]
+    aff_member: jax.Array  # i32[P, T]
 
 
 class State(NamedTuple):
@@ -102,6 +106,8 @@ class State(NamedTuple):
     disk_any: jax.Array    # u32[N, K]
     disk_rw: jax.Array     # u32[N, K]
     spread: jax.Array      # i32[G, N]
+    aff_count: jax.Array   # i32[T, D]
+    aff_total: jax.Array   # i32[T]
 
 
 def _step(node: NodeConst, weights: Tuple[int, int, int],
@@ -126,8 +132,24 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
     disk_conflict = jnp.any(
         ((state.disk_any & pod.qany[None, :])
          | (state.disk_rw & pod.qrw[None, :])) != 0, axis=1)
+
+    # inter-pod affinity/anti-affinity (BASELINE config 4; semantics =
+    # sched.predicates.new_inter_pod_affinity_predicate). Per term t the
+    # node's scope count is the placed-pod count in its topology domain;
+    # affinity needs the key present and count>0 (or the bootstrap: the
+    # pod self-matches an empty-scope term), anti-affinity needs count==0.
+    has_key = node.aff_dom >= 0                                   # [T, N]
+    counts = jnp.take_along_axis(
+        state.aff_count, jnp.maximum(node.aff_dom, 0), axis=1)    # [T, N]
+    counts = jnp.where(has_key, counts, 0)
+    boot = (pod.aff_member > 0) & (state.aff_total == 0)          # [T]
+    aff_ok = jnp.all(~pod.aff_req[:, None]
+                     | (has_key & (boot[:, None] | (counts > 0))),
+                     axis=0)                                      # [N]
+    anti_ok = jnp.all(~pod.anti_req[:, None] | (counts == 0), axis=0)
+
     mask = (node.valid & pod.valid & res_ok & ~port_conflict & sel_ok
-            & host_ok & ~disk_conflict)
+            & host_ok & ~disk_conflict & aff_ok & anti_ok)
 
     # ---- priorities (priorities.go:33,77,198; selector_spreading.go:80) ----
     safe_cpu = jnp.maximum(node.cpu_cap, 1)
@@ -185,8 +207,21 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
         disk_rw=jnp.where(ohc, state.disk_rw | pod.srw[None, :],
                           state.disk_rw),
         spread=state.spread
-        + pod.member[:, None] * oh.astype(jnp.int32)[None, :])
+        + pod.member[:, None] * oh.astype(jnp.int32)[None, :],
+        aff_count=_aff_count_update(node, state, pod, pick, fit_any),
+        aff_total=state.aff_total
+        + jnp.where(fit_any, pod.aff_member, 0))
     return new_state, assigned
+
+
+def _aff_count_update(node: NodeConst, state: State, pod, pick, fit_any):
+    """Placed pod joins its in-scope terms' domain counts (the quadratic
+    term's running state; domain of the chosen node per term)."""
+    t = state.aff_count.shape[0]
+    dom_at = jnp.take(node.aff_dom, pick, axis=1)                 # [T]
+    add = jnp.where(fit_any & (dom_at >= 0), pod.aff_member, 0)
+    return state.aff_count.at[
+        jnp.arange(t), jnp.maximum(dom_at, 0)].add(add)
 
 
 def _make_run(weights: Tuple[int, int, int]):
@@ -202,15 +237,16 @@ def _node_shardings(mesh: Mesh, axis: str):
         return NamedSharding(mesh, P(*spec))
     node = NodeConst(valid=s(axis), cpu_cap=s(axis), mem_cap=s(axis),
                      pod_cap=s(axis), labels=s(axis, None), tie_rank=s(axis),
-                     exceed_cpu=s(axis), exceed_mem=s(axis), offgrid_max=s())
+                     exceed_cpu=s(axis), exceed_mem=s(axis), offgrid_max=s(),
+                     aff_dom=s(None, axis))
     state = State(cpu_used=s(axis), mem_used=s(axis), nz_cpu=s(axis),
                   nz_mem=s(axis), pod_count=s(axis), port_bits=s(axis, None),
                   disk_any=s(axis, None), disk_rw=s(axis, None),
-                  spread=s(None, axis))
+                  spread=s(None, axis), aff_count=s(), aff_total=s())
     pods = PodXs(valid=s(), req_cpu=s(), req_mem=s(), zero_req=s(),
                  nz_cpu=s(), nz_mem=s(), sel=s(), ports=s(), qany=s(),
                  qrw=s(), sany=s(), srw=s(), host_idx=s(), group_id=s(),
-                 member=s())
+                 member=s(), aff_req=s(), anti_req=s(), aff_member=s())
     return node, state, pods
 
 
@@ -244,18 +280,21 @@ class BatchEngine:
             valid=nt.valid, cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,
             pod_cap=nt.pod_cap, labels=nt.label_words, tie_rank=nt.tie_rank,
             exceed_cpu=nt.exceed_cpu, exceed_mem=nt.exceed_mem,
-            offgrid_max=enc.offgrid_max)
+            offgrid_max=enc.offgrid_max, aff_dom=nt.aff_dom)
         state = State(cpu_used=st.cpu_used, mem_used=st.mem_used,
                       nz_cpu=st.nz_cpu, nz_mem=st.nz_mem,
                       pod_count=st.pod_count, port_bits=st.port_bits,
                       disk_any=st.disk_any, disk_rw=st.disk_rw,
-                      spread=st.spread)
+                      spread=st.spread, aff_count=st.aff_count,
+                      aff_total=st.aff_total)
         pods = PodXs(valid=pb.valid, req_cpu=pb.req_cpu, req_mem=pb.req_mem,
                      zero_req=pb.zero_req, nz_cpu=pb.nz_cpu,
                      nz_mem=pb.nz_mem, sel=pb.sel_words, ports=pb.port_words,
                      qany=pb.disk_qany, qrw=pb.disk_qrw, sany=pb.disk_sany,
                      srw=pb.disk_srw, host_idx=pb.host_idx,
-                     group_id=pb.group_id, member=pb.member)
+                     group_id=pb.group_id, member=pb.member,
+                     aff_req=pb.aff_req, anti_req=pb.anti_req,
+                     aff_member=pb.aff_member)
         return node, state, pods
 
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:

@@ -30,8 +30,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ...core import labels as labelspkg
 from ...core import types as api
-from ..predicates import get_resource_request
+from ..predicates import (filter_non_running_pods, get_resource_request,
+                          term_namespaces)
 from ..priorities import get_nonzero_requests
 
 WORD = 32
@@ -76,6 +78,11 @@ class ClusterSnapshot:
     services: List[api.Service] = field(default_factory=list)
     controllers: List[api.ReplicationController] = field(default_factory=list)
     pending_pods: List[api.Pod] = field(default_factory=list)
+    # Full node cache (schedulable or not) for resolving existing pods'
+    # topology domains in affinity terms — the serial path's node_by_name
+    # resolves ANY cached node (ReadyNodeLister.get), not just candidates.
+    # None -> fall back to `nodes`.
+    all_nodes: Optional[List[api.Node]] = None
 
 
 @dataclass
@@ -88,6 +95,8 @@ class NodeArrays:
     tie_rank: np.ndarray    # i32[N] — higher wins ties (name-descending pick)
     exceed_cpu: np.ndarray  # bool[N] — snapshot had a cpu-misfit pod
     exceed_mem: np.ndarray  # bool[N]
+    aff_dom: np.ndarray     # i32[T, N] — topology-domain id per affinity
+                            #   term (-1: node lacks the term's topology key)
 
 
 @dataclass
@@ -107,6 +116,10 @@ class PodArrays:
     host_idx: np.ndarray    # i32[P] (-1 unpinned, -2 pinned off-table)
     group_id: np.ndarray    # i32[P] (-1 = no spread selectors)
     member: np.ndarray      # i32[P, G]
+    aff_req: np.ndarray     # bool[P, T] — pod requires affinity term t
+    anti_req: np.ndarray    # bool[P, T] — pod requires anti-affinity term t
+    aff_member: np.ndarray  # i32[P, T] — pod falls in term t's scope
+                            #   (counts into the term's domains once placed)
 
 
 @dataclass
@@ -120,6 +133,10 @@ class StateArrays:
     disk_any: np.ndarray    # u32[N, K]
     disk_rw: np.ndarray     # u32[N, K]
     spread: np.ndarray      # i32[G, N]
+    aff_count: np.ndarray   # i32[T, D] — placed pods in term t's scope per
+                            #   topology domain
+    aff_total: np.ndarray   # i32[T] — placed pods in term t's scope anywhere
+                            #   (drives the bootstrap rule)
 
 
 @dataclass
@@ -228,7 +245,8 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         label_words=np.zeros((n_pad, L), np.uint32),
         tie_rank=np.full(n_pad, -1, np.int32),
         exceed_cpu=np.zeros(n_pad, bool),
-        exceed_mem=np.zeros(n_pad, bool))
+        exceed_mem=np.zeros(n_pad, bool),
+        aff_dom=np.zeros((0, 0), np.int32))  # filled after term interning
     for i, n in enumerate(nodes):
         nt.valid[i] = True
         cap = n.status.capacity
@@ -263,6 +281,88 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         pod_groups.append(gid)
     G = max(1, len(group_meta))
 
+    # --------------------------------------------- inter-pod affinity terms
+    # (BASELINE config 4; semantics defined by the oracle predicate,
+    # predicates.new_inter_pod_affinity_predicate). Terms are interned by
+    # (resolved namespace scope, selector, topology key); each term gets a
+    # per-node topology-domain id and running scope counts in the carry.
+    term_ids: Dict[object, int] = {}
+    term_meta: List[Tuple[frozenset, Dict[str, str], str]] = []
+    pod_terms: List[Tuple[List[int], List[int]]] = []  # (aff ids, anti ids)
+
+    def intern_term(pod: api.Pod, term: api.PodAffinityTerm) -> int:
+        ns_scope = frozenset(term_namespaces(pod, term))
+        key = (ns_scope, frozenset(term.label_selector.items()),
+               term.topology_key)
+        tid = term_ids.get(key)
+        if tid is None:
+            tid = len(term_meta)
+            term_ids[key] = tid
+            term_meta.append((ns_scope, dict(term.label_selector),
+                              term.topology_key))
+        return tid
+
+    for pod in snap.pending_pods:
+        aff = pod.spec.affinity
+        aff_ids: List[int] = []
+        anti_ids: List[int] = []
+        if aff is not None:
+            if aff.pod_affinity is not None:
+                aff_ids = [intern_term(pod, t)
+                           for t in aff.pod_affinity.required_during_scheduling]
+            if aff.pod_anti_affinity is not None:
+                anti_ids = [
+                    intern_term(pod, t)
+                    for t in aff.pod_anti_affinity.required_during_scheduling]
+        pod_terms.append((aff_ids, anti_ids))
+    T = max(1, len(term_meta))
+
+    def in_term_scope(p: api.Pod, tid: int) -> bool:
+        # same matcher the oracle's pod_matches_term uses, against the
+        # interned (namespace scope, selector) pair
+        ns_scope, selector, _ = term_meta[tid]
+        if p.metadata.namespace not in ns_scope:
+            return False
+        return labelspkg.selector_from_set(selector).matches(p.metadata.labels)
+
+    # per-term topology domains over the node table
+    aff_dom = np.full((T, n_pad), -1, np.int32)
+    dom_ids: List[Dict[str, int]] = [dict() for _ in range(T)]
+    for tid, (_, _, topo_key) in enumerate(term_meta):
+        for i, n in enumerate(nodes):
+            value = n.metadata.labels.get(topo_key)
+            if value is None:
+                continue
+            dom = dom_ids[tid].setdefault(value, len(dom_ids[tid]))
+            aff_dom[tid, i] = dom
+    D = max(1, max((len(d) for d in dom_ids), default=0))
+
+    aff_count = np.zeros((T, D), np.int32)
+    aff_total = np.zeros(T, np.int32)
+    if term_meta:
+        # scope counts over the snapshot's running pods. A pod's domain is
+        # resolved through the FULL node cache (all_nodes) — a peer on a
+        # cached-but-unschedulable node still occupies its domain, exactly
+        # as the serial predicate sees through node_by_name. Domains whose
+        # value no candidate node carries can never satisfy a term, so
+        # those peers count only toward the bootstrap total.
+        labels_by_node: Dict[str, Dict[str, str]] = {
+            n.metadata.name: n.metadata.labels
+            for n in (snap.all_nodes if snap.all_nodes is not None
+                      else snap.nodes)}
+        for epod in filter_non_running_pods(snap.existing_pods):
+            host_labels = labels_by_node.get(epod.spec.node_name)
+            for tid, (_, _, topo_key) in enumerate(term_meta):
+                if not in_term_scope(epod, tid):
+                    continue
+                aff_total[tid] += 1
+                if host_labels is None:
+                    continue
+                value = host_labels.get(topo_key)
+                dom = dom_ids[tid].get(value) if value is not None else None
+                if dom is not None:
+                    aff_count[tid, dom] += 1
+
     st = StateArrays(
         cpu_used=np.zeros(n_pad, np.int64),
         mem_used=np.zeros(n_pad, np.int64),
@@ -272,7 +372,10 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         port_bits=np.zeros((n_pad, PW), np.uint32),
         disk_any=np.zeros((n_pad, K), np.uint32),
         disk_rw=np.zeros((n_pad, K), np.uint32),
-        spread=np.zeros((G, n_pad), np.int32))
+        spread=np.zeros((G, n_pad), np.int32),
+        aff_count=aff_count,
+        aff_total=aff_total)
+    nt.aff_dom = aff_dom
     offgrid: List[Dict[str, int]] = [dict() for _ in range(G)]
 
     by_node: Dict[int, List[api.Pod]] = {}
@@ -354,7 +457,10 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         disk_srw=np.zeros((p_pad, K), np.uint32),
         host_idx=np.full(p_pad, -1, np.int32),
         group_id=np.full(p_pad, -1, np.int32),
-        member=np.zeros((p_pad, G), np.int32))
+        member=np.zeros((p_pad, G), np.int32),
+        aff_req=np.zeros((p_pad, T), bool),
+        anti_req=np.zeros((p_pad, T), bool),
+        aff_member=np.zeros((p_pad, T), np.int32))
     for j, pod in enumerate(snap.pending_pods):
         pb.valid[j] = True
         req_cpu, req_mem = get_resource_request(pod)
@@ -384,6 +490,15 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
                     _set_bit(pb.disk_srw[j], bit)
         if pod.spec.node_name:
             pb.host_idx[j] = node_idx.get(pod.spec.node_name, -2)
+        aff_ids, anti_ids = pod_terms[j]
+        for tid in aff_ids:
+            pb.aff_req[j, tid] = True
+        for tid in anti_ids:
+            pb.anti_req[j, tid] = True
+        if term_meta:
+            for tid in range(len(term_meta)):
+                if in_term_scope(pod, tid):
+                    pb.aff_member[j, tid] = 1
         pb.group_id[j] = pod_groups[j]
         for gid, (ns, sels) in enumerate(group_meta):
             if pod.metadata.namespace != ns:

@@ -133,6 +133,20 @@ register_fit_predicate("MatchNodeSelector",
                        lambda args: preds.pod_selector_matches)
 register_fit_predicate("HostName", lambda args: preds.pod_fits_host)
 
+
+def _inter_pod_affinity_factory(args: PluginFactoryArgs) -> Callable:
+    # BASELINE config 4 extension (the quadratic pod x pod term). The
+    # node lister MUST resolve arbitrary cached nodes by name — anything
+    # less silently disables anti-affinity, so fail loudly at wiring time.
+    if not hasattr(args.node_lister, "get"):
+        raise BadRequest(
+            "InterPodAffinity requires a node lister with get(name)")
+    return preds.new_inter_pod_affinity_predicate(
+        args.pod_lister, args.node_lister.get)
+
+
+register_fit_predicate("InterPodAffinity", _inter_pod_affinity_factory)
+
 register_priority(
     "LeastRequestedPriority",
     lambda args: prios.least_requested_priority, 1)
@@ -151,9 +165,14 @@ register_priority("EqualPriority", lambda args: prios.equal_priority, 1)
 
 DEFAULT_PROVIDER = "DefaultProvider"
 
+# Deliberate divergence from defaults.go:54-96: InterPodAffinity joins the
+# default predicate set (the reference has no inter-pod affinity at v1.1;
+# the batch engine enforces it unconditionally for pods that carry
+# spec.affinity, so the serial fallback must too — path-independent
+# bindings). Pods without affinity specs are unaffected.
 register_algorithm_provider(
     DEFAULT_PROVIDER,
     {"PodFitsHostPorts", "PodFitsResources", "NoDiskConflict",
-     "MatchNodeSelector", "HostName"},
+     "MatchNodeSelector", "HostName", "InterPodAffinity"},
     {"LeastRequestedPriority", "BalancedResourceAllocation",
      "SelectorSpreadPriority"})

@@ -251,6 +251,87 @@ def new_service_affinity_predicate(pod_lister, service_lister,
     return check_service_affinity
 
 
+# ------------------------------------------------ inter-pod affinity tier
+
+def term_namespaces(pod: api.Pod, term: api.PodAffinityTerm) -> List[str]:
+    """Resolved namespace scope: empty list means the pod's own namespace."""
+    return list(term.namespaces) if term.namespaces else [pod.metadata.namespace]
+
+
+def pod_matches_term(candidate: api.Pod, pod: api.Pod,
+                     term: api.PodAffinityTerm) -> bool:
+    """Does `candidate` fall inside `term`'s selector+namespace scope
+    (scope resolved relative to `pod`, the term's owner)?"""
+    if candidate.metadata.namespace not in term_namespaces(pod, term):
+        return False
+    sel = labelspkg.selector_from_set(term.label_selector)
+    return sel.matches(candidate.metadata.labels)
+
+
+def new_inter_pod_affinity_predicate(pod_lister, node_by_name):
+    """Required inter-pod affinity/anti-affinity — the quadratic pod x pod
+    term (BASELINE config 4; no v1.1 reference symbol — see
+    core/types.py PodAffinityTerm).
+
+    Semantics (the parity contract the device engine reproduces):
+      - affinity term: the candidate node must carry `topology_key`, and
+        some running, assigned pod matching the term must live on a node
+        with the same value for that key. Bootstrap rule: if NO pod
+        anywhere matches the term but the incoming pod matches its own
+        term, the term is satisfied (first pod of a self-affine group).
+      - anti-affinity term: no running, assigned pod matching the term may
+        share the candidate node's topology domain; a node lacking the key
+        belongs to no domain and always passes.
+      - pods on unknown nodes (node_by_name -> None) or nodes lacking the
+        key occupy no domain; Succeeded/Failed pods are ignored, matching
+        MapPodsToMachines' phase filter (predicates.go:429).
+    """
+    def inter_pod_affinity(pod: api.Pod, existing_pods, node) -> PredicateResult:
+        affinity = pod.spec.affinity
+        if affinity is None:
+            return True, None
+        aff_terms = (affinity.pod_affinity.required_during_scheduling
+                     if affinity.pod_affinity else [])
+        anti_terms = (affinity.pod_anti_affinity.required_during_scheduling
+                      if affinity.pod_anti_affinity else [])
+        if not aff_terms and not anti_terms:
+            return True, None
+        all_pods = filter_non_running_pods(
+            pod_lister.list(labelspkg.everything()))
+
+        def domain_value(p: api.Pod, key: str) -> Optional[str]:
+            if not p.spec.node_name:
+                return None
+            host = node_by_name(p.spec.node_name)
+            if host is None:
+                return None
+            return host.metadata.labels.get(key)
+
+        for term in aff_terms:
+            node_value = node.metadata.labels.get(term.topology_key)
+            if node_value is None:
+                # an affinity term always needs the key, even under the
+                # bootstrap rule — else the first pod of a group could land
+                # on a domain-less node and strand the rest
+                return False, None
+            matches = [p for p in all_pods if pod_matches_term(p, pod, term)]
+            if not matches and pod_matches_term(pod, pod, term):
+                continue  # bootstrap: first pod of a self-affine group
+            if not any(domain_value(p, term.topology_key) == node_value
+                       for p in matches):
+                return False, None
+        for term in anti_terms:
+            node_value = node.metadata.labels.get(term.topology_key)
+            if node_value is None:
+                continue
+            for p in all_pods:
+                if pod_matches_term(p, pod, term) and \
+                        domain_value(p, term.topology_key) == node_value:
+                    return False, None
+        return True, None
+    return inter_pod_affinity
+
+
 def filter_non_running_pods(pods: Sequence[api.Pod]) -> List[api.Pod]:
     """Drop Succeeded/Failed pods (ref: predicates.go:429
     filterNonRunningPods)."""

@@ -1,0 +1,241 @@
+"""Affinity tier (BASELINE config 4): inter-pod affinity/anti-affinity —
+the quadratic pod x pod term — oracle semantics and oracle<->device parity.
+
+The oracle driver mirrors test_device_parity.oracle_schedule but adds the
+InterPodAffinity predicate wired with the live pod lister (so in-batch
+assumed pods participate in the quadratic term, as the device carry does).
+"""
+
+import copy
+import random
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.sched import predicates as preds
+from kubernetes_tpu.sched import priorities as prios
+from kubernetes_tpu.sched.device import ClusterSnapshot, schedule_batch
+from kubernetes_tpu.sched.generic import (FitError, GenericScheduler,
+                                          NoNodesAvailable)
+from kubernetes_tpu.sched.listers import (FakeControllerLister,
+                                          FakeNodeLister, FakePodLister,
+                                          FakeServiceLister)
+from kubernetes_tpu.sched.priorities import SelectorSpread
+
+from test_device_parity import MI, make_node, rand_cluster
+
+
+def aff(selector, topo="zone", anti=False, namespaces=()):
+    term = api.PodAffinityTerm(label_selector=dict(selector),
+                               namespaces=list(namespaces),
+                               topology_key=topo)
+    if anti:
+        return api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling=[term]))
+    return api.Affinity(
+        pod_affinity=api.PodAffinity(required_during_scheduling=[term]))
+
+
+def pod(name, labels=None, affinity=None, ns="default", node=None,
+        phase="Pending"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns,
+                                labels=labels or {}),
+        spec=api.PodSpec(
+            containers=[api.Container(name="c", image="img")],
+            node_name=node or "", affinity=affinity),
+        status=api.PodStatus(phase=phase))
+
+
+def nodes_ab():
+    return [make_node("node-a1", 4000, 2048 * MI, 110, {"zone": "a"}),
+            make_node("node-a2", 4000, 2048 * MI, 110, {"zone": "a"}),
+            make_node("node-b1", 4000, 2048 * MI, 110, {"zone": "b"}),
+            make_node("node-nolabel", 4000, 2048 * MI, 110, {})]
+
+
+def run_predicate(p, existing, nodes, node):
+    lister = FakePodLister(existing)
+    by_name = {n.metadata.name: n for n in nodes}
+    pred = preds.new_inter_pod_affinity_predicate(lister, by_name.get)
+    return pred(p, existing, node)[0]
+
+
+class TestOracle:
+    def test_affinity_requires_colocated_peer(self):
+        ns = nodes_ab()
+        existing = [pod("peer", {"app": "db"}, node="node-a1",
+                        phase="Running")]
+        p = pod("new", {"app": "web"}, aff({"app": "db"}))
+        assert run_predicate(p, existing, ns, ns[0])      # zone a
+        assert run_predicate(p, existing, ns, ns[1])      # zone a, other node
+        assert not run_predicate(p, existing, ns, ns[2])  # zone b
+        assert not run_predicate(p, existing, ns, ns[3])  # keyless node
+
+    def test_anti_affinity_excludes_domain(self):
+        ns = nodes_ab()
+        existing = [pod("peer", {"app": "web"}, node="node-a1",
+                        phase="Running")]
+        p = pod("new", {"app": "web"}, aff({"app": "web"}, anti=True))
+        assert not run_predicate(p, existing, ns, ns[0])
+        assert not run_predicate(p, existing, ns, ns[1])  # same domain
+        assert run_predicate(p, existing, ns, ns[2])
+        assert run_predicate(p, existing, ns, ns[3])      # keyless passes
+
+    def test_bootstrap_first_self_affine_pod(self):
+        ns = nodes_ab()
+        p = pod("first", {"app": "web"}, aff({"app": "web"}))
+        # no pod matches anywhere; the pod matches its own term -> allowed
+        assert run_predicate(p, [], ns, ns[0])
+        # a matching unassigned pod kills the bootstrap but satisfies
+        # no domain -> all nodes fail
+        floating = pod("float", {"app": "web"})
+        assert not run_predicate(p, [floating], ns, ns[0])
+
+    def test_no_bootstrap_without_self_match(self):
+        ns = nodes_ab()
+        p = pod("new", {"app": "web"}, aff({"app": "db"}))
+        assert not run_predicate(p, [], ns, ns[0])
+
+    def test_namespace_scoping(self):
+        ns = nodes_ab()
+        existing = [pod("peer", {"app": "db"}, ns="other", node="node-a1",
+                        phase="Running")]
+        same_ns = pod("new", {"app": "web"}, aff({"app": "db"}))
+        assert not run_predicate(same_ns, existing, ns, ns[0])
+        cross = pod("new2", {"app": "web"},
+                    aff({"app": "db"}, namespaces=["other"]))
+        assert run_predicate(cross, existing, ns, ns[0])
+
+    def test_succeeded_pods_ignored(self):
+        ns = nodes_ab()
+        existing = [pod("done", {"app": "db"}, node="node-a1",
+                        phase="Succeeded")]
+        p = pod("new", {"app": "web"}, aff({"app": "db"}))
+        assert not run_predicate(p, existing, ns, ns[0])
+
+
+# --------------------------------------------------- oracle <-> device
+
+
+def oracle_schedule_affinity(snap: ClusterSnapshot):
+    existing = list(snap.existing_pods)
+    svc_lister = FakeServiceLister(snap.services)
+    rc_lister = FakeControllerLister(snap.controllers)
+    node_lister = FakeNodeLister(snap.nodes)
+    by_name = {n.metadata.name: n for n in snap.nodes}
+    out = []
+    for p in snap.pending_pods:
+        pod_lister = FakePodLister(existing)
+        spread = SelectorSpread(svc_lister, rc_lister)
+        gs = GenericScheduler(
+            {"PodFitsHostPorts": preds.pod_fits_host_ports,
+             "PodFitsResources": preds.pod_fits_resources,
+             "NoDiskConflict": preds.no_disk_conflict,
+             "MatchNodeSelector": preds.pod_selector_matches,
+             "HostName": preds.pod_fits_host,
+             "InterPodAffinity": preds.new_inter_pod_affinity_predicate(
+                 pod_lister, by_name.get)},
+            [(prios.least_requested_priority, 1),
+             (prios.balanced_resource_allocation, 1),
+             (spread.calculate_spread_priority, 1)],
+            pod_lister)
+        try:
+            host = gs.schedule(p, node_lister)
+        except (FitError, NoNodesAvailable):
+            out.append(None)
+            continue
+        out.append(host)
+        bound = copy.deepcopy(p)
+        bound.spec.node_name = host
+        existing.append(bound)
+    return out
+
+
+def with_random_affinity(snap: ClusterSnapshot, seed) -> ClusterSnapshot:
+    rng = random.Random(seed)
+    for p in snap.pending_pods:
+        r = rng.random()
+        if r < 0.55:
+            continue
+        app = rng.choice(["web", "db", "cache"])
+        topo = rng.choice(["zone", "zone", "disk"])
+        anti = r > 0.8
+        namespaces = []
+        if rng.random() < 0.15:
+            namespaces = [rng.choice(["default", "kube-system"])]
+        p.spec.affinity = aff({"app": app}, topo=topo, anti=anti,
+                              namespaces=namespaces)
+        if rng.random() < 0.2:  # both kinds on one pod
+            other = rng.choice(["web", "db"])
+            extra = api.PodAffinityTerm(label_selector={"app": other},
+                                        topology_key="zone")
+            if anti:
+                p.spec.affinity.pod_affinity = api.PodAffinity(
+                    required_during_scheduling=[extra])
+            else:
+                p.spec.affinity.pod_anti_affinity = api.PodAntiAffinity(
+                    required_during_scheduling=[extra])
+    return snap
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_engine_matches_oracle_with_affinity(seed):
+    snap = with_random_affinity(rand_cluster(seed + 100), seed)
+    assert schedule_batch(snap) == oracle_schedule_affinity(snap)
+
+
+def test_offtable_node_peers_occupy_their_domain():
+    # A peer on a cached-but-unschedulable node still occupies its zone:
+    # anti-affinity must exclude that zone, affinity must accept it
+    # (parity with the serial predicate resolving via the full node cache).
+    candidates = nodes_ab()[:3]           # a1, a2 (zone a), b1 (zone b)
+    notready = make_node("node-x", 4000, 2048 * MI, 110, {"zone": "a"})
+    peer = pod("peer", {"app": "db"}, node="node-x", phase="Running")
+
+    anti_pod = pod("anti", {"app": "web"}, aff({"app": "db"}, anti=True))
+    snap = ClusterSnapshot(nodes=candidates, existing_pods=[peer],
+                           pending_pods=[anti_pod],
+                           all_nodes=candidates + [notready])
+    assert schedule_batch(snap) == ["node-b1"]
+
+    aff_pod = pod("aff", {"app": "web"}, aff({"app": "db"}))
+    snap = ClusterSnapshot(nodes=candidates, existing_pods=[peer],
+                           pending_pods=[aff_pod],
+                           all_nodes=candidates + [notready])
+    got = schedule_batch(snap)
+    assert got[0] in ("node-a1", "node-a2")
+    # and the serial oracle agrees when its node_by_name spans the cache
+    lister = FakePodLister([peer])
+    by_name = {n.metadata.name: n
+               for n in candidates + [notready]}
+    pred = preds.new_inter_pod_affinity_predicate(lister, by_name.get)
+    assert not pred(anti_pod, [peer], candidates[0])[0]
+    assert pred(anti_pod, [peer], candidates[2])[0]
+    assert pred(aff_pod, [peer], candidates[0])[0]
+
+
+def test_engine_anti_affinity_spreads_batch():
+    # 3 self-anti-affine pods over 2 zones: third pod must fail
+    nodes = nodes_ab()[:3]  # a1, a2, b1 -> zones {a, b}
+    pods = [pod(f"p{i}", {"app": "web"}, aff({"app": "web"}, anti=True))
+            for i in range(3)]
+    snap = ClusterSnapshot(nodes=nodes, pending_pods=pods)
+    got = schedule_batch(snap)
+    assert got == oracle_schedule_affinity(snap)
+    assert got[2] is None
+    assert {g.split("-")[1][0] for g in got[:2]} == {"a", "b"}
+
+
+def test_engine_affinity_colocates_batch():
+    nodes = nodes_ab()
+    pods = [pod(f"p{i}", {"app": "web"}, aff({"app": "web"}))
+            for i in range(4)]
+    snap = ClusterSnapshot(nodes=nodes, pending_pods=pods)
+    got = schedule_batch(snap)
+    assert got == oracle_schedule_affinity(snap)
+    # first pod bootstraps; the rest must land in its zone
+    zones = {"node-a1": "a", "node-a2": "a", "node-b1": "b"}
+    assert None not in got
+    assert len({zones[g] for g in got}) == 1

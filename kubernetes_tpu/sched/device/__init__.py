@@ -22,10 +22,10 @@ default provider's predicate/priority set take the serial fallback path
 (SURVEY.md section 7 hard part 3: provable fallback).
 """
 
-from .tables import ClusterSnapshot, EncodeResult, encode_snapshot
+from .tables import ClusterSnapshot, DevicePolicy, EncodeResult, encode_snapshot
 from .engine import BatchEngine, schedule_batch
 
 __all__ = [
-    "ClusterSnapshot", "EncodeResult", "encode_snapshot",
+    "ClusterSnapshot", "DevicePolicy", "EncodeResult", "encode_snapshot",
     "BatchEngine", "schedule_batch",
 ]

@@ -73,6 +73,10 @@ class NodeConst(NamedTuple):
     exceed_mem: jax.Array  # bool[N]
     offgrid_max: jax.Array  # i32[G]
     aff_dom: jax.Array     # i32[T, N]
+    zone_id: jax.Array     # i32[N]
+    zone_scratch: jax.Array  # i32[Z] zeros (shape carrier)
+    static_mask: jax.Array  # bool[N]
+    static_score: jax.Array  # i64[N]
 
 
 class PodXs(NamedTuple):
@@ -94,6 +98,8 @@ class PodXs(NamedTuple):
     aff_req: jax.Array     # bool[P, T]
     anti_req: jax.Array    # bool[P, T]
     aff_member: jax.Array  # i32[P, T]
+    svc_group: jax.Array   # i32[P]
+    svc_member: jax.Array  # i32[P, S]
 
 
 class State(NamedTuple):
@@ -108,10 +114,12 @@ class State(NamedTuple):
     spread: jax.Array      # i32[G, N]
     aff_count: jax.Array   # i32[T, D]
     aff_total: jax.Array   # i32[T]
+    svc_count: jax.Array   # i32[S, N]
+    svc_total: jax.Array   # i32[S]
 
 
 def _step(node: NodeConst, weights: Tuple[int, int, int],
-          state: State, pod) -> Tuple[State, jax.Array]:
+          anti_weight: int, state: State, pod) -> Tuple[State, jax.Array]:
     n = node.valid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
 
@@ -149,7 +157,8 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
     anti_ok = jnp.all(~pod.anti_req[:, None] | (counts == 0), axis=0)
 
     mask = (node.valid & pod.valid & res_ok & ~port_conflict & sel_ok
-            & host_ok & ~disk_conflict & aff_ok & anti_ok)
+            & host_ok & ~disk_conflict & aff_ok & anti_ok
+            & node.static_mask)
 
     # ---- priorities (priorities.go:33,77,198; selector_spreading.go:80) ----
     safe_cpu = jnp.maximum(node.cpu_cap, 1)
@@ -180,7 +189,29 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
                        jnp.int64(10), jnp.floor(spread_f).astype(jnp.int64))
 
     total = (weights[0] * least_requested + weights[1] * balanced
-             + weights[2] * spread)
+             + weights[2] * spread + node.static_score)
+
+    if anti_weight:
+        # ServiceAntiAffinity (selector_spreading.go:117-196): spread the
+        # pod's service across zone-label values. The oracle only counts
+        # peers on nodes that passed THIS pod's predicates, so the zone
+        # reduction happens under `mask`.
+        g = jnp.maximum(pod.svc_group, 0)
+        row = state.svc_count[g]                               # i32[N]
+        labeled = node.zone_id >= 0
+        zidx = jnp.maximum(node.zone_id, 0)
+        contrib = jnp.where(mask & labeled, row, 0)
+        zc = jnp.zeros_like(node.zone_scratch).at[zidx].add(
+            contrib, mode="drop")                              # i32[Z]
+        count_n = zc[zidx]                                     # i32[N]
+        svc_total = jnp.where(pod.svc_group >= 0, state.svc_total[g], 0)
+        sa_f = (10.0 * (svc_total - count_n).astype(jnp.float64)
+                / jnp.maximum(svc_total, 1).astype(jnp.float64))
+        sa = jnp.where(
+            ~labeled, jnp.int64(0),
+            jnp.where(svc_total > 0,
+                      jnp.floor(sa_f).astype(jnp.int64), jnp.int64(10)))
+        total = total + anti_weight * sa
 
     # ---- selection (generic_scheduler.go:95 selectHost) ----
     masked = jnp.where(mask, total, jnp.int64(-1))
@@ -210,7 +241,10 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
         + pod.member[:, None] * oh.astype(jnp.int32)[None, :],
         aff_count=_aff_count_update(node, state, pod, pick, fit_any),
         aff_total=state.aff_total
-        + jnp.where(fit_any, pod.aff_member, 0))
+        + jnp.where(fit_any, pod.aff_member, 0),
+        svc_count=state.svc_count
+        + pod.svc_member[:, None] * oh.astype(jnp.int32)[None, :],
+        svc_total=state.svc_total + jnp.where(fit_any, pod.svc_member, 0))
     return new_state, assigned
 
 
@@ -224,10 +258,10 @@ def _aff_count_update(node: NodeConst, state: State, pod, pick, fit_any):
         jnp.arange(t), jnp.maximum(dom_at, 0)].add(add)
 
 
-def _make_run(weights: Tuple[int, int, int]):
+def _make_run(weights: Tuple[int, int, int], anti_weight: int = 0):
     def run(node: NodeConst, state: State, pods: PodXs):
         def step(carry, x):
-            return _step(node, weights, carry, x)
+            return _step(node, weights, anti_weight, carry, x)
         return jax.lax.scan(step, state, pods)
     return run
 
@@ -238,15 +272,19 @@ def _node_shardings(mesh: Mesh, axis: str):
     node = NodeConst(valid=s(axis), cpu_cap=s(axis), mem_cap=s(axis),
                      pod_cap=s(axis), labels=s(axis, None), tie_rank=s(axis),
                      exceed_cpu=s(axis), exceed_mem=s(axis), offgrid_max=s(),
-                     aff_dom=s(None, axis))
+                     aff_dom=s(None, axis), zone_id=s(axis),
+                     zone_scratch=s(), static_mask=s(axis),
+                     static_score=s(axis))
     state = State(cpu_used=s(axis), mem_used=s(axis), nz_cpu=s(axis),
                   nz_mem=s(axis), pod_count=s(axis), port_bits=s(axis, None),
                   disk_any=s(axis, None), disk_rw=s(axis, None),
-                  spread=s(None, axis), aff_count=s(), aff_total=s())
+                  spread=s(None, axis), aff_count=s(), aff_total=s(),
+                  svc_count=s(None, axis), svc_total=s())
     pods = PodXs(valid=s(), req_cpu=s(), req_mem=s(), zero_req=s(),
                  nz_cpu=s(), nz_mem=s(), sel=s(), ports=s(), qany=s(),
                  qrw=s(), sany=s(), srw=s(), host_idx=s(), group_id=s(),
-                 member=s(), aff_req=s(), anti_req=s(), aff_member=s())
+                 member=s(), aff_req=s(), anti_req=s(), aff_member=s(),
+                 svc_group=s(), svc_member=s())
     return node, state, pods
 
 
@@ -256,12 +294,17 @@ class BatchEngine:
     jit caches per (N, P, word-count) shape signature."""
 
     def __init__(self, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
-                 mesh: Optional[Mesh] = None, node_axis: str = "nodes"):
+                 mesh: Optional[Mesh] = None, node_axis: str = "nodes",
+                 policy=None):
         ensure_x64()
         self.weights = tuple(int(w) for w in weights)
         self.mesh = mesh
         self.node_axis = node_axis
-        run = _make_run(self.weights)
+        self.policy = policy
+        anti_weight = (policy.anti_affinity_weight
+                       if policy is not None and policy.needs_anti_affinity
+                       else 0)
+        run = _make_run(self.weights, anti_weight)
         if mesh is not None:
             shardings = _node_shardings(mesh, node_axis)
             self._run = jax.jit(
@@ -280,13 +323,16 @@ class BatchEngine:
             valid=nt.valid, cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,
             pod_cap=nt.pod_cap, labels=nt.label_words, tie_rank=nt.tie_rank,
             exceed_cpu=nt.exceed_cpu, exceed_mem=nt.exceed_mem,
-            offgrid_max=enc.offgrid_max, aff_dom=nt.aff_dom)
+            offgrid_max=enc.offgrid_max, aff_dom=nt.aff_dom,
+            zone_id=nt.zone_id, zone_scratch=nt.zone_scratch,
+            static_mask=nt.static_mask, static_score=nt.static_score)
         state = State(cpu_used=st.cpu_used, mem_used=st.mem_used,
                       nz_cpu=st.nz_cpu, nz_mem=st.nz_mem,
                       pod_count=st.pod_count, port_bits=st.port_bits,
                       disk_any=st.disk_any, disk_rw=st.disk_rw,
                       spread=st.spread, aff_count=st.aff_count,
-                      aff_total=st.aff_total)
+                      aff_total=st.aff_total, svc_count=st.svc_count,
+                      svc_total=st.svc_total)
         pods = PodXs(valid=pb.valid, req_cpu=pb.req_cpu, req_mem=pb.req_mem,
                      zero_req=pb.zero_req, nz_cpu=pb.nz_cpu,
                      nz_mem=pb.nz_mem, sel=pb.sel_words, ports=pb.port_words,
@@ -294,7 +340,8 @@ class BatchEngine:
                      srw=pb.disk_srw, host_idx=pb.host_idx,
                      group_id=pb.group_id, member=pb.member,
                      aff_req=pb.aff_req, anti_req=pb.anti_req,
-                     aff_member=pb.aff_member)
+                     aff_member=pb.aff_member, svc_group=pb.svc_group,
+                     svc_member=pb.svc_member)
         return node, state, pods
 
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
@@ -307,7 +354,7 @@ class BatchEngine:
                  ) -> Tuple[List[Optional[str]], EncodeResult]:
         """Encode + run + decode: one host name (or None) per pending pod."""
         enc = encode_snapshot(snap, node_pad_to=self.n_shards,
-                              pod_pad_to=pod_pad_to)
+                              pod_pad_to=pod_pad_to, policy=self.policy)
         assigned, _ = self.run(enc)
         out: List[Optional[str]] = []
         for j in range(enc.n_pods):
@@ -318,6 +365,7 @@ class BatchEngine:
 
 def schedule_batch(snap: ClusterSnapshot,
                    weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
-                   mesh: Optional[Mesh] = None) -> List[Optional[str]]:
+                   mesh: Optional[Mesh] = None,
+                   policy=None) -> List[Optional[str]]:
     """One-shot helper (tests, extender sidecar)."""
-    return BatchEngine(weights, mesh).schedule(snap)[0]
+    return BatchEngine(weights, mesh, policy=policy).schedule(snap)[0]

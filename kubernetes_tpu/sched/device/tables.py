@@ -65,6 +65,31 @@ def _set_bit(row: np.ndarray, idx: int) -> None:
 
 
 @dataclass
+class DevicePolicy:
+    """Policy knobs the device engine supports beyond the default provider
+    (scheduler-policy-file surface; ref: plugin/pkg/scheduler/api).
+
+    - anti_affinity_label: ServiceAntiAffinity custom priority — spread a
+      service's pods across values of this node label
+      (selector_spreading.go:117-196); weight from the policy entry.
+    - label_presence: CheckNodeLabelPresence custom predicates
+      (predicates.go:292) — list of (labels, presence).
+    - label_priorities: CalculateNodeLabelPriority custom priorities
+      (priorities.go:148) — list of (label, presence, weight).
+    """
+    anti_affinity_label: Optional[str] = None
+    anti_affinity_weight: int = 1
+    label_presence: List[Tuple[Tuple[str, ...], bool]] = field(
+        default_factory=list)
+    label_priorities: List[Tuple[str, bool, int]] = field(
+        default_factory=list)
+
+    @property
+    def needs_anti_affinity(self) -> bool:
+        return self.anti_affinity_label is not None
+
+
+@dataclass
 class ClusterSnapshot:
     """What the algorithm would see through its listers at batch start.
 
@@ -97,6 +122,14 @@ class NodeArrays:
     exceed_mem: np.ndarray  # bool[N]
     aff_dom: np.ndarray     # i32[T, N] — topology-domain id per affinity
                             #   term (-1: node lacks the term's topology key)
+    zone_id: np.ndarray     # i32[N] — ServiceAntiAffinity label value id
+                            #   (-1: unlabeled; all -1 when not configured)
+    zone_scratch: np.ndarray  # i32[Z] zeros — carries the zone-count shape
+                            #   into the jitted step
+    static_mask: np.ndarray  # bool[N] — AND of configured label-presence
+                            #   predicates (CheckNodeLabelPresence)
+    static_score: np.ndarray  # i64[N] — weighted sum of configured static
+                            #   priorities (CalculateNodeLabelPriority)
 
 
 @dataclass
@@ -120,6 +153,9 @@ class PodArrays:
     anti_req: np.ndarray    # bool[P, T] — pod requires anti-affinity term t
     aff_member: np.ndarray  # i32[P, T] — pod falls in term t's scope
                             #   (counts into the term's domains once placed)
+    svc_group: np.ndarray   # i32[P] — ServiceAntiAffinity service group
+                            #   (-1: pod has no matching service)
+    svc_member: np.ndarray  # i32[P, S] — pod matches group's (ns, selector)
 
 
 @dataclass
@@ -137,6 +173,10 @@ class StateArrays:
                             #   topology domain
     aff_total: np.ndarray   # i32[T] — placed pods in term t's scope anywhere
                             #   (drives the bootstrap rule)
+    svc_count: np.ndarray   # i32[S, N] — service-group pods per table node
+                            #   (zone reduction happens under the per-step
+                            #   mask, matching the oracle's filtered lister)
+    svc_total: np.ndarray   # i32[S] — service-group pods anywhere
 
 
 @dataclass
@@ -195,7 +235,8 @@ def _disk_keys(volume: api.Volume) -> Tuple[List[object], bool]:
 
 
 def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
-                    pod_pad_to: Optional[int] = None) -> EncodeResult:
+                    pod_pad_to: Optional[int] = None,
+                    policy: Optional[DevicePolicy] = None) -> EncodeResult:
     """Encode a cluster snapshot into device-ready arrays.
 
     `node_pad_to`: pad the node axis to a multiple of this (shard count);
@@ -246,7 +287,11 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         tie_rank=np.full(n_pad, -1, np.int32),
         exceed_cpu=np.zeros(n_pad, bool),
         exceed_mem=np.zeros(n_pad, bool),
-        aff_dom=np.zeros((0, 0), np.int32))  # filled after term interning
+        aff_dom=np.zeros((0, 0), np.int32),  # filled after term interning
+        zone_id=np.full(n_pad, -1, np.int32),
+        zone_scratch=np.zeros(1, np.int32),
+        static_mask=np.ones(n_pad, bool),
+        static_score=np.zeros(n_pad, np.int64))
     for i, n in enumerate(nodes):
         nt.valid[i] = True
         cap = n.status.capacity
@@ -363,6 +408,74 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
                 if dom is not None:
                     aff_count[tid, dom] += 1
 
+    # ----------------------------------------- policy tier (DevicePolicy)
+    pol = policy or DevicePolicy()
+    for i, n in enumerate(nodes):
+        node_labels = n.metadata.labels
+        for wanted, presence in pol.label_presence:
+            # ref: predicates.go:292 CheckNodeLabelPresence
+            for label in wanted:
+                exists = label in node_labels
+                if (exists and not presence) or (not exists and presence):
+                    nt.static_mask[i] = False
+        for label, presence, weight in pol.label_priorities:
+            # ref: priorities.go:148 — 0 or 10, weighted
+            exists = label in node_labels
+            success = (exists and presence) or (not exists and not presence)
+            nt.static_score[i] += (10 if success else 0) * weight
+
+    # ServiceAntiAffinity groups: one per (namespace, first matching
+    # service's selector) over the pending pods (the oracle consults
+    # services[0] only, selector_spreading.go:140)
+    svc_groups: Dict[object, int] = {}
+    svc_meta: List[Tuple[str, Dict[str, str]]] = []
+    pod_svc_group: List[int] = []
+    if pol.needs_anti_affinity:
+        zone_vals: Dict[str, int] = {}
+        for i, n in enumerate(nodes):
+            value = n.metadata.labels.get(pol.anti_affinity_label)
+            if value is not None:
+                nt.zone_id[i] = zone_vals.setdefault(value, len(zone_vals))
+        nt.zone_scratch = np.zeros(max(1, len(zone_vals)), np.int32)
+        for pod in snap.pending_pods:
+            first = next(
+                (svc for svc in snap.services
+                 if (not svc.metadata.namespace
+                     or svc.metadata.namespace == pod.metadata.namespace)
+                 and svc.spec.selector
+                 and _selector_matches(svc.spec.selector,
+                                       pod.metadata.labels)), None)
+            if first is None:
+                pod_svc_group.append(-1)
+                continue
+            key = (pod.metadata.namespace,
+                   frozenset(first.spec.selector.items()))
+            gid = svc_groups.get(key)
+            if gid is None:
+                gid = len(svc_meta)
+                svc_groups[key] = gid
+                svc_meta.append((pod.metadata.namespace,
+                                 dict(first.spec.selector)))
+            pod_svc_group.append(gid)
+    else:
+        pod_svc_group = [-1] * len(snap.pending_pods)
+    S = max(1, len(svc_meta))
+
+    svc_count = np.zeros((S, n_pad), np.int32)
+    svc_total = np.zeros(S, np.int32)
+    for gid, (ns, sel) in enumerate(svc_meta):
+        # the oracle lists via pod_lister.list(selector) with NO phase
+        # filter (selector_spreading.go:140-147)
+        for epod in snap.existing_pods:
+            if epod.metadata.namespace != ns:
+                continue
+            if not _selector_matches(sel, epod.metadata.labels):
+                continue
+            svc_total[gid] += 1
+            i = node_idx.get(epod.spec.node_name)
+            if i is not None:
+                svc_count[gid, i] += 1
+
     st = StateArrays(
         cpu_used=np.zeros(n_pad, np.int64),
         mem_used=np.zeros(n_pad, np.int64),
@@ -374,7 +487,9 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         disk_rw=np.zeros((n_pad, K), np.uint32),
         spread=np.zeros((G, n_pad), np.int32),
         aff_count=aff_count,
-        aff_total=aff_total)
+        aff_total=aff_total,
+        svc_count=svc_count,
+        svc_total=svc_total)
     nt.aff_dom = aff_dom
     offgrid: List[Dict[str, int]] = [dict() for _ in range(G)]
 
@@ -460,7 +575,9 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         member=np.zeros((p_pad, G), np.int32),
         aff_req=np.zeros((p_pad, T), bool),
         anti_req=np.zeros((p_pad, T), bool),
-        aff_member=np.zeros((p_pad, T), np.int32))
+        aff_member=np.zeros((p_pad, T), np.int32),
+        svc_group=np.full(p_pad, -1, np.int32),
+        svc_member=np.zeros((p_pad, S), np.int32))
     for j, pod in enumerate(snap.pending_pods):
         pb.valid[j] = True
         req_cpu, req_mem = get_resource_request(pod)
@@ -505,6 +622,11 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
                 continue
             if any(_selector_matches(s, pod.metadata.labels) for s in sels):
                 pb.member[j, gid] = 1
+        pb.svc_group[j] = pod_svc_group[j]
+        for gid, (ns, sel) in enumerate(svc_meta):
+            if pod.metadata.namespace == ns and \
+                    _selector_matches(sel, pod.metadata.labels):
+                pb.svc_member[j, gid] = 1
 
     return EncodeResult(
         node_tab=nt, pod_batch=pb, init_state=st, offgrid_max=offgrid_max,

@@ -86,6 +86,69 @@ class PodQueueLister:
         return self.fifo.contains(meta_namespace_key(pod))
 
 
+# engine core predicates: always enforced by the device scan (1.0 alias
+# PodFitsPorts accepted); a policy must name all of them to be eligible
+_ENGINE_CORE_PREDICATES = {"PodFitsResources", "NoDiskConflict",
+                           "MatchNodeSelector", "HostName"}
+
+
+def _translate_policy(policy):
+    """Policy -> (weights, DevicePolicy) for the device engine, or None if
+    the policy needs the serial path. See ConfigFactory.create_batch."""
+    from .device import DevicePolicy
+    if policy is None:
+        return (1, 1, 1), None
+    if policy.extenders:
+        return None
+    dev = DevicePolicy()
+    if policy.predicates:
+        named = set()
+        for p in policy.predicates:
+            if p.service_affinity is not None:
+                return None  # peer-inherited node affinity: serial only
+            if p.labels_presence is not None:
+                dev.label_presence.append(
+                    (tuple(p.labels_presence.labels),
+                     p.labels_presence.presence))
+                continue
+            named.add("PodFitsHostPorts" if p.name == "PodFitsPorts"
+                      else p.name)
+        # InterPodAffinity is required too: the engine enforces the
+        # affinity mask unconditionally, so a policy omitting it would get
+        # a stricter engine than its serial counterpart
+        required = _ENGINE_CORE_PREDICATES | {"PodFitsHostPorts",
+                                              "InterPodAffinity"}
+        if not required <= named or named - required:
+            return None  # dropped core predicate / unknown name
+    weights = [1, 1, 1]
+    if policy.priorities:
+        weights = [0, 0, 0]
+        slot = {"LeastRequestedPriority": 0,
+                "BalancedResourceAllocation": 1,
+                "SelectorSpreadPriority": 2}
+        for p in policy.priorities:
+            if p.service_anti_affinity is not None:
+                if dev.needs_anti_affinity:
+                    return None  # engine encodes one zone label
+                dev.anti_affinity_label = p.service_anti_affinity.label
+                dev.anti_affinity_weight = p.weight
+                continue
+            if p.label_preference is not None:
+                dev.label_priorities.append(
+                    (p.label_preference.label, p.label_preference.presence,
+                     p.weight))
+                continue
+            if p.name in slot:
+                weights[slot[p.name]] += p.weight
+            elif p.name == "EqualPriority":
+                pass  # constant shift across nodes: argmax-invariant
+            else:
+                return None  # e.g. ServiceSpreadingPriority (services-only)
+    dev_needed = (dev.needs_anti_affinity or dev.label_presence
+                  or dev.label_priorities)
+    return tuple(weights), (dev if dev_needed else None)
+
+
 class ConfigFactory:
     """(ref: factory.go:72 NewConfigFactory)"""
 
@@ -221,16 +284,26 @@ class ConfigFactory:
 
     def create_batch(self, policy: Optional[Policy] = None, **kw):
         """TPU fast-path config, or None if the policy needs the serial
-        path. Eligible: the default provider's predicate/priority set with
-        no extenders — exactly what the device engine implements
-        (sched/device). Anything else (custom/service-affinity predicates,
-        label-preference or anti-affinity priorities, HTTP extenders)
-        must use create()/create_from_config() — the provable serial
-        fallback the BASELINE requires."""
+        path. The engine covers the default provider's predicate/priority
+        set plus the policy-file customs it can encode statically
+        (CheckNodeLabelPresence, CalculateNodeLabelPriority,
+        ServiceAntiAffinity — device.DevicePolicy). Anything else
+        (ServiceAffinity predicates, HTTP extenders, a policy that drops
+        one of the engine's core predicates) must use
+        create()/create_from_config() — the provable serial fallback the
+        BASELINE requires."""
         from .batch import BatchSchedulerConfig
-        if policy is not None and (policy.predicates or policy.priorities
-                                   or policy.extenders):
+        from .device import BatchEngine
+        translated = _translate_policy(policy)
+        if translated is None:
             return None
+        weights, device_policy = translated
+        if device_policy is not None or weights != (1, 1, 1):
+            if "engine" in kw:
+                raise ValueError(
+                    "create_batch: cannot combine an explicit engine with "
+                    "a policy that needs engine configuration")
+            kw["engine"] = BatchEngine(weights, policy=device_policy)
         return BatchSchedulerConfig(self, **kw)
 
     def make_default_error_func(self) -> Callable:

@@ -1,0 +1,175 @@
+"""BASELINE config 3 on device: ServiceAntiAffinity (zone spreading) plus
+static label predicates/priorities (CheckNodeLabelPresence,
+CalculateNodeLabelPriority) — parity against the serial oracle with the
+same custom policy, and the factory's policy -> engine translation."""
+
+import copy
+import random
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.sched import predicates as preds
+from kubernetes_tpu.sched import priorities as prios
+from kubernetes_tpu.sched.api import (LabelPreferenceArgs,
+                                      LabelsPresenceArgs, Policy,
+                                      PredicatePolicy, PriorityPolicy,
+                                      ServiceAntiAffinityArgs)
+from kubernetes_tpu.sched.device import (ClusterSnapshot, DevicePolicy,
+                                         schedule_batch)
+from kubernetes_tpu.sched.factory import _translate_policy
+from kubernetes_tpu.sched.generic import (FitError, GenericScheduler,
+                                          NoNodesAvailable)
+from kubernetes_tpu.sched.listers import (FakeControllerLister,
+                                          FakeNodeLister, FakePodLister,
+                                          FakeServiceLister)
+from kubernetes_tpu.sched.priorities import SelectorSpread, ServiceAntiAffinity
+
+from test_device_parity import rand_cluster
+
+DEFAULT_PREDICATES = {
+    "PodFitsHostPorts": preds.pod_fits_host_ports,
+    "PodFitsResources": preds.pod_fits_resources,
+    "NoDiskConflict": preds.no_disk_conflict,
+    "MatchNodeSelector": preds.pod_selector_matches,
+    "HostName": preds.pod_fits_host,
+}
+
+
+def oracle_schedule_policy(snap: ClusterSnapshot, dev: DevicePolicy,
+                           weights=(1, 1, 1)):
+    """Serial loop with the oracle's custom predicates/priorities mirroring
+    a DevicePolicy."""
+    existing = list(snap.existing_pods)
+    svc_lister = FakeServiceLister(snap.services)
+    rc_lister = FakeControllerLister(snap.controllers)
+    node_lister = FakeNodeLister(snap.nodes)
+    out = []
+    for p in snap.pending_pods:
+        pod_lister = FakePodLister(existing)
+        predicates = dict(DEFAULT_PREDICATES)
+        for i, (labels, presence) in enumerate(dev.label_presence):
+            predicates[f"LabelPresence{i}"] = \
+                preds.new_node_label_predicate(labels, presence)
+        prioritizers = [
+            (prios.least_requested_priority, weights[0]),
+            (prios.balanced_resource_allocation, weights[1]),
+            (SelectorSpread(svc_lister, rc_lister).calculate_spread_priority,
+             weights[2]),
+        ]
+        for label, presence, weight in dev.label_priorities:
+            prioritizers.append(
+                (prios.new_node_label_priority(label, presence), weight))
+        if dev.needs_anti_affinity:
+            prioritizers.append(
+                (ServiceAntiAffinity(
+                    svc_lister, dev.anti_affinity_label)
+                 .calculate_anti_affinity_priority,
+                 dev.anti_affinity_weight))
+        gs = GenericScheduler(predicates, prioritizers, pod_lister)
+        try:
+            host = gs.schedule(p, node_lister)
+        except (FitError, NoNodesAvailable):
+            out.append(None)
+            continue
+        out.append(host)
+        bound = copy.deepcopy(p)
+        bound.spec.node_name = host
+        existing.append(bound)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_service_anti_affinity_parity(seed):
+    snap = rand_cluster(seed + 300)
+    dev = DevicePolicy(anti_affinity_label="zone", anti_affinity_weight=2)
+    got = schedule_batch(snap, policy=dev)
+    want = oracle_schedule_policy(snap, dev)
+    assert got == want
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_label_presence_and_preference_parity(seed):
+    snap = rand_cluster(seed + 400)
+    dev = DevicePolicy(
+        label_presence=[(("disk",), False)],     # forbid ssd-labeled nodes
+        label_priorities=[("zone", True, 3)])    # prefer zoned nodes
+    got = schedule_batch(snap, policy=dev)
+    want = oracle_schedule_policy(snap, dev)
+    assert got == want
+
+
+def test_combined_policy_parity():
+    snap = rand_cluster(777, n_nodes=10, n_existing=25, n_pending=35)
+    dev = DevicePolicy(anti_affinity_label="zone", anti_affinity_weight=1,
+                       label_priorities=[("disk", True, 2)])
+    assert schedule_batch(snap, policy=dev) == \
+        oracle_schedule_policy(snap, dev)
+
+
+# ------------------------------------------------- policy translation
+
+
+def default_predicate_policies():
+    return [PredicatePolicy(name=n) for n in
+            ["PodFitsHostPorts", "PodFitsResources", "NoDiskConflict",
+             "MatchNodeSelector", "HostName", "InterPodAffinity"]]
+
+
+def test_translate_none_policy():
+    assert _translate_policy(None) == ((1, 1, 1), None)
+
+
+def test_translate_anti_affinity_policy():
+    pol = Policy(
+        predicates=default_predicate_policies(),
+        priorities=[
+            PriorityPolicy(name="LeastRequestedPriority", weight=1),
+            PriorityPolicy(name="BalancedResourceAllocation", weight=1),
+            PriorityPolicy(name="SelectorSpreadPriority", weight=2),
+            PriorityPolicy(weight=3, service_anti_affinity=
+                           ServiceAntiAffinityArgs(label="zone"))])
+    weights, dev = _translate_policy(pol)
+    assert weights == (1, 1, 2)
+    assert dev.anti_affinity_label == "zone"
+    assert dev.anti_affinity_weight == 3
+
+
+def test_translate_labels_presence():
+    pol = Policy(
+        predicates=default_predicate_policies() + [
+            PredicatePolicy(labels_presence=LabelsPresenceArgs(
+                labels=["retiring"], presence=False))],
+        priorities=[PriorityPolicy(
+            weight=4, label_preference=LabelPreferenceArgs(
+                label="ssd", presence=True))])
+    weights, dev = _translate_policy(pol)
+    assert weights == (0, 0, 0)
+    assert dev.label_presence == [(("retiring",), False)]
+    assert dev.label_priorities == [("ssd", True, 4)]
+
+
+def test_translate_falls_back_to_serial():
+    # dropped core predicate
+    assert _translate_policy(Policy(
+        predicates=[PredicatePolicy(name="PodFitsResources")])) is None
+    # omitting InterPodAffinity: engine enforces it unconditionally, so the
+    # serial path from this policy would diverge -> serial only
+    assert _translate_policy(Policy(
+        predicates=default_predicate_policies()[:-1])) is None
+    # services-only spreading differs from SelectorSpread
+    assert _translate_policy(Policy(
+        priorities=[PriorityPolicy(name="ServiceSpreadingPriority")])) is None
+    # extenders are serial-path only
+    from kubernetes_tpu.sched.api import ExtenderConfig
+    assert _translate_policy(Policy(
+        extenders=[ExtenderConfig(url_prefix="http://x")])) is None
+
+
+def test_translate_equal_priority_ignored():
+    pol = Policy(priorities=[
+        PriorityPolicy(name="LeastRequestedPriority", weight=1),
+        PriorityPolicy(name="BalancedResourceAllocation", weight=1),
+        PriorityPolicy(name="SelectorSpreadPriority", weight=1),
+        PriorityPolicy(name="EqualPriority", weight=5)])
+    assert _translate_policy(pol) == ((1, 1, 1), None)

@@ -1,0 +1,16 @@
+"""Kubemark: master-plane scale testing with hollow resources.
+
+Reference: pkg/kubemark (HollowKubelet hollow_kubelet.go:35-80), deployed
+by test/kubemark/start-kubemark.sh as NUM_NODES pods of real kubelet code
+wired to fakes. Here the same idea runs in-process: agents.HollowKubelet
+is the faithful per-node agent (own informer/heartbeat threads); for
+thousand-node fleets HollowFleet multiplexes every node through ONE watch
+stream and ONE status pump — the master sees the identical API traffic
+(N node objects heartbeating, pods confirmed Running) without N x 3
+threads.
+"""
+
+from .fleet import HollowFleet
+from .benchmark import BenchmarkResult, run_scheduling_benchmark
+
+__all__ = ["HollowFleet", "BenchmarkResult", "run_scheduling_benchmark"]

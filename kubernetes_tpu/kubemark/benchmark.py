@@ -1,0 +1,164 @@
+"""BenchmarkScheduling, ported.
+
+Reference: test/integration/scheduler_test.go:278-354 — in-process master
++ scheduler, 1000 fake nodes (4 CPU / 32Gi / 32-pod cap :329-354), N pods
+created by 30 concurrent writer goroutines (:379), clock stops when the
+scheduled-pod lister has seen every pod. Here the master is the in-proc
+registry, the nodes come from a HollowFleet (full kubemark wiring: the
+fleet also confirms pods Running), and the scheduler is either the serial
+control loop or the TPU batch loop — the benchmark measures the whole
+bind pipeline, not just the scoring math (bench.py measures that).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api.client import InProcClient
+from ..api.registry import Registry
+from ..core import types as api
+from ..core.quantity import parse_quantity
+from ..sched.batch import BatchScheduler
+from ..sched.factory import ConfigFactory
+from ..sched.scheduler import Scheduler
+from .fleet import HollowFleet
+
+WRITER_THREADS = 30  # ref: scheduler_test.go:379
+
+
+@dataclass
+class BenchmarkResult:
+    n_nodes: int
+    n_pods: int
+    scheduled: int
+    running: int
+    elapsed_s: float          # create-start -> all pods bound
+    pods_per_sec: float
+    mode: str                 # "batch" | "serial"
+
+
+def _bench_pod(i: int) -> api.Pod:
+    # shape from the reference fixture: 100m / no memory request
+    # isn't specified there; keep requests small enough that 1000x32-cap
+    # nodes absorb any N used in tests/benches
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"bench-pod-{i:06d}",
+                                namespace="default",
+                                labels={"app": "bench"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="benchmark-image",
+            resources=api.ResourceRequirements(requests={
+                "cpu": parse_quantity("100m"),
+                "memory": parse_quantity("64Mi")}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
+                             mode: str = "batch",
+                             max_pods_per_node: int = 32,
+                             wait_running: bool = False,
+                             timeout_s: float = 300.0,
+                             registry: Optional[Registry] = None
+                             ) -> BenchmarkResult:
+    """Stand up master + fleet + scheduler, blast pods from 30 writers,
+    measure time until every pod is bound (and optionally Running)."""
+    registry = registry or Registry()
+    client = InProcClient(registry)
+    fleet = HollowFleet(client, n_nodes, cpu="4", memory="32Gi",
+                        max_pods=max_pods_per_node,
+                        heartbeat_interval=60.0).run()
+    factory = ConfigFactory(client, rate_limit=False).start()
+    if mode == "batch":
+        sched = BatchScheduler(factory.create_batch()).run()
+    elif mode == "serial":
+        sched = Scheduler(factory.create()).run()
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    try:
+        # wait until the scheduler's node cache sees the fleet
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and \
+                len(factory.node_lister.list()) < n_nodes:
+            time.sleep(0.05)
+
+        start = time.time()
+        next_i = iter(range(n_pods))
+        lock = threading.Lock()
+
+        def writer():
+            while True:
+                with lock:
+                    i = next(next_i, None)
+                if i is None:
+                    return
+                client.create("pods", _bench_pod(i), "default")
+
+        writers = [threading.Thread(target=writer, daemon=True)
+                   for _ in range(WRITER_THREADS)]
+        for w in writers:
+            w.start()
+        for w in writers:
+            w.join()
+
+        def bound_count() -> int:
+            pods, _ = registry.list("pods", "default")
+            return sum(1 for p in pods
+                       if p.metadata.name.startswith("bench-pod-")
+                       and p.spec.node_name)
+
+        scheduled = 0
+        while time.time() < deadline:
+            scheduled = bound_count()
+            if scheduled >= n_pods:
+                break
+            time.sleep(0.05)
+        elapsed = time.time() - start
+
+        running = 0
+        if wait_running:
+            while time.time() < deadline:
+                pods, _ = registry.list("pods", "default")
+                running = sum(1 for p in pods
+                              if p.metadata.name.startswith("bench-pod-")
+                              and p.status.phase == "Running")
+                if running >= n_pods:
+                    break
+                time.sleep(0.05)
+
+        return BenchmarkResult(
+            n_nodes=n_nodes, n_pods=n_pods, scheduled=scheduled,
+            running=running, elapsed_s=elapsed,
+            pods_per_sec=scheduled / elapsed if elapsed > 0 else 0.0,
+            mode=mode)
+    finally:
+        sched.stop()
+        factory.stop()
+        fleet.stop()
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=1000)
+    ap.add_argument("--mode", choices=["batch", "serial"], default="batch")
+    ap.add_argument("--wait-running", action="store_true")
+    args = ap.parse_args()
+    r = run_scheduling_benchmark(args.nodes, args.pods, args.mode,
+                                 wait_running=args.wait_running)
+    print(json.dumps({
+        "metric": f"e2e_scheduling_throughput_{r.mode}",
+        "nodes": r.n_nodes, "pods": r.n_pods, "scheduled": r.scheduled,
+        "elapsed_s": round(r.elapsed_s, 3),
+        "value": round(r.pods_per_sec, 1), "unit": "pods/sec",
+        "vs_baseline": round(r.pods_per_sec / 50.0, 1)}))
+
+
+if __name__ == "__main__":
+    main()

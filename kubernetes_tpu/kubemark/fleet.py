@@ -1,0 +1,184 @@
+"""Multiplexed hollow-node fleet.
+
+One object simulates N hollow kubelets against the apiserver:
+
+- registers N Node objects (capacity + Ready/OutOfDisk conditions, the
+  fields the scheduler's node filter reads, factory.go:241-256)
+- heartbeats all of them on one timer (NodeStatus updates, the signal the
+  node-lifecycle controller watches)
+- watches ALL pods on one informer and dispatches by spec.nodeName,
+  confirming each bound pod Running through one batched status pump —
+  the hollow kubelet contract (pkg/kubemark/hollow_kubelet.go: fake
+  runtime, instant success)
+
+The per-node agent (agents.HollowKubelet) stays the faithful single-node
+implementation; this fleet is the scale harness (5k nodes in one process,
+the start-kubemark.sh role).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import replace
+from typing import Dict, List, Optional
+
+from ..api.cache import Informer, meta_namespace_key
+from ..core import types as api
+from ..core.errors import ApiError, NotFound
+from ..core.quantity import parse_quantity
+
+
+class HollowFleet:
+    def __init__(self, client, n_nodes: int, name_prefix: str = "hollow-",
+                 cpu: str = "4", memory: str = "32Gi", max_pods: int = 40,
+                 heartbeat_interval: float = 10.0,
+                 labels_for=None):
+        """labels_for: optional fn(index) -> labels dict (zones etc.)."""
+        self.client = client
+        self.n_nodes = n_nodes
+        self.name_prefix = name_prefix
+        self.cpu = cpu
+        self.memory = memory
+        self.max_pods = max_pods
+        self.heartbeat_interval = heartbeat_interval
+        self.labels_for = labels_for or (lambda i: {})
+        self._names = [f"{name_prefix}{i:05d}" for i in range(n_nodes)]
+        self._running: Dict[str, str] = {}  # pod key -> node
+        self._lock = threading.Lock()
+        self._status_q: "queue.Queue[Optional[api.Pod]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._informer: Optional[Informer] = None
+
+    # ---------------------------------------------------------- node side
+
+    def _node_object(self, i: int) -> api.Node:
+        ts = api.now_rfc3339()
+        return api.Node(
+            metadata=api.ObjectMeta(name=self._names[i],
+                                    labels=self.labels_for(i)),
+            status=api.NodeStatus(
+                capacity={"cpu": parse_quantity(self.cpu),
+                          "memory": parse_quantity(self.memory),
+                          "pods": parse_quantity(str(self.max_pods))},
+                conditions=[
+                    api.NodeCondition(type="Ready", status="True",
+                                      reason="KubeletReady",
+                                      last_heartbeat_time=ts),
+                    api.NodeCondition(type="OutOfDisk", status="False",
+                                      reason="KubeletHasSufficientDisk",
+                                      last_heartbeat_time=ts)],
+                node_info=api.NodeSystemInfo(
+                    kubelet_version="hollow-fleet",
+                    container_runtime_version="fake://0")))
+
+    def register_all(self) -> None:
+        for i in range(self.n_nodes):
+            try:
+                self.client.create("nodes", self._node_object(i))
+            except ApiError:
+                pass  # already registered from a prior life
+
+    def _heartbeat_all(self) -> None:
+        for i, name in enumerate(self._names):
+            if self._stop.is_set():
+                return
+            try:
+                node = self.client.get("nodes", name)
+                fresh = self._node_object(i)
+                self.client.update_status("nodes", replace(
+                    node, status=replace(node.status,
+                                         conditions=fresh.status.conditions)))
+            except NotFound:
+                try:
+                    self.client.create("nodes", self._node_object(i))
+                except ApiError:
+                    pass
+            except Exception:
+                pass  # crash-only: next tick retries
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.heartbeat_interval)
+            if self._stop.is_set():
+                return
+            self._heartbeat_all()
+
+    # ----------------------------------------------------------- pod side
+
+    def _on_pod(self, pod: api.Pod) -> None:
+        node = pod.spec.node_name
+        if not node or not node.startswith(self.name_prefix):
+            return
+        if pod.status.phase in ("Running", "Succeeded", "Failed"):
+            return
+        key = meta_namespace_key(pod)
+        with self._lock:
+            if key in self._running:
+                return
+            self._running[key] = node
+        self._status_q.put(pod)
+
+    def _on_pod_delete(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._running.pop(meta_namespace_key(pod), None)
+
+    def _status_pump(self) -> None:
+        while True:
+            pod = self._status_q.get()
+            if pod is None:
+                return
+            ts = api.now_rfc3339()
+            status = api.PodStatus(
+                phase="Running",
+                conditions=[api.PodCondition(type="Ready", status="True")],
+                host_ip="10.0.0.1", pod_ip="10.244.0.2",
+                start_time=pod.status.start_time or ts,
+                container_statuses=[api.ContainerStatus(
+                    name=c.name, ready=True, image=c.image,
+                    container_id=f"fake://{pod.metadata.uid}/{c.name}",
+                    state=api.ContainerState(
+                        running=api.ContainerStateRunning(started_at=ts)))
+                    for c in pod.spec.containers])
+            try:
+                self.client.update_status(
+                    "pods", replace(pod, status=status),
+                    pod.metadata.namespace)
+            except NotFound:
+                self._on_pod_delete(pod)
+            except Exception:
+                # transient: retry unless the fleet is shutting down
+                if not self._stop.is_set():
+                    with self._lock:
+                        wanted = meta_namespace_key(pod) in self._running
+                    if wanted:
+                        self._status_q.put(pod)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def running_count(self) -> int:
+        with self._lock:
+            return len(self._running)
+
+    def run(self) -> "HollowFleet":
+        self.register_all()
+        self._informer = Informer(
+            self.client, "pods",
+            on_add=self._on_pod,
+            on_update=lambda old, new: self._on_pod(new),
+            on_delete=self._on_pod_delete).start()
+        hb = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                              name="fleet-heartbeat")
+        pump = threading.Thread(target=self._status_pump, daemon=True,
+                                name="fleet-status-pump")
+        self._threads = [hb, pump]
+        hb.start()
+        pump.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._informer:
+            self._informer.stop()
+        self._status_q.put(None)

@@ -194,6 +194,18 @@ def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def _matching_services(pod: api.Pod, services: Sequence[api.Service]
+                       ) -> List[api.Service]:
+    """Services whose selector covers the pod, in lister order (the
+    get_pod_services rule: empty service namespace matches any pod
+    namespace, empty selectors never match)."""
+    return [svc for svc in services
+            if (not svc.metadata.namespace
+                or svc.metadata.namespace == pod.metadata.namespace)
+            and svc.spec.selector
+            and _selector_matches(svc.spec.selector, pod.metadata.labels)]
+
+
 def _pod_spread_selectors(pod: api.Pod,
                           services: Sequence[api.Service],
                           controllers: Sequence[api.ReplicationController]
@@ -201,14 +213,8 @@ def _pod_spread_selectors(pod: api.Pod,
     """Selectors SelectorSpread derives for a pod (selector_spreading.go:50-64
     via the service/controller listers; an empty lister namespace matches any
     pod namespace, matching the lister implementations)."""
-    out: List[Dict[str, str]] = []
-    for svc in services:
-        if svc.metadata.namespace and \
-                svc.metadata.namespace != pod.metadata.namespace:
-            continue
-        if svc.spec.selector and \
-                _selector_matches(svc.spec.selector, pod.metadata.labels):
-            out.append(dict(svc.spec.selector))
+    out: List[Dict[str, str]] = [
+        dict(svc.spec.selector) for svc in _matching_services(pod, services)]
     for rc in controllers:
         if rc.metadata.namespace and \
                 rc.metadata.namespace != pod.metadata.namespace:
@@ -438,13 +444,8 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
                 nt.zone_id[i] = zone_vals.setdefault(value, len(zone_vals))
         nt.zone_scratch = np.zeros(max(1, len(zone_vals)), np.int32)
         for pod in snap.pending_pods:
-            first = next(
-                (svc for svc in snap.services
-                 if (not svc.metadata.namespace
-                     or svc.metadata.namespace == pod.metadata.namespace)
-                 and svc.spec.selector
-                 and _selector_matches(svc.spec.selector,
-                                       pod.metadata.labels)), None)
+            matches = _matching_services(pod, snap.services)
+            first = matches[0] if matches else None
             if first is None:
                 pod_svc_group.append(-1)
                 continue

@@ -244,8 +244,14 @@ class ConfigFactory:
         the provider defaults)."""
         args = self.plugin_args()
         if policy.predicates:
-            predicates = {p.name: plugins.predicate_from_policy(p, args)
-                          for p in policy.predicates}
+            # key collisions (e.g. two unnamed labelsPresence entries) must
+            # not drop predicates — the device engine enforces all of them
+            predicates = {}
+            for p in policy.predicates:
+                key = p.name
+                while key in predicates:
+                    key += "#"
+                predicates[key] = plugins.predicate_from_policy(p, args)
         else:
             keys, _ = plugins.get_algorithm_provider(plugins.DEFAULT_PROVIDER)
             predicates = plugins.get_fit_predicates(keys, args)

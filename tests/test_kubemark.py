@@ -1,0 +1,62 @@
+"""Kubemark harness: multiplexed hollow fleet + the BenchmarkScheduling
+port (test/integration/scheduler_test.go:278) at test scale."""
+
+import time
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.kubemark import HollowFleet, run_scheduling_benchmark
+
+
+def wait_until(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_fleet_registers_and_heartbeats():
+    registry = Registry()
+    client = InProcClient(registry)
+    fleet = HollowFleet(client, 25, heartbeat_interval=0.2).run()
+    try:
+        assert wait_until(
+            lambda: len(registry.list("nodes")[0]) == 25)
+        node = client.get("nodes", "hollow-00007")
+        hb0 = node.status.conditions[0].last_heartbeat_time
+        assert node.status.conditions[0].type == "Ready"
+        assert wait_until(lambda: client.get(
+            "nodes",
+            "hollow-00007").status.conditions[0].last_heartbeat_time != hb0,
+            timeout=10)
+    finally:
+        fleet.stop()
+
+
+def test_fleet_reregisters_deleted_node():
+    registry = Registry()
+    client = InProcClient(registry)
+    fleet = HollowFleet(client, 3, heartbeat_interval=0.1).run()
+    try:
+        assert wait_until(lambda: len(registry.list("nodes")[0]) == 3)
+        client.delete("nodes", "hollow-00001")
+        assert wait_until(lambda: len(registry.list("nodes")[0]) == 3,
+                          timeout=10)
+    finally:
+        fleet.stop()
+
+
+def test_benchmark_scheduling_batch_mode():
+    r = run_scheduling_benchmark(n_nodes=40, n_pods=150, mode="batch",
+                                 wait_running=True, timeout_s=90)
+    assert r.scheduled == 150, r
+    assert r.running == 150, r
+    assert r.pods_per_sec > 0
+
+
+def test_benchmark_scheduling_serial_mode():
+    r = run_scheduling_benchmark(n_nodes=15, n_pods=40, mode="serial",
+                                 timeout_s=90)
+    assert r.scheduled == 40, r

@@ -1,0 +1,298 @@
+"""Admission plugins (ref: plugin/pkg/admission/*).
+
+Each factory takes the Registry (the plugins' view of cluster state — the
+reference wires a client + informers; in-proc the registry is both) and
+returns an Interface. Register order matters: the chain runs in the order
+names are given to new_from_plugins, mirroring --admission-control's
+comma-ordered list (cmd/kube-apiserver/app/server.go:230).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..core import types as api
+from ..core.errors import BadRequest, NotFound
+from ..core.quantity import Quantity
+from .chain import Chain
+from .interfaces import Attributes, Forbidden, Interface, Operation
+
+_factories: Dict[str, Callable] = {}
+
+
+def register_plugin(name: str, factory: Callable) -> str:
+    _factories[name] = factory
+    return name
+
+
+def new_from_plugins(registry, names: List[str]) -> Chain:
+    """(ref: pkg/admission/plugins.go NewFromPlugins)"""
+    plugins: List[Interface] = []
+    for name in names:
+        if name not in _factories:
+            raise BadRequest(f"unknown admission plugin {name!r}")
+        plugins.append(_factories[name](registry))
+    return Chain(plugins)
+
+
+class AlwaysAdmit(Interface):
+    """(ref: plugin/pkg/admission/admit)"""
+
+    def admit(self, attributes: Attributes) -> None:
+        return None
+
+
+class AlwaysDeny(Interface):
+    """(ref: plugin/pkg/admission/deny)"""
+
+    def admit(self, attributes: Attributes) -> None:
+        raise Forbidden("this action is not permitted (AlwaysDeny)")
+
+
+class NamespaceLifecycle(Interface):
+    """Block creates into missing or Terminating namespaces; block deleting
+    the protected system namespaces (ref:
+    plugin/pkg/admission/namespace/lifecycle)."""
+
+    immortal = ("default",)
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource == "namespaces" and \
+                attributes.operation == Operation.DELETE:
+            if attributes.name in self.immortal:
+                raise Forbidden(
+                    f"namespace {attributes.name!r} cannot be deleted")
+            return
+        if attributes.operation != Operation.CREATE:
+            return
+        if not attributes.namespace or attributes.resource in ("namespaces",
+                                                               "events"):
+            # events stay recordable during teardown (dedup/TTL storage
+            # makes them harmless; blocking them hides the teardown story)
+            return
+        try:
+            ns = self.registry.get("namespaces", attributes.namespace)
+        except NotFound:
+            raise Forbidden(
+                f"namespace {attributes.namespace!r} does not exist")
+        if ns.status.phase == "Terminating":
+            raise Forbidden(
+                f"namespace {attributes.namespace!r} is terminating")
+
+
+class NamespaceExists(Interface):
+    """(ref: plugin/pkg/admission/namespace/exists)"""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attributes: Attributes) -> None:
+        if not attributes.namespace or attributes.resource == "namespaces":
+            return
+        try:
+            self.registry.get("namespaces", attributes.namespace)
+        except NotFound:
+            raise Forbidden(
+                f"namespace {attributes.namespace!r} does not exist")
+
+
+class NamespaceAutoProvision(Interface):
+    """(ref: plugin/pkg/admission/namespace/autoprovision)"""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.operation != Operation.CREATE:
+            return
+        if not attributes.namespace or attributes.resource == "namespaces":
+            return
+        try:
+            self.registry.get("namespaces", attributes.namespace)
+        except NotFound:
+            try:
+                self.registry.create("namespaces", api.Namespace(
+                    metadata=api.ObjectMeta(name=attributes.namespace)))
+            except Exception:
+                pass  # raced with another provisioner: fine either way
+
+
+class LimitRanger(Interface):
+    """Apply LimitRange defaults and enforce min/max on pod containers
+    (ref: plugin/pkg/admission/limitranger; container-type limits)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def handles(self, operation: str) -> bool:
+        return operation in (Operation.CREATE, Operation.UPDATE)
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource != "pods" or attributes.object is None:
+            return
+        ranges, _ = self.registry.list("limitranges", attributes.namespace)
+        if not ranges:
+            return
+        pod: api.Pod = attributes.object
+        for lr in ranges:
+            for item in lr.spec.limits:
+                if item.type and item.type != "Container":
+                    continue
+                for c in pod.spec.containers:
+                    self._apply(c, item, pod.metadata.name)
+
+    @staticmethod
+    def _apply(container: api.Container, item, pod_name: str) -> None:
+        requests = dict(container.resources.requests)
+        for resource, default in item.default.items():
+            requests.setdefault(resource, default)
+        for resource, lo in item.min.items():
+            got = requests.get(resource)
+            if got is not None and got.milli < lo.milli:
+                raise Forbidden(
+                    f"pod {pod_name!r}: {resource} request {got} below "
+                    f"minimum {lo}")
+        for resource, hi in item.max.items():
+            got = requests.get(resource)
+            if got is not None and got.milli > hi.milli:
+                raise Forbidden(
+                    f"pod {pod_name!r}: {resource} request {got} above "
+                    f"maximum {hi}")
+        container.resources.requests = requests
+
+
+def _pod_usage(pod: api.Pod) -> Dict[str, int]:
+    """All values in Quantity milli units, the scale quota math runs in."""
+    cpu = 0
+    mem = 0
+    for c in pod.spec.containers:
+        req = c.resources.requests
+        if "cpu" in req:
+            cpu += req["cpu"].milli
+        if "memory" in req:
+            mem += req["memory"].milli
+    return {"pods": 1000, "cpu": cpu, "memory": mem}
+
+
+class ResourceQuota(Interface):
+    """Enforce ResourceQuota hard limits, incrementing status.used on
+    admission (ref: plugin/pkg/admission/resourcequota). Decrements happen
+    via controllers.resourcequota.ResourceQuotaController's periodic
+    recalculation, like the reference's resourcequota controller resync —
+    run it alongside this plugin or deletes never free quota."""
+
+    COUNTED = {"pods": "pods", "services": "services",
+               "replicationcontrollers": "replicationcontrollers",
+               "secrets": "secrets", "resourcequotas": "resourcequotas"}
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def handles(self, operation: str) -> bool:
+        return operation == Operation.CREATE
+
+    def admit(self, attributes: Attributes) -> None:
+        count_key = self.COUNTED.get(attributes.resource)
+        if count_key is None:
+            return
+        quotas, _ = self.registry.list("resourcequotas", attributes.namespace)
+        for quota in quotas:
+            self._charge(quota, attributes, count_key)
+
+    def _charge(self, quota, attributes: Attributes, count_key: str) -> None:
+        deltas: Dict[str, int] = {}
+        hard = quota.spec.hard
+        if count_key in hard:
+            deltas[count_key] = 1000  # whole-unit Quantity milli
+        if attributes.resource == "pods" and attributes.object is not None:
+            usage = _pod_usage(attributes.object)
+            for resource in ("cpu", "memory"):
+                if resource in hard:
+                    deltas[resource] = usage[resource]
+        if not deltas:
+            return
+
+        def apply(cur):
+            used = dict(cur.status.used)
+            for resource, delta in deltas.items():
+                limit = cur.spec.hard[resource].milli
+                have = used.get(resource, Quantity(0)).milli
+                if have + delta > limit:
+                    raise Forbidden(
+                        f"exceeded quota {cur.metadata.name!r}: "
+                        f"{resource} used {have}m + {delta}m > hard "
+                        f"{limit}m")
+                used[resource] = Quantity(have + delta)
+            from dataclasses import replace
+            return replace(cur, status=api.ResourceQuotaStatus(
+                hard=dict(cur.spec.hard), used=used))
+
+        # CAS loop through the store (GuaranteedUpdate semantics,
+        # etcd_helper.go:449): a concurrent admit retries, so two pods
+        # can't both squeeze under the same last slot
+        self.registry.guaranteed_update(
+            "resourcequotas", quota.metadata.name, attributes.namespace,
+            apply)
+
+
+class ServiceAccountPlugin(Interface):
+    """Default and validate pod service accounts
+    (ref: plugin/pkg/admission/serviceaccount)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def handles(self, operation: str) -> bool:
+        return operation == Operation.CREATE
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource != "pods" or attributes.object is None:
+            return
+        pod: api.Pod = attributes.object
+        if not pod.spec.service_account_name:
+            pod.spec.service_account_name = "default"
+        try:
+            self.registry.get("serviceaccounts",
+                              pod.spec.service_account_name,
+                              attributes.namespace)
+        except NotFound:
+            raise Forbidden(
+                f"service account {attributes.namespace}/"
+                f"{pod.spec.service_account_name} does not exist")
+
+
+class SecurityContextDeny(Interface):
+    """Deny privilege escalation requests (ref:
+    plugin/pkg/admission/securitycontext/scdeny, adapted to this schema:
+    privileged containers and host-network pods)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def handles(self, operation: str) -> bool:
+        return operation in (Operation.CREATE, Operation.UPDATE)
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource != "pods" or attributes.object is None:
+            return
+        pod: api.Pod = attributes.object
+        if pod.spec.host_network:
+            raise Forbidden("pod.spec.hostNetwork is forbidden")
+        for c in pod.spec.containers:
+            if getattr(c, "privileged", False):
+                raise Forbidden(
+                    f"privileged container {c.name!r} is forbidden")
+
+
+register_plugin("AlwaysAdmit", lambda r: AlwaysAdmit())
+register_plugin("AlwaysDeny", lambda r: AlwaysDeny())
+register_plugin("NamespaceLifecycle", NamespaceLifecycle)
+register_plugin("NamespaceExists", NamespaceExists)
+register_plugin("NamespaceAutoProvision", NamespaceAutoProvision)
+register_plugin("LimitRanger", LimitRanger)
+register_plugin("ResourceQuota", ResourceQuota)
+register_plugin("ServiceAccount", ServiceAccountPlugin)
+register_plugin("SecurityContextDeny", SecurityContextDeny)

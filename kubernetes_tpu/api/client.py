@@ -148,10 +148,14 @@ class _HttpWatcher(Watcher):
 
 class HttpClient(Client):
     def __init__(self, base_url: str, scheme: Scheme = default_scheme,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 headers: Optional[dict] = None):
+        """headers: sent with every request (Authorization etc. — the
+        kubeconfig credential role)."""
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme
         self.timeout = timeout
+        self.headers = dict(headers or {})
 
     # ------------------------------------------------------------ plumbing
 
@@ -175,7 +179,7 @@ class HttpClient(Client):
     def _do(self, method: str, url: str, body: Any = None,
             stream: bool = False, raw_body: Optional[bytes] = None):
         data = raw_body
-        headers = {"Accept": "application/json"}
+        headers = {"Accept": "application/json", **self.headers}
         if body is not None:
             data = self.scheme.encode(body).encode()
         if data is not None:
@@ -239,7 +243,8 @@ class HttpClient(Client):
         split = urllib.parse.urlsplit(url)
         conn = http.client.HTTPConnection(split.hostname, split.port)
         path = split.path + ("?" + split.query if split.query else "")
-        conn.request("GET", path, headers={"Accept": "application/json"})
+        conn.request("GET", path,
+                     headers={"Accept": "application/json", **self.headers})
         resp = conn.getresponse()
         if resp.status != 200:
             body = resp.read().decode()

@@ -113,11 +113,14 @@ class Registry:
 
     def __init__(self, store: Optional[Store] = None,
                  scheme: Scheme = default_scheme,
-                 admission: Optional[Callable[[str, str, Any], Any]] = None):
+                 admission: Optional[
+                     Callable[[str, str, Any, str, str], Any]] = None):
         self.store = store or Store()
         self.scheme = scheme
-        # admission(operation, resource, obj) -> obj; raises to reject
-        # (ref: pkg/admission chain invoked from resthandler createHandler)
+        # admission(operation, resource, obj, namespace, name) -> obj;
+        # raises to reject (ref: pkg/admission chain invoked from
+        # resthandler createHandler). Set after construction when plugins
+        # need the registry itself (admission.new_from_plugins).
         self.admission = admission
 
     # ------------------------------------------------------------- keys
@@ -179,7 +182,7 @@ class Registry:
         if info.validate:
             info.validate(obj)
         if self.admission:
-            obj = self.admission("CREATE", resource, obj)
+            obj = self.admission("CREATE", resource, obj, ns, name)
         return self.store.create(self.key(resource, ns, name), obj, ttl=info.ttl)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
@@ -227,7 +230,8 @@ class Registry:
         if info.validate:
             info.validate(obj)
         if self.admission:
-            obj = self.admission("UPDATE", resource, obj)
+            obj = self.admission("UPDATE", resource, obj, ns,
+                                 obj.metadata.name)
         key = self.key(resource, ns, obj.metadata.name)
         if not obj.metadata.resource_version:
             # Unconditional update requires the object to exist
@@ -251,9 +255,20 @@ class Registry:
 
         return self.store.guaranteed_update(key, apply)
 
+    def guaranteed_update(self, resource: str, name: str, namespace: str,
+                          fn) -> Any:
+        """Retry-on-conflict read-modify-write through the store
+        (GuaranteedUpdate semantics, etcd_helper.go:449), for callers that
+        must be atomic against concurrent writers (quota admission)."""
+        info = self.info(resource)
+        ns = namespace or ("default" if info.namespaced else "")
+        return self.store.guaranteed_update(self.key(resource, ns, name), fn)
+
     def delete(self, resource: str, name: str, namespace: str = "") -> Any:
         info = self.info(resource)
         ns = namespace or ("default" if info.namespaced else "")
+        if self.admission:
+            self.admission("DELETE", resource, None, ns, name)
         if resource == "namespaces":
             return self._delete_namespace(name)
         try:

@@ -29,12 +29,37 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
-from ..core.errors import ApiError, BadRequest, MethodNotSupported, NotFound, TooManyRequests
+from ..auth.authenticate import authenticate_request
+from ..auth.authorize import AuthorizerAttributes
+from ..core.errors import (ApiError, BadRequest, Forbidden,
+                           MethodNotSupported, NotFound, TooManyRequests,
+                           Unauthorized)
 from ..core.scheme import Scheme, default_scheme
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .registry import RESOURCES, Registry
 
 WATCH_HEARTBEAT_SECONDS = 30.0
+
+
+def _authz_target(path: str):
+    """(resource, namespace) for authorization attributes; non-API paths
+    authorize against resource ""."""
+    parts = [p for p in path.split("/") if p]
+    if len(parts) < 3 or parts[0] != "api":
+        return "", ""
+    parts = parts[2:]
+    if parts[0] == "watch":
+        parts = parts[1:]
+    if parts and parts[0] == "namespaces" and len(parts) >= 3 \
+            and parts[2] not in ("status", "finalize"):
+        return parts[2], parts[1]
+    if parts and parts[0] == "namespaces":
+        # the namespaces resource itself, incl. its own subresources
+        # (same carve-out the router applies)
+        return "namespaces", ""
+    if parts:
+        return parts[0], ""
+    return "", ""
 
 
 class ApiServer:
@@ -112,6 +137,25 @@ class ApiServer:
             self._send_error(h, TooManyRequests("too many requests in flight"))
             return
         try:
+            # handler chain order per master.go:702,710:
+            # authenticate -> 401, authorize -> 403, then route.
+            # healthz stays open (load balancers / liveness probes carry
+            # no credentials).
+            health_path = path in ("/healthz", "/healthz/ping")
+            user = None
+            if not health_path:
+                user, ok = authenticate_request(self.authenticator, h.headers)
+                if not ok:
+                    raise Unauthorized("authentication required")
+            if self.authorizer is not None and not health_path:
+                resource, namespace = _authz_target(path)
+                attrs = AuthorizerAttributes(
+                    user=user, read_only=(method == "GET"),
+                    resource=resource, namespace=namespace)
+                if not self.authorizer.authorize(attrs):
+                    name = user.name if user else "unknown"
+                    raise Forbidden(f"user {name!r} cannot "
+                                    f"{method} {resource or path}")
             self._route(h, method, path, query)
         except ApiError as e:
             self._send_error(h, e)

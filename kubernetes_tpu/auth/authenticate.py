@@ -1,0 +1,120 @@
+"""Request authenticators (ref: pkg/auth/authenticator, plugin/pkg/auth:
+password/{allow,passwordfile}, request/{basicauth,union}, token/tokenfile).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class UserInfo:
+    """(ref: pkg/auth/user.DefaultInfo)"""
+    name: str = ""
+    uid: str = ""
+    groups: List[str] = field(default_factory=list)
+
+
+class Authenticator:
+    """Returns (UserInfo, ok). Never raises for bad credentials — a False
+    lets union try the next method (ref: authenticator.Request)."""
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        raise NotImplementedError
+
+
+class BasicAuthAuthenticator(Authenticator):
+    """HTTP basic auth against a user->password map (ref:
+    plugin/pkg/auth/authenticator/request/basicauth +
+    password/passwordfile; file format: password,user,uid per line)."""
+
+    def __init__(self, passwords: Dict[str, Tuple[str, str]]):
+        """passwords: user -> (password, uid)"""
+        self.passwords = passwords
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str]) -> "BasicAuthAuthenticator":
+        out: Dict[str, Tuple[str, str]] = {}
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split(",")]
+            if len(parts) < 3:
+                raise ValueError(
+                    f"password file line needs password,user,uid: {line!r}")
+            password, user, uid = parts[0], parts[1], parts[2]
+            out[user] = (password, uid)
+        return cls(out)
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        header = headers.get("Authorization", "")
+        if not header.startswith("Basic "):
+            return None, False
+        try:
+            decoded = base64.b64decode(header[6:]).decode()
+        except (binascii.Error, UnicodeDecodeError):
+            return None, False
+        user, _, password = decoded.partition(":")
+        entry = self.passwords.get(user)
+        if entry is None or entry[0] != password:
+            return None, False
+        return UserInfo(name=user, uid=entry[1]), True
+
+
+class TokenAuthenticator(Authenticator):
+    """Bearer tokens against a token->user map (ref:
+    plugin/pkg/auth/authenticator/token/tokenfile; file format:
+    token,user,uid per line)."""
+
+    def __init__(self, tokens: Dict[str, UserInfo]):
+        self.tokens = tokens
+
+    @classmethod
+    def from_lines(cls, lines: Sequence[str]) -> "TokenAuthenticator":
+        out: Dict[str, UserInfo] = {}
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split(",")]
+            if len(parts) < 3:
+                raise ValueError(
+                    f"token file line needs token,user,uid: {line!r}")
+            out[parts[0]] = UserInfo(name=parts[1], uid=parts[2],
+                                     groups=parts[3:])
+        return cls(out)
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        header = headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            return None, False
+        info = self.tokens.get(header[7:])
+        if info is None:
+            return None, False
+        return info, True
+
+
+class UnionAuthenticator(Authenticator):
+    """First success wins (ref: request/union)."""
+
+    def __init__(self, authenticators: Sequence[Authenticator]):
+        self.authenticators = list(authenticators)
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        for a in self.authenticators:
+            info, ok = a.authenticate(headers)
+            if ok:
+                return info, True
+        return None, False
+
+
+def authenticate_request(authenticator: Optional[Authenticator],
+                         headers) -> Tuple[Optional[UserInfo], bool]:
+    """None authenticator = open server (every request is anonymous ok)."""
+    if authenticator is None:
+        return UserInfo(name="system:anonymous"), True
+    return authenticator.authenticate(headers)

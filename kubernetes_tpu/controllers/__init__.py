@@ -11,9 +11,11 @@ from .node import NodeController
 from .endpoint import EndpointsController
 from .gc import PodGCController
 from .namespace import NamespaceController
+from .resourcequota import ResourceQuotaController
 
 __all__ = [
     "ControllerExpectations", "QueueWorkers", "active_pods_sort_key",
     "filter_active_pods", "ReplicationManager", "NodeController",
     "EndpointsController", "PodGCController", "NamespaceController",
+    "ResourceQuotaController",
 ]

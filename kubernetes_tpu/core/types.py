@@ -170,6 +170,9 @@ class VolumeMount:
 
 @dataclass
 class Container:
+    """privileged is the security-context surface the SecurityContextDeny
+    admission plugin polices (the reference nests it in
+    SecurityContext.Privileged)."""
     name: str = ""
     image: str = ""
     command: List[str] = field(default_factory=list)
@@ -179,6 +182,7 @@ class Container:
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: List[VolumeMount] = field(default_factory=list)
     image_pull_policy: str = ""
+    privileged: bool = False
 
 
 @dataclass

@@ -1,0 +1,298 @@
+"""Admission chain + authn/authz (ref: pkg/admission, plugin/pkg/admission,
+pkg/auth, plugin/pkg/auth, ABAC)."""
+
+import base64
+import threading
+
+import pytest
+
+from kubernetes_tpu.admission import (Forbidden, new_from_plugins,
+                                      registry_hook)
+from kubernetes_tpu.api.client import HttpClient as HTTPClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.auth import (BasicAuthAuthenticator, TokenAuthenticator,
+                                 UnionAuthenticator, abac_from_lines)
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import ApiError, Forbidden as CoreForbidden
+from kubernetes_tpu.core.quantity import parse_quantity
+
+
+def mkpod(name, ns="default", cpu=None, privileged=False, host_net=False):
+    req = {}
+    if cpu:
+        req = {"cpu": parse_quantity(cpu),
+               "memory": parse_quantity("64Mi")}
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.PodSpec(
+            host_network=host_net,
+            containers=[api.Container(
+                name="c", image="img", privileged=privileged,
+                resources=api.ResourceRequirements(requests=req))]))
+
+
+def wired_registry(*plugins):
+    registry = Registry()
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    registry.admission = registry_hook(
+        new_from_plugins(registry, list(plugins)))
+    return registry
+
+
+class TestNamespacePlugins:
+    def test_lifecycle_blocks_missing_namespace(self):
+        r = wired_registry("NamespaceLifecycle")
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p", ns="nope"))
+
+    def test_lifecycle_blocks_terminating_namespace(self):
+        r = wired_registry("NamespaceLifecycle")
+        r.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="dying")))
+        r.delete("namespaces", "dying")  # two-phase: marks Terminating
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p", ns="dying"))
+
+    def test_lifecycle_protects_default_namespace(self):
+        r = wired_registry("NamespaceLifecycle")
+        with pytest.raises(CoreForbidden):
+            r.delete("namespaces", "default")
+
+    def test_autoprovision_creates_namespace(self):
+        r = wired_registry("NamespaceAutoProvision")
+        r.create("pods", mkpod("p", ns="fresh"))
+        assert r.get("namespaces", "fresh").metadata.name == "fresh"
+
+    def test_exists_blocks_missing(self):
+        r = wired_registry("NamespaceExists")
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p", ns="nope"))
+        r.create("pods", mkpod("p"))  # default exists
+
+
+class TestLimitRanger:
+    def setup_method(self):
+        self.r = wired_registry("LimitRanger")
+        self.r.create("limitranges", api.LimitRange(
+            metadata=api.ObjectMeta(name="lims", namespace="default"),
+            spec=api.LimitRangeSpec(limits=[api.ConfigEntry(
+                type="Container",
+                min={"cpu": parse_quantity("50m")},
+                max={"cpu": parse_quantity("2")},
+                default={"cpu": parse_quantity("100m"),
+                         "memory": parse_quantity("128Mi")})])))
+
+    def test_defaults_applied(self):
+        created = self.r.create("pods", mkpod("p"))
+        req = created.spec.containers[0].resources.requests
+        assert req["cpu"].milli == 100
+        assert req["memory"].value == 128 * 1024 * 1024
+
+    def test_max_enforced(self):
+        with pytest.raises(CoreForbidden):
+            self.r.create("pods", mkpod("big", cpu="4"))
+
+    def test_min_enforced(self):
+        with pytest.raises(CoreForbidden):
+            self.r.create("pods", mkpod("tiny", cpu="10m"))
+
+
+class TestResourceQuota:
+    def setup_method(self):
+        self.r = wired_registry("ResourceQuota")
+        self.r.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="quota", namespace="default"),
+            spec=api.ResourceQuotaSpec(hard={
+                "pods": parse_quantity("2"),
+                "cpu": parse_quantity("500m")})))
+
+    def test_pod_count_enforced(self):
+        self.r.create("pods", mkpod("a", cpu="100m"))
+        self.r.create("pods", mkpod("b", cpu="100m"))
+        with pytest.raises(CoreForbidden):
+            self.r.create("pods", mkpod("c", cpu="100m"))
+
+    def test_cpu_sum_enforced(self):
+        self.r.create("pods", mkpod("a", cpu="400m"))
+        with pytest.raises(CoreForbidden):
+            self.r.create("pods", mkpod("b", cpu="200m"))
+
+    def test_usage_recorded(self):
+        self.r.create("pods", mkpod("a", cpu="300m"))
+        q = self.r.get("resourcequotas", "quota", "default")
+        assert q.status.used["pods"].value == 1
+        assert q.status.used["cpu"].milli == 300
+
+    def test_memory_quota_units(self):
+        r = wired_registry("ResourceQuota")
+        r.create("resourcequotas", api.ResourceQuota(
+            metadata=api.ObjectMeta(name="memq", namespace="default"),
+            spec=api.ResourceQuotaSpec(hard={
+                "memory": parse_quantity("1Gi")})))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="m", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(requests={
+                    "memory": parse_quantity("1Gi")}))]))
+        r.create("pods", pod)  # exactly fills the quota
+        with pytest.raises(CoreForbidden):
+            small = api.Pod(
+                metadata=api.ObjectMeta(name="m2", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img",
+                    resources=api.ResourceRequirements(requests={
+                        "memory": parse_quantity("1Mi")}))]))
+            r.create("pods", small)
+
+    def test_quota_controller_frees_deleted_pods(self):
+        from kubernetes_tpu.controllers import ResourceQuotaController
+        client = InProcClient(self.r)
+        ctrl = ResourceQuotaController(client)
+        self.r.create("pods", mkpod("a", cpu="100m"))
+        self.r.create("pods", mkpod("b", cpu="100m"))
+        with pytest.raises(CoreForbidden):
+            self.r.create("pods", mkpod("c", cpu="100m"))
+        self.r.delete("pods", "a", "default")
+        self.r.delete("pods", "b", "default")
+        assert ctrl.sync_once() >= 1  # recalculated down to zero
+        q = self.r.get("resourcequotas", "quota", "default")
+        assert q.status.used["pods"].value == 0
+        self.r.create("pods", mkpod("c", cpu="100m"))  # admits again
+
+    def test_concurrent_admits_cannot_both_take_last_slot(self):
+        self.r.create("pods", mkpod("a", cpu="100m"))
+        errs = []
+
+        def run(i):
+            try:
+                self.r.create("pods", mkpod(f"racer-{i}", cpu="100m"))
+            except ApiError as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # hard pods=2: exactly one racer wins, three get Forbidden
+        assert len(errs) == 3
+
+
+class TestServiceAccountAndSCDeny:
+    def test_serviceaccount_defaulted_and_required(self):
+        r = wired_registry("ServiceAccount")
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p"))  # no default SA yet
+        r.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default")))
+        created = r.create("pods", mkpod("p"))
+        assert created.spec.service_account_name == "default"
+
+    def test_scdeny_blocks_privileged(self):
+        r = wired_registry("SecurityContextDeny")
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p", privileged=True))
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p", host_net=True))
+        r.create("pods", mkpod("ok"))
+
+    def test_always_deny(self):
+        r = wired_registry("AlwaysDeny")
+        with pytest.raises(CoreForbidden):
+            r.create("pods", mkpod("p"))
+
+
+# ------------------------------------------------------------ authn/authz
+
+
+@pytest.fixture()
+def secured_server():
+    registry = Registry()
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    authn = UnionAuthenticator([
+        BasicAuthAuthenticator.from_lines(["secret,alice,1"]),
+        TokenAuthenticator.from_lines(["tok123,bob,2,admins"])])
+    authz = abac_from_lines([
+        '{"user": "alice", "resource": "pods", "readonly": true}',
+        '{"group": "admins"}'])
+    server = ApiServer(registry, authenticator=authn,
+                       authorizer=authz).start()
+    yield server
+    server.stop()
+
+
+def basic(user, pw):
+    return {"Authorization":
+            "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+
+def test_unauthenticated_request_401(secured_server):
+    client = HTTPClient(secured_server.url)
+    with pytest.raises(ApiError) as e:
+        client.list("pods", "default")
+    assert e.value.code == 401
+
+
+def test_wrong_password_401(secured_server):
+    client = HTTPClient(secured_server.url, headers=basic("alice", "wrong"))
+    with pytest.raises(ApiError) as e:
+        client.list("pods", "default")
+    assert e.value.code == 401
+
+
+def test_readonly_user_can_get_but_not_post(secured_server):
+    client = HTTPClient(secured_server.url, headers=basic("alice", "secret"))
+    client.list("pods", "default")  # allowed: readonly pods
+    with pytest.raises(ApiError) as e:
+        client.create("pods", mkpod("p"), "default")
+    assert e.value.code == 403
+    with pytest.raises(ApiError) as e:
+        client.list("nodes")  # not pods
+    assert e.value.code == 403
+
+
+def test_group_admin_can_write(secured_server):
+    client = HTTPClient(secured_server.url,
+                        headers={"Authorization": "Bearer tok123"})
+    created = client.create("pods", mkpod("p"), "default")
+    assert created.metadata.name == "p"
+
+
+def test_healthz_open_without_credentials(secured_server):
+    import urllib.request
+    with urllib.request.urlopen(secured_server.url + "/healthz") as resp:
+        assert resp.status == 200
+        assert resp.read() == b"ok"
+
+
+def test_watch_carries_auth_headers(secured_server):
+    client = HTTPClient(secured_server.url,
+                        headers={"Authorization": "Bearer tok123"})
+    w = client.watch("pods", "default")
+    try:
+        client.create("pods", mkpod("seen"), "default")
+        ev = w.next(timeout=10)
+        assert ev is not None and ev.object.metadata.name == "seen"
+    finally:
+        w.stop()
+    # and without credentials the watch fails rather than hanging open
+    anon = HTTPClient(secured_server.url)
+    with pytest.raises(ApiError) as e:
+        anon.watch("pods", "default")
+    assert e.value.code == 401
+
+
+def test_namespace_finalize_authorizes_as_namespaces(secured_server):
+    # {"group": "admins"} matches every resource incl. namespaces; a
+    # finalize PUT must not 403 as resource "finalize"
+    client = HTTPClient(secured_server.url,
+                        headers={"Authorization": "Bearer tok123"})
+    ns = client.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="fin")))
+    client.delete("namespaces", "fin")
+    got = client.get("namespaces", "fin")
+    assert got.status.phase == "Terminating"

@@ -17,6 +17,7 @@ from ..core.quantity import Quantity
 from .chain import Chain
 from .interfaces import Attributes, Forbidden, Interface, Operation
 
+
 _factories: Dict[str, Callable] = {}
 
 
@@ -164,8 +165,11 @@ class LimitRanger(Interface):
         container.resources.requests = requests
 
 
-def _pod_usage(pod: api.Pod) -> Dict[str, int]:
-    """All values in Quantity milli units, the scale quota math runs in."""
+def pod_usage(pod: api.Pod) -> Dict[str, int]:
+    """Quota usage of one pod in Quantity milli units — the single
+    formula shared by the admission increment and the quota controller's
+    recalculation (controllers/resourcequota.py); keep them identical or
+    the two paths drift."""
     cpu = 0
     mem = 0
     for c in pod.spec.containers:
@@ -208,7 +212,7 @@ class ResourceQuota(Interface):
         if count_key in hard:
             deltas[count_key] = 1000  # whole-unit Quantity milli
         if attributes.resource == "pods" and attributes.object is not None:
-            usage = _pod_usage(attributes.object)
+            usage = pod_usage(attributes.object)
             for resource in ("cpu", "memory"):
                 if resource in hard:
                     deltas[resource] = usage[resource]

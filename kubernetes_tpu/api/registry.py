@@ -102,6 +102,10 @@ _register(ResourceInfo("limitranges", "LimitRange", api.LimitRange, True,
 _register(ResourceInfo("resourcequotas", "ResourceQuota", api.ResourceQuota, True))
 _register(ResourceInfo("serviceaccounts", "ServiceAccount", api.ServiceAccount,
                        True, has_status=False))
+_register(ResourceInfo("persistentvolumes", "PersistentVolume",
+                       api.PersistentVolume, False))
+_register(ResourceInfo("persistentvolumeclaims", "PersistentVolumeClaim",
+                       api.PersistentVolumeClaim, True))
 # Virtual resource: POST /bindings assigns a pod to a node (no storage of its
 # own; ref: pkg/registry/pod/etcd BindingREST).
 _register(ResourceInfo("bindings", "Binding", api.Binding, True,
@@ -114,7 +118,8 @@ class Registry:
     def __init__(self, store: Optional[Store] = None,
                  scheme: Scheme = default_scheme,
                  admission: Optional[
-                     Callable[[str, str, Any, str, str], Any]] = None):
+                     Callable[[str, str, Any, str, str], Any]] = None,
+                 service_cidr: str = "10.0.0.0/24"):
         self.store = store or Store()
         self.scheme = scheme
         # admission(operation, resource, obj, namespace, name) -> obj;
@@ -122,6 +127,24 @@ class Registry:
         # resthandler createHandler). Set after construction when plugins
         # need the registry itself (admission.new_from_plugins).
         self.admission = admission
+        # service cluster-IP + node-port allocators (ref:
+        # pkg/registry/service ipallocator/portallocator); repaired from
+        # the store so a registry over pre-existing state stays coherent
+        from .allocators import IPAllocator, PortAllocator
+        self.ip_allocator = IPAllocator(service_cidr)
+        self.port_allocator = PortAllocator()
+        for svc in self.store.list(self.prefix("services"))[0]:
+            if svc.spec.cluster_ip and svc.spec.cluster_ip != "None":
+                try:
+                    self.ip_allocator.allocate_specific(svc.spec.cluster_ip)
+                except Invalid:
+                    pass
+            for port in svc.spec.ports:
+                if port.node_port:
+                    try:
+                        self.port_allocator.allocate_specific(port.node_port)
+                    except Invalid:
+                        pass
 
     # ------------------------------------------------------------- keys
 
@@ -183,7 +206,94 @@ class Registry:
             info.validate(obj)
         if self.admission:
             obj = self.admission("CREATE", resource, obj, ns, name)
+        if resource == "services":
+            obj, allocated_ip, allocated_ports = self._service_allocate(obj)
+            try:
+                return self.store.create(self.key(resource, ns, name), obj,
+                                         ttl=info.ttl)
+            except Exception:
+                # roll the allocations back (ref: service REST releases on
+                # failed create)
+                if allocated_ip:
+                    self.ip_allocator.release(allocated_ip)
+                for port in allocated_ports:
+                    self.port_allocator.release(port)
+                raise
         return self.store.create(self.key(resource, ns, name), obj, ttl=info.ttl)
+
+    def _service_allocate(self, obj: api.Service):
+        """Assign cluster IP + node ports (ref: pkg/registry/service
+        rest.go Create: headless "None" skips IP; explicit requests are
+        honored or rejected; NodePort/LoadBalancer types get node ports)."""
+        spec = obj.spec
+        allocated_ip = ""
+        if spec.cluster_ip != "None":
+            if spec.cluster_ip:
+                self.ip_allocator.allocate_specific(spec.cluster_ip)
+                allocated_ip = spec.cluster_ip
+            else:
+                allocated_ip = self.ip_allocator.allocate()
+                spec = replace(spec, cluster_ip=allocated_ip)
+        allocated_ports = []
+        if spec.type in ("NodePort", "LoadBalancer"):
+            try:
+                ports = []
+                for port in spec.ports:
+                    if port.node_port:
+                        self.port_allocator.allocate_specific(port.node_port)
+                        allocated_ports.append(port.node_port)
+                        ports.append(port)
+                    else:
+                        node_port = self.port_allocator.allocate()
+                        allocated_ports.append(node_port)
+                        ports.append(replace(port, node_port=node_port))
+                spec = replace(spec, ports=ports)
+            except Exception:
+                if allocated_ip:
+                    self.ip_allocator.release(allocated_ip)
+                for port in allocated_ports:
+                    self.port_allocator.release(port)
+                raise
+        return replace(obj, spec=spec), allocated_ip, allocated_ports
+
+    def _service_update_ports(self, current: api.Service, obj: api.Service):
+        """Reconcile node-port allocations on update: newly requested
+        ports are claimed (or assigned when 0 on a NodePort service);
+        ports the update drops are returned for release AFTER the store
+        write lands (a failed write must leave the allocator matching
+        storage). -> (obj, claimed, to_release_on_success)."""
+        old_ports = {p.node_port for p in current.spec.ports if p.node_port}
+        spec = obj.spec
+        wants_node_ports = spec.type in ("NodePort", "LoadBalancer")
+        claimed = []
+        try:
+            ports = []
+            for port in spec.ports:
+                if port.node_port:
+                    if port.node_port not in old_ports:
+                        self.port_allocator.allocate_specific(port.node_port)
+                        claimed.append(port.node_port)
+                    ports.append(port)
+                elif wants_node_ports:
+                    node_port = self.port_allocator.allocate()
+                    claimed.append(node_port)
+                    ports.append(replace(port, node_port=node_port))
+                else:
+                    ports.append(port)
+        except Exception:
+            for port in claimed:
+                self.port_allocator.release(port)
+            raise
+        new_ports = {p.node_port for p in ports if p.node_port}
+        return (replace(obj, spec=replace(spec, ports=ports)), claimed,
+                sorted(old_ports - new_ports))
+
+    def _service_release(self, obj: api.Service) -> None:
+        if obj.spec.cluster_ip and obj.spec.cluster_ip != "None":
+            self.ip_allocator.release(obj.spec.cluster_ip)
+        for port in obj.spec.ports:
+            if port.node_port:
+                self.port_allocator.release(port.node_port)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         info = self.info(resource)
@@ -227,18 +337,43 @@ class Registry:
                                      current.metadata.deletion_timestamp)),
                 spec=replace(obj.spec,
                              finalizers=list(current.spec.finalizers)))
+        if resource == "services":
+            # clusterIP is immutable once assigned (ref:
+            # pkg/registry/service/rest.go Update + api validation)
+            current = self.store.get(self.key(resource, ns,
+                                              obj.metadata.name))
+            if not obj.spec.cluster_ip:
+                obj = replace(obj, spec=replace(
+                    obj.spec, cluster_ip=current.spec.cluster_ip))
+            elif obj.spec.cluster_ip != current.spec.cluster_ip:
+                raise Invalid("spec.clusterIP: field is immutable")
+            obj, svc_claimed, svc_to_release = \
+                self._service_update_ports(current, obj)
+        else:
+            svc_claimed, svc_to_release = [], []
         if info.validate:
             info.validate(obj)
-        if self.admission:
-            obj = self.admission("UPDATE", resource, obj, ns,
-                                 obj.metadata.name)
-        key = self.key(resource, ns, obj.metadata.name)
-        if not obj.metadata.resource_version:
-            # Unconditional update requires the object to exist
-            # (PUT never creates in the reference's generic store).
-            self.store.get(key)
-            return self.store.set(key, obj, ttl=info.ttl)
-        return self.store.update(key, obj)
+        try:
+            if self.admission:
+                obj = self.admission("UPDATE", resource, obj, ns,
+                                     obj.metadata.name)
+            key = self.key(resource, ns, obj.metadata.name)
+            if not obj.metadata.resource_version:
+                # Unconditional update requires the object to exist
+                # (PUT never creates in the reference's generic store).
+                self.store.get(key)
+                result = self.store.set(key, obj, ttl=info.ttl)
+            else:
+                result = self.store.update(key, obj)
+        except Exception:
+            # the write never landed: newly claimed ports go back, dropped
+            # ones stay owned by the stored object
+            for port in svc_claimed:
+                self.port_allocator.release(port)
+            raise
+        for port in svc_to_release:
+            self.port_allocator.release(port)
+        return result
 
     def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
         """Status subresource: replace only .status, keep spec/meta
@@ -272,9 +407,12 @@ class Registry:
         if resource == "namespaces":
             return self._delete_namespace(name)
         try:
-            return self.store.delete(self.key(resource, ns, name))
+            deleted = self.store.delete(self.key(resource, ns, name))
         except NotFound:
             raise NotFound(kind=resource, name=name)
+        if resource == "services":
+            self._service_release(deleted)
+        return deleted
 
     # --------------------------------------------- namespace lifecycle
 

@@ -12,10 +12,11 @@ from .endpoint import EndpointsController
 from .gc import PodGCController
 from .namespace import NamespaceController
 from .resourcequota import ResourceQuotaController
+from .persistentvolume import PersistentVolumeClaimBinder
 
 __all__ = [
     "ControllerExpectations", "QueueWorkers", "active_pods_sort_key",
     "filter_active_pods", "ReplicationManager", "NodeController",
     "EndpointsController", "PodGCController", "NamespaceController",
-    "ResourceQuotaController",
+    "ResourceQuotaController", "PersistentVolumeClaimBinder",
 ]

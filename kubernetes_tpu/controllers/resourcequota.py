@@ -40,15 +40,13 @@ def calculate_usage(client, quota: api.ResourceQuota) -> Dict[str, Quantity]:
     if "pods" in hard:
         used["pods"] = Quantity(1000 * len(pods))
     if "cpu" in hard or "memory" in hard:
+        from ..admission.plugins import pod_usage
         cpu = 0
         mem = 0
         for p in pods:
-            for c in p.spec.containers:
-                req = c.resources.requests
-                if "cpu" in req:
-                    cpu += req["cpu"].milli
-                if "memory" in req:
-                    mem += req["memory"].milli
+            u = pod_usage(p)
+            cpu += u["cpu"]
+            mem += u["memory"]
         if "cpu" in hard:
             used["cpu"] = Quantity(cpu)
         if "memory" in hard:

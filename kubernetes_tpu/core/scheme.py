@@ -100,6 +100,8 @@ def new_scheme() -> Scheme:
     s.register("LimitRange", api.LimitRange)
     s.register("ResourceQuota", api.ResourceQuota)
     s.register("ServiceAccount", api.ServiceAccount)
+    s.register("PersistentVolume", api.PersistentVolume)
+    s.register("PersistentVolumeClaim", api.PersistentVolumeClaim)
     return s
 
 

@@ -535,6 +535,67 @@ class ServiceAccount:
     secrets: List[ObjectReference] = field(default_factory=list)
 
 
+# ------------------------------------------------------ persistent volumes
+
+VOLUME_AVAILABLE = "Available"
+VOLUME_BOUND = "Bound"
+VOLUME_RELEASED = "Released"
+CLAIM_PENDING = "Pending"
+CLAIM_BOUND = "Bound"
+
+
+@dataclass
+class PersistentVolumeSpec:
+    """(ref: pkg/api/types.go PersistentVolumeSpec: capacity, one volume
+    source, accessModes, claimRef, reclaim policy)"""
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)
+    claim_ref: Optional[ObjectReference] = None
+    persistent_volume_reclaim_policy: str = "Retain"
+    host_path: Optional[HostPathVolumeSource] = None
+    nfs: Optional[NFSVolumeSource] = None
+    gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
+    aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = ""
+    message: str = ""
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(
+        default_factory=PersistentVolumeStatus)
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: List[str] = field(default_factory=list)
+    resources: ResourceRequirements = field(
+        default_factory=ResourceRequirements)
+    volume_name: str = ""
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = ""
+    access_modes: List[str] = field(default_factory=list)
+    capacity: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(
+        default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus)
+
+
 # ---------------------------------------------------------------- helpers
 
 def pod_resource_fields(pod: Pod) -> Dict[str, str]:

@@ -1,0 +1,241 @@
+"""Service cluster-IP / node-port allocation strategy + PV/PVC resources
+(ref: pkg/registry/service ipallocator/portallocator, pkg/registry
+persistentvolume{,claim})."""
+
+import pytest
+
+from kubernetes_tpu.api.allocators import (AllocationError, IPAllocator,
+                                           PortAllocator)
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import Invalid
+from kubernetes_tpu.core.quantity import parse_quantity
+
+
+def svc(name, cluster_ip="", stype="ClusterIP", node_port=0):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ServiceSpec(
+            cluster_ip=cluster_ip, type=stype,
+            ports=[api.ServicePort(name="http", port=80,
+                                   node_port=node_port)]))
+
+
+class TestIPAllocator:
+    def test_sequential_unique(self):
+        a = IPAllocator("10.0.0.0/28")
+        got = {a.allocate() for _ in range(14)}
+        assert len(got) == 14
+        assert "10.0.0.0" not in got and "10.0.0.15" not in got
+        with pytest.raises(AllocationError):
+            a.allocate()
+
+    def test_release_reuses(self):
+        a = IPAllocator("10.0.0.0/30")
+        ip1 = a.allocate()
+        ip2 = a.allocate()
+        with pytest.raises(AllocationError):
+            a.allocate()
+        a.release(ip1)
+        assert a.allocate() == ip1
+        assert a.has(ip2)
+
+    def test_specific(self):
+        a = IPAllocator("10.0.0.0/24")
+        assert a.allocate_specific("10.0.0.42") == "10.0.0.42"
+        with pytest.raises(AllocationError):
+            a.allocate_specific("10.0.0.42")
+        with pytest.raises(AllocationError):
+            a.allocate_specific("10.9.9.9")  # outside CIDR
+
+
+class TestServiceStrategy:
+    def setup_method(self):
+        self.r = Registry()
+
+    def test_cluster_ip_assigned(self):
+        created = self.r.create("services", svc("a"))
+        assert created.spec.cluster_ip.startswith("10.0.0.")
+        second = self.r.create("services", svc("b"))
+        assert second.spec.cluster_ip != created.spec.cluster_ip
+
+    def test_headless_skips_allocation(self):
+        created = self.r.create("services", svc("hl", cluster_ip="None"))
+        assert created.spec.cluster_ip == "None"
+
+    def test_explicit_ip_honored_and_conflicts_rejected(self):
+        created = self.r.create("services", svc("a", cluster_ip="10.0.0.77"))
+        assert created.spec.cluster_ip == "10.0.0.77"
+        with pytest.raises(Invalid):
+            self.r.create("services", svc("b", cluster_ip="10.0.0.77"))
+
+    def test_delete_releases_ip(self):
+        created = self.r.create("services", svc("a", cluster_ip="10.0.0.9"))
+        self.r.delete("services", "a", "default")
+        again = self.r.create("services", svc("b", cluster_ip="10.0.0.9"))
+        assert again.spec.cluster_ip == "10.0.0.9"
+
+    def test_nodeport_assigned_and_released(self):
+        created = self.r.create("services", svc("np", stype="NodePort"))
+        port = created.spec.ports[0].node_port
+        assert 30000 <= port <= 32767
+        with pytest.raises(Invalid):
+            self.r.create("services", svc("np2", stype="NodePort",
+                                          node_port=port))
+        self.r.delete("services", "np", "default")
+        again = self.r.create("services", svc("np3", stype="NodePort",
+                                              node_port=port))
+        assert again.spec.ports[0].node_port == port
+
+    def test_cluster_ip_immutable_on_update(self):
+        created = self.r.create("services", svc("a"))
+        from dataclasses import replace
+        moved = replace(created, spec=replace(created.spec,
+                                              cluster_ip="10.0.0.200"))
+        with pytest.raises(Invalid):
+            self.r.update("services", moved)
+        # empty IP on update keeps the assigned one
+        blank = replace(created, spec=replace(created.spec, cluster_ip=""))
+        updated = self.r.update("services", blank)
+        assert updated.spec.cluster_ip == created.spec.cluster_ip
+
+    def test_allocators_repair_from_existing_store(self):
+        created = self.r.create("services", svc("a"))
+        rebuilt = Registry(store=self.r.store)
+        with pytest.raises(Invalid):
+            rebuilt.create("services", svc(
+                "b", cluster_ip=created.spec.cluster_ip))
+
+
+class TestPortAllocator:
+    def test_range(self):
+        p = PortAllocator(base=31000, size=2)
+        assert p.allocate() == 31000
+        assert p.allocate() == 31001
+        with pytest.raises(AllocationError):
+            p.allocate()
+        p.release(31000)
+        assert p.allocate() == 31000
+
+
+class TestServiceUpdatePorts:
+    def setup_method(self):
+        self.r = Registry()
+
+    def test_update_changes_node_port(self):
+        from dataclasses import replace
+        created = self.r.create("services", svc("a", stype="NodePort"))
+        old = created.spec.ports[0].node_port
+        moved = replace(created, spec=replace(
+            created.spec,
+            ports=[replace(created.spec.ports[0], node_port=31555)]))
+        updated = self.r.update("services", moved)
+        assert updated.spec.ports[0].node_port == 31555
+        # old port released, new port claimed
+        again = self.r.create("services", svc("b", stype="NodePort",
+                                              node_port=old))
+        assert again.spec.ports[0].node_port == old
+        with pytest.raises(Invalid):
+            self.r.create("services", svc("c", stype="NodePort",
+                                          node_port=31555))
+
+    def test_update_to_clusterip_releases_ports(self):
+        from dataclasses import replace
+        created = self.r.create("services", svc("a", stype="NodePort"))
+        old = created.spec.ports[0].node_port
+        downgraded = replace(created, spec=replace(
+            created.spec, type="ClusterIP",
+            ports=[replace(created.spec.ports[0], node_port=0)]))
+        self.r.update("services", downgraded)
+        again = self.r.create("services", svc("b", stype="NodePort",
+                                              node_port=old))
+        assert again.spec.ports[0].node_port == old
+
+    def test_invalid_cluster_ip_string_rejected_cleanly(self):
+        with pytest.raises(Invalid):
+            self.r.create("services", svc("bad", cluster_ip="not-an-ip"))
+
+
+def test_pv_claim_binder():
+    from kubernetes_tpu.api.client import InProcClient
+    from kubernetes_tpu.controllers import PersistentVolumeClaimBinder
+
+    r = Registry()
+    client = InProcClient(r)
+    binder = PersistentVolumeClaimBinder(client)
+
+    def pv(name, gi, policy="Retain"):
+        return api.PersistentVolume(
+            metadata=api.ObjectMeta(name=name),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": parse_quantity(f"{gi}Gi")},
+                access_modes=["ReadWriteOnce"],
+                persistent_volume_reclaim_policy=policy,
+                host_path=api.HostPathVolumeSource(path=f"/tmp/{name}")))
+
+    r.create("persistentvolumes", pv("small", 5))
+    r.create("persistentvolumes", pv("big", 50, policy="Recycle"))
+    claim = api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name="c1", namespace="default"),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteOnce"],
+            resources=api.ResourceRequirements(
+                requests={"storage": parse_quantity("3Gi")})))
+    r.create("persistentvolumeclaims", claim)
+    binder.sync_once()
+
+    # smallest satisfying volume wins
+    small = r.get("persistentvolumes", "small")
+    assert small.status.phase == api.VOLUME_BOUND
+    assert small.spec.claim_ref.name == "c1"
+    bound_claim = r.get("persistentvolumeclaims", "c1", "default")
+    assert bound_claim.spec.volume_name == "small"
+    assert bound_claim.status.phase == api.CLAIM_BOUND
+    big = r.get("persistentvolumes", "big")
+    assert big.status.phase == api.VOLUME_AVAILABLE
+
+    # deleting the claim releases (Retain keeps claimRef, phase Released)
+    r.delete("persistentvolumeclaims", "c1", "default")
+    binder.sync_once()
+    released = r.get("persistentvolumes", "small")
+    assert released.status.phase == api.VOLUME_RELEASED
+
+    # a Recycle volume returns to Available for the next claim
+    claim2 = api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name="c2", namespace="default"),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteOnce"],
+            resources=api.ResourceRequirements(
+                requests={"storage": parse_quantity("40Gi")})))
+    r.create("persistentvolumeclaims", claim2)
+    binder.sync_once()
+    assert r.get("persistentvolumes",
+                 "big").spec.claim_ref.name == "c2"
+    r.delete("persistentvolumeclaims", "c2", "default")
+    binder.sync_once()  # Recycle: scrubbed back to Available
+    recycled = r.get("persistentvolumes", "big")
+    assert recycled.status.phase == api.VOLUME_AVAILABLE
+    assert recycled.spec.claim_ref is None
+
+
+def test_pv_pvc_crud():
+    r = Registry()
+    pv = api.PersistentVolume(
+        metadata=api.ObjectMeta(name="pv1"),
+        spec=api.PersistentVolumeSpec(
+            capacity={"storage": parse_quantity("10Gi")},
+            access_modes=["ReadWriteOnce"],
+            host_path=api.HostPathVolumeSource(path="/tmp/pv1")))
+    created = r.create("persistentvolumes", pv)
+    assert created.metadata.name == "pv1"
+    claim = api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name="c1", namespace="default"),
+        spec=api.PersistentVolumeClaimSpec(
+            access_modes=["ReadWriteOnce"],
+            resources=api.ResourceRequirements(
+                requests={"storage": parse_quantity("5Gi")})))
+    r.create("persistentvolumeclaims", claim)
+    got, _ = r.list("persistentvolumeclaims", "default")
+    assert len(got) == 1
+    r.delete("persistentvolumeclaims", "c1", "default")
+    r.delete("persistentvolumes", "pv1")

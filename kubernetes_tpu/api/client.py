@@ -161,8 +161,11 @@ class HttpClient(Client):
 
     def _url(self, resource: str, namespace: str = "", name: str = "",
              sub: str = "", query: Optional[dict] = None) -> str:
+        from .registry import EXTENSIONS_RESOURCES
         info = Registry.info(resource)
-        parts = [self.base_url, "api/v1"]
+        group = ("apis/extensions/v1beta1"
+                 if resource in EXTENSIONS_RESOURCES else "api/v1")
+        parts = [self.base_url, group]
         if info.namespaced and namespace:
             parts += ["namespaces", namespace]
         parts.append(resource)

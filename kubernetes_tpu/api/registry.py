@@ -106,6 +106,17 @@ _register(ResourceInfo("persistentvolumes", "PersistentVolume",
                        api.PersistentVolume, False))
 _register(ResourceInfo("persistentvolumeclaims", "PersistentVolumeClaim",
                        api.PersistentVolumeClaim, True))
+# extensions/v1beta1 group (ref: pkg/registry/{job,deployment,daemonset,
+# horizontalpodautoscaler,ingress}; mounted master.go:1049-1091 — served
+# under /apis/extensions/v1beta1 by the API server)
+EXTENSIONS_RESOURCES = ("jobs", "deployments", "daemonsets",
+                        "horizontalpodautoscalers", "ingresses")
+_register(ResourceInfo("jobs", "Job", api.Job, True))
+_register(ResourceInfo("deployments", "Deployment", api.Deployment, True))
+_register(ResourceInfo("daemonsets", "DaemonSet", api.DaemonSet, True))
+_register(ResourceInfo("horizontalpodautoscalers", "HorizontalPodAutoscaler",
+                       api.HorizontalPodAutoscaler, True))
+_register(ResourceInfo("ingresses", "Ingress", api.Ingress, True))
 # Virtual resource: POST /bindings assigns a pod to a node (no storage of its
 # own; ref: pkg/registry/pod/etcd BindingREST).
 _register(ResourceInfo("bindings", "Binding", api.Binding, True,

@@ -45,9 +45,14 @@ def _authz_target(path: str):
     """(resource, namespace) for authorization attributes; non-API paths
     authorize against resource ""."""
     parts = [p for p in path.split("/") if p]
-    if len(parts) < 3 or parts[0] != "api":
+    if len(parts) >= 3 and parts[0] == "apis":
+        parts = parts[3:]
+    elif len(parts) >= 3 and parts[0] == "api":
+        parts = parts[2:]
+    else:
         return "", ""
-    parts = parts[2:]
+    if not parts:
+        return "", ""  # bare group discovery (/apis/extensions/v1beta1)
     if parts[0] == "watch":
         parts = parts[1:]
     if parts and parts[0] == "namespaces" and len(parts) >= 3 \
@@ -180,18 +185,43 @@ class ApiServer:
         if path == "/api":
             return self._send_json(h, 200, {"kind": "APIVersions",
                                             "versions": ["v1"]})
+        if path == "/apis":
+            return self._send_json(h, 200, {
+                "kind": "APIGroupList",
+                "groups": [{"name": "extensions",
+                            "versions": [{"groupVersion":
+                                          "extensions/v1beta1",
+                                          "version": "v1beta1"}]}]})
+        from .registry import EXTENSIONS_RESOURCES
         if path in ("/api/v1", ""):
             return self._send_json(h, 200, {
                 "kind": "APIResourceList", "groupVersion": "v1",
                 "resources": [
                     {"name": n, "namespaced": i.namespaced, "kind": i.kind}
-                    for n, i in sorted(RESOURCES.items())]})
+                    for n, i in sorted(RESOURCES.items())
+                    if n not in EXTENSIONS_RESOURCES]})
+        if path == "/apis/extensions/v1beta1":
+            return self._send_json(h, 200, {
+                "kind": "APIResourceList",
+                "groupVersion": "extensions/v1beta1",
+                "resources": [
+                    {"name": n, "namespaced": i.namespaced, "kind": i.kind}
+                    for n, i in sorted(RESOURCES.items())
+                    if n in EXTENSIONS_RESOURCES]})
 
         parts = [p for p in path.split("/") if p]
-        # strip "api/v1"
-        if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+        # strip "api/v1" or "apis/extensions/v1beta1" (one flat registry
+        # serves both groups; the reference mounts the extensions group at
+        # its own prefix, master.go:1049)
+        if len(parts) >= 3 and parts[0] == "apis" and \
+                parts[1] == "extensions" and parts[2] == "v1beta1":
+            parts = parts[3:]
+        elif len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+            parts = parts[2:]
+        else:
             raise NotFound(f"path {path!r} not found")
-        parts = parts[2:]
+        if not parts:
+            raise NotFound(f"path {path!r} not found")
 
         namespace = ""
         if (parts[0] == "namespaces" and len(parts) >= 3

@@ -13,10 +13,19 @@ from .gc import PodGCController
 from .namespace import NamespaceController
 from .resourcequota import ResourceQuotaController
 from .persistentvolume import PersistentVolumeClaimBinder
+from .job import JobController
+from .daemon import DaemonSetController
+from .deployment import DeploymentController
+from .podautoscaler import HorizontalController
+from .serviceaccount import ServiceAccountsController, TokensController
+from .manager import ControllerManager
 
 __all__ = [
     "ControllerExpectations", "QueueWorkers", "active_pods_sort_key",
     "filter_active_pods", "ReplicationManager", "NodeController",
     "EndpointsController", "PodGCController", "NamespaceController",
     "ResourceQuotaController", "PersistentVolumeClaimBinder",
+    "JobController", "DaemonSetController", "DeploymentController",
+    "HorizontalController", "ServiceAccountsController",
+    "TokensController", "ControllerManager",
 ]

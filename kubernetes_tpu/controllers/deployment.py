@@ -1,0 +1,236 @@
+"""Deployment controller: declarative rollouts over ReplicationControllers.
+
+Reference: pkg/controller/deployment/deployment_controller.go (v1.1) —
+a Deployment owns RCs distinguished by a pod-template hash label
+(getNewRC/getOldRCs); RollingUpdate reconciliation scales the new RC up
+(bounded by maxSurge) and old RCs down (bounded by maxUnavailable) until
+the new RC carries spec.replicas; Recreate scales old RCs to zero first.
+The RC manager (replication.py) does the actual pod management — this
+controller only moves RC replica counts, exactly the reference's
+division of labor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import replace
+from typing import List, Optional, Tuple
+
+from ..api.cache import Informer, meta_namespace_key
+from ..core import types as api
+from ..core.labels import selector_from_set
+from ..core.serde import to_wire
+from .framework import QueueWorkers
+
+
+def pod_template_hash(template: api.PodTemplateSpec) -> str:
+    """Deterministic hash of the template (the reference hashes the
+    api.PodTemplateSpec with adler32; any stable digest serves)."""
+    wire = json.dumps(to_wire(template), sort_keys=True)
+    return hashlib.sha1(wire.encode()).hexdigest()[:10]
+
+
+class DeploymentController:
+    def __init__(self, client, workers: int = 5):
+        self.client = client
+        self.workers = QueueWorkers(self._sync, workers, name="deployment")
+        self.deploy_informer = Informer(
+            client, "deployments",
+            on_add=self._enqueue,
+            on_update=lambda old, new: self._enqueue(new),
+            on_delete=self._enqueue)
+        self.rc_informer = Informer(
+            client, "replicationcontrollers",
+            on_add=self._enqueue_rc_deployment,
+            on_update=lambda old, new: self._enqueue_rc_deployment(new),
+            on_delete=self._enqueue_rc_deployment)
+
+    def _enqueue(self, d: api.Deployment) -> None:
+        self.workers.enqueue(meta_namespace_key(d))
+
+    def _enqueue_rc_deployment(self, rc: api.ReplicationController) -> None:
+        for d in self.deploy_informer.cache.list():
+            if d.metadata.namespace != rc.metadata.namespace:
+                continue
+            if d.spec.selector and selector_from_set(
+                    d.spec.selector).matches(rc.spec.template.metadata.labels
+                                             if rc.spec.template else {}):
+                self._enqueue(d)
+
+    # ----------------------------------------------------------- sync
+
+    def _deployment_rcs(self, d: api.Deployment
+                        ) -> Tuple[Optional[api.ReplicationController],
+                                   List[api.ReplicationController]]:
+        """(new_rc, old_rcs) split by template hash (getNewRC/getOldRCs).
+
+        Listed LIVE through the client, not the informer cache: the sync
+        itself creates RCs, and acting on a cache that hasn't observed
+        them yet would create duplicates every pass (the v1.1 reference
+        also lists RCs through the client in its sync). The informer only
+        drives enqueues."""
+        hash_key = d.spec.unique_label_key
+        _, want = self._hashed_template(d)
+        matches: List[api.ReplicationController] = []
+        old: List[api.ReplicationController] = []
+        sel = selector_from_set(d.spec.selector)
+        rcs, _ = self.client.list("replicationcontrollers",
+                                  d.metadata.namespace)
+        for rc in rcs:
+            tpl_labels = (rc.spec.template.metadata.labels
+                          if rc.spec.template else {})
+            if not sel.matches(tpl_labels):
+                continue
+            if tpl_labels.get(hash_key) == want:
+                matches.append(rc)
+            else:
+                old.append(rc)
+        if not matches:
+            return None, old
+        # oldest same-hash RC is THE new RC; duplicates (from a crashed
+        # sync or racing controllers) drain like old RCs
+        matches.sort(key=lambda rc: (rc.metadata.creation_timestamp,
+                                     rc.metadata.name))
+        return matches[0], old + matches[1:]
+
+    def _hashed_template(self, d: api.Deployment):
+        """-> (template carrying the hash label, digest). The digest is of
+        the BASE template (hash label stripped) — the same value the label
+        stores, so lookups and creation agree (deployment_controller.go
+        getNewRC: the RC's selector and template carry podTemplateHash)."""
+        tpl = d.spec.template
+        labels = dict(tpl.metadata.labels)
+        labels.pop(d.spec.unique_label_key, None)
+        base = api.PodTemplateSpec(
+            metadata=replace(tpl.metadata, labels=labels), spec=tpl.spec)
+        digest = pod_template_hash(base)
+        labels = dict(labels)
+        labels[d.spec.unique_label_key] = digest
+        return api.PodTemplateSpec(
+            metadata=replace(tpl.metadata, labels=labels),
+            spec=tpl.spec), digest
+
+    def _sync(self, key: str) -> None:
+        d = self.deploy_informer.cache.get_by_key(key)
+        if d is None:
+            return
+        try:
+            new_rc, old_rcs = self._deployment_rcs(d)
+        except Exception:
+            return  # apiserver hiccup: informer events re-drive
+        if new_rc is None:
+            new_rc = self._create_new_rc(d)
+            if new_rc is None:
+                return
+        if d.spec.strategy.type == "Recreate":
+            for rc in old_rcs:
+                if rc.spec.replicas != 0:
+                    self._scale(rc, 0)
+            if all(rc.status.replicas == 0 for rc in old_rcs):
+                if new_rc.spec.replicas != d.spec.replicas:
+                    self._scale(new_rc, d.spec.replicas)
+        else:
+            self._rolling_update(d, new_rc, old_rcs)
+        self._cleanup_and_status(d, new_rc, old_rcs)
+
+    def _rolling_update(self, d: api.Deployment,
+                        new_rc: api.ReplicationController,
+                        old_rcs: List[api.ReplicationController]) -> None:
+        """(reconcileNewRC/reconcileOldRCs: surge and unavailable bounds)"""
+        ru = d.spec.strategy.rolling_update
+        old_total = sum(rc.spec.replicas for rc in old_rcs)
+        total = new_rc.spec.replicas + old_total
+        max_total = d.spec.replicas + ru.max_surge
+        min_available = d.spec.replicas - ru.max_unavailable
+
+        if new_rc.spec.replicas < d.spec.replicas and total < max_total:
+            grow = min(d.spec.replicas - new_rc.spec.replicas,
+                       max_total - total)
+            self._scale(new_rc, new_rc.spec.replicas + grow)
+        elif new_rc.spec.replicas > d.spec.replicas:
+            # deployment scaled down: the new RC tracks spec directly
+            # (reconcileNewRC's scale-down branch)
+            self._scale(new_rc, d.spec.replicas)
+        available = (sum(rc.status.replicas for rc in old_rcs)
+                     + new_rc.status.replicas)
+        can_remove = available - min_available
+        for rc in sorted(old_rcs, key=lambda r: (r.metadata.creation_timestamp,
+                                                 r.metadata.name)):
+            if can_remove <= 0:
+                break
+            if rc.spec.replicas == 0:
+                continue
+            shrink = min(rc.spec.replicas, can_remove)
+            self._scale(rc, rc.spec.replicas - shrink)
+            can_remove -= shrink
+
+    def _create_new_rc(self, d: api.Deployment
+                       ) -> Optional[api.ReplicationController]:
+        tpl, digest = self._hashed_template(d)
+        selector = dict(d.spec.selector)
+        selector[d.spec.unique_label_key] = digest
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(
+                generate_name=f"{d.metadata.name}-",
+                namespace=d.metadata.namespace,
+                labels=dict(tpl.metadata.labels),
+                annotations={"kubernetes.io/created-by":
+                             f"Deployment/{d.metadata.name}"}),
+            spec=api.ReplicationControllerSpec(
+                replicas=0,  # rolled up by the rolling-update loop
+                selector=selector, template=tpl))
+        try:
+            return self.client.create("replicationcontrollers", rc,
+                                      d.metadata.namespace)
+        except Exception:
+            return None
+
+    def _scale(self, rc: api.ReplicationController, replicas: int) -> None:
+        try:
+            fresh = self.client.get("replicationcontrollers",
+                                    rc.metadata.name, rc.metadata.namespace)
+            self.client.update(
+                "replicationcontrollers",
+                replace(fresh, spec=replace(fresh.spec, replicas=replicas)),
+                rc.metadata.namespace)
+        except Exception:
+            pass  # next sync retries
+
+    def _cleanup_and_status(self, d: api.Deployment,
+                            new_rc: api.ReplicationController,
+                            old_rcs: List[api.ReplicationController]) -> None:
+        # drained old RCs are deleted (cleanupOldRCs)
+        for rc in old_rcs:
+            if rc.spec.replicas == 0 and rc.status.replicas == 0:
+                try:
+                    self.client.delete("replicationcontrollers",
+                                       rc.metadata.name,
+                                       rc.metadata.namespace)
+                except Exception:
+                    pass
+        total = (new_rc.status.replicas
+                 + sum(rc.status.replicas for rc in old_rcs))
+        if (d.status.replicas == total
+                and d.status.updated_replicas == new_rc.status.replicas):
+            return
+        try:
+            self.client.update_status("deployments", replace(
+                d, status=api.DeploymentStatus(
+                    replicas=total,
+                    updated_replicas=new_rc.status.replicas,
+                    observed_generation=d.metadata.generation)),
+                d.metadata.namespace)
+        except Exception:
+            pass
+
+    def run(self) -> "DeploymentController":
+        self.deploy_informer.start()
+        self.rc_informer.start()
+        self.workers.start()
+        return self
+
+    def stop(self) -> None:
+        self.workers.stop()
+        self.deploy_informer.stop()
+        self.rc_informer.stop()

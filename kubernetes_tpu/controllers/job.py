@@ -1,0 +1,199 @@
+"""Job controller.
+
+Reference: pkg/controller/job/controller.go — syncJob: count active/
+succeeded/failed pods by phase, run up to `parallelism` active pods until
+`succeeded >= completions`, then mark the Complete condition and delete
+leftover active pods. Defaulting follows the reference's api defaults:
+parallelism nil -> 1; completions nil -> "any single success completes"
+(treated as 1 for the done-check but parallelism still bounds actives).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+from typing import List
+
+from ..api.cache import Informer, meta_namespace_key
+from ..core import types as api
+from ..core.labels import selector_from_set
+from .framework import ControllerExpectations, QueueWorkers
+
+
+class JobController:
+    def __init__(self, client, workers: int = 5, recorder=None):
+        self.client = client
+        self.recorder = recorder
+        self.expectations = ControllerExpectations()
+        self.workers = QueueWorkers(self._sync, workers, name="job-controller")
+        self.job_informer = Informer(
+            client, "jobs",
+            on_add=self._enqueue,
+            on_update=lambda old, new: self._enqueue(new),
+            on_delete=self._enqueue)
+        self.pod_informer = Informer(
+            client, "pods",
+            on_add=self._pod_event(adds=True),
+            on_update=lambda old, new: self._enqueue_pod_job(new),
+            on_delete=self._pod_event(adds=False))
+
+    def _enqueue(self, job: api.Job) -> None:
+        self.workers.enqueue(meta_namespace_key(job))
+
+    def _job_for_pod(self, pod: api.Pod):
+        for job in self.job_informer.cache.list():
+            if job.metadata.namespace != pod.metadata.namespace:
+                continue
+            if job.spec.selector and selector_from_set(
+                    job.spec.selector).matches(pod.metadata.labels):
+                return job
+        return None
+
+    def _enqueue_pod_job(self, pod: api.Pod) -> None:
+        job = self._job_for_pod(pod)
+        if job is not None:
+            self._enqueue(job)
+
+    def _pod_event(self, adds: bool):
+        def handler(pod: api.Pod) -> None:
+            job = self._job_for_pod(pod)
+            if job is None:
+                return
+            key = meta_namespace_key(job)
+            if adds:
+                self.expectations.creation_observed(key)
+            else:
+                self.expectations.deletion_observed(key)
+            self._enqueue(job)
+        return handler
+
+    # ----------------------------------------------------------- sync
+
+    def _job_pods(self, job: api.Job) -> List[api.Pod]:
+        sel = selector_from_set(job.spec.selector)
+        return [p for p in self.pod_informer.cache.list()
+                if p.metadata.namespace == job.metadata.namespace
+                and sel.matches(p.metadata.labels)]
+
+    def _sync(self, key: str) -> None:
+        job = self.job_informer.cache.get_by_key(key)
+        if job is None:
+            self.expectations.delete(key)
+            return
+        pods = self._job_pods(job)
+        active = [p for p in pods
+                  if p.status.phase in (api.POD_PENDING, api.POD_RUNNING,
+                                        api.POD_UNKNOWN, "")
+                  and p.metadata.deletion_timestamp is None]
+        succeeded = sum(1 for p in pods
+                        if p.status.phase == api.POD_SUCCEEDED)
+        failed = sum(1 for p in pods if p.status.phase == api.POD_FAILED)
+
+        parallelism = job.spec.parallelism if job.spec.parallelism is not None else 1
+        completions = job.spec.completions
+        done = (succeeded >= completions if completions is not None
+                else succeeded > 0)
+
+        if self.expectations.satisfied(key):
+            if done:
+                # job finished: tear down still-active pods (controller.go
+                # syncJob completion path)
+                if active:
+                    self.expectations.expect_deletions(key, len(active))
+                    for pod in active:
+                        self._delete_pod(job, key, pod)
+                active = []
+            else:
+                remaining = (completions - succeeded
+                             if completions is not None else parallelism)
+                want_active = min(parallelism, remaining)
+                diff = want_active - len(active)
+                if diff > 0:
+                    self.expectations.expect_creations(key, diff)
+                    threads = [threading.Thread(
+                        target=self._create_pod, args=(job, key),
+                        daemon=True) for _ in range(diff)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                elif diff < 0:
+                    self.expectations.expect_deletions(key, -diff)
+                    for pod in active[:(-diff)]:
+                        self._delete_pod(job, key, pod)
+                    active = active[(-diff):]
+
+        self._update_status(job, len(active), succeeded, failed, done)
+
+    def _create_pod(self, job: api.Job, key: str) -> None:
+        tpl = job.spec.template
+        pod = api.Pod(
+            metadata=api.ObjectMeta(
+                generate_name=f"{job.metadata.name}-",
+                namespace=job.metadata.namespace,
+                labels=dict(tpl.metadata.labels),
+                annotations={"kubernetes.io/created-by":
+                             f"Job/{job.metadata.name}"}),
+            spec=tpl.spec,
+            status=api.PodStatus(phase="Pending"))
+        try:
+            self.client.create("pods", pod, job.metadata.namespace)
+            if self.recorder:
+                self.recorder.eventf(job, "Normal", "SuccessfulCreate",
+                                     "Created pod")
+        except Exception:
+            self.expectations.creation_observed(key)
+            if self.recorder:
+                self.recorder.eventf(job, "Warning", "FailedCreate",
+                                     "Error creating pod")
+
+    def _delete_pod(self, job: api.Job, key: str, pod: api.Pod) -> None:
+        try:
+            self.client.delete("pods", pod.metadata.name,
+                               pod.metadata.namespace)
+            if self.recorder:
+                self.recorder.eventf(job, "Normal", "SuccessfulDelete",
+                                     "Deleted pod %s", pod.metadata.name)
+        except Exception:
+            self.expectations.deletion_observed(key)
+            if self.recorder:
+                self.recorder.eventf(job, "Warning", "FailedDelete",
+                                     "Error deleting pod %s",
+                                     pod.metadata.name)
+
+    def _update_status(self, job: api.Job, active: int, succeeded: int,
+                       failed: int, done: bool) -> None:
+        conditions = list(job.status.conditions)
+        complete_already = any(c.type == "Complete" and c.status == "True"
+                               for c in conditions)
+        changed = (job.status.active != active
+                   or job.status.succeeded != succeeded
+                   or job.status.failed != failed
+                   or (done and not complete_already))
+        if not changed:
+            return
+        if done and not complete_already:
+            conditions.append(api.JobCondition(type="Complete",
+                                               status="True"))
+        status = api.JobStatus(
+            conditions=conditions,
+            start_time=job.status.start_time or api.now_rfc3339(),
+            completion_time=(job.status.completion_time
+                             or (api.now_rfc3339() if done else None)),
+            active=active, succeeded=succeeded, failed=failed)
+        try:
+            self.client.update_status(
+                "jobs", replace(job, status=status), job.metadata.namespace)
+        except Exception:
+            pass  # next sync retries
+
+    def run(self) -> "JobController":
+        self.job_informer.start()
+        self.pod_informer.start()
+        self.workers.start()
+        return self
+
+    def stop(self) -> None:
+        self.workers.stop()
+        self.job_informer.stop()
+        self.pod_informer.stop()

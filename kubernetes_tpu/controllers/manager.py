@@ -1,0 +1,59 @@
+"""Controller manager: start every controller with one call.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:284-443 —
+endpoints :284, RC manager :287, node controller :303, resourcequota
+:327, namespace :351, HPA :368, daemonset :374, job :380, PV binder
+:407, serviceaccount + tokens :433-443 (plus pod GC). Each controller is
+independent; the manager only owns their lifecycle.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .daemon import DaemonSetController
+from .deployment import DeploymentController
+from .endpoint import EndpointsController
+from .gc import PodGCController
+from .job import JobController
+from .namespace import NamespaceController
+from .node import NodeController
+from .persistentvolume import PersistentVolumeClaimBinder
+from .podautoscaler import HorizontalController
+from .replication import ReplicationManager
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountsController, TokensController
+
+
+class ControllerManager:
+    def __init__(self, client, metrics_source=None, recorder=None,
+                 pod_gc_threshold: int = 12500):
+        self.controllers: List = [
+            EndpointsController(client),
+            ReplicationManager(client, recorder=recorder),
+            NodeController(client),
+            PodGCController(client, threshold=pod_gc_threshold),
+            NamespaceController(client),
+            ResourceQuotaController(client),
+            JobController(client, recorder=recorder),
+            DaemonSetController(client),
+            DeploymentController(client),
+            PersistentVolumeClaimBinder(client),
+            ServiceAccountsController(client),
+            TokensController(client),
+        ]
+        if metrics_source is not None:
+            self.controllers.append(
+                HorizontalController(client, metrics_source))
+
+    def run(self) -> "ControllerManager":
+        for c in self.controllers:
+            c.run()
+        return self
+
+    def stop(self) -> None:
+        for c in reversed(self.controllers):
+            try:
+                c.stop()
+            except Exception:
+                pass

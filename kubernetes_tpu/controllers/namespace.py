@@ -20,8 +20,12 @@ from .framework import QueueWorkers
 # content is removed in the reference's fixed order (deleteAllContent);
 # bindings is virtual (no storage), events go last like the reference
 _CONTENT_RESOURCES = [
-    "serviceaccounts", "services", "replicationcontrollers", "pods",
-    "secrets", "limitranges", "resourcequotas", "endpoints", "events",
+    # workload owners before their products (deployments create RCs,
+    # jobs/daemonsets/RCs create pods), then the rest, events last
+    "deployments", "horizontalpodautoscalers", "jobs", "daemonsets",
+    "replicationcontrollers", "pods", "serviceaccounts", "services",
+    "ingresses", "persistentvolumeclaims", "secrets", "limitranges",
+    "resourcequotas", "endpoints", "events",
 ]
 
 

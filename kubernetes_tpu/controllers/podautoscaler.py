@@ -1,0 +1,113 @@
+"""Horizontal pod autoscaler.
+
+Reference: pkg/controller/podautoscaler/horizontal.go — every sync period
+(default 30s) compute desired replicas from observed CPU utilization vs
+the target: desired = ceil(current * actual/target), with a 10% tolerance
+band, clamped to [min, max]; scale the referenced RC. The reference reads
+utilization from heapster; here the metrics source is injectable
+(fn(namespace, selector_labels) -> average utilization percent or None),
+with the same semantics: no metrics -> no scaling.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import replace
+from typing import Callable, Dict, Optional
+
+from ..core import types as api
+from ..core.errors import ApiError, NotFound
+
+SYNC_PERIOD = 30.0        # horizontal.go default --horizontal-pod-autoscaler-sync-period
+TOLERANCE = 0.1           # horizontal.go tolerance
+
+MetricsSource = Callable[[str, Dict[str, str]], Optional[float]]
+
+
+class HorizontalController:
+    def __init__(self, client, metrics: MetricsSource,
+                 sync_period: float = SYNC_PERIOD):
+        self.client = client
+        self.metrics = metrics
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def reconcile_once(self) -> int:
+        """Sync every HPA; returns how many scaled."""
+        try:
+            hpas, _ = self.client.list("horizontalpodautoscalers")
+        except Exception:
+            return 0
+        scaled = 0
+        for hpa in hpas:
+            try:
+                if self._reconcile(hpa):
+                    scaled += 1
+            except Exception:
+                # one broken HPA (bad scaleRef, metrics source raising,
+                # transport error) must not kill the reconcile thread
+                continue
+        return scaled
+
+    def _reconcile(self, hpa: api.HorizontalPodAutoscaler) -> bool:
+        ref = hpa.spec.scale_ref
+        ns = ref.namespace or hpa.metadata.namespace
+        if ref.kind != "ReplicationController":
+            return False
+        rc = self.client.get("replicationcontrollers", ref.name, ns)
+        current = rc.spec.replicas
+        target = hpa.spec.cpu_utilization_target_percentage
+        utilization = None
+        desired = current
+        if target and current > 0:
+            utilization = self.metrics(ns, rc.spec.selector)
+            if utilization is not None:
+                ratio = utilization / target
+                # inside the tolerance band nothing moves (horizontal.go)
+                if abs(ratio - 1.0) > TOLERANCE:
+                    desired = int(math.ceil(current * ratio))
+        desired = max(hpa.spec.min_replicas,
+                      min(hpa.spec.max_replicas, desired))
+        did_scale = desired != current
+        if did_scale:
+            fresh = self.client.get("replicationcontrollers", ref.name, ns)
+            self.client.update(
+                "replicationcontrollers",
+                replace(fresh, spec=replace(fresh.spec, replicas=desired)),
+                ns)
+        self._update_status(hpa, current, desired, utilization, did_scale)
+        return did_scale
+
+    def _update_status(self, hpa, current, desired, utilization,
+                       did_scale) -> None:
+        status = api.HorizontalPodAutoscalerStatus(
+            observed_generation=hpa.metadata.generation,
+            last_scale_time=(api.now_rfc3339() if did_scale
+                             else hpa.status.last_scale_time),
+            current_replicas=current, desired_replicas=desired,
+            current_cpu_utilization_percentage=(
+                int(utilization) if utilization is not None else None))
+        try:
+            self.client.update_status(
+                "horizontalpodautoscalers", replace(hpa, status=status),
+                hpa.metadata.namespace)
+        except (ApiError, NotFound):
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.reconcile_once()
+            self._stop.wait(self.sync_period)
+
+    def run(self) -> "HorizontalController":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="horizontal-pod-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -102,6 +102,12 @@ def new_scheme() -> Scheme:
     s.register("ServiceAccount", api.ServiceAccount)
     s.register("PersistentVolume", api.PersistentVolume)
     s.register("PersistentVolumeClaim", api.PersistentVolumeClaim)
+    # extensions/v1beta1 group (master.go:1049-1091)
+    s.register("Job", api.Job)
+    s.register("Deployment", api.Deployment)
+    s.register("DaemonSet", api.DaemonSet)
+    s.register("HorizontalPodAutoscaler", api.HorizontalPodAutoscaler)
+    s.register("Ingress", api.Ingress)
     return s
 
 

@@ -535,6 +535,178 @@ class ServiceAccount:
     secrets: List[ObjectReference] = field(default_factory=list)
 
 
+# ------------------------------------------------- extensions/v1beta1 group
+# (ref: pkg/apis/extensions/types.go; mounted by pkg/master/master.go
+#  :1049-1091 — HPA, jobs, deployments, daemonsets, ingress)
+
+DEPLOYMENT_POD_TEMPLATE_HASH_KEY = "deployment.kubernetes.io/podTemplateHash"
+
+
+@dataclass
+class JobSpec:
+    parallelism: Optional[int] = None   # nil -> defaulted to 1
+    completions: Optional[int] = None   # nil -> any single success completes
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class JobCondition:
+    type: str = ""        # "Complete"
+    status: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class JobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+@dataclass
+class RollingUpdateDeployment:
+    max_unavailable: int = 1
+    max_surge: int = 1
+
+
+@dataclass
+class DeploymentStrategy:
+    type: str = "RollingUpdate"   # or "Recreate"
+    rolling_update: RollingUpdateDeployment = field(
+        default_factory=RollingUpdateDeployment)
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: DeploymentStrategy = field(default_factory=DeploymentStrategy)
+    unique_label_key: str = DEPLOYMENT_POD_TEMPLATE_HASH_KEY
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+@dataclass
+class DaemonSetSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class DaemonSetStatus:
+    current_number_scheduled: int = 0
+    number_misscheduled: int = 0
+    desired_number_scheduled: int = 0
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DaemonSetSpec = field(default_factory=DaemonSetSpec)
+    status: DaemonSetStatus = field(default_factory=DaemonSetStatus)
+
+
+@dataclass
+class SubresourceReference:
+    kind: str = ""
+    name: str = ""
+    namespace: str = ""
+    subresource: str = ""
+
+
+@dataclass
+class HorizontalPodAutoscalerSpec:
+    scale_ref: SubresourceReference = field(
+        default_factory=SubresourceReference)
+    min_replicas: int = 1
+    max_replicas: int = 1
+    cpu_utilization_target_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscalerStatus:
+    observed_generation: int = 0
+    last_scale_time: Optional[str] = None
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_percentage: Optional[int] = None
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: HorizontalPodAutoscalerSpec = field(
+        default_factory=HorizontalPodAutoscalerSpec)
+    status: HorizontalPodAutoscalerStatus = field(
+        default_factory=HorizontalPodAutoscalerStatus)
+
+
+@dataclass
+class IngressBackend:
+    service_name: str = ""
+    service_port: Any = None
+
+
+@dataclass
+class HTTPIngressPath:
+    path: str = ""
+    backend: IngressBackend = field(default_factory=IngressBackend)
+
+
+@dataclass
+class HTTPIngressRuleValue:
+    paths: List[HTTPIngressPath] = field(default_factory=list)
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    http: Optional[HTTPIngressRuleValue] = None
+
+
+@dataclass
+class IngressSpec:
+    backend: Optional[IngressBackend] = None
+    rules: List[IngressRule] = field(default_factory=list)
+
+
+@dataclass
+class IngressStatus:
+    load_balancer_ingress: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Ingress:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: IngressSpec = field(default_factory=IngressSpec)
+    status: IngressStatus = field(default_factory=IngressStatus)
+
+
 # ------------------------------------------------------ persistent volumes
 
 VOLUME_AVAILABLE = "Available"

@@ -1,0 +1,330 @@
+"""Job / DaemonSet / Deployment / HPA / ServiceAccount controllers against
+the in-proc registry (the reference's controller-manager loop inventory,
+controllermanager.go:284-443)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.controllers import (DaemonSetController,
+                                        DeploymentController,
+                                        HorizontalController, JobController,
+                                        ReplicationManager,
+                                        ServiceAccountsController,
+                                        TokensController)
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+
+
+def wait_until(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def template(labels):
+    return api.PodTemplateSpec(
+        metadata=api.ObjectMeta(labels=dict(labels)),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="img")]))
+
+
+def ready_node(name, unschedulable=False, ready=True):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.NodeSpec(unschedulable=unschedulable),
+        status=api.NodeStatus(
+            capacity={"cpu": parse_quantity("4"),
+                      "memory": parse_quantity("8Gi"),
+                      "pods": parse_quantity("110")},
+            conditions=[api.NodeCondition(
+                type="Ready", status="True" if ready else "False")]))
+
+
+@pytest.fixture()
+def cluster():
+    registry = Registry()
+    client = InProcClient(registry)
+    return registry, client
+
+
+def pods_of(client, ns="default", label=None):
+    pods, _ = client.list("pods", ns)
+    if label:
+        pods = [p for p in pods if p.metadata.labels.get(label[0]) == label[1]]
+    return pods
+
+
+class TestJobController:
+    def test_runs_to_completion(self, cluster):
+        registry, client = cluster
+        ctrl = JobController(client).run()
+        try:
+            job = api.Job(
+                metadata=api.ObjectMeta(name="work", namespace="default"),
+                spec=api.JobSpec(parallelism=2, completions=3,
+                                 selector={"job": "work"},
+                                 template=template({"job": "work"})))
+            client.create("jobs", job, "default")
+            assert wait_until(lambda: len(pods_of(client)) >= 2)
+            # at most `parallelism` active at once
+            assert len([p for p in pods_of(client)
+                        if p.status.phase != "Succeeded"]) <= 2
+
+            # complete pods one by one; controller backfills then finishes
+            from dataclasses import replace
+            for _ in range(3):
+                assert wait_until(lambda: any(
+                    p.status.phase == "Pending" for p in pods_of(client)))
+                victim = next(p for p in pods_of(client)
+                              if p.status.phase == "Pending")
+                client.update_status("pods", replace(
+                    victim, status=api.PodStatus(phase="Succeeded")),
+                    "default")
+            assert wait_until(lambda: client.get(
+                "jobs", "work", "default").status.succeeded == 3)
+            done = client.get("jobs", "work", "default")
+            assert any(c.type == "Complete" and c.status == "True"
+                       for c in done.status.conditions)
+            assert done.status.completion_time
+        finally:
+            ctrl.stop()
+
+
+class TestDaemonSetController:
+    def test_one_pod_per_eligible_node(self, cluster):
+        registry, client = cluster
+        for i in range(3):
+            client.create("nodes", ready_node(f"n{i}"))
+        client.create("nodes", ready_node("cordoned", unschedulable=True))
+        client.create("nodes", ready_node("notready", ready=False))
+        ctrl = DaemonSetController(client).run()
+        try:
+            ds = api.DaemonSet(
+                metadata=api.ObjectMeta(name="agent", namespace="default"),
+                spec=api.DaemonSetSpec(selector={"ds": "agent"},
+                                       template=template({"ds": "agent"})))
+            client.create("daemonsets", ds, "default")
+            assert wait_until(lambda: len(pods_of(client)) == 3)
+            hosts = {p.spec.node_name for p in pods_of(client)}
+            assert hosts == {"n0", "n1", "n2"}
+            # a new node gets a daemon pod
+            client.create("nodes", ready_node("n3"))
+            assert wait_until(lambda: len(pods_of(client)) == 4)
+            status = client.get("daemonsets", "agent", "default").status
+            assert status.desired_number_scheduled == 4
+        finally:
+            ctrl.stop()
+
+
+class TestDeploymentController:
+    def test_rollout_creates_hashed_rc_and_scales(self, cluster):
+        registry, client = cluster
+        ctrl = DeploymentController(client).run()
+        try:
+            d = api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(replicas=3,
+                                        selector={"app": "web"},
+                                        template=template({"app": "web"})))
+            client.create("deployments", d, "default")
+
+            def new_rc():
+                rcs, _ = client.list("replicationcontrollers", "default")
+                return rcs[0] if rcs else None
+            assert wait_until(lambda: new_rc() is not None
+                              and new_rc().spec.replicas == 3)
+            rc = new_rc()
+            assert api.DEPLOYMENT_POD_TEMPLATE_HASH_KEY in rc.spec.selector
+        finally:
+            ctrl.stop()
+
+    def test_scale_down(self, cluster):
+        registry, client = cluster
+        ctrl = DeploymentController(client).run()
+        try:
+            d = api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(replicas=5,
+                                        selector={"app": "web"},
+                                        template=template({"app": "web"})))
+            client.create("deployments", d, "default")
+
+            def rc_replicas():
+                rcs, _ = client.list("replicationcontrollers", "default")
+                return rcs[0].spec.replicas if rcs else None
+            assert wait_until(lambda: rc_replicas() == 5)
+            from dataclasses import replace
+            fresh = client.get("deployments", "web", "default")
+            client.update("deployments", replace(
+                fresh, spec=replace(fresh.spec, replicas=3)), "default")
+            assert wait_until(lambda: rc_replicas() == 3)
+        finally:
+            ctrl.stop()
+
+    def test_namespace_cascade_covers_extensions(self, cluster):
+        registry, client = cluster
+        from kubernetes_tpu.controllers import NamespaceController
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="doomed")))
+        d = api.Deployment(
+            metadata=api.ObjectMeta(name="web", namespace="doomed"),
+            spec=api.DeploymentSpec(replicas=1, selector={"app": "web"},
+                                    template=template({"app": "web"})))
+        client.create("deployments", d, "doomed")
+        nsc = NamespaceController(client).run()
+        try:
+            client.delete("namespaces", "doomed")
+            assert wait_until(lambda: not _exists(
+                client, "deployments", "web", "doomed"))
+            assert wait_until(lambda: not _exists(
+                client, "namespaces", "doomed", ""))
+        finally:
+            nsc.stop()
+
+    def test_rolling_update_replaces_old_rc(self, cluster):
+        registry, client = cluster
+        rc_manager = ReplicationManager(client).run()
+        ctrl = DeploymentController(client).run()
+        try:
+            d = api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(replicas=2,
+                                        selector={"app": "web"},
+                                        template=template({"app": "web"})))
+            client.create("deployments", d, "default")
+            assert wait_until(
+                lambda: len(pods_of(client, label=("app", "web"))) == 2)
+
+            # mutate the template -> new hash -> rollout
+            from dataclasses import replace
+            fresh = client.get("deployments", "web", "default")
+            new_tpl = template({"app": "web"})
+            new_tpl.spec.containers[0].image = "img:v2"
+            client.update("deployments", replace(
+                fresh, spec=replace(fresh.spec, template=new_tpl)),
+                "default")
+
+            def rolled():
+                rcs, _ = client.list("replicationcontrollers", "default")
+                live = [rc for rc in rcs if rc.spec.replicas > 0]
+                if len(live) != 1:
+                    return False
+                tpl = live[0].spec.template
+                return (tpl.spec.containers[0].image == "img:v2"
+                        and live[0].status.replicas == 2)
+            assert wait_until(rolled, timeout=30)
+        finally:
+            ctrl.stop()
+            rc_manager.stop()
+
+
+class TestHorizontalController:
+    def test_scales_rc_by_utilization(self, cluster):
+        registry, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=2, selector={"app": "web"},
+                template=template({"app": "web"}))), "default")
+        utilization = {"value": 180.0}
+        hpa = api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="web-hpa", namespace="default"),
+            spec=api.HorizontalPodAutoscalerSpec(
+                scale_ref=api.SubresourceReference(
+                    kind="ReplicationController", name="web",
+                    namespace="default"),
+                min_replicas=1, max_replicas=5,
+                cpu_utilization_target_percentage=90))
+        client.create("horizontalpodautoscalers", hpa, "default")
+        ctrl = HorizontalController(client,
+                                    lambda ns, sel: utilization["value"])
+        assert ctrl.reconcile_once() == 1
+        rc = client.get("replicationcontrollers", "web", "default")
+        assert rc.spec.replicas == 4  # ceil(2 * 180/90)
+        # inside the tolerance band nothing moves
+        utilization["value"] = 92.0
+        assert ctrl.reconcile_once() == 0
+        # clamped to max
+        utilization["value"] = 900.0
+        ctrl.reconcile_once()
+        assert client.get("replicationcontrollers", "web",
+                          "default").spec.replicas == 5
+        status = client.get("horizontalpodautoscalers", "web-hpa",
+                            "default").status
+        assert status.desired_replicas == 5
+        assert status.last_scale_time
+
+
+class TestServiceAccountControllers:
+    def test_default_sa_and_token(self, cluster):
+        registry, client = cluster
+        sa_ctrl = ServiceAccountsController(client).run()
+        tok_ctrl = TokensController(client).run()
+        try:
+            client.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="team-a")))
+            assert wait_until(lambda: _exists(
+                client, "serviceaccounts", "default", "team-a"))
+            assert wait_until(lambda: _exists(
+                client, "secrets", "default-token", "team-a"))
+            assert wait_until(lambda: any(
+                ref.name == "default-token"
+                for ref in client.get("serviceaccounts", "default",
+                                      "team-a").secrets))
+            secret = client.get("secrets", "default-token", "team-a")
+            assert secret.type == "kubernetes.io/service-account-token"
+            assert secret.data["token"]
+            # deleted default SA comes back
+            client.delete("serviceaccounts", "default", "team-a")
+            assert wait_until(lambda: _exists(
+                client, "serviceaccounts", "default", "team-a"))
+        finally:
+            tok_ctrl.stop()
+            sa_ctrl.stop()
+
+
+def _exists(client, resource, name, ns):
+    try:
+        client.get(resource, name, ns)
+        return True
+    except Exception:
+        return False
+
+
+def test_extensions_group_served_over_http():
+    import json
+    import urllib.request
+    from kubernetes_tpu.api.server import ApiServer
+    registry = Registry()
+    server = ApiServer(registry).start()
+    try:
+        with urllib.request.urlopen(server.url + "/apis") as resp:
+            groups = json.loads(resp.read())
+        assert groups["groups"][0]["name"] == "extensions"
+        body = json.dumps({
+            "kind": "Job", "apiVersion": "extensions/v1beta1",
+            "metadata": {"name": "j", "namespace": "default"},
+            "spec": {"completions": 1, "selector": {"job": "j"},
+                     "template": {
+                         "metadata": {"labels": {"job": "j"}},
+                         "spec": {"containers": [
+                             {"name": "c", "image": "img"}]}}}}).encode()
+        req = urllib.request.Request(
+            server.url + "/apis/extensions/v1beta1/namespaces/default/jobs",
+            data=body, headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req) as resp:
+            created = json.loads(resp.read())
+        assert created["metadata"]["name"] == "j"
+        with urllib.request.urlopen(
+                server.url +
+                "/apis/extensions/v1beta1/namespaces/default/jobs") as resp:
+            listed = json.loads(resp.read())
+        assert len(listed["items"]) == 1
+    finally:
+        server.stop()

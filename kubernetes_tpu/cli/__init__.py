@@ -1,0 +1,12 @@
+"""kubectl-style CLI.
+
+Reference: pkg/kubectl + cmd/kubectl (NewKubectlCommand
+pkg/kubectl/cmd/cmd.go:134). Commands: get, describe, create, apply,
+delete, scale, label, annotate, logs, expose, rolling-update, autoscale,
+run, version, api-versions, cluster-info — over the HTTP client, with
+the reference's printer column layouts and resource-name aliases.
+"""
+
+from .cmd import main, build_parser
+
+__all__ = ["main", "build_parser"]

@@ -1,0 +1,529 @@
+"""The kubectl command tree.
+
+Reference: pkg/kubectl/cmd/cmd.go:134 NewKubectlCommand and the
+subcommand files under pkg/kubectl/cmd/ (get.go, create.go, delete.go,
+describe.go, scale.go, label.go, annotate.go, expose.go, run.go,
+rollingupdate.go, autoscale.go, logs.go, clusterinfo.go, version.go).
+argparse plays cobra's role; `--server` plays kubeconfig.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from dataclasses import replace
+from typing import List, Optional
+
+from ..api.client import HttpClient
+from ..core import types as api
+from ..core.errors import ApiError, NotFound
+from ..core.scheme import default_scheme
+from .describe import describe
+from .printers import print_objects
+from .resource import (load_manifest, parse_resource_args,
+                       resource_for_object)
+
+VERSION = "v1.1.0-tpu"  # capability parity line (pkg/version/base.go)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubectl",
+        description="controls the kubernetes_tpu cluster manager")
+    p.add_argument("-s", "--server", default="http://127.0.0.1:8080")
+    p.add_argument("--token", default="", help="bearer token")
+    p.add_argument("-n", "--namespace", default="default")
+    sub = p.add_subparsers(dest="command")
+
+    g = sub.add_parser("get", help="display one or many resources")
+    g.add_argument("args", nargs="+")
+    g.add_argument("-o", "--output", default="")
+    g.add_argument("-l", "--selector", default="")
+    g.add_argument("--field-selector", dest="field_selector", default="")
+    g.add_argument("--all-namespaces", action="store_true")
+    g.add_argument("-w", "--watch", action="store_true")
+
+    d = sub.add_parser("describe", help="show details of a resource")
+    d.add_argument("args", nargs="+")
+
+    c = sub.add_parser("create", help="create resources from a file")
+    c.add_argument("-f", "--filename", required=True)
+
+    a = sub.add_parser("apply", help="create or update from a file")
+    a.add_argument("-f", "--filename", required=True)
+
+    rm = sub.add_parser("delete", help="delete resources")
+    rm.add_argument("args", nargs="*", default=[])
+    rm.add_argument("-f", "--filename", default="")
+    rm.add_argument("-l", "--selector", default="")
+    rm.add_argument("--all", action="store_true")
+
+    sc = sub.add_parser("scale", help="set a new size for a controller")
+    sc.add_argument("args", nargs="+")
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.add_argument("--current-replicas", type=int, default=None)
+
+    lb = sub.add_parser("label", help="update labels on a resource")
+    lb.add_argument("args", nargs="+")
+    lb.add_argument("--overwrite", action="store_true")
+
+    an = sub.add_parser("annotate", help="update annotations on a resource")
+    an.add_argument("args", nargs="+")
+    an.add_argument("--overwrite", action="store_true")
+
+    ex = sub.add_parser("expose", help="expose a controller as a service")
+    ex.add_argument("args", nargs="+")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int, default=None)
+    ex.add_argument("--name", default="")
+    ex.add_argument("--type", default="ClusterIP")
+
+    rn = sub.add_parser("run", help="run an image as an RC")
+    rn.add_argument("name")
+    rn.add_argument("--image", required=True)
+    rn.add_argument("-r", "--replicas", type=int, default=1)
+    rn.add_argument("-l", "--labels", default="")
+
+    ru = sub.add_parser("rolling-update",
+                        help="gradually replace an RC's pods")
+    ru.add_argument("old_name")
+    ru.add_argument("new_name")
+    ru.add_argument("--image", default="")
+    ru.add_argument("-f", "--filename", default="")
+    ru.add_argument("--update-period", type=float, default=0.0)
+
+    au = sub.add_parser("autoscale", help="create an HPA for a controller")
+    au.add_argument("args", nargs="+")
+    au.add_argument("--min", type=int, default=1)
+    au.add_argument("--max", type=int, required=True)
+    au.add_argument("--cpu-percent", type=int, default=80)
+
+    lg = sub.add_parser("logs", help="print container logs")
+    lg.add_argument("pod")
+    lg.add_argument("container", nargs="?", default="")
+
+    sub.add_parser("version", help="print version")
+    sub.add_parser("api-versions", help="print supported API versions")
+    sub.add_parser("cluster-info", help="display cluster info")
+    return p
+
+
+def _split_kv(items: List[str], what: str):
+    updates = {}
+    removals = []
+    for item in items:
+        if item.endswith("-") and "=" not in item:
+            removals.append(item[:-1])
+            continue
+        if "=" not in item:
+            raise ApiError(f"invalid {what} {item!r} (want key=value)")
+        k, _, v = item.partition("=")
+        updates[k] = v
+    return updates, removals
+
+
+def _find_kv_split(args: List[str]):
+    """TYPE NAME KEY=VAL... -> ((resource, name), kv-args). A trailing
+    dash marks a removal; DNS names can't end with '-', so it's
+    unambiguous in any position after the first arg."""
+    kv_start = next((i for i, a in enumerate(args)
+                     if (("=" in a or a.endswith("-")) and i >= 1)),
+                    len(args))
+    targets = parse_resource_args(args[:kv_start])
+    return targets, args[kv_start:]
+
+
+class Kubectl:
+    def __init__(self, client, out=None, err=None,
+                 scheme=default_scheme):
+        self.client = client
+        self.scheme = scheme
+        self.out = out or sys.stdout
+        self.err = err or sys.stderr
+
+    # ------------------------------------------------------------- verbs
+
+    def get(self, ns, args, output="", selector="", field_selector="",
+            all_namespaces=False, watch=False) -> None:
+        targets = parse_resource_args(args)
+        objs = []
+        names: List[str] = []
+        list_rev = None
+        for resource, name in targets:
+            list_ns = "" if all_namespaces else ns
+            if name is None:
+                items, list_rev = self.client.list(
+                    resource, list_ns, selector, field_selector)
+                objs.extend(items)
+                names.extend([resource] * len(items))
+            else:
+                objs.append(self.client.get(resource, name, list_ns))
+                names.append(resource)
+        print_objects(objs, output, self.scheme, self.out,
+                      resource_names=names, with_namespace=all_namespaces)
+        if watch and len(targets) == 1 and targets[0][1] is None:
+            # resume from the list's revision: nothing created between
+            # list and watch is lost (the reflector's listwatch contract)
+            w = self.client.watch(targets[0][0],
+                                  "" if all_namespaces else ns,
+                                  since_rev=list_rev)
+            try:
+                while True:
+                    ev = w.next(timeout=1.0)
+                    if ev is None:
+                        if w.stopped:
+                            break
+                        continue
+                    print_objects([ev.object], output, self.scheme, self.out)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                w.stop()
+
+    def describe(self, ns, args) -> None:
+        for resource, name in parse_resource_args(args):
+            if name is None:
+                items, _ = self.client.list(resource, ns)
+                names = [o.metadata.name for o in items]
+            else:
+                names = [name]
+            for n in names:
+                self.out.write(describe(self.client, self.scheme, resource,
+                                        n, ns) + "\n\n")
+
+    def create(self, ns, filename) -> None:
+        for obj in load_manifest(filename, self.scheme):
+            resource = resource_for_object(obj, self.scheme)
+            created = self.client.create(resource, obj,
+                                         obj.metadata.namespace or ns)
+            self.out.write(f"{resource}/{created.metadata.name} created\n")
+
+    def apply(self, ns, filename) -> None:
+        """create-or-update (the v1.1 kubectl apply precursor: replace
+        keeping resourceVersion)."""
+        for obj in load_manifest(filename, self.scheme):
+            resource = resource_for_object(obj, self.scheme)
+            target_ns = obj.metadata.namespace or ns
+            try:
+                self.client.get(resource, obj.metadata.name, target_ns)
+            except NotFound:
+                created = self.client.create(resource, obj, target_ns)
+                self.out.write(
+                    f"{resource}/{created.metadata.name} created\n")
+            else:
+                updated = self.client.update(resource, obj, target_ns)
+                self.out.write(
+                    f"{resource}/{updated.metadata.name} configured\n")
+
+    def delete(self, ns, args, filename="", selector="",
+               delete_all=False) -> None:
+        if filename:
+            for obj in load_manifest(filename, self.scheme):
+                resource = resource_for_object(obj, self.scheme)
+                self.client.delete(resource, obj.metadata.name,
+                                   obj.metadata.namespace or ns)
+                self.out.write(f"{resource}/{obj.metadata.name} deleted\n")
+            return
+        for resource, name in parse_resource_args(args):
+            if name is not None:
+                self.client.delete(resource, name, ns)
+                self.out.write(f"{resource}/{name} deleted\n")
+            elif selector or delete_all:
+                items, _ = self.client.list(resource, ns, selector)
+                for obj in items:
+                    self.client.delete(resource, obj.metadata.name, ns)
+                    self.out.write(
+                        f"{resource}/{obj.metadata.name} deleted\n")
+            else:
+                raise ApiError(
+                    "resource name, --selector, or --all is required")
+
+    def scale(self, ns, args, replicas, current_replicas=None) -> None:
+        """(ref: pkg/kubectl/scale.go ScalerFor — RCs, jobs,
+        deployments)"""
+        for resource, name in parse_resource_args(args):
+            obj = self.client.get(resource, name, ns)
+            if resource == "jobs":
+                field = "parallelism"
+                current = obj.spec.parallelism
+            else:
+                field = "replicas"
+                current = obj.spec.replicas
+            if current_replicas is not None and current != current_replicas:
+                raise ApiError(
+                    f"precondition failed: current {current}, "
+                    f"expected {current_replicas}")
+            updated = replace(obj, spec=replace(obj.spec,
+                                                **{field: replicas}))
+            self.client.update(resource, updated, ns)
+            self.out.write(f"{resource}/{name} scaled\n")
+
+    def label(self, ns, args, overwrite=False) -> None:
+        self._metadata_edit(ns, args, "labels", overwrite)
+
+    def annotate(self, ns, args, overwrite=False) -> None:
+        self._metadata_edit(ns, args, "annotations", overwrite)
+
+    def _metadata_edit(self, ns, args, field, overwrite) -> None:
+        targets, kv_args = _find_kv_split(args)
+        updates, removals = _split_kv(kv_args, field[:-1])
+        for resource, name in targets:
+            obj = self.client.get(resource, name, ns)
+            current = dict(getattr(obj.metadata, field))
+            for k in updates:
+                if k in current and not overwrite:
+                    raise ApiError(
+                        f"'{k}' already has a value; use --overwrite")
+            current.update(updates)
+            for k in removals:
+                current.pop(k, None)
+            updated = replace(obj, metadata=replace(obj.metadata,
+                                                    **{field: current}))
+            self.client.update(resource, updated, ns)
+            self.out.write(f"{resource}/{name} {field[:-1]}ed\n")
+
+    def expose(self, ns, args, port, target_port=None, name="",
+               svc_type="ClusterIP") -> None:
+        """(ref: pkg/kubectl/cmd/expose.go — selector from the exposed
+        controller/service)"""
+        ((resource, target),) = parse_resource_args(args)
+        obj = self.client.get(resource, target, ns)
+        if resource in ("replicationcontrollers", "services"):
+            selector = dict(obj.spec.selector)
+        elif resource == "pods":
+            selector = dict(obj.metadata.labels)
+        else:
+            raise ApiError(f"cannot expose {resource}")
+        svc = api.Service(
+            metadata=api.ObjectMeta(name=name or target, namespace=ns),
+            spec=api.ServiceSpec(
+                selector=selector, type=svc_type,
+                ports=[api.ServicePort(
+                    name="default", port=port,
+                    target_port=target_port or port)]))
+        created = self.client.create("services", svc, ns)
+        self.out.write(f"services/{created.metadata.name} exposed "
+                       f"(ip {created.spec.cluster_ip})\n")
+
+    def run(self, ns, name, image, replicas=1, labels="") -> None:
+        """(ref: pkg/kubectl/cmd/run.go — image as an RC)"""
+        if labels:
+            label_map, removals = _split_kv(labels.split(","), "label")
+            if removals or not label_map:
+                raise ApiError(f"invalid --labels {labels!r}")
+        else:
+            label_map = {"run": name}
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name=name, namespace=ns,
+                                    labels=dict(label_map)),
+            spec=api.ReplicationControllerSpec(
+                replicas=replicas, selector=dict(label_map),
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels=dict(label_map)),
+                    spec=api.PodSpec(containers=[
+                        api.Container(name=name, image=image)]))))
+        self.client.create("replicationcontrollers", rc, ns)
+        self.out.write(f"replicationcontrollers/{name} created\n")
+
+    def rolling_update(self, ns, old_name, new_name, image="",
+                       filename="", update_period=0.0) -> None:
+        """(ref: pkg/kubectl/rolling_updater.go — scale new up one, old
+        down one, until old is drained, then delete old)"""
+        old = self.client.get("replicationcontrollers", old_name, ns)
+        if filename:
+            (new,) = load_manifest(filename, self.scheme)
+        elif image:
+            tpl = old.spec.template
+            containers = [replace(c, image=image)
+                          for c in tpl.spec.containers]
+            selector = dict(old.spec.selector)
+            selector["deployment"] = new_name
+            labels = dict(tpl.metadata.labels)
+            labels["deployment"] = new_name
+            new = api.ReplicationController(
+                metadata=api.ObjectMeta(name=new_name, namespace=ns,
+                                        labels=dict(labels)),
+                spec=api.ReplicationControllerSpec(
+                    replicas=0, selector=selector,
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels=labels),
+                        spec=replace(tpl.spec, containers=containers))))
+        else:
+            raise ApiError("--image or -f is required")
+        # disjoint the old RC's selector FIRST, or it adopts the new RC's
+        # pods and the scale-down deletes them (ref: rolling_updater.go
+        # AddDeploymentKeyToReplicationController: label existing pods,
+        # then narrow the old selector)
+        old = self._add_deployment_key(old, old_name, ns)
+        desired = old.spec.replicas
+        try:
+            new = self.client.create("replicationcontrollers", new, ns)
+        except ApiError:
+            new = self.client.get("replicationcontrollers",
+                                  new.metadata.name, ns)
+        while new.spec.replicas < desired or old.spec.replicas > 0:
+            if new.spec.replicas < desired:
+                new = self.client.update(
+                    "replicationcontrollers",
+                    replace(new, spec=replace(
+                        new.spec, replicas=new.spec.replicas + 1)), ns)
+                self.out.write(
+                    f"Scaling {new.metadata.name} up to "
+                    f"{new.spec.replicas}\n")
+            if old.spec.replicas > 0:
+                old = self.client.update(
+                    "replicationcontrollers",
+                    replace(old, spec=replace(
+                        old.spec, replicas=old.spec.replicas - 1)), ns)
+                self.out.write(
+                    f"Scaling {old.metadata.name} down to "
+                    f"{old.spec.replicas}\n")
+            if update_period:
+                time.sleep(update_period)
+        # delete the old RC only once its scale-down has been OBSERVED
+        # (status.replicas from the RC manager) — deleting earlier orphans
+        # the pods it hadn't removed yet (rolling_updater.go waits on each
+        # resize before the final cleanup)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            fresh = self.client.get("replicationcontrollers", old_name, ns)
+            if fresh.status.replicas == 0:
+                break
+            time.sleep(0.1)
+        self.client.delete("replicationcontrollers", old_name, ns)
+        self.out.write(
+            f"Update succeeded. Deleting {old_name}\n")
+
+    def _add_deployment_key(self, rc, value, ns):
+        """Label the RC's pods with deployment=<value>, then narrow the
+        RC's selector+template to include it — making it disjoint from
+        the new RC's pods (rolling_updater.go
+        AddDeploymentKeyToReplicationController)."""
+        if rc.spec.selector.get("deployment") == value:
+            return rc
+        from ..core.labels import selector_from_set
+        sel = selector_from_set(rc.spec.selector)
+        for pod in self.client.list("pods", ns)[0]:
+            if not sel.matches(pod.metadata.labels):
+                continue
+            labels = dict(pod.metadata.labels)
+            labels["deployment"] = value
+            try:
+                self.client.update("pods", replace(
+                    pod, metadata=replace(pod.metadata, labels=labels)), ns)
+            except ApiError:
+                pass  # pod vanished mid-update: fine
+        selector = dict(rc.spec.selector)
+        selector["deployment"] = value
+        tpl = rc.spec.template
+        tpl_labels = dict(tpl.metadata.labels)
+        tpl_labels["deployment"] = value
+        updated = replace(rc, spec=replace(
+            rc.spec, selector=selector,
+            template=api.PodTemplateSpec(
+                metadata=replace(tpl.metadata, labels=tpl_labels),
+                spec=tpl.spec)))
+        return self.client.update("replicationcontrollers", updated, ns)
+
+    def autoscale(self, ns, args, min_replicas, max_replicas,
+                  cpu_percent) -> None:
+        ((resource, name),) = parse_resource_args(args)
+        kind = {"replicationcontrollers": "ReplicationController",
+                "deployments": "Deployment"}.get(resource)
+        if kind is None:
+            raise ApiError(f"cannot autoscale {resource}")
+        hpa = api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            spec=api.HorizontalPodAutoscalerSpec(
+                scale_ref=api.SubresourceReference(
+                    kind=kind, name=name, namespace=ns),
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                cpu_utilization_target_percentage=cpu_percent))
+        self.client.create("horizontalpodautoscalers", hpa, ns)
+        self.out.write(f"horizontalpodautoscalers/{name} autoscaled\n")
+
+    def logs(self, ns, pod_name, container="") -> None:
+        """Hollow runtimes have no log stream; report container state
+        (the kubelet log endpoint is the real source, server.go:242)."""
+        pod = self.client.get("pods", pod_name, ns)
+        for cs in pod.status.container_statuses:
+            if container and cs.name != container:
+                continue
+            state = ("running" if cs.state.running
+                     else "terminated" if cs.state.terminated else "waiting")
+            self.out.write(f"[{cs.name}] state={state} "
+                           f"restarts={cs.restart_count}\n")
+
+    def version(self) -> None:
+        self.out.write(f"Client Version: {VERSION}\n")
+
+    def api_versions(self) -> None:
+        self.out.write("v1\nextensions/v1beta1\n")
+
+    def cluster_info(self, server_url) -> None:
+        self.out.write(f"Kubernetes master is running at {server_url}\n")
+
+
+def main(argv: Optional[List[str]] = None, client=None, out=None,
+         err=None) -> int:
+    parser = build_parser()
+    ns_args = parser.parse_args(argv)
+    if ns_args.command is None:
+        parser.print_help()
+        return 1
+    headers = ({"Authorization": f"Bearer {ns_args.token}"}
+               if ns_args.token else None)
+    client = client or HttpClient(ns_args.server, headers=headers)
+    k = Kubectl(client, out=out, err=err)
+    ns = ns_args.namespace
+    try:
+        if ns_args.command == "get":
+            k.get(ns, ns_args.args, ns_args.output, ns_args.selector,
+                  ns_args.field_selector, ns_args.all_namespaces,
+                  ns_args.watch)
+        elif ns_args.command == "describe":
+            k.describe(ns, ns_args.args)
+        elif ns_args.command == "create":
+            k.create(ns, ns_args.filename)
+        elif ns_args.command == "apply":
+            k.apply(ns, ns_args.filename)
+        elif ns_args.command == "delete":
+            k.delete(ns, ns_args.args, ns_args.filename, ns_args.selector,
+                     ns_args.all)
+        elif ns_args.command == "scale":
+            k.scale(ns, ns_args.args, ns_args.replicas,
+                    ns_args.current_replicas)
+        elif ns_args.command == "label":
+            k.label(ns, ns_args.args, ns_args.overwrite)
+        elif ns_args.command == "annotate":
+            k.annotate(ns, ns_args.args, ns_args.overwrite)
+        elif ns_args.command == "expose":
+            k.expose(ns, ns_args.args, ns_args.port, ns_args.target_port,
+                     ns_args.name, ns_args.type)
+        elif ns_args.command == "run":
+            k.run(ns, ns_args.name, ns_args.image, ns_args.replicas,
+                  ns_args.labels)
+        elif ns_args.command == "rolling-update":
+            k.rolling_update(ns, ns_args.old_name, ns_args.new_name,
+                             ns_args.image, ns_args.filename,
+                             ns_args.update_period)
+        elif ns_args.command == "autoscale":
+            k.autoscale(ns, ns_args.args, ns_args.min, ns_args.max,
+                        ns_args.cpu_percent)
+        elif ns_args.command == "logs":
+            k.logs(ns, ns_args.pod, ns_args.container)
+        elif ns_args.command == "version":
+            k.version()
+        elif ns_args.command == "api-versions":
+            k.api_versions()
+        elif ns_args.command == "cluster-info":
+            k.cluster_info(ns_args.server)
+        return 0
+    except ApiError as e:
+        (err or sys.stderr).write(f"Error: {e}\n")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

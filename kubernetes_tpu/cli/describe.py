@@ -1,0 +1,146 @@
+"""kubectl describe: per-kind detail blocks + related events.
+
+Reference: pkg/kubectl/describe.go (PodDescriber, NodeDescriber, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..core import types as api
+from .printers import translate_timestamp
+
+
+def _kv(out: List[str], key: str, value) -> None:
+    out.append(f"{key}:\t{value}")
+
+
+def _labels(labels) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "<none>"
+
+
+def describe_pod(pod: api.Pod, events) -> str:
+    out: List[str] = []
+    _kv(out, "Name", pod.metadata.name)
+    _kv(out, "Namespace", pod.metadata.namespace)
+    _kv(out, "Node", pod.spec.node_name or "<none>")
+    _kv(out, "Labels", _labels(pod.metadata.labels))
+    _kv(out, "Status", pod.status.phase)
+    _kv(out, "IP", pod.status.pod_ip or "<none>")
+    out.append("Containers:")
+    for c in pod.spec.containers:
+        out.append(f"  {c.name}:")
+        out.append(f"    Image:\t{c.image}")
+        req = c.resources.requests
+        if req:
+            out.append("    Requests:")
+            for r, q in sorted(req.items()):
+                out.append(f"      {r}:\t{q}")
+    _append_events(out, events)
+    return "\n".join(out)
+
+
+def describe_node(node: api.Node, pods, events) -> str:
+    out: List[str] = []
+    _kv(out, "Name", node.metadata.name)
+    _kv(out, "Labels", _labels(node.metadata.labels))
+    _kv(out, "Unschedulable", str(node.spec.unschedulable).lower())
+    out.append("Conditions:")
+    for cond in node.status.conditions:
+        out.append(f"  {cond.type}\t{cond.status}\t{cond.reason}")
+    out.append("Capacity:")
+    for r, q in sorted(node.status.capacity.items()):
+        out.append(f"  {r}:\t{q}")
+    out.append(f"Pods:\t({len(pods)} in total)")
+    for p in pods:
+        out.append(f"  {p.metadata.namespace}/{p.metadata.name}")
+    _append_events(out, events)
+    return "\n".join(out)
+
+
+def describe_service(svc: api.Service, endpoints, events) -> str:
+    out: List[str] = []
+    _kv(out, "Name", svc.metadata.name)
+    _kv(out, "Namespace", svc.metadata.namespace)
+    _kv(out, "Selector", _labels(svc.spec.selector))
+    _kv(out, "Type", svc.spec.type)
+    _kv(out, "IP", svc.spec.cluster_ip or "<none>")
+    for port in svc.spec.ports:
+        _kv(out, "Port", f"{port.name or '<unset>'}\t{port.port}/{port.protocol}")
+    if endpoints is not None:
+        addrs = []
+        for subset in endpoints.subsets:
+            for addr in subset.addresses:
+                for port in subset.ports:
+                    addrs.append(f"{addr.ip}:{port.port}")
+        _kv(out, "Endpoints", ",".join(addrs) or "<none>")
+    _append_events(out, events)
+    return "\n".join(out)
+
+
+def describe_rc(rc: api.ReplicationController, pods, events) -> str:
+    out: List[str] = []
+    _kv(out, "Name", rc.metadata.name)
+    _kv(out, "Namespace", rc.metadata.namespace)
+    _kv(out, "Selector", _labels(rc.spec.selector))
+    _kv(out, "Replicas",
+        f"{rc.status.replicas} current / {rc.spec.replicas} desired")
+    phases = {}
+    for p in pods:
+        phases[p.status.phase] = phases.get(p.status.phase, 0) + 1
+    _kv(out, "Pods Status",
+        " / ".join(f"{n} {phase}" for phase, n in sorted(phases.items()))
+        or "<none>")
+    _append_events(out, events)
+    return "\n".join(out)
+
+
+def describe_generic(obj: Any, scheme, events) -> str:
+    out: List[str] = []
+    _kv(out, "Name", obj.metadata.name)
+    if obj.metadata.namespace:
+        _kv(out, "Namespace", obj.metadata.namespace)
+    _kv(out, "Labels", _labels(obj.metadata.labels))
+    _kv(out, "Kind", scheme.kind_for(obj))
+    _kv(out, "Created",
+        translate_timestamp(obj.metadata.creation_timestamp) + " ago")
+    _append_events(out, events)
+    return "\n".join(out)
+
+
+def _append_events(out: List[str], events) -> None:
+    if not events:
+        return
+    out.append("Events:")
+    out.append("  AGE\tCOUNT\tTYPE\tREASON\tMESSAGE")
+    for e in events:
+        out.append("  " + "\t".join([
+            translate_timestamp(e.last_timestamp or e.first_timestamp),
+            str(e.count), e.type, e.reason, e.message]))
+
+
+def describe(client, scheme, resource: str, name: str, namespace: str) -> str:
+    obj = client.get(resource, name, namespace)
+    events = [e for e in client.list("events", namespace)[0]
+              if e.involved_object.name == name] if namespace else []
+    if resource == "pods":
+        return describe_pod(obj, events)
+    if resource == "nodes":
+        pods = [p for p in client.list("pods", "")[0]
+                if p.spec.node_name == name]
+        node_events = [e for e in client.list("events", "default")[0]
+                       if e.involved_object.name == name]
+        return describe_node(obj, pods, node_events)
+    if resource == "services":
+        try:
+            endpoints = client.get("endpoints", name, namespace)
+        except Exception:
+            endpoints = None
+        return describe_service(obj, endpoints, events)
+    if resource == "replicationcontrollers":
+        from ..core.labels import selector_from_set
+        sel = selector_from_set(obj.spec.selector)
+        pods = [p for p in client.list("pods", namespace)[0]
+                if sel.matches(p.metadata.labels)]
+        return describe_rc(obj, pods, events)
+    return describe_generic(obj, scheme, events)

@@ -1,0 +1,236 @@
+"""Output printers: human tables, json, yaml-ish, name, jsonpath-lite.
+
+Reference: pkg/kubectl/resource_printer.go — HumanReadablePrinter column
+sets per kind, JSONPath/template printers, `-o name`.
+"""
+
+from __future__ import annotations
+
+import json
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, List
+
+from ..core import types as api
+
+
+def translate_timestamp(ts: str) -> str:
+    """Humanized age (ref: resource_printer.go translateTimestamp)."""
+    if not ts:
+        return "<unknown>"
+    try:
+        then = datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except ValueError:
+        return "<unknown>"
+    seconds = int((datetime.now(timezone.utc) - then).total_seconds())
+    if seconds < 0:
+        return "0s"
+    if seconds < 90:
+        return f"{seconds}s"
+    minutes = seconds // 60
+    if minutes < 90:
+        return f"{minutes}m"
+    hours = seconds // 3600
+    if hours < 36:
+        return f"{hours}h"
+    return f"{seconds // 86400}d"
+
+
+def _pod_row(p: api.Pod) -> List[str]:
+    ready = sum(1 for s in p.status.container_statuses if s.ready)
+    total = len(p.spec.containers)
+    restarts = sum(s.restart_count for s in p.status.container_statuses)
+    return [p.metadata.name, f"{ready}/{total}", p.status.phase or "Unknown",
+            str(restarts), translate_timestamp(p.metadata.creation_timestamp)]
+
+
+def _node_row(n: api.Node) -> List[str]:
+    status = "Unknown"
+    for cond in n.status.conditions:
+        if cond.type == "Ready":
+            status = "Ready" if cond.status == "True" else "NotReady"
+    if n.spec.unschedulable:
+        status += ",SchedulingDisabled"
+    labels = ",".join(f"{k}={v}" for k, v in sorted(n.metadata.labels.items())) or "<none>"
+    return [n.metadata.name, labels, status,
+            translate_timestamp(n.metadata.creation_timestamp)]
+
+
+def _svc_row(s: api.Service) -> List[str]:
+    ports = ",".join(f"{p.port}/{p.protocol}" for p in s.spec.ports) or "<none>"
+    selector = ",".join(f"{k}={v}" for k, v in sorted(s.spec.selector.items())) or "<none>"
+    return [s.metadata.name, s.spec.cluster_ip or "<none>", ports, selector,
+            translate_timestamp(s.metadata.creation_timestamp)]
+
+
+def _rc_row(rc: api.ReplicationController) -> List[str]:
+    tpl = rc.spec.template
+    containers = ",".join(c.name for c in tpl.spec.containers) if tpl else ""
+    images = ",".join(c.image for c in tpl.spec.containers) if tpl else ""
+    selector = ",".join(f"{k}={v}" for k, v in sorted(rc.spec.selector.items()))
+    return [rc.metadata.name, containers, images, selector,
+            str(rc.spec.replicas),
+            translate_timestamp(rc.metadata.creation_timestamp)]
+
+
+def _event_row(e: api.Event) -> List[str]:
+    obj = e.involved_object
+    return [translate_timestamp(e.last_timestamp or e.first_timestamp),
+            str(e.count), obj.kind, obj.name, e.type, e.reason, e.message]
+
+
+def _deployment_row(d: api.Deployment) -> List[str]:
+    return [d.metadata.name, str(d.spec.replicas),
+            str(d.status.updated_replicas), str(d.status.replicas),
+            translate_timestamp(d.metadata.creation_timestamp)]
+
+
+def _job_row(j: api.Job) -> List[str]:
+    completions = j.spec.completions if j.spec.completions is not None else "<none>"
+    return [j.metadata.name, str(completions), str(j.status.succeeded),
+            translate_timestamp(j.metadata.creation_timestamp)]
+
+
+def _ns_row(ns: api.Namespace) -> List[str]:
+    return [ns.metadata.name, ns.status.phase,
+            translate_timestamp(ns.metadata.creation_timestamp)]
+
+
+# kind -> (headers, row fn); layouts follow resource_printer.go's
+# printPod/printNode/printService/printReplicationController/...
+COLUMNS: Dict[str, Any] = {
+    "Pod": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"], _pod_row),
+    "Node": (["NAME", "LABELS", "STATUS", "AGE"], _node_row),
+    "Service": (["NAME", "CLUSTER_IP", "PORT(S)", "SELECTOR", "AGE"],
+                _svc_row),
+    "ReplicationController": (
+        ["CONTROLLER", "CONTAINER(S)", "IMAGE(S)", "SELECTOR", "REPLICAS",
+         "AGE"], _rc_row),
+    "Event": (["AGE", "COUNT", "KIND", "NAME", "TYPE", "REASON", "MESSAGE"],
+              _event_row),
+    "Deployment": (["NAME", "DESIRED", "UPDATED", "TOTAL", "AGE"],
+                   _deployment_row),
+    "Job": (["NAME", "COMPLETIONS", "SUCCESSFUL", "AGE"], _job_row),
+    "Namespace": (["NAME", "STATUS", "AGE"], _ns_row),
+}
+
+
+def _generic_row(obj: Any) -> List[str]:
+    return [obj.metadata.name,
+            translate_timestamp(obj.metadata.creation_timestamp)]
+
+
+def print_table(objs: List[Any], scheme, out,
+                with_namespace=False) -> None:
+    """One table section per kind, kinds in first-seen order (kubectl
+    prints `get pods,svc` as stacked per-kind tables)."""
+    groups: Dict[str, List[Any]] = {}
+    order: List[str] = []
+    for obj in objs:
+        kind = scheme.kind_for(obj)
+        if kind not in groups:
+            groups[kind] = []
+            order.append(kind)
+        groups[kind].append(obj)
+    for i, kind in enumerate(order):
+        if i:
+            out.write("\n")
+        _print_kind_table(kind, groups[kind], out, with_namespace)
+
+
+def _print_kind_table(kind: str, objs: List[Any], out,
+                      with_namespace: bool) -> None:
+    headers, fn = COLUMNS.get(kind, (["NAME", "AGE"], _generic_row))
+    if with_namespace:
+        headers = ["NAMESPACE"] + headers
+    rows = []
+    for obj in objs:
+        row = fn(obj)
+        if with_namespace:
+            row = [obj.metadata.namespace] + row
+        rows.append(row)
+    widths = [max(len(headers[i]), max(len(r[i]) for r in rows))
+              for i in range(len(headers))]
+    out.write("   ".join(h.ljust(widths[i])
+                         for i, h in enumerate(headers)).rstrip() + "\n")
+    for r in rows:
+        out.write("   ".join(v.ljust(widths[i])
+                             for i, v in enumerate(r)).rstrip() + "\n")
+
+
+def _to_yamlish(data: Any, indent: int = 0) -> str:
+    """Minimal YAML emitter for JSON-able structures (no pyyaml dep)."""
+    pad = "  " * indent
+    if isinstance(data, dict):
+        if not data:
+            return pad + "{}"
+        lines = []
+        for k, v in data.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append(f"{pad}{k}:")
+                lines.append(_to_yamlish(v, indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {json.dumps(v)}")
+        return "\n".join(lines)
+    if isinstance(data, list):
+        lines = []
+        for v in data:
+            if isinstance(v, (dict, list)) and v:
+                body = _to_yamlish(v, indent + 1)
+                first, _, rest = body.partition("\n")
+                lines.append(f"{pad}- {first.strip()}")
+                if rest:
+                    lines.append(rest)
+            else:
+                lines.append(f"{pad}- {json.dumps(v)}")
+        return "\n".join(lines)
+    return pad + json.dumps(data)
+
+
+def jsonpath_get(data: Any, path: str) -> Any:
+    """jsonpath-lite: {.a.b[0].c} (ref: pkg/util/jsonpath, subset)."""
+    expr = path.strip()
+    if expr.startswith("{") and expr.endswith("}"):
+        expr = expr[1:-1]
+    cur = data
+    for part in expr.lstrip(".").replace("]", "").split("."):
+        if not part:
+            continue
+        name, _, idx = part.partition("[")
+        if name:
+            cur = cur[name] if isinstance(cur, dict) else None
+        if idx != "":
+            cur = cur[int(idx)] if isinstance(cur, list) else None
+        if cur is None:
+            return None
+    return cur
+
+
+def print_objects(objs: List[Any], output: str, scheme, out,
+                  resource_names=None, with_namespace=False) -> None:
+    """output: '' (table) | json | yaml | name | jsonpath=<expr>"""
+    if output == "json":
+        if len(objs) == 1:
+            payload = scheme.encode_dict(objs[0])
+        else:
+            payload = {"kind": "List", "apiVersion": "v1",
+                       "items": [scheme.encode_dict(o) for o in objs]}
+        out.write(json.dumps(payload, indent=2) + "\n")
+    elif output == "yaml":
+        for i, obj in enumerate(objs):
+            if i:
+                out.write("---\n")
+            out.write(_to_yamlish(scheme.encode_dict(obj)) + "\n")
+    elif output == "name":
+        for obj, rname in zip(objs, resource_names or
+                              [""] * len(objs)):
+            prefix = f"{rname}/" if rname else ""
+            out.write(f"{prefix}{obj.metadata.name}\n")
+    elif output.startswith("jsonpath="):
+        expr = output[len("jsonpath="):]
+        for obj in objs:
+            value = jsonpath_get(scheme.encode_dict(obj), expr)
+            out.write((json.dumps(value)
+                       if isinstance(value, (dict, list))
+                       else str(value)) + "\n")
+    else:
+        print_table(objs, scheme, out, with_namespace=with_namespace)

@@ -1,0 +1,91 @@
+"""Resource argument builder: names, aliases, TYPE/NAME forms, -f files.
+
+Reference: pkg/kubectl/resource (the Builder) and kubectl.ShortForms
+(pkg/kubectl/kubectl.go expandResourceShortcut).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from ..core.errors import BadRequest
+
+# ref: pkg/kubectl/cmd/cmd.go shortForms
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "rc": "replicationcontrollers",
+    "replicationcontroller": "replicationcontrollers",
+    "ns": "namespaces", "namespace": "namespaces",
+    "ev": "events", "event": "events",
+    "ep": "endpoints",
+    "limits": "limitranges", "limitrange": "limitranges",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "secret": "secrets",
+    "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "deploy": "deployments", "deployment": "deployments",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "job": "jobs",
+    "hpa": "horizontalpodautoscalers",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "ing": "ingresses", "ingress": "ingresses",
+}
+
+
+def resolve_resource(arg: str) -> str:
+    return ALIASES.get(arg.lower(), arg.lower())
+
+
+def parse_resource_args(args: List[str]) -> List[Tuple[str, Optional[str]]]:
+    """kubectl arg forms -> [(resource, name-or-None)]:
+    `get pods`, `get pods name1 name2`, `get pod/name`, `get pods,svc`.
+    """
+    if not args:
+        raise BadRequest("resource type required")
+    head = args[0]
+    out: List[Tuple[str, Optional[str]]] = []
+    if "/" in head:
+        for item in args:
+            if "/" not in item:
+                raise BadRequest(
+                    f"mixed TYPE/NAME and bare arguments: {item!r}")
+            rtype, _, name = item.partition("/")
+            out.append((resolve_resource(rtype), name))
+        return out
+    resources = [resolve_resource(r) for r in head.split(",")]
+    names = args[1:]
+    if names and len(resources) > 1:
+        raise BadRequest("names cannot be combined with multiple resources")
+    if not names:
+        return [(r, None) for r in resources]
+    return [(resources[0], n) for n in names]
+
+
+def load_manifest(path: str, scheme) -> List:
+    """-f input: one object, a JSON list, or a v1 List kind."""
+    if path == "-":
+        import sys
+        raw = sys.stdin.read()
+    else:
+        with open(path) as f:
+            raw = f.read()
+    data = json.loads(raw)
+    if isinstance(data, list):
+        return [scheme.decode_dict(d) for d in data]
+    if isinstance(data, dict) and data.get("kind", "").endswith("List"):
+        return [scheme.decode_dict(d) for d in data.get("items", [])]
+    return [scheme.decode_dict(data)]
+
+
+def resource_for_object(obj, scheme) -> str:
+    kind = scheme.kind_for(obj)
+    from ..api.registry import RESOURCES
+    for name, info in RESOURCES.items():
+        if info.kind == kind:
+            return name
+    raise BadRequest(f"no resource for kind {kind}")

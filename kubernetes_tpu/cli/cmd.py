@@ -17,7 +17,7 @@ from typing import List, Optional
 
 from ..api.client import HttpClient
 from ..core import types as api
-from ..core.errors import ApiError, NotFound
+from ..core.errors import AlreadyExists, ApiError, NotFound
 from ..core.scheme import default_scheme
 from .describe import describe
 from .printers import print_objects
@@ -359,7 +359,7 @@ class Kubectl:
         desired = old.spec.replicas
         try:
             new = self.client.create("replicationcontrollers", new, ns)
-        except ApiError:
+        except AlreadyExists:  # resuming an interrupted update
             new = self.client.get("replicationcontrollers",
                                   new.metadata.name, ns)
         while new.spec.replicas < desired or old.spec.replicas > 0:
@@ -521,6 +521,11 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
             k.cluster_info(ns_args.server)
         return 0
     except ApiError as e:
+        (err or sys.stderr).write(f"Error: {e}\n")
+        return 1
+    except (OSError, ValueError) as e:
+        # bad -f path, unreadable/malformed manifest (JSONDecodeError is
+        # a ValueError): a clean error beats a traceback
         (err or sys.stderr).write(f"Error: {e}\n")
         return 1
 

@@ -119,17 +119,24 @@ def _append_events(out: List[str], events) -> None:
             str(e.count), e.type, e.reason, e.message]))
 
 
+def _events_for(client, namespace: str, kind: str, name: str):
+    return [e for e in client.list("events", namespace)[0]
+            if e.involved_object.name == name
+            and (not e.involved_object.kind
+                 or e.involved_object.kind == kind)]
+
+
 def describe(client, scheme, resource: str, name: str, namespace: str) -> str:
+    from ..api.registry import Registry
     obj = client.get(resource, name, namespace)
-    events = [e for e in client.list("events", namespace)[0]
-              if e.involved_object.name == name] if namespace else []
+    kind = Registry.info(resource).kind
+    events = _events_for(client, namespace, kind, name) if namespace else []
     if resource == "pods":
         return describe_pod(obj, events)
     if resource == "nodes":
         pods = [p for p in client.list("pods", "")[0]
                 if p.spec.node_name == name]
-        node_events = [e for e in client.list("events", "default")[0]
-                       if e.involved_object.name == name]
+        node_events = _events_for(client, "default", "Node", name)
         return describe_node(obj, pods, node_events)
     if resource == "services":
         try:

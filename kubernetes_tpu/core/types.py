@@ -169,6 +169,38 @@ class VolumeMount:
 
 
 @dataclass
+class ExecAction:
+    command: List[str] = field(default_factory=list)
+
+
+@dataclass
+class HTTPGetAction:
+    path: str = ""
+    port: Any = None
+    host: str = ""
+    scheme: str = "HTTP"
+
+
+@dataclass
+class TCPSocketAction:
+    port: Any = None
+
+
+@dataclass
+class Probe:
+    """(ref: pkg/api/types.go Probe — a Handler + timing knobs; the
+    exec handler's field is literally named `exec`, matching the wire)"""
+    exec: Optional[ExecAction] = None
+    http_get: Optional[HTTPGetAction] = None
+    tcp_socket: Optional[TCPSocketAction] = None
+    initial_delay_seconds: int = 0
+    timeout_seconds: int = 1
+    period_seconds: int = 10
+    success_threshold: int = 1
+    failure_threshold: int = 3
+
+
+@dataclass
 class Container:
     """privileged is the security-context surface the SecurityContextDeny
     admission plugin polices (the reference nests it in
@@ -183,6 +215,8 @@ class Container:
     volume_mounts: List[VolumeMount] = field(default_factory=list)
     image_pull_policy: str = ""
     privileged: bool = False
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
 
 
 @dataclass

@@ -1,0 +1,24 @@
+"""Kubelet: the node agent's full core.
+
+Reference: pkg/kubelet — syncLoop/syncLoopIteration (kubelet.go:2277,
+2297: select over pod updates | PLEG events | housekeeping), per-pod
+workers (pod_workers.go:105,137), PLEG (pleg/generic.go:78,102), probers
+(prober/{manager,worker,prober}.go + pkg/probe executors), status
+manager (status/manager.go:117-146), and the container Runtime interface
+(pkg/kubelet/container) with a fake runtime standing in for the docker
+manager (dockertools/manager.go) the way kubemark's FakeDockerClient
+does. agents.hollow_node.HollowKubelet remains the thin hollow variant;
+this package is the real sync machinery.
+"""
+
+from .container import (ContainerState, FakeRuntime, Runtime,
+                        RuntimeContainer, RuntimePod)
+from .pleg import GenericPLEG, PodLifecycleEvent
+from .prober import Prober, ProberManager, ProbeResult
+from .kubelet import Kubelet
+
+__all__ = [
+    "ContainerState", "FakeRuntime", "Runtime", "RuntimeContainer",
+    "RuntimePod", "GenericPLEG", "PodLifecycleEvent", "Prober",
+    "ProberManager", "ProbeResult", "Kubelet",
+]

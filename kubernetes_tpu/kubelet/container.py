@@ -1,0 +1,149 @@
+"""Container runtime abstraction + fake runtime.
+
+Reference: pkg/kubelet/container (the Runtime interface, Pod/Container
+runtime types) and dockertools/manager.go's SyncPod semantics, with the
+fake playing FakeDockerClient's role (controllable from tests: kill a
+container, fail the next start).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..core import types as api
+
+
+class ContainerState:
+    RUNNING = "running"
+    EXITED = "exited"
+
+
+@dataclass
+class RuntimeContainer:
+    """(ref: kubecontainer.Container)"""
+    id: str = ""
+    name: str = ""
+    image: str = ""
+    state: str = ContainerState.RUNNING
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    exit_code: int = 0
+    restart_count: int = 0
+
+
+@dataclass
+class RuntimePod:
+    """(ref: kubecontainer.Pod)"""
+    uid: str = ""
+    name: str = ""
+    namespace: str = ""
+    containers: List[RuntimeContainer] = field(default_factory=list)
+
+
+class Runtime:
+    """(ref: kubecontainer.Runtime interface — the subset the sync loop
+    and PLEG consume)"""
+
+    def get_pods(self) -> List[RuntimePod]:
+        raise NotImplementedError
+
+    def start_container(self, pod: api.Pod, container: api.Container
+                        ) -> RuntimeContainer:
+        raise NotImplementedError
+
+    def kill_container(self, pod_uid: str, name: str) -> None:
+        raise NotImplementedError
+
+    def kill_pod(self, pod_uid: str) -> None:
+        raise NotImplementedError
+
+
+class FakeRuntime(Runtime):
+    """In-memory runtime: containers 'run' until told otherwise.
+
+    Test controls: exit_container() simulates a crash (with exit code);
+    fail_next_start() makes the next start raise — exercising the
+    kubelet's backoff/retry paths.
+    """
+
+    def __init__(self):
+        self._pods: Dict[str, RuntimePod] = {}
+        self._lock = threading.Lock()
+        self._fail_next = 0
+        self._counter = 0
+
+    # ----------------------------------------------------- Runtime API
+
+    def get_pods(self) -> List[RuntimePod]:
+        with self._lock:
+            return [RuntimePod(uid=p.uid, name=p.name, namespace=p.namespace,
+                               containers=[RuntimeContainer(**vars(c))
+                                           for c in p.containers])
+                    for p in self._pods.values()]
+
+    def start_container(self, pod: api.Pod, container: api.Container
+                        ) -> RuntimeContainer:
+        with self._lock:
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                raise RuntimeError(f"start {container.name}: injected failure")
+            rp = self._pods.setdefault(pod.metadata.uid, RuntimePod(
+                uid=pod.metadata.uid, name=pod.metadata.name,
+                namespace=pod.metadata.namespace))
+            prior = [c for c in rp.containers if c.name == container.name]
+            restart_count = max((c.restart_count for c in prior),
+                                default=-1) + 1
+            # the old instance's record is replaced, like docker rm
+            rp.containers = [c for c in rp.containers
+                             if c.name != container.name]
+            self._counter += 1
+            rc = RuntimeContainer(
+                id=f"fake://{pod.metadata.uid}/{container.name}/{self._counter}",
+                name=container.name, image=container.image,
+                state=ContainerState.RUNNING, started_at=time.time(),
+                restart_count=restart_count)
+            rp.containers.append(rc)
+            return rc
+
+    def kill_container(self, pod_uid: str, name: str) -> None:
+        # killed containers report 128+SIGKILL like docker (137)
+        self._transition(pod_uid, name, exit_code=137)
+
+    def kill_pod(self, pod_uid: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_uid, None)
+
+    # ------------------------------------------------- test controls
+
+    def exit_container(self, pod_uid: str, name: str,
+                       exit_code: int = 1) -> None:
+        """Simulate a container crash."""
+        self._transition(pod_uid, name, exit_code)
+
+    def fail_next_start(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_next += n
+
+    def running_containers(self, pod_uid: str) -> List[str]:
+        with self._lock:
+            rp = self._pods.get(pod_uid)
+            if rp is None:
+                return []
+            return [c.name for c in rp.containers
+                    if c.state == ContainerState.RUNNING]
+
+    # ------------------------------------------------------- helpers
+
+    def _transition(self, pod_uid: str, name: str, exit_code: int) -> None:
+        with self._lock:
+            rp = self._pods.get(pod_uid)
+            if rp is None:
+                return
+            for c in rp.containers:
+                if c.name == name and c.state == ContainerState.RUNNING:
+                    c.state = ContainerState.EXITED
+                    c.finished_at = time.time()
+                    c.exit_code = exit_code

@@ -1,0 +1,337 @@
+"""The kubelet core: sync loop, pod workers, status publication.
+
+Reference: pkg/kubelet/kubelet.go — Run :897, syncLoop :2277,
+syncLoopIteration :2297 (select over apiserver pod updates | PLEG events
+| periodic sync | housekeeping), syncPod :1597 (ensure containers match
+the spec under the restart policy), HandlePodAdditions/Updates/Deletions
+:2394-2452; pod workers pkg/kubelet/pod_workers.go:105,137 (one worker
+per pod, latest-update-wins); status manager status/manager.go.
+
+RestartPolicy semantics (syncPod + computePodStatus):
+  Always      -> dead containers restart, pod stays Running
+  OnFailure   -> restart only on exit code != 0; all succeeded -> pod
+                 Succeeded
+  Never       -> never restart; any failed -> Failed once none running,
+                 all succeeded -> Succeeded
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import replace
+from typing import Dict, List, Optional
+
+from ..agents.hollow_node import StatusManager
+from ..api.cache import Informer, meta_namespace_key
+from ..core import types as api
+from .container import ContainerState, FakeRuntime, Runtime, RuntimePod
+from .pleg import GenericPLEG
+from .prober import Prober, ProberManager
+
+HOUSEKEEPING_PERIOD = 2.0  # kubelet.go housekeepingPeriod (2s)
+SYNC_PERIOD = 10.0
+
+
+def _rfc3339(epoch: float) -> str:
+    """Stable timestamp from the runtime's recorded start time — a fresh
+    now() per publish would defeat the status manager's dedup."""
+    from datetime import datetime, timezone
+    return datetime.fromtimestamp(epoch, timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+class _PodWorker:
+    """One serial worker per pod (pod_workers.go:105 managePodLoop):
+    processes the latest requested sync; intermediate requests collapse."""
+
+    def __init__(self, kubelet: "Kubelet", pod_uid: str):
+        self.kubelet = kubelet
+        self.pod_uid = pod_uid
+        self._wake: "queue.Queue[Optional[api.Pod]]" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"pod-worker-{pod_uid[:8]}")
+        self._thread.start()
+
+    def update(self, pod: api.Pod) -> None:
+        self._wake.put(pod)
+
+    def stop(self) -> None:
+        self._wake.put(None)
+
+    def _loop(self) -> None:
+        while True:
+            pod = self._wake.get()
+            if pod is None:
+                return
+            # collapse a backlog down to the newest update
+            try:
+                while True:
+                    nxt = self._wake.get_nowait()
+                    if nxt is None:
+                        return
+                    pod = nxt
+            except queue.Empty:
+                pass
+            try:
+                self.kubelet.sync_pod(pod)
+            except Exception:
+                pass  # next update or periodic sync re-drives
+
+
+class Kubelet:
+    def __init__(self, client, node_name: str,
+                 runtime: Optional[Runtime] = None,
+                 prober: Optional[Prober] = None,
+                 max_restart_backoff: float = 10.0):
+        self.client = client
+        self.node_name = node_name
+        self.runtime = runtime or FakeRuntime()
+        self.pleg = GenericPLEG(self.runtime)
+        self.prober_manager = ProberManager(
+            prober or Prober(), on_liveness_failure=self._liveness_failed,
+            on_readiness_change=self._readiness_changed)
+        self.status_manager = StatusManager(client)
+        self._workers: Dict[str, _PodWorker] = {}
+        self._pods: Dict[str, api.Pod] = {}  # uid -> latest spec
+        self._backoff: Dict[str, float] = {}  # uid/name -> not-before
+        self._start_times: Dict[str, str] = {}  # uid -> first-seen time
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._informer: Optional[Informer] = None
+        self.max_restart_backoff = max_restart_backoff
+
+    # --------------------------------------------------- pod accounting
+
+    def _worker_for(self, pod: api.Pod) -> _PodWorker:
+        uid = pod.metadata.uid
+        with self._lock:
+            worker = self._workers.get(uid)
+            if worker is None:
+                worker = _PodWorker(self, uid)
+                self._workers[uid] = worker
+            return worker
+
+    def handle_pod_addition(self, pod: api.Pod) -> None:
+        """(kubelet.go:2394 HandlePodAdditions)"""
+        with self._lock:
+            self._pods[pod.metadata.uid] = pod
+        self.prober_manager.add_pod(pod)
+        self._worker_for(pod).update(pod)
+
+    def handle_pod_update(self, old: api.Pod, pod: api.Pod) -> None:
+        with self._lock:
+            self._pods[pod.metadata.uid] = pod
+        # refresh the probers' pod view (pod IP, new probes on spec change)
+        self.prober_manager.add_pod(pod)
+        self._worker_for(pod).update(pod)
+
+    def handle_pod_deletion(self, pod: api.Pod) -> None:
+        uid = pod.metadata.uid
+        with self._lock:
+            self._pods.pop(uid, None)
+            worker = self._workers.pop(uid, None)
+        if worker:
+            worker.stop()
+        self.prober_manager.remove_pod(uid)
+        self.runtime.kill_pod(uid)
+        self.status_manager.forget(pod)
+
+    # ----------------------------------------------------------- syncPod
+
+    def sync_pod(self, pod: api.Pod) -> None:
+        """(kubelet.go:1597 syncPod, against the runtime's view)"""
+        uid = pod.metadata.uid
+        runtime_pod = self._runtime_pod(uid)
+        by_name = {c.name: c for c in runtime_pod.containers} \
+            if runtime_pod else {}
+        now = time.time()
+        for container in pod.spec.containers:
+            rc = by_name.get(container.name)
+            if rc is not None and rc.state == ContainerState.RUNNING:
+                continue
+            if rc is not None and not self._should_restart(
+                    pod.spec.restart_policy, rc.exit_code):
+                continue
+            key = f"{uid}/{container.name}"
+            if self._backoff.get(key, 0) > now:
+                continue
+            try:
+                self.runtime.start_container(pod, container)
+                self._backoff.pop(key, None)
+            except Exception:
+                prev = self._backoff.get(f"{key}#d", 0.5)
+                delay = min(prev * 2, self.max_restart_backoff)
+                self._backoff[key] = now + delay
+                self._backoff[f"{key}#d"] = delay
+        self._publish_status(pod)
+
+    @staticmethod
+    def _should_restart(policy: str, exit_code: int) -> bool:
+        if policy == "Never":
+            return False
+        if policy == "OnFailure":
+            return exit_code != 0
+        return True  # Always
+
+    def _runtime_pod(self, uid: str) -> Optional[RuntimePod]:
+        for rp in self.runtime.get_pods():
+            if rp.uid == uid:
+                return rp
+        return None
+
+    def _readiness_changed(self, pod: api.Pod) -> None:
+        current = self._pods.get(pod.metadata.uid)
+        if current is not None:
+            self._worker_for(current).update(current)
+
+    def _liveness_failed(self, pod: api.Pod, container_name: str,
+                         message: str) -> None:
+        """Liveness failure -> kill; restart policy decides revival
+        (prober feeds syncPod in the reference the same way)."""
+        self.runtime.kill_container(pod.metadata.uid, container_name)
+        current = self._pods.get(pod.metadata.uid)
+        if current is not None:
+            self._worker_for(current).update(current)
+
+    # ----------------------------------------------------------- status
+
+    def _publish_status(self, pod: api.Pod) -> None:
+        uid = pod.metadata.uid
+        runtime_pod = self._runtime_pod(uid)
+        containers = runtime_pod.containers if runtime_pod else []
+        by_name = {c.name: c for c in containers}
+        statuses: List[api.ContainerStatus] = []
+        n_running = n_succeeded = n_failed = 0
+        for container in pod.spec.containers:
+            rc = by_name.get(container.name)
+            if rc is None:
+                statuses.append(api.ContainerStatus(
+                    name=container.name, image=container.image,
+                    state=api.ContainerState(
+                        waiting=api.ContainerStateWaiting(
+                            reason="ContainerCreating"))))
+                continue
+            if rc.state == ContainerState.RUNNING:
+                n_running += 1
+                ready = self.prober_manager.is_ready(uid, container.name)
+                statuses.append(api.ContainerStatus(
+                    name=container.name, image=rc.image, ready=ready,
+                    restart_count=rc.restart_count, container_id=rc.id,
+                    state=api.ContainerState(
+                        running=api.ContainerStateRunning(
+                            started_at=_rfc3339(rc.started_at)))))
+            else:
+                if rc.exit_code == 0:
+                    n_succeeded += 1
+                else:
+                    n_failed += 1
+                statuses.append(api.ContainerStatus(
+                    name=container.name, image=rc.image,
+                    restart_count=rc.restart_count, container_id=rc.id,
+                    state=api.ContainerState(
+                        terminated=api.ContainerStateTerminated(
+                            exit_code=rc.exit_code))))
+        phase = self._pod_phase(pod, len(pod.spec.containers), n_running,
+                                n_succeeded, n_failed)
+        all_ready = (phase == api.POD_RUNNING
+                     and all(s.ready for s in statuses))
+        start_time = (pod.status.start_time
+                      or self._start_times.setdefault(uid,
+                                                      api.now_rfc3339()))
+        status = api.PodStatus(
+            phase=phase,
+            conditions=[api.PodCondition(
+                type="Ready", status="True" if all_ready else "False")],
+            host_ip="10.0.0.1",
+            pod_ip=pod.status.pod_ip or "10.244.0.2",
+            start_time=start_time,
+            container_statuses=statuses)
+        self.status_manager.set_pod_status(pod, status)
+
+    @staticmethod
+    def _pod_phase(pod: api.Pod, total: int, running: int, succeeded: int,
+                   failed: int) -> str:
+        """(ref: kubelet.go getPhase — note Always NEVER yields a
+        terminal phase: its containers are about to restart)"""
+        policy = pod.spec.restart_policy
+        if total == 0:
+            return api.POD_PENDING
+        if running > 0:
+            return api.POD_RUNNING
+        if succeeded + failed == total:  # all terminated
+            if policy == "Always":
+                return api.POD_RUNNING  # restarts imminent
+            if policy == "OnFailure":
+                return (api.POD_SUCCEEDED if failed == 0
+                        else api.POD_RUNNING)
+            return api.POD_FAILED if failed else api.POD_SUCCEEDED
+        return api.POD_PENDING
+
+    # -------------------------------------------------------- sync loop
+
+    def _sync_loop(self) -> None:
+        """(kubelet.go:2277 syncLoop — PLEG events + periodic resync +
+        housekeeping on one thread; pod updates arrive via the informer
+        handlers, which dispatch straight to pod workers)"""
+        last_sync = last_housekeeping = time.time()
+        while not self._stop.is_set():
+            try:
+                event = self.pleg.events.get(timeout=0.2)
+            except queue.Empty:
+                event = None
+            if event is not None:
+                pod = self._pods.get(event.pod_uid)
+                if pod is not None:
+                    self._worker_for(pod).update(pod)
+            now = time.time()
+            if now - last_sync >= SYNC_PERIOD:
+                last_sync = now
+                with self._lock:
+                    pods = list(self._pods.values())
+                for pod in pods:
+                    self._worker_for(pod).update(pod)
+            if now - last_housekeeping >= HOUSEKEEPING_PERIOD:
+                last_housekeeping = now
+                self._housekeeping()
+
+    def _housekeeping(self) -> None:
+        """Kill runtime pods whose API object is gone
+        (kubelet.go HandlePodCleanups)."""
+        with self._lock:
+            known = set(self._pods)
+        for rp in self.runtime.get_pods():
+            if rp.uid not in known:
+                self.runtime.kill_pod(rp.uid)
+
+    # -------------------------------------------------------- lifecycle
+
+    def run(self) -> "Kubelet":
+        self.status_manager.start()
+        self.pleg.start()
+        self._informer = Informer(
+            self.client, "pods",
+            field_selector=f"spec.nodeName={self.node_name}",
+            on_add=self.handle_pod_addition,
+            on_update=self.handle_pod_update,
+            on_delete=self.handle_pod_deletion).start()
+        t = threading.Thread(target=self._sync_loop, daemon=True,
+                             name=f"kubelet-{self.node_name}")
+        t.start()
+        self._threads = [t]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._informer:
+            self._informer.stop()
+        self.pleg.stop()
+        self.prober_manager.stop()
+        self.status_manager.stop()
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            w.stop()

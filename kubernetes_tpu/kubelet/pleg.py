@@ -1,0 +1,90 @@
+"""PLEG — Pod Lifecycle Event Generator.
+
+Reference: pkg/kubelet/pleg/generic.go — relist() polls the runtime every
+relist period, diffs per-container states against the previous snapshot,
+and pushes PodLifecycleEvents into the channel the sync loop selects on
+(Start :78, relist :102).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .container import ContainerState, Runtime
+
+RELIST_PERIOD = 1.0  # generic.go relistPeriod (1s in the reference too)
+
+CONTAINER_STARTED = "ContainerStarted"
+CONTAINER_DIED = "ContainerDied"
+CONTAINER_REMOVED = "ContainerRemoved"
+
+
+@dataclass
+class PodLifecycleEvent:
+    pod_uid: str
+    type: str
+    container_name: str
+
+
+class GenericPLEG:
+    def __init__(self, runtime: Runtime,
+                 relist_period: float = RELIST_PERIOD):
+        self.runtime = runtime
+        self.relist_period = relist_period
+        self.events: "queue.Queue[PodLifecycleEvent]" = queue.Queue()
+        # (pod_uid, container_name) -> (container_id, state)
+        self._last: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def relist(self) -> int:
+        """One diff pass; returns the number of events emitted."""
+        current: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        for rp in self.runtime.get_pods():
+            for c in rp.containers:
+                current[(rp.uid, c.name)] = (c.id, c.state)
+        emitted = 0
+        for key, (cid, state) in current.items():
+            old = self._last.get(key)
+            if old is None:
+                if state == ContainerState.RUNNING:
+                    self._emit(key, CONTAINER_STARTED)
+                    emitted += 1
+                else:
+                    self._emit(key, CONTAINER_DIED)
+                    emitted += 1
+            elif old[1] != state or old[0] != cid:
+                if state == ContainerState.RUNNING:
+                    self._emit(key, CONTAINER_STARTED)
+                else:
+                    self._emit(key, CONTAINER_DIED)
+                emitted += 1
+        for key in self._last:
+            if key not in current:
+                self._emit(key, CONTAINER_REMOVED)
+                emitted += 1
+        self._last = current
+        return emitted
+
+    def _emit(self, key: Tuple[str, str], etype: str) -> None:
+        self.events.put(PodLifecycleEvent(pod_uid=key[0], type=etype,
+                                          container_name=key[1]))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.relist()
+            self._stop.wait(self.relist_period)
+
+    def start(self) -> "GenericPLEG":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pleg")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

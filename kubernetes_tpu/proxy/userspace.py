@@ -1,0 +1,199 @@
+"""Userspace-mode proxier: a real TCP proxy with round-robin balancing.
+
+Reference: pkg/proxy/userspace/{proxier,roundrobin}.go — one listening
+socket per service port, NextEndpoint round-robins across the service's
+endpoints (with optional client-IP session affinity), bytes shuttled
+both ways. Functional in-process: connections really balance.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core import types as api
+from .config import EndpointsConfig, ServiceConfig
+
+
+class RoundRobinLoadBalancer:
+    """(ref: roundrobin.go LoadBalancerRR)"""
+
+    def __init__(self, affinity_ttl: float = 180.0):
+        self._endpoints: Dict[Tuple[str, str, str], List[str]] = {}
+        self._index: Dict[Tuple[str, str, str], int] = {}
+        # (service, client_ip) -> (endpoint, stamp) when session affinity
+        self._affinity: Dict[Tuple[Tuple[str, str, str], str],
+                             Tuple[str, float]] = {}
+        self._affinity_on: Dict[Tuple[str, str, str], bool] = {}
+        self.affinity_ttl = affinity_ttl
+        self._lock = threading.Lock()
+
+    def set_session_affinity(self, key: Tuple[str, str, str],
+                             on: bool) -> None:
+        with self._lock:
+            self._affinity_on[key] = on
+
+    def on_endpoints_update(self, endpoints: List[api.Endpoints]) -> None:
+        """(ref: roundrobin.go OnUpdate — state rebuilt per service)"""
+        with self._lock:
+            fresh: Dict[Tuple[str, str, str], List[str]] = {}
+            for eps in endpoints:
+                for subset in eps.subsets:
+                    for port in subset.ports:
+                        key = (eps.metadata.namespace, eps.metadata.name,
+                               port.name or str(port.port))
+                        fresh.setdefault(key, []).extend(
+                            f"{a.ip}:{port.port}" for a in subset.addresses)
+            self._endpoints = {k: sorted(set(v)) for k, v in fresh.items()}
+            for key in list(self._index):
+                if key not in self._endpoints:
+                    del self._index[key]
+
+    def next_endpoint(self, key: Tuple[str, str, str],
+                      client_ip: str = "") -> Optional[str]:
+        """(ref: roundrobin.go NextEndpoint)"""
+        with self._lock:
+            endpoints = self._endpoints.get(key)
+            if not endpoints:
+                return None
+            if client_ip and self._affinity_on.get(key):
+                hit = self._affinity.get((key, client_ip))
+                if hit and hit[0] in endpoints and \
+                        time.time() - hit[1] < self.affinity_ttl:
+                    self._affinity[(key, client_ip)] = (hit[0], time.time())
+                    return hit[0]
+            i = self._index.get(key, 0) % len(endpoints)
+            self._index[key] = i + 1
+            chosen = endpoints[i]
+            if client_ip and self._affinity_on.get(key):
+                self._affinity[(key, client_ip)] = (chosen, time.time())
+            return chosen
+
+
+class _PortProxy:
+    """One listening socket shuttling to balanced endpoints."""
+
+    def __init__(self, balancer: RoundRobinLoadBalancer,
+                 key: Tuple[str, str, str], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.balancer = balancer
+        self.key = key
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn, addr[0]),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket, client_ip: str) -> None:
+        target = self.balancer.next_endpoint(self.key, client_ip)
+        if target is None:
+            conn.close()
+            return
+        host, _, port = target.rpartition(":")
+        try:
+            upstream = socket.create_connection((host, int(port)),
+                                                timeout=5)
+        except OSError:
+            conn.close()
+            return
+        for a, b in ((conn, upstream), (upstream, conn)):
+            threading.Thread(target=self._pump, args=(a, b),
+                             daemon=True).start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class UserspaceProxier:
+    """(ref: userspace/proxier.go Proxier — OnServiceUpdate opens/closes
+    port proxies; localhost ports stand in for the service portal IPs)"""
+
+    def __init__(self, client=None,
+                 balancer: Optional[RoundRobinLoadBalancer] = None):
+        self.balancer = balancer or RoundRobinLoadBalancer()
+        self._proxies: Dict[Tuple[str, str, str], _PortProxy] = {}
+        self._lock = threading.Lock()
+        self._service_config = None
+        self._endpoints_config = None
+        if client is not None:
+            self._service_config = ServiceConfig(client,
+                                                 self.on_service_update)
+            self._endpoints_config = EndpointsConfig(
+                client, self.balancer.on_endpoints_update)
+
+    def on_service_update(self, services: List[api.Service]) -> None:
+        wanted: Dict[Tuple[str, str, str], api.Service] = {}
+        for svc in services:
+            for port in svc.spec.ports:
+                key = (svc.metadata.namespace, svc.metadata.name,
+                       port.name or str(port.port))
+                wanted[key] = svc
+                self.balancer.set_session_affinity(
+                    key, svc.spec.session_affinity == "ClientIP")
+        with self._lock:
+            for key in list(self._proxies):
+                if key not in wanted:
+                    self._proxies.pop(key).close()
+            for key in wanted:
+                if key not in self._proxies:
+                    self._proxies[key] = _PortProxy(self.balancer, key)
+
+    def port_for(self, namespace: str, name: str, port_name: str
+                 ) -> Optional[int]:
+        with self._lock:
+            proxy = self._proxies.get((namespace, name, port_name))
+            return proxy.port if proxy else None
+
+    def run(self) -> "UserspaceProxier":
+        """Start the watch-driven feeds (requires a client)."""
+        if self._service_config:
+            self._service_config.start()
+        if self._endpoints_config:
+            self._endpoints_config.start()
+        return self
+
+    def stop(self) -> None:
+        if self._service_config:
+            self._service_config.stop()
+        if self._endpoints_config:
+            self._endpoints_config.stop()
+        with self._lock:
+            for proxy in self._proxies.values():
+                proxy.close()
+            self._proxies.clear()

@@ -1,0 +1,189 @@
+"""kube-proxy: iptables rule synthesis (against the fake, like
+hollow-proxy) and the userspace TCP proxy balancing real connections
+(ref: pkg/proxy/iptables/proxier.go:453, pkg/proxy/userspace)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.proxy import (FakeIPTables, IPTablesProxier,
+                                  RoundRobinLoadBalancer, UserspaceProxier)
+from kubernetes_tpu.proxy.proxier import (KUBE_NODEPORTS_CHAIN,
+                                          KUBE_SERVICES_CHAIN, TABLE_NAT,
+                                          service_chain)
+
+
+def svc(name, cluster_ip, port=80, node_port=0, port_name="http"):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ServiceSpec(
+            cluster_ip=cluster_ip,
+            type="NodePort" if node_port else "ClusterIP",
+            ports=[api.ServicePort(name=port_name, port=port,
+                                   node_port=node_port)]))
+
+
+def eps(name, addrs, port=8080, port_name="http"):
+    return api.Endpoints(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip=ip) for ip in addrs],
+            ports=[api.EndpointPort(name=port_name, port=port)])])
+
+
+class TestIPTablesProxier:
+    def test_cluster_ip_rules(self):
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("web", "10.0.0.5")])
+        p.on_endpoints_update([eps("web", ["10.244.0.2", "10.244.0.3"])])
+
+        chain = service_chain("default", "web", "http")
+        jumps = ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        assert any("-d" in r and "10.0.0.5/32" in r and chain in r
+                   for r in jumps)
+        svc_rules = ipt.list_rules(TABLE_NAT, chain)
+        # two endpoints: one probability split + one unconditional jump
+        assert len(svc_rules) == 2
+        assert any("--probability" in r for r in svc_rules)
+        sep_chains = [c for c in ipt.list_chains(TABLE_NAT)
+                      if c.startswith("KUBE-SEP-")]
+        assert len(sep_chains) == 2
+        dnats = [r for c in sep_chains
+                 for r in ipt.list_rules(TABLE_NAT, c) if "DNAT" in r]
+        targets = {r[-1] for r in dnats}
+        assert targets == {"10.244.0.2:8080", "10.244.0.3:8080"}
+
+    def test_nodeport_rules(self):
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("np", "10.0.0.9", node_port=30080)])
+        p.on_endpoints_update([eps("np", ["10.244.1.1"])])
+        np_rules = ipt.list_rules(TABLE_NAT, KUBE_NODEPORTS_CHAIN)
+        assert any("30080" in r for r in np_rules)
+
+    def test_no_endpoints_rejects(self):
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("lonely", "10.0.0.7")])
+        chain = service_chain("default", "lonely", "http")
+        assert any("REJECT" in r for r in ipt.list_rules(TABLE_NAT, chain))
+
+    def test_deleted_service_chains_gc(self):
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("web", "10.0.0.5")])
+        p.on_endpoints_update([eps("web", ["10.244.0.2"])])
+        assert any(c.startswith("KUBE-SVC-")
+                   for c in ipt.list_chains(TABLE_NAT))
+        p.on_service_update([])
+        assert not any(c.startswith(("KUBE-SVC-", "KUBE-SEP-"))
+                       for c in ipt.list_chains(TABLE_NAT))
+
+    def test_headless_service_skipped(self):
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("hl", "None")])
+        assert ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN) == []
+
+    def test_watch_driven_sync(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt, client=client)
+        p.run()
+        try:
+            client.create("services", svc("live", "10.0.0.33"), "default")
+            client.create("endpoints", eps("live", ["10.244.9.9"]),
+                          "default")
+            deadline = time.time() + 10
+            chain = service_chain("default", "live", "http")
+            while time.time() < deadline:
+                if any(chain in r for r in
+                       ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN)):
+                    break
+                time.sleep(0.05)
+            assert any(chain in r for r in
+                       ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN))
+        finally:
+            p.stop()
+
+
+class TestRoundRobin:
+    def test_rotation(self):
+        lb = RoundRobinLoadBalancer()
+        lb.on_endpoints_update([eps("web", ["1.1.1.1", "2.2.2.2"])])
+        key = ("default", "web", "http")
+        picks = [lb.next_endpoint(key) for _ in range(4)]
+        assert picks == ["1.1.1.1:8080", "2.2.2.2:8080",
+                         "1.1.1.1:8080", "2.2.2.2:8080"]
+
+    def test_session_affinity(self):
+        lb = RoundRobinLoadBalancer()
+        lb.on_endpoints_update([eps("web", ["1.1.1.1", "2.2.2.2"])])
+        key = ("default", "web", "http")
+        lb.set_session_affinity(key, True)
+        first = lb.next_endpoint(key, client_ip="9.9.9.9")
+        for _ in range(3):
+            assert lb.next_endpoint(key, client_ip="9.9.9.9") == first
+
+    def test_no_endpoints(self):
+        lb = RoundRobinLoadBalancer()
+        assert lb.next_endpoint(("default", "x", "http")) is None
+
+
+def _echo_server(reply: bytes):
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            conn.recv(1024)
+            conn.sendall(reply)
+            conn.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+class TestUserspaceProxy:
+    def test_real_connections_round_robin(self):
+        srv_a, port_a = _echo_server(b"A")
+        srv_b, port_b = _echo_server(b"B")
+        try:
+            proxier = UserspaceProxier()
+            proxier.balancer.on_endpoints_update([api.Endpoints(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                subsets=[api.EndpointSubset(
+                    addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                    ports=[api.EndpointPort(name="http", port=port_a)]),
+                    api.EndpointSubset(
+                        addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                        ports=[api.EndpointPort(name="http",
+                                                port=port_b)])])])
+            proxier.on_service_update([svc("web", "10.0.0.5")])
+            port = proxier.port_for("default", "web", "http")
+            assert port
+
+            replies = []
+            for _ in range(4):
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=5) as c:
+                    c.sendall(b"hi")
+                    replies.append(c.recv(16))
+            assert set(replies) == {b"A", b"B"}  # balanced across both
+            proxier.stop()
+        finally:
+            srv_a.close()
+            srv_b.close()

@@ -1,0 +1,17 @@
+"""Cloud provider interface + fake.
+
+Reference: pkg/cloudprovider/cloud.go (Interface: Instances,
+LoadBalancers (TCPLoadBalancer at v1.1), Zones, Routes) and
+pkg/cloudprovider/providers/fake. Real cloud SDK providers (aws, gce,
+openstack, ...) are out of scope in a hermetic build; the interface +
+fake is what the service/route controllers and cloud volumes program
+against — the reference's own controllers are tested exactly this way.
+"""
+
+from .cloud import (CloudProvider, FakeCloudProvider, Instances,
+                    LoadBalancer, LoadBalancers, Route, Routes, Zone,
+                    Zones)
+
+__all__ = ["CloudProvider", "FakeCloudProvider", "Instances",
+           "LoadBalancer", "LoadBalancers", "Route", "Routes", "Zone",
+           "Zones"]

@@ -18,6 +18,7 @@ from .daemon import DaemonSetController
 from .deployment import DeploymentController
 from .podautoscaler import HorizontalController
 from .serviceaccount import ServiceAccountsController, TokensController
+from .service import RouteController, ServiceController
 from .manager import ControllerManager
 
 __all__ = [
@@ -27,5 +28,6 @@ __all__ = [
     "ResourceQuotaController", "PersistentVolumeClaimBinder",
     "JobController", "DaemonSetController", "DeploymentController",
     "HorizontalController", "ServiceAccountsController",
-    "TokensController", "ControllerManager",
+    "TokensController", "ServiceController", "RouteController",
+    "ControllerManager",
 ]

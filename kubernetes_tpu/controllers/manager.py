@@ -22,12 +22,13 @@ from .persistentvolume import PersistentVolumeClaimBinder
 from .podautoscaler import HorizontalController
 from .replication import ReplicationManager
 from .resourcequota import ResourceQuotaController
+from .service import RouteController, ServiceController
 from .serviceaccount import ServiceAccountsController, TokensController
 
 
 class ControllerManager:
     def __init__(self, client, metrics_source=None, recorder=None,
-                 pod_gc_threshold: int = 12500):
+                 pod_gc_threshold: int = 12500, cloud=None):
         self.controllers: List = [
             EndpointsController(client),
             ReplicationManager(client, recorder=recorder),
@@ -45,6 +46,9 @@ class ControllerManager:
         if metrics_source is not None:
             self.controllers.append(
                 HorizontalController(client, metrics_source))
+        if cloud is not None:
+            self.controllers.append(ServiceController(client, cloud))
+            self.controllers.append(RouteController(client, cloud))
 
     def run(self) -> "ControllerManager":
         for c in self.controllers:

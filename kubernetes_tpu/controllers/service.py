@@ -1,0 +1,162 @@
+"""Service (cloud load balancer) + route controllers.
+
+Reference: pkg/controller/servicecontroller.go — LoadBalancer-type
+services get a cloud LB spanning the cluster's nodes; deletes tear it
+down — and pkg/controller/routecontroller.go — one cloud route per node
+toward its pod CIDR. Both program the cloudprovider interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+from typing import List, Optional
+
+from ..cloudprovider import CloudProvider, Route
+from ..core import types as api
+
+SYNC_PERIOD = 10.0
+
+
+class ServiceController:
+    def __init__(self, client, cloud: CloudProvider,
+                 sync_period: float = SYNC_PERIOD):
+        self.client = client
+        self.cloud = cloud
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sync_once(self) -> int:
+        balancers = self.cloud.load_balancers()
+        zones = self.cloud.zones()
+        if balancers is None:
+            return 0
+        region = zones.get_zone().region if zones else ""
+        try:
+            services, _ = self.client.list("services")
+            nodes, _ = self.client.list("nodes")
+        except Exception:
+            return 0
+        hosts = sorted(n.metadata.name for n in nodes)
+        actions = 0
+        wanted = set()
+        for svc in services:
+            lb_name = f"a{svc.metadata.uid[:12]}" if svc.metadata.uid \
+                else f"{svc.metadata.namespace}-{svc.metadata.name}"
+            if svc.spec.type != "LoadBalancer":
+                continue
+            wanted.add(lb_name)
+            lb = balancers.get(lb_name, region)
+            ports = [p.port for p in svc.spec.ports]
+            if lb is None or lb.ports != ports or lb.hosts != hosts:
+                lb = balancers.ensure(lb_name, region, ports, hosts)
+                actions += 1
+            ingress = [lb.external_ip]
+            if svc.status.load_balancer_ingress != ingress:
+                try:
+                    self.client.update_status("services", replace(
+                        svc, status=api.ServiceStatus(
+                            load_balancer_ingress=ingress)),
+                        svc.metadata.namespace)
+                except Exception:
+                    pass
+        # tear down balancers whose service is gone or downgraded — via
+        # the interface's list(), not provider internals
+        try:
+            existing = balancers.list()
+        except NotImplementedError:
+            existing = []
+        for lb in existing:
+            if lb.name not in wanted:
+                balancers.delete(lb.name, lb.region)
+                actions += 1
+        return actions
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.sync_once()
+            self._stop.wait(self.sync_period)
+
+    def run(self) -> "ServiceController":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="service-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RouteController:
+    """(ref: routecontroller.go — reconcile node routes)"""
+
+    def __init__(self, client, cloud: CloudProvider,
+                 cluster_cidr: str = "10.244.0.0/16",
+                 sync_period: float = SYNC_PERIOD):
+        self.client = client
+        self.cloud = cloud
+        self.cluster_cidr = cluster_cidr
+        self.sync_period = sync_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _in_cluster_cidr(self, cidr: str) -> bool:
+        import ipaddress
+        try:
+            return ipaddress.ip_network(cidr).subnet_of(
+                ipaddress.ip_network(self.cluster_cidr))
+        except ValueError:
+            return False
+
+    def sync_once(self) -> int:
+        routes = self.cloud.routes()
+        if routes is None:
+            return 0
+        try:
+            nodes, _ = self.client.list("nodes")
+        except Exception:
+            return 0
+        existing = {r.name: r for r in routes.list_routes()}
+        actions = 0
+        wanted = set()
+        for node in nodes:
+            if not node.spec.pod_cidr:
+                # no CIDR assigned yet: nothing to route (the reference
+                # waits for the node controller's CIDR allocation)
+                continue
+            name = f"route-{node.metadata.name}"
+            wanted.add(name)
+            cidr = node.spec.pod_cidr
+            route = existing.get(name)
+            if route is None or route.destination_cidr != cidr:
+                routes.create_route(Route(
+                    name=name, target_instance=node.metadata.name,
+                    destination_cidr=cidr))
+                actions += 1
+        for name, route in existing.items():
+            # only GC routes INSIDE the cluster CIDR — operator routes
+            # are not ours to delete (routecontroller.go's filter)
+            if name not in wanted and \
+                    self._in_cluster_cidr(route.destination_cidr):
+                routes.delete_route(name)
+                actions += 1
+        return actions
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.sync_once()
+            self._stop.wait(self.sync_period)
+
+    def run(self) -> "RouteController":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="route-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -127,6 +127,25 @@ class SecretVolumeSource:
 
 
 @dataclass
+class DownwardAPIVolumeSource:
+    """(ref: pkg/api/types.go DownwardAPIVolumeSource; the plugin writes
+    the standard metadata field set)"""
+    items: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class GitRepoVolumeSource:
+    repository: str = ""
+    revision: str = ""
+
+
+@dataclass
 class Volume:
     name: str = ""
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
@@ -136,6 +155,9 @@ class Volume:
     host_path: Optional[HostPathVolumeSource] = None
     nfs: Optional[NFSVolumeSource] = None
     secret: Optional[SecretVolumeSource] = None
+    downward_api: Optional[DownwardAPIVolumeSource] = None
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    git_repo: Optional[GitRepoVolumeSource] = None
 
 
 # ---------------------------------------------------------------- containers
@@ -411,7 +433,9 @@ class ServiceSpec:
 
 @dataclass
 class ServiceStatus:
-    pass
+    # external IPs assigned by the cloud LB controller (the reference
+    # nests these under status.loadBalancer.ingress[].ip)
+    load_balancer_ingress: List[str] = field(default_factory=list)
 
 
 @dataclass

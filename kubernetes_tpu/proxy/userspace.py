@@ -42,8 +42,11 @@ class RoundRobinLoadBalancer:
             for eps in endpoints:
                 for subset in eps.subsets:
                     for port in subset.ports:
+                        # keyed by port NAME only ("" when unnamed, valid
+                        # for single-port services) — the service side
+                        # keys the same way, so unnamed ports resolve
                         key = (eps.metadata.namespace, eps.metadata.name,
-                               port.name or str(port.port))
+                               port.name or "")
                         fresh.setdefault(key, []).extend(
                             f"{a.ip}:{port.port}" for a in subset.addresses)
             self._endpoints = {k: sorted(set(v)) for k, v in fresh.items()}
@@ -126,11 +129,12 @@ class _PortProxy:
         except OSError:
             pass
         finally:
-            for s in (src, dst):
-                try:
-                    s.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
+            # propagate EOF as a half-close only: the reverse pump keeps
+            # relaying the response (classic request/shutdown protocols)
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
 
     def close(self) -> None:
         self._stop.set()
@@ -162,7 +166,7 @@ class UserspaceProxier:
         for svc in services:
             for port in svc.spec.ports:
                 key = (svc.metadata.namespace, svc.metadata.name,
-                       port.name or str(port.port))
+                       port.name or "")
                 wanted[key] = svc
                 self.balancer.set_session_affinity(
                     key, svc.spec.session_affinity == "ClientIP")
@@ -174,10 +178,10 @@ class UserspaceProxier:
                 if key not in self._proxies:
                     self._proxies[key] = _PortProxy(self.balancer, key)
 
-    def port_for(self, namespace: str, name: str, port_name: str
+    def port_for(self, namespace: str, name: str, port_name: str = ""
                  ) -> Optional[int]:
         with self._lock:
-            proxy = self._proxies.get((namespace, name, port_name))
+            proxy = self._proxies.get((namespace, name, port_name or ""))
             return proxy.port if proxy else None
 
     def run(self) -> "UserspaceProxier":

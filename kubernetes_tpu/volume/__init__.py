@@ -1,0 +1,16 @@
+"""Volume plugin framework.
+
+Reference: pkg/volume — plugins.go (VolumePlugin interface +
+VolumePluginMgr), volume.go (Builder/Cleaner), and the per-type plugins
+(empty_dir, host_path, secret, downwardapi, git_repo, nfs, gce_pd,
+aws_ebs, persistent_claim, ...). Local plugins (emptyDir, hostPath,
+secret, downwardAPI) are functional against a real filesystem root;
+network/cloud plugins (NFS, GCE PD, AWS EBS) are hollow mounts that
+record attach state through the cloudprovider, the kubemark stance.
+"""
+
+from .plugins import (Builder, Cleaner, VolumeHost, VolumePlugin,
+                      VolumePluginMgr, new_default_plugin_mgr)
+
+__all__ = ["Builder", "Cleaner", "VolumeHost", "VolumePlugin",
+           "VolumePluginMgr", "new_default_plugin_mgr"]

@@ -1,0 +1,424 @@
+"""VolumePlugin interface, manager, and the plugin set.
+
+Reference: pkg/volume/plugins.go (VolumePlugin, VolumePluginMgr
+InitPlugins/FindPluginBySpec) and pkg/volume/volume.go (Builder SetUp /
+GetPath, Cleaner TearDown). Pod volume directories follow the kubelet
+layout: <root>/pods/<uid>/volumes/<plugin>/<volume-name>.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Dict, List, Optional
+
+from ..core import types as api
+from ..core.errors import BadRequest
+
+
+class VolumeHost:
+    """What plugins get from their host (ref: plugins.go VolumeHost):
+    the kubelet root dir, an API client for secret fetch, and the cloud
+    provider for attach/detach."""
+
+    def __init__(self, root_dir: str, client=None, cloud=None):
+        self.root_dir = root_dir
+        self.client = client
+        self.cloud = cloud
+
+    def pod_volume_dir(self, pod_uid: str, plugin_name: str,
+                       volume_name: str) -> str:
+        safe_plugin = plugin_name.replace("/", "~")
+        return os.path.join(self.root_dir, "pods", pod_uid, "volumes",
+                            safe_plugin, volume_name)
+
+
+class Builder:
+    """(ref: volume.Builder — SetUp + GetPath)"""
+
+    def set_up(self) -> None:
+        raise NotImplementedError
+
+    def get_path(self) -> str:
+        raise NotImplementedError
+
+
+class Cleaner:
+    """(ref: volume.Cleaner — TearDown)"""
+
+    def tear_down(self) -> None:
+        raise NotImplementedError
+
+
+class VolumePlugin:
+    name = ""
+
+    def init(self, host: VolumeHost) -> None:
+        self.host = host
+
+    def can_support(self, volume: api.Volume) -> bool:
+        raise NotImplementedError
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        raise NotImplementedError
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        raise NotImplementedError
+
+    def new_cleaner_from_spec(self, volume: api.Volume,
+                              pod: api.Pod) -> Cleaner:
+        """Spec-aware teardown: plugins that delegate (persistent claims)
+        or hold external state (cloud disk attach) override this; the
+        default routes to the name/uid cleaner."""
+        return self.new_cleaner(volume.name, pod.metadata.uid)
+
+
+class _DirBuilder(Builder, Cleaner):
+    """Shared directory-backed builder/cleaner."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def set_up(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+
+    def get_path(self) -> str:
+        return self.path
+
+    def tear_down(self) -> None:
+        shutil.rmtree(self.path, ignore_errors=True)
+
+
+# ------------------------------------------------------------ local plugins
+
+class EmptyDirPlugin(VolumePlugin):
+    """(ref: pkg/volume/empty_dir)"""
+    name = "kubernetes.io/empty-dir"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.empty_dir is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod.metadata.uid, self.name, volume.name))
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+class _HostPathBuilder(Builder, Cleaner):
+    def __init__(self, path: str):
+        self.path = path
+
+    def set_up(self) -> None:
+        pass  # the path exists (or not) on the host; nothing to create
+
+    def get_path(self) -> str:
+        return self.path
+
+    def tear_down(self) -> None:
+        pass  # never delete host paths
+
+
+class HostPathPlugin(VolumePlugin):
+    """(ref: pkg/volume/host_path)"""
+    name = "kubernetes.io/host-path"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.host_path is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _HostPathBuilder(volume.host_path.path)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _HostPathBuilder("")
+
+
+class _SecretBuilder(_DirBuilder):
+    def __init__(self, path: str, plugin: "SecretPlugin",
+                 volume: api.Volume, pod: api.Pod):
+        super().__init__(path)
+        self.plugin = plugin
+        self.volume = volume
+        self.pod = pod
+
+    def set_up(self) -> None:
+        super().set_up()
+        client = self.plugin.host.client
+        if client is None:
+            raise BadRequest("secret volumes need an API client")
+        secret = client.get("secrets", self.volume.secret.secret_name,
+                            self.pod.metadata.namespace)
+        for key, value in secret.data.items():
+            with open(os.path.join(self.path, key), "w") as f:
+                f.write(value)
+
+
+class SecretPlugin(VolumePlugin):
+    """Materialize Secret data as files (ref: pkg/volume/secret)."""
+    name = "kubernetes.io/secret"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.secret is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _SecretBuilder(self.host.pod_volume_dir(
+            pod.metadata.uid, self.name, volume.name), self, volume, pod)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+class _DownwardAPIBuilder(_DirBuilder):
+    def __init__(self, path: str, pod: api.Pod):
+        super().__init__(path)
+        self.pod = pod
+
+    def set_up(self) -> None:
+        super().set_up()
+        meta = {
+            "metadata.name": self.pod.metadata.name,
+            "metadata.namespace": self.pod.metadata.namespace,
+            "metadata.labels": json.dumps(self.pod.metadata.labels),
+            "metadata.annotations": json.dumps(
+                self.pod.metadata.annotations),
+        }
+        for key, value in meta.items():
+            with open(os.path.join(self.path, key), "w") as f:
+                f.write(value)
+
+
+class DownwardAPIPlugin(VolumePlugin):
+    """Pod metadata as files (ref: pkg/volume/downwardapi)."""
+    name = "kubernetes.io/downward-api"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return getattr(volume, "downward_api", None) is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _DownwardAPIBuilder(self.host.pod_volume_dir(
+            pod.metadata.uid, self.name, volume.name), pod)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+# ---------------------------------------------------- network/cloud plugins
+
+class _AttachingBuilder(_DirBuilder):
+    """Hollow network mount: the directory is created and a `.mounted`
+    marker records the source; cloud disks attach via the provider and
+    detach on teardown."""
+
+    def __init__(self, path: str, source: str, plugin: VolumePlugin,
+                 attach: Optional[tuple] = None):
+        super().__init__(path)
+        self.source = source
+        self.plugin = plugin
+        self.attach = attach  # (disk_name, node) -> cloud attach call
+
+    def set_up(self) -> None:
+        cloud = getattr(self.plugin.host, "cloud", None)
+        if self.attach is not None and cloud is not None:
+            cloud.attach_disk(self.attach[0], self.attach[1])
+        super().set_up()
+        with open(os.path.join(self.path, ".mounted"), "w") as f:
+            f.write(self.source)
+
+    def tear_down(self) -> None:
+        super().tear_down()
+        cloud = getattr(self.plugin.host, "cloud", None)
+        if self.attach is not None and cloud is not None:
+            cloud.detach_disk(self.attach[0], self.attach[1])
+
+
+class NFSPlugin(VolumePlugin):
+    """(ref: pkg/volume/nfs — hollow mount)"""
+    name = "kubernetes.io/nfs"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.nfs is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _AttachingBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            f"{volume.nfs.server}:{volume.nfs.path}", self)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+class GCEPDPlugin(VolumePlugin):
+    """(ref: pkg/volume/gce_pd — attach via cloudprovider, hollow mount)"""
+    name = "kubernetes.io/gce-pd"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.gce_persistent_disk is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        pd = volume.gce_persistent_disk
+        return _AttachingBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            f"gce-pd://{pd.pd_name}", self,
+            attach=(pd.pd_name, pod.spec.node_name))
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+    def new_cleaner_from_spec(self, volume: api.Volume,
+                              pod: api.Pod) -> Cleaner:
+        # spec-aware teardown detaches the disk too
+        return self.new_builder(volume, pod)
+
+
+class AWSEBSPlugin(VolumePlugin):
+    """(ref: pkg/volume/aws_ebs)"""
+    name = "kubernetes.io/aws-ebs"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.aws_elastic_block_store is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        ebs = volume.aws_elastic_block_store
+        return _AttachingBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            f"aws-ebs://{ebs.volume_id}", self,
+            attach=(ebs.volume_id, pod.spec.node_name))
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+    def new_cleaner_from_spec(self, volume: api.Volume,
+                              pod: api.Pod) -> Cleaner:
+        return self.new_builder(volume, pod)
+
+
+class PersistentClaimPlugin(VolumePlugin):
+    """Resolve claim -> bound PV -> the underlying plugin
+    (ref: pkg/volume/persistent_claim)."""
+    name = "kubernetes.io/persistent-claim"
+
+    def __init__(self, mgr: "VolumePluginMgr"):
+        self.mgr = mgr
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return getattr(volume, "persistent_volume_claim", None) is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        client = self.host.client
+        if client is None:
+            raise BadRequest("persistent claims need an API client")
+        claim = client.get("persistentvolumeclaims",
+                           volume.persistent_volume_claim.claim_name,
+                           pod.metadata.namespace)
+        if not claim.spec.volume_name:
+            raise BadRequest(
+                f"claim {claim.metadata.name!r} is not bound yet")
+        pv = client.get("persistentvolumes", claim.spec.volume_name)
+        translated = _volume_from_pv(volume.name, pv)
+        plugin = self.mgr.find_plugin(translated)
+        return plugin.new_builder(translated, pod)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+    def new_cleaner_from_spec(self, volume: api.Volume,
+                              pod: api.Pod) -> Cleaner:
+        # teardown must clean what the UNDERLYING plugin set up (the
+        # builder delegated; a cleaner under this plugin's own dir would
+        # leak the real mount)
+        client = self.host.client
+        try:
+            claim = client.get("persistentvolumeclaims",
+                               volume.persistent_volume_claim.claim_name,
+                               pod.metadata.namespace)
+            pv = client.get("persistentvolumes", claim.spec.volume_name)
+            translated = _volume_from_pv(volume.name, pv)
+            return self.mgr.find_plugin(translated).new_cleaner_from_spec(
+                translated, pod)
+        except Exception:
+            # claim/PV gone: fall back to this plugin's (empty) dir
+            return self.new_cleaner(volume.name, pod.metadata.uid)
+
+
+def _volume_from_pv(name: str, pv: api.PersistentVolume) -> api.Volume:
+    if pv.spec.host_path is not None:
+        return api.Volume(name=name, host_path=pv.spec.host_path)
+    if pv.spec.nfs is not None:
+        return api.Volume(name=name, nfs=pv.spec.nfs)
+    if pv.spec.gce_persistent_disk is not None:
+        return api.Volume(name=name,
+                          gce_persistent_disk=pv.spec.gce_persistent_disk)
+    if pv.spec.aws_elastic_block_store is not None:
+        return api.Volume(
+            name=name,
+            aws_elastic_block_store=pv.spec.aws_elastic_block_store)
+    raise BadRequest(f"PV {pv.metadata.name!r} has no supported source")
+
+
+# ------------------------------------------------------------------ manager
+
+class VolumePluginMgr:
+    """(ref: plugins.go VolumePluginMgr — InitPlugins + FindPluginBySpec)"""
+
+    def __init__(self, plugins: List[VolumePlugin], host: VolumeHost):
+        self.plugins = list(plugins)
+        for plugin in self.plugins:
+            plugin.init(host)
+
+    def find_plugin(self, volume: api.Volume) -> VolumePlugin:
+        matches = [p for p in self.plugins if p.can_support(volume)]
+        if not matches:
+            raise BadRequest(
+                f"no volume plugin supports volume {volume.name!r}")
+        if len(matches) > 1:
+            raise BadRequest(
+                f"multiple plugins match volume {volume.name!r}")
+        return matches[0]
+
+    def find_plugin_by_name(self, name: str) -> VolumePlugin:
+        for plugin in self.plugins:
+            if plugin.name == name:
+                return plugin
+        raise BadRequest(f"no volume plugin named {name!r}")
+
+    def set_up_pod_volumes(self, pod: api.Pod) -> Dict[str, str]:
+        """Mount every pod volume; -> volume name -> path
+        (the kubelet's mountExternalVolumes role)."""
+        out: Dict[str, str] = {}
+        for volume in pod.spec.volumes:
+            builder = self.find_plugin(volume).new_builder(volume, pod)
+            builder.set_up()
+            out[volume.name] = builder.get_path()
+        return out
+
+    def tear_down_pod_volumes(self, pod: api.Pod) -> None:
+        for volume in pod.spec.volumes:
+            plugin = self.find_plugin(volume)
+            plugin.new_cleaner_from_spec(volume, pod).tear_down()
+
+
+def new_default_plugin_mgr(host: VolumeHost) -> VolumePluginMgr:
+    """The probed-plugin set (cmd/kubelet volume plugin registration)."""
+    mgr = VolumePluginMgr([], host)
+    plugins: List[VolumePlugin] = [
+        EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(),
+        DownwardAPIPlugin(), NFSPlugin(), GCEPDPlugin(), AWSEBSPlugin(),
+    ]
+    claim_plugin = PersistentClaimPlugin(mgr)
+    plugins.append(claim_plugin)
+    for plugin in plugins:
+        plugin.init(host)
+    mgr.plugins = plugins
+    return mgr

@@ -1,0 +1,183 @@
+"""Volume plugin framework + cloudprovider + cloud LB/route controllers
+(ref: pkg/volume, pkg/cloudprovider, pkg/controller/servicecontroller.go,
+routecontroller.go)."""
+
+import os
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.cloudprovider import FakeCloudProvider
+from kubernetes_tpu.controllers import RouteController, ServiceController
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import BadRequest
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.volume import VolumeHost, new_default_plugin_mgr
+
+
+def mkpod(name="p", uid="uid-1", volumes=None, node="n1"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid,
+                                labels={"app": "web"}),
+        spec=api.PodSpec(node_name=node, volumes=volumes or [],
+                         containers=[api.Container(name="c", image="i")]))
+
+
+@pytest.fixture()
+def host(tmp_path):
+    registry = Registry()
+    client = InProcClient(registry)
+    cloud = FakeCloudProvider()
+    return (VolumeHost(str(tmp_path), client=client, cloud=cloud),
+            registry, client, cloud)
+
+
+class TestVolumePlugins:
+    def test_empty_dir_lifecycle(self, host):
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="scratch", empty_dir=api.EmptyDirVolumeSource())])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert os.path.isdir(paths["scratch"])
+        assert "uid-1" in paths["scratch"]
+        mgr.tear_down_pod_volumes(pod)
+        assert not os.path.exists(paths["scratch"])
+
+    def test_host_path_passthrough(self, host, tmp_path):
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        target = tmp_path / "hostdata"
+        target.mkdir()
+        pod = mkpod(volumes=[api.Volume(
+            name="hp", host_path=api.HostPathVolumeSource(
+                path=str(target)))])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert paths["hp"] == str(target)
+        mgr.tear_down_pod_volumes(pod)
+        assert target.exists()  # host paths are never deleted
+
+    def test_secret_materialized(self, host):
+        vh, registry, client, _ = host
+        client.create("secrets", api.Secret(
+            metadata=api.ObjectMeta(name="creds", namespace="default"),
+            data={"user": "alice", "pass": "s3cret"}), "default")
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="creds", secret=api.SecretVolumeSource(
+                secret_name="creds"))])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert open(os.path.join(paths["creds"], "user")).read() == "alice"
+        assert open(os.path.join(paths["creds"], "pass")).read() == "s3cret"
+
+    def test_downward_api(self, host):
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="meta", downward_api=api.DownwardAPIVolumeSource())])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert open(os.path.join(
+            paths["meta"], "metadata.name")).read() == "p"
+        assert "web" in open(os.path.join(
+            paths["meta"], "metadata.labels")).read()
+
+    def test_gce_pd_attaches_and_detaches_via_cloud(self, host):
+        vh, _, _, cloud = host
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="disk", gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+                pd_name="data-disk"))])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert cloud.attached == {"data-disk": "n1"}
+        assert open(os.path.join(
+            paths["disk"], ".mounted")).read() == "gce-pd://data-disk"
+        mgr.tear_down_pod_volumes(pod)
+        assert cloud.attached == {}  # disk released for the next node
+
+    def test_persistent_claim_resolves_to_pv(self, host):
+        vh, registry, client, _ = host
+        registry.create("persistentvolumes", api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv1"),
+            spec=api.PersistentVolumeSpec(
+                capacity={"storage": parse_quantity("1Gi")},
+                host_path=api.HostPathVolumeSource(path="/tmp/pv-data"))))
+        claim = api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="c1", namespace="default"),
+            spec=api.PersistentVolumeClaimSpec(volume_name="pv1"))
+        registry.create("persistentvolumeclaims", claim)
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="data",
+            persistent_volume_claim=api.PersistentVolumeClaimVolumeSource(
+                claim_name="c1"))])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert paths["data"] == "/tmp/pv-data"
+
+    def test_unsupported_volume_rejected(self, host):
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(name="weird")])
+        with pytest.raises(BadRequest):
+            mgr.set_up_pod_volumes(pod)
+
+
+class TestCloudControllers:
+    def test_service_controller_provisions_lb(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1")))
+        svc = client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 selector={"app": "web"},
+                                 ports=[api.ServicePort(name="http",
+                                                        port=80)])),
+            "default")
+        ctrl = ServiceController(client, cloud)
+        assert ctrl.sync_once() >= 1
+        fresh = client.get("services", "web", "default")
+        assert fresh.status.load_balancer_ingress
+        ip = fresh.status.load_balancer_ingress[0]
+        assert ip.startswith("35.0.0.")
+        lb = list(cloud.balancers.values())[0]
+        assert lb.ports == [80] and lb.hosts == ["n1"]
+
+        # new node joins the pool
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n2")))
+        ctrl.sync_once()
+        assert list(cloud.balancers.values())[0].hosts == ["n1", "n2"]
+
+        # delete -> LB torn down
+        client.delete("services", "web", "default")
+        ctrl.sync_once()
+        assert cloud.balancers == {}
+
+    def test_route_controller(self):
+        from kubernetes_tpu.cloudprovider import Route
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            spec=api.NodeSpec(pod_cidr="10.244.1.0/24")))
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n2"),
+            spec=api.NodeSpec(pod_cidr="10.244.2.0/24")))
+        # CIDR-less nodes are skipped; operator routes outside the
+        # cluster CIDR are never GC'd
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n3")))
+        cloud.create_route(Route(name="corp-vpn", target_instance="gw",
+                                 destination_cidr="192.168.0.0/16"))
+        ctrl = RouteController(client, cloud)
+        assert ctrl.sync_once() == 2
+        routes = {r.name: r for r in cloud.list_routes()}
+        assert routes["route-n1"].destination_cidr == "10.244.1.0/24"
+        assert routes["route-n1"].target_instance == "n1"
+        assert "route-n3" not in routes
+        client.delete("nodes", "n2")
+        ctrl.sync_once()
+        assert set(r.name for r in cloud.list_routes()) == {"route-n1",
+                                                            "corp-vpn"}

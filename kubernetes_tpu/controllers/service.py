@@ -17,6 +17,20 @@ from ..core import types as api
 
 SYNC_PERIOD = 10.0
 
+# LB names derive from the service UID (the reference's cloudprovider
+# naming, e.g. GCE's "a<uid>"): "a" + first 12 uid chars
+_LB_NAME_LEN = 13
+
+
+def _lb_name(svc: api.Service) -> str:
+    if svc.metadata.uid:
+        return f"a{svc.metadata.uid[:12]}"
+    return f"a{svc.metadata.namespace}-{svc.metadata.name}"[:_LB_NAME_LEN]
+
+
+def _is_owned_lb_name(name: str) -> bool:
+    return len(name) == _LB_NAME_LEN and name.startswith("a")
+
 
 class ServiceController:
     def __init__(self, client, cloud: CloudProvider,
@@ -42,9 +56,18 @@ class ServiceController:
         actions = 0
         wanted = set()
         for svc in services:
-            lb_name = f"a{svc.metadata.uid[:12]}" if svc.metadata.uid \
-                else f"{svc.metadata.namespace}-{svc.metadata.name}"
+            lb_name = _lb_name(svc)
             if svc.spec.type != "LoadBalancer":
+                if svc.status.load_balancer_ingress:
+                    # downgraded from LoadBalancer: the GC below removes
+                    # the cloud LB; the stale external IP must go too
+                    try:
+                        self.client.update_status("services", replace(
+                            svc, status=api.ServiceStatus()),
+                            svc.metadata.namespace)
+                        actions += 1
+                    except Exception:
+                        pass
                 continue
             wanted.add(lb_name)
             lb = balancers.get(lb_name, region)
@@ -62,13 +85,15 @@ class ServiceController:
                 except Exception:
                     pass
         # tear down balancers whose service is gone or downgraded — via
-        # the interface's list(), not provider internals
+        # the interface's list(), and ONLY balancers carrying this
+        # controller's naming convention: LBs we never created (operators,
+        # other clusters on the same provider) are not ours to delete
         try:
             existing = balancers.list()
         except NotImplementedError:
             existing = []
         for lb in existing:
-            if lb.name not in wanted:
+            if lb.name not in wanted and _is_owned_lb_name(lb.name):
                 balancers.delete(lb.name, lb.region)
                 actions += 1
         return actions
@@ -132,6 +157,10 @@ class RouteController:
             cidr = node.spec.pod_cidr
             route = existing.get(name)
             if route is None or route.destination_cidr != cidr:
+                if route is not None:
+                    # CIDR reassigned: drop the stale route first — the
+                    # Routes contract doesn't promise overwrite-by-name
+                    routes.delete_route(name)
                 routes.create_route(Route(
                     name=name, target_instance=node.metadata.name,
                     destination_cidr=cidr))

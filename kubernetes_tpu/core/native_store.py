@@ -1,0 +1,411 @@
+"""ctypes binding for the native C++ store (native/kvstore.cc).
+
+Drop-in replacement for core.store.Store: same verbs, same CAS/TTL/watch
+semantics, same exceptions. Objects are serialized through the Scheme
+codec at the boundary — exactly the role runtime.Codec plays between the
+reference's registry and etcd (etcd_helper.go stores JSON); the stored
+resourceVersion is stamped from the native revision on the way out, and
+a bounded (key, rev) decode cache plays the watch cache's decoded-object
+role so repeated reads don't re-parse.
+
+When to use which backend: the pure-Python Store keeps live objects and
+skips serialization entirely, so for a single-process control plane it
+is the faster default. NativeStore is the etcd-analogue backend — state
+lives outside the Python heap behind a serialization boundary (the cost
+profile the reference's apiserver actually has), store operations run
+GIL-free under a native mutex, and kv_wait parks watcher threads in
+native code. Pick it when fidelity to the external-store architecture
+matters more than in-proc throughput, or as the base for a future
+multi-process / shared-memory deployment.
+
+The shared library is compiled on first use (g++ -O2 -shared) and cached
+next to the source; a missing toolchain raises ImportError so callers
+can fall back to the pure-Python Store (native_available() probes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from . import watch as watchpkg
+from .errors import AlreadyExists, Conflict, Expired, NotFound
+from .scheme import Scheme, default_scheme
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "kvstore.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libkvstore.so")
+
+ERR_NOT_FOUND = -1
+ERR_EXISTS = -2
+ERR_CONFLICT = -3
+ERR_TOO_SMALL = -4
+ERR_EXPIRED = -5
+
+_EVENT_TYPES = {0: watchpkg.ADDED, 1: watchpkg.MODIFIED, 2: watchpkg.DELETED}
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     _SRC, "-o", _LIB + ".tmp"],
+                    check=True, capture_output=True)
+                os.replace(_LIB + ".tmp", _LIB)
+            except (OSError, subprocess.CalledProcessError) as e:
+                raise ImportError(f"cannot build native store: {e}")
+        lib = ctypes.CDLL(_LIB)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_uint64]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_current_rev.restype = ctypes.c_uint64
+        lib.kv_current_rev.argtypes = [ctypes.c_void_p]
+        lib.kv_oldest_rev.restype = ctypes.c_uint64
+        lib.kv_oldest_rev.argtypes = [ctypes.c_void_p]
+        for fn in (lib.kv_create, lib.kv_set):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_char_p, ctypes.c_uint64,
+                           ctypes.c_double]
+        lib.kv_update.restype = ctypes.c_int64
+        lib.kv_update.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_uint64]
+        lib.kv_delete.restype = ctypes.c_int64
+        lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64]
+        lib.kv_get.restype = ctypes.c_int64
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_uint64)]
+        lib.kv_list.restype = ctypes.c_int64
+        lib.kv_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_int64]
+        lib.kv_batch.restype = ctypes.c_int64
+        lib.kv_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.kv_events.restype = ctypes.c_int64
+        lib.kv_events.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                  ctypes.c_char_p, ctypes.c_char_p,
+                                  ctypes.c_int64]
+        lib.kv_wait.restype = ctypes.c_uint64
+        lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_double]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load_library()
+        return True
+    except ImportError:
+        return False
+
+
+class NativeStore:
+    """core.store.Store API over the C++ engine."""
+
+    def __init__(self, window: int = 100_000,
+                 scheme: Scheme = default_scheme,
+                 decode_cache_size: int = 200_000):
+        self._lib = _load_library()
+        self._h = self._lib.kv_open(window)
+        self.scheme = scheme
+        self._watch_threads: List[threading.Thread] = []
+        self._closed = False
+        # (key, rev) -> decoded object. Plays the watch cache's decoded-
+        # object role in front of "etcd" (cacher.go): objects are frozen
+        # by the store contract, so sharing decoded instances is safe —
+        # and writers hand their object over, so writes cache without
+        # ever decoding.
+        from collections import OrderedDict
+        self._decoded: "OrderedDict[Tuple[str, int], Any]" = OrderedDict()
+        self._decoded_cap = decode_cache_size
+        self._cache_lock = threading.Lock()
+
+    def __del__(self):
+        try:
+            if not self._closed and self._h:
+                self._closed = True
+                self._lib.kv_close(self._h)
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- serde
+
+    def _encode(self, obj: Any) -> bytes:
+        return self.scheme.encode(obj).encode()
+
+    def _stamp(self, obj: Any, rev: int) -> Any:
+        from dataclasses import replace
+        return replace(obj, metadata=replace(obj.metadata,
+                                             resource_version=str(rev)))
+
+    def _cache_put(self, key: str, rev: int, obj: Any) -> None:
+        with self._cache_lock:
+            self._decoded[(key, rev)] = obj
+            while len(self._decoded) > self._decoded_cap:
+                self._decoded.popitem(last=False)
+
+    def _decode(self, raw: bytes, rev: int, key: str = "") -> Any:
+        if key:
+            with self._cache_lock:
+                hit = self._decoded.get((key, rev))
+            if hit is not None:
+                return hit
+        obj = self._stamp(self.scheme.decode(raw.decode()), rev)
+        if key:
+            self._cache_put(key, rev, obj)
+        return obj
+
+    @staticmethod
+    def _key_name(key: str) -> Tuple[str, str]:
+        kind = key.split("/")[2] if key.count("/") >= 2 else ""
+        return kind, key.rsplit("/", 1)[-1]
+
+    # --------------------------------------------------------- verbs
+
+    @property
+    def current_revision(self) -> int:
+        return int(self._lib.kv_current_rev(self._h))
+
+    def create(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        raw = self._encode(obj)
+        rev = self._lib.kv_create(self._h, key.encode(), raw, len(raw),
+                                  float(ttl or 0))
+        if rev == ERR_EXISTS:
+            kind, name = self._key_name(key)
+            raise AlreadyExists(kind=kind, name=name)
+        out = self._stamp(obj, rev)
+        self._cache_put(key, rev, out)
+        return out
+
+    def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        raw = self._encode(obj)
+        rev = self._lib.kv_set(self._h, key.encode(), raw, len(raw),
+                               float(ttl or 0))
+        out = self._stamp(obj, rev)
+        self._cache_put(key, rev, out)
+        return out
+
+    def update(self, key: str, obj: Any) -> Any:
+        raw = self._encode(obj)
+        rv = obj.metadata.resource_version
+        expect = int(rv) if rv else 0
+        rev = self._lib.kv_update(self._h, key.encode(), raw, len(raw),
+                                  expect)
+        if rev == ERR_NOT_FOUND:
+            raise NotFound(name=key)
+        if rev == ERR_CONFLICT:
+            raise Conflict(
+                f"operation on {key} failed: object was modified")
+        out = self._stamp(obj, rev)
+        self._cache_put(key, rev, out)
+        return out
+
+    def guaranteed_update(self, key: str, fn: Callable[[Any], Any],
+                          retries: int = 10) -> Any:
+        for _ in range(retries):
+            raw, mod_rev = self._get_raw(key)
+            new_obj = fn(self._decode(raw, mod_rev, key))
+            new_raw = self._encode(new_obj)
+            rev = self._lib.kv_update(self._h, key.encode(), new_raw,
+                                      len(new_raw), mod_rev)
+            if rev == ERR_CONFLICT:
+                continue  # raced: re-read and retry (etcd_helper.go:449)
+            if rev == ERR_NOT_FOUND:
+                raise NotFound(name=key)
+            out = self._stamp(new_obj, rev)
+            self._cache_put(key, rev, out)
+            return out
+        raise Conflict(f"guaranteed_update on {key}: too many retries")
+
+    def delete(self, key: str, expect_rv: Optional[str] = None) -> Any:
+        while True:
+            raw, mod_rev = self._get_raw(key)
+            if expect_rv and int(expect_rv) != mod_rev:
+                raise Conflict(f"delete {key}: revision mismatch")
+            rev = self._lib.kv_delete(self._h, key.encode(),
+                                      mod_rev if not expect_rv
+                                      else int(expect_rv))
+            if rev == ERR_NOT_FOUND:
+                raise NotFound(name=key)
+            if rev == ERR_CONFLICT:
+                continue  # raced an unconditional delete: re-read
+            return self._decode(raw, mod_rev, key)
+
+    def batch(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
+              ) -> List[Any]:
+        ops = list(ops)
+        for _ in range(10):
+            staged: List[Tuple[str, Any, bytes, int]] = []
+            for key, fn in ops:
+                raw, mod_rev = self._get_raw(key)
+                new_obj = fn(self._decode(raw, mod_rev, key))
+                staged.append((key, new_obj, self._encode(new_obj),
+                               mod_rev))
+            n = len(staged)
+            keys = (ctypes.c_char_p * n)(
+                *[s[0].encode() for s in staged])
+            vals = (ctypes.c_char_p * n)(*[s[2] for s in staged])
+            val_lens = (ctypes.c_uint64 * n)(
+                *[len(s[2]) for s in staged])
+            expects = (ctypes.c_uint64 * n)(*[s[3] for s in staged])
+            first = self._lib.kv_batch(self._h, n, keys, vals, val_lens,
+                                       expects)
+            if first == ERR_CONFLICT:
+                continue  # some key raced: restage the whole tile
+            if first == ERR_NOT_FOUND:
+                raise NotFound(name="batch")
+            out = []
+            for i, (key, new_obj, _raw, _mr) in enumerate(staged):
+                stamped = self._stamp(new_obj, first + i)
+                self._cache_put(key, first + i, stamped)
+                out.append(stamped)
+            return out
+        raise Conflict("batch: too many retries")
+
+    # --------------------------------------------------------- reads
+
+    def _get_raw(self, key: str, initial: int = 1 << 16) -> Tuple[bytes, int]:
+        size = initial
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            mod_rev = ctypes.c_uint64()
+            n = self._lib.kv_get(self._h, key.encode(), buf, size,
+                                 ctypes.byref(mod_rev))
+            if n == ERR_NOT_FOUND:
+                raise NotFound(name=key)
+            if n == ERR_TOO_SMALL:
+                size *= 4
+                continue
+            return buf.raw[:n], int(mod_rev.value)
+
+    def get(self, key: str) -> Any:
+        raw, mod_rev = self._get_raw(key)
+        return self._decode(raw, mod_rev, key)
+
+    def list(self, prefix: str,
+             predicate: Optional[Callable[[Any], bool]] = None
+             ) -> Tuple[List[Any], int]:
+        size = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.kv_list(self._h, prefix.encode(), buf, size)
+            if n < 0:
+                size = -n + 64
+                continue
+            break
+        data = buf.raw[:n]
+        store_rev, count = struct.unpack_from("<QI", data, 0)
+        pos = 12
+        items = []
+        for _ in range(count):
+            (obj_rev,) = struct.unpack_from("<Q", data, pos)
+            pos += 8
+            (klen,) = struct.unpack_from("<I", data, pos)
+            pos += 4 + klen
+            k = data[pos - klen:pos].decode()
+            (vlen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            items.append(self._decode(data[pos:pos + vlen], obj_rev, k))
+            pos += vlen
+        if predicate is not None:
+            items = [o for o in items if predicate(o)]
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return items, int(store_rev)
+
+    # --------------------------------------------------------- watch
+
+    def _events_since(self, since_rev: int, prefix: str
+                      ) -> List[Tuple[int, str, Any]]:
+        size = 1 << 20
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            n = self._lib.kv_events(self._h, since_rev, prefix.encode(),
+                                    buf, size)
+            if n == ERR_EXPIRED:
+                raise Expired(
+                    f"resourceVersion {since_rev} is too old")
+            if n < 0:
+                size = -n + 64
+                continue
+            break
+        data = buf.raw[:n]
+        (count,) = struct.unpack_from("<I", data, 0)
+        pos = 4
+        out = []
+        for _ in range(count):
+            (rev,) = struct.unpack_from("<Q", data, pos)
+            pos += 8
+            etype = _EVENT_TYPES[data[pos]]
+            pos += 1
+            (klen,) = struct.unpack_from("<I", data, pos)
+            pos += 4 + klen
+            k = data[pos - klen:pos].decode()
+            (obj_rev,) = struct.unpack_from("<Q", data, pos)
+            pos += 8
+            (vlen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            out.append((rev, etype,
+                        self._decode(data[pos:pos + vlen], obj_rev, k)))
+            pos += vlen
+        return out
+
+    def watch(self, prefix: str, since_rev: Optional[int] = None,
+              capacity: int = 100_000) -> watchpkg.Watcher:
+        start_rev = (self.current_revision if since_rev is None
+                     else since_rev)
+        replay = self._events_since(start_rev, prefix)  # raises Expired
+        w = watchpkg.Watcher(max(capacity, len(replay) + 16))
+        last = start_rev
+        for rev, etype, obj in replay:
+            w.send(watchpkg.Event(etype, obj))
+            last = rev
+
+        def pump(last_rev: int) -> None:
+            while not w.stopped:
+                # kv_wait parks in native code (GIL released)
+                self._lib.kv_wait(self._h, last_rev, 0.5)
+                if w.stopped or self._closed:
+                    return
+                try:
+                    events = self._events_since(last_rev, prefix)
+                except Expired:
+                    w.send(watchpkg.Event(
+                        watchpkg.ERROR,
+                        Expired("watch window overrun")))
+                    w.stop()
+                    return
+                for rev, etype, obj in events:
+                    if not w.send(watchpkg.Event(etype, obj)):
+                        w.stop()
+                        return
+                    last_rev = rev
+
+        t = threading.Thread(target=pump, args=(last,), daemon=True,
+                             name="native-store-watch")
+        t.start()
+        self._watch_threads.append(t)
+        return w

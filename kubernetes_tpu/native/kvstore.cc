@@ -1,0 +1,339 @@
+// Native revisioned KV store with CAS and a windowed watch history.
+//
+// This is the framework's etcd: where the reference runs etcd as an external
+// native (Go) process speaking CompareAndSwap + watch
+// (pkg/storage/etcd/etcd_helper.go), this library provides the same
+// semantics in-process behind a C ABI consumed via ctypes
+// (core/native_store.py). The contract mirrors core/store.py exactly:
+// monotonic revision counter doubling as resourceVersion, CAS on update and
+// delete, lazy TTL expiry emitting DELETED events, an all-or-nothing batch
+// commit, and a bounded event history with an oldest-replayable revision
+// (the watch-cache window, pkg/storage/cacher.go:109).
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC kvstore.cc -o libkvstore.so
+//
+// Error codes (negative returns): -1 not found, -2 already exists,
+// -3 conflict, -4 buffer too small (get only; list/events return the
+// negative REQUIRED size so the caller allocates exactly once), -5 expired
+// (watch window no longer covers since_rev). Buffer-too-small results from
+// list/events below -5 are distinguished by magnitude (sizes > 5).
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t ERR_NOT_FOUND = -1;
+constexpr int64_t ERR_EXISTS = -2;
+constexpr int64_t ERR_CONFLICT = -3;
+constexpr int64_t ERR_TOO_SMALL = -4;
+constexpr int64_t ERR_EXPIRED = -5;
+
+double now_seconds() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Entry {
+  std::string value;
+  uint64_t mod_rev = 0;
+  double expiry = 0;  // 0 = no TTL
+};
+
+enum class EventType : uint8_t { Added = 0, Modified = 1, Deleted = 2 };
+
+struct Event {
+  uint64_t rev;       // revision at which the event happened
+  EventType type;
+  std::string key;
+  uint64_t obj_rev;   // resourceVersion to stamp on the delivered object
+  std::string value;
+};
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t rev = 0;
+  uint64_t oldest_rev = 0;  // history no longer replays revs <= this... see emit
+  size_t window;
+  std::map<std::string, Entry> data;  // ordered: list output is sorted
+  std::deque<Event> history;
+
+  explicit Store(size_t window_size) : window(window_size) {}
+
+  uint64_t bump() { return ++rev; }
+
+  void emit(uint64_t r, EventType t, const std::string& key,
+            uint64_t obj_rev, const std::string& value) {
+    if (history.size() == window) {
+      oldest_rev = history.front().rev;
+      history.pop_front();
+    }
+    history.push_back(Event{r, t, key, obj_rev, value});
+    cv.notify_all();
+  }
+
+  bool expired(const Entry& e, double now) const {
+    return e.expiry != 0 && e.expiry <= now;
+  }
+
+  // Lazy TTL GC, mirroring core/store.py _gc_expired: expired entries are
+  // deleted and emit DELETED carrying the stale object.
+  void gc(double now) {
+    std::vector<std::string> dead;
+    for (auto& [k, e] : data) {
+      if (expired(e, now)) dead.push_back(k);
+    }
+    for (auto& k : dead) {
+      Entry e = data[k];
+      data.erase(k);
+      emit(bump(), EventType::Deleted, k, e.mod_rev, e.value);
+    }
+  }
+};
+
+// Serialize records into caller buffers.
+// Event record:  u64 rev | u8 type | u32 klen | key | u64 obj_rev |
+//                u32 vlen | value
+// List record:   u64 obj_rev | u32 klen | key | u32 vlen | value
+class Writer {
+ public:
+  Writer(uint8_t* buf, int64_t cap) : buf_(buf), cap_(cap) {}
+
+  template <typename T>
+  void put(T v) {
+    if (pos_ + static_cast<int64_t>(sizeof(T)) <= cap_ && buf_) {
+      std::memcpy(buf_ + pos_, &v, sizeof(T));
+    }
+    pos_ += sizeof(T);
+  }
+
+  void put_bytes(const std::string& s) {
+    put<uint32_t>(static_cast<uint32_t>(s.size()));
+    if (pos_ + static_cast<int64_t>(s.size()) <= cap_ && buf_) {
+      std::memcpy(buf_ + pos_, s.data(), s.size());
+    }
+    pos_ += s.size();
+  }
+
+  bool fits() const { return pos_ <= cap_; }
+  int64_t size() const { return pos_; }
+
+ private:
+  uint8_t* buf_;
+  int64_t cap_;
+  int64_t pos_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(uint64_t window) { return new Store(window); }
+
+void kv_close(void* h) { delete static_cast<Store*>(h); }
+
+uint64_t kv_current_rev(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->rev;
+}
+
+uint64_t kv_oldest_rev(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return s->oldest_rev;
+}
+
+int64_t kv_create(void* h, const char* key, const uint8_t* val,
+                  uint64_t val_len, double ttl_seconds) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  double now = now_seconds();
+  s->gc(now);
+  std::string k(key);
+  if (s->data.count(k)) return ERR_EXISTS;
+  uint64_t rev = s->bump();
+  Entry e{std::string(reinterpret_cast<const char*>(val), val_len), rev,
+          ttl_seconds > 0 ? now + ttl_seconds : 0};
+  s->data[k] = e;
+  s->emit(rev, EventType::Added, k, rev, e.value);
+  return static_cast<int64_t>(rev);
+}
+
+int64_t kv_set(void* h, const char* key, const uint8_t* val,
+               uint64_t val_len, double ttl_seconds) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  double now = now_seconds();
+  s->gc(now);
+  std::string k(key);
+  bool existed = s->data.count(k) > 0;
+  uint64_t rev = s->bump();
+  Entry e{std::string(reinterpret_cast<const char*>(val), val_len), rev,
+          ttl_seconds > 0 ? now + ttl_seconds : 0};
+  s->data[k] = e;
+  s->emit(rev, existed ? EventType::Modified : EventType::Added, k, rev,
+          e.value);
+  return static_cast<int64_t>(rev);
+}
+
+// expect_rev 0 = unconditional (but the key must exist).
+int64_t kv_update(void* h, const char* key, const uint8_t* val,
+                  uint64_t val_len, uint64_t expect_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->gc(now_seconds());
+  std::string k(key);
+  auto it = s->data.find(k);
+  if (it == s->data.end()) return ERR_NOT_FOUND;
+  if (expect_rev != 0 && it->second.mod_rev != expect_rev)
+    return ERR_CONFLICT;
+  uint64_t rev = s->bump();
+  it->second.value.assign(reinterpret_cast<const char*>(val), val_len);
+  it->second.mod_rev = rev;  // TTL carries over, like core/store.py update
+  s->emit(rev, EventType::Modified, k, rev, it->second.value);
+  return static_cast<int64_t>(rev);
+}
+
+int64_t kv_delete(void* h, const char* key, uint64_t expect_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->gc(now_seconds());
+  std::string k(key);
+  auto it = s->data.find(k);
+  if (it == s->data.end()) return ERR_NOT_FOUND;
+  if (expect_rev != 0 && it->second.mod_rev != expect_rev)
+    return ERR_CONFLICT;
+  Entry e = it->second;
+  s->data.erase(it);
+  uint64_t rev = s->bump();
+  s->emit(rev, EventType::Deleted, k, e.mod_rev, e.value);
+  return static_cast<int64_t>(rev);
+}
+
+int64_t kv_get(void* h, const char* key, uint8_t* buf, int64_t buflen,
+               uint64_t* mod_rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  std::string k(key);
+  auto it = s->data.find(k);
+  if (it == s->data.end() || s->expired(it->second, now_seconds()))
+    return ERR_NOT_FOUND;
+  const std::string& v = it->second.value;
+  *mod_rev = it->second.mod_rev;
+  if (static_cast<int64_t>(v.size()) > buflen) return ERR_TOO_SMALL;
+  std::memcpy(buf, v.data(), v.size());
+  return static_cast<int64_t>(v.size());
+}
+
+// Buffer layout: u64 store_rev | u32 count | records...
+int64_t kv_list(void* h, const char* prefix, uint8_t* buf, int64_t buflen) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  double now = now_seconds();
+  std::string p(prefix);
+  Writer w(buf, buflen);
+  w.put<uint64_t>(s->rev);
+  uint32_t count = 0;
+  Writer counter(nullptr, 0);  // first pass to count
+  for (auto it = s->data.lower_bound(p); it != s->data.end(); ++it) {
+    if (it->first.compare(0, p.size(), p) != 0) break;
+    if (s->expired(it->second, now)) continue;
+    ++count;
+  }
+  w.put<uint32_t>(count);
+  for (auto it = s->data.lower_bound(p); it != s->data.end(); ++it) {
+    if (it->first.compare(0, p.size(), p) != 0) break;
+    if (s->expired(it->second, now)) continue;
+    w.put<uint64_t>(it->second.mod_rev);
+    w.put_bytes(it->first);
+    w.put_bytes(it->second.value);
+  }
+  if (!w.fits()) return -w.size();  // negative required size: grow + retry
+  return w.size();
+}
+
+// All-or-nothing multi-key CAS commit (the binding tile fast path,
+// core/store.py batch). expect_revs[i] 0 = no per-key CAS check.
+int64_t kv_batch(void* h, uint64_t n, const char** keys,
+                 const uint8_t** vals, const uint64_t* val_lens,
+                 const uint64_t* expect_revs) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->gc(now_seconds());
+  // validate everything first: a mid-batch failure commits nothing
+  for (uint64_t i = 0; i < n; ++i) {
+    auto it = s->data.find(keys[i]);
+    if (it == s->data.end()) return ERR_NOT_FOUND;
+    if (expect_revs[i] != 0 && it->second.mod_rev != expect_revs[i])
+      return ERR_CONFLICT;
+  }
+  int64_t first_rev = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    auto it = s->data.find(keys[i]);
+    uint64_t rev = s->bump();
+    if (first_rev == 0) first_rev = static_cast<int64_t>(rev);
+    it->second.value.assign(reinterpret_cast<const char*>(vals[i]),
+                            val_lens[i]);
+    it->second.mod_rev = rev;
+    s->emit(rev, EventType::Modified, it->first, rev, it->second.value);
+  }
+  return first_rev;
+}
+
+// Events with rev > since_rev for keys under prefix.
+// Layout: u32 count | event records... Returns bytes used, or negative
+// required size if the buffer is too small, or ERR_EXPIRED.
+int64_t kv_events(void* h, uint64_t since_rev, const char* prefix,
+                  uint8_t* buf, int64_t buflen) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (since_rev < s->oldest_rev) return ERR_EXPIRED;
+  std::string p(prefix);
+  Writer w(buf, buflen);
+  // history is revision-ordered: binary-search the resume point so a
+  // watcher poll costs O(log n + new events), not a full window scan
+  auto begin = std::upper_bound(
+      s->history.begin(), s->history.end(), since_rev,
+      [](uint64_t rev, const Event& e) { return rev < e.rev; });
+  uint32_t count = 0;
+  for (auto it = begin; it != s->history.end(); ++it) {
+    if (it->key.compare(0, p.size(), p) == 0) ++count;
+  }
+  w.put<uint32_t>(count);
+  for (auto it = begin; it != s->history.end(); ++it) {
+    const Event& e = *it;
+    if (e.key.compare(0, p.size(), p) != 0) continue;
+    w.put<uint64_t>(e.rev);
+    w.put<uint8_t>(static_cast<uint8_t>(e.type));
+    w.put_bytes(e.key);
+    w.put<uint64_t>(e.obj_rev);
+    w.put_bytes(e.value);
+  }
+  if (!w.fits()) return -w.size();
+  return w.size();
+}
+
+// Block until the store revision exceeds since_rev (or timeout).
+// Returns the current revision. ctypes releases the GIL around this,
+// so watcher threads park in native code, not in Python polling loops.
+uint64_t kv_wait(void* h, uint64_t since_rev, double timeout_seconds) {
+  Store* s = static_cast<Store*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait_for(
+      lk, std::chrono::duration<double>(timeout_seconds),
+      [&] { return s->rev > since_rev; });
+  return s->rev;
+}
+
+}  // extern "C"

@@ -111,6 +111,10 @@ class _PortProxy:
         try:
             upstream = socket.create_connection((host, int(port)),
                                                 timeout=5)
+            # the connect timeout must not become a read timeout — a slow
+            # backend response would OSError the pump and half-close the
+            # client mid-request
+            upstream.settimeout(None)
         except OSError:
             conn.close()
             return

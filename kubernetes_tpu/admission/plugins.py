@@ -222,6 +222,10 @@ class ResourceQuota(Interface):
         def apply(cur):
             used = dict(cur.status.used)
             for resource, delta in deltas.items():
+                if resource not in cur.spec.hard:
+                    # a concurrent writer dropped this resource from
+                    # spec.hard; nothing to enforce or charge for it
+                    continue
                 limit = cur.spec.hard[resource].milli
                 have = used.get(resource, Quantity(0)).milli
                 if have + delta > limit:

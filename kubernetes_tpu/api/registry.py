@@ -12,6 +12,7 @@ field selectors), update, update-status, delete, watch, plus the pod
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 import uuid
@@ -29,11 +30,20 @@ from ..core.watch import Watcher
 DEFAULT_EVENT_TTL = 60 * 60.0  # ref: --event-ttl default 1h (cmd/kube-apiserver)
 
 
+_DNS1123_LABEL_RE = re.compile(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?")
+_DNS1123_SUBDOMAIN_RE = re.compile(
+    r"[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*")
+
+
 def _dns1123(name: str) -> bool:
-    if not name or len(name) > 253:
-        return False
-    return all(c.islower() or c.isdigit() or c in ".-" for c in name) and \
-        name[0].isalnum() and name[-1].isalnum()
+    """DNS-1123 subdomain (ref: pkg/api/validation IsDNS1123Subdomain)."""
+    return 0 < len(name) <= 253 and bool(_DNS1123_SUBDOMAIN_RE.fullmatch(name))
+
+
+def _dns1123_label(name: str) -> bool:
+    """DNS-1123 label: lowercase ASCII alnum + '-', alnum at both ends,
+    <=63. Ref: pkg/api/validation ValidateDNS1123Label (volume names)."""
+    return 0 < len(name) <= 63 and bool(_DNS1123_LABEL_RE.fullmatch(name))
 
 
 def validate_object_meta(meta: api.ObjectMeta, namespaced: bool) -> None:
@@ -56,9 +66,15 @@ def validate_pod(pod: api.Pod) -> None:
         if c.name in names:
             raise Invalid(f"spec.containers[].name: duplicate {c.name!r}")
         names.add(c.name)
-    vol_names = {v.name for v in pod.spec.volumes}
-    if len(vol_names) != len(pod.spec.volumes):
-        raise Invalid("spec.volumes[].name: duplicate volume name")
+    vol_names = set()
+    for v in pod.spec.volumes:
+        # DNS-1123 label check also forecloses path traversal through the
+        # kubelet volume dir layout (ref: validation.go validateVolumes).
+        if not _dns1123_label(v.name):
+            raise Invalid(f"spec.volumes[].name: invalid value {v.name!r}")
+        if v.name in vol_names:
+            raise Invalid("spec.volumes[].name: duplicate volume name")
+        vol_names.add(v.name)
 
 
 def validate_node(node: api.Node) -> None:
@@ -280,7 +296,12 @@ class Registry:
         try:
             ports = []
             for port in spec.ports:
-                if port.node_port:
+                if port.node_port and not wants_node_ports:
+                    # type changed to ClusterIP: strip the node port so the
+                    # stale allocation is released below (ref: service REST
+                    # releases node ports when the type drops them).
+                    ports.append(replace(port, node_port=0))
+                elif port.node_port:
                     if port.node_port not in old_ports:
                         self.port_allocator.allocate_specific(port.node_port)
                         claimed.append(port.node_port)

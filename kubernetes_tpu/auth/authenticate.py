@@ -6,6 +6,8 @@ from __future__ import annotations
 
 import base64
 import binascii
+import hashlib
+import hmac
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -60,7 +62,10 @@ class BasicAuthAuthenticator(Authenticator):
             return None, False
         user, _, password = decoded.partition(":")
         entry = self.passwords.get(user)
-        if entry is None or entry[0] != password:
+        expected = entry[0] if entry is not None else ""
+        # constant-time compare forecloses the timing side channel
+        ok = hmac.compare_digest(expected.encode(), password.encode())
+        if entry is None or not ok:
             return None, False
         return UserInfo(name=user, uid=entry[1]), True
 
@@ -72,6 +77,9 @@ class TokenAuthenticator(Authenticator):
 
     def __init__(self, tokens: Dict[str, UserInfo]):
         self.tokens = tokens
+        self._by_digest = {
+            hashlib.sha256(t.encode()).hexdigest(): (t, u)
+            for t, u in tokens.items()}
 
     @classmethod
     def from_lines(cls, lines: Sequence[str]) -> "TokenAuthenticator":
@@ -92,10 +100,15 @@ class TokenAuthenticator(Authenticator):
         header = headers.get("Authorization", "")
         if not header.startswith("Bearer "):
             return None, False
-        info = self.tokens.get(header[7:])
-        if info is None:
+        presented = header[7:]
+        # probe by digest, then one constant-time compare of the stored
+        # token — O(1) per request with no token-prefix timing channel
+        digest = hashlib.sha256(presented.encode()).hexdigest()
+        entry = self._by_digest.get(digest)
+        if entry is None or not hmac.compare_digest(
+                entry[0].encode(), presented.encode()):
             return None, False
-        return info, True
+        return entry[1], True
 
 
 class UnionAuthenticator(Authenticator):

@@ -46,6 +46,18 @@ ERR_EXISTS = -2
 ERR_CONFLICT = -3
 ERR_TOO_SMALL = -4
 ERR_EXPIRED = -5
+# Buffer size hints come back as -(required + SIZE_HINT_BASE): a range
+# disjoint from the error codes so a tiny required size can't alias them
+# (kvstore.cc SIZE_HINT_BASE).
+SIZE_HINT_BASE = 64
+_RETRY_SLACK = 64  # extra bytes on retry; unrelated to SIZE_HINT_BASE
+
+
+def _size_hint(n: int) -> Optional[int]:
+    """Decode a kv_* return: buffer size to retry with, or None."""
+    if n <= -SIZE_HINT_BASE:
+        return (-n - SIZE_HINT_BASE) + _RETRY_SLACK
+    return None
 
 _EVENT_TYPES = {0: watchpkg.ADDED, 1: watchpkg.MODIFIED, 2: watchpkg.DELETED}
 
@@ -313,9 +325,12 @@ class NativeStore:
         while True:
             buf = ctypes.create_string_buffer(size)
             n = self._lib.kv_list(self._h, prefix.encode(), buf, size)
-            if n < 0:
-                size = -n + 64
+            hint = _size_hint(n)
+            if hint is not None:
+                size = hint
                 continue
+            if n < 0:
+                raise RuntimeError(f"kv_list failed: {n}")
             break
         data = buf.raw[:n]
         store_rev, count = struct.unpack_from("<QI", data, 0)
@@ -348,9 +363,12 @@ class NativeStore:
             if n == ERR_EXPIRED:
                 raise Expired(
                     f"resourceVersion {since_rev} is too old")
-            if n < 0:
-                size = -n + 64
+            hint = _size_hint(n)
+            if hint is not None:
+                size = hint
                 continue
+            if n < 0:
+                raise RuntimeError(f"kv_events failed: {n}")
             break
         data = buf.raw[:n]
         (count,) = struct.unpack_from("<I", data, 0)

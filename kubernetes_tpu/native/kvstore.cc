@@ -36,6 +36,11 @@ constexpr int64_t ERR_EXISTS = -2;
 constexpr int64_t ERR_CONFLICT = -3;
 constexpr int64_t ERR_TOO_SMALL = -4;
 constexpr int64_t ERR_EXPIRED = -5;
+// Buffer-too-small size hints are returned as -(size + SIZE_HINT_BASE) so
+// they occupy a range disjoint from the error codes above — a tiny payload
+// (e.g. 4 bytes) must not alias ERR_TOO_SMALL. Callers recover the
+// required size as (-ret) - SIZE_HINT_BASE.
+constexpr int64_t SIZE_HINT_BASE = 64;
 
 double now_seconds() {
   return std::chrono::duration<double>(
@@ -259,7 +264,7 @@ int64_t kv_list(void* h, const char* prefix, uint8_t* buf, int64_t buflen) {
     w.put_bytes(it->first);
     w.put_bytes(it->second.value);
   }
-  if (!w.fits()) return -w.size();  // negative required size: grow + retry
+  if (!w.fits()) return -(w.size() + SIZE_HINT_BASE);  // size hint: grow + retry
   return w.size();
 }
 
@@ -292,8 +297,8 @@ int64_t kv_batch(void* h, uint64_t n, const char** keys,
 }
 
 // Events with rev > since_rev for keys under prefix.
-// Layout: u32 count | event records... Returns bytes used, or negative
-// required size if the buffer is too small, or ERR_EXPIRED.
+// Layout: u32 count | event records... Returns bytes used, or
+// -(required + SIZE_HINT_BASE) if the buffer is too small, or ERR_EXPIRED.
 int64_t kv_events(void* h, uint64_t since_rev, const char* prefix,
                   uint8_t* buf, int64_t buflen) {
   Store* s = static_cast<Store*>(h);
@@ -320,7 +325,7 @@ int64_t kv_events(void* h, uint64_t since_rev, const char* prefix,
     w.put<uint64_t>(e.obj_rev);
     w.put_bytes(e.value);
   }
-  if (!w.fits()) return -w.size();
+  if (!w.fits()) return -(w.size() + SIZE_HINT_BASE);
   return w.size();
 }
 

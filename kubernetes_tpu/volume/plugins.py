@@ -30,8 +30,16 @@ class VolumeHost:
     def pod_volume_dir(self, pod_uid: str, plugin_name: str,
                        volume_name: str) -> str:
         safe_plugin = plugin_name.replace("/", "~")
-        return os.path.join(self.root_dir, "pods", pod_uid, "volumes",
+        path = os.path.join(self.root_dir, "pods", pod_uid, "volumes",
                             safe_plugin, volume_name)
+        # Defense in depth behind validate_pod's DNS-1123 volume-name check:
+        # a traversal-shaped uid/name must never resolve outside root_dir
+        # (tear_down rmtree's this path).
+        root = os.path.realpath(self.root_dir)
+        if not os.path.realpath(path).startswith(root + os.sep):
+            raise BadRequest(
+                f"volume path {path!r} escapes kubelet root {root!r}")
+        return path
 
 
 class Builder:

@@ -166,6 +166,16 @@ class Reflector:
         self.namespace = namespace
         self.label_selector = label_selector
         self.field_selector = field_selector
+        # selectors are immutable per reflector: parse once, not per event
+        self._parsed_fields = None
+        self._fields_fn = None
+        if field_selector:
+            from ..core import fields as fieldspkg
+            from .registry import Registry
+            self._parsed_fields = fieldspkg.parse(field_selector)
+            self._fields_fn = Registry.info(resource).fields_fn
+        self._parsed_labels = (labelspkg.parse(label_selector)
+                               if label_selector else None)
         self.store = store
         self.on_add = on_add
         self.on_update = on_update
@@ -180,15 +190,12 @@ class Reflector:
     # watch events are not field-filtered by the in-proc store (the reference
     # filters in the apiserver; filtering at both ends is harmless).
     def _matches(self, obj: Any) -> bool:
-        if self.field_selector:
-            from ..core import fields as fieldspkg
-            from .registry import Registry
-            info = Registry.info(self.resource)
-            if not fieldspkg.parse(self.field_selector).matches(info.fields_fn(obj)):
-                return False
-        if self.label_selector:
-            if not labelspkg.parse(self.label_selector).matches(obj.metadata.labels):
-                return False
+        if self._parsed_fields is not None and \
+                not self._parsed_fields.matches(self._fields_fn(obj)):
+            return False
+        if self._parsed_labels is not None and \
+                not self._parsed_labels.matches(obj.metadata.labels):
+            return False
         return True
 
     def _list_and_watch(self) -> None:

@@ -27,6 +27,7 @@ exactly like etcd's modifiedIndex in the reference.
 from __future__ import annotations
 
 import fnmatch
+import heapq
 import threading
 import time
 from collections import OrderedDict, deque
@@ -53,6 +54,10 @@ class Store:
         self._history: deque = deque(maxlen=window)
         self._oldest_rev = 0  # smallest rev still replayable + its predecessor
         self._watchers: List[Tuple[str, "watchpkg.Watcher"]] = []
+        # min-heap of (expiry, key) for TTL'd entries only, so GC cost is
+        # O(expired) per write instead of a full-store scan (only events
+        # carry TTLs; pods/nodes must not pay for them)
+        self._expiry_heap: List[Tuple[float, str]] = []
 
     # ------------------------------------------------------------- helpers
 
@@ -87,9 +92,14 @@ class Store:
 
     def _gc_expired(self, now: Optional[float] = None) -> None:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
+        if not self._expiry_heap:
+            return
         now = time.time() if now is None else now
-        dead = [k for k, e in self._data.items() if self._expired(e, now)]
-        for k in dead:
+        while self._expiry_heap and self._expiry_heap[0][0] <= now:
+            expiry, k = heapq.heappop(self._expiry_heap)
+            entry = self._data.get(k)
+            if entry is None or entry[2] != expiry:
+                continue  # stale heap entry: key deleted or re-written
             obj, _, _ = self._data.pop(k)
             self._emit(self._bump(), watchpkg.DELETED, k, obj, obj)
 
@@ -105,6 +115,8 @@ class Store:
             obj = _with_rv(obj, rev)
             expiry = time.time() + ttl if ttl else None
             self._data[key] = (obj, rev, expiry)
+            if expiry is not None:
+                heapq.heappush(self._expiry_heap, (expiry, key))
             self._emit(rev, watchpkg.ADDED, key, obj, None)
             return obj
 
@@ -117,6 +129,8 @@ class Store:
             expiry = time.time() + ttl if ttl else None
             prev = self._data.get(key)
             self._data[key] = (obj, rev, expiry)
+            if expiry is not None:
+                heapq.heappush(self._expiry_heap, (expiry, key))
             etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
             self._emit(rev, etype, key, obj, prev[0] if prev else None)
             return obj

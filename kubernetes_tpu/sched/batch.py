@@ -30,24 +30,27 @@ from typing import List, Optional
 from ..core import types as api
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .device import BatchEngine, ClusterSnapshot
+from .device.incremental import IncrementalEncoder, NeedsFullEncode
 from .generic import FitError
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
 
 
 class BatchSchedulerConfig:
     def __init__(self, factory, engine: Optional[BatchEngine] = None,
-                 tile_size: int = 4096, min_pad: int = 64,
+                 tile_size: int = 8192, min_pad: int = 64,
+                 bulk_chunk: int = 1024, incremental: bool = True,
                  metrics: Optional[MetricsRegistry] = None):
         self.factory = factory
         self.engine = engine or BatchEngine()
         self.tile_size = tile_size
+        # scan-chunk sizes: small drains compile/run the [min_pad] program,
+        # bulk drains the [bulk_chunk] one — exactly two XLA programs per
+        # node-table shape, regardless of tile size (engine.run_chunked)
         self.min_pad = min_pad
+        self.bulk_chunk = bulk_chunk
+        # incremental device state (watch deltas -> persistent arrays,
+        # SURVEY.md section 7 hard part 4); DevicePolicy engines keep the
+        # full per-tile encode, which knows how to encode policy tiers
+        self.incremental = incremental and self.engine.policy is None
         self.metrics = metrics or global_metrics
 
 
@@ -58,6 +61,16 @@ class BatchScheduler:
         self.config = config
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._inc: Optional[IncrementalEncoder] = None
+
+    def _incremental(self) -> Optional[IncrementalEncoder]:
+        """Lazily attach the incremental encoder (the factory's informers
+        must be running; attach+bootstrap is idempotent via the ledger)."""
+        if not self.config.incremental:
+            return None
+        if self._inc is None:
+            self._inc = IncrementalEncoder().attach(self.config.factory)
+        return self._inc
 
     def run(self) -> "BatchScheduler":
         self._thread = threading.Thread(target=self._loop,
@@ -109,21 +122,50 @@ class BatchScheduler:
         start = time.monotonic()
 
         try:
-            # the full node cache (not just ready nodes) resolves existing
-            # pods' topology domains for affinity terms, mirroring the
-            # serial predicate's node_by_name (ReadyNodeLister.get)
-            node_cache = getattr(f.node_lister, "cache", None)
-            snap = ClusterSnapshot(
-                nodes=f.node_lister.list(),
-                existing_pods=f.pod_lister.list(),
-                services=f.service_lister.list(),
-                controllers=f.controller_lister.list(),
-                pending_pods=pods,
-                all_nodes=(node_cache.list()
-                           if node_cache is not None else None))
-            # pad the pod axis to stable shapes -> XLA compiles once per tier
-            pad = min(max(_next_pow2(len(pods)), c.min_pad), c.tile_size)
-            hosts, _enc = self.config.engine.schedule(snap, pod_pad_to=pad)
+            # fixed scan-chunk ladder -> stable shapes -> XLA compiles one
+            # program per rung; big drains run as ONE dispatch (each extra
+            # dispatch re-enters Python and fights the GIL mid-benchmark)
+            n = len(pods)
+            if n <= c.min_pad:
+                chunk = c.min_pad
+            elif n <= 2 * c.bulk_chunk:
+                chunk = c.bulk_chunk
+            else:
+                chunk = c.tile_size
+            hosts = None
+            inc = self._incremental()
+            if inc is not None:
+                try:
+                    enc = inc.encode_tile(pods, f.service_lister.list(),
+                                          f.controller_lister.list())
+                    c.metrics.observe("batch_snapshot_latency_microseconds",
+                                      (time.monotonic() - start) * 1e6)
+                    t_dev = time.monotonic()
+                    assigned, _ = c.engine.run_chunked(enc, chunk)
+                    hosts = [enc.node_names[i] if i >= 0 else None
+                             for i in assigned[:enc.n_pods]]
+                except NeedsFullEncode:
+                    hosts = None  # this tile needs the full encoder
+            if hosts is None:
+                # the full node cache (not just ready nodes) resolves
+                # existing pods' topology domains for affinity terms,
+                # mirroring the serial predicate's node_by_name
+                # (ReadyNodeLister.get)
+                node_cache = getattr(f.node_lister, "cache", None)
+                snap = ClusterSnapshot(
+                    nodes=f.node_lister.list(),
+                    existing_pods=f.pod_lister.list(),
+                    services=f.service_lister.list(),
+                    controllers=f.controller_lister.list(),
+                    pending_pods=pods,
+                    all_nodes=(node_cache.list()
+                               if node_cache is not None else None))
+                c.metrics.observe("batch_snapshot_latency_microseconds",
+                                  (time.monotonic() - start) * 1e6)
+                t_dev = time.monotonic()
+                hosts, _enc = c.engine.schedule(snap, chunk=chunk)
+            c.metrics.observe("batch_device_latency_microseconds",
+                              (time.monotonic() - t_dev) * 1e6)
         except Exception as e:
             # encode/device failure: the tile is already drained from the
             # FIFO, so every pod must take the error path (backoff+requeue)
@@ -179,6 +221,10 @@ class BatchScheduler:
                 assumed = replace(pod,
                                   spec=replace(pod.spec, node_name=host))
                 f.modeler.assume_pod(assumed)
+                if self._inc is not None:
+                    # count the binding into the persistent device state
+                    # now; the watch echo dedupes via the ledger
+                    self._inc.assume(assumed)
 
         f.modeler.locked_action(bind_and_assume)
 

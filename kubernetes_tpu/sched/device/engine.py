@@ -118,8 +118,24 @@ class State(NamedTuple):
     svc_total: jax.Array   # i32[S]
 
 
+def _floordiv_exact(num: jax.Array, den: jax.Array,
+                    inv_den: jax.Array) -> jax.Array:
+    """floor(num/den) for |num| < 2^53, den >= 1, computed without integer
+    division: i64 vector division has no SIMD path on CPU and is emulated
+    on TPU (measured 82us/pod of the scan step — the single hottest op).
+    A f64 reciprocal-multiply estimate is within 1 of the true quotient
+    (relative error ~2^-51 on an exact f64 product), so two integer
+    compare-corrections make it exact."""
+    e = jnp.floor(num.astype(jnp.float64) * inv_den).astype(jnp.int64)
+    e = e + ((e + 1) * den <= num).astype(jnp.int64)
+    e = e - (e * den > num).astype(jnp.int64)
+    return e
+
+
 def _step(node: NodeConst, weights: Tuple[int, int, int],
-          anti_weight: int, state: State, pod) -> Tuple[State, jax.Array]:
+          anti_weight: int, state: State, pod,
+          has_aff: bool = True, has_spread: bool = True
+          ) -> Tuple[State, jax.Array]:
     n = node.valid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
 
@@ -141,36 +157,50 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
         ((state.disk_any & pod.qany[None, :])
          | (state.disk_rw & pod.qrw[None, :])) != 0, axis=1)
 
-    # inter-pod affinity/anti-affinity (BASELINE config 4; semantics =
-    # sched.predicates.new_inter_pod_affinity_predicate). Per term t the
-    # node's scope count is the placed-pod count in its topology domain;
-    # affinity needs the key present and count>0 (or the bootstrap: the
-    # pod self-matches an empty-scope term), anti-affinity needs count==0.
-    has_key = node.aff_dom >= 0                                   # [T, N]
-    counts = jnp.take_along_axis(
-        state.aff_count, jnp.maximum(node.aff_dom, 0), axis=1)    # [T, N]
-    counts = jnp.where(has_key, counts, 0)
-    boot = (pod.aff_member > 0) & (state.aff_total == 0)          # [T]
-    aff_ok = jnp.all(~pod.aff_req[:, None]
-                     | (has_key & (boot[:, None] | (counts > 0))),
-                     axis=0)                                      # [N]
-    anti_ok = jnp.all(~pod.anti_req[:, None] | (counts == 0), axis=0)
-
     mask = (node.valid & pod.valid & res_ok & ~port_conflict & sel_ok
-            & host_ok & ~disk_conflict & aff_ok & anti_ok
-            & node.static_mask)
+            & host_ok & ~disk_conflict & node.static_mask)
+
+    if has_aff:
+        # inter-pod affinity/anti-affinity (BASELINE config 4; semantics =
+        # sched.predicates.new_inter_pod_affinity_predicate). Per term t the
+        # node's scope count is the placed-pod count in its topology domain;
+        # affinity needs the key present and count>0 (or the bootstrap: the
+        # pod self-matches an empty-scope term), anti-affinity needs
+        # count==0. Compiled out (has_aff=False) when the batch carries no
+        # terms — the tier is then provably all-True.
+        has_key = node.aff_dom >= 0                                   # [T, N]
+        counts = jnp.take_along_axis(
+            state.aff_count, jnp.maximum(node.aff_dom, 0), axis=1)    # [T, N]
+        counts = jnp.where(has_key, counts, 0)
+        boot = (pod.aff_member > 0) & (state.aff_total == 0)          # [T]
+        aff_ok = jnp.all(~pod.aff_req[:, None]
+                         | (has_key & (boot[:, None] | (counts > 0))),
+                         axis=0)                                      # [N]
+        anti_ok = jnp.all(~pod.anti_req[:, None] | (counts == 0), axis=0)
+        mask = mask & aff_ok & anti_ok
 
     # ---- priorities (priorities.go:33,77,198; selector_spreading.go:80) ----
     safe_cpu = jnp.maximum(node.cpu_cap, 1)
     safe_mem = jnp.maximum(node.mem_cap, 1)
+    # reciprocals of loop-invariant capacities: XLA hoists them out of the
+    # scan, so each step pays multiplies, not divisions
+    inv_cpu = 1.0 / safe_cpu.astype(jnp.float64)
+    inv_mem = 1.0 / safe_mem.astype(jnp.float64)
     tc = state.nz_cpu + pod.nz_cpu
     tm = state.nz_mem + pod.nz_mem
-    cpu_score = jnp.where((node.cpu_cap == 0) | (tc > node.cpu_cap),
-                          0, ((node.cpu_cap - tc) * 10) // safe_cpu)
-    mem_score = jnp.where((node.mem_cap == 0) | (tm > node.mem_cap),
-                          0, ((node.mem_cap - tm) * 10) // safe_mem)
-    least_requested = (cpu_score + mem_score) // 2
+    cpu_score = jnp.where(
+        (node.cpu_cap == 0) | (tc > node.cpu_cap), 0,
+        _floordiv_exact((node.cpu_cap - tc) * 10, safe_cpu, inv_cpu))
+    mem_score = jnp.where(
+        (node.mem_cap == 0) | (tm > node.mem_cap), 0,
+        _floordiv_exact((node.mem_cap - tm) * 10, safe_mem, inv_mem))
+    # operands are 0..20, so the halving is a shift, not a division
+    least_requested = (cpu_score + mem_score) >> 1
 
+    # true f64 division here, NOT reciprocal-multiply: the oracle computes
+    # this fraction with Python float division and the floor below must
+    # agree bit-for-bit (f64 division is SIMD-cheap; only the integer
+    # division above was hot)
     cpu_frac = jnp.where(node.cpu_cap == 0, jnp.float64(1.0),
                          tc.astype(jnp.float64) / safe_cpu.astype(jnp.float64))
     mem_frac = jnp.where(node.mem_cap == 0, jnp.float64(1.0),
@@ -180,16 +210,22 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
         (cpu_frac >= 1.0) | (mem_frac >= 1.0), jnp.int64(0),
         jnp.floor(jnp.float64(10.0) - diff * 10.0).astype(jnp.int64))
 
-    gid = jnp.maximum(pod.group_id, 0)
-    counts = state.spread[gid]
-    max_count = jnp.maximum(jnp.max(counts), node.offgrid_max[gid])
-    spread_f = (10.0 * (max_count - counts).astype(jnp.float64)
-                / jnp.maximum(max_count, 1).astype(jnp.float64))
-    spread = jnp.where((pod.group_id < 0) | (max_count == 0),
-                       jnp.int64(10), jnp.floor(spread_f).astype(jnp.int64))
-
     total = (weights[0] * least_requested + weights[1] * balanced
-             + weights[2] * spread + node.static_score)
+             + node.static_score)
+
+    if has_spread:
+        gid = jnp.maximum(pod.group_id, 0)
+        counts = state.spread[gid]
+        max_count = jnp.maximum(jnp.max(counts), node.offgrid_max[gid])
+        spread_f = (10.0 * (max_count - counts).astype(jnp.float64)
+                    / jnp.maximum(max_count, 1).astype(jnp.float64))
+        spread = jnp.where((pod.group_id < 0) | (max_count == 0),
+                           jnp.int64(10),
+                           jnp.floor(spread_f).astype(jnp.int64))
+        total = total + weights[2] * spread
+    # has_spread=False: every pod scores the constant 10 on all nodes
+    # (group_id < 0), which shifts all totals equally and cannot change
+    # the argmax — compiled out.
 
     if anti_weight:
         # ServiceAntiAffinity (selector_spreading.go:117-196): spread the
@@ -214,11 +250,13 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
         total = total + anti_weight * sa
 
     # ---- selection (generic_scheduler.go:95 selectHost) ----
-    masked = jnp.where(mask, total, jnp.int64(-1))
-    best = jnp.max(masked)
-    fit_any = best >= 0
-    cand = mask & (masked == best)
-    pick = jnp.argmax(jnp.where(cand, node.tie_rank, -1)).astype(jnp.int32)
+    # one composite argmax: scores are non-negative and tie_rank is a
+    # distinct 0..n-1 per valid node, so argmax(total*n + tie_rank) is
+    # exactly "max score, then deterministic max tie-rank" in one
+    # reduction instead of max + compare + argmax
+    composite = jnp.where(mask, total * n + node.tie_rank, jnp.int64(-1))
+    pick = jnp.argmax(composite).astype(jnp.int32)
+    fit_any = composite[pick] >= 0
     assigned = jnp.where(fit_any, pick, jnp.int32(-1))
 
     # ---- assume-pod state update (modeler.go:113) ----
@@ -237,14 +275,18 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
                            state.disk_any),
         disk_rw=jnp.where(ohc, state.disk_rw | pod.srw[None, :],
                           state.disk_rw),
-        spread=state.spread
-        + pod.member[:, None] * oh.astype(jnp.int32)[None, :],
-        aff_count=_aff_count_update(node, state, pod, pick, fit_any),
-        aff_total=state.aff_total
-        + jnp.where(fit_any, pod.aff_member, 0),
-        svc_count=state.svc_count
-        + pod.svc_member[:, None] * oh.astype(jnp.int32)[None, :],
-        svc_total=state.svc_total + jnp.where(fit_any, pod.svc_member, 0))
+        spread=(state.spread
+                + pod.member[:, None] * oh.astype(jnp.int32)[None, :])
+        if has_spread else state.spread,
+        aff_count=_aff_count_update(node, state, pod, pick, fit_any)
+        if has_aff else state.aff_count,
+        aff_total=(state.aff_total + jnp.where(fit_any, pod.aff_member, 0))
+        if has_aff else state.aff_total,
+        svc_count=(state.svc_count
+                   + pod.svc_member[:, None] * oh.astype(jnp.int32)[None, :])
+        if anti_weight else state.svc_count,
+        svc_total=(state.svc_total + jnp.where(fit_any, pod.svc_member, 0))
+        if anti_weight else state.svc_total)
     return new_state, assigned
 
 
@@ -258,10 +300,12 @@ def _aff_count_update(node: NodeConst, state: State, pod, pick, fit_any):
         jnp.arange(t), jnp.maximum(dom_at, 0)].add(add)
 
 
-def _make_run(weights: Tuple[int, int, int], anti_weight: int = 0):
+def _make_run(weights: Tuple[int, int, int], anti_weight: int = 0,
+              has_aff: bool = True, has_spread: bool = True):
     def run(node: NodeConst, state: State, pods: PodXs):
         def step(carry, x):
-            return _step(node, weights, anti_weight, carry, x)
+            return _step(node, weights, anti_weight, carry, x,
+                         has_aff, has_spread)
         return jax.lax.scan(step, state, pods)
     return run
 
@@ -301,17 +345,38 @@ class BatchEngine:
         self.mesh = mesh
         self.node_axis = node_axis
         self.policy = policy
-        anti_weight = (policy.anti_affinity_weight
-                       if policy is not None and policy.needs_anti_affinity
-                       else 0)
-        run = _make_run(self.weights, anti_weight)
-        if mesh is not None:
-            shardings = _node_shardings(mesh, node_axis)
-            self._run = jax.jit(
+        self._anti_weight = (policy.anti_affinity_weight
+                             if policy is not None
+                             and policy.needs_anti_affinity else 0)
+        # jitted variants keyed by (has_aff, has_spread): inactive tiers
+        # (no affinity terms / no spread groups in the batch) compile out
+        # entirely rather than running on dummy [1, N] arrays every step
+        self._runs = {}
+        self._run = self._get_run(True, True)
+
+    def _get_run(self, has_aff: bool, has_spread: bool):
+        key = (has_aff, has_spread)
+        cached = self._runs.get(key)
+        if cached is not None:
+            return cached
+        run = _make_run(self.weights, self._anti_weight,
+                        has_aff=has_aff, has_spread=has_spread)
+        if self.mesh is not None:
+            shardings = _node_shardings(self.mesh, self.node_axis)
+            jitted = jax.jit(
                 run, in_shardings=shardings,
-                out_shardings=(shardings[1], NamedSharding(mesh, P())))
+                out_shardings=(shardings[1], NamedSharding(self.mesh, P())))
         else:
-            self._run = jax.jit(run)
+            jitted = jax.jit(run)
+        self._runs[key] = jitted
+        return jitted
+
+    @staticmethod
+    def _enc_flags(enc: EncodeResult) -> Tuple[bool, bool]:
+        pb = enc.pod_batch
+        has_aff = bool(pb.aff_req.any() or pb.anti_req.any())
+        has_spread = bool((pb.group_id >= 0).any())
+        return has_aff, has_spread
 
     @property
     def n_shards(self) -> int:
@@ -347,15 +412,47 @@ class BatchEngine:
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
         """-> (assigned node indices i32[P] (-1 = no fit), final state)."""
         node, state, pods = self.device_args(enc)
-        final_state, assigned = self._run(node, state, pods)
+        run = self._get_run(*self._enc_flags(enc))
+        final_state, assigned = run(node, state, pods)
         return np.asarray(assigned), final_state
 
-    def schedule(self, snap: ClusterSnapshot, pod_pad_to: Optional[int] = None
+    def run_chunked(self, enc: EncodeResult, chunk: int = 1024
+                    ) -> Tuple[np.ndarray, State]:
+        """Like run(), but the pod axis executes as fixed-size scan chunks
+        with the carry threaded between calls on device. One XLA program
+        (the [chunk] shape) serves every tile size — the pow2-ladder of
+        per-tile-shape compiles collapses to a single compilation, and a
+        30k-pod batch is ~30 dispatches of the same executable. Padded
+        pods are invalid and never touch state, so chunked execution is
+        bit-identical to one long scan."""
+        node, state, pods = self.device_args(enc)
+        run = self._get_run(*self._enc_flags(enc))
+        p = pods.valid.shape[0]
+        outs = []
+        for lo in range(0, p, chunk):
+            piece = jax.tree_util.tree_map(lambda a: a[lo:lo + chunk], pods)
+            n = piece.valid.shape[0]
+            if n < chunk:  # pad the tail chunk to the compiled shape
+                piece = jax.tree_util.tree_map(
+                    lambda a: np.concatenate(
+                        [np.asarray(a),
+                         np.zeros((chunk - n,) + a.shape[1:], a.dtype)]),
+                    piece)
+            state, assigned = run(node, state, piece)
+            outs.append(assigned)
+        flat = jnp.concatenate(outs)[:p] if outs else jnp.zeros(0, jnp.int32)
+        return np.asarray(flat), state
+
+    def schedule(self, snap: ClusterSnapshot, pod_pad_to: Optional[int] = None,
+                 chunk: Optional[int] = None
                  ) -> Tuple[List[Optional[str]], EncodeResult]:
         """Encode + run + decode: one host name (or None) per pending pod."""
         enc = encode_snapshot(snap, node_pad_to=self.n_shards,
                               pod_pad_to=pod_pad_to, policy=self.policy)
-        assigned, _ = self.run(enc)
+        if chunk:
+            assigned, _ = self.run_chunked(enc, chunk)
+        else:
+            assigned, _ = self.run(enc)
         out: List[Optional[str]] = []
         for j in range(enc.n_pods):
             idx = int(assigned[j])

@@ -1,0 +1,681 @@
+"""Incremental device-state encoder: watch deltas -> persistent arrays.
+
+SURVEY.md section 7 hard part 4: the full encoder (tables.encode_snapshot)
+re-walks every node and every existing pod for every tile, so per-tile
+host cost grows with cluster size — the serial MapPodsToMachines
+pathology (predicates.go:445) reborn on the host. This encoder instead
+maintains the Struct-of-Arrays cluster state persistently and applies
+watch-stream deltas (the reference's reflector feed,
+client/cache/reflector.go:225) plus the scheduler's own assume() calls,
+so encoding a tile costs O(tile), independent of cluster size.
+
+Fidelity contract (vs tables.encode_snapshot, which remains the oracle
+for parity tests):
+  - aggregates, bitsets, and spread counts are maintained to the same
+    definitions: resource sums replay CheckPodsExceedingFreeResources'
+    skip-on-misfit accounting (predicates.go:160-185), nonzero-request
+    sums (priorities.go:53-54), selector-spread groups over the
+    UNfiltered pod set (selector_spreading.go:43-114), MapPodsToMachines'
+    Succeeded/Failed phase filter for resource state (predicates.go:429).
+  - deliberate divergence: the misfit replay runs in event ARRIVAL order,
+    not snapshot list order. The two only differ when a node is
+    oversubscribed with a mix of fitting and misfitting pods whose order
+    matters; the full encoder stays authoritative for that edge and the
+    parity suite pins it.
+  - scope: the default provider tier. Tiles carrying inter-pod affinity
+    terms raise NeedsFullEncode (the caller falls back to the full
+    encoder), and engines configured with a DevicePolicy (zone
+    anti-affinity, label policy tiers) should not use this path.
+
+Shape stability: node capacity and interner word capacities grow by
+doubling, so array shapes — and therefore XLA compilations — change
+O(log) times over a cluster's life, not per tile.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ...core import types as api
+from ..predicates import get_resource_request
+from ..priorities import get_nonzero_requests
+from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
+                     _disk_keys, _matching_services, _pod_spread_selectors,
+                     _selector_matches, _set_bit, _words)
+
+
+class NeedsFullEncode(Exception):
+    """Tile needs a feature this encoder doesn't maintain incrementally."""
+
+
+def _grow(arr: np.ndarray, axis: int, new_len: int) -> np.ndarray:
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, new_len - arr.shape[axis])
+    return np.pad(arr, pad)
+
+
+class _GrowingInterner:
+    """String->bit-index dictionary with a word capacity that doubles;
+    exposes the current padded word count so bitset shapes stay stable
+    between growths."""
+
+    def __init__(self, min_words: int = 1):
+        self.ids: Dict[object, int] = {}
+        self.words = min_words
+
+    def intern(self, key: object) -> Tuple[int, bool]:
+        """-> (bit index, grew) — grew means bitset arrays must widen."""
+        idx = self.ids.get(key)
+        if idx is not None:
+            return idx, False
+        idx = len(self.ids)
+        self.ids[key] = idx
+        if _words(len(self.ids)) > self.words:
+            self.words *= 2
+            return idx, True
+        return idx, False
+
+
+class _Group:
+    """One selector-spread group (ns, selector set): per-node counts plus
+    the off-table bucket (unassigned '' / unknown hosts)."""
+
+    __slots__ = ("ns", "sels", "row", "offgrid")
+
+    def __init__(self, ns: str, sels: List[Dict[str, str]], cap: int):
+        self.ns = ns
+        self.sels = sels
+        self.row = np.zeros(cap, np.int32)
+        self.offgrid: Dict[str, int] = {}
+
+    def matches(self, ns: str, labels: Dict[str, str]) -> bool:
+        return ns == self.ns and any(
+            _selector_matches(s, labels) for s in self.sels)
+
+
+class _PodRecord:
+    __slots__ = ("rv", "node", "slot", "ns", "labels", "counted_res",
+                 "misfit", "req_cpu", "req_mem", "nz_cpu", "nz_mem",
+                 "ports", "disks")
+
+    def __init__(self):
+        self.rv = ""
+        self.node = ""
+        self.slot: Optional[int] = None
+        self.ns = ""
+        self.labels: Dict[str, str] = {}
+        self.counted_res = False   # phase not Succeeded/Failed at count time
+        self.misfit: Optional[str] = None   # 'cpu' | 'mem' | None
+        self.req_cpu = 0
+        self.req_mem = 0
+        self.nz_cpu = 0
+        self.nz_mem = 0
+        self.ports: List[int] = []
+        self.disks: List[Tuple[int, bool, bool]] = []  # (bit, any_q, rw)
+
+
+class IncrementalEncoder:
+    """Persistent cluster arrays fed by pod/node watch deltas."""
+
+    def __init__(self, node_capacity: int = 64):
+        self._lock = threading.RLock()
+        # interners shared across the encoder's life
+        self.labels_dict = _GrowingInterner()
+        self.ports_dict = _GrowingInterner()
+        self.disk_dict = _GrowingInterner()
+
+        # ---- node table (slot-stable: a node keeps its index for life) --
+        self.n_cap = node_capacity
+        self.node_slot: Dict[str, int] = {}
+        self.node_names: List[str] = [""] * self.n_cap
+        self._free_slots: List[int] = []
+        self.valid = np.zeros(self.n_cap, bool)
+        self.cpu_cap = np.zeros(self.n_cap, np.int64)
+        self.mem_cap = np.zeros(self.n_cap, np.int64)
+        self.pod_cap = np.zeros(self.n_cap, np.int32)
+        self.label_words = np.zeros((self.n_cap, 1), np.uint32)
+        self.tie_rank = np.full(self.n_cap, -1, np.int32)
+        self._tie_dirty = False
+
+        # ---- per-node aggregates (the State init the engine consumes) --
+        self.cpu_used = np.zeros(self.n_cap, np.int64)
+        self.mem_used = np.zeros(self.n_cap, np.int64)
+        self.nz_cpu = np.zeros(self.n_cap, np.int64)
+        self.nz_mem = np.zeros(self.n_cap, np.int64)
+        self.pod_count = np.zeros(self.n_cap, np.int32)
+        self.port_bits = np.zeros((self.n_cap, 1), np.uint32)
+        self.disk_any = np.zeros((self.n_cap, 1), np.uint32)
+        self.disk_rw = np.zeros((self.n_cap, 1), np.uint32)
+        self.exceed_cpu = np.zeros(self.n_cap, bool)
+        self.exceed_mem = np.zeros(self.n_cap, bool)
+
+        # ---- ledgers --
+        self.pods: Dict[str, _PodRecord] = {}
+        # per-slot insertion-ordered pod keys (replay order for misfit
+        # recompute); unknown-host pods parked by node name
+        self.node_pods: Dict[int, List[str]] = {}
+        self.unknown_node_pods: Dict[str, Set[str]] = {}
+        self.groups: Dict[object, _Group] = {}
+
+    # ================================================== watch delta feed
+
+    def on_pod_add(self, pod: api.Pod) -> None:
+        with self._lock:
+            self._pod_upsert(pod)
+
+    def on_pod_update(self, old: api.Pod, new: api.Pod) -> None:
+        with self._lock:
+            self._pod_upsert(new)
+
+    def on_pod_delete(self, pod: api.Pod) -> None:
+        with self._lock:
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            rec = self.pods.pop(key, None)
+            if rec is not None:
+                self._remove_record(key, rec)
+
+    def assume(self, pod: api.Pod) -> None:
+        """Count a just-bound pod before the watch confirms it (the
+        modeler.AssumePod moment, modeler.go:113)."""
+        self.on_pod_add(pod)
+
+    def on_node_add(self, node: api.Node) -> None:
+        with self._lock:
+            self._node_upsert(node)
+
+    def on_node_update(self, old: api.Node, new: api.Node) -> None:
+        with self._lock:
+            self._node_upsert(new)
+
+    def on_node_delete(self, node: api.Node) -> None:
+        with self._lock:
+            slot = self.node_slot.get(node.metadata.name)
+            if slot is None:
+                return
+            self.valid[slot] = False
+
+    # ================================================== pod bookkeeping
+
+    def _pod_upsert(self, pod: api.Pod) -> None:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        old = self.pods.get(key)
+        if old is not None:
+            if old.rv and old.rv == pod.metadata.resource_version:
+                return  # idempotent: bootstrap overlap / assume+watch echo
+            new_counted = pod.status.phase not in (api.POD_SUCCEEDED,
+                                                   api.POD_FAILED)
+            if (old.node == pod.spec.node_name
+                    and old.counted_res == new_counted
+                    and old.labels == pod.metadata.labels):
+                old.rv = pod.metadata.resource_version or old.rv
+                return  # status-only change: nothing we count moved
+            self._remove_record(key, old)
+        rec = self._build_record(pod)
+        self.pods[key] = rec
+        self._apply_record(key, rec)
+
+    def _build_record(self, pod: api.Pod) -> _PodRecord:
+        rec = _PodRecord()
+        rec.rv = pod.metadata.resource_version or ""
+        rec.node = pod.spec.node_name
+        rec.ns = pod.metadata.namespace
+        rec.labels = dict(pod.metadata.labels)
+        rec.counted_res = pod.status.phase not in (api.POD_SUCCEEDED,
+                                                   api.POD_FAILED)
+        rec.req_cpu, rec.req_mem = get_resource_request(pod)
+        for c in pod.spec.containers:
+            nz_c, nz_m = get_nonzero_requests(c.resources.requests)
+            rec.nz_cpu += nz_c
+            rec.nz_mem += nz_m
+            for cp in c.ports:
+                if cp.host_port != 0:
+                    bit, grew = self.ports_dict.intern(cp.host_port)
+                    if grew:
+                        self.port_bits = _grow(self.port_bits, 1,
+                                               self.ports_dict.words)
+                    rec.ports.append(bit)
+        for v in pod.spec.volumes:
+            keys, gce_ro = _disk_keys(v)
+            is_gce = v.gce_persistent_disk is not None
+            for dk in keys:
+                bit, grew = self.disk_dict.intern(dk)
+                if grew:
+                    self.disk_any = _grow(self.disk_any, 1,
+                                          self.disk_dict.words)
+                    self.disk_rw = _grow(self.disk_rw, 1,
+                                         self.disk_dict.words)
+                rec.disks.append((bit, True, is_gce and not gce_ro))
+        return rec
+
+    def _apply_record(self, key: str, rec: _PodRecord) -> None:
+        # spread groups see every pod (no phase filter)
+        for g in self.groups.values():
+            if g.matches(rec.ns, rec.labels):
+                slot = self.node_slot.get(rec.node)
+                if slot is None:
+                    g.offgrid[rec.node] = g.offgrid.get(rec.node, 0) + 1
+                else:
+                    g.row[slot] += 1
+        slot = self.node_slot.get(rec.node)
+        if slot is None:
+            self.unknown_node_pods.setdefault(rec.node, set()).add(key)
+            return
+        rec.slot = slot
+        self.node_pods.setdefault(slot, []).append(key)
+        if not rec.counted_res:
+            return
+        self.pod_count[slot] += 1
+        self.nz_cpu[slot] += rec.nz_cpu
+        self.nz_mem[slot] += rec.nz_mem
+        for bit in rec.ports:
+            _set_bit(self.port_bits[slot], bit)
+        for bit, any_q, rw in rec.disks:
+            _set_bit(self.disk_any[slot], bit)
+            if rw:
+                _set_bit(self.disk_rw[slot], bit)
+        # skip-on-misfit replay, arrival order (predicates.go:160-185)
+        cap_c = int(self.cpu_cap[slot])
+        cap_m = int(self.mem_cap[slot])
+        fits_cpu = cap_c == 0 or cap_c - int(self.cpu_used[slot]) >= rec.req_cpu
+        fits_mem = cap_m == 0 or cap_m - int(self.mem_used[slot]) >= rec.req_mem
+        if not fits_cpu:
+            self.exceed_cpu[slot] = True
+            rec.misfit = "cpu"
+        elif not fits_mem:
+            self.exceed_mem[slot] = True
+            rec.misfit = "mem"
+        else:
+            self.cpu_used[slot] += rec.req_cpu
+            self.mem_used[slot] += rec.req_mem
+
+    def _remove_record(self, key: str, rec: _PodRecord) -> None:
+        for g in self.groups.values():
+            if g.matches(rec.ns, rec.labels):
+                slot = self.node_slot.get(rec.node)
+                if slot is None:
+                    left = g.offgrid.get(rec.node, 0) - 1
+                    if left > 0:
+                        g.offgrid[rec.node] = left
+                    else:
+                        g.offgrid.pop(rec.node, None)
+                else:
+                    g.row[slot] -= 1
+        if rec.slot is None:
+            parked = self.unknown_node_pods.get(rec.node)
+            if parked is not None:
+                parked.discard(key)
+                if not parked:
+                    del self.unknown_node_pods[rec.node]
+            return
+        slot = rec.slot
+        keys = self.node_pods.get(slot, [])
+        try:
+            keys.remove(key)
+        except ValueError:
+            pass
+        if not rec.counted_res:
+            return
+        self.pod_count[slot] -= 1
+        self.nz_cpu[slot] -= rec.nz_cpu
+        self.nz_mem[slot] -= rec.nz_mem
+        if rec.ports or rec.disks or self.exceed_cpu[slot] \
+                or self.exceed_mem[slot]:
+            # bitsets aren't reference-counted and the misfit replay is
+            # order-dependent: rebuild this node's aggregates from its
+            # remaining pods (rare path: ports/disks/oversubscription)
+            self._replay_node(slot)
+        elif rec.misfit is None:
+            self.cpu_used[slot] -= rec.req_cpu
+            self.mem_used[slot] -= rec.req_mem
+
+    def _replay_node(self, slot: int) -> None:
+        """Recompute one node's aggregate state from its pod ledger, in
+        insertion order (the arrival-order replay)."""
+        self.cpu_used[slot] = 0
+        self.mem_used[slot] = 0
+        self.nz_cpu[slot] = 0
+        self.nz_mem[slot] = 0
+        self.pod_count[slot] = 0
+        self.port_bits[slot] = 0
+        self.disk_any[slot] = 0
+        self.disk_rw[slot] = 0
+        self.exceed_cpu[slot] = False
+        self.exceed_mem[slot] = False
+        cap_c = int(self.cpu_cap[slot])
+        cap_m = int(self.mem_cap[slot])
+        for key in self.node_pods.get(slot, []):
+            rec = self.pods[key]
+            if not rec.counted_res:
+                continue
+            rec.misfit = None
+            self.pod_count[slot] += 1
+            self.nz_cpu[slot] += rec.nz_cpu
+            self.nz_mem[slot] += rec.nz_mem
+            for bit in rec.ports:
+                _set_bit(self.port_bits[slot], bit)
+            for bit, any_q, rw in rec.disks:
+                _set_bit(self.disk_any[slot], bit)
+                if rw:
+                    _set_bit(self.disk_rw[slot], bit)
+            fits_cpu = cap_c == 0 or \
+                cap_c - int(self.cpu_used[slot]) >= rec.req_cpu
+            fits_mem = cap_m == 0 or \
+                cap_m - int(self.mem_used[slot]) >= rec.req_mem
+            if not fits_cpu:
+                self.exceed_cpu[slot] = True
+                rec.misfit = "cpu"
+            elif not fits_mem:
+                self.exceed_mem[slot] = True
+                rec.misfit = "mem"
+            else:
+                self.cpu_used[slot] += rec.req_cpu
+                self.mem_used[slot] += rec.req_mem
+
+    # ================================================== node bookkeeping
+
+    def _node_upsert(self, node: api.Node) -> None:
+        name = node.metadata.name
+        slot = self.node_slot.get(name)
+        new_node = slot is None
+        if new_node:
+            slot = self._alloc_slot(name)
+        cap_changed = (
+            not new_node and (
+                self.cpu_cap[slot] != (node.status.capacity["cpu"].milli
+                                       if "cpu" in node.status.capacity else 0)
+                or self.mem_cap[slot] != (
+                    node.status.capacity["memory"].value
+                    if "memory" in node.status.capacity else 0)))
+        cap = node.status.capacity
+        self.cpu_cap[slot] = cap["cpu"].milli if "cpu" in cap else 0
+        self.mem_cap[slot] = cap["memory"].value if "memory" in cap else 0
+        self.pod_cap[slot] = cap["pods"].value if "pods" in cap else 0
+        self.label_words[slot] = 0
+        for kv in node.metadata.labels.items():
+            bit, grew = self.labels_dict.intern(kv)
+            if grew:
+                self.label_words = _grow(self.label_words, 1,
+                                         self.labels_dict.words)
+            _set_bit(self.label_words[slot], bit)
+        from ..factory import node_condition_predicate
+        self.valid[slot] = node_condition_predicate(node)
+        if new_node:
+            parked = self.unknown_node_pods.pop(name, None)
+            if parked:
+                for key in sorted(parked):
+                    rec = self.pods[key]
+                    # move spread counts from the offgrid bucket to the row
+                    for g in self.groups.values():
+                        if g.matches(rec.ns, rec.labels):
+                            left = g.offgrid.get(name, 0) - 1
+                            if left > 0:
+                                g.offgrid[name] = left
+                            else:
+                                g.offgrid.pop(name, None)
+                            g.row[slot] += 1
+                    rec.slot = slot
+                    self.node_pods.setdefault(slot, []).append(key)
+                self._replay_node(slot)
+        elif cap_changed:
+            self._replay_node(slot)
+
+    def _alloc_slot(self, name: str) -> int:
+        if self._free_slots:
+            slot = self._free_slots.pop()
+        else:
+            if len(self.node_slot) >= self.n_cap:
+                self._grow_nodes()
+            slot = len(self.node_slot)
+        self.node_slot[name] = slot
+        self.node_names[slot] = name
+        self._tie_dirty = True
+        return slot
+
+    def _grow_nodes(self) -> None:
+        # double while small, then step by 1024: a 5000-node cluster pads
+        # to 5120 lanes (2% waste), not 8192 (64%) — every scan step pays
+        # for the full node axis width
+        new_cap = self.n_cap * 2 if self.n_cap < 1024 else self.n_cap + 1024
+        for attr in ("valid", "cpu_cap", "mem_cap", "pod_cap", "tie_rank",
+                     "cpu_used", "mem_used", "nz_cpu", "nz_mem", "pod_count",
+                     "exceed_cpu", "exceed_mem"):
+            setattr(self, attr, _grow(getattr(self, attr), 0, new_cap))
+        self.tie_rank[self.n_cap:] = -1
+        for attr in ("label_words", "port_bits", "disk_any", "disk_rw"):
+            setattr(self, attr, _grow(getattr(self, attr), 0, new_cap))
+        for g in self.groups.values():
+            g.row = _grow(g.row, 0, new_cap)
+        self.node_names.extend([""] * (new_cap - self.n_cap))
+        self.n_cap = new_cap
+
+    def _recompute_tie_rank(self) -> None:
+        # rank over ALL known names: relative order among valid nodes is
+        # what the tie-break consumes, and a superset ranking preserves it
+        for rank, name in enumerate(sorted(self.node_slot)):
+            self.tie_rank[self.node_slot[name]] = rank
+        self._tie_dirty = False
+
+    # ================================================== group bookkeeping
+
+    def _group_for(self, ns: str, sels: List[Dict[str, str]]) -> _Group:
+        key = (ns, frozenset(frozenset(s.items()) for s in sels))
+        g = self.groups.get(key)
+        if g is None:
+            g = _Group(ns, [dict(s) for s in sels], self.n_cap)
+            # first sighting: one full scan of the ledger seeds the counts;
+            # afterwards the group maintains itself from deltas
+            for rec in self.pods.values():
+                if g.matches(rec.ns, rec.labels):
+                    slot = self.node_slot.get(rec.node)
+                    if slot is None:
+                        g.offgrid[rec.node] = g.offgrid.get(rec.node, 0) + 1
+                    else:
+                        g.row[slot] += 1
+            self.groups[key] = g
+        return g
+
+    # ================================================== tile assembly
+
+    def _intern_pending(self, pod: api.Pod) -> None:
+        """Intern every key a pending pod references, growing the
+        persistent bitset arrays in lockstep — BEFORE tile arrays are
+        allocated, so tile and persistent widths always agree."""
+        for c in pod.spec.containers:
+            for cp in c.ports:
+                if cp.host_port != 0:
+                    _, grew = self.ports_dict.intern(cp.host_port)
+                    if grew:
+                        self.port_bits = _grow(self.port_bits, 1,
+                                               self.ports_dict.words)
+        for kv in pod.spec.node_selector.items():
+            _, grew = self.labels_dict.intern(kv)
+            if grew:
+                self.label_words = _grow(self.label_words, 1,
+                                         self.labels_dict.words)
+        for v in pod.spec.volumes:
+            for dk in _disk_keys(v)[0]:
+                _, grew = self.disk_dict.intern(dk)
+                if grew:
+                    self.disk_any = _grow(self.disk_any, 1,
+                                          self.disk_dict.words)
+                    self.disk_rw = _grow(self.disk_rw, 1,
+                                         self.disk_dict.words)
+
+    def encode_tile(self, pending_pods: List[api.Pod],
+                    services: List[api.Service],
+                    controllers: List[api.ReplicationController]
+                    ) -> EncodeResult:
+        """O(tile) encode against the current persistent state."""
+        with self._lock:
+            if self._tie_dirty:
+                self._recompute_tie_rank()
+            for pod in pending_pods:
+                self._intern_pending(pod)
+            n_pad = self.n_cap
+            L = self.labels_dict.words
+            PW = self.ports_dict.words
+            K = self.disk_dict.words
+            p = len(pending_pods)
+            p_pad = max(1, p)
+
+            # ---- pod batch + spread groups of this tile ----
+            tile_groups: List[_Group] = []
+            group_idx: Dict[int, int] = {}
+            pod_groups: List[int] = []
+            for pod in pending_pods:
+                aff = pod.spec.affinity
+                if aff is not None and (
+                        (aff.pod_affinity is not None
+                         and aff.pod_affinity.required_during_scheduling)
+                        or (aff.pod_anti_affinity is not None
+                            and aff.pod_anti_affinity
+                            .required_during_scheduling)):
+                    raise NeedsFullEncode("inter-pod affinity terms")
+                sels = _pod_spread_selectors(pod, services, controllers)
+                if not sels:
+                    pod_groups.append(-1)
+                    continue
+                g = self._group_for(pod.metadata.namespace, sels)
+                gid = group_idx.get(id(g))
+                if gid is None:
+                    gid = len(tile_groups)
+                    group_idx[id(g)] = gid
+                    tile_groups.append(g)
+                pod_groups.append(gid)
+            G = max(1, len(tile_groups))
+
+            pb = PodArrays(
+                valid=np.zeros(p_pad, bool),
+                req_cpu=np.zeros(p_pad, np.int64),
+                req_mem=np.zeros(p_pad, np.int64),
+                zero_req=np.zeros(p_pad, bool),
+                nz_cpu=np.zeros(p_pad, np.int64),
+                nz_mem=np.zeros(p_pad, np.int64),
+                sel_words=np.zeros((p_pad, L), np.uint32),
+                port_words=np.zeros((p_pad, PW), np.uint32),
+                disk_qany=np.zeros((p_pad, K), np.uint32),
+                disk_qrw=np.zeros((p_pad, K), np.uint32),
+                disk_sany=np.zeros((p_pad, K), np.uint32),
+                disk_srw=np.zeros((p_pad, K), np.uint32),
+                host_idx=np.full(p_pad, -1, np.int32),
+                group_id=np.full(p_pad, -1, np.int32),
+                member=np.zeros((p_pad, G), np.int32),
+                aff_req=np.zeros((p_pad, 1), bool),
+                anti_req=np.zeros((p_pad, 1), bool),
+                aff_member=np.zeros((p_pad, 1), np.int32),
+                svc_group=np.full(p_pad, -1, np.int32),
+                svc_member=np.zeros((p_pad, 1), np.int32))
+            for j, pod in enumerate(pending_pods):
+                pb.valid[j] = True
+                req_cpu, req_mem = get_resource_request(pod)
+                pb.req_cpu[j] = req_cpu
+                pb.req_mem[j] = req_mem
+                pb.zero_req[j] = req_cpu == 0 and req_mem == 0
+                for c in pod.spec.containers:
+                    nz_c, nz_m = get_nonzero_requests(c.resources.requests)
+                    pb.nz_cpu[j] += nz_c
+                    pb.nz_mem[j] += nz_m
+                    for cp in c.ports:
+                        if cp.host_port != 0:
+                            # pre-interned by _intern_pending: never grows
+                            bit, _ = self.ports_dict.intern(cp.host_port)
+                            _set_bit(pb.port_words[j], bit)
+                for kv in pod.spec.node_selector.items():
+                    bit, _ = self.labels_dict.intern(kv)
+                    _set_bit(pb.sel_words[j], bit)
+                for v in pod.spec.volumes:
+                    keys, gce_ro = _disk_keys(v)
+                    is_gce = v.gce_persistent_disk is not None
+                    for dk in keys:
+                        bit, _ = self.disk_dict.intern(dk)
+                        _set_bit(pb.disk_sany[j], bit)
+                        if is_gce and gce_ro:
+                            _set_bit(pb.disk_qrw[j], bit)
+                        else:
+                            _set_bit(pb.disk_qany[j], bit)
+                        if is_gce and not gce_ro:
+                            _set_bit(pb.disk_srw[j], bit)
+                if pod.spec.node_name:
+                    pb.host_idx[j] = self.node_slot.get(pod.spec.node_name,
+                                                        -2)
+                pb.group_id[j] = pod_groups[j]
+                for gid, g in enumerate(tile_groups):
+                    if g.matches(pod.metadata.namespace, pod.metadata.labels):
+                        pb.member[j, gid] = 1
+
+            # ---- views of the persistent state (copied: the reflector
+            # threads keep mutating these arrays while the scan runs) ----
+            nt = NodeArrays(
+                valid=self.valid.copy(),
+                cpu_cap=self.cpu_cap.copy(),
+                mem_cap=self.mem_cap.copy(),
+                pod_cap=self.pod_cap.copy(),
+                label_words=self.label_words.copy(),
+                tie_rank=self.tie_rank.copy(),
+                exceed_cpu=self.exceed_cpu.copy(),
+                exceed_mem=self.exceed_mem.copy(),
+                aff_dom=np.full((1, n_pad), -1, np.int32),
+                zone_id=np.full(n_pad, -1, np.int32),
+                zone_scratch=np.zeros(1, np.int32),
+                static_mask=np.ones(n_pad, bool),
+                static_score=np.zeros(n_pad, np.int64))
+            spread = (np.stack([g.row for g in tile_groups])
+                      if tile_groups else np.zeros((1, n_pad), np.int32))
+            offgrid_max = np.zeros(G, np.int32)
+            for gid, g in enumerate(tile_groups):
+                if g.offgrid:
+                    offgrid_max[gid] = max(g.offgrid.values())
+            st = StateArrays(
+                cpu_used=self.cpu_used.copy(),
+                mem_used=self.mem_used.copy(),
+                nz_cpu=self.nz_cpu.copy(),
+                nz_mem=self.nz_mem.copy(),
+                pod_count=self.pod_count.copy(),
+                port_bits=self.port_bits.copy(),
+                disk_any=self.disk_any.copy(),
+                disk_rw=self.disk_rw.copy(),
+                spread=spread.copy(),
+                aff_count=np.zeros((1, 1), np.int32),
+                aff_total=np.zeros(1, np.int32),
+                svc_count=np.zeros((1, n_pad), np.int32),
+                svc_total=np.zeros(1, np.int32))
+            return EncodeResult(
+                node_tab=nt, pod_batch=pb, init_state=st,
+                offgrid_max=offgrid_max,
+                node_names=list(self.node_names),
+                n_nodes=len(self.node_slot), n_pods=p)
+
+    # ================================================== wiring helpers
+
+    def attach(self, factory) -> "IncrementalEncoder":
+        """Chain onto the factory's scheduled-pod reflector and node
+        informer, then bootstrap from their caches. Events that land
+        between attach and bootstrap are absorbed by the ledger's
+        resourceVersion idempotency check."""
+        sref = factory.scheduled_reflector
+
+        def chain(first, second):
+            if first is None:
+                return second
+            def chained(*a):
+                first(*a)
+                second(*a)
+            return chained
+
+        sref.on_add = chain(sref.on_add, self.on_pod_add)
+        sref.on_update = chain(sref.on_update,
+                               lambda old, new: self.on_pod_update(old, new))
+        sref.on_delete = chain(sref.on_delete, self.on_pod_delete)
+        nref = factory.node_informer.reflector
+        nref.on_add = chain(nref.on_add, self.on_node_add)
+        nref.on_update = chain(nref.on_update,
+                               lambda old, new: self.on_node_update(old, new))
+        nref.on_delete = chain(nref.on_delete, self.on_node_delete)
+        for node in factory.node_informer.cache.list():
+            self.on_node_add(node)
+        for pod in factory.scheduled_cache.list():
+            self.on_pod_add(pod)
+        return self

@@ -1,0 +1,228 @@
+"""Incremental encoder vs full encoder equivalence.
+
+The incremental encoder (sched/device/incremental.py) must produce device
+state that schedules identically to the full per-tile encoder
+(sched/device/tables.py encode_snapshot) for the default provider tier,
+across watch-delta histories: adds, deletes, phase transitions, node
+arrivals/removals, and the assume/watch-echo dedup.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import Quantity
+from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
+                                         encode_snapshot)
+from kubernetes_tpu.sched.device.incremental import (IncrementalEncoder,
+                                                     NeedsFullEncode)
+
+MI = 1024 * 1024
+
+
+def mk_node(name, cpu=4000, mem=1024, pods=110, labels=None, ready=True):
+    conds = [api.NodeCondition(type=api.NODE_READY,
+                               status=api.CONDITION_TRUE if ready
+                               else api.CONDITION_FALSE)]
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        status=api.NodeStatus(
+            capacity={"cpu": Quantity(cpu),
+                      "memory": Quantity(mem * MI * 1000),
+                      "pods": Quantity(pods * 1000)},
+            conditions=conds))
+
+
+def mk_pod(name, node="", cpu=100, mem=64, labels=None, phase="Running",
+           host_port=0, rv="1", ns="default"):
+    ports = [api.ContainerPort(container_port=80, host_port=host_port)] \
+        if host_port else []
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns,
+                                labels=labels or {}, resource_version=rv),
+        spec=api.PodSpec(
+            node_name=node,
+            containers=[api.Container(
+                name="c", image="img", ports=ports,
+                resources=api.ResourceRequirements(requests={
+                    "cpu": Quantity(cpu),
+                    "memory": Quantity(mem * MI * 1000)}))]),
+        status=api.PodStatus(phase=phase))
+
+
+def mk_service(name, selector, ns="default"):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ServiceSpec(selector=selector))
+
+
+def schedule_both(inc, nodes, existing, services, pending):
+    """Run the engine over incremental and full encodings; -> host lists."""
+    engine = BatchEngine()
+    enc_inc = inc.encode_tile(pending, services, [])
+    a_inc, _ = engine.run_chunked(enc_inc, 64)
+    hosts_inc = [enc_inc.node_names[i] if i >= 0 else None
+                 for i in a_inc[:enc_inc.n_pods]]
+    snap = ClusterSnapshot(nodes=[n for n in nodes], existing_pods=existing,
+                           services=services, pending_pods=pending)
+    enc_full = encode_snapshot(snap)
+    a_full, _ = engine.run_chunked(enc_full, 64)
+    hosts_full = [enc_full.node_names[i] if i >= 0 else None
+                  for i in a_full[:enc_full.n_pods]]
+    return hosts_inc, hosts_full
+
+
+def feed(inc, nodes, pods, seed=0):
+    """Feed node/pod adds in shuffled order (watch arrival order is not
+    list order)."""
+    rng = random.Random(seed)
+    nodes = list(nodes)
+    rng.shuffle(nodes)
+    for n in nodes:
+        inc.on_node_add(n)
+    pods = list(pods)
+    rng.shuffle(pods)
+    for p in pods:
+        inc.on_pod_add(p)
+
+
+def test_equivalence_basic():
+    nodes = [mk_node(f"n-{i:02d}", labels={"zone": "a" if i % 2 else "b"})
+             for i in range(10)]
+    existing = [mk_pod(f"e-{j}", node=f"n-{j % 10:02d}",
+                       cpu=200 + 100 * (j % 3),
+                       labels={"app": "web"} if j % 2 else {})
+                for j in range(25)]
+    services = [mk_service("web", {"app": "web"})]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, existing)
+    pending = [mk_pod(f"p-{k}", labels={"app": "web"}) for k in range(12)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, existing, services,
+                                          pending)
+    assert hosts_inc == hosts_full
+    assert all(h is not None for h in hosts_inc)
+
+
+def test_equivalence_phases_and_ports():
+    nodes = [mk_node(f"n-{i:02d}") for i in range(6)]
+    existing = []
+    for j in range(18):
+        phase = ["Running", "Succeeded", "Failed"][j % 3]
+        existing.append(mk_pod(f"e-{j}", node=f"n-{j % 6:02d}", phase=phase,
+                               host_port=9000 + (j % 2),
+                               labels={"app": "db"}))
+    services = [mk_service("db", {"app": "db"})]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, existing, seed=3)
+    # host-port collisions force spread across remaining nodes
+    pending = [mk_pod(f"p-{k}", host_port=9000, labels={"app": "db"})
+               for k in range(4)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, existing, services,
+                                          pending)
+    assert hosts_inc == hosts_full
+
+
+def test_deltas_delete_and_phase_transition():
+    nodes = [mk_node(f"n-{i:02d}", cpu=1000) for i in range(4)]
+    existing = [mk_pod(f"e-{j}", node=f"n-{j % 4:02d}", cpu=300, rv=str(j))
+                for j in range(8)]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, existing)
+    # delete half; transition one to Succeeded (frees resources but stays
+    # in the spread universe)
+    for j in (0, 2, 4):
+        inc.on_pod_delete(existing[j])
+    done = mk_pod("e-1", node="n-01", cpu=300, phase="Succeeded", rv="99")
+    inc.on_pod_update(existing[1], done)
+    remaining = [existing[j] for j in (3, 5, 6, 7)] + [done]
+    pending = [mk_pod(f"p-{k}", cpu=300) for k in range(6)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, remaining, [], pending)
+    assert hosts_inc == hosts_full
+
+
+def test_unknown_node_pod_migrates():
+    inc = IncrementalEncoder()
+    late = mk_node("n-late", cpu=2000)
+    # the pod's node isn't known yet — parked, then migrated on node add
+    inc.on_pod_add(mk_pod("e-0", node="n-late", cpu=500))
+    inc.on_node_add(mk_node("n-00", cpu=2000))
+    inc.on_node_add(late)
+    nodes = [mk_node("n-00", cpu=2000), late]
+    existing = [mk_pod("e-0", node="n-late", cpu=500)]
+    pending = [mk_pod("p-0", cpu=500), mk_pod("p-1", cpu=500)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, existing, [], pending)
+    assert hosts_inc == hosts_full
+
+
+def test_node_readiness_and_capacity_update():
+    n0, n1 = mk_node("n-00"), mk_node("n-01")
+    inc = IncrementalEncoder()
+    inc.on_node_add(n0)
+    inc.on_node_add(n1)
+    inc.on_pod_add(mk_pod("e-0", node="n-00", cpu=1000))
+    # n0 goes NotReady -> only n1 schedulable
+    inc.on_node_update(n0, mk_node("n-00", ready=False))
+    enc = inc.encode_tile([mk_pod("p-0")], [], [])
+    engine = BatchEngine()
+    a, _ = engine.run_chunked(enc, 64)
+    assert enc.node_names[int(a[0])] == "n-01"
+    # capacity shrink triggers a replay (pod no longer fits -> exceed flag)
+    inc.on_node_update(mk_node("n-00"), mk_node("n-00", cpu=500, ready=True))
+    assert inc.exceed_cpu[inc.node_slot["n-00"]]
+
+
+def test_assume_then_watch_echo_dedup():
+    inc = IncrementalEncoder()
+    inc.on_node_add(mk_node("n-00"))
+    bound = mk_pod("p-0", node="n-00", cpu=400, rv="5")
+    inc.assume(bound)
+    slot = inc.node_slot["n-00"]
+    assert inc.cpu_used[slot] == 400
+    # watch confirms with a newer resourceVersion: no double count
+    inc.on_pod_add(mk_pod("p-0", node="n-00", cpu=400, rv="6"))
+    assert inc.cpu_used[slot] == 400
+    assert inc.pod_count[slot] == 1
+
+
+def test_affinity_tile_raises_needs_full_encode():
+    inc = IncrementalEncoder()
+    inc.on_node_add(mk_node("n-00", labels={"zone": "a"}))
+    pod = mk_pod("p-0")
+    pod = api.Pod(
+        metadata=pod.metadata,
+        spec=api.PodSpec(
+            containers=pod.spec.containers,
+            affinity=api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling=[api.PodAffinityTerm(
+                    label_selector={"app": "x"}, topology_key="zone")]))),
+        status=pod.status)
+    with pytest.raises(NeedsFullEncode):
+        inc.encode_tile([pod], [], [])
+
+
+def test_new_group_seeded_from_ledger():
+    """A service selector first seen at tile time must count pods that
+    were already in the ledger."""
+    nodes = [mk_node(f"n-{i:02d}") for i in range(3)]
+    existing = [mk_pod(f"e-{j}", node=f"n-{j % 2:02d}",
+                       labels={"app": "late"}) for j in range(4)]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, existing)
+    services = [mk_service("late", {"app": "late"})]
+    pending = [mk_pod(f"p-{k}", labels={"app": "late"}) for k in range(3)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, existing, services,
+                                          pending)
+    assert hosts_inc == hosts_full
+    # spread must push the first pending pod to the empty node
+    assert hosts_inc[0] == "n-02"
+
+
+def test_node_table_growth_keeps_state():
+    inc = IncrementalEncoder(node_capacity=2)
+    for i in range(5):
+        inc.on_node_add(mk_node(f"n-{i:02d}"))
+        inc.on_pod_add(mk_pod(f"e-{i}", node=f"n-{i:02d}", cpu=250, rv=str(i)))
+    assert inc.n_cap >= 5
+    for i in range(5):
+        assert inc.cpu_used[inc.node_slot[f"n-{i:02d}"]] == 250

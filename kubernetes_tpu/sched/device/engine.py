@@ -132,10 +132,15 @@ def _floordiv_exact(num: jax.Array, den: jax.Array,
     return e
 
 
-def _step(node: NodeConst, weights: Tuple[int, int, int],
-          anti_weight: int, state: State, pod,
-          has_aff: bool = True, has_spread: bool = True
-          ) -> Tuple[State, jax.Array]:
+def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
+                    anti_weight: int, state: State, pod,
+                    has_aff: bool = True, has_spread: bool = True
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Predicate mask + priority totals for ONE pod against `state`.
+
+    The shared core of the scan step and the extender sidecar's
+    filter/prioritize probe (plugin/pkg/scheduler/extender.go:95,119 —
+    the extender server answers per-pod, stateless between requests)."""
     n = node.valid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
 
@@ -249,6 +254,18 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
                       jnp.floor(sa_f).astype(jnp.int64), jnp.int64(10)))
         total = total + anti_weight * sa
 
+    return mask, total
+
+
+def _step(node: NodeConst, weights: Tuple[int, int, int],
+          anti_weight: int, state: State, pod,
+          has_aff: bool = True, has_spread: bool = True
+          ) -> Tuple[State, jax.Array]:
+    n = node.valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    mask, total = _mask_and_score(node, weights, anti_weight, state, pod,
+                                  has_aff, has_spread)
+
     # ---- selection (generic_scheduler.go:95 selectHost) ----
     # one composite argmax: scores are non-negative and tie_rank is a
     # distinct 0..n-1 per valid node, so argmax(total*n + tie_rank) is
@@ -308,6 +325,19 @@ def _make_run(weights: Tuple[int, int, int], anti_weight: int = 0,
                          has_aff, has_spread)
         return jax.lax.scan(step, state, pods)
     return run
+
+
+def _make_probe(weights: Tuple[int, int, int], anti_weight: int = 0,
+                has_aff: bool = True, has_spread: bool = True):
+    """Stateless variant: every pod scored against the same pre-batch
+    state (no sequential commit) — extender Filter/Prioritize answer
+    per-pod without assuming the pod lands (extender.go:95,119)."""
+    def probe(node: NodeConst, state: State, pods: PodXs):
+        def one(pod):
+            return _mask_and_score(node, weights, anti_weight, state, pod,
+                                   has_aff, has_spread)
+        return jax.vmap(one)(pods)
+    return probe
 
 
 def _node_shardings(mesh: Mesh, axis: str):
@@ -408,6 +438,25 @@ class BatchEngine:
                      aff_member=pb.aff_member, svc_group=pb.svc_group,
                      svc_member=pb.svc_member)
         return node, state, pods
+
+    def probe(self, enc: EncodeResult) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (mask bool[P, N], total i64[P, N]) of predicate fit and
+        priority score per pending pod against the pre-batch state. The
+        extender sidecar's kernel; also the device half of mixed-mode
+        (device predicates + HTTP extender filter on survivors)."""
+        node, state, pods = self.device_args(enc)
+        has_aff, _ = self._enc_flags(enc)
+        # has_spread stays ON: compiling the spread tier out shifts every
+        # total by a constant — fine for the scan's argmax, wrong for the
+        # absolute HostPriority scores the extender protocol returns
+        key = ("probe", has_aff)
+        fn = self._runs.get(key)
+        if fn is None:
+            fn = jax.jit(_make_probe(self.weights, self._anti_weight,
+                                     has_aff, has_spread=True))
+            self._runs[key] = fn
+        mask, total = fn(node, state, pods)
+        return np.asarray(mask), np.asarray(total)
 
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
         """-> (assigned node indices i32[P] (-1 = no fit), final state)."""

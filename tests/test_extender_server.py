@@ -1,0 +1,221 @@
+"""Scheduler extender sidecar, server side — over real HTTP.
+
+Ports test/integration/extender_test.go:187 TestSchedulerExtender: two
+extender servers behind the verbatim wire protocol, a policy config
+naming both, the scheduler control loop filtering/prioritizing through
+them; expected placement machine3 (extender_test.go:298-301). Plus the
+TPU-native case the reference cannot have: the device engine serving
+Filter/Prioritize (DeviceBackend), checked for parity against the serial
+oracle through the HTTP client."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.sched.api import (ExtenderConfig, HostPriority, Policy,
+                                      policy_from_json)
+from kubernetes_tpu.sched.extender import HTTPExtender
+from kubernetes_tpu.sched.extender_server import (CallableBackend,
+                                                  DeviceBackend,
+                                                  ExtenderServer)
+from kubernetes_tpu.sched.factory import ConfigFactory
+from kubernetes_tpu.sched.scheduler import Scheduler
+
+
+def ready_node(name, cpu="4", mem="32Gi", pods="32", labels=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        status=api.NodeStatus(
+            capacity={"cpu": parse_quantity(cpu),
+                      "memory": parse_quantity(mem),
+                      "pods": parse_quantity(pods)},
+            conditions=[api.NodeCondition(type="Ready", status="True"),
+                        api.NodeCondition(type="OutOfDisk", status="False")]))
+
+
+def pending_pod(name, cpu="100m", mem="200Mi"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="container", image="kubernetes/pause:go",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity(cpu),
+                          "memory": parse_quantity(mem)}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+def wait_until(cond, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# --- the reference test's fixtures (extender_test.go:149-186) ---
+
+def machine_1_2_3_predicate(pod, node):
+    return node.metadata.name in ("machine1", "machine2", "machine3")
+
+
+def machine_2_3_5_predicate(pod, node):
+    return node.metadata.name in ("machine2", "machine3", "machine5")
+
+
+def machine_2_prioritizer(pod, nodes):
+    return [HostPriority(n.metadata.name,
+                         10 if n.metadata.name == "machine2" else 1)
+            for n in nodes]
+
+
+def machine_3_prioritizer(pod, nodes):
+    return [HostPriority(n.metadata.name,
+                         10 if n.metadata.name == "machine3" else 1)
+            for n in nodes]
+
+
+def test_scheduler_with_extender_sidecars():
+    """TestSchedulerExtender, over real HTTP both hops that matter."""
+    es1 = ExtenderServer(CallableBackend(
+        predicates=[machine_1_2_3_predicate],
+        prioritizers=[(machine_2_prioritizer, 1)])).start()
+    es2 = ExtenderServer(CallableBackend(
+        predicates=[machine_2_3_5_predicate],
+        prioritizers=[(machine_3_prioritizer, 1)])).start()
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    policy = Policy(extenders=[
+        ExtenderConfig(url_prefix=es1.url, filter_verb="filter",
+                       prioritize_verb="prioritize", weight=3),
+        ExtenderConfig(url_prefix=es2.url, filter_verb="filter",
+                       prioritize_verb="prioritize", weight=4)])
+    sched = Scheduler(factory.create_from_config(policy)).run()
+    try:
+        for i in range(5):
+            client.create("nodes", ready_node(f"machine{i + 1}"))
+        client.create("pods", pending_pod("extender-test-pod"))
+        assert wait_until(
+            lambda: client.get("pods", "extender-test-pod").spec.node_name)
+        # intersection of filters = {machine2, machine3}; scores
+        # machine2 = 10*3 + 1*4 = 34, machine3 = 1*3 + 10*4 = 43
+        assert client.get("pods",
+                          "extender-test-pod").spec.node_name == "machine3"
+    finally:
+        sched.stop()
+        factory.stop()
+        es1.stop()
+        es2.stop()
+
+
+def test_policy_file_with_extenders_parses():
+    """The reference ships the config shape as an example
+    (examples/scheduler-policy-config-with-extender.json)."""
+    raw = """{
+      "kind": "Policy", "apiVersion": "v1",
+      "predicates": [{"name": "PodFitsResources"}],
+      "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+      "extenders": [{
+        "urlPrefix": "http://127.0.0.1:12346/scheduler",
+        "filterVerb": "filter", "prioritizeVerb": "prioritize",
+        "weight": 5, "enableHttps": false}]
+    }"""
+    pol = policy_from_json(raw)
+    assert pol.extenders[0].url_prefix == "http://127.0.0.1:12346/scheduler"
+    assert pol.extenders[0].weight == 5
+
+
+def test_filter_error_reported_in_band():
+    """Filter errors must travel in ExtenderFilterResult.error — the
+    caller fails the pod on them (extender.go:95)."""
+    def boom(pod, node):
+        raise RuntimeError("backend exploded")
+
+    es = ExtenderServer(CallableBackend(predicates=[boom])).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=es.url, filter_verb="filter"))
+        with pytest.raises(Exception, match="backend exploded"):
+            ext.filter(pending_pod("p"), [ready_node("n1")])
+    finally:
+        es.stop()
+
+
+def test_prioritize_error_yields_empty_list():
+    def boom(pod, nodes):
+        raise RuntimeError("no scores today")
+
+    es = ExtenderServer(CallableBackend(
+        prioritizers=[(boom, 1)])).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=es.url, prioritize_verb="prioritize"))
+        scores, weight = ext.prioritize(pending_pod("p"), [ready_node("n1")])
+        assert scores == []
+    finally:
+        es.stop()
+
+
+def test_device_backend_parity_with_oracle_over_http():
+    """The north-star seam: a stock (serial) scheduler talking to the TPU
+    backend through the wire protocol gets the oracle's answers.
+
+    Filter must equal the serial predicate pass and prioritize totals the
+    serial priority sums for the default provider set (the engine's
+    existing parity contract, probed per-request here)."""
+    from kubernetes_tpu.sched.generic import find_nodes_that_fit, \
+        prioritize_nodes
+    from kubernetes_tpu.sched import plugins
+    from kubernetes_tpu.sched.plugins import PluginFactoryArgs
+    from kubernetes_tpu.sched.listers import (FakeControllerLister,
+                                              FakeNodeLister, FakePodLister,
+                                              FakeServiceLister)
+
+    nodes = [
+        ready_node("n0", cpu="1", mem="2Gi"),
+        ready_node("n1", cpu="4", mem="32Gi"),
+        ready_node("n2", cpu="8", mem="8Gi", labels={"disk": "ssd"}),
+        ready_node("n3", cpu="2", mem="4Gi"),
+    ]
+    existing = []
+    for i, host in enumerate(["n1", "n1", "n2"]):
+        p = pending_pod(f"existing-{i}", cpu="500m", mem="1Gi")
+        p.spec.node_name = host
+        p.status.phase = "Running"
+        existing.append(p)
+
+    backend = DeviceBackend(state_provider=lambda: (existing, [], []))
+    es = ExtenderServer(backend).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=es.url, filter_verb="filter",
+            prioritize_verb="prioritize", weight=1))
+        pod = pending_pod("probe-pod", cpu="900m", mem="1Gi")
+
+        pod_lister = FakePodLister(existing)
+        args = PluginFactoryArgs(pod_lister=pod_lister,
+                                 service_lister=FakeServiceLister([]),
+                                 controller_lister=FakeControllerLister([]),
+                                 node_lister=FakeNodeLister(nodes))
+        pred_keys, prio_keys = plugins.get_algorithm_provider(
+            plugins.DEFAULT_PROVIDER)
+        preds = plugins.get_fit_predicates(pred_keys, args)
+        prios = plugins.get_priority_configs(prio_keys, args)
+
+        got = {n.metadata.name for n in ext.filter(pod, nodes)}
+        want_nodes, _ = find_nodes_that_fit(pod, pod_lister, preds, nodes)
+        assert got == {n.metadata.name for n in want_nodes}
+
+        scores, _ = ext.prioritize(pod, nodes)
+        got_scores = {s.host: s.score for s in scores}
+        want = prioritize_nodes(pod, pod_lister, prios,
+                                FakeNodeLister(nodes))
+        for entry in want:
+            assert got_scores[entry.host] == entry.score
+    finally:
+        es.stop()

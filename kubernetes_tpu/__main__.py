@@ -1,0 +1,8 @@
+"""`python -m kubernetes_tpu <component>` — the hyperkube entry
+(ref: cmd/hyperkube/main.go:42)."""
+
+import sys
+
+from .hyperkube import main
+
+sys.exit(main())

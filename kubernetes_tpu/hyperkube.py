@@ -1,0 +1,258 @@
+"""hyperkube — the all-in-one component multiplexer.
+
+Reference: cmd/hyperkube/main.go:42 (one binary, component picked by the
+first argument / argv[0] morph) and cmd/kubemark/hollow-node.go:80-130
+(--morph). Run as:
+
+    python -m kubernetes_tpu apiserver  --port 8080 --storage-backend native
+    python -m kubernetes_tpu scheduler  --master http://127.0.0.1:8080 --mode batch
+    python -m kubernetes_tpu controller-manager --master http://...
+    python -m kubernetes_tpu hollow-node  --master http://... --name node-1
+    python -m kubernetes_tpu hollow-fleet --master http://... --num-nodes 100
+    python -m kubernetes_tpu kubectl  -s http://... get pods
+
+Each long-running component prints one READY line to stdout
+(`<component> ready <detail>`) once serving — process supervisors and the
+multi-process tests key on it — then blocks until SIGTERM/SIGINT, stops
+cleanly, and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+
+def _pin_jax_platform() -> None:
+    """Honor JAX_PLATFORMS even though the image's sitecustomize pins the
+    platform at interpreter start (same re-pin tests/conftest.py makes):
+    a scheduler child process launched with JAX_PLATFORMS=cpu must not
+    grab the TPU out from under its parent."""
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+def _read_lines(path: Optional[str]) -> Optional[List[str]]:
+    if not path:
+        return None
+    with open(path) as f:
+        return f.read().splitlines()
+
+
+def _wait_for_master(url: str, timeout_s: float = 60.0) -> None:
+    """Block until the apiserver's /healthz answers (components race the
+    master at process start; the reference's client retries likewise)."""
+    deadline = time.time() + timeout_s
+    last: Exception = RuntimeError("never tried")
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                        timeout=5) as resp:
+                if resp.status == 200:
+                    return
+        except (urllib.error.URLError, OSError) as e:
+            last = e
+        time.sleep(0.1)
+    raise RuntimeError(f"master {url} not healthy after {timeout_s}s: {last}")
+
+
+def _serve_until_signal(ready_line: str, stop_fns) -> int:
+    """Print the READY line, then park until SIGTERM/SIGINT and unwind."""
+    stop_event = threading.Event()
+
+    def on_signal(signum, frame):
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    print(ready_line, flush=True)
+    stop_event.wait()
+    for fn in stop_fns:
+        try:
+            fn()
+        except Exception:
+            pass
+    return 0
+
+
+# ------------------------------------------------------------- components
+
+def run_apiserver(argv: List[str]) -> int:
+    """(ref: cmd/kube-apiserver/app/server.go:358 APIServer.Run)"""
+    p = argparse.ArgumentParser(prog="apiserver")
+    p.add_argument("--bind-address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--storage-backend", choices=["memory", "native"],
+                   default="memory")
+    p.add_argument("--admission-control", default="",
+                   help="ordered comma-separated plugin list "
+                        "(ref: server.go:230)")
+    p.add_argument("--basic-auth-file")
+    p.add_argument("--token-auth-file")
+    p.add_argument("--authorization-mode", default="AlwaysAllow",
+                   choices=["AlwaysAllow", "AlwaysDeny", "ABAC"])
+    p.add_argument("--authorization-policy-file")
+    p.add_argument("--service-cluster-ip-range", default="10.0.0.0/24")
+    p.add_argument("--max-requests-inflight", type=int, default=400)
+    args = p.parse_args(argv)
+
+    from .master import Master, MasterConfig
+    master = Master(MasterConfig(
+        host=args.bind_address, port=args.port,
+        storage_backend=args.storage_backend,
+        admission_control=[s for s in args.admission_control.split(",") if s],
+        basic_auth_lines=_read_lines(args.basic_auth_file),
+        token_auth_lines=_read_lines(args.token_auth_file),
+        authorization_mode=args.authorization_mode,
+        authorization_policy_lines=_read_lines(args.authorization_policy_file),
+        service_cidr=args.service_cluster_ip_range,
+        max_in_flight=args.max_requests_inflight)).start()
+    return _serve_until_signal(f"apiserver ready {master.url}",
+                               [master.stop])
+
+
+def run_scheduler(argv: List[str]) -> int:
+    """(ref: plugin/cmd/kube-scheduler/app/server.go:49-187)"""
+    p = argparse.ArgumentParser(prog="scheduler")
+    p.add_argument("--master", required=True)
+    p.add_argument("--mode", choices=["batch", "serial"], default="batch")
+    p.add_argument("--policy-config-file")
+    p.add_argument("--algorithm-provider", default="DefaultProvider")
+    p.add_argument("--no-rate-limit", action="store_true",
+                   help="disable the 50/s bind rate limit "
+                        "(--bind-pods-qps equivalent)")
+    args = p.parse_args(argv)
+
+    _pin_jax_platform()
+    from .api.client import HttpClient
+    from .sched.api import policy_from_json
+    from .sched.batch import BatchScheduler
+    from .sched.factory import ConfigFactory
+    from .sched.scheduler import Scheduler
+
+    _wait_for_master(args.master)
+    client = HttpClient(args.master)
+    factory = ConfigFactory(client, rate_limit=not args.no_rate_limit).start()
+
+    policy = None
+    if args.policy_config_file:
+        with open(args.policy_config_file) as f:
+            policy = policy_from_json(f.read())
+
+    if args.mode == "batch":
+        config = factory.create_batch(policy)
+        if config is not None:
+            sched = BatchScheduler(config).run()
+        else:
+            # the provable serial fallback: this policy doesn't map onto
+            # the device engine (extenders / custom predicates)
+            sched = Scheduler(
+                factory.create_from_config(policy) if policy
+                else factory.create_from_provider(
+                    args.algorithm_provider)).run()
+    else:
+        sched = Scheduler(
+            factory.create_from_config(policy) if policy
+            else factory.create_from_provider(args.algorithm_provider)).run()
+    return _serve_until_signal(
+        f"scheduler ready mode={args.mode}", [sched.stop, factory.stop])
+
+
+def run_controller_manager(argv: List[str]) -> int:
+    """(ref: cmd/kube-controller-manager/app/controllermanager.go:284)"""
+    p = argparse.ArgumentParser(prog="controller-manager")
+    p.add_argument("--master", required=True)
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .controllers.manager import ControllerManager
+
+    _wait_for_master(args.master)
+    manager = ControllerManager(HttpClient(args.master)).run()
+    return _serve_until_signal("controller-manager ready", [manager.stop])
+
+
+def run_hollow_node(argv: List[str]) -> int:
+    """(ref: cmd/kubemark/hollow-node.go:80 --morph=kubelet)"""
+    p = argparse.ArgumentParser(prog="hollow-node")
+    p.add_argument("--master", required=True)
+    p.add_argument("--name", required=True)
+    p.add_argument("--cpu", default="4")
+    p.add_argument("--memory", default="32Gi")
+    p.add_argument("--max-pods", type=int, default=40)
+    args = p.parse_args(argv)
+
+    from .agents.hollow_node import HollowKubelet
+    from .api.client import HttpClient
+
+    _wait_for_master(args.master)
+    kubelet = HollowKubelet(HttpClient(args.master), args.name,
+                            cpu=args.cpu, memory=args.memory,
+                            max_pods=args.max_pods).run()
+    return _serve_until_signal(f"hollow-node ready {args.name}",
+                               [kubelet.stop])
+
+
+def run_hollow_fleet(argv: List[str]) -> int:
+    """A fleet of hollow nodes in one process (ref: pkg/kubemark/ +
+    test/kubemark/start-kubemark.sh: NUM_NODES hollow-node replicas)."""
+    p = argparse.ArgumentParser(prog="hollow-fleet")
+    p.add_argument("--master", required=True)
+    p.add_argument("--num-nodes", type=int, default=100)
+    p.add_argument("--name-prefix", default="hollow-")
+    p.add_argument("--cpu", default="4")
+    p.add_argument("--memory", default="32Gi")
+    p.add_argument("--max-pods", type=int, default=40)
+    p.add_argument("--heartbeat-interval", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .kubemark.fleet import HollowFleet
+
+    _wait_for_master(args.master)
+    fleet = HollowFleet(HttpClient(args.master), args.num_nodes,
+                        name_prefix=args.name_prefix, cpu=args.cpu,
+                        memory=args.memory, max_pods=args.max_pods,
+                        heartbeat_interval=args.heartbeat_interval).run()
+    return _serve_until_signal(
+        f"hollow-fleet ready nodes={args.num_nodes}", [fleet.stop])
+
+
+def run_kubectl(argv: List[str]) -> int:
+    from .cli.cmd import main as kubectl_main
+    return kubectl_main(argv)
+
+
+COMPONENTS = {
+    "apiserver": run_apiserver,
+    "kube-apiserver": run_apiserver,
+    "scheduler": run_scheduler,
+    "kube-scheduler": run_scheduler,
+    "controller-manager": run_controller_manager,
+    "kube-controller-manager": run_controller_manager,
+    "hollow-node": run_hollow_node,
+    "hollow-fleet": run_hollow_fleet,
+    "kubectl": run_kubectl,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        names = " | ".join(sorted(set(COMPONENTS)))
+        print(f"usage: python -m kubernetes_tpu <{names}> [flags]")
+        return 0 if argv else 1
+    name = argv[0]
+    if name not in COMPONENTS:
+        print(f"unknown component {name!r}", file=sys.stderr)
+        return 1
+    return COMPONENTS[name](argv[1:])

@@ -1,0 +1,158 @@
+"""Multi-process deployment: apiserver + scheduler + fleet as separate
+OS processes over HTTP + the native store.
+
+The reference runs every component as its own binary against etcd
+(cmd/hyperkube/main.go:42, test/integration's in-process master being the
+exception, master_utils.go:92); round 1 only ever composed in-proc. Here
+the full bind pipeline crosses real process boundaries: pods created over
+HTTP land in the apiserver process (C++ NativeStore backend), the
+scheduler process sees them through its HTTP watch, binds over HTTP, and
+the hollow-fleet process confirms them Running."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import HttpClient
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(component, *flags):
+    """Start a hyperkube component; returns (proc, ready_line)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu", component, *flags],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+    return proc
+
+
+def wait_ready(proc, timeout_s=120.0):
+    """Block until the component prints its READY line."""
+    line = proc.stdout.readline()
+    if not line:
+        raise RuntimeError(
+            f"component died: {proc.stderr.read()[-2000:]}")
+    assert " ready" in line, line
+    return line.strip()
+
+
+def terminate(proc):
+    """SIGTERM and assert the clean-exit contract."""
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+            raise
+    return proc.returncode
+
+
+def bench_pod(i):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"mp-pod-{i:03d}", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity("100m"),
+                          "memory": parse_quantity("64Mi")}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+@pytest.mark.slow
+def test_split_process_bind_pipeline():
+    n_nodes, n_pods = 10, 40
+    procs = []
+    try:
+        apiserver = spawn("apiserver", "--port", "0",
+                          "--storage-backend", "native",
+                          "--admission-control", "NamespaceAutoProvision")
+        procs.append(apiserver)
+        url = wait_ready(apiserver).split()[-1]
+
+        fleet = spawn("hollow-fleet", "--master", url,
+                      "--num-nodes", str(n_nodes),
+                      "--heartbeat-interval", "60")
+        sched = spawn("scheduler", "--master", url, "--mode", "batch",
+                      "--no-rate-limit")
+        procs += [fleet, sched]
+        wait_ready(fleet)
+        wait_ready(sched)
+
+        client = HttpClient(url)
+        for i in range(n_pods):
+            client.create("pods", bench_pod(i), "default")
+
+        deadline = time.time() + 180
+        bound = running = 0
+        while time.time() < deadline:
+            pods, _ = client.list("pods", "default")
+            mine = [p for p in pods
+                    if p.metadata.name.startswith("mp-pod-")]
+            bound = sum(1 for p in mine if p.spec.node_name)
+            running = sum(1 for p in mine
+                          if p.status.phase == "Running")
+            if bound >= n_pods and running >= n_pods:
+                break
+            time.sleep(0.2)
+        assert bound == n_pods, f"only {bound}/{n_pods} bound"
+        assert running == n_pods, f"only {running}/{n_pods} running"
+
+        # every binding target must be a fleet node that exists
+        nodes = {n.metadata.name for n in client.list("nodes")[0]}
+        for p in client.list("pods", "default")[0]:
+            if p.metadata.name.startswith("mp-pod-"):
+                assert p.spec.node_name in nodes
+    finally:
+        errs = []
+        for proc in reversed(procs):
+            try:
+                rc = terminate(proc)
+                if rc != 0:
+                    errs.append(
+                        f"rc={rc}: {proc.stderr.read()[-1500:]}")
+            except Exception as e:
+                errs.append(repr(e))
+        assert not errs, errs
+
+
+def test_kubectl_against_live_apiserver():
+    """CLI process against an apiserver process (the operator loop)."""
+    apiserver = spawn("apiserver", "--port", "0")
+    try:
+        url = wait_ready(apiserver).split()[-1]
+        client = HttpClient(url)
+        client.create("namespaces",
+                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        client.create("pods", bench_pod(0), "default")
+        out = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu", "kubectl",
+             "-s", url, "get", "pods"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "mp-pod-000" in out.stdout
+    finally:
+        assert terminate(apiserver) == 0
+
+
+def test_hyperkube_usage():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
+    assert out.returncode == 1
+    assert "apiserver" in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu", "no-such-thing"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
+    assert out.returncode == 1

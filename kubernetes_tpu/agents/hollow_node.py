@@ -37,11 +37,13 @@ class FakeRuntime:
 
     def __init__(self):
         self._running: Dict[str, List[api.ContainerStatus]] = {}
+        self._pods: Dict[str, api.Pod] = {}  # key -> latest pod object
         self._lock = threading.Lock()
 
     def run_pod(self, pod: api.Pod) -> List[api.ContainerStatus]:
         key = pod_key(pod)
         with self._lock:
+            self._pods[key] = pod
             # already running: report the existing containers so started_at
             # stays stable across resyncs (a real runtime wouldn't restart)
             if key in self._running:
@@ -59,10 +61,59 @@ class FakeRuntime:
     def kill_pod(self, pod: api.Pod) -> None:
         with self._lock:
             self._running.pop(pod_key(pod), None)
+            self._pods.pop(pod_key(pod), None)
 
     def running_pods(self) -> List[str]:
         with self._lock:
             return list(self._running)
+
+    def pods(self) -> List[api.Pod]:
+        """Latest bound-pod objects (the KubeletServer /pods source)."""
+        with self._lock:
+            return list(self._pods.values())
+
+    # -- kubelet-server seam (kubelet/server.py KubeletServer.runtime) --
+
+    def get_pods(self):
+        """The runtime's view in kubecontainer.Pod shape
+        (ref: kubecontainer.Runtime.GetPods)."""
+        from ..kubelet.container import RuntimeContainer, RuntimePod
+        out = []
+        with self._lock:
+            for key, statuses in self._running.items():
+                pod = self._pods.get(key)
+                if pod is None:
+                    continue
+                out.append(RuntimePod(
+                    uid=pod.metadata.uid, name=pod.metadata.name,
+                    namespace=pod.metadata.namespace,
+                    containers=[RuntimeContainer(
+                        id=cs.container_id, name=cs.name, image=cs.image)
+                        for cs in statuses]))
+        return out
+
+    def get_container_logs(self, pod_uid: str, name: str,
+                           tail_lines: int = 0) -> str:
+        with self._lock:
+            for key, pod in self._pods.items():
+                if pod.metadata.uid != pod_uid or key not in self._running:
+                    continue
+                if any(cs.name == name for cs in self._running[key]):
+                    from ..kubelet.container import tail_text
+                    return tail_text(
+                        f"hollow logs for {pod.metadata.name}/{name}\n",
+                        tail_lines)
+        raise KeyError(f"container {name!r} not found")
+
+    def exec_in_container(self, pod_uid: str, name: str, cmd):
+        with self._lock:
+            known = any(
+                pod.metadata.uid == pod_uid
+                and any(cs.name == name for cs in self._running.get(key, []))
+                for key, pod in self._pods.items())
+        if not known:
+            raise KeyError(f"container {name!r} not found")
+        return 0, f"hollow exec: {' '.join(cmd)}\n"
 
 
 pod_key = meta_namespace_key
@@ -131,7 +182,8 @@ class HollowKubelet:
                  heartbeat_interval: float = 10.0,
                  clock: Optional[Clock] = None,
                  runtime: Optional[FakeRuntime] = None,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 serve_http: bool = False):
         self.client = client
         self.node_name = node_name
         self.cpu = cpu
@@ -145,6 +197,19 @@ class HollowKubelet:
         self._informer: Optional[Informer] = None
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        # the node's remote surface (ref: hollow nodes run the REAL
+        # kubelet server in kubemark, hollow_kubelet.go:35); port lands
+        # in NodeStatus.daemon_endpoints for the apiserver proxy
+        # allocatable accounting (stub: no reservations, hollow-node.go:101)
+        from ..kubelet.cm import stub_container_manager
+        self.container_manager = stub_container_manager()
+        self.server = None
+        if serve_http:
+            from ..kubelet.server import KubeletServer
+            self.server = KubeletServer(
+                node_name, self.runtime.pods, self.runtime,
+                self._capacity,
+                container_manager=self.container_manager)
 
     # -- node object ------------------------------------------------------
 
@@ -164,12 +229,27 @@ class HollowKubelet:
                               last_heartbeat_time=ts),
         ]
 
+    def _endpoints(self) -> api.NodeDaemonEndpoints:
+        port = self.server.port if self.server is not None else 0
+        return api.NodeDaemonEndpoints(
+            kubelet_endpoint=api.DaemonEndpoint(port=port))
+
+    def _addresses(self) -> List[api.NodeAddress]:
+        if self.server is None:
+            return []
+        return [api.NodeAddress(type="InternalIP",
+                                address=self.server.host)]
+
     def _node_object(self) -> api.Node:
         return api.Node(
             metadata=api.ObjectMeta(name=self.node_name, labels=self.labels),
             status=api.NodeStatus(
                 capacity=self._capacity(),
+                allocatable=self.container_manager.allocatable(
+                    self._capacity()),
                 conditions=self._conditions(),
+                addresses=self._addresses(),
+                daemon_endpoints=self._endpoints(),
                 node_info=api.NodeSystemInfo(
                     kubelet_version="hollow",
                     container_runtime_version="fake://0")))
@@ -189,7 +269,11 @@ class HollowKubelet:
             # the store/cache-resident one in place (core/store.py contract)
             updated = replace(node, status=replace(
                 node.status, capacity=self._capacity(),
-                conditions=self._conditions()))
+                allocatable=self.container_manager.allocatable(
+                    self._capacity()),
+                conditions=self._conditions(),
+                addresses=self._addresses(),
+                daemon_endpoints=self._endpoints()))
             self.client.update_status("nodes", updated)
         except NotFound:
             # node object deleted (e.g. by a node controller) or initial
@@ -235,6 +319,8 @@ class HollowKubelet:
     # -- lifecycle --------------------------------------------------------
 
     def run(self) -> "HollowKubelet":
+        if self.server is not None:
+            self.server.start()
         self.register()
         self.status_manager.start()
         self._informer = Informer(
@@ -253,3 +339,5 @@ class HollowKubelet:
         if self._informer:
             self._informer.stop()
         self.status_manager.stop()
+        if self.server is not None:
+            self.server.stop()

@@ -19,7 +19,8 @@ import urllib.request
 from typing import Any, Iterator, List, Optional, Tuple
 
 from ..core import types as api
-from ..core.errors import ApiError, from_status
+from ..core.errors import (ApiError, BadGateway, BadRequest, NotFound,
+                           from_status)
 from ..core.scheme import Scheme, default_scheme
 from ..core.watch import Event, Watcher
 from .registry import Registry
@@ -65,6 +66,12 @@ class Client:
     def finalize_namespace(self, obj: api.Namespace) -> Any:
         raise NotImplementedError
 
+    def pod_logs(self, name: str, namespace: str = "default",
+                 container: str = "", tail_lines: int = 0) -> str:
+        """Container logs via the pod `log` subresource (the apiserver
+        relays to the node's kubelet server)."""
+        raise NotImplementedError
+
 
 class InProcClient(Client):
     def __init__(self, registry: Registry):
@@ -97,6 +104,31 @@ class InProcClient(Client):
 
     def bind_batch(self, bindings, namespace=""):
         return self.registry.bind_batch(bindings, namespace)
+
+    def pod_logs(self, name, namespace="default", container="",
+                 tail_lines=0):
+        # even in-proc, the kubelet is across the network: resolve the
+        # node's daemon endpoint and fetch (same relay ApiServer does)
+        from ..kubelet.server import kubelet_base_url
+        pod = self.registry.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {name!r} is not scheduled yet")
+        if not container:
+            container = pod.spec.containers[0].name
+        node = self.registry.get("nodes", pod.spec.node_name)
+        url = (f"{kubelet_base_url(node)}/containerLogs/"
+               f"{namespace}/{name}/{container}")
+        if tail_lines:
+            url += f"?tailLines={tail_lines}"
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(e.read().decode(errors="replace"))
+            raise BadGateway(f"kubelet answered {e.code}")
+        except (urllib.error.URLError, OSError) as e:
+            raise BadGateway(f"kubelet unreachable: {e}")
 
     def finalize_namespace(self, obj):
         return self.registry.finalize_namespace(obj)
@@ -279,3 +311,25 @@ class HttpClient(Client):
         data = self._do("POST", self._url("bindings", namespace),
                         raw_body=payload)
         return [self._decode({**i, "kind": "Pod"}) for i in data["items"]]
+
+    def pod_logs(self, name, namespace="default", container="",
+                 tail_lines=0):
+        query = {"container": container}
+        if tail_lines:
+            query["tailLines"] = str(tail_lines)
+        url = self._url("pods", namespace, name, "log", query)
+        resp = self._do("GET", url, stream=True)
+        try:
+            return resp.read().decode()
+        finally:
+            resp.close()
+
+    def node_proxy(self, node_name: str, path: str) -> bytes:
+        """GET through the apiserver's node proxy
+        (/api/v1/proxy/nodes/{name}/{path})."""
+        url = f"{self.base_url}/api/v1/proxy/nodes/{node_name}/{path}"
+        resp = self._do("GET", url, stream=True)
+        try:
+            return resp.read()
+        finally:
+            resp.close()

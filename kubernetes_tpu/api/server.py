@@ -31,7 +31,7 @@ from typing import Any, Optional, Tuple
 
 from ..auth.authenticate import authenticate_request
 from ..auth.authorize import AuthorizerAttributes
-from ..core.errors import (ApiError, BadRequest, Forbidden,
+from ..core.errors import (ApiError, BadGateway, BadRequest, Forbidden,
                            MethodNotSupported, NotFound, TooManyRequests,
                            Unauthorized)
 from ..core.scheme import Scheme, default_scheme
@@ -241,12 +241,27 @@ class ApiServer:
 
         if not parts:
             raise NotFound(f"path {path!r} not found")
+        # node proxy: /api/v1/proxy/nodes/{name}/{kubelet path...}
+        # (ref: pkg/apiserver ProxyHandler + master.go "proxy/nodes")
+        if parts[0] == "proxy" and len(parts) >= 3 and parts[1] == "nodes":
+            if method != "GET":
+                raise MethodNotSupported("node proxy supports GET")
+            # forward the ORIGINAL query string: the flattened `query`
+            # dict drops repeated params (kubelet /exec takes repeated
+            # ?command=)
+            raw_q = urllib.parse.urlsplit(h.path).query
+            return self._proxy_node(h, parts[2], "/".join(parts[3:]), raw_q)
         resource = parts[0]
         name = parts[1] if len(parts) > 1 else ""
         sub = parts[2] if len(parts) > 2 else ""
         watching = is_watch_path or query.get("watch") in ("true", "1")
 
         if method == "GET":
+            if resource == "pods" and sub == "log":
+                # ref: pod log subresource — the apiserver relays to the
+                # node's kubelet server (pkg/registry/pod/etcd LogREST ->
+                # kubelet /containerLogs, server.go:242)
+                return self._serve_pod_log(h, namespace, name, query)
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
@@ -304,6 +319,52 @@ class ApiServer:
             return self._send_json(h, 200, self.scheme.encode_dict(obj))
 
         raise MethodNotSupported(f"method {method} not supported")
+
+    # ----------------------------------------------------- kubelet relay
+
+    def _kubelet_base(self, node_name: str) -> str:
+        from ..kubelet.server import kubelet_base_url
+        node = self.registry.get("nodes", node_name)
+        try:
+            return kubelet_base_url(node)
+        except KeyError as e:
+            raise NotFound(str(e))
+
+    def _relay(self, h, url: str) -> None:
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                self._send_raw(h, resp.status, resp.read(),
+                               resp.headers.get("Content-Type",
+                                                "text/plain"))
+        except urllib.error.HTTPError as e:
+            self._send_raw(h, e.code, e.read(), "text/plain")
+        except (urllib.error.URLError, OSError) as e:
+            raise BadGateway(f"kubelet unreachable: {e}")
+
+    def _serve_pod_log(self, h, namespace: str, name: str,
+                       query: dict) -> None:
+        pod = self.registry.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {name!r} is not scheduled yet")
+        container = query.get("container", "")
+        if not container:
+            if len(pod.spec.containers) > 1:
+                raise BadRequest(
+                    f"pod {name!r} has several containers; "
+                    f"set ?container=")
+            container = pod.spec.containers[0].name
+        q = f"?tailLines={query['tailLines']}" if "tailLines" in query else ""
+        base = self._kubelet_base(pod.spec.node_name)
+        self._relay(
+            h, f"{base}/containerLogs/{namespace}/{name}/{container}{q}")
+
+    def _proxy_node(self, h, node_name: str, rest: str,
+                    raw_query: str) -> None:
+        base = self._kubelet_base(node_name)
+        self._relay(h, f"{base}/{rest}"
+                    + (f"?{raw_query}" if raw_query else ""))
 
     # -------------------------------------------------------------- watch
 

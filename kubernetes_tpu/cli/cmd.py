@@ -444,8 +444,14 @@ class Kubectl:
         self.out.write(f"horizontalpodautoscalers/{name} autoscaled\n")
 
     def logs(self, ns, pod_name, container="") -> None:
-        """Hollow runtimes have no log stream; report container state
-        (the kubelet log endpoint is the real source, server.go:242)."""
+        """Stream from the node's kubelet via the pod log subresource
+        (the kubelet log endpoint, server.go:242). Nodes that serve no
+        kubelet endpoint fall back to a container-state summary."""
+        try:
+            self.out.write(self.client.pod_logs(pod_name, ns, container))
+            return
+        except (ApiError, NotImplementedError, KeyError):
+            pass
         pod = self.client.get("pods", pod_name, ns)
         for cs in pod.status.container_statuses:
             if container and cs.name != container:

@@ -51,6 +51,16 @@ def describe_node(node: api.Node, pods, events) -> str:
     out.append("Capacity:")
     for r, q in sorted(node.status.capacity.items()):
         out.append(f"  {r}:\t{q}")
+    if node.status.allocatable:
+        out.append("Allocatable:")
+        for r, q in sorted(node.status.allocatable.items()):
+            out.append(f"  {r}:\t{q}")
+    if node.status.addresses:
+        _kv(out, "Addresses", ",".join(
+            a.address for a in node.status.addresses))
+    if node.status.daemon_endpoints.kubelet_endpoint.port:
+        _kv(out, "Kubelet Port",
+            str(node.status.daemon_endpoints.kubelet_endpoint.port))
     out.append(f"Pods:\t({len(pods)} in total)")
     for p in pods:
         out.append(f"  {p.metadata.namespace}/{p.metadata.name}")

@@ -84,11 +84,17 @@ class Expired(ApiError):
     reason = "Expired"
 
 
+class BadGateway(ApiError):
+    """An upstream the apiserver relays to (a node's kubelet) failed."""
+    code = 502
+    reason = "BadGateway"
+
+
 def from_status(status: dict) -> ApiError:
     reason = status.get("reason", "")
     for cls in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest,
                 MethodNotSupported, Unauthorized, Forbidden, TooManyRequests,
-                Expired):
+                Expired, BadGateway):
         if cls.reason == reason:
             err = cls(status.get("message", ""))
             details = status.get("details") or {}

@@ -395,12 +395,28 @@ class NodeSystemInfo:
 
 
 @dataclass
+class DaemonEndpoint:
+    """(ref: pkg/api/types.go DaemonEndpoint)"""
+    port: int = 0
+
+
+@dataclass
+class NodeDaemonEndpoints:
+    """Where the node's kubelet server listens
+    (ref: pkg/api/types.go NodeDaemonEndpoints; served by
+    pkg/kubelet/server.go and consumed by the apiserver node proxy)."""
+    kubelet_endpoint: DaemonEndpoint = field(default_factory=DaemonEndpoint)
+
+
+@dataclass
 class NodeStatus:
     capacity: Dict[str, Quantity] = field(default_factory=dict)
     allocatable: Dict[str, Quantity] = field(default_factory=dict)
     phase: str = ""
     conditions: List[NodeCondition] = field(default_factory=list)
     addresses: List[NodeAddress] = field(default_factory=list)
+    daemon_endpoints: NodeDaemonEndpoints = field(
+        default_factory=NodeDaemonEndpoints)
     node_info: NodeSystemInfo = field(default_factory=NodeSystemInfo)
 
 

@@ -148,18 +148,13 @@ def run_scheduler(argv: List[str]) -> int:
         with open(args.policy_config_file) as f:
             policy = policy_from_json(f.read())
 
-    if args.mode == "batch":
-        config = factory.create_batch(policy)
-        if config is not None:
-            sched = BatchScheduler(config).run()
-        else:
-            # the provable serial fallback: this policy doesn't map onto
-            # the device engine (extenders / custom predicates)
-            sched = Scheduler(
-                factory.create_from_config(policy) if policy
-                else factory.create_from_provider(
-                    args.algorithm_provider)).run()
+    config = factory.create_batch(policy) if args.mode == "batch" else None
+    if config is not None:
+        sched = BatchScheduler(config).run()
     else:
+        # --mode serial, or the provable serial fallback: this policy
+        # doesn't map onto the device engine (extenders / custom
+        # predicates)
         sched = Scheduler(
             factory.create_from_config(policy) if policy
             else factory.create_from_provider(args.algorithm_provider)).run()
@@ -189,6 +184,10 @@ def run_hollow_node(argv: List[str]) -> int:
     p.add_argument("--cpu", default="4")
     p.add_argument("--memory", default="32Gi")
     p.add_argument("--max-pods", type=int, default=40)
+    p.add_argument("--serve-http", action="store_true",
+                   help="serve the kubelet HTTP surface (/pods /stats "
+                        "/containerLogs ...) and register its port on "
+                        "the Node (server.go:210)")
     args = p.parse_args(argv)
 
     from .agents.hollow_node import HollowKubelet
@@ -197,7 +196,8 @@ def run_hollow_node(argv: List[str]) -> int:
     _wait_for_master(args.master)
     kubelet = HollowKubelet(HttpClient(args.master), args.name,
                             cpu=args.cpu, memory=args.memory,
-                            max_pods=args.max_pods).run()
+                            max_pods=args.max_pods,
+                            serve_http=args.serve_http).run()
     return _serve_until_signal(f"hollow-node ready {args.name}",
                                [kubelet.stop])
 

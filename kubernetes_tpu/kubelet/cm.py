@@ -1,0 +1,46 @@
+"""Container manager — node allocatable accounting.
+
+Reference: pkg/kubelet/cm (541 LoC: cgroup setup for node allocatable,
+system/kube reserved carve-outs) and NewStubContainerManager
+(cmd/kubemark/hollow-node.go:101 — what hollow nodes run). The TPU-native
+build has no cgroups to configure; what survives is the accounting
+contract: allocatable = capacity - system-reserved - kube-reserved,
+published on NodeStatus so the scheduler's resource predicates see the
+node's true usable envelope rather than raw capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..core.quantity import Quantity
+
+
+class ContainerManager:
+    """(ref: pkg/kubelet/cm/container_manager.go ContainerManager)"""
+
+    def __init__(self,
+                 system_reserved: Optional[Dict[str, Quantity]] = None,
+                 kube_reserved: Optional[Dict[str, Quantity]] = None):
+        self.system_reserved = dict(system_reserved or {})
+        self.kube_reserved = dict(kube_reserved or {})
+
+    def allocatable(self, capacity: Dict[str, Quantity]
+                    ) -> Dict[str, Quantity]:
+        """capacity minus reservations, floored at zero (a reservation
+        larger than capacity must not go negative into the scheduler)."""
+        out: Dict[str, Quantity] = {}
+        for resource, cap in capacity.items():
+            reserved = 0
+            for res_map in (self.system_reserved, self.kube_reserved):
+                q = res_map.get(resource)
+                if q is not None:
+                    reserved += q.milli
+            out[resource] = Quantity(max(0, cap.milli - reserved))
+        return out
+
+
+def stub_container_manager() -> ContainerManager:
+    """(ref: NewStubContainerManager — no reservations; allocatable ==
+    capacity, the hollow-node configuration)"""
+    return ContainerManager()

@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..core import types as api
 
@@ -19,6 +19,14 @@ from ..core import types as api
 class ContainerState:
     RUNNING = "running"
     EXITED = "exited"
+
+
+def tail_text(text: str, tail_lines: int) -> str:
+    """Last N lines (0 = all) — the /containerLogs?tailLines contract,
+    shared by every runtime."""
+    if tail_lines > 0:
+        return "".join(text.splitlines(keepends=True)[-tail_lines:])
+    return text
 
 
 @dataclass
@@ -60,6 +68,17 @@ class Runtime:
     def kill_pod(self, pod_uid: str) -> None:
         raise NotImplementedError
 
+    def get_container_logs(self, pod_uid: str, name: str,
+                           tail_lines: int = 0) -> str:
+        """(ref: kubecontainer.Runtime GetContainerLogs, served by the
+        kubelet's /containerLogs endpoint, server.go:242)"""
+        raise NotImplementedError
+
+    def exec_in_container(self, pod_uid: str, name: str,
+                          cmd: List[str]) -> Tuple[int, str]:
+        """-> (exit_code, combined output) (ref: ExecInContainer)"""
+        raise NotImplementedError
+
 
 class FakeRuntime(Runtime):
     """In-memory runtime: containers 'run' until told otherwise.
@@ -74,6 +93,7 @@ class FakeRuntime(Runtime):
         self._lock = threading.Lock()
         self._fail_next = 0
         self._counter = 0
+        self._logs: Dict[Tuple[str, str], str] = {}  # (uid, name) -> text
 
     # ----------------------------------------------------- Runtime API
 
@@ -116,7 +136,33 @@ class FakeRuntime(Runtime):
         with self._lock:
             self._pods.pop(pod_uid, None)
 
+    def get_container_logs(self, pod_uid: str, name: str,
+                           tail_lines: int = 0) -> str:
+        with self._lock:
+            text = self._logs.get((pod_uid, name))
+            if text is None:
+                rp = self._pods.get(pod_uid)
+                known = rp is not None and any(
+                    c.name == name for c in rp.containers)
+                if not known:
+                    raise KeyError(f"container {name!r} not found")
+                text = f"fake logs for {name}\n"
+        return tail_text(text, tail_lines)
+
+    def exec_in_container(self, pod_uid: str, name: str,
+                          cmd: List[str]) -> Tuple[int, str]:
+        with self._lock:
+            rp = self._pods.get(pod_uid)
+            if rp is None or not any(c.name == name for c in rp.containers):
+                raise KeyError(f"container {name!r} not found")
+        return 0, f"fake exec: {' '.join(cmd)}\n"
+
     # ------------------------------------------------- test controls
+
+    def set_container_logs(self, pod_uid: str, name: str,
+                           text: str) -> None:
+        with self._lock:
+            self._logs[(pod_uid, name)] = text
 
     def exit_container(self, pod_uid: str, name: str,
                        exit_code: int = 1) -> None:

@@ -113,6 +113,12 @@ class Kubelet:
                 self._workers[uid] = worker
             return worker
 
+    def get_pods(self) -> List[api.Pod]:
+        """Current bound-pod specs (the KubeletServer /pods source;
+        ref: kubelet.go GetPods)."""
+        with self._lock:
+            return list(self._pods.values())
+
     def handle_pod_addition(self, pod: api.Pod) -> None:
         """(kubelet.go:2394 HandlePodAdditions)"""
         with self._lock:

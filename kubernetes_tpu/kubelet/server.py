@@ -1,0 +1,205 @@
+"""The kubelet's HTTP server — the node plane's remote surface.
+
+Reference: pkg/kubelet/server.go (InstallDefaultHandlers :210 — /healthz,
+/pods, /stats, /spec; InstallDebuggingHandlers :242 — /runningpods,
+/containerLogs, /exec, /metrics). Routes:
+
+    GET /healthz
+    GET /pods                              PodList the kubelet is running
+    GET /runningpods                       the runtime's view
+    GET /spec                              machine capacity/allocatable
+    GET /stats/summary                     node + per-pod resource stats
+    GET /containerLogs/{ns}/{pod}/{container}[?tailLines=N]
+    GET /exec/{ns}/{pod}/{container}?command=...&command=...
+    GET /metrics
+
+Deliberate divergence: /exec answers with the command's combined output
+in a plain HTTP response instead of upgrading to a SPDY stream
+(pkg/util/httpstream) — same request surface, simpler transport; the
+interactive-stream upgrade is out of the TPU-native scope.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from ..core import types as api
+from ..core.scheme import Scheme, default_scheme
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .cm import ContainerManager, stub_container_manager
+from .stats import FakeStatsProvider, StatsProvider
+
+
+def kubelet_base_url(node: api.Node) -> str:
+    """Resolve a node's kubelet server from its registered daemon
+    endpoint + first address (the apiserver relay and in-proc clients
+    share this)."""
+    port = node.status.daemon_endpoints.kubelet_endpoint.port
+    if not port:
+        raise KeyError(
+            f"node {node.metadata.name!r} has no kubelet endpoint "
+            f"registered")
+    addr = "127.0.0.1"
+    for a in node.status.addresses:
+        if a.address:
+            addr = a.address
+            break
+    return f"http://{addr}:{port}"
+
+
+class KubeletServer:
+    """Serves one node's kubelet surface. Decoupled from the kubelet
+    implementation through three seams so both the real Kubelet and the
+    hollow-node agent can sit behind it: `pod_provider()` -> the bound
+    pods, `runtime` (get_pods/logs/exec), `capacity_provider()` -> the
+    node's capacity map."""
+
+    def __init__(self, node_name: str,
+                 pod_provider: Callable[[], List[api.Pod]],
+                 runtime,
+                 capacity_provider: Callable[[], dict],
+                 stats: Optional[StatsProvider] = None,
+                 container_manager: Optional[ContainerManager] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 scheme: Scheme = default_scheme,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.node_name = node_name
+        self.pod_provider = pod_provider
+        self.runtime = runtime
+        self.capacity_provider = capacity_provider
+        self.stats = stats or FakeStatsProvider()
+        self.cm = container_manager or stub_container_manager()
+        self.scheme = scheme
+        self.metrics = metrics or global_metrics
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                server.handle(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "KubeletServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ----------------------------------------------------------- dispatch
+
+    def handle(self, h: BaseHTTPRequestHandler) -> None:
+        parsed = urllib.parse.urlsplit(h.path)
+        path = parsed.path.rstrip("/")
+        query = urllib.parse.parse_qs(parsed.query)
+        try:
+            if path in ("/healthz", "/healthz/ping"):
+                return self._raw(h, 200, b"ok", "text/plain")
+            if path == "/metrics":
+                return self._raw(h, 200, self.metrics.render().encode(),
+                                 "text/plain; version=0.0.4")
+            if path == "/pods":
+                pods = self.pod_provider()
+                return self._json(h, 200,
+                                  self.scheme.encode_list("Pod", pods))
+            if path == "/runningpods":
+                return self._json(h, 200, self._running_pods())
+            if path == "/spec":
+                capacity = self.capacity_provider()
+                return self._json(h, 200, {
+                    "nodeName": self.node_name,
+                    "capacity": {k: str(v) for k, v in capacity.items()},
+                    "allocatable": {
+                        k: str(v) for k, v
+                        in self.cm.allocatable(capacity).items()}})
+            if path in ("/stats", "/stats/summary"):
+                summary = self.stats.summary(
+                    self.node_name, self.pod_provider(), self.runtime)
+                return self._json(h, 200, summary.to_dict())
+            if path.startswith("/containerLogs/"):
+                return self._container_logs(h, path, query)
+            if path.startswith("/exec/"):
+                return self._exec(h, path, query)
+            self._raw(h, 404, f"not found: {path}".encode(), "text/plain")
+        except KeyError as e:
+            self._raw(h, 404, str(e).encode(), "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:
+            self._raw(h, 500, repr(e).encode(), "text/plain")
+
+    # ----------------------------------------------------------- handlers
+
+    def _find_pod(self, ns: str, name: str) -> api.Pod:
+        for pod in self.pod_provider():
+            if pod.metadata.namespace == ns and pod.metadata.name == name:
+                return pod
+        raise KeyError(f"pod {ns}/{name} not found")
+
+    def _split_target(self, path: str, prefix: str):
+        parts = path[len(prefix):].split("/")
+        if len(parts) != 3 or not all(parts):
+            raise KeyError(f"want {prefix}{{ns}}/{{pod}}/{{container}}")
+        return parts  # ns, pod, container
+
+    def _container_logs(self, h, path: str, query: dict) -> None:
+        ns, pod_name, container = self._split_target(path, "/containerLogs/")
+        pod = self._find_pod(ns, pod_name)
+        tail = int(query.get("tailLines", ["0"])[0])
+        text = self.runtime.get_container_logs(pod.metadata.uid, container,
+                                               tail_lines=tail)
+        self._raw(h, 200, text.encode(), "text/plain")
+
+    def _exec(self, h, path: str, query: dict) -> None:
+        ns, pod_name, container = self._split_target(path, "/exec/")
+        pod = self._find_pod(ns, pod_name)
+        cmd = query.get("command", [])
+        if not cmd:
+            return self._raw(h, 400, b"missing command", "text/plain")
+        code, output = self.runtime.exec_in_container(
+            pod.metadata.uid, container, cmd)
+        self._json(h, 200, {"exitCode": code, "output": output})
+
+    def _running_pods(self) -> dict:
+        items = []
+        for rp in self.runtime.get_pods():
+            items.append({
+                "metadata": {"name": rp.name, "namespace": rp.namespace,
+                             "uid": rp.uid},
+                "spec": {"containers": [
+                    {"name": c.name, "image": c.image}
+                    for c in rp.containers]}})
+        return {"kind": "PodList", "apiVersion": "v1", "items": items}
+
+    # ------------------------------------------------------------ helpers
+
+    def _json(self, h, code: int, payload) -> None:
+        self._raw(h, code, json.dumps(payload).encode(), "application/json")
+
+    @staticmethod
+    def _raw(h, code: int, payload: bytes, ctype: str) -> None:
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(payload)))
+        h.end_headers()
+        h.wfile.write(payload)

@@ -1,0 +1,186 @@
+"""Resource stats — the cadvisor analogue.
+
+Reference: pkg/kubelet/cadvisor (wraps github.com/google/cadvisor reading
+cgroupfs) feeding the kubelet's /stats endpoints (pkg/kubelet/server.go),
+with cadvisor.Fake for kubemark hollow nodes. Here the same split:
+`ProcStatsProvider` reads the real /proc for node-level CPU/memory (the
+runtime supplies per-pod numbers when it can — the subprocess runtime
+reads its children's /proc), and `FakeStatsProvider` produces
+deterministic synthetic stats for hollow fleets.
+
+The wire shape follows the summary API (NodeStats/PodStats/
+ContainerStats) the reference's /stats/summary serves.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class ContainerStats:
+    name: str = ""
+    cpu_usage_nano_cores: int = 0
+    memory_working_set_bytes: int = 0
+    restart_count: int = 0
+
+
+@dataclass
+class PodStats:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    containers: List[ContainerStats] = field(default_factory=list)
+
+
+@dataclass
+class NodeStats:
+    node_name: str = ""
+    cpu_usage_nano_cores: int = 0
+    memory_total_bytes: int = 0
+    memory_available_bytes: int = 0
+    memory_working_set_bytes: int = 0
+    fs_capacity_bytes: int = 0
+    fs_available_bytes: int = 0
+    start_time: float = 0.0
+
+
+@dataclass
+class Summary:
+    """(ref: the /stats/summary response shape)"""
+    node: NodeStats = field(default_factory=NodeStats)
+    pods: List[PodStats] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "node": {
+                "nodeName": self.node.node_name,
+                "cpu": {"usageNanoCores": self.node.cpu_usage_nano_cores},
+                "memory": {
+                    "totalBytes": self.node.memory_total_bytes,
+                    "availableBytes": self.node.memory_available_bytes,
+                    "workingSetBytes": self.node.memory_working_set_bytes},
+                "fs": {"capacityBytes": self.node.fs_capacity_bytes,
+                       "availableBytes": self.node.fs_available_bytes},
+                "startTime": self.node.start_time},
+            "pods": [{
+                "podRef": {"name": p.name, "namespace": p.namespace,
+                           "uid": p.uid},
+                "containers": [{
+                    "name": c.name,
+                    "cpu": {"usageNanoCores": c.cpu_usage_nano_cores},
+                    "memory": {
+                        "workingSetBytes": c.memory_working_set_bytes},
+                    "restartCount": c.restart_count}
+                    for c in p.containers]}
+                for p in self.pods]}
+
+
+class StatsProvider:
+    """Interface: summary(node_name, pods, runtime) -> Summary."""
+
+    def summary(self, node_name: str, pods, runtime) -> Summary:
+        raise NotImplementedError
+
+
+def _pod_container_stats(pods, runtime) -> List[PodStats]:
+    """Per-pod stats from the runtime's view; runtimes that can meter
+    their containers expose container_stats(pod_uid, name) -> dict."""
+    out = []
+    meter = getattr(runtime, "container_stats", None)
+    by_uid = {rp.uid: rp for rp in runtime.get_pods()}
+    for pod in pods:
+        ps = PodStats(name=pod.metadata.name,
+                      namespace=pod.metadata.namespace,
+                      uid=pod.metadata.uid)
+        rp = by_uid.get(pod.metadata.uid)
+        for c in (rp.containers if rp is not None else []):
+            cs = ContainerStats(name=c.name,
+                                restart_count=c.restart_count)
+            if meter is not None:
+                m = meter(rp.uid, c.name) or {}
+                cs.cpu_usage_nano_cores = int(
+                    m.get("cpu_usage_nano_cores", 0))
+                cs.memory_working_set_bytes = int(
+                    m.get("memory_working_set_bytes", 0))
+            ps.containers.append(cs)
+        out.append(ps)
+    return out
+
+
+class ProcStatsProvider(StatsProvider):
+    """Real node stats from /proc (the cgroupfs-reading role of cadvisor;
+    node-level only — per-container metering belongs to the runtime)."""
+
+    def __init__(self):
+        self._start = time.time()
+        self._last_cpu: Optional[tuple] = None  # (ts, busy_jiffies)
+
+    @staticmethod
+    def _read_proc_stat_busy() -> int:
+        with open("/proc/stat") as f:
+            fields = f.readline().split()[1:]
+        vals = [int(v) for v in fields]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+        return sum(vals) - idle
+
+    def _cpu_nano_cores(self) -> int:
+        """Busy jiffies per wall second -> nanocores (USER_HZ=100)."""
+        now = time.time()
+        busy = self._read_proc_stat_busy()
+        last, self._last_cpu = self._last_cpu, (now, busy)
+        if last is None or now <= last[0]:
+            return 0
+        cores = (busy - last[1]) / 100.0 / (now - last[0])
+        return int(cores * 1e9)
+
+    @staticmethod
+    def _meminfo() -> Dict[str, int]:
+        out = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                name, _, rest = line.partition(":")
+                out[name] = int(rest.split()[0]) * 1024
+        return out
+
+    def summary(self, node_name: str, pods, runtime) -> Summary:
+        mem = self._meminfo()
+        st = os.statvfs("/")
+        total = mem.get("MemTotal", 0)
+        avail = mem.get("MemAvailable", mem.get("MemFree", 0))
+        node = NodeStats(
+            node_name=node_name,
+            cpu_usage_nano_cores=self._cpu_nano_cores(),
+            memory_total_bytes=total,
+            memory_available_bytes=avail,
+            memory_working_set_bytes=total - avail,
+            fs_capacity_bytes=st.f_blocks * st.f_frsize,
+            fs_available_bytes=st.f_bavail * st.f_frsize,
+            start_time=self._start)
+        return Summary(node=node, pods=_pod_container_stats(pods, runtime))
+
+
+class FakeStatsProvider(StatsProvider):
+    """(ref: cadvisor.Fake — fixed synthetic machine stats so hollow
+    fleets serve /stats without touching the host)"""
+
+    def __init__(self, cpu_nano_cores: int = 250_000_000,
+                 memory_total: int = 32 << 30):
+        self.cpu_nano_cores = cpu_nano_cores
+        self.memory_total = memory_total
+        self._start = time.time()
+
+    def summary(self, node_name: str, pods, runtime) -> Summary:
+        node = NodeStats(
+            node_name=node_name,
+            cpu_usage_nano_cores=self.cpu_nano_cores,
+            memory_total_bytes=self.memory_total,
+            memory_available_bytes=self.memory_total // 2,
+            memory_working_set_bytes=self.memory_total // 2,
+            fs_capacity_bytes=100 << 30,
+            fs_available_bytes=50 << 30,
+            start_time=self._start)
+        return Summary(node=node, pods=_pod_container_stats(pods, runtime))

@@ -1,0 +1,193 @@
+"""Kubelet HTTP server, stats, container manager, and the apiserver's
+log/proxy relay (ref: pkg/kubelet/server.go:210,242, pkg/kubelet/cm,
+pkg/kubelet/cadvisor)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.agents.hollow_node import HollowKubelet
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import Quantity, parse_quantity
+from kubernetes_tpu.kubelet.cm import ContainerManager
+from kubernetes_tpu.kubelet.container import FakeRuntime
+from kubernetes_tpu.kubelet.server import KubeletServer
+from kubernetes_tpu.kubelet.stats import FakeStatsProvider, ProcStatsProvider
+
+
+def mkpod(name, uid, containers=("c",)):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=api.PodSpec(containers=[
+            api.Container(name=c, image="img") for c in containers]))
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+@pytest.fixture()
+def served():
+    runtime = FakeRuntime()
+    pod = mkpod("web", "uid-1", containers=("app", "sidecar"))
+    for c in pod.spec.containers:
+        runtime.start_container(pod, c)
+    srv = KubeletServer(
+        "node-1", lambda: [pod], runtime,
+        lambda: {"cpu": parse_quantity("4"),
+                 "memory": parse_quantity("8Gi")},
+        container_manager=ContainerManager(
+            system_reserved={"cpu": parse_quantity("500m")})).start()
+    yield srv, runtime, pod
+    srv.stop()
+
+
+class TestKubeletServer:
+    def test_healthz_and_pods(self, served):
+        srv, _, _ = served
+        assert get(f"{srv.url}/healthz")[1] == b"ok"
+        code, body = get(f"{srv.url}/pods")
+        pods = json.loads(body)
+        assert pods["kind"] == "PodList"
+        assert pods["items"][0]["metadata"]["name"] == "web"
+
+    def test_runningpods_from_runtime(self, served):
+        srv, _, _ = served
+        _, body = get(f"{srv.url}/runningpods")
+        names = {c["name"]
+                 for item in json.loads(body)["items"]
+                 for c in item["spec"]["containers"]}
+        assert names == {"app", "sidecar"}
+
+    def test_spec_reports_allocatable(self, served):
+        srv, _, _ = served
+        _, body = get(f"{srv.url}/spec")
+        spec = json.loads(body)
+        assert spec["capacity"]["cpu"] == "4"
+        # 4 cores - 500m system reserved
+        assert spec["allocatable"]["cpu"] == "3500m"
+
+    def test_stats_summary(self, served):
+        srv, _, _ = served
+        _, body = get(f"{srv.url}/stats/summary")
+        summary = json.loads(body)
+        assert summary["node"]["nodeName"] == "node-1"
+        assert summary["node"]["memory"]["totalBytes"] > 0
+        assert summary["pods"][0]["podRef"]["name"] == "web"
+        assert {c["name"] for c in summary["pods"][0]["containers"]} \
+            == {"app", "sidecar"}
+
+    def test_container_logs_and_tail(self, served):
+        srv, runtime, _ = served
+        runtime.set_container_logs("uid-1", "app", "l1\nl2\nl3\n")
+        _, body = get(f"{srv.url}/containerLogs/default/web/app")
+        assert body == b"l1\nl2\nl3\n"
+        _, body = get(
+            f"{srv.url}/containerLogs/default/web/app?tailLines=1")
+        assert body == b"l3\n"
+
+    def test_missing_container_404(self, served):
+        srv, _, _ = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{srv.url}/containerLogs/default/web/ghost")
+        assert e.value.code == 404
+
+    def test_exec(self, served):
+        srv, _, _ = served
+        _, body = get(f"{srv.url}/exec/default/web/app"
+                      f"?command=echo&command=hi")
+        out = json.loads(body)
+        assert out["exitCode"] == 0
+        assert "echo hi" in out["output"]
+
+
+class TestStatsProviders:
+    def test_proc_stats_reads_real_machine(self):
+        p = ProcStatsProvider()
+        s1 = p.summary("n", [], FakeRuntime())
+        assert s1.node.memory_total_bytes > 0
+        assert s1.node.fs_capacity_bytes > 0
+        time.sleep(0.05)
+        s2 = p.summary("n", [], FakeRuntime())
+        assert s2.node.cpu_usage_nano_cores >= 0
+
+    def test_container_manager_floors_at_zero(self):
+        cm = ContainerManager(kube_reserved={"cpu": Quantity(10_000)})
+        out = cm.allocatable({"cpu": Quantity(4_000)})
+        assert out["cpu"].milli == 0
+
+
+class TestApiServerRelay:
+    """CLI logs / describe node read from a live hollow node over HTTP:
+    pod log subresource + node proxy through the apiserver."""
+
+    @pytest.fixture()
+    def stack(self):
+        registry = Registry()
+        apiserver = ApiServer(registry).start()
+        client = HttpClient(apiserver.url)
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        kubelet = HollowKubelet(InProcClient(registry), "hollow-a",
+                                heartbeat_interval=60.0,
+                                serve_http=True).run()
+        yield registry, apiserver, client, kubelet
+        kubelet.stop()
+        apiserver.stop()
+
+    def _bind_pod(self, client, registry, name="logpod"):
+        pod = mkpod(name, "")
+        pod.metadata.uid = ""
+        created = client.create("pods", pod, "default")
+        registry.bind(api.Binding(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            target=api.ObjectReference(kind="Node", name="hollow-a")),
+            "default")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if client.get("pods", name, "default").status.phase \
+                    == "Running":
+                return client.get("pods", name, "default")
+            time.sleep(0.05)
+        raise AssertionError("pod never ran")
+
+    def test_pod_log_subresource(self, stack):
+        registry, apiserver, client, kubelet = stack
+        self._bind_pod(client, registry)
+        text = client.pod_logs("logpod", "default", "c")
+        assert "hollow logs for logpod/c" in text
+
+    def test_node_proxy_stats(self, stack):
+        registry, apiserver, client, kubelet = stack
+        body = client.node_proxy("hollow-a", "stats/summary")
+        assert json.loads(body)["node"]["nodeName"] == "hollow-a"
+
+    def test_cli_logs_and_describe_node(self, stack):
+        import io
+
+        from kubernetes_tpu.cli.cmd import Kubectl
+        registry, apiserver, client, kubelet = stack
+        self._bind_pod(client, registry, "clipod")
+        out = io.StringIO()
+        k = Kubectl(client, out=out)
+        k.logs("default", "clipod")
+        assert "hollow logs for clipod/c" in out.getvalue()
+        out = io.StringIO()
+        Kubectl(client, out=out).describe("", ["node", "hollow-a"])
+        text = out.getvalue()
+        assert "Allocatable" in text
+        assert "Kubelet Port" in text
+
+    def test_unscheduled_pod_log_is_bad_request(self, stack):
+        registry, apiserver, client, kubelet = stack
+        client.create("pods", mkpod("floating", ""), "default")
+        from kubernetes_tpu.core.errors import ApiError
+        with pytest.raises(ApiError):
+            client.pod_logs("floating", "default")

@@ -35,6 +35,11 @@ def spawn(component, *flags):
 
 def wait_ready(proc, timeout_s=120.0):
     """Block until the component prints its READY line."""
+    import select
+    ready, _, _ = select.select([proc.stdout], [], [], timeout_s)
+    if not ready:
+        proc.kill()
+        raise RuntimeError(f"no READY line within {timeout_s}s")
     line = proc.stdout.readline()
     if not line:
         raise RuntimeError(

@@ -114,9 +114,17 @@ class InProcClient(Client):
         if not pod.spec.node_name:
             raise BadRequest(f"pod {name!r} is not scheduled yet")
         if not container:
+            if len(pod.spec.containers) > 1:
+                # match the HTTP path (ApiServer._serve_pod_log)
+                raise BadRequest(
+                    f"pod {name!r} has several containers; name one")
             container = pod.spec.containers[0].name
         node = self.registry.get("nodes", pod.spec.node_name)
-        url = (f"{kubelet_base_url(node)}/containerLogs/"
+        try:
+            base = kubelet_base_url(node)
+        except KeyError as e:
+            raise NotFound(str(e))
+        url = (f"{base}/containerLogs/"
                f"{namespace}/{name}/{container}")
         if tail_lines:
             url += f"?tailLines={tail_lines}"

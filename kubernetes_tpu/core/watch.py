@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Iterator, List, Optional
 
@@ -35,6 +36,9 @@ class Watcher:
     def __init__(self, capacity: int = 1000):
         self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._stopped = threading.Event()
+        # consumer-side buffer for batched sends (one queue slot may hold
+        # a whole tile's events); consumer-thread only, no lock needed
+        self._pending: "deque[Event]" = deque()
 
     def send(self, event: Event) -> bool:
         """Enqueue an event without blocking. Returns False if the watcher is
@@ -43,6 +47,20 @@ class Watcher:
             return False
         try:
             self._q.put_nowait(event)
+            return True
+        except queue.Full:
+            return False
+
+    def send_many(self, events: List[Event]) -> bool:
+        """Enqueue a batch as ONE queue slot — the store's tile-commit
+        fan-out (30k bindings = a handful of puts per watcher instead of
+        30k lock/notify cycles each). Consumers unwrap transparently."""
+        if not events:
+            return True
+        if self._stopped.is_set():
+            return False
+        try:
+            self._q.put_nowait(list(events))
             return True
         except queue.Full:
             return False
@@ -70,20 +88,30 @@ class Watcher:
 
     def __iter__(self) -> Iterator[Event]:
         while True:
+            while self._pending:
+                yield self._pending.popleft()
             item = self._q.get()
             if item is _SENTINEL:
                 # Drain-to-sentinel: deliver nothing after stop.
                 return
+            if isinstance(item, list):
+                self._pending.extend(item)
+                continue
             yield item
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         """Blocking pop with timeout; None on timeout or stop."""
+        if self._pending:
+            return self._pending.popleft()
         try:
             item = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
         if item is _SENTINEL:
             return None
+        if isinstance(item, list):
+            self._pending.extend(item)
+            return self._pending.popleft()
         return item
 
 

@@ -132,6 +132,13 @@ def run_scheduler(argv: List[str]) -> int:
                         "(--bind-pods-qps equivalent)")
     args = p.parse_args(argv)
 
+    # A dedicated scheduler process: its thread re-enters Python between
+    # device dispatches, and CPython's default 5ms GIL slice makes each
+    # re-entry wait behind watch/IO threads (measured ~10% of e2e wall
+    # at kubemark scale). Process-wide by design — this process exists
+    # to schedule.
+    import sys as _sys
+    _sys.setswitchinterval(0.001)
     _pin_jax_platform()
     from .api.client import HttpClient
     from .sched.api import policy_from_json

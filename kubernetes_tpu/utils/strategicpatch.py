@@ -1,0 +1,113 @@
+"""Strategic merge patch — the 3-way merge behind kubectl apply.
+
+Reference: pkg/util/strategicpatch/patch.go. Operates on wire-form dicts
+(what the last-applied annotation stores). Semantics:
+
+- maps merge recursively; a key present in `original` (the last applied
+  config) but absent from `modified` (the new config) was deleted by the
+  user and is removed from the result; keys only the live object carries
+  (server-set: status, clusterIP, nodeName, uid...) are preserved
+- lists of maps with a merge key (the reference's patchMergeKey struct
+  tags: containers/env/volumes by name, ports by containerPort/port,
+  volumeMounts by mountPath) merge element-wise by that key with the
+  same ownership rule; all other lists are replaced atomically
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+# field name -> candidate merge keys, first present in the elements wins
+# (ref: the patchMergeKey tags in pkg/api/v1/types.go)
+MERGE_KEYS: Dict[str, Sequence[str]] = {
+    "containers": ("name",),
+    "env": ("name",),
+    "ports": ("containerPort", "port"),
+    "volumes": ("name",),
+    "volumeMounts": ("mountPath",),
+    "conditions": ("type",),
+    "imagePullSecrets": ("name",),
+}
+
+
+def _merge_key_for(field: str, *lists: Sequence[Any]) -> Optional[str]:
+    for candidate in MERGE_KEYS.get(field, ()):
+        for lst in lists:
+            for el in lst:
+                if isinstance(el, dict) and candidate in el:
+                    return candidate
+    return None
+
+
+def _is_map_list(value: Any) -> bool:
+    return isinstance(value, list) and \
+        all(isinstance(el, dict) for el in value) and bool(value)
+
+
+def merge_maps(original: Dict, modified: Dict, current: Dict) -> Dict:
+    """(ref: patch.go mergeMap, three-way)"""
+    out = dict(current)
+    # deletions: owned by the last applied config, dropped from the new
+    for key in original:
+        if key not in modified and key in out:
+            del out[key]
+    for key, mval in modified.items():
+        oval = original.get(key)
+        cval = out.get(key)
+        if isinstance(mval, dict) and isinstance(cval, dict):
+            out[key] = merge_maps(oval if isinstance(oval, dict) else {},
+                                  mval, cval)
+        elif (_is_map_list(mval) or _is_map_list(cval)) and \
+                isinstance(mval, list) and isinstance(cval, list):
+            out[key] = _merge_lists(
+                key, oval if isinstance(oval, list) else [], mval, cval)
+        else:
+            out[key] = mval
+    return out
+
+
+def _merge_lists(field: str, original: List, modified: List,
+                 current: List) -> List:
+    """(ref: patch.go mergeSlice — patchMergeKey lists merge by element,
+    the rest replace)"""
+    mk = _merge_key_for(field, original, modified, current)
+    if mk is None:
+        return list(modified)
+    cur_by = {el[mk]: el for el in current
+              if isinstance(el, dict) and mk in el}
+    orig_keys = {el[mk] for el in original
+                 if isinstance(el, dict) and mk in el}
+    orig_by = {el[mk]: el for el in original
+               if isinstance(el, dict) and mk in el}
+    out: List = []
+    mod_keys = set()
+    for el in modified:
+        if not isinstance(el, dict) or mk not in el:
+            out.append(el)
+            continue
+        k = el[mk]
+        mod_keys.add(k)
+        if k in cur_by:
+            out.append(merge_maps(orig_by.get(k, {}), el, cur_by[k]))
+        else:
+            out.append(el)
+    # elements only the live object has: server-set (or another owner's)
+    # unless the last applied config owned them — then they're deletions
+    for el in current:
+        if not isinstance(el, dict) or mk not in el:
+            continue
+        k = el[mk]
+        if k not in mod_keys and k not in orig_keys:
+            out.append(el)
+    return out
+
+
+def three_way_merge(original: Dict, modified: Dict,
+                    current: Dict) -> Dict:
+    """kubectl apply's patch: original = last applied config, modified =
+    the new config, current = the live object. Returns the object to
+    write back: the user's intent applied over the live state with
+    server-set fields intact (ref: patch.go CreateThreeWayMergePatch +
+    StrategicMergePatch, fused — we write the merged object, not a
+    patch document)."""
+    return merge_maps(original or {}, modified or {}, current or {})

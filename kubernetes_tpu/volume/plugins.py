@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 from typing import Dict, List, Optional
 
@@ -311,6 +312,126 @@ class AWSEBSPlugin(VolumePlugin):
         return self.new_builder(volume, pod)
 
 
+# hashes, tags, branch paths — no option-looking or traversal-looking forms
+_GIT_REVISION_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._/-]*")
+
+
+class _GitRepoBuilder(_DirBuilder):
+    """Real clone via the git binary (ref: pkg/volume/git_repo — the
+    reference execs git the same way)."""
+
+    def __init__(self, path: str, repository: str, revision: str):
+        super().__init__(path)
+        self.repository = repository
+        self.revision = revision
+
+    def set_up(self) -> None:
+        import subprocess
+        # API-supplied revision must never parse as a git option (the
+        # reference hardened its git_repo volume the same way); refnames
+        # and hashes never start with '-'
+        if self.revision and (self.revision.startswith("-")
+                              or not _GIT_REVISION_RE.fullmatch(
+                                  self.revision)):
+            raise BadRequest(
+                f"invalid git revision {self.revision!r}")
+        super().set_up()
+        if os.listdir(self.path):
+            return  # idempotent resync: already cloned
+        subprocess.run(["git", "clone", "--", self.repository, self.path],
+                       check=True, capture_output=True, timeout=120)
+        if self.revision:
+            subprocess.run(["git", "checkout", self.revision, "--"],
+                           cwd=self.path, check=True, capture_output=True,
+                           timeout=60)
+
+
+class GitRepoPlugin(VolumePlugin):
+    """(ref: pkg/volume/git_repo)"""
+    name = "kubernetes.io/git-repo"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.git_repo is not None
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _GitRepoBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            volume.git_repo.repository, volume.git_repo.revision)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+class _HollowNetworkPlugin(VolumePlugin):
+    """Shared shape of the network filesystems mounted hollow (the
+    `.mounted` marker records the source; no cloud attach step)."""
+
+    def _source(self, volume: api.Volume) -> str:
+        raise NotImplementedError
+
+    def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
+        return _AttachingBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            self._source(volume), self)
+
+    def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
+        return _DirBuilder(self.host.pod_volume_dir(
+            pod_uid, self.name, volume_name))
+
+
+class ISCSIPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/iscsi — hollow mount)"""
+    name = "kubernetes.io/iscsi"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.iscsi is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        i = volume.iscsi
+        return f"iscsi://{i.target_portal}/{i.iqn}/lun-{i.lun}"
+
+
+class GlusterfsPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/glusterfs — hollow mount)"""
+    name = "kubernetes.io/glusterfs"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.glusterfs is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        g = volume.glusterfs
+        return f"glusterfs://{g.endpoints_name}/{g.path}"
+
+
+class CephFSPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/cephfs — hollow mount)"""
+    name = "kubernetes.io/cephfs"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.cephfs is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        c = volume.cephfs
+        return f"cephfs://{','.join(c.monitors)}"
+
+
+class RBDPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/rbd — hollow mount; the disk-conflict predicate
+    reads the same source fields, predicates.go:75-117)"""
+    name = "kubernetes.io/rbd"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.rbd is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        r = volume.rbd
+        return (f"rbd://{','.join(r.ceph_monitors)}/"
+                f"{r.rbd_pool}/{r.rbd_image}")
+
+
 class PersistentClaimPlugin(VolumePlugin):
     """Resolve claim -> bound PV -> the underlying plugin
     (ref: pkg/volume/persistent_claim)."""
@@ -423,6 +544,8 @@ def new_default_plugin_mgr(host: VolumeHost) -> VolumePluginMgr:
     plugins: List[VolumePlugin] = [
         EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(),
         DownwardAPIPlugin(), NFSPlugin(), GCEPDPlugin(), AWSEBSPlugin(),
+        GitRepoPlugin(), ISCSIPlugin(), GlusterfsPlugin(), CephFSPlugin(),
+        RBDPlugin(),
     ]
     claim_plugin = PersistentClaimPlugin(mgr)
     plugins.append(claim_plugin)

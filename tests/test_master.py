@@ -91,6 +91,15 @@ def test_readonly_user_cannot_reach_exec_proxy():
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req, timeout=5)
         assert e.value.code == 403
+        # empty path segments must not slip past the write classifier
+        # (the router drops them; the authz check must see the same
+        # normalized path)
+        req = urllib.request.Request(
+            m.url + "/api/v1/proxy/nodes/n1//exec/default/p/c?command=id",
+            headers={"Authorization": "Bearer ro-token"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 403
         # read-only relays stay readable: stats proxy authorizes as GET
         # (404 = authz passed, node simply doesn't exist)
         req = urllib.request.Request(

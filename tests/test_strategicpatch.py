@@ -1,0 +1,157 @@
+"""Strategic merge patch + the kubectl apply annotation protocol
+(ref: pkg/util/strategicpatch/patch.go)."""
+
+import io
+import json
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.cli.cmd import LAST_APPLIED_ANNOTATION, Kubectl
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.utils.strategicpatch import three_way_merge
+
+
+class TestThreeWayMerge:
+    def test_server_set_fields_survive(self):
+        original = {"spec": {"replicas": 1}}
+        modified = {"spec": {"replicas": 3}}
+        current = {"spec": {"replicas": 1, "clusterIP": "10.0.0.7"},
+                   "status": {"observed": 1},
+                   "metadata": {"uid": "u1", "resourceVersion": "9"}}
+        merged = three_way_merge(original, modified, current)
+        assert merged["spec"]["replicas"] == 3
+        assert merged["spec"]["clusterIP"] == "10.0.0.7"
+        assert merged["status"] == {"observed": 1}
+        assert merged["metadata"]["resourceVersion"] == "9"
+
+    def test_user_deletion_removes_owned_key(self):
+        original = {"spec": {"a": 1, "b": 2}}
+        modified = {"spec": {"a": 1}}
+        current = {"spec": {"a": 1, "b": 2, "server": True}}
+        merged = three_way_merge(original, modified, current)
+        assert "b" not in merged["spec"]
+        assert merged["spec"]["server"] is True
+
+    def test_containers_merge_by_name(self):
+        original = {"spec": {"containers": [
+            {"name": "app", "image": "app:v1"}]}}
+        modified = {"spec": {"containers": [
+            {"name": "app", "image": "app:v2"}]}}
+        current = {"spec": {"containers": [
+            {"name": "app", "image": "app:v1",
+             "terminationMessagePath": "/dev/log"},
+            {"name": "injected-sidecar", "image": "mesh:1"}]}}
+        merged = three_way_merge(original, modified, current)
+        by_name = {c["name"]: c for c in merged["spec"]["containers"]}
+        # the user's image change lands, server-set field survives
+        assert by_name["app"]["image"] == "app:v2"
+        assert by_name["app"]["terminationMessagePath"] == "/dev/log"
+        # a container another writer injected is preserved
+        assert "injected-sidecar" in by_name
+
+    def test_owned_list_element_deletion(self):
+        original = {"spec": {"containers": [
+            {"name": "app", "image": "a"},
+            {"name": "helper", "image": "h"}]}}
+        modified = {"spec": {"containers": [
+            {"name": "app", "image": "a"}]}}
+        current = {"spec": {"containers": [
+            {"name": "app", "image": "a"},
+            {"name": "helper", "image": "h"}]}}
+        merged = three_way_merge(original, modified, current)
+        assert [c["name"] for c in merged["spec"]["containers"]] == ["app"]
+
+    def test_primitive_lists_replace_atomically(self):
+        original = {"spec": {"cmd": ["a", "b"]}}
+        modified = {"spec": {"cmd": ["c"]}}
+        current = {"spec": {"cmd": ["a", "b", "x"]}}
+        assert three_way_merge(original, modified,
+                               current)["spec"]["cmd"] == ["c"]
+
+    def test_labels_map_merge(self):
+        original = {"metadata": {"labels": {"mine": "1", "gone": "x"}}}
+        modified = {"metadata": {"labels": {"mine": "2"}}}
+        current = {"metadata": {"labels": {"mine": "1", "gone": "x",
+                                           "server": "s"}}}
+        labels = three_way_merge(original, modified,
+                                 current)["metadata"]["labels"]
+        assert labels == {"mine": "2", "server": "s"}
+
+
+class TestKubectlApply:
+    @pytest.fixture()
+    def cluster(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        return registry, client
+
+    def _apply(self, client, tmp_path, manifest, name="m.json"):
+        path = tmp_path / name
+        path.write_text(json.dumps(manifest))
+        out = io.StringIO()
+        Kubectl(client, out=out).apply("default", str(path))
+        return out.getvalue()
+
+    def test_apply_preserves_server_fields_over_reapply(self, cluster,
+                                                        tmp_path):
+        registry, client = cluster
+        svc = {"kind": "Service", "apiVersion": "v1",
+               "metadata": {"name": "web"},
+               "spec": {"selector": {"app": "web"},
+                        "ports": [{"port": 80}]}}
+        assert "created" in self._apply(client, tmp_path, svc)
+        live = client.get("services", "web", "default")
+        allocated_ip = live.spec.cluster_ip
+        assert allocated_ip  # server-set on create
+
+        # modify-reapply: change the selector; the allocated clusterIP
+        # must survive the 3-way merge (the VERDICT done-criterion)
+        svc["spec"]["selector"] = {"app": "web", "tier": "front"}
+        assert "configured" in self._apply(client, tmp_path, svc)
+        live = client.get("services", "web", "default")
+        assert live.spec.cluster_ip == allocated_ip
+        assert live.spec.selector == {"app": "web", "tier": "front"}
+        assert LAST_APPLIED_ANNOTATION in live.metadata.annotations
+
+    def test_apply_deletes_owned_fields_only(self, cluster, tmp_path):
+        registry, client = cluster
+        rc = {"kind": "ReplicationController", "apiVersion": "v1",
+              "metadata": {"name": "rc1",
+                           "labels": {"owned": "yes", "drop": "me"}},
+              "spec": {"replicas": 2, "selector": {"app": "a"},
+                       "template": {
+                           "metadata": {"labels": {"app": "a"}},
+                           "spec": {"containers": [
+                               {"name": "c", "image": "i:1"}]}}}}
+        self._apply(client, tmp_path, rc)
+        # another writer adds a label the config doesn't know about
+        live = client.get("replicationcontrollers", "rc1", "default")
+        from dataclasses import replace
+        client.update("replicationcontrollers", replace(
+            live, metadata=replace(
+                live.metadata,
+                labels={**live.metadata.labels, "other-writer": "x"})),
+            "default")
+
+        del rc["metadata"]["labels"]["drop"]
+        rc["spec"]["replicas"] = 5
+        self._apply(client, tmp_path, rc)
+        live = client.get("replicationcontrollers", "rc1", "default")
+        assert live.spec.replicas == 5
+        assert "drop" not in live.metadata.labels      # owned deletion
+        assert live.metadata.labels["other-writer"] == "x"  # preserved
+
+    def test_apply_twice_is_idempotent(self, cluster, tmp_path):
+        registry, client = cluster
+        pod = {"kind": "Pod", "apiVersion": "v1",
+               "metadata": {"name": "p1"},
+               "spec": {"containers": [{"name": "c", "image": "i"}]}}
+        self._apply(client, tmp_path, pod)
+        before = client.get("pods", "p1", "default")
+        self._apply(client, tmp_path, pod)
+        after = client.get("pods", "p1", "default")
+        assert after.spec == before.spec

@@ -181,3 +181,66 @@ class TestCloudControllers:
         ctrl.sync_once()
         assert set(r.name for r in cloud.list_routes()) == {"route-n1",
                                                             "corp-vpn"}
+
+
+class TestNewVolumePlugins:
+    """git_repo (real clone), iscsi/glusterfs/cephfs/rbd (hollow mounts)
+    — ref: pkg/volume/{git_repo,iscsi,glusterfs,cephfs,rbd}."""
+
+    def test_git_repo_clones_real_repository(self, host, tmp_path):
+        import subprocess
+        vh, *_ = host
+        src = tmp_path / "srcrepo"
+        src.mkdir()
+        (src / "hello.txt").write_text("bonjour\n")
+        subprocess.run(["git", "init", "-q"], cwd=src, check=True)
+        subprocess.run(["git", "add", "."], cwd=src, check=True)
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             "commit", "-qm", "init"], cwd=src, check=True)
+
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[api.Volume(
+            name="code", git_repo=api.GitRepoVolumeSource(
+                repository=str(src)))])
+        paths = mgr.set_up_pod_volumes(pod)
+        assert (os.path.isfile(os.path.join(paths["code"], "hello.txt")))
+        # idempotent resync must not re-clone into a non-empty dir
+        mgr.set_up_pod_volumes(pod)
+        mgr.tear_down_pod_volumes(pod)
+        assert not os.path.exists(paths["code"])
+
+    @pytest.mark.parametrize("volume,marker", [
+        (api.Volume(name="v", iscsi=api.ISCSIVolumeSource(
+            target_portal="10.0.0.5:3260", iqn="iqn.2026.example",
+            lun=2)), "iscsi://10.0.0.5:3260/iqn.2026.example/lun-2"),
+        (api.Volume(name="v", glusterfs=api.GlusterfsVolumeSource(
+            endpoints_name="gcluster", path="vol1")),
+         "glusterfs://gcluster/vol1"),
+        (api.Volume(name="v", cephfs=api.CephFSVolumeSource(
+            monitors=["m1:6789", "m2:6789"])),
+         "cephfs://m1:6789,m2:6789"),
+        (api.Volume(name="v", rbd=api.RBDVolumeSource(
+            ceph_monitors=["m1:6789"], rbd_pool="rbd",
+            rbd_image="img1")), "rbd://m1:6789/rbd/img1"),
+    ])
+    def test_hollow_network_mounts(self, host, volume, marker):
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        pod = mkpod(volumes=[volume])
+        paths = mgr.set_up_pod_volumes(pod)
+        with open(os.path.join(paths["v"], ".mounted")) as f:
+            assert f.read() == marker
+        mgr.tear_down_pod_volumes(pod)
+        assert not os.path.exists(paths["v"])
+
+    def test_git_repo_rejects_option_revisions(self, host):
+        from kubernetes_tpu.core.errors import BadRequest
+        vh, *_ = host
+        mgr = new_default_plugin_mgr(vh)
+        for bad in ("--detach", "-b", "..", "-"):
+            pod = mkpod(volumes=[api.Volume(
+                name="code", git_repo=api.GitRepoVolumeSource(
+                    repository="/tmp/nowhere", revision=bad))])
+            with pytest.raises(BadRequest):
+                mgr.set_up_pod_volumes(pod)

@@ -56,9 +56,19 @@ def to_wire(obj: Any) -> Any:
         return str(obj)
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out: Dict[str, Any] = {}
+        hints = _hints(type(obj))
         for f in dataclasses.fields(obj):
             v = getattr(obj, f.name)
             if v is None:
+                continue
+            # Optional[...] fields use None for absence, so a non-None
+            # value is PRESENT even when all-default: `emptyDir: {}` on a
+            # volume selects the volume type by existing. Dropping it
+            # would decode back as None — lossy, unlike the cases below.
+            optional = (get_origin(hints.get(f.name)) is typing.Union
+                        and type(None) in get_args(hints[f.name]))
+            if optional:
+                out[_camel(f.name)] = to_wire(v)
                 continue
             # omitempty relative to the declared default: a field at its
             # default decodes back identically, so dropping it is lossless

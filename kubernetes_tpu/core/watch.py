@@ -34,22 +34,36 @@ class Watcher:
     """A single watch stream. Iterate to receive events; `stop()` ends it."""
 
     def __init__(self, capacity: int = 1000):
-        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self.capacity = capacity
+        self._q: "queue.Queue" = queue.Queue()
         self._stopped = threading.Event()
+        # capacity is counted in EVENTS (a batched send occupies one
+        # queue slot but many events), so laggard detection and the
+        # memory bound survive send_many; producer-side lock only
+        self._count = 0
+        self._count_lock = threading.Lock()
         # consumer-side buffer for batched sends (one queue slot may hold
         # a whole tile's events); consumer-thread only, no lock needed
         self._pending: "deque[Event]" = deque()
 
+    def _reserve(self, n: int) -> bool:
+        with self._count_lock:
+            if self._count + n > self.capacity:
+                return False
+            self._count += n
+            return True
+
+    def _release(self, n: int) -> None:
+        with self._count_lock:
+            self._count -= n
+
     def send(self, event: Event) -> bool:
         """Enqueue an event without blocking. Returns False if the watcher is
         stopped or its queue is full (laggard — callers drop such watchers)."""
-        if self._stopped.is_set():
+        if self._stopped.is_set() or not self._reserve(1):
             return False
-        try:
-            self._q.put_nowait(event)
-            return True
-        except queue.Full:
-            return False
+        self._q.put_nowait(event)
+        return True
 
     def send_many(self, events: List[Event]) -> bool:
         """Enqueue a batch as ONE queue slot — the store's tile-commit
@@ -57,30 +71,18 @@ class Watcher:
         30k lock/notify cycles each). Consumers unwrap transparently."""
         if not events:
             return True
-        if self._stopped.is_set():
+        if self._stopped.is_set() or not self._reserve(len(events)):
             return False
-        try:
-            self._q.put_nowait(list(events))
-            return True
-        except queue.Full:
-            return False
+        self._q.put_nowait(list(events))
+        return True
 
     def stop(self) -> None:
         if self._stopped.is_set():
             return
         self._stopped.set()
-        # The sentinel must land even if the queue is full (the laggard-drop
-        # path stops exactly such watchers): evict buffered events until it
-        # fits — the consumer is being cut off anyway.
-        for _ in range(3):
-            try:
-                self._q.put_nowait(_SENTINEL)
-                return
-            except queue.Full:
-                try:
-                    self._q.get_nowait()
-                except queue.Empty:
-                    pass
+        # the queue itself is unbounded (capacity is enforced by the
+        # event counter in send/send_many), so the sentinel always lands
+        self._q.put_nowait(_SENTINEL)
 
     @property
     def stopped(self) -> bool:
@@ -95,8 +97,10 @@ class Watcher:
                 # Drain-to-sentinel: deliver nothing after stop.
                 return
             if isinstance(item, list):
+                self._release(len(item))
                 self._pending.extend(item)
                 continue
+            self._release(1)
             yield item
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
@@ -110,8 +114,10 @@ class Watcher:
         if item is _SENTINEL:
             return None
         if isinstance(item, list):
+            self._release(len(item))
             self._pending.extend(item)
             return self._pending.popleft()
+        self._release(1)
         return item
 
 

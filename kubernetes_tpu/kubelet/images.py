@@ -1,0 +1,92 @@
+"""Image manager: pull policies + LRU image garbage collection.
+
+Reference: pkg/kubelet/container/image_puller.go (EnsureImageExists —
+pull-policy dispatch, back-to-back pull throttling is out of hollow
+scope) and pkg/kubelet/image_manager.go (disk-threshold LRU GC). The
+runtime seam is a `puller(image) -> None` callable (the docker-pull HTTP
+call in the reference; instant success for hollow nodes, a no-op for the
+subprocess runtime whose "images" are argv[0] binaries).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class ImageNeverPullError(Exception):
+    """(ref: image_puller.go ErrImageNeverPull)"""
+
+
+def default_pull_policy(image: str, explicit: str) -> str:
+    """:latest (or untagged) images default to Always, the rest to
+    IfNotPresent (ref: pkg/api/v1/defaults.go SetDefaults_Container)."""
+    if explicit:
+        return explicit
+    tag = image.rsplit(":", 1)[1] if ":" in image.split("/")[-1] else ""
+    return "Always" if tag in ("", "latest") else "IfNotPresent"
+
+
+class ImageManager:
+    def __init__(self, puller: Optional[Callable[[str], None]] = None,
+                 recorder=None):
+        self.puller = puller or (lambda image: None)
+        self.recorder = recorder
+        self._lock = threading.Lock()
+        self._present: Dict[str, float] = {}  # image -> last-used ts
+
+    def is_present(self, image: str) -> bool:
+        with self._lock:
+            return image in self._present
+
+    def ensure_image_exists(self, pod, container) -> None:
+        """(ref: image_puller.go EnsureImageExists)"""
+        image = container.image
+        policy = default_pull_policy(image, container.image_pull_policy)
+        with self._lock:
+            present = image in self._present
+            if present:
+                self._present[image] = time.time()
+        if policy == "Never" and not present:
+            raise ImageNeverPullError(
+                f"container {container.name}: image {image!r} is not "
+                f"present with pull policy of Never")
+        if policy == "IfNotPresent" and present:
+            return
+        self.puller(image)
+        if self.recorder is not None:
+            self.recorder.eventf(pod, "Normal", "Pulled",
+                                 f"Successfully pulled image {image!r}")
+        with self._lock:
+            self._present[image] = time.time()
+
+    def images(self):
+        with self._lock:
+            return dict(self._present)
+
+    def garbage_collect(self, usage_percent: float,
+                        high_threshold: float = 90.0,
+                        low_threshold: float = 80.0,
+                        remover: Optional[Callable[[str], None]] = None
+                        ) -> int:
+        """Evict least-recently-used images until usage is projected
+        under the low threshold (ref: image_manager.go GarbageCollect —
+        thresholds are --image-gc-high-threshold/-low-threshold). Each
+        evicted image is assumed to free an equal share of usage, the
+        hollow stand-in for byte sizes."""
+        if usage_percent < high_threshold:
+            return 0
+        with self._lock:
+            by_age = sorted(self._present.items(), key=lambda kv: kv[1])
+            if not by_age:
+                return 0
+            share = usage_percent / len(by_age)
+            freed = 0
+            while by_age and usage_percent - freed * share > low_threshold:
+                image, _ = by_age.pop(0)
+                del self._present[image]
+                if remover is not None:
+                    remover(image)
+                freed += 1
+            return freed

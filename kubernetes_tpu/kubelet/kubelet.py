@@ -83,10 +83,25 @@ class Kubelet:
     def __init__(self, client, node_name: str,
                  runtime: Optional[Runtime] = None,
                  prober: Optional[Prober] = None,
-                 max_restart_backoff: float = 10.0):
+                 max_restart_backoff: float = 10.0,
+                 volume_mgr=None, image_manager=None,
+                 manifest_path: Optional[str] = None,
+                 manifest_url: Optional[str] = None):
+        """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
+        before containers start and torn down on deletion (kubelet.go
+        syncPod mountExternalVolumes). image_manager: pull-policy
+        enforcement before each container start (image_puller.go).
+        manifest_path/url: static-pod sources merged with the apiserver
+        watch (pkg/kubelet/config)."""
         self.client = client
         self.node_name = node_name
         self.runtime = runtime or FakeRuntime()
+        self.volume_mgr = volume_mgr
+        self.image_manager = image_manager
+        self.manifest_path = manifest_path
+        self.manifest_url = manifest_url
+        self._sources = []
+        self._mounted: set = set()  # pod uids with volumes set up
         self.pleg = GenericPLEG(self.runtime)
         self.prober_manager = ProberManager(
             prober or Prober(), on_liveness_failure=self._liveness_failed,
@@ -146,6 +161,14 @@ class Kubelet:
             worker.stop()
         self.prober_manager.remove_pod(uid)
         self.runtime.kill_pod(uid)
+        if self.volume_mgr is not None and uid in self._mounted:
+            try:
+                self.volume_mgr.tear_down_pod_volumes(pod)
+            except Exception:
+                pass  # stays in _mounted: housekeeping retries it
+            else:
+                with self._lock:
+                    self._mounted.discard(uid)
         self.status_manager.forget(pod)
 
     # ----------------------------------------------------------- syncPod
@@ -157,6 +180,24 @@ class Kubelet:
         by_name = {c.name: c for c in runtime_pod.containers} \
             if runtime_pod else {}
         now = time.time()
+        if self.volume_mgr is not None:
+            # volumes mount before any container starts, EVERY sync —
+            # set_up is idempotent and a spec update may declare new
+            # volumes (kubelet.go syncPod mountExternalVolumes); failure
+            # holds the whole pod in backoff, not just one container
+            key = f"{uid}/#volumes"
+            if self._backoff.get(key, 0) > now:
+                return
+            try:
+                self.volume_mgr.set_up_pod_volumes(pod)
+                with self._lock:
+                    self._mounted.add(uid)
+                self._backoff.pop(key, None)
+                self._backoff.pop(f"{key}#d", None)
+            except Exception:
+                self._note_backoff(key, now)
+                self._publish_status(pod)
+                return
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
             if rc is not None and rc.state == ContainerState.RUNNING:
@@ -168,15 +209,22 @@ class Kubelet:
             if self._backoff.get(key, 0) > now:
                 continue
             try:
+                if self.image_manager is not None:
+                    # pull policy gates the start (image_puller.go
+                    # EnsureImageExists)
+                    self.image_manager.ensure_image_exists(pod, container)
                 self.runtime.start_container(pod, container)
                 self._backoff.pop(key, None)
                 self._backoff.pop(f"{key}#d", None)  # full delay reset
             except Exception:
-                prev = self._backoff.get(f"{key}#d", 0.5)
-                delay = min(prev * 2, self.max_restart_backoff)
-                self._backoff[key] = now + delay
-                self._backoff[f"{key}#d"] = delay
+                self._note_backoff(key, now)
         self._publish_status(pod)
+
+    def _note_backoff(self, key: str, now: float) -> None:
+        prev = self._backoff.get(f"{key}#d", 0.5)
+        delay = min(prev * 2, self.max_restart_backoff)
+        self._backoff[key] = now + delay
+        self._backoff[f"{key}#d"] = delay
 
     @staticmethod
     def _should_restart(policy: str, exit_code: int) -> bool:
@@ -308,13 +356,24 @@ class Kubelet:
                 self._housekeeping()
 
     def _housekeeping(self) -> None:
-        """Kill runtime pods whose API object is gone
-        (kubelet.go HandlePodCleanups)."""
+        """Kill runtime pods whose API object is gone, and tear down
+        their orphaned volume dirs (kubelet.go HandlePodCleanups +
+        cleanupOrphanedPodDirs)."""
         with self._lock:
             known = set(self._pods)
         for rp in self.runtime.get_pods():
             if rp.uid not in known:
                 self.runtime.kill_pod(rp.uid)
+        if self.volume_mgr is not None:
+            with self._lock:
+                orphaned = self._mounted - known
+            for uid in orphaned:
+                try:
+                    self.volume_mgr.tear_down_orphaned(uid)
+                except Exception:
+                    continue  # stays tracked: next pass retries
+                with self._lock:
+                    self._mounted.discard(uid)
 
     # -------------------------------------------------------- lifecycle
 
@@ -327,6 +386,21 @@ class Kubelet:
             on_add=self.handle_pod_addition,
             on_update=self.handle_pod_update,
             on_delete=self.handle_pod_deletion).start()
+        if self.manifest_path or self.manifest_url:
+            # static-pod sources merge with the apiserver stream
+            # (pkg/kubelet/config PodConfig mux)
+            from .config import FileSource, HTTPSource, PodConfig
+            pod_config = PodConfig(self.handle_pod_addition,
+                                   self.handle_pod_update,
+                                   self.handle_pod_deletion)
+            if self.manifest_path:
+                self._sources.append(FileSource(
+                    pod_config, self.node_name,
+                    self.manifest_path).start())
+            if self.manifest_url:
+                self._sources.append(HTTPSource(
+                    pod_config, self.node_name,
+                    self.manifest_url).start())
         t = threading.Thread(target=self._sync_loop, daemon=True,
                              name=f"kubelet-{self.node_name}")
         t.start()
@@ -337,6 +411,8 @@ class Kubelet:
         self._stop.set()
         if self._informer:
             self._informer.stop()
+        for source in self._sources:
+            source.stop()
         self.pleg.stop()
         self.prober_manager.stop()
         self.status_manager.stop()

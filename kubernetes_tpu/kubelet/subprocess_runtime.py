@@ -48,6 +48,7 @@ class SubprocessRuntime(Runtime):
         # image-less containers run the default command (the pause-
         # container analogue: hold the pod alive until killed)
         self.root_dir = root_dir or tempfile.mkdtemp(prefix="kubelet-run-")
+        os.makedirs(self.root_dir, exist_ok=True)
         self.default_command = list(default_command or ["sleep", "3600"])
         self._procs: Dict[Tuple[str, str], _Proc] = {}  # (uid, name)
         self._pods: Dict[str, api.Pod] = {}

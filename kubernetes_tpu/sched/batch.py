@@ -106,7 +106,13 @@ class BatchScheduler:
             # after this point still binds — both threads are daemons
             return
         # flush: every scheduled-but-uncommitted tile still binds
-        self._commit_q.put(None)
+        try:
+            self._commit_q.put(None, timeout=30)
+        except queue.Full:
+            # committer wedged mid-tile (e.g. per-pod CAS fallback over
+            # a big tile): it's a daemon, let it drain in the background
+            # rather than hanging shutdown
+            return
         if self._commit_thread:
             self._commit_thread.join(timeout=30)
 

@@ -336,14 +336,24 @@ class _GitRepoBuilder(_DirBuilder):
             raise BadRequest(
                 f"invalid git revision {self.revision!r}")
         super().set_up()
+        # resync idempotence keys on a marker written only after BOTH
+        # clone and checkout succeeded — a clone whose checkout failed
+        # must retry, not silently serve the default branch. The marker
+        # lives inside the volume dir, so teardown removes it with it.
+        marker = os.path.join(self.path, ".kubelet-git-ready")
+        if os.path.exists(marker):
+            return
         if os.listdir(self.path):
-            return  # idempotent resync: already cloned
+            shutil.rmtree(self.path)  # half-finished prior attempt
+            os.makedirs(self.path)
         subprocess.run(["git", "clone", "--", self.repository, self.path],
                        check=True, capture_output=True, timeout=120)
         if self.revision:
             subprocess.run(["git", "checkout", self.revision, "--"],
                            cwd=self.path, check=True, capture_output=True,
                            timeout=60)
+        with open(marker, "w"):
+            pass
 
 
 class GitRepoPlugin(VolumePlugin):
@@ -503,6 +513,7 @@ class VolumePluginMgr:
 
     def __init__(self, plugins: List[VolumePlugin], host: VolumeHost):
         self.plugins = list(plugins)
+        self.host = host
         for plugin in self.plugins:
             plugin.init(host)
 
@@ -536,6 +547,19 @@ class VolumePluginMgr:
         for volume in pod.spec.volumes:
             plugin = self.find_plugin(volume)
             plugin.new_cleaner_from_spec(volume, pod).tear_down()
+
+    def tear_down_orphaned(self, pod_uid: str) -> None:
+        """Remove a gone pod's whole volume tree — the spec is no longer
+        available, so per-plugin cleaners can't run (ref: kubelet.go
+        cleanupOrphanedPodDirs)."""
+        pod_dir = os.path.join(self.host.root_dir, "pods", pod_uid)
+        root = os.path.realpath(self.host.root_dir)
+        real = os.path.realpath(pod_dir)
+        if not real.startswith(root + os.sep):
+            raise BadRequest(
+                f"pod dir {pod_dir!r} escapes kubelet root {root!r}")
+        if os.path.isdir(real):
+            shutil.rmtree(real, ignore_errors=True)
 
 
 def new_default_plugin_mgr(host: VolumeHost) -> VolumePluginMgr:

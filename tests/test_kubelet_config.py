@@ -1,0 +1,210 @@
+"""Kubelet completeness: image manager (pull policies + GC), static pod
+sources (file/HTTP mux), and volumes in the pod sync path (ref:
+pkg/kubelet/container/image_puller.go, pkg/kubelet/image_manager.go,
+pkg/kubelet/config/, kubelet.go syncPod mountExternalVolumes)."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.config import (FileSource, HTTPSource,
+                                           PodConfig)
+from kubernetes_tpu.kubelet.images import (ImageManager,
+                                           ImageNeverPullError,
+                                           default_pull_policy)
+from kubernetes_tpu.volume import VolumeHost, new_default_plugin_mgr
+
+
+def wait_until(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def mkpod(name, uid="", node="n1", image="img:v1", volumes=None,
+          pull_policy=""):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=api.PodSpec(
+            node_name=node, volumes=volumes or [],
+            containers=[api.Container(name="c", image=image,
+                                      image_pull_policy=pull_policy)]),
+        status=api.PodStatus(phase="Pending"))
+
+
+class TestImageManager:
+    def test_default_policy(self):
+        assert default_pull_policy("nginx", "") == "Always"
+        assert default_pull_policy("nginx:latest", "") == "Always"
+        assert default_pull_policy("nginx:1.9", "") == "IfNotPresent"
+        assert default_pull_policy("reg:5000/nginx:1.9", "") \
+            == "IfNotPresent"
+        assert default_pull_policy("nginx:1.9", "Always") == "Always"
+
+    def test_pull_counting_and_if_not_present(self):
+        pulls = []
+        mgr = ImageManager(puller=pulls.append)
+        pod = mkpod("p", "u1", image="app:v1")
+        c = pod.spec.containers[0]
+        mgr.ensure_image_exists(pod, c)
+        mgr.ensure_image_exists(pod, c)
+        assert pulls == ["app:v1"]  # IfNotPresent: one pull
+
+        pod2 = mkpod("p2", "u2", image="app:latest")
+        mgr.ensure_image_exists(pod2, pod2.spec.containers[0])
+        mgr.ensure_image_exists(pod2, pod2.spec.containers[0])
+        assert pulls.count("app:latest") == 2  # Always re-pulls
+
+    def test_never_policy(self):
+        mgr = ImageManager()
+        pod = mkpod("p", "u1", image="ghost:v1", pull_policy="Never")
+        with pytest.raises(ImageNeverPullError):
+            mgr.ensure_image_exists(pod, pod.spec.containers[0])
+        # present images pass under Never
+        mgr._present["ghost:v1"] = time.time()
+        mgr.ensure_image_exists(pod, pod.spec.containers[0])
+
+    def test_gc_evicts_lru(self):
+        removed = []
+        mgr = ImageManager()
+        for i, image in enumerate(["old:1", "mid:1", "new:1"]):
+            mgr._present[image] = float(i)
+        n = mgr.garbage_collect(95.0, remover=removed.append)
+        assert n >= 1 and removed[0] == "old:1"
+        assert mgr.garbage_collect(50.0) == 0  # under threshold: no-op
+
+
+class TestPodSources:
+    def test_file_source_add_update_delete(self, tmp_path):
+        events = []
+        config = PodConfig(
+            on_add=lambda p: events.append(("add", p.metadata.name)),
+            on_update=lambda o, p: events.append(("upd", p.metadata.name)),
+            on_delete=lambda p: events.append(("del", p.metadata.name)))
+        manifest = tmp_path / "web.json"
+        from kubernetes_tpu.core.scheme import default_scheme
+        manifest.write_text(json.dumps(
+            default_scheme.encode_dict(mkpod("web", node=""))))
+        src = FileSource(config, "node-9", str(tmp_path))
+        src.poll_once()
+        assert events == [("add", "web-node-9")]
+        # static defaults: deterministic uid, node bound, ns default
+        src.poll_once()
+        assert len(events) == 1  # unchanged manifest: no churn
+        manifest.unlink()
+        src.poll_once()
+        assert events[-1] == ("del", "web-node-9")
+
+    def test_http_source_podlist(self):
+        from kubernetes_tpu.core.scheme import default_scheme
+        body = json.dumps({"kind": "PodList", "items": [
+            default_scheme.encode_dict(mkpod("a", node="")),
+            default_scheme.encode_dict(mkpod("b", node=""))]}).encode()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            added = []
+            config = PodConfig(
+                on_add=lambda p: added.append(p.metadata.name),
+                on_update=lambda o, p: None, on_delete=lambda p: None)
+            src = HTTPSource(config, "n1",
+                             f"http://127.0.0.1:{httpd.server_address[1]}/")
+            src.poll_once()
+            assert sorted(added) == ["a-n1", "b-n1"]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_static_pod_runs_through_kubelet(self, tmp_path):
+        """A manifest file becomes a running (fake) container with no
+        apiserver pod object — the static-pod contract."""
+        registry = Registry()
+        runtime = FakeRuntime()
+        manifests = tmp_path / "manifests"
+        manifests.mkdir()
+        from kubernetes_tpu.core.scheme import default_scheme
+        (manifests / "static.json").write_text(json.dumps(
+            default_scheme.encode_dict(mkpod("static", node=""))))
+        kubelet = Kubelet(InProcClient(registry), "n1", runtime=runtime,
+                          manifest_path=str(manifests)).run()
+        try:
+            assert wait_until(lambda: any(
+                rp.name.startswith("static-n1")
+                for rp in runtime.get_pods()))
+            # removing the manifest tears the pod down
+            (manifests / "static.json").unlink()
+            assert wait_until(lambda: not runtime.get_pods(), timeout=30)
+        finally:
+            kubelet.stop()
+
+
+class TestVolumesInSyncPath:
+    def test_volumes_mount_before_start_and_teardown_on_delete(
+            self, tmp_path):
+        registry = Registry()
+        client = InProcClient(registry)
+        runtime = FakeRuntime()
+        mgr = new_default_plugin_mgr(VolumeHost(str(tmp_path),
+                                                client=client))
+        kubelet = Kubelet(client, "n1", runtime=runtime,
+                          volume_mgr=mgr).run()
+        try:
+            pod = mkpod("vols", volumes=[api.Volume(
+                name="scratch", empty_dir=api.EmptyDirVolumeSource())])
+            created = client.create("pods", pod, "default")
+            uid = created.metadata.uid
+            vol_dir = os.path.join(
+                str(tmp_path), "pods", uid, "volumes",
+                "kubernetes.io~empty-dir", "scratch")
+            assert wait_until(lambda: os.path.isdir(vol_dir))
+            client.delete("pods", "vols", "default")
+            assert wait_until(lambda: not os.path.exists(vol_dir))
+        finally:
+            kubelet.stop()
+
+    def test_orphaned_volume_dirs_cleaned(self, tmp_path):
+        mgr = new_default_plugin_mgr(VolumeHost(str(tmp_path)))
+        pod = mkpod("ghost", uid="gone-uid", volumes=[api.Volume(
+            name="scratch", empty_dir=api.EmptyDirVolumeSource())])
+        mgr.set_up_pod_volumes(pod)
+        pod_dir = os.path.join(str(tmp_path), "pods", "gone-uid")
+        assert os.path.isdir(pod_dir)
+        mgr.tear_down_orphaned("gone-uid")
+        assert not os.path.exists(pod_dir)
+
+
+def test_empty_volume_source_roundtrips_presence():
+    """`emptyDir: {}` selects the volume type by PRESENCE; the codec
+    must not drop all-default optional dataclasses (a manifest-file
+    static pod with an emptyDir volume lost its volume source before
+    this guard)."""
+    from kubernetes_tpu.core.scheme import default_scheme
+    pod = mkpod("p", volumes=[api.Volume(
+        name="scratch", empty_dir=api.EmptyDirVolumeSource())])
+    wire = default_scheme.encode_dict(pod)
+    vol = wire["spec"]["volumes"][0]
+    assert vol["emptyDir"] == {}
+    back = default_scheme.decode_dict(wire)
+    assert back.spec.volumes[0].empty_dir is not None

@@ -13,6 +13,7 @@ class Operation:
     CREATE = "CREATE"
     UPDATE = "UPDATE"
     DELETE = "DELETE"
+    CONNECT = "CONNECT"  # exec/attach/proxy subresources
 
 
 @dataclass

@@ -295,6 +295,77 @@ class SecurityContextDeny(Interface):
                     f"privileged container {c.name!r} is forbidden")
 
 
+# the InitialResources usage history: image -> {"cpu"|"memory": milli}.
+# Process-global by design — it plays the reference's shared metrics DB
+# (influxdb/GCM), not per-apiserver state.
+usage_history: Dict[str, Dict[str, int]] = {}
+
+
+def record_usage(image: str, resource: str, milli: int) -> None:
+    """Feed the InitialResources history (the kubelet-stats role)."""
+    usage_history.setdefault(image, {})[resource] = int(milli)
+
+
+class DenyExecOnPrivileged(Interface):
+    """Reject exec into pods that run privileged or host-network
+    (ref: plugin/pkg/admission/exec/denyprivileged — intercepts the
+    pods/exec CONNECT; our apiserver relay consults it before relaying
+    to the kubelet)."""
+
+    def __init__(self, registry):
+        self.registry = registry
+
+    def handles(self, operation: str) -> bool:
+        return operation == Operation.CONNECT
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource != "pods/exec":
+            return
+        try:
+            pod = self.registry.get("pods", attributes.name,
+                                    attributes.namespace)
+        except NotFound:
+            return  # missing pod fails later with a clean 404
+        # any other lookup failure propagates: a security admission
+        # plugin must fail CLOSED, not open
+        if pod.spec.host_network or any(
+                getattr(c, "privileged", False)
+                for c in pod.spec.containers):
+            raise Forbidden(
+                f"cannot exec into privileged/host-network pod "
+                f"{attributes.name!r}")
+
+
+class InitialResources(Interface):
+    """Fill absent container CPU/memory requests from observed usage
+    (ref: plugin/pkg/admission/initialresources — the reference queries
+    an influxdb/GCM history, a store shared by every consumer; the
+    analogue here is the module-level `usage_history`, fed via
+    `record_usage` by whatever meters containers, or a custom
+    `estimator(image, resource) -> milli or None`)."""
+
+    def __init__(self, registry, estimator=None):
+        self.registry = registry
+        self.estimator = estimator or (
+            lambda image, resource:
+            usage_history.get(image, {}).get(resource))
+
+    def handles(self, operation: str) -> bool:
+        return operation == Operation.CREATE
+
+    def admit(self, attributes: Attributes) -> None:
+        if attributes.resource != "pods" or attributes.object is None:
+            return
+        pod: api.Pod = attributes.object
+        for c in pod.spec.containers:
+            for resource in ("cpu", "memory"):
+                if resource in c.resources.requests:
+                    continue
+                milli = self.estimator(c.image, resource)
+                if milli is not None:
+                    c.resources.requests[resource] = Quantity(int(milli))
+
+
 register_plugin("AlwaysAdmit", lambda r: AlwaysAdmit())
 register_plugin("AlwaysDeny", lambda r: AlwaysDeny())
 register_plugin("NamespaceLifecycle", NamespaceLifecycle)
@@ -304,3 +375,5 @@ register_plugin("LimitRanger", LimitRanger)
 register_plugin("ResourceQuota", ResourceQuota)
 register_plugin("ServiceAccount", ServiceAccountPlugin)
 register_plugin("SecurityContextDeny", SecurityContextDeny)
+register_plugin("DenyExecOnPrivileged", DenyExecOnPrivileged)
+register_plugin("InitialResources", InitialResources)

@@ -1,0 +1,117 @@
+"""kubeconfig loading — the clientcmd role.
+
+Reference: pkg/client/unversioned/clientcmd (kubeconfig schema: clusters
+/ users / contexts / current-context, merged from --kubeconfig, the
+KUBECONFIG env var, or ~/.kube/config) feeding client.Config. Supports
+the credential forms the server side understands: bearer token,
+token-file, and basic auth.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..core.errors import BadRequest
+
+DEFAULT_PATH = os.path.join(os.path.expanduser("~"), ".kube", "config")
+
+
+@dataclass
+class Cluster:
+    server: str = ""
+
+
+@dataclass
+class AuthInfo:
+    token: str = ""
+    token_file: str = ""
+    username: str = ""
+    password: str = ""
+
+
+@dataclass
+class Context:
+    cluster: str = ""
+    user: str = ""
+    namespace: str = ""
+
+
+@dataclass
+class KubeConfig:
+    clusters: Dict[str, Cluster] = field(default_factory=dict)
+    users: Dict[str, AuthInfo] = field(default_factory=dict)
+    contexts: Dict[str, Context] = field(default_factory=dict)
+    current_context: str = ""
+
+    def resolve(self, context: str = ""):
+        """-> (server, headers, namespace) for a context (default: the
+        current-context), ready for HttpClient."""
+        name = context or self.current_context
+        if not name:
+            raise BadRequest("kubeconfig has no current-context")
+        ctx = self.contexts.get(name)
+        if ctx is None:
+            raise BadRequest(f"context {name!r} not found in kubeconfig")
+        cluster = self.clusters.get(ctx.cluster)
+        if cluster is None or not cluster.server:
+            raise BadRequest(
+                f"context {name!r} names unknown cluster {ctx.cluster!r}")
+        headers: Dict[str, str] = {}
+        user = self.users.get(ctx.user)
+        if user is not None:
+            token = user.token
+            if not token and user.token_file:
+                with open(user.token_file) as f:
+                    token = f.read().strip()
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+            elif user.username:
+                raw = f"{user.username}:{user.password}".encode()
+                headers["Authorization"] = \
+                    "Basic " + base64.b64encode(raw).decode()
+        return cluster.server, headers, ctx.namespace or "default"
+
+
+def load_kubeconfig(path: Optional[str] = None) -> KubeConfig:
+    """Load one kubeconfig file (YAML or JSON — YAML is a superset).
+    Resolution order mirrors clientcmd: explicit path, $KUBECONFIG,
+    ~/.kube/config."""
+    try:
+        import yaml
+        loads = yaml.safe_load
+    except ImportError:  # stdlib-only environments: JSON configs work
+        import json
+        loads = json.loads
+
+    path = path or os.environ.get("KUBECONFIG") or DEFAULT_PATH
+    with open(path) as f:
+        data = loads(f.read()) or {}
+    cfg = KubeConfig(current_context=data.get("current-context", ""))
+    for entry in data.get("clusters", []):
+        cfg.clusters[entry.get("name", "")] = Cluster(
+            server=(entry.get("cluster") or {}).get("server", ""))
+    for entry in data.get("users", []):
+        u = entry.get("user") or {}
+        cfg.users[entry.get("name", "")] = AuthInfo(
+            token=u.get("token", ""),
+            token_file=u.get("tokenFile", ""),
+            username=u.get("username", ""),
+            password=u.get("password", ""))
+    for entry in data.get("contexts", []):
+        c = entry.get("context") or {}
+        cfg.contexts[entry.get("name", "")] = Context(
+            cluster=c.get("cluster", ""), user=c.get("user", ""),
+            namespace=c.get("namespace", ""))
+    return cfg
+
+
+def client_from_kubeconfig(path: Optional[str] = None,
+                           context: str = ""):
+    """-> (HttpClient, default_namespace)."""
+    from .client import HttpClient
+
+    server, headers, namespace = load_kubeconfig(path).resolve(context)
+    return HttpClient(server, headers=headers), namespace

@@ -194,6 +194,13 @@ class ApiServer:
         if path == "/metrics":
             return self._send_raw(h, 200, self.metrics.render().encode(),
                                   "text/plain; version=0.0.4")
+        if path == "/swaggerapi":
+            from .swagger import swagger_api
+            return self._send_json(h, 200, swagger_api(self.url))
+        if path in ("/ui", "/ui/"):
+            from .swagger import ui_page
+            return self._send_raw(h, 200, ui_page().encode(),
+                                  "text/html; charset=utf-8")
         if path == "/api":
             return self._send_json(h, 200, {"kind": "APIVersions",
                                             "versions": ["v1"]})
@@ -374,6 +381,13 @@ class ApiServer:
 
     def _proxy_node(self, h, node_name: str, rest: str,
                     raw_query: str) -> None:
+        segments = [s for s in rest.split("/") if s]
+        if segments and segments[0] == "exec" and len(segments) >= 3 \
+                and self.registry.admission is not None:
+            # exec admission (DenyExecOnPrivileged): the relay is the
+            # CONNECT moment (ref: plugin/pkg/admission/exec)
+            self.registry.admission("CONNECT", "pods/exec", None,
+                                    segments[1], segments[2])
         base = self._kubelet_base(node_name)
         self._relay(h, f"{base}/{rest}"
                     + (f"?{raw_query}" if raw_query else ""))

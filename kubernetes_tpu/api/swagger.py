@@ -1,0 +1,119 @@
+"""Swagger API discovery + the minimal UI page.
+
+Reference: pkg/apiserver InstallSwaggerAPI (go-restful swagger at
+/swaggerapi) and pkg/ui (the bundled dashboard at /ui; its 17k LoC of
+go-bindata'd JS is replaced by one reflective page — the reference's
+generated datafile.go is exactly the kind of artifact this design
+obviates). Models are derived from the dataclass schema the same way
+the serde is, so the docs can never drift from the wire format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict, get_args, get_origin
+
+from ..core.quantity import Quantity
+from ..core.serde import _camel
+from .registry import RESOURCES
+
+
+def _type_name(tp: Any) -> str:
+    tp_origin = get_origin(tp)
+    if tp_origin is typing.Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        return _type_name(args[0]) if args else "any"
+    if tp_origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return f"array[{_type_name(elem)}]"
+    if tp_origin is dict:
+        args = get_args(tp)
+        vtp = args[1] if len(args) == 2 else Any
+        return f"map[string,{_type_name(vtp)}]"
+    if tp is Quantity:
+        return "string"
+    if dataclasses.is_dataclass(tp):
+        return tp.__name__
+    return getattr(tp, "__name__", "any")
+
+
+def _collect_models(cls: type, models: Dict[str, dict]) -> None:
+    if not dataclasses.is_dataclass(cls) or cls.__name__ in models:
+        return
+    props: Dict[str, dict] = {}
+    models[cls.__name__] = {"id": cls.__name__, "properties": props}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        tp = hints[f.name]
+        props[_camel(f.name)] = {"type": _type_name(tp)}
+        # recurse into nested dataclasses (incl. through containers)
+        stack = [tp]
+        while stack:
+            t = stack.pop()
+            origin = get_origin(t)
+            if origin is not None:
+                stack.extend(get_args(t))
+            elif dataclasses.is_dataclass(t):
+                _collect_models(t, models)
+
+
+def swagger_api(base_url: str = "") -> dict:
+    """The /swaggerapi document: one api entry per REST resource plus
+    the reflected model schemas."""
+    apis = []
+    models: Dict[str, dict] = {}
+    for name, info in sorted(RESOURCES.items()):
+        prefix = ("/apis/extensions/v1beta1" if _is_extensions(name)
+                  else "/api/v1")
+        path = (f"{prefix}/namespaces/{{namespace}}/{name}"
+                if info.namespaced else f"{prefix}/{name}")
+        apis.append({
+            "path": path,
+            "description": f"API for {info.kind} ({name})",
+            "operations": [
+                {"method": m, "type": info.kind}
+                for m in ("GET", "POST", "PUT", "DELETE")],
+        })
+        _collect_models(info.cls, models)
+    return {
+        "swaggerVersion": "1.2",
+        "basePath": base_url,
+        "apiVersion": "v1",
+        "apis": apis,
+        "models": models,
+    }
+
+
+def _is_extensions(resource: str) -> bool:
+    from .registry import EXTENSIONS_RESOURCES
+    return resource in EXTENSIONS_RESOURCES
+
+
+def ui_page() -> str:
+    """The /ui dashboard: live resource listing (pkg/ui's role)."""
+    rows = "\n".join(
+        f'<tr><td><a href="{_href(name, info)}">{name}</a></td>'
+        f"<td>{info.kind}</td>"
+        f"<td>{'namespaced' if info.namespaced else 'cluster'}</td></tr>"
+        for name, info in sorted(RESOURCES.items()))
+    return f"""<!DOCTYPE html>
+<html><head><title>kubernetes_tpu</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: 4px 12px; }}
+</style></head>
+<body>
+<h1>kubernetes_tpu</h1>
+<p>API resources (<a href="/swaggerapi">swagger</a>,
+<a href="/metrics">metrics</a>, <a href="/healthz">healthz</a>)</p>
+<table><tr><th>resource</th><th>kind</th><th>scope</th></tr>
+{rows}
+</table></body></html>"""
+
+
+def _href(name: str, info) -> str:
+    prefix = ("/apis/extensions/v1beta1" if _is_extensions(name)
+              else "/api/v1")
+    return f"{prefix}/{name}"

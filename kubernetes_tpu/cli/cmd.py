@@ -33,9 +33,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kubectl",
         description="controls the kubernetes_tpu cluster manager")
-    p.add_argument("-s", "--server", default="http://127.0.0.1:8080")
+    p.add_argument("-s", "--server", default="")
     p.add_argument("--token", default="", help="bearer token")
-    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--kubeconfig", default="",
+                   help="path to a kubeconfig file (default: $KUBECONFIG "
+                        "or ~/.kube/config)")
+    p.add_argument("--context", default="",
+                   help="kubeconfig context to use")
+    p.add_argument("-n", "--namespace", default="")
     sub = p.add_subparsers(dest="command")
 
     g = sub.add_parser("get", help="display one or many resources")
@@ -509,11 +514,34 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
     if ns_args.command is None:
         parser.print_help()
         return 1
-    headers = ({"Authorization": f"Bearer {ns_args.token}"}
-               if ns_args.token else None)
-    client = client or HttpClient(ns_args.server, headers=headers)
-    k = Kubectl(client, out=out, err=err)
     ns = ns_args.namespace
+    if client is None:
+        # credential resolution mirrors clientcmd: explicit -s/--token
+        # beats kubeconfig; kubeconfig is consulted when -s is absent
+        # and a config exists (--kubeconfig / $KUBECONFIG /
+        # ~/.kube/config)
+        from ..api.kubeconfig import DEFAULT_PATH, client_from_kubeconfig
+        import os as _os
+        kc_path = (ns_args.kubeconfig or _os.environ.get("KUBECONFIG")
+                   or (DEFAULT_PATH if _os.path.exists(DEFAULT_PATH)
+                       else ""))
+        if not ns_args.server and not ns_args.token and kc_path:
+            try:
+                client, kc_ns = client_from_kubeconfig(
+                    kc_path, ns_args.context)
+                ns = ns or kc_ns
+            except Exception as e:  # unreadable/malformed config: a
+                # clean one-liner, whatever the parser raised
+                (err or sys.stderr).write(f"Error loading kubeconfig: {e}\n")
+                return 1
+        else:
+            headers = ({"Authorization": f"Bearer {ns_args.token}"}
+                       if ns_args.token else None)
+            client = HttpClient(
+                ns_args.server or "http://127.0.0.1:8080",
+                headers=headers)
+    k = Kubectl(client, out=out, err=err)
+    ns = ns or "default"
     try:
         if ns_args.command == "get":
             k.get(ns, ns_args.args, ns_args.output, ns_args.selector,
@@ -555,7 +583,8 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         elif ns_args.command == "api-versions":
             k.api_versions()
         elif ns_args.command == "cluster-info":
-            k.cluster_info(ns_args.server)
+            k.cluster_info(getattr(client, "base_url", None)
+                           or ns_args.server)
         return 0
     except ApiError as e:
         (err or sys.stderr).write(f"Error: {e}\n")

@@ -48,7 +48,10 @@ class Watcher:
 
     def _reserve(self, n: int) -> bool:
         with self._count_lock:
-            if self._count + n > self.capacity:
+            # a single batch larger than capacity is admitted into an
+            # EMPTY watcher (it isn't lagging — the commit is just big);
+            # a watcher already holding events gets the strict bound
+            if self._count + n > self.capacity and self._count > 0:
                 return False
             self._count += n
             return True

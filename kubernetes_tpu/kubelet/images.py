@@ -77,16 +77,20 @@ class ImageManager:
         hollow stand-in for byte sizes."""
         if usage_percent < high_threshold:
             return 0
+        evicted = []
         with self._lock:
             by_age = sorted(self._present.items(), key=lambda kv: kv[1])
             if not by_age:
                 return 0
             share = usage_percent / len(by_age)
-            freed = 0
-            while by_age and usage_percent - freed * share > low_threshold:
+            while by_age and \
+                    usage_percent - len(evicted) * share > low_threshold:
                 image, _ = by_age.pop(0)
                 del self._present[image]
-                if remover is not None:
-                    remover(image)
-                freed += 1
-            return freed
+                evicted.append(image)
+        # removers run OUTSIDE the lock: they may be slow or call back
+        # into this manager
+        if remover is not None:
+            for image in evicted:
+                remover(image)
+        return len(evicted)

@@ -1,0 +1,228 @@
+"""Coverage breadth: exec/initial-resources admission, kubeconfig
+clientcmd, swagger discovery + UI, JWT (OIDC-shaped) authentication
+(ref: plugin/pkg/admission/{exec,initialresources},
+pkg/client/unversioned/clientcmd, pkg/apiserver swagger + pkg/ui,
+plugin/pkg/auth/authenticator/token/oidc)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml
+
+from kubernetes_tpu.admission import registry_hook
+from kubernetes_tpu.admission.plugins import (new_from_plugins,
+                                              record_usage, usage_history)
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.kubeconfig import (client_from_kubeconfig,
+                                           load_kubeconfig)
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.auth.authenticate import JWTAuthenticator, make_jwt
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import ApiError, Forbidden
+from kubernetes_tpu.core.quantity import parse_quantity
+
+
+def mkpod(name, privileged=False, host_network=False, requests=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(
+            host_network=host_network,
+            containers=[api.Container(
+                name="c", image="img:v1", privileged=privileged,
+                resources=api.ResourceRequirements(
+                    requests=requests or {}))]))
+
+
+def wired_registry(*plugins):
+    registry = Registry()
+    registry.create("namespaces",
+                    api.Namespace(metadata=api.ObjectMeta(name="default")))
+    registry.admission = registry_hook(
+        new_from_plugins(registry, list(plugins)))
+    return registry
+
+
+class TestExecAdmission:
+    def test_deny_exec_on_privileged_via_proxy(self):
+        registry = wired_registry("DenyExecOnPrivileged")
+        registry.create("pods", mkpod("priv", privileged=True))
+        registry.create("pods", mkpod("plain"))
+        # register a node so the relay path resolves (port 1 = nothing
+        # listening; plain pod's exec must fail with 502, NOT 403)
+        registry.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(
+                daemon_endpoints=api.NodeDaemonEndpoints(
+                    kubelet_endpoint=api.DaemonEndpoint(port=1)))))
+        srv = ApiServer(registry).start()
+        try:
+            url = (srv.url
+                   + "/api/v1/proxy/nodes/n1/exec/default/{}/c?command=id")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(url.format("priv"), timeout=5)
+            assert e.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(url.format("plain"), timeout=5)
+            assert e.value.code == 502  # admission passed, kubelet down
+        finally:
+            srv.stop()
+
+    def test_host_network_denied_too(self):
+        registry = wired_registry("DenyExecOnPrivileged")
+        registry.create("pods", mkpod("hostnet", host_network=True))
+        with pytest.raises(Forbidden):
+            registry.admission("CONNECT", "pods/exec", None,
+                               "default", "hostnet")
+
+
+class TestInitialResources:
+    def test_fills_absent_requests_from_observations(self):
+        registry = wired_registry("InitialResources")
+        record_usage("img:v1", "cpu", 250)
+        record_usage("img:v1", "memory", 128 * 1024 * 1024 * 1000)
+        try:
+            created = registry.create("pods", mkpod("estimated"))
+            req = created.spec.containers[0].resources.requests
+            assert req["cpu"].milli == 250
+        finally:
+            usage_history.clear()
+
+    def test_explicit_requests_untouched(self):
+        registry = wired_registry("InitialResources")
+        record_usage("img:v1", "cpu", 250)
+        try:
+            created = registry.create("pods", mkpod(
+                "explicit", requests={"cpu": parse_quantity("1")}))
+            assert created.spec.containers[0] \
+                .resources.requests["cpu"].milli == 1000
+        finally:
+            usage_history.clear()
+
+
+class TestKubeconfig:
+    def _write(self, tmp_path, server):
+        cfg = {
+            "current-context": "dev",
+            "clusters": [{"name": "local",
+                          "cluster": {"server": server}}],
+            "users": [{"name": "alice", "user": {"token": "sekrit"}}],
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "local", "user": "alice",
+                                      "namespace": "team-a"}}],
+        }
+        path = tmp_path / "config"
+        path.write_text(yaml.safe_dump(cfg))
+        return str(path)
+
+    def test_load_and_resolve(self, tmp_path):
+        path = self._write(tmp_path, "http://127.0.0.1:9999")
+        server, headers, ns = load_kubeconfig(path).resolve()
+        assert server == "http://127.0.0.1:9999"
+        assert headers["Authorization"] == "Bearer sekrit"
+        assert ns == "team-a"
+
+    def test_client_against_live_master(self, tmp_path):
+        from kubernetes_tpu.auth.authenticate import TokenAuthenticator
+        registry = Registry()
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="team-a")))
+        srv = ApiServer(registry, authenticator=TokenAuthenticator.from_lines(
+            ["sekrit,alice,uid1"])).start()
+        try:
+            client, ns = client_from_kubeconfig(
+                self._write(tmp_path, srv.url))
+            client.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="kc-pod", namespace=ns),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="i")])), ns)
+            assert client.get("pods", "kc-pod", ns).metadata.name \
+                == "kc-pod"
+        finally:
+            srv.stop()
+
+    def test_kubectl_uses_kubeconfig(self, tmp_path, monkeypatch):
+        import io
+
+        from kubernetes_tpu.cli.cmd import main as kubectl_main
+        registry = Registry()
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="team-a")))
+        registry.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="seen", namespace="team-a"),
+            spec=api.PodSpec(containers=[api.Container(name="c",
+                                                       image="i")])))
+        srv = ApiServer(registry).start()
+        try:
+            out = io.StringIO()
+            rc = kubectl_main(
+                ["--kubeconfig", self._write(tmp_path, srv.url),
+                 "get", "pods"], out=out)
+            assert rc == 0
+            assert "seen" in out.getvalue()  # namespace came from context
+        finally:
+            srv.stop()
+
+
+class TestSwaggerAndUI:
+    def test_swagger_reflects_resources_and_models(self):
+        srv = ApiServer(Registry()).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/swaggerapi",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+            paths = {a["path"] for a in doc["apis"]}
+            assert "/api/v1/namespaces/{namespace}/pods" in paths
+            assert "/api/v1/nodes" in paths
+            assert "Pod" in doc["models"]
+            assert "containers" in doc["models"]["PodSpec"]["properties"]
+            with urllib.request.urlopen(srv.url + "/ui",
+                                        timeout=5) as resp:
+                page = resp.read().decode()
+            assert "pods" in page and "<html" in page
+        finally:
+            srv.stop()
+
+
+class TestJWTAuthenticator:
+    SECRET = b"tpu-secret"
+
+    def _headers(self, claims):
+        return {"Authorization": f"Bearer {make_jwt(self.SECRET, claims)}"}
+
+    def test_valid_token(self):
+        auth = JWTAuthenticator(self.SECRET, issuer="https://issuer",
+                                audience="kube")
+        user, ok = auth.authenticate(self._headers({
+            "iss": "https://issuer", "aud": "kube", "sub": "alice",
+            "groups": ["dev"], "exp": time.time() + 60}))
+        assert ok and user.name == "alice" and user.groups == ["dev"]
+
+    @pytest.mark.parametrize("claims", [
+        {"iss": "https://evil", "aud": "kube", "sub": "a"},
+        {"iss": "https://issuer", "aud": "other", "sub": "a"},
+        {"iss": "https://issuer", "aud": "kube", "sub": "a",
+         "exp": time.time() - 10},
+        {"iss": "https://issuer", "aud": "kube"},
+    ])
+    def test_rejections(self, claims):
+        auth = JWTAuthenticator(self.SECRET, issuer="https://issuer",
+                                audience="kube")
+        _, ok = auth.authenticate(self._headers(claims))
+        assert not ok
+
+    def test_bad_signature(self):
+        auth = JWTAuthenticator(self.SECRET)
+        token = make_jwt(b"wrong-secret", {"sub": "mallory"})
+        _, ok = auth.authenticate(
+            {"Authorization": f"Bearer {token}"})
+        assert not ok
+
+    def test_custom_username_claim(self):
+        auth = JWTAuthenticator(self.SECRET, username_claim="email")
+        user, ok = auth.authenticate(self._headers(
+            {"sub": "u1", "email": "a@b.c"}))
+        assert ok and user.name == "a@b.c"

@@ -159,12 +159,17 @@ def run_scheduler(argv: List[str]) -> int:
     if config is not None:
         sched = BatchScheduler(config).run()
     else:
-        # --mode serial, or the provable serial fallback: this policy
-        # doesn't map onto the device engine (extenders / custom
-        # predicates)
-        sched = Scheduler(
-            factory.create_from_config(policy) if policy
-            else factory.create_from_provider(args.algorithm_provider)).run()
+        # the fast-path ladder: batch > mixed (device probe + HTTP
+        # extenders) > serial — each rung a provable fallback
+        mixed = (factory.create_mixed(policy)
+                 if args.mode == "batch" else None)
+        if mixed is not None:
+            sched = Scheduler(mixed).run()
+        else:
+            sched = Scheduler(
+                factory.create_from_config(policy) if policy
+                else factory.create_from_provider(
+                    args.algorithm_provider)).run()
     return _serve_until_signal(
         f"scheduler ready mode={args.mode}", [sched.stop, factory.stop])
 

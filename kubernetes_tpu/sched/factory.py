@@ -264,9 +264,11 @@ class ConfigFactory:
         extenders = [HTTPExtender(cfg) for cfg in policy.extenders]
         return self._create(predicates, priorities, extenders)
 
-    def _create(self, predicates, priorities, extenders) -> SchedulerConfig:
-        algorithm = GenericScheduler(predicates, priorities,
-                                     self.pod_lister, extenders)
+    def _create(self, predicates, priorities, extenders,
+                algorithm=None, on_assume=None) -> SchedulerConfig:
+        if algorithm is None:
+            algorithm = GenericScheduler(predicates, priorities,
+                                         self.pod_lister, extenders)
         return SchedulerConfig(
             algorithm=algorithm,
             next_pod=self._next_pod,
@@ -275,7 +277,8 @@ class ConfigFactory:
             modeler=self.modeler,
             error=self.make_default_error_func(),
             recorder=self.recorder,
-            bind_pods_rate_limiter=self.rate_limiter)
+            bind_pods_rate_limiter=self.rate_limiter,
+            on_assume=on_assume)
 
     def _next_pod(self) -> Optional[api.Pod]:
         """(ref: factory.go:230 NextPod — blocking FIFO pop)"""
@@ -311,6 +314,33 @@ class ConfigFactory:
                     "a policy that needs engine configuration")
             kw["engine"] = BatchEngine(weights, policy=device_policy)
         return BatchSchedulerConfig(self, **kw)
+
+    def create_mixed(self, policy: Optional[Policy]):
+        """Mixed-mode config (device probe + HTTP extenders), or None if
+        the policy doesn't qualify: it must carry extenders (otherwise
+        create_batch is strictly better) and its predicate/priority set
+        must map onto the engine without DevicePolicy tiers (the
+        incremental encoder's domain). The middle rung of the ladder
+        batch > mixed > serial."""
+        if policy is None or not policy.extenders:
+            return None
+        stripped = Policy(predicates=policy.predicates,
+                          priorities=policy.priorities, extenders=[])
+        translated = _translate_policy(stripped)
+        if translated is None:
+            return None
+        weights, device_policy = translated
+        if device_policy is not None:
+            return None
+        from .device import BatchEngine
+        from .device_assist import DeviceAssistedAlgorithm
+        serial = self.create_from_config(policy)
+        algorithm = DeviceAssistedAlgorithm(
+            self, BatchEngine(weights),
+            extenders=serial.algorithm.extenders,
+            serial_fallback=serial.algorithm)
+        return self._create({}, [], [], algorithm=algorithm,
+                            on_assume=algorithm.assume)
 
     def make_default_error_func(self) -> Callable:
         """(ref: factory.go:297 makeDefaultErrorFunc — backoff + requeue)"""

@@ -21,7 +21,8 @@ class SchedulerConfig:
                  binder, node_lister, modeler,
                  error: Callable[[api.Pod, Exception], None],
                  recorder=None, bind_pods_rate_limiter=None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 on_assume: Optional[Callable[[api.Pod], None]] = None):
         self.algorithm = algorithm
         self.next_pod = next_pod
         self.binder = binder
@@ -31,6 +32,9 @@ class SchedulerConfig:
         self.recorder = recorder
         self.bind_pods_rate_limiter = bind_pods_rate_limiter
         self.metrics = metrics or global_metrics
+        # extra assume observer (the mixed-mode device state joins the
+        # modeler at the AssumePod moment)
+        self.on_assume = on_assume
 
 
 class Scheduler:
@@ -111,6 +115,8 @@ class Scheduler:
             from dataclasses import replace
             assumed = replace(pod, spec=replace(pod.spec, node_name=dest))
             c.modeler.assume_pod(assumed)
+            if c.on_assume is not None:
+                c.on_assume(assumed)
 
         c.modeler.locked_action(bind_and_assume)
         c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",

@@ -219,3 +219,78 @@ def test_device_backend_parity_with_oracle_over_http():
             assert got_scores[entry.host] == entry.score
     finally:
         es.stop()
+
+
+def test_mixed_mode_scheduler_with_extenders():
+    """The fast-path ladder's middle rung: device-probed predicates +
+    HTTP extenders, same expected placement as the serial port of
+    TestSchedulerExtender (machine3)."""
+    es1 = ExtenderServer(CallableBackend(
+        predicates=[machine_1_2_3_predicate],
+        prioritizers=[(machine_2_prioritizer, 1)])).start()
+    es2 = ExtenderServer(CallableBackend(
+        predicates=[machine_2_3_5_predicate],
+        prioritizers=[(machine_3_prioritizer, 1)])).start()
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    policy = Policy(extenders=[
+        ExtenderConfig(url_prefix=es1.url, filter_verb="filter",
+                       prioritize_verb="prioritize", weight=3),
+        ExtenderConfig(url_prefix=es2.url, filter_verb="filter",
+                       prioritize_verb="prioritize", weight=4)])
+    config = factory.create_mixed(policy)
+    assert config is not None, "policy should qualify for mixed mode"
+    from kubernetes_tpu.sched.device_assist import DeviceAssistedAlgorithm
+    assert isinstance(config.algorithm, DeviceAssistedAlgorithm)
+    sched = Scheduler(config).run()
+    try:
+        for i in range(5):
+            client.create("nodes", ready_node(f"machine{i + 1}"))
+        client.create("pods", pending_pod("mixed-pod"))
+        assert wait_until(
+            lambda: client.get("pods", "mixed-pod").spec.node_name,
+            timeout=30)
+        # extender scores dominate the device priorities here:
+        # machine2 = dev + 10*3 + 1*4, machine3 = dev + 1*3 + 10*4;
+        # identical device scores on identical empty nodes -> machine3
+        assert client.get("pods", "mixed-pod").spec.node_name \
+            == "machine3"
+        # the on_assume hook: the bound pod must land in the device
+        # state at the AssumePod moment (not only via the watch echo) —
+        # the encoder's ledger records it on machine3
+        inc = config.algorithm.inc
+        assert wait_until(
+            lambda: inc.pods.get("default/mixed-pod") is not None
+            and inc.pods["default/mixed-pod"].node == "machine3")
+        client.create("pods", pending_pod("mixed-pod-2"))
+        assert wait_until(
+            lambda: client.get("pods", "mixed-pod-2").spec.node_name,
+            timeout=30)
+    finally:
+        sched.stop()
+        factory.stop()
+        es1.stop()
+        es2.stop()
+
+
+def test_mixed_mode_requires_extenders_and_plain_policy():
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    try:
+        # no extenders -> batch path owns it
+        assert factory.create_mixed(Policy()) is None
+        assert factory.create_mixed(None) is None
+        # service-affinity predicates can't ride the engine
+        from kubernetes_tpu.sched.api import (PredicatePolicy,
+                                              ServiceAffinityArgs)
+        pol = Policy(
+            predicates=[PredicatePolicy(
+                name="ServiceAffinity",
+                service_affinity=ServiceAffinityArgs(labels=["zone"]))],
+            extenders=[ExtenderConfig(url_prefix="http://x",
+                                      filter_verb="filter")])
+        assert factory.create_mixed(pol) is None
+    finally:
+        factory.stop()

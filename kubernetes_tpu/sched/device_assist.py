@@ -54,11 +54,16 @@ class DeviceAssistedAlgorithm:
             return self.serial_fallback.schedule(pod, node_lister)
         mask, total = self.engine.probe(enc)
         mask, total = mask[0], total[0]
-        slot = {name: i for i, name in enumerate(enc.node_names) if name}
-        by_name = {n.metadata.name: n for n in node_lister.list()}
-        survivors: List[api.Node] = [
-            by_name[name] for name, i in slot.items()
-            if mask[i] and name in by_name]
+        # one pass over the candidate nodes (the Node objects are needed
+        # for the extender wire format anyway); slots come from the
+        # encoder's live table — stable for a node's life — instead of
+        # rebuilding O(n_cap) dicts per pod
+        slot = self.inc.node_slot
+        survivors: List[api.Node] = []
+        for n in node_lister.list():
+            i = slot.get(n.metadata.name)
+            if i is not None and mask[i]:
+                survivors.append(n)
         if survivors:
             for extender in self.extenders:
                 survivors = extender.filter(pod, survivors)

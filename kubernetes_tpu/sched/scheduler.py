@@ -8,9 +8,12 @@ func (backoff + requeue). Metrics names match metrics/metrics.go:30-80.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 from ..core import types as api
 from ..utils.metrics import MetricsRegistry, global_metrics
@@ -58,7 +61,15 @@ class Scheduler:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if not self.schedule_one():
+            try:
+                busy = self.schedule_one()
+            except Exception:
+                # pod-level failures are routed inside schedule_one;
+                # anything escaping would otherwise kill the daemon
+                # thread and stall scheduling cluster-wide
+                logger.exception("schedule_one failed")
+                busy = True
+            if not busy:
                 # no pod this round (timeout or closed queue): back off a
                 # touch so a closed factory doesn't turn this into a busy-spin
                 self._stop.wait(0.01)
@@ -114,9 +125,16 @@ class Scheduler:
                     f"Successfully assigned {pod.metadata.name} to {dest}")
             from dataclasses import replace
             assumed = replace(pod, spec=replace(pod.spec, node_name=dest))
-            c.modeler.assume_pod(assumed)
-            if c.on_assume is not None:
-                c.on_assume(assumed)
+            # the bind already landed: a failure in the assume tail must
+            # not escape and kill the scheduler thread — the watch echo
+            # re-syncs whatever the caches missed
+            try:
+                c.modeler.assume_pod(assumed)
+                if c.on_assume is not None:
+                    c.on_assume(assumed)
+            except Exception:
+                logger.exception("assume after bind failed for %s",
+                                 pod.metadata.name)
 
         c.modeler.locked_action(bind_and_assume)
         c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",

@@ -1,0 +1,59 @@
+"""Device profiling — the jax.profiler integration.
+
+Reference: net/http/pprof mounted on the apiserver/scheduler/kubelet
+(pkg/master/master.go:689-691, plugin/cmd/kube-scheduler/app/
+server.go:131-135) + hack/grab-profiles.sh. The TPU-native analogue:
+`device_trace` wraps a region in a jax.profiler trace (XPlane dumps
+readable by TensorBoard / xprof), and `profiled_schedule` captures one
+engine run — the equivalent of grabbing a scheduler CPU profile
+mid-benchmark. Pairs with utils/trace.py (the over-threshold span
+logger playing pkg/util/trace.go's role on the host side).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Trace every XLA dispatch/execution in the region into `logdir`.
+
+    Usage:
+        with device_trace("/tmp/sched-trace"):
+            engine.run_chunked(enc, 1024)
+    """
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named sub-span inside a device trace (jax.profiler.TraceAnnotation
+    — shows up as a labeled region in the timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def profiled_schedule(engine, enc, logdir: str,
+                      chunk: Optional[int] = None):
+    """One traced engine run -> (assigned, logdir). The grab-profiles.sh
+    move: point it at a live encoder's output, read the dump in
+    TensorBoard."""
+    with device_trace(logdir):
+        with annotate("batch-schedule"):
+            if chunk:
+                assigned, _ = engine.run_chunked(enc, chunk)
+            else:
+                assigned, _ = engine.run(enc)
+    return assigned, logdir

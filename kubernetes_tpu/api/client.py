@@ -72,6 +72,10 @@ class Client:
         relays to the node's kubelet server)."""
         raise NotImplementedError
 
+    def node_proxy(self, node_name: str, path: str) -> bytes:
+        """GET a kubelet-server path through the node proxy."""
+        raise NotImplementedError
+
 
 class InProcClient(Client):
     def __init__(self, registry: Registry):
@@ -109,7 +113,7 @@ class InProcClient(Client):
                  tail_lines=0):
         # even in-proc, the kubelet is across the network: resolve the
         # node's daemon endpoint and fetch (same relay ApiServer does)
-        from ..kubelet.server import kubelet_base_url
+        from .relay import fetch_kubelet, kubelet_base_for
         pod = self.registry.get("pods", name, namespace)
         if not pod.spec.node_name:
             raise BadRequest(f"pod {name!r} is not scheduled yet")
@@ -119,24 +123,20 @@ class InProcClient(Client):
                 raise BadRequest(
                     f"pod {name!r} has several containers; name one")
             container = pod.spec.containers[0].name
-        node = self.registry.get("nodes", pod.spec.node_name)
-        try:
-            base = kubelet_base_url(node)
-        except KeyError as e:
-            raise NotFound(str(e))
+        base = kubelet_base_for(self.registry, pod.spec.node_name)
         url = (f"{base}/containerLogs/"
                f"{namespace}/{name}/{container}")
         if tail_lines:
             url += f"?tailLines={tail_lines}"
-        try:
-            with urllib.request.urlopen(url, timeout=30) as resp:
-                return resp.read().decode()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                raise NotFound(e.read().decode(errors="replace"))
-            raise BadGateway(f"kubelet answered {e.code}")
-        except (urllib.error.URLError, OSError) as e:
-            raise BadGateway(f"kubelet unreachable: {e}")
+        return fetch_kubelet(url).decode()
+
+    def node_proxy(self, node_name, path):
+        # in-proc: the same relay ApiServer performs, incl. the exec
+        # CONNECT admission moment
+        from .relay import exec_admission, fetch_kubelet, kubelet_base_for
+        exec_admission(self.registry, path)
+        base = kubelet_base_for(self.registry, node_name)
+        return fetch_kubelet(f"{base}/{path}")
 
     def finalize_namespace(self, obj):
         return self.registry.finalize_namespace(obj)

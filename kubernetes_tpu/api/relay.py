@@ -1,0 +1,64 @@
+"""Shared kubelet-relay plumbing: the ApiServer's node proxy and the
+in-proc client implement the same relay (resolve the node's daemon
+endpoint, fetch, map errors; exec paths pass the CONNECT admission
+moment first). One implementation, two mounts."""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..core.errors import BadGateway, NotFound
+
+
+def exec_admission(registry, rest_path: str) -> None:
+    """Run the CONNECT admission for a kubelet exec relay path
+    (`exec/{ns}/{pod}/{container}...`) — DenyExecOnPrivileged's moment
+    (ref: plugin/pkg/admission/exec). Non-exec paths are a no-op."""
+    segments = [s for s in rest_path.split("?")[0].split("/") if s]
+    if segments and segments[0] == "exec" and len(segments) >= 3 \
+            and registry.admission is not None:
+        registry.admission("CONNECT", "pods/exec", None,
+                           segments[1], segments[2])
+
+
+def fetch_kubelet(url: str, timeout: float = 30.0) -> bytes:
+    """GET a kubelet-server URL with the client-side error mapping: 404
+    passes through as NotFound, anything else wrong becomes 502."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            raise NotFound(e.read().decode(errors="replace"))
+        raise BadGateway(f"kubelet answered {e.code}")
+    except (urllib.error.URLError, OSError) as e:
+        raise BadGateway(f"kubelet unreachable: {e}")
+
+
+def fetch_kubelet_response(url: str, timeout: float = 30.0):
+    """GET for a verbatim HTTP relay -> (status, content_type, body):
+    kubelet statuses pass through untouched; only transport failures
+    become 502 (what the ApiServer proxy forwards)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return (resp.status, resp.headers.get("Content-Type",
+                                                  "text/plain"),
+                    resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, "text/plain", e.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise BadGateway(f"kubelet unreachable: {e}")
+
+
+def kubelet_base_for(registry, node_name: str) -> str:
+    """Resolve a node's kubelet base URL from the registry, mapping a
+    missing endpoint to NotFound."""
+    from ..kubelet.server import kubelet_base_url
+
+    node = registry.get("nodes", node_name)
+    try:
+        return kubelet_base_url(node)
+    except KeyError as e:
+        raise NotFound(str(e))

@@ -22,6 +22,7 @@ matching the reference's watch/json wire format (pkg/watch/json).
 
 from __future__ import annotations
 
+import base64
 import json
 import re
 import threading
@@ -342,25 +343,13 @@ class ApiServer:
     # ----------------------------------------------------- kubelet relay
 
     def _kubelet_base(self, node_name: str) -> str:
-        from ..kubelet.server import kubelet_base_url
-        node = self.registry.get("nodes", node_name)
-        try:
-            return kubelet_base_url(node)
-        except KeyError as e:
-            raise NotFound(str(e))
+        from .relay import kubelet_base_for
+        return kubelet_base_for(self.registry, node_name)
 
     def _relay(self, h, url: str) -> None:
-        import urllib.error
-        import urllib.request
-        try:
-            with urllib.request.urlopen(url, timeout=30) as resp:
-                self._send_raw(h, resp.status, resp.read(),
-                               resp.headers.get("Content-Type",
-                                                "text/plain"))
-        except urllib.error.HTTPError as e:
-            self._send_raw(h, e.code, e.read(), "text/plain")
-        except (urllib.error.URLError, OSError) as e:
-            raise BadGateway(f"kubelet unreachable: {e}")
+        from .relay import fetch_kubelet_response
+        status, ctype, body = fetch_kubelet_response(url)
+        self._send_raw(h, status, body, ctype)
 
     def _serve_pod_log(self, h, namespace: str, name: str,
                        query: dict) -> None:
@@ -381,24 +370,30 @@ class ApiServer:
 
     def _proxy_node(self, h, node_name: str, rest: str,
                     raw_query: str) -> None:
-        segments = [s for s in rest.split("/") if s]
-        if segments and segments[0] == "exec" and len(segments) >= 3 \
-                and self.registry.admission is not None:
-            # exec admission (DenyExecOnPrivileged): the relay is the
-            # CONNECT moment (ref: plugin/pkg/admission/exec)
-            self.registry.admission("CONNECT", "pods/exec", None,
-                                    segments[1], segments[2])
+        from .relay import exec_admission
+        # exec admission (DenyExecOnPrivileged): the relay is the
+        # CONNECT moment (ref: plugin/pkg/admission/exec)
+        exec_admission(self.registry, rest)
         base = self._kubelet_base(node_name)
         self._relay(h, f"{base}/{rest}"
                     + (f"?{raw_query}" if raw_query else ""))
 
     # -------------------------------------------------------------- watch
 
+    @staticmethod
+    def _wants_websocket(h) -> bool:
+        """(ref: pkg/apiserver/watch.go:44 isWebsocketRequest)"""
+        connection = (h.headers.get("Connection") or "").lower()
+        upgrade = (h.headers.get("Upgrade") or "").lower()
+        return "upgrade" in connection and upgrade == "websocket"
+
     def _serve_watch(self, h, resource: str, namespace: str, query: dict) -> None:
         rv = query.get("resourceVersion")
         since_rev = int(rv) if rv not in (None, "") else None
         watcher = self.registry.watch(resource, namespace, since_rev)
         self.metrics.inc("apiserver_watch_count", {"resource": resource})
+        if self._wants_websocket(h):
+            return self._serve_watch_websocket(h, watcher)
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -427,6 +422,90 @@ class ApiServer:
             pass
         finally:
             watcher.stop()
+
+    def _serve_watch_websocket(self, h, watcher) -> None:
+        """Watch over a websocket (ref: watch.go:89 HandleWS; wire events
+        are the same JSON objects, one per text frame). RFC 6455 server
+        side in stdlib: Sec-WebSocket-Accept handshake + unmasked
+        server-to-client text frames; client frames are drained and
+        discarded like the reference's Receive loop (watch.go:96)."""
+        import hashlib as _hashlib
+
+        key = h.headers.get("Sec-WebSocket-Key", "")
+        try:
+            if not key:
+                return self._send_error(
+                    h, BadRequest("missing Sec-WebSocket-Key"))
+            accept = base64.b64encode(_hashlib.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()).decode()
+            h.send_response(101, "Switching Protocols")
+            h.send_header("Upgrade", "websocket")
+            h.send_header("Connection", "Upgrade")
+            h.send_header("Sec-WebSocket-Accept", accept)
+            h.end_headers()
+
+            def drain_client_frames():
+                """Read and discard client frames (watch.go:96's Receive
+                loop); a Close frame stops the watcher, which makes the
+                write loop answer with its own Close."""
+                try:
+                    while True:
+                        head = h.rfile.read(2)
+                        if len(head) < 2:
+                            break
+                        opcode = head[0] & 0x0F
+                        ln = head[1] & 0x7F
+                        masked = head[1] & 0x80
+                        if ln == 126:
+                            ln = int.from_bytes(h.rfile.read(2), "big")
+                        elif ln == 127:
+                            ln = int.from_bytes(h.rfile.read(8), "big")
+                        if masked:
+                            h.rfile.read(4)
+                        if ln:
+                            h.rfile.read(ln)
+                        if opcode == 0x8:
+                            break
+                except (OSError, ValueError):
+                    pass
+                finally:
+                    watcher.stop()
+
+            threading.Thread(target=drain_client_frames,
+                             daemon=True).start()
+
+            def frame(payload: bytes, opcode: int = 0x1) -> bytes:
+                head = bytes([0x80 | opcode])
+                n = len(payload)
+                if n < 126:
+                    head += bytes([n])
+                elif n < 1 << 16:
+                    head += bytes([126]) + n.to_bytes(2, "big")
+                else:
+                    head += bytes([127]) + n.to_bytes(8, "big")
+                return head + payload
+
+            while True:
+                ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
+                if ev is None:
+                    if watcher.stopped:
+                        break
+                    h.wfile.write(frame(b"", opcode=0x9))  # ping
+                    h.wfile.flush()
+                    continue
+                line = json.dumps({
+                    "type": ev.type,
+                    "object": self.scheme.encode_dict(ev.object),
+                }).encode()
+                h.wfile.write(frame(line))
+                h.wfile.flush()
+            h.wfile.write(frame(b"", opcode=0x8))  # close
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watcher.stop()
+            h.close_connection = True
 
     # ------------------------------------------------------------- helpers
 

@@ -110,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("pod")
     lg.add_argument("container", nargs="?", default="")
 
+    ex = sub.add_parser("exec", help="execute a command in a container")
+    ex.add_argument("pod")
+    ex.add_argument("-c", "--container", default="")
+    ex.add_argument("cmd", nargs="+",
+                    help="command and args (use -- before flags)")
+
     sub.add_parser("version", help="print version")
     sub.add_parser("api-versions", help="print supported API versions")
     sub.add_parser("cluster-info", help="display cluster info")
@@ -497,6 +503,28 @@ class Kubectl:
             self.out.write(f"[{cs.name}] state={state} "
                            f"restarts={cs.restart_count}\n")
 
+    def exec_cmd(self, ns, pod_name, container, cmd) -> int:
+        """Run a command in a container via the apiserver's node-proxy
+        exec relay (ref: kubectl exec -> kubelet /exec; output answered
+        in-band, our documented non-SPDY divergence)."""
+        import json as jsonlib
+        import urllib.parse as up
+        pod = self.client.get("pods", pod_name, ns)
+        if not pod.spec.node_name:
+            raise ApiError(f"pod {pod_name!r} is not scheduled yet")
+        if not container:
+            if len(pod.spec.containers) > 1:
+                raise ApiError(
+                    f"pod {pod_name!r} has several containers; use -c")
+            container = pod.spec.containers[0].name
+        query = up.urlencode([("command", c) for c in cmd])
+        raw = self.client.node_proxy(
+            pod.spec.node_name,
+            f"exec/{ns}/{pod_name}/{container}?{query}")
+        result = jsonlib.loads(raw)
+        self.out.write(result.get("output", ""))
+        return int(result.get("exitCode", 0))
+
     def version(self) -> None:
         self.out.write(f"Client Version: {VERSION}\n")
 
@@ -578,6 +606,9 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
                         ns_args.cpu_percent)
         elif ns_args.command == "logs":
             k.logs(ns, ns_args.pod, ns_args.container)
+        elif ns_args.command == "exec":
+            return k.exec_cmd(ns, ns_args.pod, ns_args.container,
+                              ns_args.cmd)
         elif ns_args.command == "version":
             k.version()
         elif ns_args.command == "api-versions":

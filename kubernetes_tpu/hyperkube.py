@@ -239,6 +239,37 @@ def run_hollow_fleet(argv: List[str]) -> int:
         f"hollow-fleet ready nodes={args.num_nodes}", [fleet.stop])
 
 
+def run_proxy(argv: List[str]) -> int:
+    """(ref: cmd/kube-proxy + the hollow --morph=proxy,
+    cmd/kubemark/hollow-node.go:80: fake iptables backing the real
+    proxier code)"""
+    p = argparse.ArgumentParser(prog="proxy")
+    p.add_argument("--master", required=True)
+    p.add_argument("--proxy-mode", choices=["iptables", "userspace"],
+                   default="iptables")
+    p.add_argument("--hollow", action="store_true",
+                   help="fake iptables (the kubemark hollow-proxy morph; "
+                        "without it, iptables mode execs the real binary "
+                        "and needs netfilter privileges)")
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .proxy.iptables import ExecIPTables, FakeIPTables
+
+    _wait_for_master(args.master)
+    client = HttpClient(args.master)
+    if args.proxy_mode == "userspace":
+        from .proxy.userspace import UserspaceProxier
+        proxier = UserspaceProxier(client).run()
+    else:
+        from .proxy.proxier import IPTablesProxier
+        ipt = FakeIPTables() if args.hollow else ExecIPTables()
+        proxier = IPTablesProxier(ipt, client).run()
+    return _serve_until_signal(
+        f"proxy ready mode={args.proxy_mode}"
+        + (" hollow" if args.hollow else ""), [proxier.stop])
+
+
 def run_kubectl(argv: List[str]) -> int:
     from .cli.cmd import main as kubectl_main
     return kubectl_main(argv)
@@ -253,6 +284,8 @@ COMPONENTS = {
     "kube-controller-manager": run_controller_manager,
     "hollow-node": run_hollow_node,
     "hollow-fleet": run_hollow_fleet,
+    "proxy": run_proxy,
+    "kube-proxy": run_proxy,
     "kubectl": run_kubectl,
 }
 

@@ -77,3 +77,62 @@ class FakeIPTables(IPTablesInterface):
     def list_rules(self, table: str, chain: str) -> List[Tuple[str, ...]]:
         with self._lock:
             return list(self._table(table).get(chain, []))
+
+
+class ExecIPTables(IPTablesInterface):
+    """The exec-ing adapter (ref: pkg/util/iptables runner — shells out
+    to the iptables binary). `runner` is injectable for tests; the
+    default requires the binary and netfilter privileges, which hollow
+    deployments don't have — they use FakeIPTables instead."""
+
+    def __init__(self, runner=None, binary: str = "iptables"):
+        import subprocess
+
+        self.binary = binary
+        self._run = runner or (lambda args: subprocess.run(
+            args, capture_output=True, text=True, timeout=30))
+
+    def _exec(self, *args: str):
+        result = self._run([self.binary, *args])
+        return result
+
+    def _check(self, *args: str) -> None:
+        result = self._exec(*args)
+        if result.returncode != 0:
+            raise RuntimeError(
+                f"{self.binary} {' '.join(args)}: "
+                f"{(result.stderr or '').strip()}")
+
+    def ensure_chain(self, table: str, chain: str) -> bool:
+        if self._exec("-t", table, "-L", chain, "-n").returncode == 0:
+            return True
+        self._check("-t", table, "-N", chain)
+        return False
+
+    def flush_chain(self, table: str, chain: str) -> None:
+        self._check("-t", table, "-F", chain)
+
+    def delete_chain(self, table: str, chain: str) -> None:
+        self._check("-t", table, "-X", chain)
+
+    def ensure_rule(self, table: str, chain: str, *args: str) -> bool:
+        if self._exec("-t", table, "-C", chain, *args).returncode == 0:
+            return True
+        self._check("-t", table, "-A", chain, *args)
+        return False
+
+    def list_chains(self, table: str) -> List[str]:
+        result = self._exec("-t", table, "-S")
+        if result.returncode != 0:
+            return []
+        # "-P BUILTIN policy" and "-N USER-CHAIN" lines declare chains
+        return [line.split()[1] for line in result.stdout.splitlines()
+                if line.startswith(("-N ", "-P "))]
+
+    def list_rules(self, table: str, chain: str) -> List[Tuple[str, ...]]:
+        result = self._exec("-t", table, "-S", chain)
+        if result.returncode != 0:
+            return []
+        return [tuple(line.split()[2:])
+                for line in result.stdout.splitlines()
+                if line.startswith("-A ")]

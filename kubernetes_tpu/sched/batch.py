@@ -51,9 +51,12 @@ class BatchSchedulerConfig:
         self.min_pad = min_pad
         self.bulk_chunk = bulk_chunk
         # incremental device state (watch deltas -> persistent arrays,
-        # SURVEY.md section 7 hard part 4); DevicePolicy engines keep the
-        # full per-tile encode, which knows how to encode policy tiers
-        self.incremental = incremental and self.engine.policy is None
+        # SURVEY.md section 7 hard part 4). Node-static policy tiers
+        # (label presence/priorities) ride along; the anti-affinity tier
+        # needs per-tile service groups and keeps the full encode
+        self.incremental = incremental and (
+            self.engine.policy is None
+            or not self.engine.policy.needs_anti_affinity)
         self.metrics = metrics or global_metrics
 
 
@@ -84,7 +87,9 @@ class BatchScheduler:
         if not self.config.incremental:
             return None
         if self._inc is None:
-            self._inc = IncrementalEncoder().attach(self.config.factory)
+            self._inc = IncrementalEncoder(
+                policy=self.config.engine.policy).attach(
+                    self.config.factory)
         return self._inc
 
     def run(self) -> "BatchScheduler":

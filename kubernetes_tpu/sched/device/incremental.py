@@ -120,7 +120,16 @@ class _PodRecord:
 class IncrementalEncoder:
     """Persistent cluster arrays fed by pod/node watch deltas."""
 
-    def __init__(self, node_capacity: int = 64):
+    def __init__(self, node_capacity: int = 64, policy=None):
+        """policy: a DevicePolicy whose NODE-STATIC tiers (label
+        presence/priorities) are maintained incrementally; the
+        anti-affinity tier needs per-tile service groups and stays with
+        the full encoder (callers must not pass one that needs it)."""
+        if policy is not None and policy.needs_anti_affinity:
+            raise ValueError(
+                "IncrementalEncoder: anti-affinity policies need the "
+                "full per-tile encoder")
+        self._policy = policy
         self._lock = threading.RLock()
         # interners shared across the encoder's life
         self.labels_dict = _GrowingInterner()
@@ -139,6 +148,10 @@ class IncrementalEncoder:
         self.label_words = np.zeros((self.n_cap, 1), np.uint32)
         self.tie_rank = np.full(self.n_cap, -1, np.int32)
         self._tie_dirty = False
+        # node-static policy tiers (CheckNodeLabelPresence /
+        # CalculateNodeLabelPriority), recomputed per node at upsert
+        self.static_mask = np.ones(self.n_cap, bool)
+        self.static_score = np.zeros(self.n_cap, np.int64)
 
         # ---- per-node aggregates (the State init the engine consumes) --
         self.cpu_used = np.zeros(self.n_cap, np.int64)
@@ -402,6 +415,25 @@ class IncrementalEncoder:
             _set_bit(self.label_words[slot], bit)
         from ..factory import node_condition_predicate
         self.valid[slot] = node_condition_predicate(node)
+        if self._policy is not None:
+            # same math as tables.py's policy tier (predicates.go:292 /
+            # priorities.go:148), one node at a time
+            labels = node.metadata.labels
+            mask = True
+            for wanted, presence in self._policy.label_presence:
+                for label in wanted:
+                    exists = label in labels
+                    if (exists and not presence) or \
+                            (not exists and presence):
+                        mask = False
+            score = 0
+            for label, presence, weight in self._policy.label_priorities:
+                exists = label in labels
+                success = (exists and presence) or \
+                    (not exists and not presence)
+                score += (10 if success else 0) * weight
+            self.static_mask[slot] = mask
+            self.static_score[slot] = score
         if new_node:
             parked = self.unknown_node_pods.pop(name, None)
             if parked:
@@ -441,9 +473,13 @@ class IncrementalEncoder:
         new_cap = self.n_cap * 2 if self.n_cap < 1024 else self.n_cap + 1024
         for attr in ("valid", "cpu_cap", "mem_cap", "pod_cap", "tie_rank",
                      "cpu_used", "mem_used", "nz_cpu", "nz_mem", "pod_count",
-                     "exceed_cpu", "exceed_mem"):
+                     "exceed_cpu", "exceed_mem", "static_score"):
             setattr(self, attr, _grow(getattr(self, attr), 0, new_cap))
         self.tie_rank[self.n_cap:] = -1
+        # _grow zero-fills; the static mask's neutral value is True
+        grown_mask = np.ones(new_cap, bool)
+        grown_mask[:self.n_cap] = self.static_mask
+        self.static_mask = grown_mask
         for attr in ("label_words", "port_bits", "disk_any", "disk_rw"):
             setattr(self, attr, _grow(getattr(self, attr), 0, new_cap))
         for g in self.groups.values():
@@ -620,8 +656,8 @@ class IncrementalEncoder:
                 aff_dom=np.full((1, n_pad), -1, np.int32),
                 zone_id=np.full(n_pad, -1, np.int32),
                 zone_scratch=np.zeros(1, np.int32),
-                static_mask=np.ones(n_pad, bool),
-                static_score=np.zeros(n_pad, np.int64))
+                static_mask=self.static_mask.copy(),
+                static_score=self.static_score.copy())
             spread = (np.stack([g.row for g in tile_groups])
                       if tile_groups else np.zeros((1, n_pad), np.int32))
             offgrid_max = np.zeros(G, np.int32)

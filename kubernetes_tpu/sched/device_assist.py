@@ -59,10 +59,14 @@ class DeviceAssistedAlgorithm:
         # encoder's live table — stable for a node's life — instead of
         # rebuilding O(n_cap) dicts per pod
         slot = self.inc.node_slot
+        n_lanes = len(mask)
         survivors: List[api.Node] = []
         for n in node_lister.list():
             i = slot.get(n.metadata.name)
-            if i is not None and mask[i]:
+            # bounds guard: a node added after encode_tile may hold a
+            # slot past this probe's arrays (table growth); it wasn't in
+            # the snapshot, so it simply isn't a candidate this pod
+            if i is not None and i < n_lanes and mask[i]:
                 survivors.append(n)
         if survivors:
             for extender in self.extenders:
@@ -78,8 +82,8 @@ class DeviceAssistedAlgorithm:
         combined = {}
         for n in survivors:
             i = slot.get(n.metadata.name)
-            combined[n.metadata.name] = int(total[i]) if i is not None \
-                else 0
+            combined[n.metadata.name] = (
+                int(total[i]) if i is not None and i < n_lanes else 0)
         for extender in self.extenders:
             try:
                 scores, weight = extender.prioritize(pod, survivors)

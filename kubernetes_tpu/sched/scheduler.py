@@ -66,9 +66,11 @@ class Scheduler:
             except Exception:
                 # pod-level failures are routed inside schedule_one;
                 # anything escaping would otherwise kill the daemon
-                # thread and stall scheduling cluster-wide
+                # thread and stall scheduling cluster-wide. Treat the
+                # round as idle so a persistent failure backs off
+                # instead of busy-spinning the log.
                 logger.exception("schedule_one failed")
-                busy = True
+                busy = False
             if not busy:
                 # no pod this round (timeout or closed queue): back off a
                 # touch so a closed factory doesn't turn this into a busy-spin
@@ -93,9 +95,14 @@ class Scheduler:
             # attempts too
             c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
                               (time.monotonic() - start) * 1e6)
-            if c.recorder is not None:
-                c.recorder.eventf(pod, "Warning", "FailedScheduling", str(e))
-            c.error(pod, e)
+            try:
+                if c.recorder is not None:
+                    c.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                      str(e))
+            finally:
+                # the requeue must not be lost to a recorder failure —
+                # the pod is already consumed from the FIFO
+                c.error(pod, e)
             return True
         c.metrics.observe("scheduling_algorithm_latency_microseconds",
                           (time.monotonic() - start) * 1e6)

@@ -342,3 +342,69 @@ def test_fifo_len_no_double_count():
     assert len(f) == 1
     assert f.pop(timeout=1).metadata.name == "a"
     assert len(f) == 0
+
+
+def test_websocket_watch():
+    """Watch over a websocket upgrade (ref: pkg/apiserver/watch.go:89
+    HandleWS) — raw RFC 6455 client against the live server."""
+    import base64
+    import hashlib
+    import json as jsonlib
+    import socket
+    import struct
+
+    from kubernetes_tpu.core import types as api
+
+    registry = Registry()
+    srv = ApiServer(registry, port=0).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        sock.sendall((
+            "GET /api/v1/pods?watch=true HTTP/1.1\r\n"
+            f"Host: 127.0.0.1:{srv.port}\r\n"
+            "Connection: Upgrade\r\nUpgrade: websocket\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        # handshake response
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += sock.recv(4096)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        assert b"101" in head.split(b"\r\n")[0]
+        want = base64.b64encode(hashlib.sha1(
+            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+        ).digest())
+        assert want in head
+
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        registry.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="ws-pod", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c",
+                                                       image="i")])))
+
+        def read_frame(pre):
+            data = pre
+            while len(data) < 2:
+                data += sock.recv(4096)
+            fin_op, ln = data[0], data[1] & 0x7F
+            offset = 2
+            if ln == 126:
+                while len(data) < 4:
+                    data += sock.recv(4096)
+                ln = struct.unpack(">H", data[2:4])[0]
+                offset = 4
+            while len(data) < offset + ln:
+                data += sock.recv(4096)
+            return (fin_op & 0x0F, data[offset:offset + ln],
+                    data[offset + ln:])
+
+        op, payload, rest = read_frame(rest)
+        assert op == 0x1
+        ev = jsonlib.loads(payload)
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["metadata"]["name"] == "ws-pod"
+        sock.close()
+    finally:
+        srv.stop()

@@ -119,3 +119,38 @@ def test_batch_scheduler_over_http_end_to_end():
         if factory:
             factory.stop()
         srv.stop()
+
+
+def test_batch_label_policy_rides_incremental_path():
+    """A label-presence policy stays on the batch fast path WITH the
+    incremental encoder (node-static tiers maintained by watch deltas)."""
+    import json
+
+    from kubernetes_tpu.sched.api import policy_from_json
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    policy = policy_from_json(json.dumps({
+        "kind": "Policy", "apiVersion": "v1",
+        "predicates": [
+            {"name": "PodFitsResources"}, {"name": "PodFitsHostPorts"},
+            {"name": "NoDiskConflict"}, {"name": "MatchNodeSelector"},
+            {"name": "HostName"}, {"name": "InterPodAffinity"},
+            {"name": "NoRetiring", "argument": {"labelsPresence": {
+                "labels": ["retiring"], "presence": False}}}],
+    }))
+    config = factory.create_batch(policy)
+    assert config is not None and config.incremental
+    sched = BatchScheduler(config).run()
+    try:
+        client.create("nodes", ready_node("forbidden",
+                                          labels={"retiring": "yes"}))
+        client.create("nodes", ready_node("allowed"))
+        for i in range(6):
+            client.create("pods", pending_pod(f"lp-{i}"))
+        assert wait_until(lambda: all(
+            client.get("pods", f"lp-{i}").spec.node_name == "allowed"
+            for i in range(6)))
+    finally:
+        sched.stop()
+        factory.stop()

@@ -226,3 +226,48 @@ def test_node_table_growth_keeps_state():
     assert inc.n_cap >= 5
     for i in range(5):
         assert inc.cpu_used[inc.node_slot[f"n-{i:02d}"]] == 250
+
+
+class TestIncrementalPolicyTiers:
+    """Node-static DevicePolicy tiers maintained by the incremental
+    encoder (label presence predicates + label priorities) — policy
+    files keep the fast path (ref: predicates.go:292, priorities.go:148)."""
+
+    def test_label_presence_and_priority_live_updates(self):
+        from kubernetes_tpu.sched.device import BatchEngine, DevicePolicy
+        from kubernetes_tpu.sched.device.incremental import \
+            IncrementalEncoder
+
+        pol = DevicePolicy(label_presence=[(("retiring",), False)],
+                           label_priorities=[("ssd", True, 2)])
+        inc = IncrementalEncoder(policy=pol)
+        inc.on_node_add(mk_node("plain"))
+        inc.on_node_add(mk_node("fast", labels={"ssd": "true"}))
+        inc.on_node_add(mk_node("old", labels={"retiring": "soon"}))
+        enc = inc.encode_tile([mk_pod("p1", phase="Pending")], [], [])
+        names = {n: i for i, n in enumerate(enc.node_names) if n}
+        assert bool(enc.node_tab.static_mask[names["plain"]])
+        assert not bool(enc.node_tab.static_mask[names["old"]])
+        assert int(enc.node_tab.static_score[names["fast"]]) == 20
+        assert int(enc.node_tab.static_score[names["plain"]]) == 0
+
+        # engine end-to-end: the ssd node must win, retiring never picked
+        engine = BatchEngine(policy=pol)
+        assigned, _ = engine.run(enc)
+        assert enc.node_names[int(assigned[0])] == "fast"
+
+        # live update: the label is removed -> score drops at next tile
+        inc.on_node_update(mk_node("fast", labels={"ssd": "true"}),
+                           mk_node("fast"))
+        enc2 = inc.encode_tile([mk_pod("p2", phase="Pending")], [], [])
+        assert int(enc2.node_tab.static_score[names["fast"]]) == 0
+
+    def test_anti_affinity_policy_rejected(self):
+        import pytest
+
+        from kubernetes_tpu.sched.device import DevicePolicy
+        from kubernetes_tpu.sched.device.incremental import \
+            IncrementalEncoder
+        with pytest.raises(ValueError):
+            IncrementalEncoder(policy=DevicePolicy(
+                anti_affinity_label="zone"))

@@ -191,3 +191,37 @@ class TestApiServerRelay:
         from kubernetes_tpu.core.errors import ApiError
         with pytest.raises(ApiError):
             client.pod_logs("floating", "default")
+
+
+def test_kubectl_exec_through_relay():
+    """kubectl exec -> apiserver node proxy -> kubelet /exec (output
+    in-band, the documented non-SPDY divergence)."""
+    import io
+
+    from kubernetes_tpu.cli.cmd import Kubectl
+    registry = Registry()
+    apiserver = ApiServer(registry).start()
+    srv_client = HttpClient(apiserver.url)
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    kubelet = HollowKubelet(InProcClient(registry), "exec-node",
+                            heartbeat_interval=60.0, serve_http=True).run()
+    try:
+        pod = mkpod("shellpod", "")
+        created = srv_client.create("pods", pod, "default")
+        registry.bind(api.Binding(
+            metadata=api.ObjectMeta(name="shellpod", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="exec-node")),
+            "default")
+        deadline = time.time() + 10
+        while time.time() < deadline and srv_client.get(
+                "pods", "shellpod", "default").status.phase != "Running":
+            time.sleep(0.05)
+        out = io.StringIO()
+        rc = Kubectl(srv_client, out=out).exec_cmd(
+            "default", "shellpod", "", ["echo", "salut"])
+        assert rc == 0
+        assert "hollow exec: echo salut" in out.getvalue()
+    finally:
+        kubelet.stop()
+        apiserver.stop()

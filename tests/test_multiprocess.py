@@ -161,3 +161,18 @@ def test_hyperkube_usage():
         capture_output=True, text=True, cwd=REPO,
         env={**os.environ, "PYTHONPATH": REPO}, timeout=60)
     assert out.returncode == 1
+
+
+def test_proxy_component_serves():
+    """The kube-proxy process entry (hollow-proxy morph)."""
+    apiserver = spawn("apiserver", "--port", "0")
+    try:
+        url = wait_ready(apiserver).split()[-1]
+        proxy = spawn("proxy", "--master", url, "--hollow")
+        try:
+            line = wait_ready(proxy)
+            assert "iptables" in line and "hollow" in line
+        finally:
+            assert terminate(proxy) == 0
+    finally:
+        assert terminate(apiserver) == 0

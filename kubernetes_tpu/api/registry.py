@@ -144,13 +144,81 @@ _register(ResourceInfo("persistentvolumeclaims", "PersistentVolumeClaim",
 # horizontalpodautoscaler,ingress}; mounted master.go:1049-1091 — served
 # under /apis/extensions/v1beta1 by the API server)
 EXTENSIONS_RESOURCES = ("jobs", "deployments", "daemonsets",
-                        "horizontalpodautoscalers", "ingresses")
+                        "horizontalpodautoscalers", "ingresses",
+                        "thirdpartyresources")
+
+
+def validate_third_party_resource(tpr: api.ThirdPartyResource) -> None:
+    """(ref: validation.ValidateThirdPartyResource + util.go
+    ExtractApiGroupAndKind: name must be <kind>.<domain>.<tld>)"""
+    validate_object_meta(tpr.metadata, True)
+    if len(tpr.metadata.name.split(".")) < 3:
+        raise Invalid(
+            f"metadata.name: {tpr.metadata.name!r} must be "
+            f"<kind>.<domain>.<tld>")
+    if not tpr.versions:
+        raise Invalid("versions: at least one version is required")
+    seen = set()
+    for v in tpr.versions:
+        if not v.name:
+            raise Invalid("versions[].name: required value")
+        if v.name in seen:
+            raise Invalid(f"versions[].name: duplicate {v.name!r}")
+        seen.add(v.name)
+
+
+def extract_group_and_kind(tpr: api.ThirdPartyResource):
+    """-> (kind, group, plural) from `<kind-dashed>.<domain>...`
+    (ref: thirdpartyresourcedata/util.go ExtractApiGroupAndKind)."""
+    parts = tpr.metadata.name.split(".")
+    kind = "".join(p[:1].upper() + p[1:] for p in parts[0].split("-"))
+    group = ".".join(parts[1:])
+    plural = parts[0].replace("-", "") + "s"
+    return kind, group, plural
+
+
+def encode_third_party(obj: api.ThirdPartyResourceData, kind: str,
+                       group_version: str) -> dict:
+    """The raw custom document back out (the reference stores the whole
+    JSON and re-serves it)."""
+    wire = dict(obj.data)
+    wire["kind"] = kind
+    wire["apiVersion"] = group_version
+    meta = {"name": obj.metadata.name, "namespace": obj.metadata.namespace,
+            "uid": obj.metadata.uid,
+            "resourceVersion": obj.metadata.resource_version,
+            "creationTimestamp": obj.metadata.creation_timestamp}
+    if obj.metadata.labels:
+        meta["labels"] = dict(obj.metadata.labels)
+    if obj.metadata.annotations:
+        meta["annotations"] = dict(obj.metadata.annotations)
+    wire["metadata"] = meta
+    return wire
+
+
+def decode_third_party(data: dict) -> api.ThirdPartyResourceData:
+    meta = data.get("metadata") or {}
+    return api.ThirdPartyResourceData(
+        metadata=api.ObjectMeta(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+            uid=meta.get("uid", ""),
+            resource_version=meta.get("resourceVersion", ""),
+            creation_timestamp=meta.get("creationTimestamp", ""),
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {})),
+        data={k: v for k, v in data.items()
+              if k not in ("kind", "apiVersion", "metadata")})
 _register(ResourceInfo("jobs", "Job", api.Job, True))
 _register(ResourceInfo("deployments", "Deployment", api.Deployment, True))
 _register(ResourceInfo("daemonsets", "DaemonSet", api.DaemonSet, True))
 _register(ResourceInfo("horizontalpodautoscalers", "HorizontalPodAutoscaler",
                        api.HorizontalPodAutoscaler, True))
 _register(ResourceInfo("ingresses", "Ingress", api.Ingress, True))
+_register(ResourceInfo("thirdpartyresources", "ThirdPartyResource",
+                       api.ThirdPartyResource, True,
+                       validate=validate_third_party_resource,
+                       has_status=False))
 # Virtual resource: POST /bindings assigns a pod to a node (no storage of its
 # own; ref: pkg/registry/pod/etcd BindingREST).
 _register(ResourceInfo("bindings", "Binding", api.Binding, True,
@@ -579,3 +647,116 @@ class Registry:
             ns, name, assign = self._binding_op(b, namespace)
             ops.append((self.key("pods", ns, name), assign))
         return self.store.batch(ops)
+
+    # ------------------------------------------- third-party resources
+
+    def third_party_groups(self) -> Dict[str, Dict[str, Tuple[str, str]]]:
+        """group -> {plural: (Kind, version)} derived live from the
+        stored ThirdPartyResources (a restarted apiserver re-mounts
+        everything from the store, like master.go:972 on re-list).
+        TPRs are namespaced per the reference's strategy, so two
+        namespaces can declare the same group/kind; the first in
+        (namespace, name) order wins deterministically."""
+        out: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        tprs, _ = self.list("thirdpartyresources", "")
+        for tpr in sorted(tprs, key=lambda t: (t.metadata.namespace,
+                                               t.metadata.name)):
+            kind, group, plural = extract_group_and_kind(tpr)
+            version = tpr.versions[0].name if tpr.versions else "v1"
+            out.setdefault(group, {}).setdefault(plural, (kind, version))
+        return out
+
+    def third_party_kind(self, group: str, plural: str,
+                         groups: Optional[Dict] = None
+                         ) -> Tuple[str, str]:
+        """-> (Kind, version); NotFound when no TPR declares the pair.
+        `groups`: a precomputed third_party_groups() map (the server
+        resolves once per request instead of re-scanning per verb)."""
+        kinds = (groups if groups is not None
+                 else self.third_party_groups()).get(group, {})
+        if plural not in kinds:
+            raise NotFound(
+                f'the server could not find resource "{plural}" '
+                f'in group "{group}"')
+        return kinds[plural]
+
+    @staticmethod
+    def third_party_key(group: str, plural: str, namespace: str,
+                        name: str = "") -> str:
+        base = f"/registry/thirdparty/{group}/{plural}/{namespace}/"
+        return base + name if name else base
+
+    def third_party_create(self, group: str, plural: str,
+                           obj: api.ThirdPartyResourceData,
+                           namespace: str, checked: bool = False
+                           ) -> api.ThirdPartyResourceData:
+        if not checked:
+            self.third_party_kind(group, plural)
+        name = obj.metadata.name
+        if not _dns1123(name):
+            raise Invalid(f"metadata.name: invalid value {name!r}")
+        ns = obj.metadata.namespace or namespace or "default"
+        if not _dns1123(ns):
+            raise Invalid(f"metadata.namespace: invalid value {ns!r}")
+        obj = api.fast_replace(obj, metadata=api.fast_replace(
+            obj.metadata, namespace=ns, uid=obj.metadata.uid or _new_uid(),
+            creation_timestamp=(obj.metadata.creation_timestamp
+                                or api.now_rfc3339()),
+            resource_version=""))
+        return self.store.create(
+            self.third_party_key(group, plural, ns, name), obj)
+
+    def third_party_get(self, group: str, plural: str, name: str,
+                        namespace: str, checked: bool = False
+                        ) -> api.ThirdPartyResourceData:
+        if not checked:
+            self.third_party_kind(group, plural)
+        try:
+            return self.store.get(
+                self.third_party_key(group, plural, namespace, name))
+        except NotFound:
+            raise NotFound(kind=plural, name=name)
+
+    def third_party_list(self, group: str, plural: str,
+                         namespace: str = "", checked: bool = False):
+        if not checked:
+            self.third_party_kind(group, plural)
+        if namespace:
+            return self.store.list(
+                self.third_party_key(group, plural, namespace))
+        return self.store.list(f"/registry/thirdparty/{group}/{plural}/")
+
+    def third_party_update(self, group: str, plural: str,
+                           obj: api.ThirdPartyResourceData,
+                           namespace: str, checked: bool = False
+                           ) -> api.ThirdPartyResourceData:
+        if not checked:
+            self.third_party_kind(group, plural)
+        if not obj.metadata.name:
+            raise Invalid("metadata.name: required value")
+        ns = obj.metadata.namespace or namespace or "default"
+        return self.store.update(
+            self.third_party_key(group, plural, ns, obj.metadata.name),
+            obj)
+
+    def third_party_delete(self, group: str, plural: str, name: str,
+                           namespace: str, checked: bool = False
+                           ) -> api.ThirdPartyResourceData:
+        if not checked:
+            self.third_party_kind(group, plural)
+        try:
+            return self.store.delete(
+                self.third_party_key(group, plural, namespace, name))
+        except NotFound:
+            raise NotFound(kind=plural, name=name)
+
+    def third_party_watch(self, group: str, plural: str,
+                          namespace: str = "",
+                          since_rev: Optional[int] = None,
+                          checked: bool = False):
+        if not checked:
+            self.third_party_kind(group, plural)
+        prefix = (self.third_party_key(group, plural, namespace)
+                  if namespace
+                  else f"/registry/thirdparty/{group}/{plural}/")
+        return self.store.watch(prefix, since_rev)

@@ -206,12 +206,17 @@ class ApiServer:
             return self._send_json(h, 200, {"kind": "APIVersions",
                                             "versions": ["v1"]})
         if path == "/apis":
-            return self._send_json(h, 200, {
-                "kind": "APIGroupList",
-                "groups": [{"name": "extensions",
-                            "versions": [{"groupVersion":
-                                          "extensions/v1beta1",
-                                          "version": "v1beta1"}]}]})
+            groups = [{"name": "extensions",
+                       "versions": [{"groupVersion": "extensions/v1beta1",
+                                     "version": "v1beta1"}]}]
+            for g, kinds in sorted(
+                    self.registry.third_party_groups().items()):
+                versions = sorted({v for _, v in kinds.values()})
+                groups.append({"name": g, "versions": [
+                    {"groupVersion": f"{g}/{v}", "version": v}
+                    for v in versions]})
+            return self._send_json(h, 200, {"kind": "APIGroupList",
+                                            "groups": groups})
         from .registry import EXTENSIONS_RESOURCES
         if path in ("/api/v1", ""):
             return self._send_json(h, 200, {
@@ -238,6 +243,10 @@ class ApiServer:
             parts = parts[3:]
         elif len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
             parts = parts[2:]
+        elif parts[0] == "apis" and len(parts) >= 2:
+            # dynamic third-party groups (master.go:972
+            # InstallThirdPartyResource): /apis/<group>[/<version>/...]
+            return self._route_third_party(h, method, parts[1:], query)
         else:
             raise NotFound(f"path {path!r} not found")
         if not parts:
@@ -340,6 +349,95 @@ class ApiServer:
 
         raise MethodNotSupported(f"method {method} not supported")
 
+    # -------------------------------------------- third-party resources
+
+    def _route_third_party(self, h, method: str, parts: list,
+                           query: dict) -> None:
+        """REST verbs for dynamically-registered groups (the CRD
+        ancestor; ref: pkg/registry/thirdpartyresourcedata + the
+        per-group APIGroupVersion master.go builds)."""
+        from .registry import decode_third_party, encode_third_party
+        group = parts[0]
+        groups = self.registry.third_party_groups()
+        if group not in groups:
+            raise NotFound(f"group {group!r} not found")
+        if len(parts) == 1:  # group discovery
+            versions = sorted({v for _, v in groups[group].values()})
+            return self._send_json(h, 200, {
+                "kind": "APIGroup", "name": group,
+                "versions": [{"groupVersion": f"{group}/{v}",
+                              "version": v} for v in versions]})
+        version, rest = parts[1], parts[2:]
+        if not rest:  # version discovery
+            return self._send_json(h, 200, {
+                "kind": "APIResourceList",
+                "groupVersion": f"{group}/{version}",
+                "resources": [
+                    {"name": plural, "namespaced": True, "kind": kind}
+                    for plural, (kind, v) in sorted(
+                        groups[group].items()) if v == version]})
+        namespace = ""
+        if rest[0] == "namespaces" and len(rest) >= 2:
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            raise NotFound("resource required")
+        plural = rest[0]
+        name = rest[1] if len(rest) > 1 else ""
+        kind, declared = self.registry.third_party_kind(group, plural,
+                                                        groups=groups)
+        if version != declared:
+            raise NotFound(
+                f"group {group!r} serves version {declared!r}")
+        gv = f"{group}/{version}"
+        encode = lambda obj: encode_third_party(obj, kind, gv)  # noqa: E731
+
+        if method == "GET":
+            if query.get("watch") in ("true", "1") and not name:
+                rv = query.get("resourceVersion")
+                watcher = self.registry.third_party_watch(
+                    group, plural, namespace,
+                    int(rv) if rv not in (None, "") else None,
+                    checked=True)
+                self.metrics.inc("apiserver_watch_count",
+                                 {"resource": f"{group}/{plural}"})
+                if self._wants_websocket(h):
+                    return self._serve_watch_websocket(h, watcher, encode)
+                return self._stream_watch_events(h, watcher, encode)
+            if not name:
+                items, rev = self.registry.third_party_list(
+                    group, plural, namespace, checked=True)
+                return self._send_json(h, 200, {
+                    "kind": kind + "List", "apiVersion": gv,
+                    "metadata": {"resourceVersion": str(rev)},
+                    "items": [encode(i) for i in items]})
+            obj = self.registry.third_party_get(
+                group, plural, name, namespace or "default", checked=True)
+            return self._send_json(h, 200, encode(obj))
+        if method == "POST":
+            obj = decode_third_party(self._read_body(h))
+            created = self.registry.third_party_create(
+                group, plural, obj, namespace, checked=True)
+            return self._send_json(h, 201, encode(created))
+        if method == "PUT":
+            if not name:
+                raise MethodNotSupported("PUT requires a resource name")
+            obj = decode_third_party(self._read_body(h))
+            # the URL names the object; the body must not redirect the
+            # write elsewhere (typed PUT enforces the same)
+            obj.metadata.name = name
+            if namespace:
+                obj.metadata.namespace = namespace
+            updated = self.registry.third_party_update(
+                group, plural, obj, namespace, checked=True)
+            return self._send_json(h, 200, encode(updated))
+        if method == "DELETE":
+            if not name:
+                raise MethodNotSupported("DELETE requires a name")
+            deleted = self.registry.third_party_delete(
+                group, plural, name, namespace or "default", checked=True)
+            return self._send_json(h, 200, encode(deleted))
+        raise MethodNotSupported(f"method {method} not supported")
+
     # ----------------------------------------------------- kubelet relay
 
     def _kubelet_base(self, node_name: str) -> str:
@@ -394,6 +492,11 @@ class ApiServer:
         self.metrics.inc("apiserver_watch_count", {"resource": resource})
         if self._wants_websocket(h):
             return self._serve_watch_websocket(h, watcher)
+        self._stream_watch_events(h, watcher, self.scheme.encode_dict)
+
+    def _stream_watch_events(self, h, watcher, encode) -> None:
+        """Chunked JSON event stream shared by the typed watch and the
+        third-party watch (encode: object -> wire dict)."""
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -414,7 +517,7 @@ class ApiServer:
                     continue
                 line = json.dumps({
                     "type": ev.type,
-                    "object": self.scheme.encode_dict(ev.object),
+                    "object": encode(ev.object),
                 }).encode() + b"\n"
                 write_chunk(line)
             h.wfile.write(b"0\r\n\r\n")
@@ -423,7 +526,7 @@ class ApiServer:
         finally:
             watcher.stop()
 
-    def _serve_watch_websocket(self, h, watcher) -> None:
+    def _serve_watch_websocket(self, h, watcher, encode=None) -> None:
         """Watch over a websocket (ref: watch.go:89 HandleWS; wire events
         are the same JSON objects, one per text frame). RFC 6455 server
         side in stdlib: Sec-WebSocket-Accept handshake + unmasked
@@ -431,6 +534,8 @@ class ApiServer:
         discarded like the reference's Receive loop (watch.go:96)."""
         import hashlib as _hashlib
 
+        if encode is None:
+            encode = self.scheme.encode_dict
         key = h.headers.get("Sec-WebSocket-Key", "")
         try:
             if not key:
@@ -496,7 +601,7 @@ class ApiServer:
                     continue
                 line = json.dumps({
                     "type": ev.type,
-                    "object": self.scheme.encode_dict(ev.object),
+                    "object": encode(ev.object),
                 }).encode()
                 h.wfile.write(frame(line))
                 h.wfile.flush()

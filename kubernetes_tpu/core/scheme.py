@@ -108,6 +108,10 @@ def new_scheme() -> Scheme:
     s.register("DaemonSet", api.DaemonSet)
     s.register("HorizontalPodAutoscaler", api.HorizontalPodAutoscaler)
     s.register("Ingress", api.Ingress)
+    s.register("ThirdPartyResource", api.ThirdPartyResource)
+    # the storage form of custom objects (dynamic kinds encode through
+    # encode_third_party on the wire, but stores serialize the carrier)
+    s.register("ThirdPartyResourceData", api.ThirdPartyResourceData)
     return s
 
 

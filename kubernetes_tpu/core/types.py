@@ -823,6 +823,33 @@ class Ingress:
     status: IngressStatus = field(default_factory=IngressStatus)
 
 
+@dataclass
+class APIVersionEntry:
+    """(ref: pkg/apis/extensions/types.go APIVersion)"""
+    name: str = ""
+
+
+@dataclass
+class ThirdPartyResource:
+    """Dynamic API registration — the CRD ancestor (ref:
+    pkg/apis/extensions/types.go:145; name `<kind>.<domain>...` mounts
+    /apis/<domain>/<version>/<kind>s, master.go:972
+    InstallThirdPartyResource)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    description: str = ""
+    versions: List[APIVersionEntry] = field(default_factory=list)
+
+
+@dataclass
+class ThirdPartyResourceData:
+    """One custom object: standard metadata + the raw custom fields
+    (ref: pkg/registry/thirdpartyresourcedata — the reference stores the
+    whole JSON document; `data` carries everything that isn't
+    kind/apiVersion/metadata)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
 # ------------------------------------------------------ persistent volumes
 
 VOLUME_AVAILABLE = "Available"

@@ -1,0 +1,248 @@
+"""ThirdPartyResources — dynamic API groups, the CRD ancestor.
+
+Reference: pkg/apis/extensions/types.go:145 ThirdPartyResource,
+pkg/registry/thirdpartyresourcedata (raw-document storage),
+master.go:972 InstallThirdPartyResource (a TPR named <kind>.<domain>
+mounts /apis/<domain>/<version>/<kind>s, namespaced)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import (Registry, extract_group_and_kind)
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import Conflict, Invalid, NotFound
+
+
+def mktpr(name="lizard.stable.example.com", version="v1"):
+    return api.ThirdPartyResource(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        description="a custom kind",
+        versions=[api.APIVersionEntry(name=version)])
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+class TestRegistration:
+    def test_name_parsing(self):
+        kind, group, plural = extract_group_and_kind(mktpr())
+        assert (kind, group, plural) == \
+            ("Lizard", "stable.example.com", "lizards")
+        kind, _, plural = extract_group_and_kind(
+            mktpr("fire-dragon.acme.io"))
+        assert kind == "FireDragon" and plural == "firedragons"
+
+    def test_validation(self):
+        registry = Registry()
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        with pytest.raises(Invalid):
+            registry.create("thirdpartyresources",
+                            mktpr(name="tooshort.io"))
+        with pytest.raises(Invalid):
+            bad = mktpr()
+            bad.versions = []
+            registry.create("thirdpartyresources", bad)
+
+    def test_groups_derived_from_store(self):
+        registry = Registry()
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        registry.create("thirdpartyresources", mktpr())
+        assert registry.third_party_groups() == {
+            "stable.example.com": {"lizards": ("Lizard", "v1")}}
+        # a fresh registry over the same store re-mounts everything
+        registry2 = Registry(store=registry.store)
+        assert "stable.example.com" in registry2.third_party_groups()
+
+    def test_unknown_group_404(self):
+        registry = Registry()
+        with pytest.raises(NotFound):
+            registry.third_party_kind("nope.example.com", "things")
+
+
+class TestDynamicAPIOverHTTP:
+    @pytest.fixture()
+    def served(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        client.create("thirdpartyresources", mktpr())
+        srv = ApiServer(registry).start()
+        yield registry, srv
+        srv.stop()
+
+    def test_full_crud_cycle(self, served):
+        registry, srv = served
+        base = f"{srv.url}/apis/stable.example.com/v1"
+        status, created = post(
+            f"{base}/namespaces/default/lizards",
+            {"kind": "Lizard", "apiVersion": "stable.example.com/v1",
+             "metadata": {"name": "liz"},
+             "spec": {"color": "green", "length": 42}})
+        assert status == 201
+        assert created["spec"]["color"] == "green"
+        assert created["metadata"]["uid"]
+
+        got = get(f"{base}/namespaces/default/lizards/liz")
+        assert got["kind"] == "Lizard"
+        assert got["apiVersion"] == "stable.example.com/v1"
+        assert got["spec"]["length"] == 42
+
+        # update preserves CAS semantics on resourceVersion
+        got["spec"]["color"] = "blue"
+        req = urllib.request.Request(
+            f"{base}/namespaces/default/lizards/liz",
+            data=json.dumps(got).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            updated = json.loads(resp.read())
+        assert updated["spec"]["color"] == "blue"
+
+        listing = get(f"{base}/namespaces/default/lizards")
+        assert listing["kind"] == "LizardList"
+        assert len(listing["items"]) == 1
+
+        req = urllib.request.Request(
+            f"{base}/namespaces/default/lizards/liz", method="DELETE")
+        urllib.request.urlopen(req, timeout=10).close()
+        assert get(f"{base}/namespaces/default/lizards")["items"] == []
+
+    def test_discovery(self, served):
+        registry, srv = served
+        groups = get(f"{srv.url}/apis")
+        names = {g["name"] for g in groups["groups"]}
+        assert "stable.example.com" in names and "extensions" in names
+        group = get(f"{srv.url}/apis/stable.example.com")
+        assert group["versions"][0]["groupVersion"] \
+            == "stable.example.com/v1"
+        rl = get(f"{srv.url}/apis/stable.example.com/v1")
+        assert rl["resources"] == [
+            {"name": "lizards", "namespaced": True, "kind": "Lizard"}]
+
+    def test_watch_streams_custom_objects(self, served):
+        import threading
+
+        registry, srv = served
+        events = []
+        done = threading.Event()
+
+        def watch():
+            req = urllib.request.Request(
+                f"{srv.url}/apis/stable.example.com/v1/namespaces/"
+                f"default/lizards?watch=true")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        events.append(json.loads(line))
+                        done.set()
+                        return
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.3)
+        registry.third_party_create(
+            "stable.example.com", "lizards",
+            api.ThirdPartyResourceData(
+                metadata=api.ObjectMeta(name="w1", namespace="default"),
+                data={"spec": {"color": "red"}}), "default")
+        assert done.wait(timeout=10)
+        assert events[0]["type"] == "ADDED"
+        assert events[0]["object"]["spec"]["color"] == "red"
+
+    def test_wrong_version_404(self, served):
+        registry, srv = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{srv.url}/apis/stable.example.com/v2/lizards")
+        assert e.value.code == 404
+
+    def test_unknown_resource_404(self, served):
+        registry, srv = served
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(f"{srv.url}/apis/stable.example.com/v1/dragons")
+        assert e.value.code == 404
+
+
+def test_custom_objects_on_native_store():
+    """The C++ store serializes through the scheme — the data carrier
+    must be a registered kind."""
+    from kubernetes_tpu.core.native_store import NativeStore
+    registry = Registry(store=NativeStore())
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    registry.create("thirdpartyresources", mktpr())
+    created = registry.third_party_create(
+        "stable.example.com", "lizards",
+        api.ThirdPartyResourceData(
+            metadata=api.ObjectMeta(name="native-liz",
+                                    namespace="default"),
+            data={"spec": {"scales": 99}}), "default")
+    got = registry.third_party_get("stable.example.com", "lizards",
+                                   "native-liz", "default")
+    assert got.data["spec"]["scales"] == 99
+
+
+def test_put_is_pinned_to_url_name(served=None):
+    registry = Registry()
+    client = InProcClient(registry)
+    client.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    client.create("thirdpartyresources", mktpr())
+    srv = ApiServer(registry).start()
+    try:
+        base = f"{srv.url}/apis/stable.example.com/v1"
+        post(f"{base}/namespaces/default/lizards",
+             {"kind": "Lizard", "metadata": {"name": "a"},
+              "spec": {"v": 1}})
+        post(f"{base}/namespaces/default/lizards",
+             {"kind": "Lizard", "metadata": {"name": "b"},
+              "spec": {"v": 1}})
+        # a body naming "b" sent to a's URL must update A, not b
+        got = get(f"{base}/namespaces/default/lizards/a")
+        got["metadata"]["name"] = "b"
+        got["spec"]["v"] = 2
+        got["metadata"].pop("resourceVersion", None)
+        req = urllib.request.Request(
+            f"{base}/namespaces/default/lizards/a",
+            data=json.dumps(got).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).close()
+        assert get(f"{base}/namespaces/default/lizards/a")["spec"]["v"] \
+            == 2
+        assert get(f"{base}/namespaces/default/lizards/b")["spec"]["v"] \
+            == 1
+    finally:
+        srv.stop()
+
+
+def test_invalid_names_rejected():
+    registry = Registry()
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    registry.create("thirdpartyresources", mktpr())
+    for bad in ("a/b", "", "UPPER", "a b"):
+        with pytest.raises(Invalid):
+            registry.third_party_create(
+                "stable.example.com", "lizards",
+                api.ThirdPartyResourceData(
+                    metadata=api.ObjectMeta(name=bad,
+                                            namespace="default")),
+                "default")

@@ -23,7 +23,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..core import fields as fieldspkg
 from ..core import labels as labelspkg
 from ..core import types as api
-from ..core.errors import BadRequest, Conflict, Invalid, NotFound
+from ..core.errors import (BadRequest, Conflict, Invalid,
+                           MethodNotSupported, NotFound)
 from ..core.scheme import Scheme, default_scheme
 from ..core.store import Store
 from ..core.watch import Watcher
@@ -140,6 +141,13 @@ _register(ResourceInfo("persistentvolumes", "PersistentVolume",
                        api.PersistentVolume, False))
 _register(ResourceInfo("persistentvolumeclaims", "PersistentVolumeClaim",
                        api.PersistentVolumeClaim, True))
+_register(ResourceInfo("podtemplates", "PodTemplate", api.PodTemplate,
+                       True, has_status=False))
+# read-only, computed per request from component health probes
+# (ref: pkg/registry/componentstatus — scheduler :10251, controller-
+# manager :10252, etcd; master.go getServersToValidate)
+_register(ResourceInfo("componentstatuses", "ComponentStatus",
+                       api.ComponentStatus, False, has_status=False))
 # extensions/v1beta1 group (ref: pkg/registry/{job,deployment,daemonset,
 # horizontalpodautoscaler,ingress}; mounted master.go:1049-1091 — served
 # under /apis/extensions/v1beta1 by the API server)
@@ -243,6 +251,12 @@ class Registry:
         # service cluster-IP + node-port allocators (ref:
         # pkg/registry/service ipallocator/portallocator); repaired from
         # the store so a registry over pre-existing state stays coherent
+        # componentstatus probes (ref: master.go getServersToValidate —
+        # the store plays etcd-0; Master adds scheduler/controller-
+        # manager probes at their conventional ports)
+        self.component_probes: Dict[str, Callable] = {
+            "etcd-0": lambda: (
+                True, f"revision {self.store.current_revision}")}
         from .allocators import IPAllocator, PortAllocator
         self.ip_allocator = IPAllocator(service_cidr)
         self.port_allocator = PortAllocator()
@@ -292,8 +306,19 @@ class Registry:
     # ------------------------------------------------------------ verbs
 
     def create(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        if resource == "componentstatuses":
+            raise MethodNotSupported("componentstatuses is read-only")
         if resource == "bindings":
             return self.bind(obj, namespace)
+        if resource == "thirdpartyresources":
+            # two TPRs must never map to one (group, plural) — they'd
+            # silently share a storage prefix and the first one's Kind
+            _, new_group, new_plural = extract_group_and_kind(obj)
+            existing = self.third_party_groups().get(new_group, {})
+            if new_plural in existing:
+                raise Conflict(
+                    f"a ThirdPartyResource already serves "
+                    f"{new_group}/{new_plural}")
         info = self.info(resource)
         if not isinstance(obj, info.cls):
             raise BadRequest(f"expected {info.kind}, got {type(obj).__name__}")
@@ -414,12 +439,50 @@ class Registry:
                 self.port_allocator.release(port.node_port)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
+        if resource == "componentstatuses":
+            if name not in self.component_probes:
+                raise NotFound(kind=resource, name=name)
+            # only the requested component is probed — a down scheduler
+            # must not slow a GET of etcd-0
+            return self._component_statuses([name])[0]
         info = self.info(resource)
         ns = namespace or ("default" if info.namespaced else "")
         try:
             return self.store.get(self.key(resource, ns, name))
         except NotFound:
             raise NotFound(kind=resource, name=name)
+
+    def _component_statuses(self, names: Optional[List[str]] = None
+                            ) -> List[api.ComponentStatus]:
+        """Computed per request from the registered probes, fanned out
+        in parallel — one slow/down component costs one timeout, not a
+        sum (ref: pkg/registry/componentstatus REST.List ->
+        validator.Server, probed concurrently)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        wanted = sorted(names if names is not None
+                        else self.component_probes)
+
+        def run_probe(name):
+            try:
+                return self.component_probes[name]()
+            except Exception as e:
+                return False, repr(e)
+
+        with ThreadPoolExecutor(max_workers=max(1, len(wanted))) as pool:
+            results = list(pool.map(run_probe, wanted))
+        return [api.ComponentStatus(
+            metadata=api.ObjectMeta(name=name),
+            conditions=[api.ComponentCondition(
+                type="Healthy",
+                status="True" if ok else "False",
+                message=message if ok else "",
+                error="" if ok else message)])
+            for name, (ok, message) in zip(wanted, results)]
+
+    def add_component_probe(self, name: str, probe) -> None:
+        """probe() -> (healthy: bool, message: str)."""
+        self.component_probes[name] = probe
 
     def list(self, resource: str, namespace: str = "",
              label_selector: str = "", field_selector: str = ""
@@ -436,9 +499,16 @@ class Registry:
             return True
 
         use_pred = pred if (lsel is not None or fsel is not None) else None
+        if resource == "componentstatuses":
+            statuses = self._component_statuses()
+            if use_pred is not None:
+                statuses = [s for s in statuses if pred(s)]
+            return statuses, self.store.current_revision
         return self.store.list(self.prefix(resource, namespace), use_pred)
 
     def update(self, resource: str, obj: Any, namespace: str = "") -> Any:
+        if resource == "componentstatuses":
+            raise MethodNotSupported("componentstatuses is read-only")
         info = self.info(resource)
         ns = self._namespace_for(info, obj, namespace)
         if not obj.metadata.name:
@@ -518,6 +588,8 @@ class Registry:
         return self.store.guaranteed_update(self.key(resource, ns, name), fn)
 
     def delete(self, resource: str, name: str, namespace: str = "") -> Any:
+        if resource == "componentstatuses":
+            raise MethodNotSupported("componentstatuses is read-only")
         info = self.info(resource)
         ns = namespace or ("default" if info.namespaced else "")
         if self.admission:
@@ -695,6 +767,14 @@ class Registry:
         name = obj.metadata.name
         if not _dns1123(name):
             raise Invalid(f"metadata.name: invalid value {name!r}")
+        if obj.metadata.namespace and namespace \
+                and obj.metadata.namespace != namespace:
+            # the URL names the namespace the authorizer approved; the
+            # body must not redirect the write (typed _namespace_for
+            # enforces the same)
+            raise BadRequest(
+                f"namespace mismatch: body {obj.metadata.namespace!r} "
+                f"vs request {namespace!r}")
         ns = obj.metadata.namespace or namespace or "default"
         if not _dns1123(ns):
             raise Invalid(f"metadata.namespace: invalid value {ns!r}")
@@ -734,6 +814,11 @@ class Registry:
             self.third_party_kind(group, plural)
         if not obj.metadata.name:
             raise Invalid("metadata.name: required value")
+        if obj.metadata.namespace and namespace \
+                and obj.metadata.namespace != namespace:
+            raise BadRequest(
+                f"namespace mismatch: body {obj.metadata.namespace!r} "
+                f"vs request {namespace!r}")
         ns = obj.metadata.namespace or namespace or "default"
         return self.store.update(
             self.third_party_key(group, plural, ns, obj.metadata.name),

@@ -369,6 +369,10 @@ class ApiServer:
                               "version": v} for v in versions]})
         version, rest = parts[1], parts[2:]
         if not rest:  # version discovery
+            declared_versions = {v for _, v in groups[group].values()}
+            if version not in declared_versions:
+                raise NotFound(
+                    f"group {group!r} has no version {version!r}")
             return self._send_json(h, 200, {
                 "kind": "APIResourceList",
                 "groupVersion": f"{group}/{version}",

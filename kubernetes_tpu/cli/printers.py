@@ -111,6 +111,16 @@ COLUMNS: Dict[str, Any] = {
                    _deployment_row),
     "Job": (["NAME", "COMPLETIONS", "SUCCESSFUL", "AGE"], _job_row),
     "Namespace": (["NAME", "STATUS", "AGE"], _ns_row),
+    "ComponentStatus": (["NAME", "STATUS", "MESSAGE", "ERROR"],
+                        lambda cs: [
+                            cs.metadata.name,
+                            ("Healthy" if cs.conditions
+                             and cs.conditions[0].status == "True"
+                             else "Unhealthy"),
+                            cs.conditions[0].message if cs.conditions
+                            else "",
+                            cs.conditions[0].error if cs.conditions
+                            else ""]),
 }
 
 

@@ -102,6 +102,8 @@ def new_scheme() -> Scheme:
     s.register("ServiceAccount", api.ServiceAccount)
     s.register("PersistentVolume", api.PersistentVolume)
     s.register("PersistentVolumeClaim", api.PersistentVolumeClaim)
+    s.register("PodTemplate", api.PodTemplate)
+    s.register("ComponentStatus", api.ComponentStatus)
     # extensions/v1beta1 group (master.go:1049-1091)
     s.register("Job", api.Job)
     s.register("Deployment", api.Deployment)

@@ -824,6 +824,30 @@ class Ingress:
 
 
 @dataclass
+class PodTemplate:
+    """(ref: pkg/api/types.go:1121 PodTemplate)"""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ComponentCondition:
+    """(ref: pkg/api/types.go ComponentCondition)"""
+    type: str = "Healthy"
+    status: str = ""
+    message: str = ""
+    error: str = ""
+
+
+@dataclass
+class ComponentStatus:
+    """(ref: pkg/api/types.go:2086 ComponentStatus — the health of
+    scheduler/controller-manager/etcd as seen by the apiserver)"""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    conditions: List[ComponentCondition] = field(default_factory=list)
+
+
+@dataclass
 class APIVersionEntry:
     """(ref: pkg/apis/extensions/types.go APIVersion)"""
     name: str = ""

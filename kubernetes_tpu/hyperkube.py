@@ -65,6 +65,24 @@ def _wait_for_master(url: str, timeout_s: float = 60.0) -> None:
     raise RuntimeError(f"master {url} not healthy after {timeout_s}s: {last}")
 
 
+def _start_healthz(component: str):
+    """Serve healthz/metrics on the component's conventional port
+    (scheduler :10251 / controller-manager :10252, the ports the
+    apiserver's componentstatus resource probes; ref: plugin/cmd/
+    kube-scheduler/app/server.go:128-143). Best effort: a taken port
+    (tests, multiple schedulers) disables the server rather than the
+    component."""
+    from .utils.healthz import (CONTROLLER_MANAGER_PORT, SCHEDULER_PORT,
+                                HealthzServer)
+    port = (SCHEDULER_PORT if component == "scheduler"
+            else CONTROLLER_MANAGER_PORT)
+    try:
+        server = HealthzServer(port=port).start()
+        return server.stop
+    except OSError:
+        return lambda: None
+
+
 def _serve_until_signal(ready_line: str, stop_fns) -> int:
     """Print the READY line, then park until SIGTERM/SIGINT and unwind."""
     stop_event = threading.Event()
@@ -170,8 +188,10 @@ def run_scheduler(argv: List[str]) -> int:
                 factory.create_from_config(policy) if policy
                 else factory.create_from_provider(
                     args.algorithm_provider)).run()
+    stops = [sched.stop, factory.stop]
+    stops.append(_start_healthz("scheduler"))
     return _serve_until_signal(
-        f"scheduler ready mode={args.mode}", [sched.stop, factory.stop])
+        f"scheduler ready mode={args.mode}", stops)
 
 
 def run_controller_manager(argv: List[str]) -> int:
@@ -185,7 +205,9 @@ def run_controller_manager(argv: List[str]) -> int:
 
     _wait_for_master(args.master)
     manager = ControllerManager(HttpClient(args.master)).run()
-    return _serve_until_signal("controller-manager ready", [manager.stop])
+    return _serve_until_signal(
+        "controller-manager ready",
+        [manager.stop, _start_healthz("controller-manager")])
 
 
 def run_hollow_node(argv: List[str]) -> int:

@@ -27,6 +27,21 @@ from .auth.authorize import (AlwaysAllowAuthorizer, AlwaysDenyAuthorizer,
 from .core.errors import BadRequest
 
 
+def _healthz_probe(port: int, host: str = "127.0.0.1"):
+    def probe():
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=2) as resp:
+                body = resp.read().decode(errors="replace").strip()
+                if resp.status == 200:
+                    return True, body or "ok"
+                return False, f"healthz status {resp.status}: {body}"
+        except Exception as e:
+            return False, f"Get http://{host}:{port}/healthz: {e}"
+    return probe
+
+
 @dataclass
 class MasterConfig:
     """(ref: master.go:157 Config + the cmd/kube-apiserver flag surface)"""
@@ -102,6 +117,16 @@ class Master:
                                 max_in_flight=cfg.max_in_flight,
                                 authenticator=authenticator,
                                 authorizer=authorizer)
+
+        # componentstatus probes at the components' conventional healthz
+        # ports (ref: master.go getServersToValidate: scheduler :10251,
+        # controller-manager :10252)
+        from .utils.healthz import (CONTROLLER_MANAGER_PORT,
+                                    SCHEDULER_PORT)
+        self.registry.add_component_probe(
+            "scheduler", _healthz_probe(SCHEDULER_PORT))
+        self.registry.add_component_probe(
+            "controller-manager", _healthz_probe(CONTROLLER_MANAGER_PORT))
 
     @property
     def url(self) -> str:

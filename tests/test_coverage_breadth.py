@@ -226,3 +226,64 @@ class TestJWTAuthenticator:
         user, ok = auth.authenticate(self._headers(
             {"sub": "u1", "email": "a@b.c"}))
         assert ok and user.name == "a@b.c"
+
+
+class TestComponentStatusesAndPodTemplates:
+    def test_componentstatuses_computed_from_probes(self):
+        registry = Registry()
+        statuses, _ = registry.list("componentstatuses")
+        by_name = {s.metadata.name: s for s in statuses}
+        # the store plays etcd-0 and is healthy
+        assert by_name["etcd-0"].conditions[0].status == "True"
+        # a healthy custom component
+        registry.add_component_probe("scheduler",
+                                     lambda: (True, "ok"))
+        registry.add_component_probe("controller-manager",
+                                     lambda: (False, "connection refused"))
+        sched = registry.get("componentstatuses", "scheduler")
+        assert sched.conditions[0].status == "True"
+        cm = registry.get("componentstatuses", "controller-manager")
+        assert cm.conditions[0].status == "False"
+        assert "refused" in cm.conditions[0].error
+
+    def test_componentstatuses_read_only(self):
+        from kubernetes_tpu.core.errors import MethodNotSupported
+        registry = Registry()
+        with pytest.raises(MethodNotSupported):
+            registry.create("componentstatuses", api.ComponentStatus(
+                metadata=api.ObjectMeta(name="fake")))
+
+    def test_componentstatuses_with_live_healthz(self):
+        """Master probes a real scheduler healthz server — the
+        getServersToValidate loop end-to-end."""
+        from kubernetes_tpu.master import _healthz_probe
+        from kubernetes_tpu.utils.healthz import HealthzServer
+        srv = HealthzServer().start()
+        try:
+            registry = Registry()
+            registry.add_component_probe("scheduler",
+                                         _healthz_probe(srv.port))
+            cs = registry.get("componentstatuses", "scheduler")
+            assert cs.conditions[0].status == "True"
+        finally:
+            srv.stop()
+        cs = registry.get("componentstatuses", "scheduler")
+        assert cs.conditions[0].status == "False"
+
+    def test_podtemplates_crud(self):
+        registry = Registry()
+        registry.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        tmpl = api.PodTemplate(
+            metadata=api.ObjectMeta(name="web-template",
+                                    namespace="default"),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"app": "web"}),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="web:v1")])))
+        registry.create("podtemplates", tmpl)
+        got = registry.get("podtemplates", "web-template", "default")
+        assert got.template.spec.containers[0].image == "web:v1"
+        registry.delete("podtemplates", "web-template", "default")
+        with pytest.raises(Exception):
+            registry.get("podtemplates", "web-template", "default")

@@ -22,8 +22,40 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
+
+_PLATFORM_ENV = "KTPU_BENCH_PLATFORM_CHECKED"
+
+
+def _ensure_live_platform() -> str:
+    """The default platform may be a tunneled TPU; a wedged tunnel hangs
+    the first dispatch forever. Probe it in a subprocess with a timeout
+    and fall back to CPU (recorded in the output) rather than hang the
+    benchmark run."""
+    if os.environ.get(_PLATFORM_ENV):
+        import jax
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if plat:  # honor the fallback past any sitecustomize pin
+            jax.config.update("jax_platforms", plat)
+        return "cpu-fallback" if plat == "cpu" else "default"
+    probe = ("import jax, jax.numpy as jnp; "
+             "jnp.ones(4).sum().block_until_ready(); print('ok')")
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=180).returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    os.environ[_PLATFORM_ENV] = "1"
+    if not ok:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
+    return "default"
 
 
 def engine_only(n_nodes, n_pods):
@@ -79,6 +111,7 @@ def main():
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
+    platform = _ensure_live_platform()
     from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
 
     r = run_scheduling_benchmark(args.nodes, args.pods, "batch")
@@ -96,7 +129,8 @@ def main():
         "scheduled": r.scheduled,
         "nodes": r.n_nodes,
         "pods": r.n_pods,
-        "engine_only_pods_per_sec": round(engine_rate, 1)}))
+        "engine_only_pods_per_sec": round(engine_rate, 1),
+        "platform": platform}))
 
 
 if __name__ == "__main__":

@@ -665,6 +665,10 @@ class Registry:
 
     def watch(self, resource: str, namespace: str = "",
               since_rev: Optional[int] = None) -> Watcher:
+        if resource == "componentstatuses":
+            # computed per request, not stored: a watch would hang
+            # forever with zero events (the reference rejects it too)
+            raise MethodNotSupported("componentstatuses is not watchable")
         return self.store.watch(self.prefix(resource, namespace), since_rev)
 
     # ------------------------------------------------- binding subresource
@@ -726,8 +730,8 @@ class Registry:
         """group -> {plural: (Kind, version)} derived live from the
         stored ThirdPartyResources (a restarted apiserver re-mounts
         everything from the store, like master.go:972 on re-list).
-        TPRs are namespaced per the reference's strategy, so two
-        namespaces can declare the same group/kind; the first in
+        create() rejects new collisions on (group, plural); should
+        pre-existing store state still contain any, the first TPR in
         (namespace, name) order wins deterministically."""
         out: Dict[str, Dict[str, Tuple[str, str]]] = {}
         tprs, _ = self.list("thirdpartyresources", "")

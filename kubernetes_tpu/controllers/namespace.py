@@ -23,9 +23,10 @@ _CONTENT_RESOURCES = [
     # workload owners before their products (deployments create RCs,
     # jobs/daemonsets/RCs create pods), then the rest, events last
     "deployments", "horizontalpodautoscalers", "jobs", "daemonsets",
-    "replicationcontrollers", "pods", "serviceaccounts", "services",
-    "ingresses", "persistentvolumeclaims", "secrets", "limitranges",
-    "resourcequotas", "endpoints", "events",
+    "replicationcontrollers", "pods", "podtemplates", "serviceaccounts",
+    "services", "ingresses", "persistentvolumeclaims", "secrets",
+    "limitranges", "resourcequotas", "thirdpartyresources", "endpoints",
+    "events",
 ]
 
 

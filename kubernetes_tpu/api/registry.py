@@ -602,6 +602,19 @@ class Registry:
             raise NotFound(kind=resource, name=name)
         if resource == "services":
             self._service_release(deleted)
+        if resource == "thirdpartyresources":
+            # unmounting a kind removes its instance data too (ref:
+            # master.go removeThirdPartyStorage) — otherwise stale
+            # objects silently resurrect under a re-created TPR
+            _, group, plural = extract_group_and_kind(deleted)
+            prefix = f"/registry/thirdparty/{group}/{plural}/"
+            for obj in self.store.list(prefix)[0]:
+                try:
+                    self.store.delete(self.third_party_key(
+                        group, plural, obj.metadata.namespace,
+                        obj.metadata.name))
+                except NotFound:
+                    pass
         return deleted
 
     # --------------------------------------------- namespace lifecycle

@@ -126,9 +126,10 @@ def _floordiv_exact(num: jax.Array, den: jax.Array,
     A f64 reciprocal-multiply estimate is within 1 of the true quotient
     (relative error ~2^-51 on an exact f64 product), so two integer
     compare-corrections make it exact."""
-    e = jnp.floor(num.astype(jnp.float64) * inv_den).astype(jnp.int64)
-    e = e + ((e + 1) * den <= num).astype(jnp.int64)
-    e = e - (e * den > num).astype(jnp.int64)
+    dt = num.dtype
+    e = jnp.floor(num.astype(jnp.float64) * inv_den).astype(dt)
+    e = e + ((e + 1) * den <= num).astype(dt)
+    e = e - (e * den > num).astype(dt)
     return e
 
 
@@ -143,6 +144,10 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
     the extender server answers per-pod, stateless between requests)."""
     n = node.valid.shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
+    # score dtype follows the resource arrays: i64 normally, i32 when the
+    # encoder narrowed (exact gcd rescale of memory + bounds checks make
+    # the narrow math bit-identical — see tables._maybe_narrow)
+    sdt = node.cpu_cap.dtype
 
     # ---- predicate masks (predicates.go:127,192,250,258,403) ----
     fits_count = state.pod_count < node.pod_cap
@@ -212,8 +217,8 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
                          tm.astype(jnp.float64) / safe_mem.astype(jnp.float64))
     diff = jnp.abs(cpu_frac - mem_frac)
     balanced = jnp.where(
-        (cpu_frac >= 1.0) | (mem_frac >= 1.0), jnp.int64(0),
-        jnp.floor(jnp.float64(10.0) - diff * 10.0).astype(jnp.int64))
+        (cpu_frac >= 1.0) | (mem_frac >= 1.0), jnp.zeros((), sdt),
+        jnp.floor(jnp.float64(10.0) - diff * 10.0).astype(sdt))
 
     total = (weights[0] * least_requested + weights[1] * balanced
              + node.static_score)
@@ -225,8 +230,8 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
         spread_f = (10.0 * (max_count - counts).astype(jnp.float64)
                     / jnp.maximum(max_count, 1).astype(jnp.float64))
         spread = jnp.where((pod.group_id < 0) | (max_count == 0),
-                           jnp.int64(10),
-                           jnp.floor(spread_f).astype(jnp.int64))
+                           jnp.full((), 10, sdt),
+                           jnp.floor(spread_f).astype(sdt))
         total = total + weights[2] * spread
     # has_spread=False: every pod scores the constant 10 on all nodes
     # (group_id < 0), which shifts all totals equally and cannot change
@@ -249,9 +254,10 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
         sa_f = (10.0 * (svc_total - count_n).astype(jnp.float64)
                 / jnp.maximum(svc_total, 1).astype(jnp.float64))
         sa = jnp.where(
-            ~labeled, jnp.int64(0),
+            ~labeled, jnp.zeros((), sdt),
             jnp.where(svc_total > 0,
-                      jnp.floor(sa_f).astype(jnp.int64), jnp.int64(10)))
+                      jnp.floor(sa_f).astype(sdt),
+                      jnp.full((), 10, sdt)))
         total = total + anti_weight * sa
 
     return mask, total
@@ -271,7 +277,8 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
     # distinct 0..n-1 per valid node, so argmax(total*n + tie_rank) is
     # exactly "max score, then deterministic max tie-rank" in one
     # reduction instead of max + compare + argmax
-    composite = jnp.where(mask, total * n + node.tie_rank, jnp.int64(-1))
+    composite = jnp.where(mask, total * n + node.tie_rank,
+                          jnp.full((), -1, total.dtype))
     pick = jnp.argmax(composite).astype(jnp.int32)
     fit_any = composite[pick] >= 0
     assigned = jnp.where(fit_any, pick, jnp.int32(-1))
@@ -282,7 +289,8 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
     # step's state write is O(1) instead of O(nodes) (the state arrays
     # are ~the same size as the score reads — this halves per-step HBM
     # traffic). A no-fit step scatters a zero delta at lane 0.
-    add = jnp.where(fit_any, jnp.int64(1), jnp.int64(0))
+    add = jnp.where(fit_any, jnp.ones((), state.cpu_used.dtype),
+                    jnp.zeros((), state.cpu_used.dtype))
     add32 = add.astype(jnp.int32)
     j = jnp.maximum(pick, 0)
     new_state = State(
@@ -415,7 +423,44 @@ class BatchEngine:
     def n_shards(self) -> int:
         return 1 if self.mesh is None else self.mesh.devices.size
 
+    def _ensure_safe_dtypes(self, enc: EncodeResult) -> EncodeResult:
+        """The encoder narrows with a conservative default weight bound;
+        an engine configured with larger policy weights must re-widen or
+        the i32 composite argmax could wrap (encode can't know the
+        engine's weights — this is the engine's half of the contract)."""
+        nt = enc.node_tab
+        if nt.cpu_cap.dtype != np.int32:
+            return enc
+        n = nt.valid.shape[0]
+        max_static = int(np.max(np.abs(nt.static_score))) \
+            if nt.static_score.size else 0
+        wsum = sum(abs(w) for w in self.weights) + abs(self._anti_weight)
+        if (10 * wsum + max_static + 1) * max(n, 1) < (1 << 30):
+            return enc
+        from dataclasses import replace as _dc_replace
+        i64 = np.int64
+        g = enc.mem_scale
+        st, pb = enc.init_state, enc.pod_batch
+        return _dc_replace(
+            enc,
+            mem_scale=1,
+            node_tab=_dc_replace(
+                nt, cpu_cap=nt.cpu_cap.astype(i64),
+                mem_cap=nt.mem_cap.astype(i64) * g,
+                static_score=nt.static_score.astype(i64)),
+            init_state=_dc_replace(
+                st, cpu_used=st.cpu_used.astype(i64),
+                mem_used=st.mem_used.astype(i64) * g,
+                nz_cpu=st.nz_cpu.astype(i64),
+                nz_mem=st.nz_mem.astype(i64) * g),
+            pod_batch=_dc_replace(
+                pb, req_cpu=pb.req_cpu.astype(i64),
+                req_mem=pb.req_mem.astype(i64) * g,
+                nz_cpu=pb.nz_cpu.astype(i64),
+                nz_mem=pb.nz_mem.astype(i64) * g))
+
     def device_args(self, enc: EncodeResult):
+        enc = self._ensure_safe_dtypes(enc)
         nt, st, pb = enc.node_tab, enc.init_state, enc.pod_batch
         node = NodeConst(
             valid=nt.valid, cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,

@@ -25,7 +25,7 @@ the reference, plugin/pkg/scheduler/algorithm):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -188,6 +188,79 @@ class EncodeResult:
     node_names: List[str]        # index -> name (padded entries "")
     n_nodes: int                 # valid (unpadded) node count
     n_pods: int                  # valid (unpadded) pod count
+    # >1 when the resource arrays were narrowed to i32: every memory
+    # quantity is stored divided by this exact common divisor
+    mem_scale: int = 1
+
+
+_I32_BOUND = 1 << 30  # slack below 2^31 for the x10 score scaling
+
+
+def _maybe_narrow(nt: NodeArrays, st: StateArrays, pb: PodArrays,
+                  weights_hint: int = 64):
+    """Narrow the i64 resource/score arrays to i32 when provably exact.
+
+    Memory quantities (bytes) exceed i32, but every formula that touches
+    them is scale-invariant under an EXACT common divisor g:
+    floor((a/g)*10 / (b/g)) == floor(a*10/b) when g|a and g|b (integer
+    identity), and f64((a/g))/f64((b/g)) is the correctly-rounded
+    quotient of the same rational as f64(a)/f64(b), hence bit-identical.
+    So divide all memory values by their collective gcd and cast to i32
+    — on TPU this halves the emulated-64-bit op count of the scan step,
+    on CPU it halves the per-step memory traffic. Ineligible inputs
+    (scaled values still too large, oversized cpu milli-values) keep the
+    wide arrays; the engine compiles per-dtype, so both coexist.
+
+    Returns (nt, st, pb, mem_scale)."""
+    mem_arrays = [nt.mem_cap, st.mem_used, st.nz_mem, pb.req_mem,
+                  pb.nz_mem]
+    g = 0
+    for arr in mem_arrays:
+        if arr.size:
+            g = int(np.gcd(int(g), int(np.gcd.reduce(np.abs(arr)))))
+    if g == 0:
+        g = 1
+    # accumulation bound: the scan adds each pod's request into the used
+    # vectors (zero-capacity nodes accept without limit), so the final
+    # sums must stay in range too
+    max_mem = max((int(np.max(np.abs(a))) if a.size else 0)
+                  for a in mem_arrays) // g
+    mem_growth = (int(np.max(pb.req_mem)) // g if pb.req_mem.size else 0) \
+        * max(1, pb.req_mem.shape[0])
+    nz_growth = (int(np.max(pb.nz_mem)) // g if pb.nz_mem.size else 0) \
+        * max(1, pb.nz_mem.shape[0])
+    cpu_arrays = [nt.cpu_cap, st.cpu_used, st.nz_cpu, pb.req_cpu,
+                  pb.nz_cpu]
+    max_cpu = max((int(np.max(np.abs(a))) if a.size else 0)
+                  for a in cpu_arrays)
+    cpu_growth = (int(np.max(pb.req_cpu)) if pb.req_cpu.size else 0) \
+        * max(1, pb.req_cpu.shape[0])
+    max_static = int(np.max(np.abs(nt.static_score))) \
+        if nt.static_score.size else 0
+    # composite = total * n + tie_rank; bound total conservatively
+    n = nt.valid.shape[0]
+    total_bound = (30 * weights_hint + max_static) * max(n, 1)
+    if max(max_mem * 10, max_mem + mem_growth, nz_growth,
+           max_cpu * 10, max_cpu + cpu_growth,
+           total_bound) >= _I32_BOUND:
+        return nt, st, pb, 1
+
+    i32 = np.int32
+    nt = replace(
+        nt, cpu_cap=nt.cpu_cap.astype(i32),
+        mem_cap=(nt.mem_cap // g).astype(i32),
+        static_score=nt.static_score.astype(i32))
+    st = replace(
+        st, cpu_used=st.cpu_used.astype(i32),
+        mem_used=(st.mem_used // g).astype(i32),
+        nz_cpu=st.nz_cpu.astype(i32),
+        nz_mem=(st.nz_mem // g).astype(i32))
+    pb = replace(
+        pb, req_cpu=pb.req_cpu.astype(i32),
+        req_mem=(pb.req_mem // g).astype(i32),
+        nz_cpu=pb.nz_cpu.astype(i32),
+        nz_mem=(pb.nz_mem // g).astype(i32))
+    return nt, st, pb, g
 
 
 def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
@@ -629,7 +702,8 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
                     _selector_matches(sel, pod.metadata.labels):
                 pb.svc_member[j, gid] = 1
 
+    nt, st, pb, mem_scale = _maybe_narrow(nt, st, pb)
     return EncodeResult(
         node_tab=nt, pod_batch=pb, init_state=st, offgrid_max=offgrid_max,
         node_names=[n.metadata.name for n in nodes] + [""] * (n_pad - n_real),
-        n_nodes=n_real, n_pods=p)
+        n_nodes=n_real, n_pods=p, mem_scale=mem_scale)

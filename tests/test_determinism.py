@@ -215,3 +215,52 @@ class TestDeviceProfiling:
         dumped = [os.path.join(dp, f)
                   for dp, _, fs in os.walk(logdir) for f in fs]
         assert dumped, "profiler wrote nothing"
+
+
+class TestDtypeNarrowing:
+    """The i32 gcd-rescale fast path must be bit-identical to the wide
+    path and only trigger when provably exact (tables._maybe_narrow)."""
+
+    def test_narrow_equals_wide(self):
+        import kubernetes_tpu.sched.device.tables as T
+        snap = snapshot(n_nodes=50, n_pods=200, seed=3)
+        enc_n = encode_snapshot(snap)
+        orig = T._maybe_narrow
+        T._maybe_narrow = \
+            lambda nt, st, pb, weights_hint=64: (nt, st, pb, 1)
+        try:
+            enc_w = encode_snapshot(snap)
+        finally:
+            T._maybe_narrow = orig
+        assert enc_n.mem_scale > 1, "fixture should narrow"
+        assert enc_w.mem_scale == 1
+        engine = BatchEngine()
+        a, _ = engine.run(enc_n)
+        b, _ = engine.run(enc_w)
+        assert list(a) == list(b)
+
+    def test_coprime_quantities_stay_wide(self):
+        from kubernetes_tpu.core import types as api
+        from kubernetes_tpu.core.quantity import Quantity
+        nodes = [api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(capacity={
+                "cpu": Quantity(4000),
+                # a prime byte count: gcd collapses to ~1 and the
+                # scaled value exceeds i32 -> wide
+                "memory": Quantity((2**35 + 1) * 1000),
+                "pods": Quantity(10 * 1000)}))]
+        pods = [api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(requests={
+                    "memory": Quantity(3 * 1000)}))]))]
+        enc = encode_snapshot(ClusterSnapshot(nodes=nodes,
+                                              pending_pods=pods))
+        assert enc.mem_scale == 1
+        import numpy as np
+        assert enc.node_tab.mem_cap.dtype == np.int64
+        hosts, _ = BatchEngine().schedule(
+            ClusterSnapshot(nodes=nodes, pending_pods=pods))
+        assert hosts == ["n1"]

@@ -246,3 +246,58 @@ def test_invalid_names_rejected():
                     metadata=api.ObjectMeta(name=bad,
                                             namespace="default")),
                 "default")
+
+
+def test_deleting_tpr_removes_instance_data():
+    """Unmounting a kind deletes its objects (master.go
+    removeThirdPartyStorage) — no resurrection under a re-created TPR."""
+    registry = Registry()
+    registry.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="default")))
+    registry.create("thirdpartyresources", mktpr())
+    registry.third_party_create(
+        "stable.example.com", "lizards",
+        api.ThirdPartyResourceData(
+            metadata=api.ObjectMeta(name="stale", namespace="default"),
+            data={"spec": {"v": 1}}), "default")
+    registry.delete("thirdpartyresources", "lizard.stable.example.com",
+                    "default")
+    # re-creating the TPR must mount an EMPTY kind
+    registry.create("thirdpartyresources", mktpr())
+    items, _ = registry.third_party_list("stable.example.com", "lizards")
+    assert items == []
+
+
+def test_engine_rewidens_for_huge_policy_weights():
+    """The encode-time narrowing assumes bounded weights; an engine
+    with larger ones must re-widen instead of wrapping i32."""
+    import numpy as np
+
+    from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
+                                             encode_snapshot)
+    from kubernetes_tpu.core.quantity import Quantity
+    mi = 1024 * 1024
+    nodes = [api.Node(
+        metadata=api.ObjectMeta(name=f"n{i}"),
+        status=api.NodeStatus(capacity={
+            "cpu": Quantity(4000), "memory": Quantity(1024 * mi * 1000),
+            "pods": Quantity(10 * 1000)})) for i in range(4)]
+    pods = [api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(requests={
+                "cpu": Quantity(100),
+                "memory": Quantity(64 * mi * 1000)}))]))]
+    snap = ClusterSnapshot(nodes=nodes, pending_pods=pods)
+    enc = encode_snapshot(snap)
+    assert enc.node_tab.cpu_cap.dtype == np.int32  # narrowed
+    big = BatchEngine(weights=(1_000_000_000, 1, 1))
+    safe = big._ensure_safe_dtypes(enc)
+    assert safe.node_tab.cpu_cap.dtype == np.int64  # re-widened
+    hosts, _ = big.schedule(snap)
+    assert hosts[0] in {n.metadata.name for n in nodes}
+    # a normal engine keeps the narrow arrays
+    normal = BatchEngine()
+    assert normal._ensure_safe_dtypes(enc).node_tab.cpu_cap.dtype \
+        == np.int32

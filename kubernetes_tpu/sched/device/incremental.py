@@ -51,6 +51,19 @@ class NeedsFullEncode(Exception):
     """Tile needs a feature this encoder doesn't maintain incrementally."""
 
 
+def replace_pod_batch_dtypes(pb: PodArrays, narrow: bool,
+                             mem_scale: int) -> PodArrays:
+    """Narrow a freshly-built pod batch's resource arrays in place
+    (the tile arrays are private to this encode call)."""
+    if not narrow:
+        return pb
+    pb.req_cpu = pb.req_cpu.astype(np.int32)
+    pb.nz_cpu = pb.nz_cpu.astype(np.int32)
+    pb.req_mem = (pb.req_mem // mem_scale).astype(np.int32)
+    pb.nz_mem = (pb.nz_mem // mem_scale).astype(np.int32)
+    return pb
+
+
 def _grow(arr: np.ndarray, axis: int, new_len: int) -> np.ndarray:
     pad = [(0, 0)] * arr.ndim
     pad[axis] = (0, new_len - arr.shape[axis])
@@ -165,6 +178,16 @@ class IncrementalEncoder:
         self.exceed_cpu = np.zeros(self.n_cap, bool)
         self.exceed_mem = np.zeros(self.n_cap, bool)
 
+        # i32 narrowing metadata (tables._maybe_narrow's contract): the
+        # HOST arrays stay raw i64 — only the per-tile device copies are
+        # divided by the running gcd and cast when provably exact. The
+        # gcd is monotone (only shrinks), so no rescaling ever happens.
+        self._mem_gcd = 0
+        self._mem_cap_max = 0
+        self._mem_req_max = 0
+        self._cpu_cap_max = 0
+        self._cpu_req_max = 0
+
         # ---- ledgers --
         self.pods: Dict[str, _PodRecord] = {}
         # per-slot insertion-ordered pod keys (replay order for misfit
@@ -250,6 +273,10 @@ class IncrementalEncoder:
                         self.port_bits = _grow(self.port_bits, 1,
                                                self.ports_dict.words)
                     rec.ports.append(bit)
+        self._note_mem(rec.req_mem, is_cap=False)
+        self._note_mem(rec.nz_mem, is_cap=False)
+        self._cpu_req_max = max(self._cpu_req_max, rec.req_cpu,
+                                rec.nz_cpu)
         for v in pod.spec.volumes:
             keys, gce_ro = _disk_keys(v)
             is_gce = v.gce_persistent_disk is not None
@@ -405,6 +432,9 @@ class IncrementalEncoder:
         cap = node.status.capacity
         self.cpu_cap[slot] = cap["cpu"].milli if "cpu" in cap else 0
         self.mem_cap[slot] = cap["memory"].value if "memory" in cap else 0
+        self._note_mem(int(self.mem_cap[slot]), is_cap=True)
+        self._cpu_cap_max = max(self._cpu_cap_max,
+                                int(self.cpu_cap[slot]))
         self.pod_cap[slot] = cap["pods"].value if "pods" in cap else 0
         self.label_words[slot] = 0
         for kv in node.metadata.labels.items():
@@ -465,6 +495,29 @@ class IncrementalEncoder:
         self.node_names[slot] = name
         self._tie_dirty = True
         return slot
+
+    def _note_mem(self, value: int, is_cap: bool) -> None:
+        if value:
+            import math
+            self._mem_gcd = math.gcd(self._mem_gcd, value)
+        if is_cap:
+            self._mem_cap_max = max(self._mem_cap_max, value)
+        else:
+            self._mem_req_max = max(self._mem_req_max, value)
+
+    def _narrow_params(self, static_max: int):
+        """-> (g, eligible) per tables._maybe_narrow's exactness rules:
+        scaled scores fit i32 with x10 headroom, zero-capacity nodes
+        can absorb a whole tile of requests without overflow, and the
+        composite argmax stays in range for default-scale weights (the
+        engine re-widens itself for larger ones)."""
+        g = self._mem_gcd or 1
+        cap_s = self._mem_cap_max // g
+        req_s = self._mem_req_max // g
+        bound = max((cap_s + 16384 * req_s) * 10,
+                    (self._cpu_cap_max + 16384 * self._cpu_req_max) * 10,
+                    (30 * 64 + static_max) * max(self.n_cap, 1))
+        return g, bound < (1 << 30)
 
     def _grow_nodes(self) -> None:
         # double while small, then step by 1024: a 5000-node cluster pads
@@ -610,6 +663,11 @@ class IncrementalEncoder:
                 pb.req_cpu[j] = req_cpu
                 pb.req_mem[j] = req_mem
                 pb.zero_req[j] = req_cpu == 0 and req_mem == 0
+                # the tile's quantities join the gcd BEFORE this encode
+                # narrows (a gcd-breaking request must keep this and
+                # every later tile exact)
+                self._note_mem(req_mem, is_cap=False)
+                self._cpu_req_max = max(self._cpu_req_max, req_cpu)
                 for c in pod.spec.containers:
                     nz_c, nz_m = get_nonzero_requests(c.resources.requests)
                     pb.nz_cpu[j] += nz_c
@@ -619,6 +677,9 @@ class IncrementalEncoder:
                             # pre-interned by _intern_pending: never grows
                             bit, _ = self.ports_dict.intern(cp.host_port)
                             _set_bit(pb.port_words[j], bit)
+                self._note_mem(int(pb.nz_mem[j]), is_cap=False)
+                self._cpu_req_max = max(self._cpu_req_max,
+                                        int(pb.nz_cpu[j]))
                 for kv in pod.spec.node_selector.items():
                     bit, _ = self.labels_dict.intern(kv)
                     _set_bit(pb.sel_words[j], bit)
@@ -643,11 +704,24 @@ class IncrementalEncoder:
                         pb.member[j, gid] = 1
 
             # ---- views of the persistent state (copied: the reflector
-            # threads keep mutating these arrays while the scan runs) ----
+            # threads keep mutating these arrays while the scan runs).
+            # The host arrays stay raw i64; when the running gcd proves
+            # the i32 rescale exact (tables._maybe_narrow's rules), the
+            # device copies narrow here — same single pass as the copy.
+            static_max = int(np.max(np.abs(self.static_score))) \
+                if self.static_score.size else 0
+            mem_scale, narrow = self._narrow_params(static_max)
+
+            def res(arr, scale=1):
+                if narrow:
+                    return ((arr // scale) if scale != 1 else arr) \
+                        .astype(np.int32)
+                return arr.copy()
+
             nt = NodeArrays(
                 valid=self.valid.copy(),
-                cpu_cap=self.cpu_cap.copy(),
-                mem_cap=self.mem_cap.copy(),
+                cpu_cap=res(self.cpu_cap),
+                mem_cap=res(self.mem_cap, mem_scale),
                 pod_cap=self.pod_cap.copy(),
                 label_words=self.label_words.copy(),
                 tie_rank=self.tie_rank.copy(),
@@ -657,7 +731,7 @@ class IncrementalEncoder:
                 zone_id=np.full(n_pad, -1, np.int32),
                 zone_scratch=np.zeros(1, np.int32),
                 static_mask=self.static_mask.copy(),
-                static_score=self.static_score.copy())
+                static_score=res(self.static_score))
             spread = (np.stack([g.row for g in tile_groups])
                       if tile_groups else np.zeros((1, n_pad), np.int32))
             offgrid_max = np.zeros(G, np.int32)
@@ -665,10 +739,10 @@ class IncrementalEncoder:
                 if g.offgrid:
                     offgrid_max[gid] = max(g.offgrid.values())
             st = StateArrays(
-                cpu_used=self.cpu_used.copy(),
-                mem_used=self.mem_used.copy(),
-                nz_cpu=self.nz_cpu.copy(),
-                nz_mem=self.nz_mem.copy(),
+                cpu_used=res(self.cpu_used),
+                mem_used=res(self.mem_used, mem_scale),
+                nz_cpu=res(self.nz_cpu),
+                nz_mem=res(self.nz_mem, mem_scale),
                 pod_count=self.pod_count.copy(),
                 port_bits=self.port_bits.copy(),
                 disk_any=self.disk_any.copy(),
@@ -678,11 +752,13 @@ class IncrementalEncoder:
                 aff_total=np.zeros(1, np.int32),
                 svc_count=np.zeros((1, n_pad), np.int32),
                 svc_total=np.zeros(1, np.int32))
+            pb = replace_pod_batch_dtypes(pb, narrow, mem_scale)
             return EncodeResult(
                 node_tab=nt, pod_batch=pb, init_state=st,
                 offgrid_max=offgrid_max,
                 node_names=list(self.node_names),
-                n_nodes=len(self.node_slot), n_pods=p)
+                n_nodes=len(self.node_slot), n_pods=p,
+                mem_scale=mem_scale if narrow else 1)
 
     # ================================================== wiring helpers
 

@@ -271,3 +271,52 @@ class TestIncrementalPolicyTiers:
         with pytest.raises(ValueError):
             IncrementalEncoder(policy=DevicePolicy(
                 anti_affinity_label="zone"))
+
+
+class TestIncrementalNarrowing:
+    """The e2e path's i32 narrowing: host arrays stay raw i64, the
+    emitted tile copies narrow under the running gcd, and a late
+    gcd-breaking quantity keeps every tile exact (it can only widen)."""
+
+    def test_tiles_narrow_and_match_full_encoder(self):
+        import numpy as np
+        inc = IncrementalEncoder()
+        nodes = [mk_node(f"n{i}", mem=8 * 1024) for i in range(6)]
+        for n in nodes:
+            inc.on_node_add(n)
+        existing = [mk_pod(f"e{i}", node=f"n{i % 6}", mem=512)
+                    for i in range(4)]
+        for p in existing:
+            inc.on_pod_add(p)
+        pending = [mk_pod(f"p{i}", mem=256, phase="Pending")
+                   for i in range(8)]
+        enc = inc.encode_tile(pending, [], [])
+        assert enc.mem_scale > 1
+        assert enc.node_tab.mem_cap.dtype == np.int32
+        # bindings identical to the full encoder over the same view
+        eng = BatchEngine()
+        got, _ = eng.run(enc)
+        full = encode_snapshot(ClusterSnapshot(
+            nodes=nodes, existing_pods=existing, pending_pods=pending))
+        want, _ = eng.run(full)
+        assert [enc.node_names[i] for i in got[:8]] \
+            == [full.node_names[i] for i in want[:8]]
+
+    def test_gcd_breaking_pod_widens_but_stays_exact(self):
+        import numpy as np
+        inc = IncrementalEncoder()
+        for i in range(4):
+            inc.on_node_add(mk_node(f"n{i}", mem=8 * 1024))
+        enc1 = inc.encode_tile([mk_pod("a", mem=256, phase="Pending")],
+                               [], [])
+        assert enc1.mem_scale > 1
+        # a pod whose raw byte request breaks every useful gcd
+        odd = mk_pod("b", phase="Pending")
+        odd.spec.containers[0].resources.requests["memory"] = Quantity(
+            (7 * 1000))  # 7 bytes
+        enc2 = inc.encode_tile([odd], [], [])
+        assert enc2.mem_scale == 1
+        assert enc2.node_tab.mem_cap.dtype == np.int64
+        eng = BatchEngine()
+        got, _ = eng.run(enc2)
+        assert enc2.node_names[int(got[0])].startswith("n")

@@ -53,7 +53,9 @@ def terminate(proc):
     if proc.poll() is None:
         proc.send_signal(signal.SIGTERM)
         try:
-            proc.wait(timeout=30)
+            # generous: under full-suite load XLA compiles can hog every
+            # core while a component unwinds
+            proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10)

@@ -34,6 +34,7 @@ O(log) times over a cluster's life, not per tile.
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -498,24 +499,34 @@ class IncrementalEncoder:
 
     def _note_mem(self, value: int, is_cap: bool) -> None:
         if value:
-            import math
             self._mem_gcd = math.gcd(self._mem_gcd, value)
         if is_cap:
             self._mem_cap_max = max(self._mem_cap_max, value)
         else:
             self._mem_req_max = max(self._mem_req_max, value)
 
-    def _narrow_params(self, static_max: int):
+    def _narrow_params(self, static_max: int, tile_len: int):
         """-> (g, eligible) per tables._maybe_narrow's exactness rules:
-        scaled scores fit i32 with x10 headroom, zero-capacity nodes
-        can absorb a whole tile of requests without overflow, and the
-        composite argmax stays in range for default-scale weights (the
-        engine re-widens itself for larger ones)."""
+        scaled scores fit i32 with x10 headroom, the already-accumulated
+        running sums (measured from the arrays — zero-capacity nodes
+        accumulate without a misfit gate, and nz sums grow on every
+        node) plus this tile's worst-case additions stay in range, and
+        the composite argmax fits for default-scale weights (the engine
+        re-widens itself for larger ones)."""
         g = self._mem_gcd or 1
+
+        def amax(arr):
+            return int(arr.max()) if arr.size else 0
+
         cap_s = self._mem_cap_max // g
         req_s = self._mem_req_max // g
-        bound = max((cap_s + 16384 * req_s) * 10,
-                    (self._cpu_cap_max + 16384 * self._cpu_req_max) * 10,
+        mem_base = max(cap_s, amax(self.mem_used) // g,
+                       amax(self.nz_mem) // g)
+        cpu_base = max(self._cpu_cap_max, amax(self.cpu_used),
+                       amax(self.nz_cpu))
+        tiles = max(tile_len, 1)
+        bound = max((mem_base + tiles * req_s) * 10,
+                    (cpu_base + tiles * self._cpu_req_max) * 10,
                     (30 * 64 + static_max) * max(self.n_cap, 1))
         return g, bound < (1 << 30)
 
@@ -710,7 +721,7 @@ class IncrementalEncoder:
             # device copies narrow here — same single pass as the copy.
             static_max = int(np.max(np.abs(self.static_score))) \
                 if self.static_score.size else 0
-            mem_scale, narrow = self._narrow_params(static_max)
+            mem_scale, narrow = self._narrow_params(static_max, p_pad)
 
             def res(arr, scale=1):
                 if narrow:

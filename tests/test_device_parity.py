@@ -209,3 +209,36 @@ def test_engine_sharded_matches_unsharded():
     sharded = BatchEngine(mesh=mesh).schedule(snap)[0]
     assert sharded == schedule_batch(snap)
     assert sharded == oracle_schedule(snap)
+
+
+def test_engine_sharded_narrowed_matches_oracle():
+    """The i32-narrowed arrays shard over the mesh identically (the
+    NamedSharding specs are dtype-agnostic; the ICI argmax reduces i32
+    composites the same way)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    # gcd-friendly quantities so narrowing triggers
+    nodes = [make_node(f"n-{i:02d}", 4000, (8 + 8 * (i % 3)) * 1024 * MI,
+                       20, labels={"zone": f"z{i % 3}"})
+             for i in range(16)]
+    pods = [api.Pod(
+        metadata=api.ObjectMeta(name=f"p-{j:02d}", namespace="default",
+                                labels={"app": "web"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(requests={
+                "cpu": mq(250), "memory": bq(256 * MI)}))]))
+        for j in range(40)]
+    svcs = [api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(selector={"app": "web"}))]
+    snap = ClusterSnapshot(nodes=nodes, services=svcs, pending_pods=pods)
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engine = BatchEngine(mesh=mesh)
+    sharded, enc = engine.schedule(snap)
+    assert enc.node_tab.mem_cap.dtype == np.int32  # narrowing active
+    assert sharded == schedule_batch(snap)
+    assert sharded == oracle_schedule(snap)

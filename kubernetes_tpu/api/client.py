@@ -76,6 +76,12 @@ class Client:
         """GET a kubelet-server path through the node proxy."""
         raise NotImplementedError
 
+    def pod_logs_stream(self, name: str, namespace: str = "default",
+                        container: str = ""):
+        """Follow a container's log (kubectl logs -f): yields text
+        pieces until the container exits or the caller stops."""
+        raise NotImplementedError
+
 
 class InProcClient(Client):
     def __init__(self, registry: Registry):
@@ -137,6 +143,22 @@ class InProcClient(Client):
         exec_admission(self.registry, path)
         base = kubelet_base_for(self.registry, node_name)
         return fetch_kubelet(f"{base}/{path}")
+
+    def pod_logs_stream(self, name, namespace="default", container=""):
+        from .relay import (iter_http_stream, kubelet_base_for,
+                            open_kubelet_stream)
+        pod = self.registry.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {name!r} is not scheduled yet")
+        if not container:
+            if len(pod.spec.containers) > 1:
+                raise BadRequest(
+                    f"pod {name!r} has several containers; name one")
+            container = pod.spec.containers[0].name
+        base = kubelet_base_for(self.registry, pod.spec.node_name)
+        url = (f"{base}/containerLogs/{namespace}/{name}/{container}"
+               f"?follow=true")
+        return iter_http_stream(open_kubelet_stream(url))
 
     def finalize_namespace(self, obj):
         return self.registry.finalize_namespace(obj)
@@ -331,6 +353,12 @@ class HttpClient(Client):
             return resp.read().decode()
         finally:
             resp.close()
+
+    def pod_logs_stream(self, name, namespace="default", container=""):
+        from .relay import iter_http_stream
+        url = self._url("pods", namespace, name, "log",
+                        {"container": container, "follow": "true"})
+        return iter_http_stream(self._do("GET", url, stream=True))
 
     def node_proxy(self, node_name: str, path: str) -> bytes:
         """GET through the apiserver's node proxy

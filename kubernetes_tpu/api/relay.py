@@ -52,6 +52,33 @@ def fetch_kubelet_response(url: str, timeout: float = 30.0):
         raise BadGateway(f"kubelet unreachable: {e}")
 
 
+def open_kubelet_stream(url: str):
+    """Open a follow-stream to the kubelet with the relay's error
+    mapping (404 -> NotFound, transport -> 502); caller closes."""
+    try:
+        return urllib.request.urlopen(url, timeout=None)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            raise NotFound(e.read().decode(errors="replace"))
+        raise BadGateway(f"kubelet answered {e.code}")
+    except (urllib.error.URLError, OSError) as e:
+        raise BadGateway(f"kubelet unreachable: {e}")
+
+
+def iter_http_stream(resp):
+    """Yield decoded text pieces from a live HTTP response as they
+    arrive (read1: return as soon as ANY data is buffered — a plain
+    read(n) would block until n bytes amass, defeating `logs -f`)."""
+    try:
+        while True:
+            data = resp.read1(65536)
+            if not data:
+                return
+            yield data.decode(errors="replace")
+    finally:
+        resp.close()
+
+
 def kubelet_base_for(registry, node_name: str) -> str:
     """Resolve a node's kubelet base URL from the registry, mapping a
     missing endpoint to NotFound."""

@@ -142,6 +142,7 @@ class ApiServer:
         # limit, or thousands of agents' watches would starve every other
         # request (ref: pkg/apiserver/handlers.go longRunningRequestRE).
         long_running = (query.get("watch") in ("true", "1")
+                        or query.get("follow") in ("true", "1")
                         or "/watch/" in path or path.endswith("/watch"))
         if not long_running and not self._inflight.acquire(blocking=False):
             self._send_error(h, TooManyRequests("too many requests in flight"))
@@ -465,10 +466,47 @@ class ApiServer:
                     f"pod {name!r} has several containers; "
                     f"set ?container=")
             container = pod.spec.containers[0].name
-        q = f"?tailLines={query['tailLines']}" if "tailLines" in query else ""
+        params = {k: query[k] for k in ("tailLines", "follow")
+                  if k in query}
+        q = ("?" + urllib.parse.urlencode(params)) if params else ""
         base = self._kubelet_base(pod.spec.node_name)
-        self._relay(
-            h, f"{base}/containerLogs/{namespace}/{name}/{container}{q}")
+        url = f"{base}/containerLogs/{namespace}/{name}/{container}{q}"
+        if query.get("follow") in ("true", "1"):
+            return self._relay_stream(h, url)
+        self._relay(h, url)
+
+    def _relay_stream(self, h, url: str) -> None:
+        """Streaming relay (follow logs): pieces copied through as they
+        arrive (read1 — a full read(n) would buffer until n bytes amass
+        and the follower would see nothing until exit)."""
+        import urllib.error
+        import urllib.request
+        try:
+            upstream = urllib.request.urlopen(url, timeout=None)
+        except urllib.error.HTTPError as e:
+            return self._send_raw(h, e.code, e.read(), "text/plain")
+        except (urllib.error.URLError, OSError) as e:
+            raise BadGateway(f"kubelet unreachable: {e}")
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            while True:
+                data = upstream.read1(65536)
+                if not data:
+                    break
+                h.wfile.write(f"{len(data):x}\r\n".encode())
+                h.wfile.write(data + b"\r\n")
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # a broken upstream mid-stream cannot emit a valid
+            # terminator: drop the connection so the follower gets EOF
+            # instead of hanging on a keep-alive socket
+            h.close_connection = True
+        finally:
+            upstream.close()
 
     def _proxy_node(self, h, node_name: str, rest: str,
                     raw_query: str) -> None:

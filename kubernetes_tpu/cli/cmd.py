@@ -109,6 +109,7 @@ def build_parser() -> argparse.ArgumentParser:
     lg = sub.add_parser("logs", help="print container logs")
     lg.add_argument("pod")
     lg.add_argument("container", nargs="?", default="")
+    lg.add_argument("-f", "--follow", action="store_true")
 
     ex = sub.add_parser("exec", help="execute a command in a container")
     ex.add_argument("pod")
@@ -482,11 +483,18 @@ class Kubectl:
         self.client.create("horizontalpodautoscalers", hpa, ns)
         self.out.write(f"horizontalpodautoscalers/{name} autoscaled\n")
 
-    def logs(self, ns, pod_name, container="") -> None:
+    def logs(self, ns, pod_name, container="", follow=False) -> None:
         """Stream from the node's kubelet via the pod log subresource
         (the kubelet log endpoint, server.go:242). Nodes that serve no
         kubelet endpoint fall back to a container-state summary."""
         try:
+            if follow:
+                for piece in self.client.pod_logs_stream(
+                        pod_name, ns, container):
+                    self.out.write(piece)
+                    if hasattr(self.out, "flush"):
+                        self.out.flush()
+                return
             self.out.write(self.client.pod_logs(pod_name, ns, container))
             return
         except (NotFound, NotImplementedError, KeyError):
@@ -605,7 +613,8 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
             k.autoscale(ns, ns_args.args, ns_args.min, ns_args.max,
                         ns_args.cpu_percent)
         elif ns_args.command == "logs":
-            k.logs(ns, ns_args.pod, ns_args.container)
+            k.logs(ns, ns_args.pod, ns_args.container,
+                   follow=ns_args.follow)
         elif ns_args.command == "exec":
             return k.exec_cmd(ns, ns_args.pod, ns_args.container,
                               ns_args.cmd)

@@ -166,9 +166,56 @@ class KubeletServer:
         ns, pod_name, container = self._split_target(path, "/containerLogs/")
         pod = self._find_pod(ns, pod_name)
         tail = int(query.get("tailLines", ["0"])[0])
+        follow = query.get("follow", ["false"])[0] in ("true", "1")
+        if follow and hasattr(self.runtime, "container_log_path"):
+            return self._follow_logs(h, pod.metadata.uid, container, tail)
         text = self.runtime.get_container_logs(pod.metadata.uid, container,
                                                tail_lines=tail)
         self._raw(h, 200, text.encode(), "text/plain")
+
+    def _follow_logs(self, h, uid: str, container: str,
+                     tail: int) -> None:
+        """?follow=true: chunked tail -f of the captured log until the
+        container exits (ref: server.go containerLogs + the docker
+        follow stream; runtimes expose container_log_path)."""
+        import time as _time
+
+        log_path = self.runtime.container_log_path(uid, container)
+        h.send_response(200)
+        h.send_header("Content-Type", "text/plain")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def chunk(payload: bytes) -> None:
+            h.wfile.write(f"{len(payload):x}\r\n".encode())
+            h.wfile.write(payload + b"\r\n")
+            h.wfile.flush()
+
+        try:
+            with open(log_path, "rb") as f:
+                if tail > 0:
+                    head = f.read().decode(errors="replace")
+                    from .container import tail_text
+                    payload = tail_text(head, tail).encode()
+                    if payload:  # an empty chunk IS the terminator
+                        chunk(payload)
+                while True:
+                    data = f.read(65536)
+                    if data:
+                        chunk(data)
+                        continue
+                    if not self.runtime.container_running(uid, container):
+                        # one final read: output written between the
+                        # empty read and the exit check must not race
+                        # away
+                        data = f.read(65536)
+                        if data:
+                            chunk(data)
+                        break
+                    _time.sleep(0.2)
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            h.close_connection = True
 
     def _exec(self, h, path: str, query: dict) -> None:
         ns, pod_name, container = self._split_target(path, "/exec/")

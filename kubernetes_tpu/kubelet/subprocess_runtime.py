@@ -121,6 +121,20 @@ class SubprocessRuntime(Runtime):
                 del self._procs[key]
             self._pods.pop(pod_uid, None)
 
+    def container_log_path(self, pod_uid: str, name: str) -> str:
+        """The captured log file (the follow-stream seam the kubelet
+        server tails for ?follow=true)."""
+        with self._lock:
+            proc = self._procs.get((pod_uid, name))
+        if proc is None:
+            raise KeyError(f"container {name!r} not found")
+        return proc.log_path
+
+    def container_running(self, pod_uid: str, name: str) -> bool:
+        with self._lock:
+            proc = self._procs.get((pod_uid, name))
+        return proc is not None and proc.popen.poll() is None
+
     def get_container_logs(self, pod_uid: str, name: str,
                            tail_lines: int = 0) -> str:
         with self._lock:

@@ -168,3 +168,67 @@ class TestKubeletWithSubprocessRuntime:
             kubelet.stop()
             for rp in runtime.get_pods():
                 runtime.kill_pod(rp.uid)
+
+
+def test_follow_logs_streams_live_output(tmp_path):
+    """kubectl logs -f: the kubelet server tails the captured file in a
+    chunked stream until the container exits (server.go containerLogs
+    follow; our runtime exposes the log path)."""
+    import io
+    import threading
+
+    from kubernetes_tpu.api.client import HttpClient
+    from kubernetes_tpu.api.server import ApiServer
+    from kubernetes_tpu.kubelet.server import KubeletServer
+
+    registry = Registry()
+    client = InProcClient(registry)
+    rt = SubprocessRuntime(root_dir=str(tmp_path))
+    kubelet = Kubelet(client, "n1", runtime=rt).run()
+    ks = KubeletServer("n1", kubelet.get_pods, rt, lambda: {}).start()
+    apiserver = ApiServer(registry).start()
+    http = HttpClient(apiserver.url)
+    try:
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            status=api.NodeStatus(
+                addresses=[api.NodeAddress(type="InternalIP",
+                                           address="127.0.0.1")],
+                daemon_endpoints=api.NodeDaemonEndpoints(
+                    kubelet_endpoint=api.DaemonEndpoint(port=ks.port)))))
+        # three lines over ~0.6s, then exit: the follow stream must see
+        # all of them and then terminate on its own
+        client.create("pods", mkpod(
+            "ticker", "",
+            ["sh", "-c",
+             "for i in 1 2 3; do echo tick-$i; sleep 0.2; done"],
+            restart_policy="Never"), "default")
+        assert wait_until(lambda: any(
+            rp.name == "ticker" for rp in rt.get_pods()))
+
+        pieces = []
+        done = threading.Event()
+
+        def follow():
+            for piece in http.pod_logs_stream("ticker", "default"):
+                pieces.append(piece)
+            done.set()
+
+        threading.Thread(target=follow, daemon=True).start()
+        assert done.wait(timeout=30), "follow stream never terminated"
+        text = "".join(pieces)
+        assert "tick-1" in text and "tick-3" in text
+
+        # the CLI -f plumbing end to end
+        out = io.StringIO()
+        from kubernetes_tpu.cli.cmd import Kubectl
+        Kubectl(http, out=out).logs("default", "ticker", follow=True)
+        assert "tick-3" in out.getvalue()
+    finally:
+        apiserver.stop()
+        ks.stop()
+        kubelet.stop()
+        for rp in rt.get_pods():
+            rt.kill_pod(rp.uid)

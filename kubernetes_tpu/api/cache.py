@@ -229,7 +229,13 @@ class Reflector:
                     self.on_update(old, obj)
         self._known = prev = new_known  # aliased: the watch loop mutates it
 
-        w = self.client.watch(self.resource, self.namespace, since_rev=rev)
+        # selectors ride to the server: the store filters watch events
+        # before they ever reach this watcher's queue (the client-side
+        # _matches check stays — plain Watchers from tests and fakes
+        # deliver unfiltered streams)
+        w = self.client.watch(self.resource, self.namespace, since_rev=rev,
+                              label_selector=self.label_selector,
+                              field_selector=self.field_selector)
         self._watcher = w
         while not self._stop.is_set():
             ev = w.next(timeout=1.0)

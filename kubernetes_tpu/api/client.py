@@ -51,7 +51,8 @@ class Client:
         raise NotImplementedError
 
     def watch(self, resource: str, namespace: str = "",
-              since_rev: Optional[int] = None) -> Watcher:
+              since_rev: Optional[int] = None, label_selector: str = "",
+              field_selector: str = "") -> Watcher:
         raise NotImplementedError
 
     def bind(self, binding: api.Binding, namespace: str = "") -> Any:
@@ -106,8 +107,10 @@ class InProcClient(Client):
     def delete(self, resource, name, namespace=""):
         return self.registry.delete(resource, name, namespace)
 
-    def watch(self, resource, namespace="", since_rev=None):
-        return self.registry.watch(resource, namespace, since_rev)
+    def watch(self, resource, namespace="", since_rev=None,
+              label_selector="", field_selector=""):
+        return self.registry.watch(resource, namespace, since_rev,
+                                   label_selector, field_selector)
 
     def bind(self, binding, namespace=""):
         return self.registry.bind(binding, namespace)
@@ -119,21 +122,10 @@ class InProcClient(Client):
                  tail_lines=0):
         # even in-proc, the kubelet is across the network: resolve the
         # node's daemon endpoint and fetch (same relay ApiServer does)
-        from .relay import fetch_kubelet, kubelet_base_for
-        pod = self.registry.get("pods", name, namespace)
-        if not pod.spec.node_name:
-            raise BadRequest(f"pod {name!r} is not scheduled yet")
-        if not container:
-            if len(pod.spec.containers) > 1:
-                # match the HTTP path (ApiServer._serve_pod_log)
-                raise BadRequest(
-                    f"pod {name!r} has several containers; name one")
-            container = pod.spec.containers[0].name
-        base = kubelet_base_for(self.registry, pod.spec.node_name)
-        url = (f"{base}/containerLogs/"
-               f"{namespace}/{name}/{container}")
-        if tail_lines:
-            url += f"?tailLines={tail_lines}"
+        from .relay import container_log_url, fetch_kubelet
+        url = container_log_url(
+            self.registry, namespace, name, container,
+            f"tailLines={tail_lines}" if tail_lines else "")
         return fetch_kubelet(url).decode()
 
     def node_proxy(self, node_name, path):
@@ -145,19 +137,10 @@ class InProcClient(Client):
         return fetch_kubelet(f"{base}/{path}")
 
     def pod_logs_stream(self, name, namespace="default", container=""):
-        from .relay import (iter_http_stream, kubelet_base_for,
+        from .relay import (container_log_url, iter_http_stream,
                             open_kubelet_stream)
-        pod = self.registry.get("pods", name, namespace)
-        if not pod.spec.node_name:
-            raise BadRequest(f"pod {name!r} is not scheduled yet")
-        if not container:
-            if len(pod.spec.containers) > 1:
-                raise BadRequest(
-                    f"pod {name!r} has several containers; name one")
-            container = pod.spec.containers[0].name
-        base = kubelet_base_for(self.registry, pod.spec.node_name)
-        url = (f"{base}/containerLogs/{namespace}/{name}/{container}"
-               f"?follow=true")
+        url = container_log_url(self.registry, namespace, name, container,
+                                "follow=true")
         return iter_http_stream(open_kubelet_stream(url))
 
     def finalize_namespace(self, obj):
@@ -301,9 +284,12 @@ class HttpClient(Client):
         ns = namespace or "default"
         return self._decode(self._do("DELETE", self._url(resource, ns, name)))
 
-    def watch(self, resource, namespace="", since_rev=None):
+    def watch(self, resource, namespace="", since_rev=None,
+              label_selector="", field_selector=""):
         url = self._url(resource, namespace, query={
             "watch": "true",
+            "labelSelector": label_selector,
+            "fieldSelector": field_selector,
             "resourceVersion": "" if since_rev is None else str(since_rev)})
         split = urllib.parse.urlsplit(url)
         conn = http.client.HTTPConnection(split.hostname, split.port)

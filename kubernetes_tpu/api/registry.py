@@ -46,7 +46,13 @@ _uid_lock = threading.Lock()
 
 def _new_uid() -> str:
     with _uid_lock:
-        return str(uuid.UUID(int=_uid_rng.getrandbits(128), version=4))
+        bits = _uid_rng.getrandbits(128)
+    # format the RFC-4122 v4 shape directly: uuid.UUID's field validation
+    # plus __str__ was ~7us per create under the 30-writer benchmark load
+    bits = (bits & ~(0xF << 76)) | (0x4 << 76)   # version nibble
+    bits = (bits & ~(0x3 << 62)) | (0x2 << 62)   # variant bits
+    h = "%032x" % bits
+    return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
 def _name_suffix(n: int = 5) -> str:
@@ -677,12 +683,28 @@ class Registry:
         return out
 
     def watch(self, resource: str, namespace: str = "",
-              since_rev: Optional[int] = None) -> Watcher:
+              since_rev: Optional[int] = None, label_selector: str = "",
+              field_selector: str = "") -> Watcher:
         if resource == "componentstatuses":
             # computed per request, not stored: a watch would hang
             # forever with zero events (the reference rejects it too)
             raise MethodNotSupported("componentstatuses is not watchable")
-        return self.store.watch(self.prefix(resource, namespace), since_rev)
+        pred = None
+        if label_selector or field_selector:
+            # server-side watch filtering (the apiserver filters before
+            # the wire; transition semantics live in store._filtered_event)
+            info = self.info(resource)
+            lsel = labelspkg.parse(label_selector) if label_selector else None
+            fsel = fieldspkg.parse(field_selector) if field_selector else None
+
+            def pred(o: Any) -> bool:
+                if lsel is not None and not lsel.matches(o.metadata.labels):
+                    return False
+                if fsel is not None and not fsel.matches(info.fields_fn(o)):
+                    return False
+                return True
+        return self.store.watch(self.prefix(resource, namespace), since_rev,
+                                predicate=pred)
 
     # ------------------------------------------------- binding subresource
 

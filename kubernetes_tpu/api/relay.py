@@ -52,12 +52,21 @@ def fetch_kubelet_response(url: str, timeout: float = 30.0):
         raise BadGateway(f"kubelet unreachable: {e}")
 
 
-def open_kubelet_stream(url: str):
-    """Open a follow-stream to the kubelet with the relay's error
-    mapping (404 -> NotFound, transport -> 502); caller closes."""
+def open_kubelet_stream(url: str, verbatim_errors: bool = False):
+    """Open a follow-stream to the kubelet; caller closes.
+
+    verbatim_errors=False (in-proc clients): typed error mapping —
+    404 -> NotFound, other HTTP errors -> 502.
+    verbatim_errors=True (the ApiServer's HTTP relay): kubelet HTTP
+    statuses return as the response object itself (HTTPError doubles as
+    one) so the proxy can pass status + body through untouched, exactly
+    like its non-follow _relay path. Transport failures are 502 both
+    ways."""
     try:
         return urllib.request.urlopen(url, timeout=None)
     except urllib.error.HTTPError as e:
+        if verbatim_errors:
+            return e
         if e.code == 404:
             raise NotFound(e.read().decode(errors="replace"))
         raise BadGateway(f"kubelet answered {e.code}")
@@ -89,3 +98,27 @@ def kubelet_base_for(registry, node_name: str) -> str:
         return kubelet_base_url(node)
     except KeyError as e:
         raise NotFound(str(e))
+
+
+def container_log_url(registry, namespace: str, name: str,
+                      container: str = "", query: str = "") -> str:
+    """Resolve a pod's kubelet containerLogs URL: scheduled-check,
+    single-container defaulting, daemon-endpoint lookup. The one
+    implementation behind the in-proc client (plain + streaming) and the
+    ApiServer's log relay — container defaulting must not drift between
+    those paths.
+
+    query: pre-encoded query string without the '?' (e.g. 'follow=true')."""
+    from ..core.errors import BadRequest
+
+    pod = registry.get("pods", name, namespace)
+    if not pod.spec.node_name:
+        raise BadRequest(f"pod {name!r} is not scheduled yet")
+    if not container:
+        if len(pod.spec.containers) > 1:
+            raise BadRequest(
+                f"pod {name!r} has several containers; name one")
+        container = pod.spec.containers[0].name
+    base = kubelet_base_for(registry, pod.spec.node_name)
+    url = f"{base}/containerLogs/{namespace}/{name}/{container}"
+    return url + (f"?{query}" if query else "")

@@ -456,37 +456,54 @@ class ApiServer:
 
     def _serve_pod_log(self, h, namespace: str, name: str,
                        query: dict) -> None:
-        pod = self.registry.get("pods", name, namespace)
-        if not pod.spec.node_name:
-            raise BadRequest(f"pod {name!r} is not scheduled yet")
-        container = query.get("container", "")
-        if not container:
-            if len(pod.spec.containers) > 1:
-                raise BadRequest(
-                    f"pod {name!r} has several containers; "
-                    f"set ?container=")
-            container = pod.spec.containers[0].name
+        from .relay import container_log_url
         params = {k: query[k] for k in ("tailLines", "follow")
                   if k in query}
-        q = ("?" + urllib.parse.urlencode(params)) if params else ""
-        base = self._kubelet_base(pod.spec.node_name)
-        url = f"{base}/containerLogs/{namespace}/{name}/{container}{q}"
+        url = container_log_url(self.registry, namespace, name,
+                                query.get("container", ""),
+                                urllib.parse.urlencode(params))
         if query.get("follow") in ("true", "1"):
             return self._relay_stream(h, url)
         self._relay(h, url)
 
     def _relay_stream(self, h, url: str) -> None:
         """Streaming relay (follow logs): pieces copied through as they
-        arrive (read1 — a full read(n) would buffer until n bytes amass
-        and the follower would see nothing until exit)."""
-        import urllib.error
-        import urllib.request
-        try:
-            upstream = urllib.request.urlopen(url, timeout=None)
-        except urllib.error.HTTPError as e:
-            return self._send_raw(h, e.code, e.read(), "text/plain")
-        except (urllib.error.URLError, OSError) as e:
-            raise BadGateway(f"kubelet unreachable: {e}")
+        arrive (relay.open_kubelet_stream carries the shared error
+        mapping, so a kubelet 404 surfaces as the same typed NotFound
+        the in-proc path raises)."""
+        import select
+        from .relay import open_kubelet_stream
+        # transport failures raise BadGateway (JSON status); kubelet HTTP
+        # statuses pass through verbatim like the non-follow _relay path
+        upstream = open_kubelet_stream(url, verbatim_errors=True)
+        code = getattr(upstream, "status", getattr(upstream, "code", 200))
+        if code != 200:
+            body = upstream.read()
+            upstream.close()
+            return self._send_raw(h, code, body, "text/plain")
+        # Disconnect watchdog: with a quiet container nothing is ever
+        # written downstream, so a vanished follower would otherwise pin
+        # this thread in upstream.read1 forever. The follower sends no
+        # bytes after its GET — a readable client socket means EOF/reset;
+        # closing upstream unblocks the read loop.
+        gone = threading.Event()
+
+        def watchdog():
+            while not gone.is_set():
+                try:
+                    readable, _, _ = select.select([h.connection], [], [],
+                                                   0.5)
+                except (ValueError, OSError):
+                    return  # handler already closed the client socket
+                if readable and not gone.is_set():
+                    try:
+                        upstream.close()
+                    except Exception:
+                        pass
+                    return
+
+        threading.Thread(target=watchdog, daemon=True,
+                         name="log-relay-watchdog").start()
         try:
             h.send_response(200)
             h.send_header("Content-Type", "text/plain")
@@ -500,12 +517,13 @@ class ApiServer:
                 h.wfile.write(data + b"\r\n")
                 h.wfile.flush()
             h.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError, OSError):
-            # a broken upstream mid-stream cannot emit a valid
-            # terminator: drop the connection so the follower gets EOF
-            # instead of hanging on a keep-alive socket
+        except (BrokenPipeError, ConnectionResetError, ValueError, OSError):
+            # broken upstream or watchdog-closed stream: no valid
+            # terminator possible — drop the connection so the follower
+            # gets EOF instead of hanging on a keep-alive socket
             h.close_connection = True
         finally:
+            gone.set()
             upstream.close()
 
     def _proxy_node(self, h, node_name: str, rest: str,
@@ -530,7 +548,9 @@ class ApiServer:
     def _serve_watch(self, h, resource: str, namespace: str, query: dict) -> None:
         rv = query.get("resourceVersion")
         since_rev = int(rv) if rv not in (None, "") else None
-        watcher = self.registry.watch(resource, namespace, since_rev)
+        watcher = self.registry.watch(resource, namespace, since_rev,
+                                      query.get("labelSelector", ""),
+                                      query.get("fieldSelector", ""))
         self.metrics.inc("apiserver_watch_count", {"resource": resource})
         if self._wants_websocket(h):
             return self._serve_watch_websocket(h, watcher)

@@ -392,14 +392,64 @@ class NativeStore:
         return out
 
     def watch(self, prefix: str, since_rev: Optional[int] = None,
-              capacity: int = 100_000) -> watchpkg.Watcher:
+              capacity: int = 100_000,
+              predicate=None) -> watchpkg.Watcher:
         start_rev = (self.current_revision if since_rev is None
                      else since_rev)
+        # Membership snapshot for the filter seed, taken BEFORE the
+        # replay read: any write landing between the two shows up in
+        # replay (or the pump), so its key is excluded from the seed and
+        # tracked from its events instead — never seeded stale.
+        snapshot = (self.list(prefix)[0] if predicate is not None else [])
         replay = self._events_since(start_rev, prefix)  # raises Expired
         w = watchpkg.Watcher(max(capacity, len(replay) + 16))
+
+        # Filtered-watch transition semantics (Store._filtered_event's
+        # contract) without prev objects on the wire: track each key's
+        # last predicate result — entering the selector surfaces as
+        # ADDED, leaving it as DELETED with the current object. Keys
+        # untouched by the replay are seeded exactly from the snapshot
+        # (their objects haven't changed since start_rev); keys first
+        # seen mid-stream with no seed resolve conservatively: a
+        # leave-event delivers DELETED (suppressing it would strand
+        # stale cache entries) and a matching MODIFIED delivers ADDED —
+        # both are the duplicate-tolerant direction for reflectors, the
+        # same bias the reference's watch cache has when it replays its
+        # window as init ADDED events (pkg/storage/cacher.go).
+        known: dict = {}
+        if predicate is not None:
+            touched = {(o.metadata.namespace, o.metadata.name)
+                       for _rev, _etype, o in replay}
+            for obj in snapshot:
+                k = (obj.metadata.namespace, obj.metadata.name)
+                if k not in touched:
+                    known[k] = predicate(obj)
+
+        def mapped(etype: str, obj) -> Optional[watchpkg.Event]:
+            if predicate is None:
+                return watchpkg.Event(etype, obj)
+            key = (obj.metadata.namespace, obj.metadata.name)
+            was = known.get(key)          # True / False / None (unknown)
+            if etype == watchpkg.DELETED:
+                known.pop(key, None)
+                return None if was is False else watchpkg.Event(etype, obj)
+            match_new = predicate(obj)
+            known[key] = match_new
+            if match_new:
+                if was is True and etype != watchpkg.ADDED:
+                    return watchpkg.Event(watchpkg.MODIFIED, obj)
+                return watchpkg.Event(watchpkg.ADDED, obj)
+            if was is False:
+                return None
+            if was is None and etype == watchpkg.ADDED:
+                return None               # created non-matching: never seen
+            return watchpkg.Event(watchpkg.DELETED, obj)
+
         last = start_rev
         for rev, etype, obj in replay:
-            w.send(watchpkg.Event(etype, obj))
+            ev = mapped(etype, obj)
+            if ev is not None:
+                w.send(ev)
             last = rev
 
         def pump(last_rev: int) -> None:
@@ -417,7 +467,8 @@ class NativeStore:
                     w.stop()
                     return
                 for rev, etype, obj in events:
-                    if not w.send(watchpkg.Event(etype, obj)):
+                    ev = mapped(etype, obj)
+                    if ev is not None and not w.send(ev):
                         w.stop()
                         return
                     last_rev = rev

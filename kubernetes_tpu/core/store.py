@@ -54,7 +54,9 @@ class Store:
         # sliding watch window: deque of (rev, event_type, key, obj, prev_obj)
         self._history: deque = deque(maxlen=window)
         self._oldest_rev = 0  # smallest rev still replayable + its predecessor
-        self._watchers: List[Tuple[str, "watchpkg.Watcher"]] = []
+        # (prefix, server-side predicate | None, watcher)
+        self._watchers: List[Tuple[str, Optional[Callable[[Any], bool]],
+                                   "watchpkg.Watcher"]] = []
         # min-heap of (expiry, key) for TTL'd entries only, so GC cost is
         # O(expired) per write instead of a full-store scan (only events
         # carry TTLs; pods/nodes must not pay for them)
@@ -82,15 +84,45 @@ class Store:
         self._history.append((rev, etype, key, obj, prev))
         return watchpkg.Event(etype, obj)
 
-    def _fanout(self, items: List[Tuple[str, watchpkg.Event]]) -> None:
+    @staticmethod
+    def _filtered_event(ev: watchpkg.Event, prev: Any,
+                        pred: Callable[[Any], bool]
+                        ) -> Optional[watchpkg.Event]:
+        """Map one committed event through a watch predicate with the
+        reference's filtered-watch transition semantics
+        (pkg/storage/etcd/etcd_watcher.go sendModify): an object
+        entering the selector surfaces as ADDED, one leaving it as
+        DELETED (carrying the current object), and non-matching events
+        are suppressed entirely — the watcher's queue never sees them."""
+        if ev.type != watchpkg.MODIFIED:
+            return ev if pred(ev.object) else None
+        match_new = pred(ev.object)
+        match_old = prev is not None and pred(prev)
+        if match_new:
+            return ev if match_old else watchpkg.Event(watchpkg.ADDED,
+                                                       ev.object)
+        if match_old:
+            return watchpkg.Event(watchpkg.DELETED, ev.object)
+        return None
+
+    def _fanout(self, items: List[Tuple[str, watchpkg.Event, Any]]) -> None:
         """Deliver committed events to watchers — one send per watcher
         when the batch has more than one event — and sweep the dead."""
         dead = []
-        for i, (prefix, w) in enumerate(self._watchers):
+        for i, (prefix, pred, w) in enumerate(self._watchers):
             if w.stopped:
                 dead.append(i)
                 continue
-            evs = [ev for key, ev in items if key.startswith(prefix)]
+            if pred is None:
+                evs = [ev for key, ev, _prev in items
+                       if key.startswith(prefix)]
+            else:
+                evs = []
+                for key, ev, prev in items:
+                    if key.startswith(prefix):
+                        mapped = self._filtered_event(ev, prev, pred)
+                        if mapped is not None:
+                            evs.append(mapped)
             if not evs:
                 continue
             ok = (w.send(evs[0]) if len(evs) == 1
@@ -102,7 +134,7 @@ class Store:
             del self._watchers[i]
 
     def _emit(self, rev: int, etype: str, key: str, obj: Any, prev: Any) -> None:
-        self._fanout([(key, self._record(rev, etype, key, obj, prev))])
+        self._fanout([(key, self._record(rev, etype, key, obj, prev), prev)])
 
     def _gc_expired(self, now: Optional[float] = None) -> None:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
@@ -228,13 +260,13 @@ class Store:
                     raise NotFound(name=key)
                 stored, _mod_rev, expiry = entry
                 staged.append((key, fn(stored), stored, expiry))
-            batch_events: List[Tuple[str, watchpkg.Event]] = []
+            batch_events: List[Tuple[str, watchpkg.Event, Any]] = []
             for key, new_obj, stored, expiry in staged:
                 rev = self._bump()
                 new_obj = _with_rv(new_obj, rev)
                 self._data[key] = (new_obj, rev, expiry)
                 batch_events.append((key, self._record(
-                    rev, watchpkg.MODIFIED, key, new_obj, stored)))
+                    rev, watchpkg.MODIFIED, key, new_obj, stored), stored))
                 out.append(new_obj)
             # one send per watcher for the whole tile, not per object
             # (the fan-out was ~half the measured binding commit cost)
@@ -270,7 +302,9 @@ class Store:
     # ------------------------------------------------------------- watch
 
     def watch(self, prefix: str, since_rev: Optional[int] = None,
-              capacity: int = 100_000) -> watchpkg.Watcher:
+              capacity: int = 100_000,
+              predicate: Optional[Callable[[Any], bool]] = None
+              ) -> watchpkg.Watcher:
         """Stream events for keys under prefix with rev > since_rev.
 
         since_rev=None means "from now" (no replay). Any integer — including
@@ -279,6 +313,12 @@ class Store:
         very first write. If the window no longer covers since_rev, Expired
         is raised and the client must re-list (ref: cacher.go 'too old
         resource version').
+
+        predicate: server-side selector filter (the apiserver filters
+        watches before they reach the wire; filtering here keeps
+        non-matching events out of the watcher queue entirely). Events
+        are mapped through the reference's filtered-watch transition
+        semantics — see _filtered_event.
         """
         with self._lock:
             replay = []
@@ -287,20 +327,25 @@ class Store:
                     raise Expired(
                         f"resourceVersion {since_rev} is too old "
                         f"(oldest available {self._oldest_rev})")
-                replay = [
-                    watchpkg.Event(etype, obj)
-                    for rev, etype, key, obj, _prev in self._history
-                    if rev > since_rev and key.startswith(prefix)
-                ]
+                for rev, etype, key, obj, prev in self._history:
+                    if rev <= since_rev or not key.startswith(prefix):
+                        continue
+                    ev = watchpkg.Event(etype, obj)
+                    if predicate is not None:
+                        ev = self._filtered_event(ev, prev, predicate)
+                        if ev is None:
+                            continue
+                    replay.append(ev)
             # Size the queue to hold the whole replay: a blocking send here
             # would deadlock the store (no consumer can run until we return).
             w = watchpkg.Watcher(max(capacity, len(replay) + 16))
             for ev in replay:
                 w.send(ev)
-            self._watchers.append((prefix, w))
+            self._watchers.append((prefix, predicate, w))
             return w
 
     def watcher_count(self) -> int:
         with self._lock:
-            self._watchers = [(p, w) for p, w in self._watchers if not w.stopped]
+            self._watchers = [(p, f, w) for p, f, w in self._watchers
+                              if not w.stopped]
             return len(self._watchers)

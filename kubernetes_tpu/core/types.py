@@ -55,8 +55,17 @@ def fast_replace(obj, **fields):
     return new
 
 
+_now_cache = (0, "")  # (unix second, formatted) — timestamps have 1s grain
+
+
 def now_rfc3339() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    global _now_cache
+    t = int(time.time())
+    cached = _now_cache
+    if cached[0] != t:
+        cached = (t, time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t)))
+        _now_cache = cached  # tuple swap is atomic under the GIL
+    return cached[1]
 
 
 @dataclass

@@ -178,7 +178,7 @@ class KubeletServer:
         """?follow=true: chunked tail -f of the captured log until the
         container exits (ref: server.go containerLogs + the docker
         follow stream; runtimes expose container_log_path)."""
-        import time as _time
+        import select as _select
 
         log_path = self.runtime.container_log_path(uid, container)
         h.send_response(200)
@@ -212,7 +212,16 @@ class KubeletServer:
                         if data:
                             chunk(data)
                         break
-                    _time.sleep(0.2)
+                    # idle wait doubling as disconnect detection: the
+                    # follower sends nothing after its GET, so a readable
+                    # client socket means EOF/reset — without this, a
+                    # quiet long-running container pins this thread (and
+                    # the apiserver's relay) long after the follower left
+                    readable, _, _ = _select.select([h.connection], [], [],
+                                                    0.2)
+                    if readable:
+                        h.close_connection = True
+                        return
             h.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError, OSError):
             h.close_connection = True

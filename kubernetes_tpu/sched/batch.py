@@ -198,8 +198,13 @@ class BatchScheduler:
             inc = self._incremental()
             if inc is not None:
                 try:
+                    # pre-pad the pod axis to a chunk multiple at encode
+                    # time: run_chunked then slices exact [chunk] pieces
+                    # and never concatenates under the GIL
+                    pad = ((n + chunk - 1) // chunk) * chunk
                     enc = inc.encode_tile(pods, f.service_lister.list(),
-                                          f.controller_lister.list())
+                                          f.controller_lister.list(),
+                                          pad_to=pad)
                     c.metrics.observe("batch_snapshot_latency_microseconds",
                                       (time.monotonic() - start) * 1e6)
                     t_dev = time.monotonic()

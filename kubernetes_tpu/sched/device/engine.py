@@ -328,13 +328,21 @@ def _aff_count_update(node: NodeConst, state: State, pod, pick, fit_any):
         jnp.arange(t), jnp.maximum(dom_at, 0)].add(add)
 
 
+# Scan unroll factor: the per-step op count is small enough that the TPU
+# while-loop's per-iteration overhead dominates (measured ~30us/step at
+# unroll=1 vs ~25us at 4 on a v5e; flat beyond 4). Unrolling packs 4 pods
+# into one loop iteration — results are bit-identical, only the loop
+# structure changes. Compile time grows ~3x (one-time per shape).
+SCAN_UNROLL = 4
+
+
 def _make_run(weights: Tuple[int, int, int], anti_weight: int = 0,
               has_aff: bool = True, has_spread: bool = True):
     def run(node: NodeConst, state: State, pods: PodXs):
         def step(carry, x):
             return _step(node, weights, anti_weight, carry, x,
                          has_aff, has_spread)
-        return jax.lax.scan(step, state, pods)
+        return jax.lax.scan(step, state, pods, unroll=SCAN_UNROLL)
     return run
 
 

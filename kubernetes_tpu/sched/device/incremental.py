@@ -606,9 +606,13 @@ class IncrementalEncoder:
 
     def encode_tile(self, pending_pods: List[api.Pod],
                     services: List[api.Service],
-                    controllers: List[api.ReplicationController]
-                    ) -> EncodeResult:
-        """O(tile) encode against the current persistent state."""
+                    controllers: List[api.ReplicationController],
+                    pad_to: int = 0) -> EncodeResult:
+        """O(tile) encode against the current persistent state.
+
+        pad_to: allocate the pod axis at this length up front (invalid
+        rows are zero / valid=False) so run_chunked never re-pads — the
+        tail-chunk concatenate was measured GIL-hostile in situ."""
         with self._lock:
             if self._tie_dirty:
                 self._recompute_tie_rank()
@@ -619,7 +623,7 @@ class IncrementalEncoder:
             PW = self.ports_dict.words
             K = self.disk_dict.words
             p = len(pending_pods)
-            p_pad = max(1, p)
+            p_pad = max(1, p, pad_to)
 
             # ---- pod batch + spread groups of this tile ----
             tile_groups: List[_Group] = []

@@ -246,6 +246,54 @@ def test_store_watch_prefix_isolation():
     w.stop()
 
 
+def test_store_filtered_watch_transition_semantics():
+    """Server-side watch predicates follow the reference's filtered-watch
+    mapping (etcd_watcher.go sendModify): entering the selector -> ADDED,
+    leaving it -> DELETED with the current object, never-matching events
+    never reach the queue."""
+    s = Store()
+    unassigned = s.watch("/registry/pods/",
+                         predicate=lambda p: not p.spec.node_name)
+    assigned = s.watch("/registry/pods/",
+                       predicate=lambda p: bool(p.spec.node_name))
+    key = pod_key("default", "p1")
+    s.create(key, make_pod())                       # pending
+    ev = unassigned.next(timeout=1)
+    assert ev.type == watchpkg.ADDED
+    # bind it: MODIFIED leaves the unassigned selector, enters assigned
+    s.guaranteed_update(
+        key, lambda p: api.fast_replace(
+            p, spec=api.fast_replace(p.spec, node_name="n1")))
+    ev = unassigned.next(timeout=1)
+    assert ev.type == watchpkg.DELETED
+    assert ev.object.spec.node_name == "n1"         # current object
+    ev = assigned.next(timeout=1)
+    assert ev.type == watchpkg.ADDED
+    # a status-only touch while bound: plain MODIFIED for assigned only
+    s.guaranteed_update(key, lambda p: api.fast_replace(p))
+    assert assigned.next(timeout=1).type == watchpkg.MODIFIED
+    s.delete(key)
+    assert assigned.next(timeout=1).type == watchpkg.DELETED
+    assert unassigned.next(timeout=0.1) is None     # nothing leaked
+    unassigned.stop(); assigned.stop()
+
+
+def test_store_filtered_watch_replay():
+    """Replay through a predicate applies the same transition mapping."""
+    s = Store()
+    key = pod_key("default", "p1")
+    s.create(key, make_pod())
+    rev = s.current_revision
+    s.guaranteed_update(
+        key, lambda p: api.fast_replace(
+            p, spec=api.fast_replace(p.spec, node_name="n1")))
+    w = s.watch("/registry/pods/", since_rev=rev,
+                predicate=lambda p: not p.spec.node_name)
+    ev = w.next(timeout=1)
+    assert ev.type == watchpkg.DELETED              # left the selector
+    w.stop()
+
+
 def test_store_watch_window_expiry():
     s = Store(window=4)
     for i in range(10):

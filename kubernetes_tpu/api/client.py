@@ -47,6 +47,12 @@ class Client:
     def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
         raise NotImplementedError
 
+    def update_status_batch(self, resource: str, objs: List[Any],
+                            namespace: str = "") -> List[Any]:
+        # Default: sequential (the reference wire protocol has no status
+        # batching; the in-proc client overrides with one store pass).
+        return [self.update_status(resource, o, namespace) for o in objs]
+
     def delete(self, resource: str, name: str, namespace: str = "") -> Any:
         raise NotImplementedError
 
@@ -103,6 +109,9 @@ class InProcClient(Client):
 
     def update_status(self, resource, obj, namespace=""):
         return self.registry.update_status(resource, obj, namespace)
+
+    def update_status_batch(self, resource, objs, namespace=""):
+        return self.registry.update_status_batch(resource, objs, namespace)
 
     def delete(self, resource, name, namespace=""):
         return self.registry.delete(resource, name, namespace)
@@ -194,13 +203,17 @@ class _HttpWatcher(Watcher):
 class HttpClient(Client):
     def __init__(self, base_url: str, scheme: Scheme = default_scheme,
                  timeout: float = 30.0,
-                 headers: Optional[dict] = None):
+                 headers: Optional[dict] = None,
+                 ssl_context=None):
         """headers: sent with every request (Authorization etc. — the
-        kubeconfig credential role)."""
+        kubeconfig credential role). ssl_context: for https servers —
+        CA trust plus an optional client certificate
+        (ssl.SSLContext.load_cert_chain), the x509 credential role."""
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme
         self.timeout = timeout
         self.headers = dict(headers or {})
+        self.ssl_context = ssl_context
 
     # ------------------------------------------------------------ plumbing
 
@@ -236,7 +249,8 @@ class HttpClient(Client):
                                      method=method)
         try:
             resp = urllib.request.urlopen(
-                req, timeout=None if stream else self.timeout)
+                req, timeout=None if stream else self.timeout,
+                context=self.ssl_context)
         except urllib.error.HTTPError as e:
             try:
                 status = json.loads(e.read().decode())
@@ -292,7 +306,11 @@ class HttpClient(Client):
             "fieldSelector": field_selector,
             "resourceVersion": "" if since_rev is None else str(since_rev)})
         split = urllib.parse.urlsplit(url)
-        conn = http.client.HTTPConnection(split.hostname, split.port)
+        if split.scheme == "https":
+            conn = http.client.HTTPSConnection(split.hostname, split.port,
+                                               context=self.ssl_context)
+        else:
+            conn = http.client.HTTPConnection(split.hostname, split.port)
         path = split.path + ("?" + split.query if split.query else "")
         conn.request("GET", path,
                      headers={"Accept": "application/json", **self.headers})

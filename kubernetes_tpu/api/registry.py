@@ -120,6 +120,11 @@ class ResourceInfo:
 
 RESOURCES: Dict[str, ResourceInfo] = {}
 
+# per-resource field-map memo shared by all filtered watch predicates
+# (see Registry.watch); values live only as long as the event objects
+# they describe are being fanned out, bounded by periodic clear
+_fields_memo: Dict[str, dict] = {}
+
 
 def _register(info: ResourceInfo) -> None:
     RESOURCES[info.name] = info
@@ -584,6 +589,23 @@ class Registry:
 
         return self.store.guaranteed_update(key, apply)
 
+    def update_status_batch(self, resource: str, objs: List[Any],
+                            namespace: str = "") -> List[Any]:
+        """Many status writes in ONE store pass (single lock, batched
+        watch fan-out). The hollow fleet confirms a whole tile of pods
+        Running this way; semantics per object match update_status. The
+        batch is all-or-nothing (store.batch) — callers that need
+        per-object NotFound tolerance catch and degrade to singles."""
+        info = self.info(resource)
+        if not info.has_status:
+            raise BadRequest(f"{resource} has no status subresource")
+        ops = []
+        for obj in objs:
+            ns = self._namespace_for(info, obj, namespace)
+            ops.append((self.key(resource, ns, obj.metadata.name),
+                        lambda cur, s=obj.status: replace(cur, status=s)))
+        return self.store.batch(ops)
+
     def guaranteed_update(self, resource: str, name: str, namespace: str,
                           fn) -> Any:
         """Retry-on-conflict read-modify-write through the store
@@ -696,11 +718,28 @@ class Registry:
             info = self.info(resource)
             lsel = labelspkg.parse(label_selector) if label_selector else None
             fsel = fieldspkg.parse(field_selector) if field_selector else None
+            # The store fans one event out to every filtered watcher
+            # while holding its write lock; without sharing, N watchers
+            # rebuild the same field map N times per event (2N for
+            # MODIFIED: new + prev). Memo key (id, resourceVersion) is
+            # collision-safe — rv strings are unique per committed write,
+            # so an id reused by a later object can't alias.
+            memo = _fields_memo.setdefault(resource, {})
+
+            def fields_of(o: Any) -> Dict[str, str]:
+                key = (id(o), o.metadata.resource_version)
+                f = memo.get(key)
+                if f is None:
+                    if len(memo) > 16:
+                        memo.clear()
+                    f = info.fields_fn(o)
+                    memo[key] = f
+                return f
 
             def pred(o: Any) -> bool:
                 if lsel is not None and not lsel.matches(o.metadata.labels):
                     return False
-                if fsel is not None and not fsel.matches(info.fields_fn(o)):
+                if fsel is not None and not fsel.matches(fields_of(o)):
                     return False
                 return True
         return self.store.watch(self.prefix(resource, namespace), since_rev,

@@ -77,7 +77,15 @@ class ApiServer:
                  port: int = 0, max_in_flight: int = 400,
                  scheme: Scheme = default_scheme,
                  metrics: Optional[MetricsRegistry] = None,
-                 authenticator=None, authorizer=None, request_log=None):
+                 authenticator=None, authorizer=None, request_log=None,
+                 tls_cert_file: str = "", tls_key_file: str = "",
+                 tls_client_ca_file: str = ""):
+        """tls_cert_file/tls_key_file: serve HTTPS (the reference's
+        --tls-cert-file/--tls-private-key-file secure port).
+        tls_client_ca_file: additionally request client certificates
+        verified against this CA (--client-ca-file); the verified peer
+        subject reaches authenticators as the X-Peer-Certificate
+        pseudo-header (auth.X509Authenticator consumes it)."""
         self.registry = registry
         self.scheme = scheme
         self.metrics = metrics or global_metrics
@@ -87,6 +95,7 @@ class ApiServer:
         self.authenticator = authenticator
         self.authorizer = authorizer
         self.request_log = request_log
+        self._tls = bool(tls_cert_file)
 
         server = self
 
@@ -111,6 +120,38 @@ class ApiServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
+        if self._tls:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert_file, tls_key_file or None)
+            if tls_client_ca_file:
+                ctx.load_verify_locations(tls_client_ca_file)
+                # request-but-don't-require: unauthenticated clients may
+                # still basic-auth/token-auth; presented certs must chain
+                # to the CA (ref: --client-ca-file x509 request auth)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            # Handshake in the per-connection thread, NOT on the listening
+            # socket: wrapping the listener would run the (blocking,
+            # unbounded) handshake inside the single accept loop, letting
+            # one silent TCP client park the whole control plane.
+            # ThreadingMixIn calls finish_request from the spawned thread.
+            httpd = self.httpd
+            orig_finish = httpd.finish_request
+
+            def finish_request(request, client_address):
+                request.settimeout(10)  # bound the handshake
+                try:
+                    tls_conn = ctx.wrap_socket(request, server_side=True)
+                except (ssl.SSLError, OSError, TimeoutError):
+                    try:
+                        request.close()
+                    except OSError:
+                        pass
+                    return
+                tls_conn.settimeout(None)  # watches stream indefinitely
+                orig_finish(tls_conn, client_address)
+
+            httpd.finish_request = finish_request
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None
@@ -119,7 +160,8 @@ class ApiServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def start(self) -> "ApiServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -153,6 +195,20 @@ class ApiServer:
             # healthz stays open (load balancers / liveness probes carry
             # no credentials).
             health_path = path in ("/healthz", "/healthz/ping")
+            # the verified TLS peer subject travels to authenticators as
+            # a pseudo-header (the reference's x509 request authenticator
+            # reads req.TLS.PeerCertificates). Strip any client-supplied
+            # copy first — it would be a trivial spoof otherwise.
+            if "X-Peer-Certificate" in h.headers:
+                del h.headers["X-Peer-Certificate"]
+            if self._tls:
+                try:
+                    peer = h.connection.getpeercert()
+                except (ValueError, OSError):
+                    peer = None
+                if peer and peer.get("subject"):
+                    h.headers["X-Peer-Certificate"] = json.dumps(
+                        peer["subject"])
             user = None
             if not health_path:
                 user, ok = authenticate_request(self.authenticator, h.headers)

@@ -8,6 +8,7 @@ import base64
 import binascii
 import hashlib
 import hmac
+import json
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -199,6 +200,40 @@ def make_jwt(secret: bytes, claims: dict) -> str:
                    hashlib.sha256).digest()
     return (f"{head}.{body}."
             f"{base64.urlsafe_b64encode(sig).rstrip(b'=').decode()}")
+
+
+class X509Authenticator(Authenticator):
+    """Client-certificate auth: CommonName -> user, Organization ->
+    groups, from the CA-verified TLS peer subject the ApiServer injects
+    as the X-Peer-Certificate pseudo-header (the server strips any
+    client-supplied copy, so the header only ever carries what the TLS
+    layer verified). Ref: plugin/pkg/auth/authenticator/request/x509
+    CommonNameUserConversion."""
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        raw = headers.get("X-Peer-Certificate", "")
+        if not raw:
+            return None, False
+        try:
+            subject = json.loads(raw)
+        except ValueError:
+            return None, False
+        cn = ""
+        orgs = []
+        # ssl.getpeercert subject: sequence of RDNs, each a sequence of
+        # (attribute, value) pairs
+        for rdn in subject:
+            for pair in rdn:
+                if len(pair) != 2:
+                    continue
+                attr, value = pair
+                if attr == "commonName" and not cn:
+                    cn = value
+                elif attr == "organizationName":
+                    orgs.append(value)
+        if not cn:
+            return None, False
+        return UserInfo(name=cn, groups=orgs), True
 
 
 class UnionAuthenticator(Authenticator):

@@ -194,6 +194,29 @@ class CephFSVolumeSource:
 
 
 @dataclass
+class FCVolumeSource:
+    """(ref: pkg/api/types.go FCVolumeSource)"""
+    target_wwns: List[str] = field(default_factory=list)
+    lun: int = 0
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class CinderVolumeSource:
+    """(ref: pkg/api/types.go CinderVolumeSource)"""
+    volume_id: str = ""
+    fs_type: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class FlockerVolumeSource:
+    """(ref: pkg/api/types.go FlockerVolumeSource)"""
+    dataset_name: str = ""
+
+
+@dataclass
 class Volume:
     name: str = ""
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
@@ -209,6 +232,9 @@ class Volume:
     iscsi: Optional[ISCSIVolumeSource] = None
     glusterfs: Optional[GlusterfsVolumeSource] = None
     cephfs: Optional[CephFSVolumeSource] = None
+    fc: Optional[FCVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    flocker: Optional[FlockerVolumeSource] = None
 
 
 # ---------------------------------------------------------------- containers
@@ -904,6 +930,9 @@ class PersistentVolumeSpec:
     nfs: Optional[NFSVolumeSource] = None
     gce_persistent_disk: Optional[GCEPersistentDiskVolumeSource] = None
     aws_elastic_block_store: Optional[AWSElasticBlockStoreVolumeSource] = None
+    cinder: Optional[CinderVolumeSource] = None
+    fc: Optional[FCVolumeSource] = None
+    flocker: Optional[FlockerVolumeSource] = None
 
 
 @dataclass

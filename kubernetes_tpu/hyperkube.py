@@ -121,6 +121,12 @@ def run_apiserver(argv: List[str]) -> int:
     p.add_argument("--authorization-policy-file")
     p.add_argument("--service-cluster-ip-range", default="10.0.0.0/24")
     p.add_argument("--max-requests-inflight", type=int, default=400)
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve HTTPS (ref: --tls-cert-file)")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--client-ca-file", default="",
+                   help="verify client certs against this CA and enable "
+                        "x509 authentication (ref: --client-ca-file)")
     args = p.parse_args(argv)
 
     from .master import Master, MasterConfig
@@ -133,7 +139,10 @@ def run_apiserver(argv: List[str]) -> int:
         authorization_mode=args.authorization_mode,
         authorization_policy_lines=_read_lines(args.authorization_policy_file),
         service_cidr=args.service_cluster_ip_range,
-        max_in_flight=args.max_requests_inflight)).start()
+        max_in_flight=args.max_requests_inflight,
+        tls_cert_file=args.tls_cert_file,
+        tls_key_file=args.tls_private_key_file,
+        tls_client_ca_file=args.client_ca_file)).start()
     return _serve_until_signal(f"apiserver ready {master.url}",
                                [master.stop])
 

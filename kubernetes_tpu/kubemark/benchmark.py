@@ -129,11 +129,13 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
 
         # watch-based bound counter: polling list() at scale steals the
         # GIL from the writers and the scheduler; the reference waits on
-        # its ScheduledPodLister (a watch cache) for the same reason
+        # its ScheduledPodLister (a watch cache) for the same reason.
+        # Server-side field selector: only bound pods reach this queue
         bound = set()
         bound_lock = threading.Lock()
         all_bound = threading.Event()
-        watcher = client.watch("pods", "default")
+        watcher = client.watch("pods", "default",
+                               field_selector="spec.nodeName!=")
 
         def count_bindings():
             for ev in watcher:

@@ -124,36 +124,64 @@ class HollowFleet:
         with self._lock:
             self._running.pop(meta_namespace_key(pod), None)
 
+    def _running_status(self, pod: api.Pod, ts: str) -> api.PodStatus:
+        return api.PodStatus(
+            phase="Running",
+            conditions=[api.PodCondition(type="Ready", status="True")],
+            host_ip="10.0.0.1", pod_ip="10.244.0.2",
+            start_time=pod.status.start_time or ts,
+            container_statuses=[api.ContainerStatus(
+                name=c.name, ready=True, image=c.image,
+                container_id=f"fake://{pod.metadata.uid}/{c.name}",
+                state=api.ContainerState(
+                    running=api.ContainerStateRunning(started_at=ts)))
+                for c in pod.spec.containers])
+
     def _status_pump(self) -> None:
         while True:
             pod = self._status_q.get()
             if pod is None:
                 return
+            # drain a whole burst: under a scheduler tile-commit, the
+            # watch hands this queue thousands of freshly-bound pods —
+            # confirm them Running in ONE batched store pass instead of
+            # per-pod writes fighting the GIL (per-object semantics are
+            # unchanged; see registry.update_status_batch)
+            batch = [pod]
+            while len(batch) < 4096:
+                try:
+                    nxt = self._status_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._status_q.put(None)  # re-arm shutdown sentinel
+                    break
+                batch.append(nxt)
             ts = api.now_rfc3339()
-            status = api.PodStatus(
-                phase="Running",
-                conditions=[api.PodCondition(type="Ready", status="True")],
-                host_ip="10.0.0.1", pod_ip="10.244.0.2",
-                start_time=pod.status.start_time or ts,
-                container_statuses=[api.ContainerStatus(
-                    name=c.name, ready=True, image=c.image,
-                    container_id=f"fake://{pod.metadata.uid}/{c.name}",
-                    state=api.ContainerState(
-                        running=api.ContainerStateRunning(started_at=ts)))
-                    for c in pod.spec.containers])
-            try:
-                self.client.update_status(
-                    "pods", replace(pod, status=status),
-                    pod.metadata.namespace)
-            except NotFound:
-                self._on_pod_delete(pod)
-            except Exception:
-                # transient: retry unless the fleet is shutting down
-                if not self._stop.is_set():
-                    with self._lock:
-                        wanted = meta_namespace_key(pod) in self._running
-                    if wanted:
-                        self._status_q.put(pod)
+            updated = [replace(p, status=self._running_status(p, ts))
+                       for p in batch]
+            if len(updated) > 1:
+                try:
+                    self.client.update_status_batch("pods", updated)
+                    continue
+                except Exception:
+                    pass  # degrade to singles: per-pod NotFound handling
+            for p, u in zip(batch, updated):
+                self._status_one(p, u)
+
+    def _status_one(self, pod: api.Pod, updated: api.Pod) -> None:
+        try:
+            self.client.update_status(
+                "pods", updated, pod.metadata.namespace)
+        except NotFound:
+            self._on_pod_delete(pod)
+        except Exception:
+            # transient: retry unless the fleet is shutting down
+            if not self._stop.is_set():
+                with self._lock:
+                    wanted = meta_namespace_key(pod) in self._running
+                if wanted:
+                    self._status_q.put(pod)
 
     # ---------------------------------------------------------- lifecycle
 
@@ -163,8 +191,13 @@ class HollowFleet:
 
     def run(self) -> "HollowFleet":
         self.register_all()
+        # assigned pods only — the same spec.nodeName watch a real
+        # kubelet makes (its field selector names one node; the fleet's
+        # dispatch-by-nodeName covers all of its nodes with one stream),
+        # and the server-side filter keeps the firehose of pending-pod
+        # ADDED events out of this informer's queue entirely
         self._informer = Informer(
-            self.client, "pods",
+            self.client, "pods", field_selector="spec.nodeName!=",
             on_add=self._on_pod,
             on_update=lambda old, new: self._on_pod(new),
             on_delete=self._on_pod_delete).start()

@@ -62,6 +62,12 @@ class MasterConfig:
     authorization_policy_lines: Optional[List[str]] = None
     service_cidr: str = "10.0.0.0/24"  # ref: --service-cluster-ip-range
     max_in_flight: int = 400           # ref: --max-requests-inflight
+    # secure serving (ref: --tls-cert-file/--tls-private-key-file); with
+    # a client CA, x509 client-cert auth joins the authenticator union
+    # (ref: --client-ca-file)
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_client_ca_file: str = ""
 
 
 class Master:
@@ -90,6 +96,9 @@ class Master:
                 new_from_plugins(self.registry, cfg.admission_control))
 
         authenticators: List[Authenticator] = []
+        if cfg.tls_client_ca_file:
+            from .auth.authenticate import X509Authenticator
+            authenticators.append(X509Authenticator())
         if cfg.basic_auth_lines:
             authenticators.append(
                 BasicAuthAuthenticator.from_lines(cfg.basic_auth_lines))
@@ -116,7 +125,10 @@ class Master:
         self.server = ApiServer(self.registry, host=cfg.host, port=cfg.port,
                                 max_in_flight=cfg.max_in_flight,
                                 authenticator=authenticator,
-                                authorizer=authorizer)
+                                authorizer=authorizer,
+                                tls_cert_file=cfg.tls_cert_file,
+                                tls_key_file=cfg.tls_key_file,
+                                tls_client_ca_file=cfg.tls_client_ca_file)
 
         # componentstatus probes at the components' conventional healthz
         # ports (ref: master.go getServersToValidate: scheduler :10251,

@@ -26,7 +26,10 @@ import logging
 import queue
 import threading
 import time
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -35,6 +38,43 @@ from ..utils.metrics import MetricsRegistry, global_metrics
 from .device import BatchEngine, ClusterSnapshot
 from .device.incremental import IncrementalEncoder, NeedsFullEncode
 from .generic import FitError
+
+
+@dataclass
+class _Inflight:
+    """A tile dispatched to the device but not yet finalized: its
+    assignment array is lazy (materializes on np.asarray) and its final
+    carry State lives on device for the next tile to chain from."""
+    pods: List[api.Pod]
+    enc: Any                 # EncodeResult
+    assigned: Any            # lazy jax i32[p_pad]
+    state: Any               # device State (the scan's final carry)
+    epoch: int               # encoder state_epoch at encode time
+    flags: Tuple[bool, bool]  # (has_aff, has_spread)
+    t_start: float
+    t_dev: float
+
+
+def _carry_compatible(enc, prev_state) -> bool:
+    """Would the device carry from the previous tile slot into this
+    tile's State position bit-for-bit? Shapes and dtypes must agree
+    (interner growth widens bitsets; gcd changes flip narrowing)."""
+    st = enc.init_state
+    pairs = ((st.cpu_used, prev_state.cpu_used),
+             (st.mem_used, prev_state.mem_used),
+             (st.nz_cpu, prev_state.nz_cpu),
+             (st.nz_mem, prev_state.nz_mem),
+             (st.pod_count, prev_state.pod_count),
+             (st.port_bits, prev_state.port_bits),
+             (st.disk_any, prev_state.disk_any),
+             (st.disk_rw, prev_state.disk_rw),
+             (st.spread, prev_state.spread),
+             (st.aff_count, prev_state.aff_count),
+             (st.aff_total, prev_state.aff_total),
+             (st.svc_count, prev_state.svc_count),
+             (st.svc_total, prev_state.svc_total))
+    return all(a.shape == tuple(b.shape) and a.dtype == b.dtype
+               for a, b in pairs)
 
 
 class BatchSchedulerConfig:
@@ -68,6 +108,9 @@ class BatchScheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inc: Optional[IncrementalEncoder] = None
+        # the dispatched-but-unfinalized tile (device pipeline depth 1):
+        # scheduler-thread only
+        self._prev: Optional[_Inflight] = None
         # the commit pipeline (SURVEY.md section 7 hard part 2 + the
         # reference's scheduler->binder two-stage analogue,
         # scheduler.go:120-165): tile k's binding commit runs on this
@@ -87,9 +130,10 @@ class BatchScheduler:
         if not self.config.incremental:
             return None
         if self._inc is None:
-            self._inc = IncrementalEncoder(
-                policy=self.config.engine.policy).attach(
-                    self.config.factory)
+            inc = IncrementalEncoder(policy=self.config.engine.policy)
+            # narrowing must budget for a dispatched-but-unassumed tile
+            inc.inflight_pad = self.config.tile_size
+            self._inc = inc.attach(self.config.factory)
         return self._inc
 
     def run(self) -> "BatchScheduler":
@@ -152,12 +196,15 @@ class BatchScheduler:
                 # stall scheduling cluster-wide
                 busy = True
             if not busy:
+                # idle: land the in-flight tile before parking
+                self._finalize_prev()
                 self._stop.wait(0.01)
+        self._finalize_prev()
 
-    def _drain_tile(self) -> List[api.Pod]:
+    def _drain_tile(self, timeout: float = 0.5) -> List[api.Pod]:
         f = self.config.factory
         pods: List[api.Pod] = []
-        pod = f.pod_queue.pop(timeout=0.5)
+        pod = f.pod_queue.pop(timeout=timeout)
         if pod is None:
             return pods
         pods.append(pod)
@@ -168,11 +215,27 @@ class BatchScheduler:
             pods.append(pod)
         return pods
 
+    @staticmethod
+    def _chunk_for(c: BatchSchedulerConfig, n: int) -> int:
+        # fixed scan-chunk ladder -> stable shapes -> XLA compiles one
+        # program per rung. Big drains run as ONE tile-sized dispatch:
+        # on an idle chip, small chunks win (tail padding burns scan
+        # steps), but in situ — 30 writer threads contending — each
+        # extra dispatch re-enters Python behind the GIL, and the
+        # measured e2e is ~20% better at chunk=tile than chunk=1024
+        if n <= c.min_pad:
+            return c.min_pad
+        if n <= 2 * c.bulk_chunk:
+            return c.bulk_chunk
+        return c.tile_size
+
     def schedule_tile(self) -> bool:
         """Returns True if any pods were processed."""
         c = self.config
         f = c.factory
-        pods = self._drain_tile()
+        # with a tile in flight, don't park on the FIFO — an empty drain
+        # must fall through so the idle path can finalize promptly
+        pods = self._drain_tile(0 if self._prev is not None else 0.5)
         if not pods:
             return False
         if f.rate_limiter is not None:
@@ -180,68 +243,42 @@ class BatchScheduler:
                 f.rate_limiter.accept()
         start = time.monotonic()
 
+        inc = self._incremental()
+        if inc is not None:
+            try:
+                return self._schedule_incremental(pods, start)
+            except NeedsFullEncode:
+                pass  # this tile needs the full encoder
+            except Exception as e:
+                self._fail_tile(pods, e)
+                return True
+
+        # full-encode path: strictly ordered after any in-flight tile
+        # (the encoder below reads the modeler's merged lister)
+        self._finalize_prev()
         try:
-            # fixed scan-chunk ladder -> stable shapes -> XLA compiles one
-            # program per rung. Big drains run as ONE tile-sized dispatch:
-            # on an idle chip, small chunks win (tail padding burns scan
-            # steps), but in situ — 30 writer threads contending — each
-            # extra dispatch re-enters Python behind the GIL, and the
-            # measured e2e is ~20% better at chunk=tile than chunk=1024
-            n = len(pods)
-            if n <= c.min_pad:
-                chunk = c.min_pad
-            elif n <= 2 * c.bulk_chunk:
-                chunk = c.bulk_chunk
-            else:
-                chunk = c.tile_size
-            hosts = None
-            inc = self._incremental()
-            if inc is not None:
-                try:
-                    # pre-pad the pod axis to a chunk multiple at encode
-                    # time: run_chunked then slices exact [chunk] pieces
-                    # and never concatenates under the GIL
-                    pad = ((n + chunk - 1) // chunk) * chunk
-                    enc = inc.encode_tile(pods, f.service_lister.list(),
-                                          f.controller_lister.list(),
-                                          pad_to=pad)
-                    c.metrics.observe("batch_snapshot_latency_microseconds",
-                                      (time.monotonic() - start) * 1e6)
-                    t_dev = time.monotonic()
-                    assigned, _ = c.engine.run_chunked(enc, chunk)
-                    hosts = [enc.node_names[i] if i >= 0 else None
-                             for i in assigned[:enc.n_pods]]
-                except NeedsFullEncode:
-                    hosts = None  # this tile needs the full encoder
-            if hosts is None:
-                # the full node cache (not just ready nodes) resolves
-                # existing pods' topology domains for affinity terms,
-                # mirroring the serial predicate's node_by_name
-                # (ReadyNodeLister.get)
-                node_cache = getattr(f.node_lister, "cache", None)
-                snap = ClusterSnapshot(
-                    nodes=f.node_lister.list(),
-                    existing_pods=f.pod_lister.list(),
-                    services=f.service_lister.list(),
-                    controllers=f.controller_lister.list(),
-                    pending_pods=pods,
-                    all_nodes=(node_cache.list()
-                               if node_cache is not None else None))
-                c.metrics.observe("batch_snapshot_latency_microseconds",
-                                  (time.monotonic() - start) * 1e6)
-                t_dev = time.monotonic()
-                hosts, _enc = c.engine.schedule(snap, chunk=chunk)
+            chunk = self._chunk_for(c, len(pods))
+            # the full node cache (not just ready nodes) resolves
+            # existing pods' topology domains for affinity terms,
+            # mirroring the serial predicate's node_by_name
+            # (ReadyNodeLister.get)
+            node_cache = getattr(f.node_lister, "cache", None)
+            snap = ClusterSnapshot(
+                nodes=f.node_lister.list(),
+                existing_pods=f.pod_lister.list(),
+                services=f.service_lister.list(),
+                controllers=f.controller_lister.list(),
+                pending_pods=pods,
+                all_nodes=(node_cache.list()
+                           if node_cache is not None else None))
+            c.metrics.observe("batch_snapshot_latency_microseconds",
+                              (time.monotonic() - start) * 1e6)
+            t_dev = time.monotonic()
+            hosts, _enc = c.engine.schedule(snap, chunk=chunk)
             c.metrics.observe("batch_device_latency_microseconds",
                               (time.monotonic() - t_dev) * 1e6)
         except Exception as e:
-            # encode/device failure: the tile is already drained from the
-            # FIFO, so every pod must take the error path (backoff+requeue)
-            # like the serial loop's algorithm failures (scheduler.go:129)
-            for pod in pods:
-                if f.recorder is not None:
-                    f.recorder.eventf(pod, "Warning", "FailedScheduling",
-                                      str(e))
-                self._error(pod, e)
+            self._fail_tile(pods, e)
             return True
         c.metrics.observe("scheduling_algorithm_latency_microseconds",
                           (time.monotonic() - start) * 1e6)
@@ -251,30 +288,147 @@ class BatchScheduler:
         unscheduled = [pod for pod, host in zip(pods, hosts) if host is None]
 
         if self._inc is not None:
-            # pipelined commit: advance the persistent device state NOW
-            # (assume-before-bind) so the next tile encodes against it,
-            # then hand the bind to the committer thread and go drain
-            # tile k+1 while tile k commits
+            # the incremental ledger exists but this tile went through
+            # the full encoder: feed the assumes back one by one
             for pod, host in scheduled:
                 self._inc.assume(api.fast_replace(
                     pod, spec=api.fast_replace(pod.spec, node_name=host)))
             self._commit_q.put(scheduled)
         else:
-            # full-encode path (policy engines): the encoder reads the
-            # modeler's merged lister, so commit stays on this thread to
-            # keep the next tile's snapshot ordered after the binds
+            # policy engines: the encoder reads the modeler's merged
+            # lister, so commit stays on this thread to keep the next
+            # tile's snapshot ordered after the binds
             f.modeler.locked_action(
                 lambda: self._commit(scheduled, inc_assumed=False))
 
+        self._route_unscheduled(unscheduled)
+        c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
+                          (time.monotonic() - start) * 1e6)
+        return True
+
+    def _schedule_incremental(self, pods: List[api.Pod],
+                              start: float) -> bool:
+        """Dispatch one tile through the incremental encoder, chaining
+        off the in-flight tile's device carry when provably equivalent;
+        the previous tile finalizes (host assume + commit enqueue) while
+        this one runs on device — the reference's scheduler->binder
+        two-stage pipeline (scheduler.go:120-165), depth 2."""
+        c = self.config
+        f = c.factory
+        inc = self._inc
+        chunk = self._chunk_for(c, len(pods))
+        # pre-pad the pod axis to a chunk multiple at encode time:
+        # run_chunked then slices exact [chunk] pieces and never
+        # concatenates under the GIL
+        pad = ((len(pods) + chunk - 1) // chunk) * chunk
+        services = f.service_lister.list()
+        controllers = f.controller_lister.list()
+        # spread groups make the device State tile-local (its [G, N]
+        # rows are this tile's groups): chain only group-free tiles
+        if self._prev is not None and (services or controllers
+                                       or inc.groups):
+            self._finalize_prev()
+        enc = inc.encode_tile(pods, services, controllers, pad_to=pad)
+        c.metrics.observe("batch_snapshot_latency_microseconds",
+                          (time.monotonic() - start) * 1e6)
+        flags = c.engine._enc_flags(enc)
+        prev = self._prev
+        chained = False
+        t_dev = time.monotonic()
+        if prev is not None:
+            if (flags == (False, False) and prev.flags == (False, False)
+                    and enc.state_epoch == prev.epoch
+                    and enc.mem_scale == prev.enc.mem_scale
+                    and _carry_compatible(enc, prev.state)):
+                # self._prev stays set until the dispatch succeeds — an
+                # exception here must not strand the in-flight tile
+                assigned, state = c.engine.run_chunked(
+                    enc, chunk, state_override=prev.state, block=False)
+                chained = True
+                self._prev = None
+            else:
+                # can't chain: land the previous tile, then re-encode so
+                # this tile's init state includes its assumes
+                self._finalize_prev()
+                prev = None
+                enc = inc.encode_tile(pods, services, controllers,
+                                      pad_to=pad)
+                flags = c.engine._enc_flags(enc)
+        if not chained:
+            t_dev = time.monotonic()
+            assigned, state = c.engine.run_chunked(enc, chunk, block=False)
+        self._prev = _Inflight(pods=pods, enc=enc, assigned=assigned,
+                               state=state, epoch=enc.state_epoch,
+                               flags=flags, t_start=start, t_dev=t_dev)
+        if chained and prev is not None:
+            # overlap: tile k finalizes on the host while tile k+1 runs
+            self._finalize(prev)
+        return True
+
+    def _finalize_prev(self) -> None:
+        fl = self._prev
+        self._prev = None
+        if fl is not None:
+            self._finalize(fl)
+
+    def _finalize(self, fl: _Inflight) -> None:
+        """Land a dispatched tile: block on its assignments, assume them
+        into the persistent encoder state, hand bindings to the
+        committer, route no-fit pods to backoff."""
+        c = self.config
+        f = c.factory
+        try:
+            assigned = np.asarray(fl.assigned)
+        except Exception as e:
+            self._fail_tile(fl.pods, e)
+            return
+        c.metrics.observe("batch_device_latency_microseconds",
+                          (time.monotonic() - fl.t_dev) * 1e6)
+        enc = fl.enc
+        idx = assigned[: enc.n_pods]
+        names = enc.node_names
+        scheduled: List[Tuple[api.Pod, str]] = []
+        unscheduled: List[api.Pod] = []
+        for j, pod in enumerate(fl.pods):
+            i = idx[j]
+            if i >= 0:
+                scheduled.append((pod, names[i]))
+            else:
+                unscheduled.append(pod)
+        c.metrics.observe("scheduling_algorithm_latency_microseconds",
+                          (time.monotonic() - fl.t_start) * 1e6)
+        try:
+            self._inc.assume_assigned(enc, fl.pods, idx)
+        except Exception:
+            # the slow path inside assume_assigned is the robust one;
+            # anything escaping means the ledger may be torn for this
+            # tile — scheduling continues (the watch echo reconciles),
+            # binds still commit
+            logger.exception("assume_assigned failed")
+        self._commit_q.put(scheduled)
+        self._route_unscheduled(unscheduled)
+        c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
+                          (time.monotonic() - fl.t_start) * 1e6)
+
+    def _route_unscheduled(self, unscheduled: List[api.Pod]) -> None:
+        f = self.config.factory
         for pod in unscheduled:
             err = FitError(pod, {})
             if f.recorder is not None:
                 f.recorder.eventf(pod, "Warning", "FailedScheduling",
                                   str(err))
             self._error(pod, err)
-        c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
-                          (time.monotonic() - start) * 1e6)
-        return True
+
+    def _fail_tile(self, pods: List[api.Pod], e: Exception) -> None:
+        """Encode/device failure: the tile is already drained from the
+        FIFO, so every pod must take the error path (backoff+requeue)
+        like the serial loop's algorithm failures (scheduler.go:129)."""
+        f = self.config.factory
+        for pod in pods:
+            if f.recorder is not None:
+                f.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                  str(e))
+            self._error(pod, e)
 
     def _commit(self, scheduled: List[Tuple[api.Pod, str]],
                 inc_assumed: bool) -> None:

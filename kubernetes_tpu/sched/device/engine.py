@@ -521,16 +521,26 @@ class BatchEngine:
         final_state, assigned = run(node, state, pods)
         return np.asarray(assigned), final_state
 
-    def run_chunked(self, enc: EncodeResult, chunk: int = 1024
-                    ) -> Tuple[np.ndarray, State]:
+    def run_chunked(self, enc: EncodeResult, chunk: int = 1024,
+                    state_override: Optional[State] = None,
+                    block: bool = True) -> Tuple[np.ndarray, State]:
         """Like run(), but the pod axis executes as fixed-size scan chunks
         with the carry threaded between calls on device. One XLA program
         (the [chunk] shape) serves every tile size — the pow2-ladder of
         per-tile-shape compiles collapses to a single compilation, and a
         30k-pod batch is ~30 dispatches of the same executable. Padded
         pods are invalid and never touch state, so chunked execution is
-        bit-identical to one long scan."""
+        bit-identical to one long scan.
+
+        state_override: start from this on-device State instead of the
+        encoded init (the pipelined scheduler chains tile k+1 off tile
+        k's final carry without a host round-trip). block=False skips
+        the final host transfer — dispatches are queued asynchronously
+        and the returned assignment array materializes on first
+        np.asarray."""
         node, state, pods = self.device_args(enc)
+        if state_override is not None:
+            state = state_override
         run = self._get_run(*self._enc_flags(enc))
         p = pods.valid.shape[0]
         outs = []
@@ -546,7 +556,9 @@ class BatchEngine:
             state, assigned = run(node, state, piece)
             outs.append(assigned)
         flat = jnp.concatenate(outs)[:p] if outs else jnp.zeros(0, jnp.int32)
-        return np.asarray(flat), state
+        if block:
+            return np.asarray(flat), state
+        return flat, state
 
     def schedule(self, snap: ClusterSnapshot, pod_pad_to: Optional[int] = None,
                  chunk: Optional[int] = None

@@ -191,6 +191,14 @@ class EncodeResult:
     # >1 when the resource arrays were narrowed to i32: every memory
     # quantity is stored divided by this exact common divisor
     mem_scale: int = 1
+    # incremental-encoder only: the _Group objects behind pod_batch's
+    # group_id column, so assume_assigned can bump their per-node rows
+    # without re-matching selectors
+    tile_groups: Optional[list] = None
+    # incremental-encoder only: the encoder's state_epoch at encode time
+    # (assume_assigned's fast path and the device-carry chain both
+    # require no intervening mutations)
+    state_epoch: int = -1
 
 
 _I32_BOUND = 1 << 30  # slack below 2^31 for the x10 score scaling

@@ -442,6 +442,41 @@ class RBDPlugin(_HollowNetworkPlugin):
                 f"{r.rbd_pool}/{r.rbd_image}")
 
 
+class FCPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/fc — hollow mount of a fibre-channel LUN)"""
+    name = "kubernetes.io/fc"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.fc is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        f = volume.fc
+        return f"fc://{','.join(f.target_wwns)}/lun-{f.lun}"
+
+
+class CinderPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/cinder — hollow mount; the OpenStack attach
+    step belongs to the cloudprovider fake)"""
+    name = "kubernetes.io/cinder"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.cinder is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        return f"cinder://{volume.cinder.volume_id}"
+
+
+class FlockerPlugin(_HollowNetworkPlugin):
+    """(ref: pkg/volume/flocker — hollow mount by dataset name)"""
+    name = "kubernetes.io/flocker"
+
+    def can_support(self, volume: api.Volume) -> bool:
+        return volume.flocker is not None
+
+    def _source(self, volume: api.Volume) -> str:
+        return f"flocker://{volume.flocker.dataset_name}"
+
+
 class PersistentClaimPlugin(VolumePlugin):
     """Resolve claim -> bound PV -> the underlying plugin
     (ref: pkg/volume/persistent_claim)."""
@@ -569,7 +604,7 @@ def new_default_plugin_mgr(host: VolumeHost) -> VolumePluginMgr:
         EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(),
         DownwardAPIPlugin(), NFSPlugin(), GCEPDPlugin(), AWSEBSPlugin(),
         GitRepoPlugin(), ISCSIPlugin(), GlusterfsPlugin(), CephFSPlugin(),
-        RBDPlugin(),
+        RBDPlugin(), FCPlugin(), CinderPlugin(), FlockerPlugin(),
     ]
     claim_plugin = PersistentClaimPlugin(mgr)
     plugins.append(claim_plugin)

@@ -223,6 +223,13 @@ class TestNewVolumePlugins:
         (api.Volume(name="v", rbd=api.RBDVolumeSource(
             ceph_monitors=["m1:6789"], rbd_pool="rbd",
             rbd_image="img1")), "rbd://m1:6789/rbd/img1"),
+        (api.Volume(name="v", fc=api.FCVolumeSource(
+            target_wwns=["50060e801049cfd1"], lun=3)),
+         "fc://50060e801049cfd1/lun-3"),
+        (api.Volume(name="v", cinder=api.CinderVolumeSource(
+            volume_id="vol-0042")), "cinder://vol-0042"),
+        (api.Volume(name="v", flocker=api.FlockerVolumeSource(
+            dataset_name="postgres-data")), "flocker://postgres-data"),
     ])
     def test_hollow_network_mounts(self, host, volume, marker):
         vh, *_ = host

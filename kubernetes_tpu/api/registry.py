@@ -120,11 +120,6 @@ class ResourceInfo:
 
 RESOURCES: Dict[str, ResourceInfo] = {}
 
-# per-resource field-map memo shared by all filtered watch predicates
-# (see Registry.watch); values live only as long as the event objects
-# they describe are being fanned out, bounded by periodic clear
-_fields_memo: Dict[str, dict] = {}
-
 
 def _register(info: ResourceInfo) -> None:
     RESOURCES[info.name] = info
@@ -254,6 +249,10 @@ class Registry:
                  service_cidr: str = "10.0.0.0/24"):
         self.store = store or Store()
         self.scheme = scheme
+        # per-resource field-map memo shared by this registry's filtered
+        # watch predicates (see watch()); entries are transient and
+        # bounded by periodic clear
+        self._fields_memo: Dict[str, dict] = {}
         # admission(operation, resource, obj, namespace, name) -> obj;
         # raises to reject (ref: pkg/admission chain invoked from
         # resthandler createHandler). Set after construction when plugins
@@ -722,9 +721,12 @@ class Registry:
             # while holding its write lock; without sharing, N watchers
             # rebuild the same field map N times per event (2N for
             # MODIFIED: new + prev). Memo key (id, resourceVersion) is
-            # collision-safe — rv strings are unique per committed write,
-            # so an id reused by a later object can't alias.
-            memo = _fields_memo.setdefault(resource, {})
+            # collision-safe within this registry — its rv strings are
+            # unique per committed write, so an id reused by a later
+            # object of the SAME store can't alias (the memo is
+            # per-Registry precisely because two stores can mint equal
+            # rvs for different objects).
+            memo = self._fields_memo.setdefault(resource, {})
 
             def fields_of(o: Any) -> Dict[str, str]:
                 key = (id(o), o.metadata.resource_version)

@@ -357,6 +357,8 @@ class BatchScheduler:
         if not chained:
             t_dev = time.monotonic()
             assigned, state = c.engine.run_chunked(enc, chunk, block=False)
+        c.metrics.inc("batch_tiles_total",
+                      {"chained": str(chained).lower()})
         self._prev = _Inflight(pods=pods, enc=enc, assigned=assigned,
                                state=state, epoch=enc.state_epoch,
                                flags=flags, t_start=start, t_dev=t_dev)
@@ -411,13 +413,21 @@ class BatchScheduler:
                           (time.monotonic() - fl.t_start) * 1e6)
 
     def _route_unscheduled(self, unscheduled: List[api.Pod]) -> None:
+        """Per-pod robust: _finalize may run while a LATER tile is
+        already dispatched and registered in _prev — an exception
+        escaping here would be caught by schedule_tile's handler and
+        error-requeue that tile's pods even though it still lands,
+        double-processing them."""
         f = self.config.factory
         for pod in unscheduled:
-            err = FitError(pod, {})
-            if f.recorder is not None:
-                f.recorder.eventf(pod, "Warning", "FailedScheduling",
-                                  str(err))
-            self._error(pod, err)
+            try:
+                err = FitError(pod, {})
+                if f.recorder is not None:
+                    f.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                      str(err))
+                self._error(pod, err)
+            except Exception:
+                logger.exception("routing unscheduled pod failed")
 
     def _fail_tile(self, pods: List[api.Pod], e: Exception) -> None:
         """Encode/device failure: the tile is already drained from the
@@ -425,10 +435,13 @@ class BatchScheduler:
         like the serial loop's algorithm failures (scheduler.go:129)."""
         f = self.config.factory
         for pod in pods:
-            if f.recorder is not None:
-                f.recorder.eventf(pod, "Warning", "FailedScheduling",
-                                  str(e))
-            self._error(pod, e)
+            try:
+                if f.recorder is not None:
+                    f.recorder.eventf(pod, "Warning", "FailedScheduling",
+                                      str(e))
+                self._error(pod, e)
+            except Exception:
+                logger.exception("error-routing pod failed")
 
     def _commit(self, scheduled: List[Tuple[api.Pod, str]],
                 inc_assumed: bool) -> None:

@@ -514,6 +514,20 @@ class BatchEngine:
         mask, total = fn(node, state, pods)
         return np.asarray(mask), np.asarray(total)
 
+    def filter_masks(self, enc: EncodeResult) -> np.ndarray:
+        """-> bool[n_pods, N] predicate-fit masks against the pre-batch
+        state (the extender Filter verb / mixed mode's probe rung). The
+        all-integer predicate tier runs as a hand-written Pallas TPU
+        kernel when the encoding qualifies (i32-narrowed, no affinity
+        terms, single device — see pallas_filter.supports); anything
+        else takes the XLA probe. Both are bit-exact with the oracle."""
+        if self.mesh is None and self.policy is None:
+            from . import pallas_filter
+            if pallas_filter.supports(enc):
+                return pallas_filter.filter_masks(enc)
+        mask, _ = self.probe(enc)
+        return np.asarray(mask[:enc.n_pods]).astype(bool)
+
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
         """-> (assigned node indices i32[P] (-1 = no fit), final state)."""
         node, state, pods = self.device_args(enc)

@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: the predicate-filter probe.
+
+The extender sidecar's Filter verb and mixed mode's device-probe rung
+(plugin/pkg/scheduler/extender.go:95 Filter; sched/device_assist.py)
+evaluate every fit predicate for P pending pods against N nodes — a
+[P, N] boolean mask with no sequential dependence. That makes it the
+one hot op that is BOTH worth a hand kernel and provably bit-exact in
+one: every predicate is pure integer/bitset arithmetic
+(predicates.go:127,192,250,258,403 — resource sums, port/disk bitset
+intersections, selector subset tests, hostname equality), so unlike the
+scoring scan there is no f64 rounding contract to replicate (the
+BalancedResourceAllocation priority keeps the scan on the XLA path; see
+engine._mask_and_score).
+
+Kernel shape: grid over (pod tiles x node tiles); node-axis data rides
+the lane dimension (bitsets pre-transposed to [words, N]), pod scalars
+broadcast from the sublane axis, bitset word loops unroll statically.
+Output is i32 (bool carries awkward tile constraints); the wrapper
+casts.
+
+Eligibility (checked by filter_masks): i32-narrowed encoding (TPU
+vector units are 32-bit; the i64 wide path falls back to the XLA
+probe), no inter-pod affinity terms in the batch, single device.
+On CPU backends the kernel runs in interpreter mode — that is how the
+parity suite pins it against the XLA probe.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BP = 8     # pod rows per block (sublane-friendly)
+BN = 512   # node lanes per block (4x the 128-lane vector width)
+
+
+def _filter_kernel(n_real_nodes: int,
+                   # node axis [1, BN] / [W, BN]; booleans arrive as i32
+                   valid, cpu_cap, mem_cap, pod_cap, exceed_cpu,
+                   exceed_mem, static_mask, labels_t,
+                   cpu_used, mem_used, pod_count, port_bits_t,
+                   disk_any_t, disk_rw_t,
+                   # pod axis [BP, 1] / [BP, W]
+                   pvalid, preq_cpu, preq_mem, pzero, psel, pports,
+                   pqany, pqrw, phost,
+                   out):
+    j = pl.program_id(1)
+
+    # ---- PodFitsResources (predicates.go:192-222) ----
+    fits_count = pod_count[:] < pod_cap[:]                      # [1, BN]
+    cap_c = cpu_cap[:]
+    cap_m = mem_cap[:]
+    free_cpu = (cap_c == 0) | (cap_c - cpu_used[:] >= preq_cpu[:])
+    free_mem = (cap_m == 0) | (cap_m - mem_used[:] >= preq_mem[:])
+    not_exceeded = (exceed_cpu[:] == 0) & (exceed_mem[:] == 0)
+    res_ok = jnp.where(pzero[:] != 0, fits_count,
+                       fits_count & not_exceeded & free_cpu & free_mem)
+
+    # ---- PodFitsHostPorts (predicates.go:403-415) ----
+    pw = pports.shape[1]
+    port_conflict = jnp.zeros(out.shape, jnp.bool_)
+    for w in range(pw):
+        port_conflict |= (port_bits_t[w:w + 1, :]
+                          & pports[:, w:w + 1]) != 0
+
+    # ---- MatchNodeSelector (predicates.go:250 via label bitsets) ----
+    lw = psel.shape[1]
+    sel_ok = jnp.ones(out.shape, jnp.bool_)
+    for w in range(lw):
+        sel_ok &= (psel[:, w:w + 1] & ~labels_t[w:w + 1, :]) == 0
+
+    # ---- NoDiskConflict (predicates.go:127-137) ----
+    kw = pqany.shape[1]
+    disk_conflict = jnp.zeros(out.shape, jnp.bool_)
+    for w in range(kw):
+        disk_conflict |= ((disk_any_t[w:w + 1, :] & pqany[:, w:w + 1])
+                          | (disk_rw_t[w:w + 1, :]
+                             & pqrw[:, w:w + 1])) != 0
+
+    # ---- PodFitsHost (predicates.go:258) ----
+    node_idx = j * BN + jax.lax.broadcasted_iota(jnp.int32, out.shape, 1)
+    host_ok = (phost[:] == -1) | (node_idx == phost[:])
+
+    mask = ((valid[:] != 0) & (pvalid[:] != 0) & res_ok
+            & jnp.logical_not(port_conflict) & sel_ok & host_ok
+            & jnp.logical_not(disk_conflict) & (static_mask[:] != 0)
+            & (node_idx < n_real_nodes))
+    out[:] = mask.astype(jnp.int32)
+
+
+def _pad_to(a: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    n = a.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _filter_call(node_args, state_args, pod_args, interpret=False):
+    (valid, cpu_cap, mem_cap, pod_cap, exceed_cpu, exceed_mem,
+     static_mask, labels) = node_args
+    (cpu_used, mem_used, pod_count, port_bits, disk_any, disk_rw) = \
+        state_args
+    (pvalid, preq_cpu, preq_mem, pzero, psel, pports, pqany, pqrw,
+     phost) = pod_args
+
+    n = valid.shape[0]
+    p = pvalid.shape[0]
+
+    def nvec(a, dtype=None):
+        a = a.astype(dtype) if dtype is not None else a
+        return _pad_to(a.reshape(1, -1), 1, BN)
+
+    def nbits(a):  # [N, W] -> [W, N_pad]
+        return _pad_to(a.T, 1, BN)
+
+    def pvec(a, dtype=None):
+        a = a.astype(dtype) if dtype is not None else a
+        return _pad_to(a.reshape(-1, 1), 0, BP)
+
+    def pbits(a):  # [P, W]
+        return _pad_to(a, 0, BP)
+
+    node_in = (nvec(valid, jnp.int32), nvec(cpu_cap), nvec(mem_cap),
+               nvec(pod_cap), nvec(exceed_cpu, jnp.int32),
+               nvec(exceed_mem, jnp.int32), nvec(static_mask, jnp.int32),
+               nbits(labels))
+    state_in = (nvec(cpu_used), nvec(mem_used), nvec(pod_count),
+                nbits(port_bits), nbits(disk_any), nbits(disk_rw))
+    pod_in = (pvec(pvalid, jnp.int32), pvec(preq_cpu), pvec(preq_mem),
+              pvec(pzero, jnp.int32), pbits(psel), pbits(pports),
+              pbits(pqany), pbits(pqrw), pvec(phost))
+
+    n_pad = node_in[0].shape[1]
+    p_pad = pod_in[0].shape[0]
+    grid = (p_pad // BP, n_pad // BN)
+
+    def nspec(a):
+        return pl.BlockSpec((a.shape[0], BN), lambda i, j: (0, j))
+
+    def pspec(a):
+        return pl.BlockSpec((BP, a.shape[1]), lambda i, j: (i, 0))
+
+    out = pl.pallas_call(
+        functools.partial(_filter_kernel, n),
+        out_shape=jax.ShapeDtypeStruct((p_pad, n_pad), jnp.int32),
+        grid=grid,
+        in_specs=[nspec(a) for a in node_in]
+        + [nspec(a) for a in state_in]
+        + [pspec(a) for a in pod_in],
+        out_specs=pl.BlockSpec((BP, BN), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(*node_in, *state_in, *pod_in)
+    return out[:p, :n]
+
+
+def supports(enc) -> bool:
+    """Kernel eligibility for this encoding: i32-narrowed resources
+    (the wide i64 path stays on XLA), no inter-pod affinity terms."""
+    pb = enc.pod_batch
+    if enc.node_tab.cpu_cap.dtype != np.int32:
+        return False
+    if bool(pb.aff_req.any() or pb.anti_req.any()):
+        return False
+    return True
+
+
+def filter_masks(enc) -> np.ndarray:
+    """-> bool[P, N] predicate-fit mask for every pending pod against
+    the pre-batch state — the pallas fast path of BatchEngine.probe's
+    mask half. Caller must have checked supports(enc)."""
+    nt, st, pb = enc.node_tab, enc.init_state, enc.pod_batch
+    interpret = jax.default_backend() not in ("tpu",)
+    out = _filter_call(
+        (jnp.asarray(nt.valid), jnp.asarray(nt.cpu_cap),
+         jnp.asarray(nt.mem_cap), jnp.asarray(nt.pod_cap),
+         jnp.asarray(nt.exceed_cpu), jnp.asarray(nt.exceed_mem),
+         jnp.asarray(nt.static_mask), jnp.asarray(nt.label_words)),
+        (jnp.asarray(st.cpu_used), jnp.asarray(st.mem_used),
+         jnp.asarray(st.pod_count), jnp.asarray(st.port_bits),
+         jnp.asarray(st.disk_any), jnp.asarray(st.disk_rw)),
+        (jnp.asarray(pb.valid), jnp.asarray(pb.req_cpu),
+         jnp.asarray(pb.req_mem), jnp.asarray(pb.zero_req),
+         jnp.asarray(pb.sel_words), jnp.asarray(pb.port_words),
+         jnp.asarray(pb.disk_qany), jnp.asarray(pb.disk_qrw),
+         jnp.asarray(pb.host_idx)),
+        interpret=interpret)
+    return np.asarray(out[:enc.n_pods]).astype(bool)

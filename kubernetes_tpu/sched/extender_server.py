@@ -87,20 +87,21 @@ class DeviceBackend:
         self.engine = BatchEngine(weights or DEFAULT_WEIGHTS, policy=policy)
         self.state_provider = state_provider or (lambda: ([], [], []))
 
-    def _probe(self, pod: api.Pod, nodes: Sequence[api.Node]):
+    def _encode(self, pod: api.Pod, nodes: Sequence[api.Node]):
         from .device import ClusterSnapshot, encode_snapshot
         existing, services, controllers = self.state_provider()
         snap = ClusterSnapshot(
             nodes=list(nodes), existing_pods=list(existing),
             services=list(services), controllers=list(controllers),
             pending_pods=[pod])
-        enc = encode_snapshot(snap, policy=self.engine.policy)
-        mask, total = self.engine.probe(enc)
-        return enc, mask[0], total[0]
+        return encode_snapshot(snap, policy=self.engine.policy)
 
     def filter(self, pod: api.Pod,
                nodes: Sequence[api.Node]) -> List[api.Node]:
-        enc, mask, _ = self._probe(pod, nodes)
+        # mask-only: rides the Pallas predicate kernel when the
+        # encoding qualifies (engine.filter_masks)
+        enc = self._encode(pod, nodes)
+        mask = self.engine.filter_masks(enc)[0]
         by_name = {n.metadata.name: n for n in nodes}
         return [by_name[enc.node_names[i]]
                 for i in range(len(enc.node_names))
@@ -108,7 +109,9 @@ class DeviceBackend:
 
     def prioritize(self, pod: api.Pod,
                    nodes: Sequence[api.Node]) -> List[HostPriority]:
-        enc, _, total = self._probe(pod, nodes)
+        enc = self._encode(pod, nodes)
+        _mask, total = self.engine.probe(enc)
+        total = total[0]
         wanted = {n.metadata.name for n in nodes}
         return [HostPriority(enc.node_names[i], int(total[i]))
                 for i in range(len(enc.node_names))

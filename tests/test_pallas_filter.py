@@ -1,0 +1,136 @@
+"""Pallas predicate-filter kernel: bit-exact parity with the XLA probe.
+
+The kernel (sched/device/pallas_filter.py) computes the [P, N] fit mask
+for the extender Filter verb; every predicate is integer/bitset math, so
+parity with engine.probe — itself parity-pinned against the serial
+oracle — must be exact, not approximate. On the CPU test platform the
+kernel runs in pallas interpreter mode.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import Quantity
+from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
+                                         encode_snapshot)
+from kubernetes_tpu.sched.device import pallas_filter
+
+MI = 1024 * 1024
+
+
+def _snapshot(rng: random.Random, n_nodes: int, n_pods: int,
+              n_existing: int) -> ClusterSnapshot:
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"zone": f"z{i % 3}"}
+        if i % 2:
+            labels["disk"] = "ssd"
+        nodes.append(api.Node(
+            metadata=api.ObjectMeta(name=f"n{i:04d}", labels=labels),
+            status=api.NodeStatus(capacity={
+                "cpu": Quantity(rng.choice([1000, 2000, 4000])),
+                "memory": Quantity(rng.choice([256, 512]) * MI * 1000),
+                "pods": Quantity(rng.choice([2, 40]) * 1000)})))
+    existing = []
+    for j in range(n_existing):
+        vols = []
+        if j % 9 == 0:
+            vols.append(api.Volume(name="d", gce_persistent_disk=(
+                api.GCEPersistentDiskVolumeSource(pd_name=f"pd-{j % 4}"))))
+        existing.append(api.Pod(
+            metadata=api.ObjectMeta(name=f"e{j}", namespace="default"),
+            spec=api.PodSpec(
+                node_name=f"n{j % n_nodes:04d}",
+                volumes=vols,
+                containers=[api.Container(
+                    name="c", image="i",
+                    ports=([api.ContainerPort(host_port=9000 + j % 3)]
+                           if j % 5 == 0 else []),
+                    resources=api.ResourceRequirements(requests={
+                        "cpu": Quantity(rng.choice([100, 500])),
+                        "memory": Quantity(
+                            rng.choice([50, 100]) * MI * 1000)}))])))
+    pods = []
+    for j in range(n_pods):
+        vols = []
+        if j % 6 == 0:
+            vols.append(api.Volume(name="d", gce_persistent_disk=(
+                api.GCEPersistentDiskVolumeSource(pd_name=f"pd-{j % 4}"))))
+        pods.append(api.Pod(
+            metadata=api.ObjectMeta(name=f"p{j:04d}", namespace="default"),
+            spec=api.PodSpec(
+                node_selector={"disk": "ssd"} if j % 5 == 0 else {},
+                node_name=f"n{j % n_nodes:04d}" if j % 11 == 0 else "",
+                volumes=vols,
+                containers=[api.Container(
+                    name="c", image="i",
+                    ports=([api.ContainerPort(host_port=9000 + j % 3)]
+                           if j % 7 == 0 else []),
+                    resources=api.ResourceRequirements(requests={
+                        "cpu": Quantity(rng.choice([0, 100, 900])),
+                        "memory": Quantity(
+                            rng.choice([0, 64, 200]) * MI * 1000)}))])))
+    return ClusterSnapshot(nodes=nodes, existing_pods=existing,
+                           services=[], pending_pods=pods)
+
+
+@pytest.mark.parametrize("n_nodes,n_pods,n_existing,seed", [
+    (7, 3, 5, 1),          # smaller than one block in both axes
+    (137, 53, 200, 7),     # odd sizes straddling block boundaries
+    (512, 16, 64, 3),      # node axis an exact block multiple
+    (60, 129, 0, 5),       # pod axis straddles, empty cluster
+])
+def test_pallas_filter_matches_probe(n_nodes, n_pods, n_existing, seed):
+    snap = _snapshot(random.Random(seed), n_nodes, n_pods, n_existing)
+    engine = BatchEngine()
+    enc = encode_snapshot(snap)
+    assert pallas_filter.supports(enc)
+    ref, _ = engine.probe(enc)
+    got = pallas_filter.filter_masks(enc)
+    assert got.shape == (enc.n_pods, ref.shape[1])
+    assert np.array_equal(got, np.asarray(ref[:enc.n_pods]).astype(bool))
+
+
+def test_pallas_filter_matches_scan_first_step():
+    """The scan's first pod sees the same pre-batch state the probe
+    does: its predicate row must agree with the kernel's row 0."""
+    snap = _snapshot(random.Random(11), 64, 1, 40)
+    engine = BatchEngine()
+    enc = encode_snapshot(snap)
+    masks = pallas_filter.filter_masks(enc)
+    assigned, _ = engine.run(enc)
+    # scores are non-negative, so the scan assigns iff any node passed
+    # the predicate tier — the kernel's row must agree exactly
+    assert bool(masks[0].any()) == (assigned[0] >= 0)
+    if assigned[0] >= 0:
+        assert masks[0, assigned[0]]
+
+
+def test_engine_filter_masks_routes_and_agrees():
+    """BatchEngine.filter_masks must agree with probe regardless of
+    which implementation it picked."""
+    snap = _snapshot(random.Random(13), 100, 20, 50)
+    engine = BatchEngine()
+    enc = encode_snapshot(snap)
+    ref, _ = engine.probe(enc)
+    got = engine.filter_masks(enc)
+    assert np.array_equal(got, np.asarray(ref[:enc.n_pods]).astype(bool))
+
+
+def test_wide_encoding_falls_back():
+    """An i64 (non-narrowed) encoding is ineligible for the kernel but
+    filter_masks still answers via the XLA probe."""
+    snap = _snapshot(random.Random(17), 10, 4, 0)
+    # a prime-byte memory request breaks the gcd rescale -> wide path
+    snap.pending_pods[0].spec.containers[0].resources.requests[
+        "memory"] = Quantity((1 << 40) + 7)
+    engine = BatchEngine()
+    enc = encode_snapshot(snap)
+    if enc.node_tab.cpu_cap.dtype != np.int32:
+        assert not pallas_filter.supports(enc)
+    ref, _ = engine.probe(enc)
+    got = engine.filter_masks(enc)
+    assert np.array_equal(got, np.asarray(ref[:enc.n_pods]).astype(bool))

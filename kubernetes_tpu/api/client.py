@@ -145,6 +145,22 @@ class InProcClient(Client):
         base = kubelet_base_for(self.registry, node_name)
         return fetch_kubelet(f"{base}/{path}")
 
+    def portforward_open(self, name, namespace, port):
+        """-> an upgraded websocket socket carrying the pod's TCP port
+        as binary frames. In-proc skips the apiserver leg and dials the
+        kubelet directly (same frames either way)."""
+        import urllib.parse as up
+        from ..utils import wsstream
+        from .relay import kubelet_base_for
+        pod = self.registry.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {name!r} is not scheduled yet")
+        base = kubelet_base_for(self.registry, pod.spec.node_name)
+        split = up.urlsplit(base)
+        return wsstream.client_connect(
+            split.hostname, split.port,
+            f"/portForward/{namespace}/{name}?port={port}")
+
     def pod_logs_stream(self, name, namespace="default", container=""):
         from .relay import (container_log_url, iter_http_stream,
                             open_kubelet_stream)
@@ -297,6 +313,27 @@ class HttpClient(Client):
     def delete(self, resource, name, namespace=""):
         ns = namespace or "default"
         return self._decode(self._do("DELETE", self._url(resource, ns, name)))
+
+    def portforward_open(self, name, namespace, port):
+        """-> an upgraded websocket socket through the apiserver's
+        portforward relay (the remote-kubectl leg). Carries the same
+        credentials and TLS posture as every other request: the
+        kubeconfig headers ride the upgrade, and an https base_url
+        wraps the socket with this client's ssl_context."""
+        import urllib.parse as up
+        from ..utils import wsstream
+        split = up.urlsplit(self.base_url)
+        ns = namespace or "default"
+        port_num = split.port or (443 if split.scheme == "https" else 80)
+        ctx = None
+        if split.scheme == "https":
+            import ssl as _ssl
+            ctx = self.ssl_context or _ssl.create_default_context()
+        return wsstream.client_connect(
+            split.hostname, port_num,
+            f"/api/v1/namespaces/{ns}/pods/{name}/portforward"
+            f"?port={port}",
+            headers=self.headers, ssl_context=ctx)
 
     def watch(self, resource, namespace="", since_rev=None,
               label_selector="", field_selector=""):

@@ -22,7 +22,6 @@ matching the reference's watch/json wire format (pkg/watch/json).
 
 from __future__ import annotations
 
-import base64
 import json
 import re
 import threading
@@ -185,7 +184,8 @@ class ApiServer:
         # request (ref: pkg/apiserver/handlers.go longRunningRequestRE).
         long_running = (query.get("watch") in ("true", "1")
                         or query.get("follow") in ("true", "1")
-                        or "/watch/" in path or path.endswith("/watch"))
+                        or "/watch/" in path or path.endswith("/watch")
+                        or path.endswith("/portforward"))
         if not long_running and not self._inflight.acquire(blocking=False):
             self._send_error(h, TooManyRequests("too many requests in flight"))
             return
@@ -348,6 +348,8 @@ class ApiServer:
                 # node's kubelet server (pkg/registry/pod/etcd LogREST ->
                 # kubelet /containerLogs, server.go:242)
                 return self._serve_pod_log(h, namespace, name, query)
+            if resource == "pods" and sub == "portforward":
+                return self._serve_port_forward(h, namespace, name, query)
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
@@ -510,6 +512,42 @@ class ApiServer:
         status, ctype, body = fetch_kubelet_response(url)
         self._send_raw(h, status, body, ctype)
 
+    def _serve_port_forward(self, h, namespace: str, name: str,
+                            query: dict) -> None:
+        """GET /pods/{name}/portforward?port=N, websocket upgrade: the
+        apiserver leg of port forwarding — relays frames to the owning
+        kubelet's /portForward endpoint (ref: pkg/registry/pod/etcd
+        PortForwardREST -> kubelet server.go PortForward; SPDY there,
+        websocket here)."""
+        import urllib.parse as _parse
+
+        from ..utils import wsstream
+
+        pod = self.registry.get("pods", name, namespace)
+        if not pod.spec.node_name:
+            raise BadRequest(f"pod {name!r} is not scheduled yet")
+        port = query.get("port", "")
+        base = self._kubelet_base(pod.spec.node_name)
+        split = _parse.urlsplit(base)
+        path = (f"/portForward/{namespace}/{name}"
+                f"?port={_parse.quote(port)}")
+        try:
+            up = wsstream.client_connect(split.hostname, split.port, path)
+        except (ConnectionError, OSError) as e:
+            raise BadGateway(f"kubelet portForward: {e}")
+        try:
+            if not wsstream.server_handshake(h):
+                return
+
+            def down_write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
+
+            wsstream.relay_ws(h.rfile.read, down_write, up)
+        finally:
+            up.close()
+            h.close_connection = True
+
     def _serve_pod_log(self, h, namespace: str, name: str,
                        query: dict) -> None:
         from .relay import container_log_url
@@ -646,51 +684,29 @@ class ApiServer:
 
     def _serve_watch_websocket(self, h, watcher, encode=None) -> None:
         """Watch over a websocket (ref: watch.go:89 HandleWS; wire events
-        are the same JSON objects, one per text frame). RFC 6455 server
-        side in stdlib: Sec-WebSocket-Accept handshake + unmasked
-        server-to-client text frames; client frames are drained and
-        discarded like the reference's Receive loop (watch.go:96)."""
-        import hashlib as _hashlib
+        are the same JSON objects, one per text frame). Framing and
+        handshake come from utils/wsstream (the pkg/util/wsstream role);
+        client frames are drained and discarded like the reference's
+        Receive loop (watch.go:96)."""
+        from ..utils import wsstream
 
         if encode is None:
             encode = self.scheme.encode_dict
-        key = h.headers.get("Sec-WebSocket-Key", "")
         try:
-            if not key:
-                return self._send_error(
-                    h, BadRequest("missing Sec-WebSocket-Key"))
-            accept = base64.b64encode(_hashlib.sha1(
-                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
-            ).digest()).decode()
-            h.send_response(101, "Switching Protocols")
-            h.send_header("Upgrade", "websocket")
-            h.send_header("Connection", "Upgrade")
-            h.send_header("Sec-WebSocket-Accept", accept)
-            h.end_headers()
+            if not wsstream.server_handshake(h):
+                return
 
             def drain_client_frames():
-                """Read and discard client frames (watch.go:96's Receive
-                loop); a Close frame stops the watcher, which makes the
-                write loop answer with its own Close."""
+                """Read and discard client frames; a Close frame (or a
+                malformed/oversized one) stops the watcher, which makes
+                the write loop answer with its own Close."""
                 try:
                     while True:
-                        head = h.rfile.read(2)
-                        if len(head) < 2:
+                        opcode, _payload = wsstream.read_frame(
+                            h.rfile.read)
+                        if opcode == wsstream.CLOSE:
                             break
-                        opcode = head[0] & 0x0F
-                        ln = head[1] & 0x7F
-                        masked = head[1] & 0x80
-                        if ln == 126:
-                            ln = int.from_bytes(h.rfile.read(2), "big")
-                        elif ln == 127:
-                            ln = int.from_bytes(h.rfile.read(8), "big")
-                        if masked:
-                            h.rfile.read(4)
-                        if ln:
-                            h.rfile.read(ln)
-                        if opcode == 0x8:
-                            break
-                except (OSError, ValueError):
+                except (ConnectionError, OSError, ValueError):
                     pass
                 finally:
                     watcher.stop()
@@ -698,32 +714,23 @@ class ApiServer:
             threading.Thread(target=drain_client_frames,
                              daemon=True).start()
 
-            def frame(payload: bytes, opcode: int = 0x1) -> bytes:
-                head = bytes([0x80 | opcode])
-                n = len(payload)
-                if n < 126:
-                    head += bytes([n])
-                elif n < 1 << 16:
-                    head += bytes([126]) + n.to_bytes(2, "big")
-                else:
-                    head += bytes([127]) + n.to_bytes(8, "big")
-                return head + payload
+            def write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
 
             while True:
                 ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
                 if ev is None:
                     if watcher.stopped:
                         break
-                    h.wfile.write(frame(b"", opcode=0x9))  # ping
-                    h.wfile.flush()
+                    wsstream.write_frame(write, b"", wsstream.PING)
                     continue
                 line = json.dumps({
                     "type": ev.type,
                     "object": encode(ev.object),
                 }).encode()
-                h.wfile.write(frame(line))
-                h.wfile.flush()
-            h.wfile.write(frame(b"", opcode=0x8))  # close
+                wsstream.write_frame(write, line, wsstream.TEXT)
+            wsstream.write_frame(write, b"", wsstream.CLOSE)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

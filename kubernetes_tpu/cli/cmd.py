@@ -117,6 +117,13 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("cmd", nargs="+",
                     help="command and args (use -- before flags)")
 
+    pf = sub.add_parser("port-forward",
+                        help="forward a local port to a pod port")
+    pf.add_argument("pod")
+    pf.add_argument("mapping",
+                    help="LOCAL:REMOTE (or PORT for same-port)")
+    pf.add_argument("--address", default="127.0.0.1")
+
     sub.add_parser("version", help="print version")
     sub.add_parser("api-versions", help="print supported API versions")
     sub.add_parser("cluster-info", help="display cluster info")
@@ -511,6 +518,37 @@ class Kubectl:
             self.out.write(f"[{cs.name}] state={state} "
                            f"restarts={cs.restart_count}\n")
 
+    def port_forward(self, ns, pod_name, mapping, address="127.0.0.1",
+                     block=True) -> int:
+        """kubectl port-forward POD LOCAL:REMOTE (ref: cmd/portforward.go
+        — SPDY there, websocket legs here; see cli/portforward.py)."""
+        from .portforward import PortForwarder
+        parts = mapping.split(":")
+        if len(parts) == 1:
+            local = remote = int(parts[0])
+        elif len(parts) == 2:
+            local, remote = int(parts[0] or 0), int(parts[1])
+        else:
+            raise ApiError(f"bad port mapping {mapping!r}")
+        fwd = PortForwarder(self.client, pod_name, ns, local, remote,
+                            address).start()
+        self.out.write(f"Forwarding from {address}:{fwd.local_port} "
+                       f"-> {remote}\n")
+        if hasattr(self.out, "flush"):
+            self.out.flush()
+        if not block:
+            self._forwarder = fwd  # tests stop it explicitly
+            return 0
+        try:
+            while True:
+                fwd._accept_thread.join(1.0)
+                if not fwd._accept_thread.is_alive():
+                    return 0
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            fwd.stop()
+
     def exec_cmd(self, ns, pod_name, container, cmd) -> int:
         """Run a command in a container via the apiserver's node-proxy
         exec relay (ref: kubectl exec -> kubelet /exec; output answered
@@ -618,6 +656,9 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         elif ns_args.command == "exec":
             return k.exec_cmd(ns, ns_args.pod, ns_args.container,
                               ns_args.cmd)
+        elif ns_args.command == "port-forward":
+            return k.port_forward(ns, ns_args.pod, ns_args.mapping,
+                                  ns_args.address)
         elif ns_args.command == "version":
             k.version()
         elif ns_args.command == "api-versions":

@@ -1,0 +1,73 @@
+"""kubectl port-forward: a local TCP listener bridged to a pod port.
+
+Reference: pkg/kubectl/cmd/portforward.go + pkg/client/unversioned/
+portforward — there the local listener speaks SPDY to the apiserver,
+which relays to the kubelet; here every leg is a websocket carrying raw
+TCP bytes as binary frames (utils/wsstream, the documented transport
+divergence). The client object decides the route: HttpClient goes
+through the apiserver relay, InProcClient dials the kubelet directly.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from ..utils import wsstream
+
+
+class PortForwarder:
+    """Serve local_port -> pod:remote_port until stop()."""
+
+    def __init__(self, client, pod_name: str, namespace: str,
+                 local_port: int, remote_port: int,
+                 address: str = "127.0.0.1"):
+        self.client = client
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.remote_port = remote_port
+        self._listener = socket.create_server((address, local_port))
+        self.local_port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PortForwarder":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"port-forward-{self.local_port}")
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            ws = self.client.portforward_open(
+                self.pod_name, self.namespace, self.remote_port)
+        except Exception:
+            conn.close()
+            return
+        try:
+            # local TCP <-> websocket; we are the ws client, so frames
+            # we send are masked
+            wsstream.bridge(ws.recv, ws.sendall, conn, mask=True)
+        finally:
+            ws.close()
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._accept_thread:
+            self._accept_thread.join(timeout=5)

@@ -79,6 +79,13 @@ class Runtime:
         """-> (exit_code, combined output) (ref: ExecInContainer)"""
         raise NotImplementedError
 
+    def pod_port_address(self, pod_uid: str, port: int) -> Tuple[str, int]:
+        """Where a pod's TCP port is reachable from this kubelet — the
+        PortForward target (ref: kubecontainer.Runtime PortForward;
+        dockertools resolves the pod's network namespace). Host-network
+        runtimes answer ("127.0.0.1", port)."""
+        raise NotImplementedError
+
 
 class FakeRuntime(Runtime):
     """In-memory runtime: containers 'run' until told otherwise.
@@ -94,6 +101,7 @@ class FakeRuntime(Runtime):
         self._fail_next = 0
         self._counter = 0
         self._logs: Dict[Tuple[str, str], str] = {}  # (uid, name) -> text
+        self._port_addrs: Dict[Tuple[str, int], Tuple[str, int]] = {}
 
     # ----------------------------------------------------- Runtime API
 
@@ -168,6 +176,18 @@ class FakeRuntime(Runtime):
                        exit_code: int = 1) -> None:
         """Simulate a container crash."""
         self._transition(pod_uid, name, exit_code)
+
+    def set_port_address(self, pod_uid: str, port: int,
+                         addr: Tuple[str, int]) -> None:
+        """Test control: where pod_port_address answers for (pod, port)
+        — tests point it at a real local listener."""
+        self._port_addrs[(pod_uid, port)] = addr
+
+    def pod_port_address(self, pod_uid: str, port: int) -> Tuple[str, int]:
+        try:
+            return self._port_addrs[(pod_uid, port)]
+        except KeyError:
+            raise KeyError(f"pod {pod_uid!r} has nothing on port {port}")
 
     def fail_next_start(self, n: int = 1) -> None:
         with self._lock:

@@ -140,6 +140,8 @@ class KubeletServer:
                 return self._container_logs(h, path, query)
             if path.startswith("/exec/"):
                 return self._exec(h, path, query)
+            if path.startswith("/portForward/"):
+                return self._port_forward(h, path, query)
             self._raw(h, 404, f"not found: {path}".encode(), "text/plain")
         except KeyError as e:
             self._raw(h, 404, str(e).encode(), "text/plain")
@@ -224,6 +226,50 @@ class KubeletServer:
                         return
             h.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError, OSError):
+            h.close_connection = True
+
+    def _port_forward(self, h, path: str, query: dict) -> None:
+        """GET /portForward/{ns}/{pod}?port=N, websocket upgrade: binary
+        frames carry raw TCP bytes to/from the pod's port (ref:
+        pkg/kubelet/server.go PortForward — SPDY there, RFC 6455 here;
+        see DIVERGENCES.md transport note)."""
+        import socket as _socket
+
+        from ..utils import wsstream
+
+        parts = [p for p in path[len("/portForward/"):].split("/") if p]
+        if len(parts) != 2:
+            raise KeyError("want /portForward/{ns}/{pod}?port=N")
+        ns, pod_name = parts
+        pod = self._find_pod(ns, pod_name)
+        try:
+            port = int(query.get("port", ["0"])[0])
+        except ValueError:
+            port = 0
+        if not 0 < port < 65536:
+            return self._raw(h, 400, b"?port= required", "text/plain")
+        host, target_port = self.runtime.pod_port_address(
+            pod.metadata.uid, port)
+        try:
+            sock = _socket.create_connection((host, target_port),
+                                             timeout=10)
+        except OSError as e:
+            return self._raw(h, 502,
+                             f"dial {host}:{target_port}: {e}".encode(),
+                             "text/plain")
+        try:
+            if not wsstream.server_handshake(h):
+                return
+
+            def write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
+
+            # pod_side: EOF from the pod's socket means the response
+            # stream is complete -> send CLOSE, ending the session
+            wsstream.bridge(h.rfile.read, write, sock, pod_side=True)
+        finally:
+            sock.close()
             h.close_connection = True
 
     def _exec(self, h, path: str, query: dict) -> None:

@@ -148,6 +148,15 @@ class SubprocessRuntime(Runtime):
             text = ""
         return tail_text(text, tail_lines)
 
+    def pod_port_address(self, pod_uid: str, port: int) -> Tuple[str, int]:
+        # pods run as host-network process groups: their listeners bind
+        # the loopback directly (the pause-container analogue holds no
+        # separate netns)
+        with self._lock:
+            if not any(uid == pod_uid for uid, _ in self._procs):
+                raise KeyError(f"pod {pod_uid!r} has no running container")
+        return ("127.0.0.1", port)
+
     def exec_in_container(self, pod_uid: str, name: str,
                           cmd: List[str]) -> Tuple[int, str]:
         with self._lock:

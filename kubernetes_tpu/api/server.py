@@ -43,6 +43,9 @@ WATCH_HEARTBEAT_SECONDS = 30.0
 
 # /api/v1/proxy/nodes/{name}/exec/... — the relayed kubelet exec surface
 _EXEC_PROXY_RE = re.compile(r"/proxy/nodes/[^/]+/exec(/|$)")
+# pods/{name}/portforward — a GET in transport, a raw TCP channel into
+# the pod in effect (the reference requires the create verb on it)
+_PORTFORWARD_RE = re.compile(r"/pods/[^/]+/portforward$")
 
 
 def _authz_target(path: str):
@@ -222,10 +225,11 @@ class ApiServer:
                 # segments the router uses (raw-path matching is bypassable
                 # with empty segments: /proxy/nodes/n1//exec/...)
                 norm = "/" + "/".join(p for p in path.split("/") if p)
-                exec_proxy = bool(_EXEC_PROXY_RE.search(norm))
+                write_effect = bool(_EXEC_PROXY_RE.search(norm)
+                                    or _PORTFORWARD_RE.search(norm))
                 attrs = AuthorizerAttributes(
                     user=user,
-                    read_only=(method == "GET" and not exec_proxy),
+                    read_only=(method == "GET" and not write_effect),
                     resource=resource, namespace=namespace)
                 if not self.authorizer.authorize(attrs):
                     name = user.name if user else "unknown"

@@ -11,6 +11,7 @@ through the apiserver relay, InProcClient dials the kubelet directly.
 from __future__ import annotations
 
 import socket
+import sys
 import threading
 from typing import Optional
 
@@ -52,7 +53,11 @@ class PortForwarder:
         try:
             ws = self.client.portforward_open(
                 self.pod_name, self.namespace, self.remote_port)
-        except Exception:
+        except Exception as e:
+            # the reference kubectl logs each failed connection; silence
+            # here would look like inexplicable instant disconnects
+            print(f"port-forward {self.pod_name}:{self.remote_port}: {e}",
+                  file=sys.stderr)
             conn.close()
             return
         try:

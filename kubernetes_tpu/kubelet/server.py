@@ -257,6 +257,9 @@ class KubeletServer:
             return self._raw(h, 502,
                              f"dial {host}:{target_port}: {e}".encode(),
                              "text/plain")
+        # the dial timeout must not linger: an idle-but-healthy session
+        # (quiet pod side) would hit recv timeouts and get torn down
+        sock.settimeout(None)
         try:
             if not wsstream.server_handshake(h):
                 return

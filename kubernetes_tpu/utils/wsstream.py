@@ -96,12 +96,46 @@ def client_connect(host: str, port: int, path: str,
         status = head.split(b"\r\n", 1)[0]
         if b"101" not in status:
             raise ConnectionError(f"upgrade refused: {status.decode()}")
-        assert not rest, "server spoke before the first frame"
         sock.settimeout(None)
+        if rest:
+            # server-speaks-first targets (SMTP/SSH banners): the pod's
+            # first frame can coalesce with the 101 — hand the leftover
+            # bytes back ahead of the socket
+            return _PrefixedSocket(sock, rest)
         return sock
     except BaseException:
         sock.close()
         raise
+
+
+class _PrefixedSocket:
+    """A socket whose recv drains buffered bytes first (the tail of the
+    TCP segment that carried the upgrade response). Delegates the rest
+    of the socket surface."""
+
+    def __init__(self, sock: socket.socket, prefix: bytes):
+        self._sock = sock
+        self._prefix = prefix
+
+    def recv(self, n: int) -> bytes:
+        if self._prefix:
+            out, self._prefix = self._prefix[:n], self._prefix[n:]
+            return out
+        return self._sock.recv(n)
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+def _xor_mask(payload: bytes, key: bytes) -> bytes:
+    """RFC 6455 masking. A per-byte Python loop caps the forward data
+    plane at tens of MB/s; XOR of big ints runs at memcpy-ish speed for
+    the 64KiB frames the pumps emit."""
+    n = len(payload)
+    reps = (n + 3) // 4
+    p = int.from_bytes(payload, "little")
+    m = int.from_bytes((key * reps)[:n], "little")
+    return (p ^ m).to_bytes(n, "little")
 
 
 def _read_exact(read: Callable[[int], bytes], n: int) -> bytes:
@@ -131,7 +165,7 @@ def read_frame(read: Callable[[int], bytes]) -> Tuple[int, bytes]:
     mask = _read_exact(read, 4) if masked else b""
     payload = _read_exact(read, ln) if ln else b""
     if masked and payload:
-        payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        payload = _xor_mask(payload, mask)
     return opcode, payload
 
 
@@ -147,8 +181,7 @@ def write_frame(write: Callable[[bytes], None], payload: bytes,
         head += bytes([(0x80 if mask else 0) | 127]) + n.to_bytes(8, "big")
     if mask:
         key = os.urandom(4)
-        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
-        write(head + key + payload)
+        write(head + key + _xor_mask(payload, key))
     else:
         write(head + payload)
 

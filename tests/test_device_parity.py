@@ -242,3 +242,52 @@ def test_engine_sharded_narrowed_matches_oracle():
     assert enc.node_tab.mem_cap.dtype == np.int32  # narrowing active
     assert sharded == schedule_batch(snap)
     assert sharded == oracle_schedule(snap)
+
+
+def test_mesh_chained_pipeline_matches_single_run():
+    """The batch pipeline's device-carry chain (tile k+1 scans from tile
+    k's final state without a host round-trip) holds over a sharded
+    mesh: two chained 16-pod tiles must bind identically to one 32-pod
+    run — the carry is just the scan state, sharding and all."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+
+    def encoder_with_nodes():
+        e = IncrementalEncoder(node_capacity=64)
+        for i in range(40):
+            e.on_node_add(make_node(f"n{i:03d}", 4000, 4 * 1024 * MI, 40))
+        return e
+
+    def mkpods(lo, n):
+        return [api.Pod(
+            metadata=api.ObjectMeta(name=f"p{j:04d}", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(requests={
+                    "cpu": mq(100), "memory": bq(64 * MI)}))]))
+            for j in range(lo, lo + n)]
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engine = BatchEngine(mesh=mesh)
+    inc = encoder_with_nodes()
+    p1, p2 = mkpods(0, 16), mkpods(16, 16)
+    e1 = inc.encode_tile(p1, [], [], pad_to=16)
+    a1, s1 = engine.run_chunked(e1, 16, block=False)
+    e2 = inc.encode_tile(p2, [], [], pad_to=16)
+    # chainable: nothing moved and the narrowing scale held
+    assert e2.state_epoch == e1.state_epoch
+    assert e2.mem_scale == e1.mem_scale
+    a2, _ = engine.run_chunked(e2, 16, state_override=s1, block=False)
+    a1, a2 = np.asarray(a1), np.asarray(a2)
+    inc.assume_assigned(e1, p1, a1)
+    inc.assume_assigned(e2, p2, a2)
+
+    fresh = encoder_with_nodes()
+    eall = fresh.encode_tile(mkpods(0, 32), [], [], pad_to=32)
+    aall, _ = engine.run_chunked(eall, 32)
+    assert np.array_equal(np.concatenate([a1[:16], a2[:16]]), aall[:32])
+    # and the host ledger absorbed both tiles exactly
+    assert int(inc.pod_count.sum()) == 32

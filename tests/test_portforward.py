@@ -213,6 +213,64 @@ def test_unknown_port_is_clean_error(cluster):
         asrv.stop()
 
 
+def test_readonly_grant_cannot_portforward(cluster):
+    """GET in transport, raw TCP channel in effect: a readonly ABAC
+    grant must not open port-forward (the reference requires the create
+    verb on pods/portforward)."""
+    from kubernetes_tpu.auth.authenticate import BasicAuthAuthenticator
+    from kubernetes_tpu.auth.authorize import ABACAuthorizer, ABACPolicy
+    registry, _client, _runtime = cluster
+    asrv = ApiServer(
+        registry,
+        authenticator=BasicAuthAuthenticator.from_lines(["pw,viewer,1"]),
+        authorizer=ABACAuthorizer([
+            ABACPolicy(user="viewer", readonly=True)])).start()
+    try:
+        import base64
+        auth = {"Authorization":
+                "Basic " + base64.b64encode(b"viewer:pw").decode()}
+        http = HttpClient(asrv.url, headers=auth)
+        # reads still work under the grant
+        assert http.list("pods", "default")[0]
+        # ...but the forward upgrade is forbidden
+        with pytest.raises((ConnectionError, OSError)):
+            ws = http.portforward_open("web", "default", 80)
+            ws.close()
+    finally:
+        asrv.stop()
+
+
+def test_banner_service_first_bytes_survive(cluster):
+    """Server-speaks-first protocols: a banner sent before the client's
+    first byte can coalesce with the 101 response — it must arrive, not
+    be discarded by the upgrade parser."""
+    registry, _client, runtime = cluster
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def banner_once():
+        conn, _ = srv.accept()
+        with conn:
+            conn.sendall(b"220 hello\r\n")
+            conn.recv(64)  # wait for the client before closing
+
+    threading.Thread(target=banner_once, daemon=True).start()
+    runtime.set_port_address("uid-pf", 25, ("127.0.0.1", port))
+    asrv = ApiServer(registry).start()
+    try:
+        http = HttpClient(asrv.url)
+        ws = http.portforward_open("web", "default", 25)
+        try:
+            opcode, payload = wsstream.read_frame(ws.recv)
+            assert opcode == wsstream.BINARY
+            assert payload == b"220 hello\r\n"
+        finally:
+            ws.close()
+    finally:
+        asrv.stop()
+        srv.close()
+
+
 def test_kubectl_port_forward_command(cluster):
     """The CLI surface: parses LOCAL:REMOTE, serves a working local
     listener (block=False keeps the forwarder for inspection)."""

@@ -161,6 +161,21 @@ class InProcClient(Client):
             split.hostname, split.port,
             f"/portForward/{namespace}/{name}?port={port}")
 
+    def attach_open(self, name, namespace, container="", stdin=False):
+        """-> an upgraded websocket: the container's live output as
+        binary frames (and stdin upstream when asked). In-proc dials
+        the kubelet directly."""
+        import urllib.parse as up
+        from ..utils import wsstream
+        from .relay import resolve_pod_container
+        container, base = resolve_pod_container(self.registry, namespace,
+                                                name, container)
+        split = up.urlsplit(base)
+        q = "?stdin=true" if stdin else ""
+        return wsstream.client_connect(
+            split.hostname, split.port,
+            f"/attach/{namespace}/{name}/{container}{q}")
+
     def pod_logs_stream(self, name, namespace="default", container=""):
         from .relay import (container_log_url, iter_http_stream,
                             open_kubelet_stream)
@@ -333,6 +348,29 @@ class HttpClient(Client):
             split.hostname, port_num,
             f"/api/v1/namespaces/{ns}/pods/{name}/portforward"
             f"?port={port}",
+            headers=self.headers, ssl_context=ctx)
+
+    def attach_open(self, name, namespace, container="", stdin=False):
+        """-> an upgraded websocket through the apiserver's attach
+        relay."""
+        import urllib.parse as up
+        from ..utils import wsstream
+        split = up.urlsplit(self.base_url)
+        ns = namespace or "default"
+        port_num = split.port or (443 if split.scheme == "https" else 80)
+        ctx = None
+        if split.scheme == "https":
+            import ssl as _ssl
+            ctx = self.ssl_context or _ssl.create_default_context()
+        params = {}
+        if container:
+            params["container"] = container
+        if stdin:
+            params["stdin"] = "true"
+        q = ("?" + up.urlencode(params)) if params else ""
+        return wsstream.client_connect(
+            split.hostname, port_num,
+            f"/api/v1/namespaces/{ns}/pods/{name}/attach{q}",
             headers=self.headers, ssl_context=ctx)
 
     def watch(self, resource, namespace="", since_rev=None,

@@ -100,15 +100,12 @@ def kubelet_base_for(registry, node_name: str) -> str:
         raise NotFound(str(e))
 
 
-def container_log_url(registry, namespace: str, name: str,
-                      container: str = "", query: str = "") -> str:
-    """Resolve a pod's kubelet containerLogs URL: scheduled-check,
-    single-container defaulting, daemon-endpoint lookup. The one
-    implementation behind the in-proc client (plain + streaming) and the
-    ApiServer's log relay — container defaulting must not drift between
-    those paths.
-
-    query: pre-encoded query string without the '?' (e.g. 'follow=true')."""
+def resolve_pod_container(registry, namespace: str, name: str,
+                          container: str = ""):
+    """-> (container, kubelet base URL): scheduled-check,
+    single-container defaulting, daemon-endpoint lookup. The ONE
+    implementation behind the log, attach, and port-forward paths —
+    container defaulting must not drift between them."""
     from ..core.errors import BadRequest
 
     pod = registry.get("pods", name, namespace)
@@ -119,6 +116,16 @@ def container_log_url(registry, namespace: str, name: str,
             raise BadRequest(
                 f"pod {name!r} has several containers; name one")
         container = pod.spec.containers[0].name
-    base = kubelet_base_for(registry, pod.spec.node_name)
+    return container, kubelet_base_for(registry, pod.spec.node_name)
+
+
+def container_log_url(registry, namespace: str, name: str,
+                      container: str = "", query: str = "") -> str:
+    """Resolve a pod's kubelet containerLogs URL (see
+    resolve_pod_container).
+
+    query: pre-encoded query string without the '?' (e.g. 'follow=true')."""
+    container, base = resolve_pod_container(registry, namespace, name,
+                                            container)
     url = f"{base}/containerLogs/{namespace}/{name}/{container}"
     return url + (f"?{query}" if query else "")

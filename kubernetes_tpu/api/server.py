@@ -43,9 +43,10 @@ WATCH_HEARTBEAT_SECONDS = 30.0
 
 # /api/v1/proxy/nodes/{name}/exec/... — the relayed kubelet exec surface
 _EXEC_PROXY_RE = re.compile(r"/proxy/nodes/[^/]+/exec(/|$)")
-# pods/{name}/portforward — a GET in transport, a raw TCP channel into
-# the pod in effect (the reference requires the create verb on it)
-_PORTFORWARD_RE = re.compile(r"/pods/[^/]+/portforward$")
+# pods/{name}/portforward and /attach — a GET in transport, a raw
+# channel into the pod in effect (the reference requires the create
+# verb on both subresources)
+_PORTFORWARD_RE = re.compile(r"/pods/[^/]+/(portforward|attach)$")
 
 
 def _authz_target(path: str):
@@ -188,7 +189,8 @@ class ApiServer:
         long_running = (query.get("watch") in ("true", "1")
                         or query.get("follow") in ("true", "1")
                         or "/watch/" in path or path.endswith("/watch")
-                        or path.endswith("/portforward"))
+                        or path.endswith("/portforward")
+                        or path.endswith("/attach"))
         if not long_running and not self._inflight.acquire(blocking=False):
             self._send_error(h, TooManyRequests("too many requests in flight"))
             return
@@ -354,6 +356,8 @@ class ApiServer:
                 return self._serve_pod_log(h, namespace, name, query)
             if resource == "pods" and sub == "portforward":
                 return self._serve_port_forward(h, namespace, name, query)
+            if resource == "pods" and sub == "attach":
+                return self._serve_attach(h, namespace, name, query)
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
@@ -539,6 +543,39 @@ class ApiServer:
             up = wsstream.client_connect(split.hostname, split.port, path)
         except (ConnectionError, OSError) as e:
             raise BadGateway(f"kubelet portForward: {e}")
+        try:
+            if not wsstream.server_handshake(h):
+                return
+
+            def down_write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
+
+            wsstream.relay_ws(h.rfile.read, down_write, up)
+        finally:
+            up.close()
+            h.close_connection = True
+
+    def _serve_attach(self, h, namespace: str, name: str,
+                      query: dict) -> None:
+        """GET /pods/{name}/attach?container=&stdin=, websocket upgrade
+        relayed to the owning kubelet's /attach endpoint (ref:
+        pkg/registry/pod/etcd AttachREST -> kubelet AttachContainer)."""
+        import urllib.parse as _parse
+
+        from ..utils import wsstream
+        from .relay import resolve_pod_container
+
+        container, base = resolve_pod_container(
+            self.registry, namespace, name, query.get("container", ""))
+        params = {k: query[k] for k in ("stdin",) if k in query}
+        q = ("?" + _parse.urlencode(params)) if params else ""
+        split = _parse.urlsplit(base)
+        path = f"/attach/{namespace}/{name}/{container}{q}"
+        try:
+            up = wsstream.client_connect(split.hostname, split.port, path)
+        except (ConnectionError, OSError) as e:
+            raise BadGateway(f"kubelet attach: {e}")
         try:
             if not wsstream.server_handshake(h):
                 return

@@ -117,6 +117,12 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("cmd", nargs="+",
                     help="command and args (use -- before flags)")
 
+    at = sub.add_parser("attach", help="attach to a running container")
+    at.add_argument("pod")
+    at.add_argument("-c", "--container", default="")
+    at.add_argument("-i", "--stdin", action="store_true",
+                    help="pass this terminal's stdin to the container")
+
     pf = sub.add_parser("port-forward",
                         help="forward a local port to a pod port")
     pf.add_argument("pod")
@@ -518,6 +524,60 @@ class Kubectl:
             self.out.write(f"[{cs.name}] state={state} "
                            f"restarts={cs.restart_count}\n")
 
+    def attach(self, ns, pod_name, container="", stdin=False,
+               stdin_stream=None) -> int:
+        """kubectl attach: stream the container's live output (and feed
+        stdin with -i) over the websocket attach subresource (ref:
+        cmd/attach.go; SPDY there, RFC 6455 here).
+        stdin_stream: byte-stream override for tests (defaults to this
+        process's stdin buffer)."""
+        import codecs
+        import threading as _threading
+
+        from ..utils import wsstream
+        ws = self.client.attach_open(pod_name, ns, container, stdin=stdin)
+        # incremental decode: the kubelet's 64KiB frames split at
+        # arbitrary byte offsets, so a multi-byte character straddling a
+        # frame boundary must not decode fragment-by-fragment
+        decode = codecs.getincrementaldecoder("utf-8")(
+            errors="replace").decode
+        try:
+            if stdin:
+                src = stdin_stream if stdin_stream is not None \
+                    else sys.stdin.buffer
+
+                def pump_stdin():
+                    try:
+                        while True:
+                            data = src.read(4096)
+                            if not data:
+                                wsstream.write_frame(
+                                    ws.sendall, wsstream.EOF_MARKER,
+                                    wsstream.TEXT, mask=True)
+                                return
+                            wsstream.write_frame(ws.sendall, data,
+                                                 wsstream.BINARY,
+                                                 mask=True)
+                    except (ConnectionError, OSError, ValueError):
+                        pass
+
+                _threading.Thread(target=pump_stdin, daemon=True).start()
+            while True:
+                opcode, payload = wsstream.read_frame(ws.recv)
+                if opcode == wsstream.CLOSE:
+                    return 0
+                if opcode == wsstream.BINARY and payload:
+                    self.out.write(decode(payload))
+                    if hasattr(self.out, "flush"):
+                        self.out.flush()
+        except (ConnectionError, OSError) as e:
+            # a broken transport is a failure, not a clean detach (the
+            # reference kubectl reports it and exits non-zero)
+            self.err.write(f"error: attach transport: {e}\n")
+            return 1
+        finally:
+            ws.close()
+
     def port_forward(self, ns, pod_name, mapping, address="127.0.0.1",
                      block=True) -> int:
         """kubectl port-forward POD LOCAL:REMOTE (ref: cmd/portforward.go
@@ -659,6 +719,9 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         elif ns_args.command == "port-forward":
             return k.port_forward(ns, ns_args.pod, ns_args.mapping,
                                   ns_args.address)
+        elif ns_args.command == "attach":
+            return k.attach(ns, ns_args.pod, ns_args.container,
+                            ns_args.stdin)
         elif ns_args.command == "version":
             k.version()
         elif ns_args.command == "api-versions":

@@ -316,6 +316,9 @@ class Container:
     privileged: bool = False
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    # ref: pkg/api/types.go:813 Container.Stdin — only stdin:true
+    # containers get a stdin pipe to attach to
+    stdin: bool = False
 
 
 @dataclass

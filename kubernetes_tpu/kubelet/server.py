@@ -142,6 +142,8 @@ class KubeletServer:
                 return self._exec(h, path, query)
             if path.startswith("/portForward/"):
                 return self._port_forward(h, path, query)
+            if path.startswith("/attach/"):
+                return self._attach(h, path, query)
             self._raw(h, 404, f"not found: {path}".encode(), "text/plain")
         except KeyError as e:
             self._raw(h, 404, str(e).encode(), "text/plain")
@@ -273,6 +275,96 @@ class KubeletServer:
             wsstream.bridge(h.rfile.read, write, sock, pod_side=True)
         finally:
             sock.close()
+            h.close_connection = True
+
+    def _attach(self, h, path: str, query: dict) -> None:
+        """GET /attach/{ns}/{pod}/{container}[?stdin=true], websocket:
+        the container's NEW output streams out as binary frames (attach
+        starts at now — logs replays history, attach does not), and with
+        ?stdin=true client binary frames feed the container's stdin
+        (ref: pkg/kubelet/server.go AttachContainer; SPDY there, RFC
+        6455 here)."""
+        import time as _time
+
+        from ..utils import wsstream
+
+        ns, pod_name, container = self._split_target(path, "/attach/")
+        pod = self._find_pod(ns, pod_name)
+        uid = pod.metadata.uid
+        if not hasattr(self.runtime, "container_log_path"):
+            return self._raw(h, 501,
+                             b"runtime does not support attach",
+                             "text/plain")
+        log_path = self.runtime.container_log_path(uid, container)
+        want_stdin = query.get("stdin", ["false"])[0] in ("true", "1")
+        # Open + seek-to-end BEFORE answering 101: the client may send
+        # stdin the instant the handshake lands, and if the seek ran
+        # after the container echoed it, that output would sit behind
+        # the read position forever. Seeking first can only over-include
+        # (a few pre-attach bytes), never lose post-attach output.
+        log_file = open(log_path, "rb")
+        log_file.seek(0, 2)
+        if not wsstream.server_handshake(h):
+            log_file.close()
+            return
+        stop = threading.Event()
+        wlock = threading.Lock()
+
+        def write(b: bytes) -> None:
+            with wlock:  # output pump and the final CLOSE share the pipe
+                h.wfile.write(b)
+                h.wfile.flush()
+
+        def out_pump():
+            try:
+                with log_file as f:
+                    while not stop.is_set():
+                        data = f.read(65536)
+                        if data:
+                            wsstream.write_frame(write, data,
+                                                 wsstream.BINARY)
+                            continue
+                        if not self.runtime.container_running(uid,
+                                                              container):
+                            # final drain: output written between the
+                            # empty read and the exit check must not
+                            # race away (same move _follow_logs makes)
+                            data = f.read(65536)
+                            if data:
+                                wsstream.write_frame(write, data,
+                                                     wsstream.BINARY)
+                            break
+                        _time.sleep(0.1)
+            except (ConnectionError, OSError, ValueError):
+                pass
+            finally:
+                try:
+                    wsstream.write_frame(write, b"", wsstream.CLOSE)
+                except (ConnectionError, OSError, ValueError):
+                    pass
+
+        pump = threading.Thread(target=out_pump, daemon=True)
+        pump.start()
+        try:
+            while True:
+                opcode, payload = wsstream.read_frame(h.rfile.read)
+                if opcode == wsstream.CLOSE:
+                    break
+                if opcode == wsstream.TEXT and \
+                        payload == wsstream.EOF_MARKER:
+                    if want_stdin and hasattr(self.runtime, "close_stdin"):
+                        self.runtime.close_stdin(uid, container)
+                    continue
+                if opcode == wsstream.BINARY and payload and want_stdin:
+                    try:
+                        self.runtime.write_stdin(uid, container, payload)
+                    except (KeyError, OSError):
+                        break  # container gone / stdin closed
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            stop.set()
+            pump.join(timeout=5)
             h.close_connection = True
 
     def _exec(self, h, path: str, query: dict) -> None:

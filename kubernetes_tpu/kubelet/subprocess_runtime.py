@@ -85,9 +85,16 @@ class SubprocessRuntime(Runtime):
             log = open(log_path, "ab")
             try:
                 # each container leads its own session so kill targets the
-                # whole process tree (the pod "cgroup")
+                # whole process tree (the pod "cgroup"). stdin: a pipe
+                # only for stdin:true containers (types.go:813 — that is
+                # what `kubectl attach -i` reaches); everything else gets
+                # devnull, so stdin-until-EOF commands exit promptly
+                # instead of blocking on a never-closed pipe
                 popen = subprocess.Popen(
-                    cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
+                    cmd,
+                    stdin=(subprocess.PIPE if container.stdin
+                           else subprocess.DEVNULL),
+                    stdout=log, stderr=subprocess.STDOUT, env=env,
                     cwd=self.root_dir, start_new_session=True)
             except OSError as e:
                 raise RuntimeError(
@@ -134,6 +141,25 @@ class SubprocessRuntime(Runtime):
         with self._lock:
             proc = self._procs.get((pod_uid, name))
         return proc is not None and proc.popen.poll() is None
+
+    def write_stdin(self, pod_uid: str, name: str, data: bytes) -> None:
+        """(ref: AttachContainer's stdin stream — dockertools attaches
+        to the container's stdin; here it is the child's pipe)"""
+        with self._lock:
+            proc = self._procs.get((pod_uid, name))
+        if proc is None or proc.popen.stdin is None:
+            raise KeyError(f"container {name!r} has no stdin")
+        proc.popen.stdin.write(data)
+        proc.popen.stdin.flush()
+
+    def close_stdin(self, pod_uid: str, name: str) -> None:
+        with self._lock:
+            proc = self._procs.get((pod_uid, name))
+        if proc is not None and proc.popen.stdin is not None:
+            try:
+                proc.popen.stdin.close()
+            except OSError:
+                pass
 
     def get_container_logs(self, pod_uid: str, name: str,
                            tail_lines: int = 0) -> str:

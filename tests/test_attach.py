@@ -1,0 +1,179 @@
+"""kubectl attach over websockets against a REAL process.
+
+Reference: pkg/kubelet/server.go AttachContainer + cmd/attach.go. The
+pod here is a live `cat` process under the subprocess runtime: bytes
+written to attach-stdin come back as attach-output, proving the whole
+chain (stdin frames -> container stdin pipe -> process -> log file ->
+output frames) and the attach-starts-at-now contract.
+"""
+
+import io
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.kubelet.server import KubeletServer
+from kubernetes_tpu.kubelet.subprocess_runtime import SubprocessRuntime
+from kubernetes_tpu.utils import wsstream
+
+
+@pytest.fixture()
+def cat_cluster(tmp_path):
+    registry = Registry()
+    client = InProcClient(registry)
+    runtime = SubprocessRuntime(root_dir=str(tmp_path))
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="cat", namespace="default",
+                                uid="uid-at"),
+        spec=api.PodSpec(node_name="node-1", containers=[
+            api.Container(name="main", image="busybox",
+                          command=["cat"], stdin=True)]))
+    runtime.start_container(pod, pod.spec.containers[0])
+    ksrv = KubeletServer(
+        "node-1", lambda: [pod], runtime,
+        lambda: {"cpu": parse_quantity("4")}).start()
+    client.create("nodes", api.Node(
+        metadata=api.ObjectMeta(name="node-1"),
+        status=api.NodeStatus(
+            addresses=[api.NodeAddress(type="InternalIP",
+                                       address="127.0.0.1")],
+            daemon_endpoints=api.NodeDaemonEndpoints(
+                kubelet_endpoint=api.DaemonEndpoint(port=ksrv.port)))))
+    client.create("pods", pod)
+    yield registry, client, runtime
+    ksrv.stop()
+    runtime.kill_pod("uid-at")
+
+
+def _read_output(ws, want: bytes, timeout=10.0) -> bytes:
+    got = b""
+    deadline = time.time() + timeout
+    ws.settimeout(2.0)  # a blocking read would mask missing output
+    while want not in got and time.time() < deadline:
+        try:
+            opcode, payload = wsstream.read_frame(ws.recv)
+        except (TimeoutError, ConnectionError, OSError):
+            continue
+        if opcode == wsstream.CLOSE:
+            break
+        if opcode == wsstream.BINARY:
+            got += payload
+    return got
+
+
+def test_attach_stdin_roundtrip_inproc(cat_cluster):
+    _registry, client, _runtime = cat_cluster
+    ws = client.attach_open("cat", "default", stdin=True)
+    try:
+        wsstream.write_frame(ws.sendall, b"hello attach\n",
+                             wsstream.BINARY, mask=True)
+        assert b"hello attach\n" in _read_output(ws, b"hello attach\n")
+    finally:
+        ws.close()
+
+
+def test_attach_streams_only_new_output(cat_cluster):
+    """attach begins at 'now': output written before the attach must not
+    replay (that is `logs`' job)."""
+    _registry, client, runtime = cat_cluster
+    runtime.write_stdin("uid-at", "main", b"before attach\n")
+    time.sleep(0.3)  # let cat echo it into the log
+    ws = client.attach_open("cat", "default", stdin=True)
+    try:
+        wsstream.write_frame(ws.sendall, b"after\n", wsstream.BINARY,
+                             mask=True)
+        got = _read_output(ws, b"after\n")
+        assert b"after\n" in got
+        assert b"before attach" not in got
+    finally:
+        ws.close()
+
+
+def test_attach_through_apiserver_relay(cat_cluster):
+    registry, _client, _runtime = cat_cluster
+    asrv = ApiServer(registry).start()
+    try:
+        http = HttpClient(asrv.url)
+        ws = http.attach_open("cat", "default", stdin=True)
+        try:
+            wsstream.write_frame(ws.sendall, b"via relay\n",
+                                 wsstream.BINARY, mask=True)
+            assert b"via relay\n" in _read_output(ws, b"via relay\n")
+        finally:
+            ws.close()
+    finally:
+        asrv.stop()
+
+
+def test_kubectl_attach_command(cat_cluster):
+    """The CLI: -i feeds a byte stream, output lands on stdout, the
+    stream ends when stdin EOF stops `cat`."""
+    from kubernetes_tpu.cli.cmd import Kubectl
+    _registry, client, _runtime = cat_cluster
+    out = io.StringIO()
+    k = Kubectl(client, out=out)
+    rc = k.attach("default", "cat", stdin=True,
+                  stdin_stream=io.BytesIO(b"typed into cat\n"))
+    assert rc == 0
+    assert "typed into cat" in out.getvalue()
+
+
+def test_no_stdin_container_reads_eof_immediately(tmp_path):
+    """A stdin-until-EOF command WITHOUT stdin:true gets devnull and
+    exits promptly (types.go:813 — only stdin containers hold a pipe);
+    with stdin:true the same command stays alive on the open pipe."""
+    runtime = SubprocessRuntime(root_dir=str(tmp_path))
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="w", namespace="default", uid="u-e"),
+        spec=api.PodSpec(containers=[
+            api.Container(name="nostdin", image="b", command=["cat"]),
+            api.Container(name="stdin", image="b", command=["cat"],
+                          stdin=True)]))
+    try:
+        runtime.start_container(pod, pod.spec.containers[0])
+        runtime.start_container(pod, pod.spec.containers[1])
+        deadline = time.time() + 10
+        while runtime.container_running("u-e", "nostdin") and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert not runtime.container_running("u-e", "nostdin")
+        assert runtime.container_running("u-e", "stdin")
+        with pytest.raises(KeyError):
+            runtime.write_stdin("u-e", "nostdin", b"x")
+    finally:
+        runtime.kill_pod("u-e")
+
+
+def test_attach_unsupported_runtime_is_clean(cat_cluster):
+    """A runtime without log files answers 501, surfacing as a failed
+    upgrade rather than a hang."""
+    from kubernetes_tpu.kubelet.container import FakeRuntime
+    registry, client, _runtime = cat_cluster
+    fake = FakeRuntime()
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="fakepod", namespace="default",
+                                uid="uid-fake"),
+        spec=api.PodSpec(node_name="node-2", containers=[
+            api.Container(name="c", image="img")]))
+    fake.start_container(pod, pod.spec.containers[0])
+    ksrv = KubeletServer("node-2", lambda: [pod], fake,
+                         lambda: {"cpu": parse_quantity("1")}).start()
+    try:
+        client.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="node-2"),
+            status=api.NodeStatus(
+                addresses=[api.NodeAddress(type="InternalIP",
+                                           address="127.0.0.1")],
+                daemon_endpoints=api.NodeDaemonEndpoints(
+                    kubelet_endpoint=api.DaemonEndpoint(port=ksrv.port)))))
+        client.create("pods", pod)
+        with pytest.raises((ConnectionError, OSError)):
+            ws = client.attach_open("fakepod", "default")
+            ws.close()
+    finally:
+        ksrv.stop()

@@ -54,8 +54,9 @@ def terminate(proc):
         proc.send_signal(signal.SIGTERM)
         try:
             # generous: under full-suite load XLA compiles can hog every
-            # core while a component unwinds
-            proc.wait(timeout=60)
+            # core while a component unwinds (measured >60s flakes when
+            # the device-parity suite compiles concurrently)
+            proc.wait(timeout=180)
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10)

@@ -329,49 +329,44 @@ class HttpClient(Client):
         ns = namespace or "default"
         return self._decode(self._do("DELETE", self._url(resource, ns, name)))
 
-    def portforward_open(self, name, namespace, port):
-        """-> an upgraded websocket socket through the apiserver's
-        portforward relay (the remote-kubectl leg). Carries the same
-        credentials and TLS posture as every other request: the
-        kubeconfig headers ride the upgrade, and an https base_url
-        wraps the socket with this client's ssl_context."""
+    def _ws_connect(self, path: str):
+        """Upgrade a websocket to the apiserver carrying this client's
+        credentials and TLS posture (the same posture every other
+        request gets from _do) — the one place the scheme/port/ssl
+        defaulting lives for upgraded streams."""
         import urllib.parse as up
         from ..utils import wsstream
         split = up.urlsplit(self.base_url)
-        ns = namespace or "default"
         port_num = split.port or (443 if split.scheme == "https" else 80)
         ctx = None
         if split.scheme == "https":
             import ssl as _ssl
             ctx = self.ssl_context or _ssl.create_default_context()
-        return wsstream.client_connect(
-            split.hostname, port_num,
+        return wsstream.client_connect(split.hostname, port_num, path,
+                                       headers=self.headers,
+                                       ssl_context=ctx)
+
+    def portforward_open(self, name, namespace, port):
+        """-> an upgraded websocket socket through the apiserver's
+        portforward relay (the remote-kubectl leg)."""
+        ns = namespace or "default"
+        return self._ws_connect(
             f"/api/v1/namespaces/{ns}/pods/{name}/portforward"
-            f"?port={port}",
-            headers=self.headers, ssl_context=ctx)
+            f"?port={port}")
 
     def attach_open(self, name, namespace, container="", stdin=False):
         """-> an upgraded websocket through the apiserver's attach
         relay."""
         import urllib.parse as up
-        from ..utils import wsstream
-        split = up.urlsplit(self.base_url)
         ns = namespace or "default"
-        port_num = split.port or (443 if split.scheme == "https" else 80)
-        ctx = None
-        if split.scheme == "https":
-            import ssl as _ssl
-            ctx = self.ssl_context or _ssl.create_default_context()
         params = {}
         if container:
             params["container"] = container
         if stdin:
             params["stdin"] = "true"
         q = ("?" + up.urlencode(params)) if params else ""
-        return wsstream.client_connect(
-            split.hostname, port_num,
-            f"/api/v1/namespaces/{ns}/pods/{name}/attach{q}",
-            headers=self.headers, ssl_context=ctx)
+        return self._ws_connect(
+            f"/api/v1/namespaces/{ns}/pods/{name}/attach{q}")
 
     def watch(self, resource, namespace="", since_rev=None,
               label_selector="", field_selector=""):

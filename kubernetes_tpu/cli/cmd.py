@@ -117,6 +117,43 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("cmd", nargs="+",
                     help="command and args (use -- before flags)")
 
+    rp = sub.add_parser("replace", help="replace a resource from a file")
+    rp.add_argument("-f", "--filename", required=True)
+    rp.add_argument("--force", action="store_true",
+                    help="delete and re-create instead of updating")
+
+    pt = sub.add_parser("patch",
+                        help="update fields with a strategic merge patch")
+    pt.add_argument("args", nargs=2, metavar=("TYPE", "NAME"))
+    pt.add_argument("-p", "--patch", required=True,
+                    help="the patch as a JSON object")
+
+    st = sub.add_parser("stop",
+                        help="gracefully shut down a resource "
+                             "(scales controllers to 0 first)")
+    st.add_argument("args", nargs="*")
+    st.add_argument("-f", "--filename", default="")
+
+    ed = sub.add_parser("edit", help="edit a resource in $EDITOR")
+    ed.add_argument("args", nargs=2, metavar=("TYPE", "NAME"))
+
+    xp = sub.add_parser("explain",
+                        help="documentation for a resource's fields")
+    xp.add_argument("path", help="RESOURCE[.field.path], e.g. "
+                                 "pods.spec.containers")
+
+    cv = sub.add_parser("convert",
+                        help="normalize a manifest to the served version")
+    cv.add_argument("-f", "--filename", required=True)
+
+    px = sub.add_parser("proxy", help="run a local proxy to the apiserver")
+    px.add_argument("--port", type=int, default=8001)
+    px.add_argument("--address", default="127.0.0.1")
+
+    nsd = sub.add_parser("namespace",
+                         help="(deprecated) show or set the namespace")
+    nsd.add_argument("name", nargs="?")
+
     at = sub.add_parser("attach", help="attach to a running container")
     at.add_argument("pod")
     at.add_argument("-c", "--container", default="")
@@ -159,6 +196,19 @@ def _find_kv_split(args: List[str]):
                     len(args))
     targets = parse_resource_args(args[:kv_start])
     return targets, args[kv_start:]
+
+
+def _apply_null_deletes(patch, merged) -> None:
+    """Strategic-merge patch semantics: an explicit null in the patch
+    DELETES the key (patch.go); merge_maps (built for 3-way apply,
+    where deletion is original-vs-modified) assigns the None through,
+    so the patch verb strips those keys afterwards. List entries are
+    replaced wholesale by merge keys and need no null handling here."""
+    for key, val in patch.items():
+        if val is None:
+            merged.pop(key, None)
+        elif isinstance(val, dict) and isinstance(merged.get(key), dict):
+            _apply_null_deletes(val, merged[key])
 
 
 class Kubectl:
@@ -524,6 +574,207 @@ class Kubectl:
             self.out.write(f"[{cs.name}] state={state} "
                            f"restarts={cs.restart_count}\n")
 
+    def replace(self, ns, filename, force=False) -> None:
+        """kubectl replace: full update from a manifest (ref:
+        cmd/replace.go — PUT semantics; --force deletes and re-creates,
+        resetting resourceVersion/uid)."""
+        for obj in load_manifest(filename, self.scheme):
+            resource = resource_for_object(obj, self.scheme)
+            target_ns = obj.metadata.namespace or ns
+            if force:
+                try:
+                    self.client.delete(resource, obj.metadata.name,
+                                       target_ns)
+                except NotFound:
+                    pass
+                self.client.create(resource, obj, target_ns)
+                self.out.write(f"{resource}/{obj.metadata.name} "
+                               f"replaced (forced)\n")
+                continue
+            live = self.client.get(resource, obj.metadata.name, target_ns)
+            # PUT needs the optimistic-concurrency token of the live
+            # object unless the manifest pinned one itself
+            if not obj.metadata.resource_version:
+                obj.metadata.resource_version = \
+                    live.metadata.resource_version
+            self.client.update(resource, obj, target_ns)
+            self.out.write(f"{resource}/{obj.metadata.name} replaced\n")
+
+    def patch(self, ns, args, patch_json) -> None:
+        """kubectl patch: strategic-merge a JSON fragment onto the live
+        object (ref: cmd/patch.go; patch semantics from
+        pkg/util/strategicpatch — map-lists merge by key, null
+        deletes)."""
+        import json as jsonlib
+
+        from ..utils.strategicpatch import merge_maps
+        resource, name = parse_resource_args(args)[0]
+        try:
+            patch = jsonlib.loads(patch_json)
+        except jsonlib.JSONDecodeError as e:
+            raise ApiError(f"invalid patch: {e}")
+        if not isinstance(patch, dict):
+            raise ApiError("patch must be a JSON object")
+        live = self.client.get(resource, name, ns)
+        merged = merge_maps({}, patch, self.scheme.encode_dict(live))
+        _apply_null_deletes(patch, merged)
+        obj = self.scheme.decode_dict(merged)
+        # keep the live concurrency token: a conflicting writer between
+        # our read and write must surface as 409
+        obj.metadata.resource_version = live.metadata.resource_version
+        self.client.update(resource, obj, ns)
+        self.out.write(f"{resource}/{name} patched\n")
+
+    def stop(self, ns, args, filename="") -> None:
+        """kubectl stop: graceful shutdown — controllers scale to 0
+        before deletion so their pods terminate first (ref:
+        pkg/kubectl/stop.go ReplicationControllerReaper)."""
+        targets = []
+        if filename:
+            for obj in load_manifest(filename, self.scheme):
+                targets.append((resource_for_object(obj, self.scheme),
+                                obj.metadata.name,
+                                obj.metadata.namespace or ns))
+        else:
+            for resource, name in parse_resource_args(args):
+                if name is None:
+                    raise ApiError("stop requires TYPE NAME")
+                targets.append((resource, name, ns))
+        import time as _time
+        for resource, name, target_ns in targets:
+            if resource == "replicationcontrollers":
+                rc = self.client.get(resource, name, target_ns)
+                # never mutate a cached object: stored objects are frozen
+                self.client.update(
+                    resource,
+                    replace(rc, spec=replace(rc.spec, replicas=0)),
+                    target_ns)
+                # wait for the manager to observe the scale-down before
+                # deleting (stop.go's reaper does exactly this) — delete
+                # racing the controller's informer would orphan the pods
+                deadline = _time.time() + 30
+                while _time.time() < deadline:
+                    live = self.client.get(resource, name, target_ns)
+                    if live.status.replicas == 0:
+                        break
+                    _time.sleep(0.1)
+            self.client.delete(resource, name, target_ns)
+            self.out.write(f"{resource}/{name} stopped\n")
+
+    def edit(self, ns, args) -> int:
+        """kubectl edit: round the live object through $EDITOR, update
+        on change (ref: cmd/edit.go)."""
+        import json as jsonlib
+        import os as _os
+        import subprocess as _subprocess
+        import tempfile as _tempfile
+
+        resource, name = parse_resource_args(args)[0]
+        live = self.client.get(resource, name, ns)
+        doc = jsonlib.dumps(self.scheme.encode_dict(live), indent=2,
+                            sort_keys=True)
+        editor = _os.environ.get("EDITOR", "vi")
+        with _tempfile.NamedTemporaryFile(
+                mode="w+", suffix=".json", delete=False) as f:
+            f.write(doc)
+            path = f.name
+        try:
+            rc = _subprocess.call(f"{editor} {path}", shell=True)
+            if rc != 0:
+                self.err.write(f"error: editor exited {rc}\n")
+                return 1
+            edited = open(path).read()
+        finally:
+            _os.unlink(path)
+        if edited.strip() == doc.strip():
+            self.out.write("Edit cancelled, no changes made.\n")
+            return 0
+        obj = self.scheme.decode_dict(jsonlib.loads(edited))
+        self.client.update(resource, obj, ns)
+        self.out.write(f"{resource}/{name} edited\n")
+        return 0
+
+    def explain(self, path) -> None:
+        """kubectl explain: field documentation reflected from the
+        API dataclasses (ref: cmd/explain.go over swagger models; our
+        swagger reflects from the same classes, so this cannot
+        drift)."""
+        import dataclasses as _dc
+        import typing as _typing
+
+        from ..api.registry import Registry
+        parts = path.split(".")
+        info = Registry.info(parts[0])
+        cls = info.cls
+        for seg in parts[1:]:
+            hints = _typing.get_type_hints(cls)
+            if seg not in hints:
+                raise ApiError(f"field {seg!r} does not exist in "
+                               f"{cls.__name__}")
+            tp = hints[seg]
+            # unwrap Optional[X] / List[X] to the element type
+            for _ in range(3):
+                args = _typing.get_args(tp)
+                if args:
+                    tp = next((a for a in args if a is not type(None)),
+                              tp)
+                else:
+                    break
+            cls = tp
+        self.out.write(f"KIND:     {info.kind}\n")
+        self.out.write(f"RESOURCE: {path}\n\n")
+        if getattr(cls, "__doc__", None):
+            first = (cls.__doc__ or "").strip().splitlines()
+            if first:
+                self.out.write(f"DESCRIPTION:\n  {first[0]}\n\n")
+        if _dc.is_dataclass(cls):
+            self.out.write("FIELDS:\n")
+            hints = _typing.get_type_hints(cls)
+            for fld in _dc.fields(cls):
+                tname = getattr(hints[fld.name], "__name__",
+                                str(hints[fld.name]))
+                self.out.write(f"  {fld.name}\t<{tname}>\n")
+        else:
+            self.out.write(f"TYPE: {getattr(cls, '__name__', cls)}\n")
+
+    def convert(self, filename) -> None:
+        """kubectl convert: normalize a manifest through the served
+        codec (one wire version here, so convert == canonicalize)."""
+        import json as jsonlib
+        for obj in load_manifest(filename, self.scheme):
+            self.out.write(jsonlib.dumps(
+                self.scheme.encode_dict(obj), indent=2, sort_keys=True)
+                + "\n")
+
+    def proxy(self, address="127.0.0.1", port=8001, block=True):
+        """kubectl proxy: a local HTTP server relaying every request to
+        the apiserver with this client's credentials (ref:
+        cmd/proxy.go)."""
+        from .proxy import ApiProxy
+        base = getattr(self.client, "base_url", None)
+        if not base:
+            raise ApiError("proxy requires an apiserver URL (-s)")
+        srv = ApiProxy(self.client, address, port).start()
+        self.out.write(f"Starting to serve on {address}:{srv.port}\n")
+        if hasattr(self.out, "flush"):
+            self.out.flush()
+        if not block:
+            self._proxy_server = srv  # tests stop it explicitly
+            return 0
+        try:
+            while True:
+                srv.join(1.0)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            srv.stop()
+
+    def namespace_cmd(self, name=None) -> None:
+        """(ref: cmd/namespace.go — deprecated in the reference too)"""
+        self.out.write(
+            "namespace has been superseded by context switching; "
+            "use kubeconfig contexts to select a namespace\n")
+
     def attach(self, ns, pod_name, container="", stdin=False,
                stdin_stream=None) -> int:
         """kubectl attach: stream the container's live output (and feed
@@ -549,7 +800,13 @@ class Kubectl:
                 def pump_stdin():
                     try:
                         while True:
-                            data = src.read(4096)
+                            # read1: forward whatever the terminal has —
+                            # BufferedReader.read(n) would block until n
+                            # bytes amass and typed input would never
+                            # reach the container
+                            data = (src.read1(4096)
+                                    if hasattr(src, "read1")
+                                    else src.read(4096))
                             if not data:
                                 wsstream.write_frame(
                                     ws.sendall, wsstream.EOF_MARKER,
@@ -570,6 +827,8 @@ class Kubectl:
                     self.out.write(decode(payload))
                     if hasattr(self.out, "flush"):
                         self.out.flush()
+        except KeyboardInterrupt:
+            return 0  # Ctrl-C is the detach gesture, not an error
         except (ConnectionError, OSError) as e:
             # a broken transport is a failure, not a clean detach (the
             # reference kubectl reports it and exits non-zero)
@@ -722,6 +981,22 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         elif ns_args.command == "attach":
             return k.attach(ns, ns_args.pod, ns_args.container,
                             ns_args.stdin)
+        elif ns_args.command == "replace":
+            k.replace(ns, ns_args.filename, ns_args.force)
+        elif ns_args.command == "patch":
+            k.patch(ns, ns_args.args, ns_args.patch)
+        elif ns_args.command == "stop":
+            k.stop(ns, ns_args.args, ns_args.filename)
+        elif ns_args.command == "edit":
+            return k.edit(ns, ns_args.args)
+        elif ns_args.command == "explain":
+            k.explain(ns_args.path)
+        elif ns_args.command == "convert":
+            k.convert(ns_args.filename)
+        elif ns_args.command == "proxy":
+            return k.proxy(ns_args.address, ns_args.port)
+        elif ns_args.command == "namespace":
+            k.namespace_cmd(ns_args.name)
         elif ns_args.command == "version":
             k.version()
         elif ns_args.command == "api-versions":

@@ -1,0 +1,108 @@
+"""kubectl proxy — a local unauthenticated door to the apiserver.
+
+Reference: pkg/kubectl/proxy.go + cmd/proxy.go: a local HTTP listener
+forwards every request to the apiserver, attaching the client's
+credentials, so local tools can speak plain HTTP to 127.0.0.1. Watches
+stream through (the relay copies chunks as they arrive)."""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+# hop-by-hop headers must not be forwarded verbatim (RFC 7230 §6.1)
+_HOP = {"connection", "keep-alive", "transfer-encoding", "upgrade",
+        "proxy-authenticate", "proxy-authorization", "te", "trailers",
+        "host", "content-length"}
+
+
+class ApiProxy:
+    def __init__(self, client, address: str = "127.0.0.1",
+                 port: int = 8001):
+        self.client = client
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _relay(self, method):
+                proxy._relay(self, method)
+
+            def do_GET(self):
+                self._relay("GET")
+
+            def do_POST(self):
+                self._relay("POST")
+
+            def do_PUT(self):
+                self._relay("PUT")
+
+            def do_DELETE(self):
+                self._relay("DELETE")
+
+        self.httpd = ThreadingHTTPServer((address, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _relay(self, h, method: str) -> None:
+        url = self.client.base_url + h.path
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else None
+        headers = {k: v for k, v in h.headers.items()
+                   if k.lower() not in _HOP}
+        headers.update(self.client.headers)  # the credential role
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method=method)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None,
+                context=getattr(self.client, "ssl_context", None))
+        except urllib.error.HTTPError as e:
+            resp = e  # relay the apiserver's status verbatim
+        except (urllib.error.URLError, OSError) as e:
+            h.send_response(502)
+            msg = f"apiserver unreachable: {e}".encode()
+            h.send_header("Content-Length", str(len(msg)))
+            h.end_headers()
+            h.wfile.write(msg)
+            return
+        try:
+            status = getattr(resp, "status", getattr(resp, "code", 200))
+            h.send_response(status)
+            ctype = resp.headers.get("Content-Type", "application/json")
+            h.send_header("Content-Type", ctype)
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            while True:
+                piece = resp.read1(65536)
+                if not piece:
+                    break
+                h.wfile.write(f"{len(piece):x}\r\n".encode())
+                h.wfile.write(piece + b"\r\n")
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            h.close_connection = True
+        finally:
+            resp.close()
+
+    def start(self) -> "ApiProxy":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -293,3 +293,215 @@ class TestDescribeAndMisc:
         client.create("pods", mkpod("w"), "default")
         code, out, _ = run_cli(client, "logs", "w")
         assert code == 0 and "state=running" in out
+
+
+class TestV11CommandParity:
+    """replace / patch / stop / edit / explain / convert / proxy /
+    namespace (ref: cmd.go:151-183's full v1.1 command tree)."""
+
+    def _manifest(self, tmp_path, obj_dict):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps(obj_dict))
+        return str(p)
+
+    def test_replace_updates_from_file(self, cluster, tmp_path):
+        _, client = cluster
+        client.create("pods", mkpod("web"), "default")
+        path = self._manifest(tmp_path, {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "web", "namespace": "default",
+                         "labels": {"tier": "prod"}},
+            "spec": {"nodeName": "n1",
+                     "containers": [{"name": "c", "image": "img:v2"}]}})
+        code, out, _ = run_cli(client, "replace", "-f", path)
+        assert code == 0 and "replaced" in out
+        live = client.get("pods", "web", "default")
+        assert live.spec.containers[0].image == "img:v2"
+        assert live.metadata.labels == {"tier": "prod"}
+
+    def test_replace_force_recreates(self, cluster, tmp_path):
+        _, client = cluster
+        client.create("pods", mkpod("web"), "default")
+        old_uid = client.get("pods", "web", "default").metadata.uid
+        path = self._manifest(tmp_path, {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "img"}]}})
+        code, out, _ = run_cli(client, "replace", "-f", path, "--force")
+        assert code == 0 and "forced" in out
+        assert client.get("pods", "web", "default").metadata.uid != old_uid
+
+    def test_patch_strategic_merge(self, cluster):
+        _, client = cluster
+        client.create("pods", mkpod("web", labels={"app": "x"}), "default")
+        code, out, _ = run_cli(
+            client, "patch", "pod", "web", "-p",
+            '{"metadata": {"labels": {"extra": "y"}}}')
+        assert code == 0 and "patched" in out
+        live = client.get("pods", "web", "default")
+        # strategic merge: existing labels survive, the patch adds
+        assert live.metadata.labels == {"app": "x", "extra": "y"}
+
+    def test_patch_merges_container_list_by_name(self, cluster):
+        _, client = cluster
+        client.create("pods", mkpod("web"), "default")
+        code, _, _ = run_cli(
+            client, "patch", "pod", "web", "-p",
+            '{"spec": {"containers": [{"name": "c", "image": "img:v3"}]}}')
+        assert code == 0
+        live = client.get("pods", "web", "default")
+        assert len(live.spec.containers) == 1
+        assert live.spec.containers[0].image == "img:v3"
+
+    def test_patch_null_deletes_key(self, cluster):
+        """Strategic-merge: an explicit null removes the key entirely
+        (patch.go), it must not survive as a None value."""
+        _, client = cluster
+        client.create("pods", mkpod("web", labels={"app": "x",
+                                                   "extra": "y"}),
+                      "default")
+        code, _, _ = run_cli(
+            client, "patch", "pod", "web", "-p",
+            '{"metadata": {"labels": {"extra": null}}}')
+        assert code == 0
+        live = client.get("pods", "web", "default")
+        assert live.metadata.labels == {"app": "x"}
+
+    def test_stop_waits_for_live_manager_scale_down(self, cluster):
+        """With a running ReplicationManager, stop must not orphan the
+        RC's pods: the reaper waits for observed replicas==0 before
+        deleting (pkg/kubectl/stop.go)."""
+        from kubernetes_tpu.controllers.replication import (
+            ReplicationManager)
+        _, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="rcl", namespace="default",
+                                    labels={"app": "live"}),
+            spec=api.ReplicationControllerSpec(
+                replicas=2, selector={"app": "live"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "live"}),
+                    spec=api.PodSpec(containers=[api.Container(
+                        name="c", image="i")])))), "default")
+        mgr = ReplicationManager(client).run()
+        try:
+            import time
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                pods, _ = client.list("pods", "default",
+                                      label_selector="app=live")
+                if len(pods) == 2:
+                    break
+                time.sleep(0.05)
+            code, out, _ = run_cli(client, "stop", "rc", "rcl")
+            assert code == 0 and "stopped" in out
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                pods, _ = client.list("pods", "default",
+                                      label_selector="app=live")
+                if not pods:
+                    break
+                time.sleep(0.05)
+            assert not pods, f"orphaned pods: {[p.metadata.name for p in pods]}"
+        finally:
+            mgr.stop()
+
+    def test_stop_scales_rc_to_zero_then_deletes(self, cluster):
+        registry, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc1", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"app": "w"})), "default")
+        seen = []
+        w = client.watch("replicationcontrollers", "default")
+        code, out, _ = run_cli(client, "stop", "rc", "rc1")
+        assert code == 0 and "stopped" in out
+        while True:
+            ev = w.next(timeout=1)
+            if ev is None:
+                break
+            seen.append((ev.type, ev.object.spec.replicas))
+        w.stop()
+        # the scale-to-0 write lands before the delete (the reaper order)
+        assert ("MODIFIED", 0) in seen
+        assert seen[-1][0] == "DELETED"
+        from kubernetes_tpu.core.errors import NotFound as NF
+        with pytest.raises(NF):
+            client.get("replicationcontrollers", "rc1", "default")
+
+    def test_edit_roundtrip(self, cluster, tmp_path, monkeypatch):
+        _, client = cluster
+        client.create("pods", mkpod("web"), "default")
+        # an "editor" that rewrites the image in place
+        editor = tmp_path / "ed.sh"
+        editor.write_text(
+            "#!/bin/sh\nsed -i 's/img/img:edited/' \"$1\"\n")
+        editor.chmod(0o755)
+        monkeypatch.setenv("EDITOR", str(editor))
+        code, out, _ = run_cli(client, "edit", "pod", "web")
+        assert code == 0 and "edited" in out
+        assert client.get("pods", "web",
+                          "default").spec.containers[0].image == "img:edited"
+
+    def test_explain_walks_fields(self, cluster):
+        _, client = cluster
+        code, out, _ = run_cli(client, "explain", "pods.spec.containers")
+        assert code == 0
+        assert "KIND:     Pod" in out
+        assert "image" in out and "resources" in out
+
+    def test_convert_canonicalizes(self, cluster, tmp_path):
+        _, client = cluster
+        path = self._manifest(tmp_path, {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "x"},
+            "spec": {"containers": [{"name": "c", "image": "i"}]}})
+        code, out, _ = run_cli(client, "convert", "-f", path)
+        assert code == 0
+        doc = json.loads(out)
+        assert doc["kind"] == "Pod" and doc["metadata"]["name"] == "x"
+
+    def test_namespace_deprecation(self, cluster):
+        _, client = cluster
+        code, out, _ = run_cli(client, "namespace")
+        assert code == 0 and "superseded" in out
+
+
+class TestProxy:
+    def test_proxy_relays_with_credentials(self):
+        """kubectl proxy: local plain-HTTP door, credentials attached
+        upstream (the reference's cmd/proxy.go contract)."""
+        import urllib.request
+
+        from kubernetes_tpu.api.client import HttpClient
+        from kubernetes_tpu.api.server import ApiServer
+        from kubernetes_tpu.auth.authenticate import BasicAuthAuthenticator
+        from kubernetes_tpu.cli.cmd import Kubectl
+
+        registry = Registry()
+        InProcClient(registry).create("pods", mkpod("via-proxy"),
+                                      "default")
+        srv = ApiServer(
+            registry,
+            authenticator=BasicAuthAuthenticator.from_lines(
+                ["pw,admin,1"])).start()
+        try:
+            import base64
+            creds = {"Authorization":
+                     "Basic " + base64.b64encode(b"admin:pw").decode()}
+            http = HttpClient(srv.url, headers=creds)
+            out = io.StringIO()
+            k = Kubectl(http, out=out)
+            assert k.proxy(port=0, block=False) == 0
+            proxy_srv = k._proxy_server
+            try:
+                # NO credentials on the local hop: the proxy adds them
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{proxy_srv.port}"
+                    "/api/v1/namespaces/default/pods",
+                    timeout=10).read()
+                assert b"via-proxy" in body
+            finally:
+                proxy_srv.stop()
+        finally:
+            srv.stop()

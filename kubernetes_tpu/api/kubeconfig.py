@@ -108,6 +108,47 @@ def load_kubeconfig(path: Optional[str] = None) -> KubeConfig:
     return cfg
 
 
+def dump_kubeconfig(cfg: KubeConfig) -> dict:
+    """KubeConfig -> the on-disk wire shape (clientcmd's v1 Config)."""
+    return {
+        "apiVersion": "v1", "kind": "Config",
+        "current-context": cfg.current_context,
+        "clusters": [{"name": name,
+                      "cluster": {"server": c.server}}
+                     for name, c in sorted(cfg.clusters.items())],
+        "users": [{"name": name, "user": {
+            k: v for k, v in (("token", u.token),
+                              ("tokenFile", u.token_file),
+                              ("username", u.username),
+                              ("password", u.password)) if v}}
+                  for name, u in sorted(cfg.users.items())],
+        "contexts": [{"name": name, "context": {
+            k: v for k, v in (("cluster", c.cluster), ("user", c.user),
+                              ("namespace", c.namespace)) if v}}
+                     for name, c in sorted(cfg.contexts.items())],
+    }
+
+
+def save_kubeconfig(cfg: KubeConfig, path: Optional[str] = None) -> str:
+    """Write the config back (ref: clientcmd ModifyConfig). YAML when
+    available, JSON otherwise (the loader reads both)."""
+    path = path or os.environ.get("KUBECONFIG") or DEFAULT_PATH
+    data = dump_kubeconfig(cfg)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    try:
+        import yaml
+        text = yaml.safe_dump(data, sort_keys=False)
+    except ImportError:
+        import json
+        text = json.dumps(data, indent=2)
+    # 0600: the file carries bearer tokens / passwords (clientcmd's
+    # ModifyConfig writes the same mode)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(text)
+    return path
+
+
 def client_from_kubeconfig(path: Optional[str] = None,
                            context: str = ""):
     """-> (HttpClient, default_namespace)."""

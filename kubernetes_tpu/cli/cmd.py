@@ -154,6 +154,21 @@ def build_parser() -> argparse.ArgumentParser:
                          help="(deprecated) show or set the namespace")
     nsd.add_argument("name", nargs="?")
 
+    cfg = sub.add_parser("config", help="modify kubeconfig files")
+    cfg.add_argument("action",
+                     choices=["view", "current-context", "use-context",
+                              "set-cluster", "set-credentials",
+                              "set-context", "get-contexts"])
+    cfg.add_argument("name", nargs="?")
+    cfg.add_argument("--server", default="")
+    cfg.add_argument("--token", default="")
+    cfg.add_argument("--username", default="")
+    cfg.add_argument("--password", default="")
+    cfg.add_argument("--cluster", default="")
+    cfg.add_argument("--user", default="")
+    cfg.add_argument("--context-namespace", default="",
+                     help="namespace for set-context")
+
     at = sub.add_parser("attach", help="attach to a running container")
     at.add_argument("pod")
     at.add_argument("-c", "--container", default="")
@@ -703,8 +718,9 @@ class Kubectl:
         import typing as _typing
 
         from ..api.registry import Registry
+        from .resource import resolve_resource
         parts = path.split(".")
-        info = Registry.info(parts[0])
+        info = Registry.info(resolve_resource(parts[0]))
         cls = info.cls
         for seg in parts[1:]:
             hints = _typing.get_type_hints(cls)
@@ -768,6 +784,65 @@ class Kubectl:
             return 0
         finally:
             srv.stop()
+
+    def config(self, args, kubeconfig_path=None) -> int:
+        """kubectl config: view / current-context / use-context /
+        set-cluster / set-credentials / set-context / get-contexts over
+        the kubeconfig file (ref: pkg/kubectl/cmd/config; the file
+        format is clientcmd's v1 Config)."""
+        import json as jsonlib
+
+        from ..api.kubeconfig import (AuthInfo, Cluster, Context,
+                                      KubeConfig, dump_kubeconfig,
+                                      load_kubeconfig, save_kubeconfig)
+        try:
+            cfg = load_kubeconfig(kubeconfig_path or None)
+        except FileNotFoundError:
+            cfg = KubeConfig()
+        action = args.action
+        if action == "view":
+            self.out.write(jsonlib.dumps(dump_kubeconfig(cfg), indent=2)
+                           + "\n")
+            return 0
+        if action == "current-context":
+            if not cfg.current_context:
+                self.err.write("error: current-context is not set\n")
+                return 1
+            self.out.write(cfg.current_context + "\n")
+            return 0
+        if action == "get-contexts":
+            self.out.write("CURRENT   NAME   CLUSTER   NAMESPACE\n")
+            for name, ctx in sorted(cfg.contexts.items()):
+                star = "*" if name == cfg.current_context else " "
+                self.out.write(f"{star}         {name}   {ctx.cluster}"
+                               f"   {ctx.namespace or 'default'}\n")
+            return 0
+        if not args.name:
+            raise ApiError(f"config {action} requires a NAME")
+        if action == "use-context":
+            if args.name not in cfg.contexts:
+                self.err.write(
+                    f"error: no context exists with the name "
+                    f"{args.name!r}\n")
+                return 1
+            cfg.current_context = args.name
+            msg = f'Switched to context "{args.name}".'
+        elif action == "set-cluster":
+            cfg.clusters[args.name] = Cluster(server=args.server)
+            msg = f'Cluster "{args.name}" set.'
+        elif action == "set-credentials":
+            cfg.users[args.name] = AuthInfo(
+                token=args.token, username=args.username,
+                password=args.password)
+            msg = f'User "{args.name}" set.'
+        else:  # set-context
+            cfg.contexts[args.name] = Context(
+                cluster=args.cluster, user=args.user,
+                namespace=args.context_namespace)
+            msg = f'Context "{args.name}" created.'
+        save_kubeconfig(cfg, kubeconfig_path or None)
+        self.out.write(msg + "\n")
+        return 0
 
     def namespace_cmd(self, name=None) -> None:
         """(ref: cmd/namespace.go — deprecated in the reference too)"""
@@ -908,6 +983,22 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         parser.print_help()
         return 1
     ns = ns_args.namespace
+    if ns_args.command == "config":
+        # config edits the kubeconfig file itself — no apiserver needed
+        k = Kubectl(client or HttpClient("http://127.0.0.1:8080"),
+                    out=out, err=err)
+        try:
+            return k.config(ns_args, ns_args.kubeconfig or None)
+        except (ApiError, OSError, ValueError) as e:
+            # unreadable/unwritable/malformed config files included: a
+            # clean error beats a traceback (same contract as below)
+            (err or sys.stderr).write(f"Error: {e}\n")
+            return 1
+        except Exception as e:
+            if type(e).__name__.endswith("YAMLError"):
+                (err or sys.stderr).write(f"Error: {e}\n")
+                return 1
+            raise
     if client is None:
         # credential resolution mirrors clientcmd: explicit -s/--token
         # beats kubeconfig; kubeconfig is consulted when -s is absent

@@ -505,3 +505,44 @@ class TestProxy:
                 proxy_srv.stop()
         finally:
             srv.stop()
+
+
+class TestConfigCommand:
+    """kubectl config over a real kubeconfig file (ref:
+    pkg/kubectl/cmd/config; wire shape = clientcmd v1 Config)."""
+
+    def test_build_view_switch_roundtrip(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "kubeconfig")
+        monkeypatch.setenv("KUBECONFIG", path)
+
+        def cfg(*args):
+            out, err = io.StringIO(), io.StringIO()
+            code = main(["config", *args], out=out, err=err)
+            return code, out.getvalue(), err.getvalue()
+
+        assert cfg("set-cluster", "prod",
+                   "--server", "http://10.0.0.1:8080")[0] == 0
+        assert cfg("set-credentials", "alice", "--token", "t0k")[0] == 0
+        assert cfg("set-context", "prod-ctx", "--cluster", "prod",
+                   "--user", "alice", "--context-namespace", "team")[0] == 0
+        code, out, err = cfg("current-context")
+        assert code == 1 and "not set" in err
+        assert cfg("use-context", "prod-ctx")[0] == 0
+        code, out, _ = cfg("current-context")
+        assert code == 0 and out.strip() == "prod-ctx"
+        code, out, _ = cfg("get-contexts")
+        assert "*" in out and "prod-ctx" in out
+
+        # the file the commands produced resolves to a working client
+        from kubernetes_tpu.api.kubeconfig import load_kubeconfig
+        server, headers, ns = load_kubeconfig(path).resolve()
+        assert server == "http://10.0.0.1:8080"
+        assert headers["Authorization"] == "Bearer t0k"
+        assert ns == "team"
+
+    def test_use_unknown_context_fails(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "kc"))
+        out, err = io.StringIO(), io.StringIO()
+        assert main(["config", "use-context", "nope"],
+                    out=out, err=err) == 1
+        assert "no context" in err.getvalue()

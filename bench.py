@@ -95,10 +95,15 @@ def engine_only(n_nodes, n_pods):
         for j in range(n_pods)]
     snap = ClusterSnapshot(nodes=nodes, services=services, pending_pods=pods)
     engine = BatchEngine()
-    enc = encode_snapshot(snap, node_pad_to=engine.n_shards)
-    assigned, _ = engine.run(enc)            # warmup: compile at shape
+    enc = encode_snapshot(snap, node_pad_to=engine.n_shards,
+                          pod_pad_to=((n_pods + 8191) // 8192) * 8192)
+    # chunked at the production tile shape: one compiled [8192] program
+    # (a single 30k-step scan would compile for minutes on the CPU
+    # fallback platform) and the same dispatch granularity the live
+    # scheduler uses
+    assigned, _ = engine.run_chunked(enc, 8192)   # warmup compile
     t0 = time.time()
-    assigned, _ = engine.run(enc)
+    assigned, _ = engine.run_chunked(enc, 8192)
     elapsed = time.time() - t0
     n_bound = int((assigned[:enc.n_pods] >= 0).sum())
     return n_bound / elapsed, n_bound

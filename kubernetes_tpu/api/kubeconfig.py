@@ -129,23 +129,73 @@ def dump_kubeconfig(cfg: KubeConfig) -> dict:
     }
 
 
+# the keys each section's dataclass models; everything else in a
+# real-world kubeconfig (certificate-authority-data, auth-provider,
+# extensions, ...) is preserved verbatim on save
+_MODELED = {"cluster": {"server"},
+            "user": {"token", "tokenFile", "username", "password"},
+            "context": {"cluster", "user", "namespace"}}
+
+
+def _merge_preserving(existing: dict, new: dict) -> dict:
+    """Overlay the modeled fields onto an existing raw config without
+    destroying anything this library doesn't model (real kubectl may
+    share the file). Per named entry: unmodeled subkeys survive,
+    modeled subkeys are replaced wholesale (set-credentials REPLACES a
+    user, it must not resurrect an old token)."""
+    out = dict(existing)
+    out["current-context"] = new["current-context"]
+    for section, subkey in (("clusters", "cluster"), ("users", "user"),
+                            ("contexts", "context")):
+        old_by_name = {e.get("name"): e
+                       for e in existing.get(section) or []}
+        merged = []
+        seen = set()
+        for entry in new[section]:
+            name = entry.get("name")
+            seen.add(name)
+            old = old_by_name.get(name)
+            if old is None:
+                merged.append(entry)
+                continue
+            keep = {k: v for k, v in (old.get(subkey) or {}).items()
+                    if k not in _MODELED[subkey]}
+            merged.append({**old, "name": name,
+                           subkey: {**keep, **entry.get(subkey, {})}})
+        # entries this library never loaded (no name, exotic shapes)
+        merged.extend(e for e in existing.get(section) or []
+                      if e.get("name") not in seen)
+        out[section] = merged
+    return out
+
+
 def save_kubeconfig(cfg: KubeConfig, path: Optional[str] = None) -> str:
-    """Write the config back (ref: clientcmd ModifyConfig). YAML when
-    available, JSON otherwise (the loader reads both)."""
+    """Write the config back (ref: clientcmd ModifyConfig: 0600, and
+    content this library doesn't model survives the round-trip). YAML
+    when available, JSON otherwise (the loader reads both)."""
     path = path or os.environ.get("KUBECONFIG") or DEFAULT_PATH
     data = dump_kubeconfig(cfg)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     try:
         import yaml
-        text = yaml.safe_dump(data, sort_keys=False)
+        loads, dumps = yaml.safe_load, (
+            lambda d: yaml.safe_dump(d, sort_keys=False))
     except ImportError:
         import json
-        text = json.dumps(data, indent=2)
-    # 0600: the file carries bearer tokens / passwords (clientcmd's
-    # ModifyConfig writes the same mode)
+        loads, dumps = json.loads, (lambda d: json.dumps(d, indent=2))
+    try:
+        with open(path) as f:
+            existing = loads(f.read()) or {}
+        if isinstance(existing, dict):
+            data = _merge_preserving(existing, data)
+    except FileNotFoundError:
+        pass
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # 0600 even for pre-existing files: the content carries bearer
+    # tokens / passwords (os.open's mode only applies on creation)
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.fchmod(fd, 0o600)
     with os.fdopen(fd, "w") as f:
-        f.write(text)
+        f.write(dumps(data))
     return path
 
 

@@ -168,6 +168,8 @@ def build_parser() -> argparse.ArgumentParser:
     cfg.add_argument("--user", default="")
     cfg.add_argument("--context-namespace", default="",
                      help="namespace for set-context")
+    cfg.add_argument("--raw", action="store_true",
+                     help="view: print credentials instead of REDACTED")
 
     at = sub.add_parser("attach", help="attach to a running container")
     at.add_argument("pod")
@@ -801,8 +803,15 @@ class Kubectl:
             cfg = KubeConfig()
         action = args.action
         if action == "view":
-            self.out.write(jsonlib.dumps(dump_kubeconfig(cfg), indent=2)
-                           + "\n")
+            doc = dump_kubeconfig(cfg)
+            if not getattr(args, "raw", False):
+                # the reference masks credentials unless --raw: view is
+                # a command users treat as safe to paste
+                for entry in doc["users"]:
+                    for secret in ("token", "password"):
+                        if entry["user"].get(secret):
+                            entry["user"][secret] = "REDACTED"
+            self.out.write(jsonlib.dumps(doc, indent=2) + "\n")
             return 0
         if action == "current-context":
             if not cfg.current_context:
@@ -995,7 +1004,9 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
             (err or sys.stderr).write(f"Error: {e}\n")
             return 1
         except Exception as e:
-            if type(e).__name__.endswith("YAMLError"):
+            # yaml's concrete errors (ScannerError/ParserError) only
+            # subclass YAMLError — check the MRO, not the leaf name
+            if any(c.__name__ == "YAMLError" for c in type(e).__mro__):
                 (err or sys.stderr).write(f"Error: {e}\n")
                 return 1
             raise

@@ -540,6 +540,59 @@ class TestConfigCommand:
         assert headers["Authorization"] == "Bearer t0k"
         assert ns == "team"
 
+    def test_view_redacts_credentials(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KUBECONFIG", str(tmp_path / "kc"))
+        out = io.StringIO()
+        main(["config", "set-credentials", "a", "--token", "sekret"],
+             out=out, err=io.StringIO())
+        out = io.StringIO()
+        assert main(["config", "view"], out=out, err=io.StringIO()) == 0
+        assert "sekret" not in out.getvalue()
+        assert "REDACTED" in out.getvalue()
+        out = io.StringIO()
+        assert main(["config", "view", "--raw"], out=out,
+                    err=io.StringIO()) == 0
+        assert "sekret" in out.getvalue()
+
+    def test_save_preserves_unmodeled_fields_and_tightens_mode(
+            self, tmp_path, monkeypatch):
+        """A kubeconfig written by real kubectl carries fields this
+        library doesn't model — mutating commands must not destroy
+        them, and writing credentials must tighten a loose mode."""
+        import os
+        import stat
+        path = tmp_path / "kc"
+        path.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "Config",
+            "current-context": "old",
+            "preferences": {"colors": True},
+            "clusters": [{"name": "prod", "cluster": {
+                "server": "https://1.2.3.4",
+                "certificate-authority-data": "Q0FEQVRB"}}],
+            "users": [{"name": "u", "user": {
+                "token": "t", "auth-provider": {"name": "oidc"}}}],
+            "contexts": [{"name": "old", "context": {
+                "cluster": "prod", "user": "u"}}]}))
+        path.chmod(0o644)
+        monkeypatch.setenv("KUBECONFIG", str(path))
+        assert main(["config", "set-context", "new", "--cluster", "prod",
+                     "--user", "u"], out=io.StringIO(),
+                    err=io.StringIO()) == 0
+        data = json.loads(path.read_text()) \
+            if path.read_text().lstrip().startswith("{") else None
+        if data is None:
+            import yaml
+            data = yaml.safe_load(path.read_text())
+        assert data["preferences"] == {"colors": True}
+        cluster = data["clusters"][0]["cluster"]
+        assert cluster["certificate-authority-data"] == "Q0FEQVRB"
+        assert cluster["server"] == "https://1.2.3.4"
+        user = data["users"][0]["user"]
+        assert user["auth-provider"] == {"name": "oidc"}
+        assert user["token"] == "t"
+        assert {c["name"] for c in data["contexts"]} == {"old", "new"}
+        assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+
     def test_use_unknown_context_fails(self, tmp_path, monkeypatch):
         monkeypatch.setenv("KUBECONFIG", str(tmp_path / "kc"))
         out, err = io.StringIO(), io.StringIO()

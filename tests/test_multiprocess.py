@@ -36,6 +36,7 @@ def spawn(component, *flags):
 def wait_ready(proc, timeout_s=120.0):
     """Block until the component prints its READY line."""
     import select
+    import threading
     ready, _, _ = select.select([proc.stdout], [], [], timeout_s)
     if not ready:
         proc.kill()
@@ -44,6 +45,11 @@ def wait_ready(proc, timeout_s=120.0):
     if not line:
         raise RuntimeError(
             f"component died: {proc.stderr.read()[-2000:]}")
+    # keep draining: a chatty component (hollow proxy sync logs) would
+    # otherwise fill the 64KB pipe, block on write, and never exit —
+    # terminate() then times out spuriously
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+    threading.Thread(target=proc.stderr.read, daemon=True).start()
     assert " ready" in line, line
     return line.strip()
 

@@ -144,6 +144,10 @@ def _merge_preserving(existing: dict, new: dict) -> dict:
     modeled subkeys are replaced wholesale (set-credentials REPLACES a
     user, it must not resurrect an old token)."""
     out = dict(existing)
+    # headerless/minimal existing files still get a valid header (real
+    # clientcmd validates apiVersion/kind)
+    out.setdefault("apiVersion", new["apiVersion"])
+    out.setdefault("kind", new["kind"])
     out["current-context"] = new["current-context"]
     for section, subkey in (("clusters", "cluster"), ("users", "user"),
                             ("contexts", "context")):

@@ -22,10 +22,11 @@ for parity tests):
     oversubscribed with a mix of fitting and misfitting pods whose order
     matters; the full encoder stays authoritative for that edge and the
     parity suite pins it.
-  - scope: the default provider tier. Tiles carrying inter-pod affinity
-    terms raise NeedsFullEncode (the caller falls back to the full
-    encoder), and engines configured with a DevicePolicy (zone
-    anti-affinity, label policy tiers) should not use this path.
+  - scope: the default provider tier plus the inter-pod affinity tier
+    (terms/domains/scope-counts computed per tile from the LEDGER —
+    one pass over cheap records, not the full O(cluster) re-encode).
+    Engines configured with a DevicePolicy needing anti-affinity (zone
+    spreading) should not use this path.
 
 Shape stability: node capacity and interner word capacities grow by
 doubling, so array shapes — and therefore XLA compilations — change
@@ -49,7 +50,12 @@ from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
 
 
 class NeedsFullEncode(Exception):
-    """Tile needs a feature this encoder doesn't maintain incrementally."""
+    """Tile needs a feature this encoder doesn't maintain incrementally.
+
+    Currently raised by NO tier (the affinity tier, the last holdout,
+    went ledger-fed) — kept as the escape-hatch contract: a future tier
+    may raise it and the batch scheduler's handler (sched/batch.py)
+    routes such tiles through the full snapshot encoder."""
 
 
 def replace_pod_batch_dtypes(pb: PodArrays, narrow: bool,
@@ -154,6 +160,11 @@ class IncrementalEncoder:
         self.n_cap = node_capacity
         self.node_slot: Dict[str, int] = {}
         self.node_names: List[str] = [""] * self.n_cap
+        # raw label dicts per slot: the affinity tier resolves topology
+        # domains from them (kept for INVALID slots too — a peer pod on
+        # a cached-but-unschedulable node still occupies its domain,
+        # the serial predicate's node_by_name view)
+        self.node_labels: List[Dict[str, str]] = [{}] * self.n_cap
         self._free_slots: List[int] = []
         self.valid = np.zeros(self.n_cap, bool)
         self.cpu_cap = np.zeros(self.n_cap, np.int64)
@@ -349,6 +360,12 @@ class IncrementalEncoder:
                 return
             self.state_epoch += 1
             self.valid[slot] = False
+            # a DELETED node left the informer cache: the serial path's
+            # node_by_name can no longer resolve it, so peers bound to
+            # it must stop occupying topology domains (NotReady-but-
+            # cached nodes keep their labels — they arrive as updates,
+            # not deletes, and still resolve domains)
+            self.node_labels[slot] = {}
 
     # ================================================== pod bookkeeping
 
@@ -557,6 +574,7 @@ class IncrementalEncoder:
                                 int(self.cpu_cap[slot]))
         self.pod_cap[slot] = cap["pods"].value if "pods" in cap else 0
         self.label_words[slot] = 0
+        self.node_labels[slot] = dict(node.metadata.labels)
         for kv in node.metadata.labels.items():
             bit, grew = self.labels_dict.intern(kv)
             if grew:
@@ -672,6 +690,7 @@ class IncrementalEncoder:
         for g in self.groups.values():
             g.row = _grow(g.row, 0, new_cap)
         self.node_names.extend([""] * (new_cap - self.n_cap))
+        self.node_labels.extend([{}] * (new_cap - self.n_cap))
         self.n_cap = new_cap
 
     def _recompute_tie_rank(self) -> None:
@@ -699,6 +718,73 @@ class IncrementalEncoder:
                         g.row[slot] += 1
             self.groups[key] = g
         return g
+
+    # ================================================== affinity tier
+
+    def _encode_aff_terms(self, pending_pods: List[api.Pod], n_pad: int):
+        """The inter-pod affinity structures of one tile
+        (tables.py's term intern + domain + scope-count build), computed
+        against the LEDGER: per-pod records carry ns/labels/node and the
+        node_labels list resolves topology domains, so affinity tiles
+        cost one pass over cheap records instead of the full O(cluster)
+        api-object re-encode they used to force (the last
+        NeedsFullEncode case). Caller holds the lock."""
+        from .tables import collect_affinity_terms
+
+        # term interning is shared with the full encoder — the parity-
+        # critical key lives in exactly one place
+        term_meta, pod_terms = collect_affinity_terms(pending_pods)
+        T = max(1, len(term_meta))
+
+        # per-term topology domains over CANDIDATE (valid) slots — a
+        # domain value only invalid nodes carry can never satisfy a
+        # term, mirroring tables.py building domains from snap.nodes
+        aff_dom = np.full((T, n_pad), -1, np.int32)
+        dom_ids: List[Dict[str, int]] = [dict() for _ in range(T)]
+        for tid, (_, _, topo_key) in enumerate(term_meta):
+            row = aff_dom[tid]
+            doms = dom_ids[tid]
+            for slot, name in enumerate(self.node_names):
+                if not name or not self.valid[slot]:
+                    continue
+                value = self.node_labels[slot].get(topo_key)
+                if value is None:
+                    continue
+                row[slot] = doms.setdefault(value, len(doms))
+        D = max(1, max((len(d) for d in dom_ids), default=0))
+
+        aff_count = np.zeros((T, D), np.int32)
+        aff_total = np.zeros(T, np.int32)
+        if term_meta:
+            # scope counts over the ledger's counted (non-terminal)
+            # placed pods; domains resolve through ALL known nodes
+            # (valid or not — node_by_name semantics), but only
+            # candidate-carried domain values scored above can match
+            matchers = [
+                (ns_scope, selector, topo_key, dom_ids[tid])
+                for tid, (ns_scope, selector, topo_key)
+                in enumerate(term_meta)]
+            for rec in self.pods.values():
+                if not rec.counted_res:
+                    continue
+                host_slot = self.node_slot.get(rec.node)
+                host_labels = (self.node_labels[host_slot]
+                               if host_slot is not None else None)
+                for tid, (ns_scope, sel, topo_key, doms) in \
+                        enumerate(matchers):
+                    if rec.ns not in ns_scope:
+                        continue
+                    if not _selector_matches(sel, rec.labels):
+                        continue
+                    aff_total[tid] += 1
+                    if host_labels is None:
+                        continue
+                    value = host_labels.get(topo_key)
+                    dom = doms.get(value) if value is not None else None
+                    if dom is not None:
+                        aff_count[tid, dom] += 1
+        return (term_meta, pod_terms, aff_dom, dom_ids, aff_count,
+                aff_total, T, D)
 
     # ================================================== tile assembly
 
@@ -753,14 +839,6 @@ class IncrementalEncoder:
             group_idx: Dict[int, int] = {}
             pod_groups: List[int] = []
             for pod in pending_pods:
-                aff = pod.spec.affinity
-                if aff is not None and (
-                        (aff.pod_affinity is not None
-                         and aff.pod_affinity.required_during_scheduling)
-                        or (aff.pod_anti_affinity is not None
-                            and aff.pod_anti_affinity
-                            .required_during_scheduling)):
-                    raise NeedsFullEncode("inter-pod affinity terms")
                 sels = _pod_spread_selectors(pod, services, controllers)
                 if not sels:
                     pod_groups.append(-1)
@@ -773,6 +851,15 @@ class IncrementalEncoder:
                     tile_groups.append(g)
                 pod_groups.append(gid)
             G = max(1, len(tile_groups))
+
+            # ---- inter-pod affinity terms of this tile (tables.py's
+            # build, fed from the LEDGER instead of a full pod re-walk:
+            # the per-pod records already carry ns/labels/node, so the
+            # scope counts cost one pass over cheap records rather than
+            # O(cluster) api-object walking per tile) ----
+            (term_meta, pod_terms, aff_dom, dom_ids,
+             aff_count, aff_total, T, D) = self._encode_aff_terms(
+                 pending_pods, n_pad)
 
             pb = PodArrays(
                 valid=np.zeros(p_pad, bool),
@@ -790,9 +877,9 @@ class IncrementalEncoder:
                 host_idx=np.full(p_pad, -1, np.int32),
                 group_id=np.full(p_pad, -1, np.int32),
                 member=np.zeros((p_pad, G), np.int32),
-                aff_req=np.zeros((p_pad, 1), bool),
-                anti_req=np.zeros((p_pad, 1), bool),
-                aff_member=np.zeros((p_pad, 1), np.int32),
+                aff_req=np.zeros((p_pad, T), bool),
+                anti_req=np.zeros((p_pad, T), bool),
+                aff_member=np.zeros((p_pad, T), np.int32),
                 svc_group=np.full(p_pad, -1, np.int32),
                 svc_member=np.zeros((p_pad, 1), np.int32))
             for j, pod in enumerate(pending_pods):
@@ -840,6 +927,16 @@ class IncrementalEncoder:
                 for gid, g in enumerate(tile_groups):
                     if g.matches(pod.metadata.namespace, pod.metadata.labels):
                         pb.member[j, gid] = 1
+                aff_ids, anti_ids = pod_terms[j]
+                for tid in aff_ids:
+                    pb.aff_req[j, tid] = True
+                for tid in anti_ids:
+                    pb.anti_req[j, tid] = True
+                for tid, (ns_scope, selector, _topo) in enumerate(term_meta):
+                    if pod.metadata.namespace in ns_scope and \
+                            _selector_matches(selector,
+                                              pod.metadata.labels):
+                        pb.aff_member[j, tid] = 1
 
             # ---- views of the persistent state (copied: the reflector
             # threads keep mutating these arrays while the scan runs).
@@ -865,7 +962,7 @@ class IncrementalEncoder:
                 tie_rank=self.tie_rank.copy(),
                 exceed_cpu=self.exceed_cpu.copy(),
                 exceed_mem=self.exceed_mem.copy(),
-                aff_dom=np.full((1, n_pad), -1, np.int32),
+                aff_dom=aff_dom,
                 zone_id=np.full(n_pad, -1, np.int32),
                 zone_scratch=np.zeros(1, np.int32),
                 static_mask=self.static_mask.copy(),
@@ -886,8 +983,8 @@ class IncrementalEncoder:
                 disk_any=self.disk_any.copy(),
                 disk_rw=self.disk_rw.copy(),
                 spread=spread.copy(),
-                aff_count=np.zeros((1, 1), np.int32),
-                aff_total=np.zeros(1, np.int32),
+                aff_count=aff_count,
+                aff_total=aff_total,
                 svc_count=np.zeros((1, n_pad), np.int32),
                 svc_total=np.zeros(1, np.int32))
             pb = replace_pod_batch_dtypes(pb, narrow, mem_scale)

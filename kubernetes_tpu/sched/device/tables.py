@@ -275,6 +275,47 @@ def _selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def collect_affinity_terms(pending_pods: Sequence[api.Pod]):
+    """Intern a pod batch's inter-pod affinity terms: ->
+    (term_meta [(ns_scope frozenset, selector dict, topology_key)],
+     pod_terms [(aff term ids, anti term ids)] per pod).
+
+    The interning key is parity-critical (the oracle predicate resolves
+    scope per pod, predicates.new_inter_pod_affinity_predicate) and is
+    shared by BOTH encoders — the full snapshot encoder below and the
+    incremental encoder's ledger-fed tier — so the two cannot drift."""
+    term_ids: Dict[object, int] = {}
+    term_meta: List[Tuple[frozenset, Dict[str, str], str]] = []
+    pod_terms: List[Tuple[List[int], List[int]]] = []
+
+    def intern_term(pod: api.Pod, term: api.PodAffinityTerm) -> int:
+        ns_scope = frozenset(term_namespaces(pod, term))
+        key = (ns_scope, frozenset(term.label_selector.items()),
+               term.topology_key)
+        tid = term_ids.get(key)
+        if tid is None:
+            tid = len(term_meta)
+            term_ids[key] = tid
+            term_meta.append((ns_scope, dict(term.label_selector),
+                              term.topology_key))
+        return tid
+
+    for pod in pending_pods:
+        aff = pod.spec.affinity
+        aff_ids: List[int] = []
+        anti_ids: List[int] = []
+        if aff is not None:
+            if aff.pod_affinity is not None:
+                aff_ids = [intern_term(pod, t)
+                           for t in aff.pod_affinity.required_during_scheduling]
+            if aff.pod_anti_affinity is not None:
+                anti_ids = [
+                    intern_term(pod, t)
+                    for t in aff.pod_anti_affinity.required_during_scheduling]
+        pod_terms.append((aff_ids, anti_ids))
+    return term_meta, pod_terms
+
+
 def _matching_services(pod: api.Pod, services: Sequence[api.Service]
                        ) -> List[api.Service]:
     """Services whose selector covers the pod, in lister order (the
@@ -418,35 +459,7 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
     # predicates.new_inter_pod_affinity_predicate). Terms are interned by
     # (resolved namespace scope, selector, topology key); each term gets a
     # per-node topology-domain id and running scope counts in the carry.
-    term_ids: Dict[object, int] = {}
-    term_meta: List[Tuple[frozenset, Dict[str, str], str]] = []
-    pod_terms: List[Tuple[List[int], List[int]]] = []  # (aff ids, anti ids)
-
-    def intern_term(pod: api.Pod, term: api.PodAffinityTerm) -> int:
-        ns_scope = frozenset(term_namespaces(pod, term))
-        key = (ns_scope, frozenset(term.label_selector.items()),
-               term.topology_key)
-        tid = term_ids.get(key)
-        if tid is None:
-            tid = len(term_meta)
-            term_ids[key] = tid
-            term_meta.append((ns_scope, dict(term.label_selector),
-                              term.topology_key))
-        return tid
-
-    for pod in snap.pending_pods:
-        aff = pod.spec.affinity
-        aff_ids: List[int] = []
-        anti_ids: List[int] = []
-        if aff is not None:
-            if aff.pod_affinity is not None:
-                aff_ids = [intern_term(pod, t)
-                           for t in aff.pod_affinity.required_during_scheduling]
-            if aff.pod_anti_affinity is not None:
-                anti_ids = [
-                    intern_term(pod, t)
-                    for t in aff.pod_anti_affinity.required_during_scheduling]
-        pod_terms.append((aff_ids, anti_ids))
+    term_meta, pod_terms = collect_affinity_terms(snap.pending_pods)
     T = max(1, len(term_meta))
 
     def in_term_scope(p: api.Pod, tid: int) -> bool:

@@ -15,8 +15,7 @@ from kubernetes_tpu.core import types as api
 from kubernetes_tpu.core.quantity import Quantity
 from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
                                          encode_snapshot)
-from kubernetes_tpu.sched.device.incremental import (IncrementalEncoder,
-                                                     NeedsFullEncode)
+from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
 
 MI = 1024 * 1024
 
@@ -185,20 +184,114 @@ def test_assume_then_watch_echo_dedup():
     assert inc.pod_count[slot] == 1
 
 
-def test_affinity_tile_raises_needs_full_encode():
-    inc = IncrementalEncoder()
-    inc.on_node_add(mk_node("n-00", labels={"zone": "a"}))
-    pod = mk_pod("p-0")
-    pod = api.Pod(
+def _with_affinity(pod, anti=None, aff=None):
+    return api.Pod(
         metadata=pod.metadata,
         spec=api.PodSpec(
+            node_name=pod.spec.node_name,
             containers=pod.spec.containers,
-            affinity=api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
-                required_during_scheduling=[api.PodAffinityTerm(
-                    label_selector={"app": "x"}, topology_key="zone")]))),
+            affinity=api.Affinity(
+                pod_affinity=(api.PodAffinity(
+                    required_during_scheduling=aff) if aff else None),
+                pod_anti_affinity=(api.PodAntiAffinity(
+                    required_during_scheduling=anti) if anti else None))),
         status=pod.status)
-    with pytest.raises(NeedsFullEncode):
-        inc.encode_tile([pod], [], [])
+
+
+def test_affinity_tile_incremental_matches_full():
+    """Inter-pod affinity/anti-affinity terms ride the incremental
+    encoder (ledger-fed scope counts) bit-identically to the full
+    encoder: anti-affinity spreads across zones, affinity pulls peers
+    together, and pre-existing matching pods count."""
+    zones = ["a", "a", "b", "b", "c"]
+    nodes = [mk_node(f"n-{i:02d}", labels={"zone": zones[i]})
+             for i in range(5)]
+    existing = [mk_pod("e-0", node="n-00", labels={"app": "anchor"})]
+    term = [api.PodAffinityTerm(label_selector={"app": "x"},
+                                topology_key="zone")]
+    pull = [api.PodAffinityTerm(label_selector={"app": "anchor"},
+                                topology_key="zone")]
+    pending = [
+        _with_affinity(mk_pod("p-0", labels={"app": "x"}), anti=term),
+        _with_affinity(mk_pod("p-1", labels={"app": "x"}), anti=term),
+        _with_affinity(mk_pod("p-2", labels={"app": "x"}), anti=term),
+        _with_affinity(mk_pod("p-3", labels={"app": "y"}), aff=pull),
+    ]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, existing)
+    hosts_inc, hosts_full = schedule_both(inc, nodes, existing, [],
+                                          pending)
+    assert hosts_inc == hosts_full
+    # three anti-affinity pods over three zones: all placed, one per zone
+    zone_of = {f"n-{i:02d}": z for i, z in enumerate(zones)}
+    placed = [zone_of[h] for h in hosts_inc[:3]]
+    assert sorted(placed) == ["a", "b", "c"]
+    # the affinity pod lands in the anchor's zone
+    assert zone_of[hosts_inc[3]] == "a"
+
+
+def test_affinity_fourth_pod_unschedulable_incremental():
+    """When every topology domain is occupied, the next anti-affinity
+    pod must not fit — on both encoders."""
+    nodes = [mk_node(f"n-{i:02d}", labels={"zone": "ab"[i % 2]})
+             for i in range(4)]
+    term = [api.PodAffinityTerm(label_selector={"app": "x"},
+                                topology_key="zone")]
+    pending = [_with_affinity(mk_pod(f"p-{k}", labels={"app": "x"}),
+                              anti=term) for k in range(3)]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, [])
+    hosts_inc, hosts_full = schedule_both(inc, nodes, [], [], pending)
+    assert hosts_inc == hosts_full
+    assert hosts_inc[0] is not None and hosts_inc[1] is not None
+    assert hosts_inc[2] is None  # both zones taken
+
+
+def test_affinity_deleted_node_frees_its_domain():
+    """A peer bound to a DELETED node must stop occupying its topology
+    domain (the full encoder resolves peers only through the live node
+    cache; stale labels would wrongly refuse the zone), while its count
+    still reaches the bootstrap total."""
+    nodes = [mk_node("n-00", labels={"zone": "a"}),
+             mk_node("n-01", labels={"zone": "a"})]
+    peer = mk_pod("e-0", node="n-01", labels={"app": "x"})
+    term = [api.PodAffinityTerm(label_selector={"app": "x"},
+                                topology_key="zone")]
+    pending = [_with_affinity(mk_pod("p-0", labels={"app": "x"}),
+                              anti=term)]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, [peer])
+    inc.on_node_delete(nodes[1])
+    # full-encoder equivalent: n-01 gone from the caches entirely
+    hosts_inc, hosts_full = schedule_both(
+        inc, [nodes[0]], [peer], [], pending)
+    assert hosts_inc == hosts_full
+    # zone a must be free again: the peer's node no longer resolves
+    assert hosts_inc[0] == "n-00"
+
+
+def test_affinity_counts_follow_assume_between_tiles():
+    """Tile 2's scope counts must see tile 1's assumed bindings through
+    the ledger (the modeler moment for the affinity tier)."""
+    nodes = [mk_node(f"n-{i:02d}", labels={"zone": "ab"[i % 2]})
+             for i in range(2)]
+    term = [api.PodAffinityTerm(label_selector={"app": "x"},
+                                topology_key="zone")]
+    inc = IncrementalEncoder()
+    feed(inc, nodes, [])
+    engine = BatchEngine()
+    p1 = [_with_affinity(mk_pod("p-0", labels={"app": "x"}), anti=term)]
+    e1 = inc.encode_tile(p1, [], [])
+    a1, _ = engine.run_chunked(e1, 64)
+    assert a1[0] >= 0
+    inc.assume_assigned(e1, p1, a1)
+    first_zone = "ab"[int(a1[0]) % 2]
+    p2 = [_with_affinity(mk_pod("p-1", labels={"app": "x"}), anti=term)]
+    e2 = inc.encode_tile(p2, [], [])
+    a2, _ = engine.run_chunked(e2, 64)
+    assert a2[0] >= 0
+    second_zone = "ab"[int(a2[0]) % 2]
+    assert second_zone != first_zone
 
 
 def test_new_group_seeded_from_ledger():

@@ -30,6 +30,46 @@ from .container import (ContainerState, Runtime, RuntimeContainer,
 _CLK_TCK = os.sysconf("SC_CLK_TCK")
 _PAGE = os.sysconf("SC_PAGE_SIZE")
 
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_PAUSE_SRC = os.path.join(_NATIVE_DIR, "pause.c")
+_PAUSE_BIN = os.path.join(_NATIVE_DIR, "pause")
+_pause_lock = threading.Lock()
+
+
+def _build_pause() -> Optional[str]:
+    """Compile native/pause.c on first use (the native-store pattern);
+    -> binary path, or None without a toolchain."""
+    with _pause_lock:
+        have_bin = os.path.exists(_PAUSE_BIN)
+        if have_bin and (not os.path.exists(_PAUSE_SRC)
+                         or os.path.getmtime(_PAUSE_SRC)
+                         <= os.path.getmtime(_PAUSE_BIN)):
+            # fresh enough — and a prebuilt binary with no shipped
+            # source is taken as-is
+            return _PAUSE_BIN
+        if not os.path.exists(_PAUSE_SRC):
+            return None
+        # compile to a per-process unique name: two processes building
+        # concurrently must not interleave into one .tmp (os.replace of
+        # a complete file is atomic either way)
+        import tempfile as _tempfile
+        fd, tmp = _tempfile.mkstemp(prefix="pause-", dir=_NATIVE_DIR)
+        os.close(fd)
+        try:
+            for flags in (["-O2", "-static"], ["-O2"]):
+                try:
+                    subprocess.run(["cc", *flags, _PAUSE_SRC, "-o", tmp],
+                                   check=True, capture_output=True)
+                    os.replace(tmp, _PAUSE_BIN)
+                    return _PAUSE_BIN
+                except (OSError, subprocess.CalledProcessError):
+                    continue
+            return _PAUSE_BIN if have_bin else None
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
 
 class _Proc:
     def __init__(self, popen: subprocess.Popen, record: RuntimeContainer,
@@ -45,11 +85,19 @@ class SubprocessRuntime(Runtime):
 
     def __init__(self, root_dir: Optional[str] = None,
                  default_command: Optional[List[str]] = None):
-        # image-less containers run the default command (the pause-
-        # container analogue: hold the pod alive until killed)
+        # image-less containers run the default command: the pause
+        # container (native/pause.c, the reference's third_party/pause
+        # role — exist, hold the pod, exit 0 on SIGTERM), compiled on
+        # first use like the native store; `sleep` is the fallback when
+        # no C toolchain is present
         self.root_dir = root_dir or tempfile.mkdtemp(prefix="kubelet-run-")
         os.makedirs(self.root_dir, exist_ok=True)
-        self.default_command = list(default_command or ["sleep", "3600"])
+        if default_command is not None:
+            self.default_command = list(default_command)
+        else:
+            pause = _build_pause()
+            self.default_command = ([pause] if pause
+                                    else ["sleep", "3600"])
         self._procs: Dict[Tuple[str, str], _Proc] = {}  # (uid, name)
         self._pods: Dict[str, api.Pod] = {}
         self._lock = threading.Lock()

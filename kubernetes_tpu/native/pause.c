@@ -1,0 +1,36 @@
+/* The pause container, C edition.
+ *
+ * Reference: third_party/pause/pause.asm — a 2-syscall x86-64 program
+ * (pause(), then exit(0)) whose only job is to exist: it holds the
+ * pod's namespaces open while real containers come and go. The
+ * subprocess runtime spawns this for image-less containers (its
+ * "default command"), giving every such pod a real native init process
+ * instead of a shell sleep.
+ *
+ * Semantics matched to the reference: block until any terminating
+ * signal arrives, then exit 0. (The reference's bare `pause` syscall
+ * returns on ANY handled signal; we park in a loop so stray SIGCHLD &
+ * co. don't end the pod, and exit cleanly on the kill the kubelet
+ * sends.)
+ */
+
+#include <signal.h>
+#include <unistd.h>
+
+static volatile sig_atomic_t done = 0;
+
+static void on_term(int sig) {
+    (void)sig;
+    done = 1;
+}
+
+int main(void) {
+    struct sigaction sa = {0};
+    sa.sa_handler = on_term;
+    sigaction(SIGINT, &sa, 0);
+    sigaction(SIGTERM, &sa, 0);
+    while (!done) {
+        pause();
+    }
+    return 0;
+}

@@ -46,7 +46,8 @@ from ..predicates import get_resource_request
 from ..priorities import get_nonzero_requests
 from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
                      _disk_keys, _matching_services, _pod_spread_selectors,
-                     _selector_matches, _set_bit, _words)
+                     _selector_matches, _set_bit, _words,
+                     collect_affinity_terms)
 
 
 class NeedsFullEncode(Exception):
@@ -164,7 +165,8 @@ class IncrementalEncoder:
         # domains from them (kept for INVALID slots too — a peer pod on
         # a cached-but-unschedulable node still occupies its domain,
         # the serial predicate's node_by_name view)
-        self.node_labels: List[Dict[str, str]] = [{}] * self.n_cap
+        self.node_labels: List[Dict[str, str]] = [
+            {} for _ in range(self.n_cap)]
         self._free_slots: List[int] = []
         self.valid = np.zeros(self.n_cap, bool)
         self.cpu_cap = np.zeros(self.n_cap, np.int64)
@@ -690,7 +692,7 @@ class IncrementalEncoder:
         for g in self.groups.values():
             g.row = _grow(g.row, 0, new_cap)
         self.node_names.extend([""] * (new_cap - self.n_cap))
-        self.node_labels.extend([{}] * (new_cap - self.n_cap))
+        self.node_labels.extend({} for _ in range(new_cap - self.n_cap))
         self.n_cap = new_cap
 
     def _recompute_tie_rank(self) -> None:
@@ -729,8 +731,6 @@ class IncrementalEncoder:
         cost one pass over cheap records instead of the full O(cluster)
         api-object re-encode they used to force (the last
         NeedsFullEncode case). Caller holds the lock."""
-        from .tables import collect_affinity_terms
-
         # term interning is shared with the full encoder — the parity-
         # critical key lives in exactly one place
         term_meta, pod_terms = collect_affinity_terms(pending_pods)

@@ -232,3 +232,31 @@ def test_follow_logs_streams_live_output(tmp_path):
         kubelet.stop()
         for rp in rt.get_pods():
             rt.kill_pod(rp.uid)
+
+
+def test_pause_is_the_default_command(tmp_path):
+    """Image-less containers run the native pause program (the
+    third_party/pause role): alive until SIGTERM, then exit 0."""
+    import signal
+    import time
+
+    from kubernetes_tpu.kubelet.subprocess_runtime import (SubprocessRuntime,
+                                                           _build_pause)
+    if _build_pause() is None:
+        import pytest
+        pytest.skip("no C toolchain")
+    rt = SubprocessRuntime(root_dir=str(tmp_path))
+    assert rt.default_command[0].endswith("pause")
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default", uid="u-p"),
+        spec=api.PodSpec(containers=[api.Container(name="hold",
+                                                   image="pause")]))
+    rt.start_container(pod, pod.spec.containers[0])
+    try:
+        time.sleep(0.2)
+        assert rt.container_running("u-p", "hold")
+        proc = rt._procs[("u-p", "hold")].popen
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=10) == 0  # clean exit, like pause.asm
+    finally:
+        rt.kill_pod("u-p")

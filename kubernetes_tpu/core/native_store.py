@@ -72,16 +72,13 @@ def _load_library() -> ctypes.CDLL:
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB) or (
-                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            try:
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     _SRC, "-o", _LIB + ".tmp"],
-                    check=True, capture_output=True)
-                os.replace(_LIB + ".tmp", _LIB)
-            except (OSError, subprocess.CalledProcessError) as e:
-                raise ImportError(f"cannot build native store: {e}")
+        from ..native.build import build_native
+        built = build_native(
+            _SRC, _LIB,
+            [["g++", "-O2", "-std=c++17", "-shared", "-fPIC"]])
+        if built is None:
+            raise ImportError("cannot build native store (no toolchain "
+                              "or unwritable native/ directory)")
         lib = ctypes.CDLL(_LIB)
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_uint64]

@@ -34,41 +34,14 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _PAUSE_SRC = os.path.join(_NATIVE_DIR, "pause.c")
 _PAUSE_BIN = os.path.join(_NATIVE_DIR, "pause")
-_pause_lock = threading.Lock()
 
 
 def _build_pause() -> Optional[str]:
-    """Compile native/pause.c on first use (the native-store pattern);
-    -> binary path, or None without a toolchain."""
-    with _pause_lock:
-        have_bin = os.path.exists(_PAUSE_BIN)
-        if have_bin and (not os.path.exists(_PAUSE_SRC)
-                         or os.path.getmtime(_PAUSE_SRC)
-                         <= os.path.getmtime(_PAUSE_BIN)):
-            # fresh enough — and a prebuilt binary with no shipped
-            # source is taken as-is
-            return _PAUSE_BIN
-        if not os.path.exists(_PAUSE_SRC):
-            return None
-        # compile to a per-process unique name: two processes building
-        # concurrently must not interleave into one .tmp (os.replace of
-        # a complete file is atomic either way)
-        import tempfile as _tempfile
-        fd, tmp = _tempfile.mkstemp(prefix="pause-", dir=_NATIVE_DIR)
-        os.close(fd)
-        try:
-            for flags in (["-O2", "-static"], ["-O2"]):
-                try:
-                    subprocess.run(["cc", *flags, _PAUSE_SRC, "-o", tmp],
-                                   check=True, capture_output=True)
-                    os.replace(tmp, _PAUSE_BIN)
-                    return _PAUSE_BIN
-                except (OSError, subprocess.CalledProcessError):
-                    continue
-            return _PAUSE_BIN if have_bin else None
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+    """Compile native/pause.c on first use; -> binary path, or None
+    (no toolchain / unwritable dir — callers fall back to sleep)."""
+    from ..native.build import build_native
+    return build_native(_PAUSE_SRC, _PAUSE_BIN,
+                        [["cc", "-O2", "-static"], ["cc", "-O2"]])
 
 
 class _Proc:
@@ -84,7 +57,8 @@ class SubprocessRuntime(Runtime):
     """(ref: the dockertools/manager.go role, OS-process transport)"""
 
     def __init__(self, root_dir: Optional[str] = None,
-                 default_command: Optional[List[str]] = None):
+                 default_command: Optional[List[str]] = None,
+                 termination_grace: float = 2.0):
         # image-less containers run the default command: the pause
         # container (native/pause.c, the reference's third_party/pause
         # role — exist, hold the pod, exit 0 on SIGTERM), compiled on
@@ -92,6 +66,7 @@ class SubprocessRuntime(Runtime):
         # no C toolchain is present
         self.root_dir = root_dir or tempfile.mkdtemp(prefix="kubelet-run-")
         os.makedirs(self.root_dir, exist_ok=True)
+        self.termination_grace = termination_grace
         if default_command is not None:
             self.default_command = list(default_command)
         else:
@@ -275,16 +250,27 @@ class SubprocessRuntime(Runtime):
     # ------------------------------------------------------------ helpers
 
     def _kill(self, proc: _Proc) -> None:
+        """Graceful-then-forced, the docker-stop semantics the kubelet
+        relies on (dockertools KillContainer: SIGTERM, grace period,
+        SIGKILL): a well-behaved init — the pause program included —
+        exits 0 instead of recording rc=-9 on every teardown."""
         popen = proc.popen
         if popen.poll() is None:
             try:  # the whole session, not just the leader
-                os.killpg(popen.pid, signal.SIGKILL)
+                os.killpg(popen.pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
             try:
-                popen.wait(timeout=10)
+                popen.wait(timeout=self.termination_grace)
             except subprocess.TimeoutExpired:
-                pass
+                try:
+                    os.killpg(popen.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    popen.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
         self._mark_exited(proc)
 
     def _mark_exited(self, proc: _Proc) -> None:

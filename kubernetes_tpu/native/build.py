@@ -1,0 +1,56 @@
+"""Compile-on-first-use for the native pieces (kvstore, pause).
+
+One implementation of the build-and-cache-next-to-source pattern so the
+error-handling contract cannot drift between call sites: stale outputs
+rebuild (source newer than artifact), concurrent builders compile to
+per-process temp names and install atomically, any failure — missing
+toolchain, unwritable directory, compile error — degrades to None (the
+caller picks its fallback), and a prebuilt artifact with no shipped
+source is used as-is.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Sequence
+
+_lock = threading.Lock()
+
+
+def build_native(src: str, out: str,
+                 flag_sets: Sequence[List[str]]) -> Optional[str]:
+    """-> `out` when a usable artifact exists (built now or before),
+    else None. flag_sets are tried in order (e.g. -static first)."""
+    with _lock:
+        have = os.path.exists(out)
+        try:
+            if have and (not os.path.exists(src)
+                         or os.path.getmtime(src) <= os.path.getmtime(out)):
+                return out
+            if not os.path.exists(src):
+                return None
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(out) + "-",
+                dir=os.path.dirname(out))
+            os.close(fd)
+        except OSError:
+            return out if have else None
+        try:
+            for flags in flag_sets:
+                try:
+                    subprocess.run([*flags, src, "-o", tmp],
+                                   check=True, capture_output=True)
+                    os.replace(tmp, out)
+                    return out
+                except (OSError, subprocess.CalledProcessError):
+                    continue
+            return out if have else None
+        finally:
+            try:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            except OSError:
+                pass

@@ -386,6 +386,10 @@ class BatchEngine:
     devices and the per-step argmax reduces over ICI; without, single-chip.
     jit caches per (N, P, word-count) shape signature."""
 
+    # process-wide: set when the pallas filter kernel fails to
+    # compile/run on this platform (filter_masks then stays on XLA)
+    _pallas_broken = False
+
     def __init__(self, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
                  mesh: Optional[Mesh] = None, node_axis: str = "nodes",
                  policy=None):
@@ -521,10 +525,21 @@ class BatchEngine:
         kernel when the encoding qualifies (i32-narrowed, no affinity
         terms, single device — see pallas_filter.supports); anything
         else takes the XLA probe. Both are bit-exact with the oracle."""
-        if self.mesh is None and self.policy is None:
+        if self.mesh is None and self.policy is None \
+                and not BatchEngine._pallas_broken:
             from . import pallas_filter
             if pallas_filter.supports(enc):
-                return pallas_filter.filter_masks(enc)
+                try:
+                    return pallas_filter.filter_masks(enc)
+                except Exception:
+                    # a Mosaic/compile rejection on some TPU generation
+                    # must degrade, not take the extender down; the XLA
+                    # probe is the same math
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "pallas filter kernel failed; falling back to "
+                        "the XLA probe for this process")
+                    BatchEngine._pallas_broken = True
         mask, _ = self.probe(enc)
         return np.asarray(mask[:enc.n_pods]).astype(bool)
 

@@ -29,7 +29,10 @@ def spawn(component, *flags):
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubernetes_tpu", component, *flags],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+        cwd=REPO, env={**os.environ, "PYTHONPATH": REPO,
+                       # a wedged component dumps stacks on SIGABRT
+                       # (terminate() escalates) instead of dying mute
+                       "PYTHONFAULTHANDLER": "1"})
     return proc
 
 
@@ -47,9 +50,22 @@ def wait_ready(proc, timeout_s=120.0):
             f"component died: {proc.stderr.read()[-2000:]}")
     # keep draining: a chatty component (hollow proxy sync logs) would
     # otherwise fill the 64KB pipe, block on write, and never exit —
-    # terminate() then times out spuriously
-    threading.Thread(target=proc.stdout.read, daemon=True).start()
-    threading.Thread(target=proc.stderr.read, daemon=True).start()
+    # terminate() then times out spuriously. Drained stderr is kept for
+    # post-mortems (terminate's SIGABRT escalation dumps stacks there).
+    proc.drained_err = []
+
+    def drain(stream, sink):
+        while True:
+            chunk = stream.readline()
+            if not chunk:
+                return
+            if sink is not None:
+                sink.append(chunk)
+
+    threading.Thread(target=drain, args=(proc.stdout, None),
+                     daemon=True).start()
+    threading.Thread(target=drain, args=(proc.stderr, proc.drained_err),
+                     daemon=True).start()
     assert " ready" in line, line
     return line.strip()
 
@@ -64,9 +80,18 @@ def terminate(proc):
             # the device-parity suite compiles concurrently)
             proc.wait(timeout=180)
         except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait(timeout=10)
-            raise
+            # escalate with a stack dump (PYTHONFAULTHANDLER): the
+            # drained stderr then tells us WHERE the component wedged
+            proc.send_signal(signal.SIGABRT)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            stacks = "".join(getattr(proc, "drained_err", []))[-4000:]
+            raise RuntimeError(
+                f"component did not exit within 180s of SIGTERM; "
+                f"stacks:\n{stacks}")
     return proc.returncode
 
 

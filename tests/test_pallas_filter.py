@@ -134,3 +134,25 @@ def test_wide_encoding_falls_back():
     ref, _ = engine.probe(enc)
     got = engine.filter_masks(enc)
     assert np.array_equal(got, np.asarray(ref[:enc.n_pods]).astype(bool))
+
+
+def test_pallas_failure_degrades_to_xla_probe(monkeypatch):
+    """A kernel rejection on some TPU generation must not take the
+    extender down: filter_masks falls back to the XLA probe and latches
+    the fallback for the process."""
+    import numpy as np
+
+    from kubernetes_tpu.sched.device import engine as eng
+
+    snap = _snapshot(random.Random(23), 20, 5, 10)
+    e = BatchEngine()
+    enc = encode_snapshot(snap)
+    monkeypatch.setattr(pallas_filter, "filter_masks",
+                        lambda _enc: (_ for _ in ()).throw(
+                            RuntimeError("mosaic says no")))
+    monkeypatch.setattr(BatchEngine, "_pallas_broken", False)
+    got = e.filter_masks(enc)
+    ref, _ = e.probe(enc)
+    assert np.array_equal(got, np.asarray(ref[:enc.n_pods]).astype(bool))
+    assert BatchEngine._pallas_broken
+    monkeypatch.setattr(BatchEngine, "_pallas_broken", False)

@@ -61,14 +61,32 @@ class TestSubprocessRuntime:
         assert runtime.get_pods()[0].containers[0].exit_code == 3
 
     def test_kill_reports_signal_exit(self, runtime):
+        # graceful first (docker-stop semantics): sleep dies on the
+        # SIGTERM -> 143
         pod = mkpod("p", "u1", ["sleep", "60"])
         rc = runtime.start_container(pod, pod.spec.containers[0])
         pid = int(rc.id.split("//")[1])
         runtime.kill_container("u1", "c")
-        assert runtime.get_pods()[0].containers[0].exit_code == 137
+        assert runtime.get_pods()[0].containers[0].exit_code == 143
         assert wait_until(lambda: not os.path.exists(f"/proc/{pid}")
                           or open(f"/proc/{pid}/stat").read()
                           .split()[2] == "Z")
+
+    def test_kill_escalates_to_sigkill(self, tmp_path):
+        # a TERM-ignoring container gets the forced kill after the
+        # grace period -> 137
+        from kubernetes_tpu.kubelet.subprocess_runtime import (
+            SubprocessRuntime)
+        rt = SubprocessRuntime(root_dir=str(tmp_path),
+                               termination_grace=0.3)
+        pod = mkpod("p", "u-kk",
+                    ["sh", "-c", 'trap "" TERM; echo armed; sleep 60'])
+        rt.start_container(pod, pod.spec.containers[0])
+        # the trap races the kill: only signal once it is installed
+        assert wait_until(
+            lambda: "armed" in rt.get_container_logs("u-kk", "c"))
+        rt.kill_container("u-kk", "c")
+        assert rt.get_pods()[0].containers[0].exit_code == 137
 
     def test_kill_pod_kills_process_group(self, runtime):
         # the container spawns a child; killing the pod must reap BOTH

@@ -1,0 +1,72 @@
+"""Driver entry-point contract tests.
+
+The driver compile-checks `entry()` single-chip and runs
+`dryrun_multichip(n)` with N virtual CPU devices. MULTICHIP_r02 failed
+rc=124 because dryrun_multichip initialized the default jax backend
+in-process and the tunneled TPU platform wedged inside backend creation.
+These tests pin the contract: the entry module must complete even when
+the in-process jax backend would hang, by refusing to initialize it and
+re-execing into a CPU-pinned subprocess instead."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import __graft_entry__ as g  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = g.entry()
+    final_state, assigned = jax.jit(fn)(*args)
+    assigned = np.asarray(assigned)
+    assert assigned.shape[0] >= 16
+    assert (assigned[:16] >= 0).all(), assigned[:16]
+
+
+def test_dryrun_multichip_inproc_on_virtual_mesh():
+    # conftest pins 8 virtual CPU devices; the backend is already live,
+    # so dryrun_multichip takes the in-process path.
+    assert len(jax.devices()) >= 8
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_survives_wedged_backend():
+    """A wedged accelerator tunnel hangs jax backend CREATION itself.
+    Simulate it: a fake `jax` module whose devices() blocks forever and
+    whose xla_bridge reports no initialized backend. dryrun_multichip
+    must not touch devices() and must finish via its CPU subprocess."""
+    prog = """
+import sys, types, time
+fake = types.ModuleType("jax")
+def _hang():
+    time.sleep(3600)
+    raise AssertionError("unreachable")
+fake.devices = _hang
+src = types.ModuleType("jax._src")
+xb = types.ModuleType("jax._src.xla_bridge")
+xb._backends = {}
+src.xla_bridge = xb
+fake._src = src
+sys.modules["jax"] = fake
+sys.modules["jax._src"] = src
+sys.modules["jax._src.xla_bridge"] = xb
+import __graft_entry__ as g
+g.dryrun_multichip(4)
+print("WEDGE-SURVIVED")
+"""
+    env = dict(os.environ)
+    # the child must not inherit the conftest's 8-device CPU pin as an
+    # excuse: the fake jax hides the platform question entirely
+    # outer timeout must exceed dryrun's own 600s subprocess timeout so
+    # a slow grandchild surfaces dryrun's diagnostic RuntimeError, not a
+    # bare TimeoutExpired here
+    res = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, cwd=REPO, timeout=700)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert "WEDGE-SURVIVED" in res.stdout

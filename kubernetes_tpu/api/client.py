@@ -33,6 +33,13 @@ class Client:
     def create(self, resource: str, obj: Any, namespace: str = "") -> Any:
         raise NotImplementedError
 
+    def create_batch(self, resource: str, objs: List[Any],
+                     namespace: str = "") -> List[Any]:
+        """Create many objects of one resource in a single apiserver
+        round-trip / store window (the write-side analogue of
+        bind_batch). Default: sequential creates."""
+        return [self.create(resource, o, namespace) for o in objs]
+
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         raise NotImplementedError
 
@@ -96,6 +103,9 @@ class InProcClient(Client):
 
     def create(self, resource, obj, namespace=""):
         return self.registry.create(resource, obj, namespace)
+
+    def create_batch(self, resource, objs, namespace=""):
+        return self.registry.create_batch(resource, objs, namespace)
 
     def get(self, resource, name, namespace=""):
         return self.registry.get(resource, name, namespace)
@@ -175,6 +185,24 @@ class InProcClient(Client):
         return wsstream.client_connect(
             split.hostname, split.port,
             f"/attach/{namespace}/{name}/{container}{q}")
+
+    def exec_open(self, name, namespace, cmd, container="", stdin=False):
+        """-> an upgraded websocket for INTERACTIVE exec: output as
+        binary frames, stdin upstream, a final TEXT {"exitCode": N}
+        frame before CLOSE. In-proc dials the kubelet directly."""
+        import urllib.parse as up
+        from ..utils import wsstream
+        from .relay import resolve_pod_container
+        container, base = resolve_pod_container(self.registry, namespace,
+                                                name, container)
+        split = up.urlsplit(base)
+        params = [("command", c) for c in cmd]
+        if stdin:
+            params.append(("stdin", "true"))
+        q = "?" + up.urlencode(params)
+        return wsstream.client_connect(
+            split.hostname, split.port,
+            f"/exec/{namespace}/{name}/{container}{q}")
 
     def pod_logs_stream(self, name, namespace="default", container=""):
         from .relay import (container_log_url, iter_http_stream,
@@ -303,6 +331,30 @@ class HttpClient(Client):
         ns = namespace or getattr(obj.metadata, "namespace", "") or "default"
         return self._decode(self._do("POST", self._url(resource, ns), obj))
 
+    def create_batch(self, resource, objs, namespace=""):
+        """POST a JSON array: one batched store window server-side.
+        Objects are grouped by namespace (the URL names one namespace;
+        a mixed-namespace batch becomes one POST per namespace, same
+        result order as the input)."""
+        if not objs:
+            return []
+        groups: dict = {}
+        for i, o in enumerate(objs):
+            ns = (namespace or getattr(o.metadata, "namespace", "")
+                  or "default")
+            groups.setdefault(ns, []).append((i, o))
+        out = [None] * len(objs)
+        for ns, members in groups.items():
+            payload = json.dumps(
+                [self.scheme.encode_dict(o) for _i, o in members]).encode()
+            data = self._do("POST", self._url(resource, ns),
+                            raw_body=payload)
+            kind = data["kind"][:-4] if data["kind"].endswith("List") \
+                else data["kind"]
+            for (i, _o), item in zip(members, data["items"]):
+                out[i] = self._decode({**item, "kind": kind})
+        return out
+
     def get(self, resource, name, namespace=""):
         ns = namespace or "default"
         return self._decode(self._do("GET", self._url(resource, ns, name)))
@@ -367,6 +419,20 @@ class HttpClient(Client):
         q = ("?" + up.urlencode(params)) if params else ""
         return self._ws_connect(
             f"/api/v1/namespaces/{ns}/pods/{name}/attach{q}")
+
+    def exec_open(self, name, namespace, cmd, container="", stdin=False):
+        """-> an upgraded websocket through the apiserver's exec
+        relay (interactive exec; the one-shot path stays node_proxy)."""
+        import urllib.parse as up
+        ns = namespace or "default"
+        params = [("command", c) for c in cmd]
+        if container:
+            params.append(("container", container))
+        if stdin:
+            params.append(("stdin", "true"))
+        return self._ws_connect(
+            f"/api/v1/namespaces/{ns}/pods/{name}/exec?"
+            + up.urlencode(params))
 
     def watch(self, resource, namespace="", since_rev=None,
               label_selector="", field_selector=""):

@@ -46,7 +46,7 @@ _EXEC_PROXY_RE = re.compile(r"/proxy/nodes/[^/]+/exec(/|$)")
 # pods/{name}/portforward and /attach — a GET in transport, a raw
 # channel into the pod in effect (the reference requires the create
 # verb on both subresources)
-_PORTFORWARD_RE = re.compile(r"/pods/[^/]+/(portforward|attach)$")
+_PORTFORWARD_RE = re.compile(r"/pods/[^/]+/(portforward|attach|exec)$")
 
 
 def _authz_target(path: str):
@@ -190,7 +190,8 @@ class ApiServer:
                         or query.get("follow") in ("true", "1")
                         or "/watch/" in path or path.endswith("/watch")
                         or path.endswith("/portforward")
-                        or path.endswith("/attach"))
+                        or path.endswith("/attach")
+                        or path.endswith("/exec"))
         if not long_running and not self._inflight.acquire(blocking=False):
             self._send_error(h, TooManyRequests("too many requests in flight"))
             return
@@ -263,8 +264,11 @@ class ApiServer:
             return self._send_json(h, 200, swagger_api(self.url))
         if path in ("/ui", "/ui/"):
             from .swagger import ui_page
-            return self._send_raw(h, 200, ui_page().encode(),
-                                  "text/html; charset=utf-8")
+            return self._send_raw(
+                h, 200,
+                ui_page(self.registry,
+                        namespace=query.get("namespace", "")).encode(),
+                "text/html; charset=utf-8")
         if path == "/api":
             return self._send_json(h, 200, {"kind": "APIVersions",
                                             "versions": ["v1"]})
@@ -358,6 +362,9 @@ class ApiServer:
                 return self._serve_port_forward(h, namespace, name, query)
             if resource == "pods" and sub == "attach":
                 return self._serve_attach(h, namespace, name, query)
+            if resource == "pods" and sub == "exec" and \
+                    self._wants_websocket(h):
+                return self._serve_exec_ws(h, namespace, name, query)
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
@@ -380,6 +387,17 @@ class ApiServer:
                 pods = self.registry.bind_batch(bindings, namespace)
                 return self._send_json(h, 201, self.scheme.encode_list(
                     "Pod", pods, "0"))
+            if isinstance(body, list) and not name and not sub:
+                # batched create: one store window, one watch flush
+                # (write-side analogue of the bindings tile above);
+                # collection URLs only — named/subresource POSTs (e.g.
+                # pods/{name}/binding) keep their own handlers
+                objs = [self.scheme.decode_dict(b) for b in body]
+                created = self.registry.create_batch(resource, objs,
+                                                     namespace)
+                info = Registry.info(resource)
+                return self._send_json(h, 201, self.scheme.encode_list(
+                    info.kind, created, "0"))
             obj = self.scheme.decode_dict(body)
             if resource == "pods" and sub == "binding":
                 created = self.registry.bind(obj, namespace)
@@ -576,6 +594,50 @@ class ApiServer:
             up = wsstream.client_connect(split.hostname, split.port, path)
         except (ConnectionError, OSError) as e:
             raise BadGateway(f"kubelet attach: {e}")
+        try:
+            if not wsstream.server_handshake(h):
+                return
+
+            def down_write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
+
+            wsstream.relay_ws(h.rfile.read, down_write, up)
+        finally:
+            up.close()
+            h.close_connection = True
+
+    def _serve_exec_ws(self, h, namespace: str, name: str,
+                       query: dict) -> None:
+        """GET /pods/{name}/exec?command=...&container=&stdin= with a
+        websocket upgrade: relayed to the owning kubelet's interactive
+        /exec endpoint (ref: pkg/registry/pod/etcd ExecREST -> kubelet
+        ExecInContainer, server.go:242). Non-upgrade exec requests keep
+        the one-shot node-proxy path."""
+        import urllib.parse as _parse
+
+        from ..utils import wsstream
+        from .relay import exec_admission, resolve_pod_container
+
+        container, base = resolve_pod_container(
+            self.registry, namespace, name, query.get("container", ""))
+        # CONNECT admission (DenyExecOnPrivileged) gates this relay
+        # exactly like the one-shot node-proxy exec path — the
+        # websocket variant must not be an admission bypass
+        exec_admission(self.registry, f"exec/{namespace}/{name}/{container}")
+        # the dispatch query dict collapses repeats; command is
+        # multi-valued, so re-parse it from the raw request line
+        raw_q = _parse.parse_qs(_parse.urlsplit(h.path).query)
+        params = [("command", c) for c in raw_q.get("command", [])]
+        if "stdin" in query:
+            params.append(("stdin", query["stdin"]))
+        q = ("?" + _parse.urlencode(params)) if params else ""
+        split = _parse.urlsplit(base)
+        path = f"/exec/{namespace}/{name}/{container}{q}"
+        try:
+            up = wsstream.client_connect(split.hostname, split.port, path)
+        except (ConnectionError, OSError) as e:
+            raise BadGateway(f"kubelet exec: {e}")
         try:
             if not wsstream.server_handshake(h):
                 return

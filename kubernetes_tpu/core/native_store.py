@@ -210,6 +210,18 @@ class NativeStore:
         self._cache_put(key, rev, out)
         return out
 
+    def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]]
+                     ) -> List[Any]:
+        """Batched create. The native store's watch fan-out is already
+        decoupled from writes (the pump thread drains kv_events_since),
+        so per-key kv_create calls don't pay a per-watcher cost the way
+        the in-memory store's synchronous fan-out does; a C-side batch
+        entry point would only save ctypes crossings. Not all-or-nothing:
+        a mid-batch AlreadyExists leaves earlier creates committed (the
+        in-memory Store.create_batch is atomic; callers that need
+        atomicity use it via --storage-backend memory)."""
+        return [self.create(k, o, ttl) for k, o, ttl in entries]
+
     def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         raw = self._encode(obj)
         rev = self._lib.kv_set(self._h, key.encode(), raw, len(raw),

@@ -153,14 +153,25 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
         start = time.time()
         next_i = iter(range(n_pods))
         lock = threading.Lock()
+        # each writer claims a chunk and POSTs it through the batched
+        # create path: one store window + one watch flush per chunk
+        # instead of per pod (the create storm was ~1.6s of the 30k-pod
+        # wall time when every pod paid its own lock + fan-out)
+        chunk = 256
 
         def writer():
             while True:
                 with lock:
-                    i = next(next_i, None)
-                if i is None:
+                    ids = []
+                    for _ in range(chunk):
+                        i = next(next_i, None)
+                        if i is None:
+                            break
+                        ids.append(i)
+                if not ids:
                     return
-                client.create("pods", _bench_pod(i), "default")
+                client.create_batch("pods", [_bench_pod(i) for i in ids],
+                                    "default")
 
         writers = [threading.Thread(target=writer, daemon=True)
                    for _ in range(WRITER_THREADS)]

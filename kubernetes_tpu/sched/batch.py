@@ -171,8 +171,14 @@ class BatchScheduler:
             if item is None:
                 return
             try:
-                self.config.factory.modeler.locked_action(
-                    lambda: self._commit(item, inc_assumed=True))
+                # No tile-wide modeler lock here: the merged lister
+                # dedupes scheduled-vs-assumed by key, so bind→assume
+                # need not be atomic against the confirm reflector's
+                # forgets (a forget racing ahead of the assume leaves a
+                # stale assumed entry that list() prunes on sight).
+                # Holding the lock across a whole tile starved the
+                # reflector's per-event forgets on small-core hosts.
+                self._commit(item, inc_assumed=True)
             except Exception as e:
                 # _commit routes per-pod failures itself; anything
                 # escaping aborted the tile mid-way — route the whole
@@ -213,6 +219,20 @@ class BatchScheduler:
             if pod is None:
                 break
             pods.append(pod)
+        # Top-up while a tile is in flight: until the device reports the
+        # previous assignments ready, dispatching this tile would only
+        # queue behind it — so keep accumulating instead. Under a create
+        # storm this turns 12 ragged ~2.5k-pod tiles (each padded to a
+        # full scan) into 4 full ones (~3x less device work); when the
+        # device is idle or the result is already ready, nothing waits.
+        prev = self._prev
+        if prev is not None and len(pods) < self.config.tile_size:
+            ready = getattr(prev.assigned, "is_ready", lambda: True)
+            while (len(pods) < self.config.tile_size and not ready()
+                   and not self._stop.is_set()):
+                pod = f.pod_queue.pop(timeout=0.002)
+                if pod is not None:
+                    pods.append(pod)
         return pods
 
     @staticmethod
@@ -446,7 +466,11 @@ class BatchScheduler:
     def _commit(self, scheduled: List[Tuple[api.Pod, str]],
                 inc_assumed: bool) -> None:
         """Bind a tile (batched CAS, per-pod fallback), record events,
-        and assume into the modeler. Runs under modeler.locked_action."""
+        and assume into the modeler. The committer thread runs this
+        lock-free (assume_pods takes the modeler lock once at the end;
+        a confirm-reflector forget racing ahead of it wins via the
+        modeler's tombstones); only the policy-engine path still wraps
+        it in locked_action for snapshot ordering."""
         c = self.config
         f = c.factory
         bindings = [api.Binding(
@@ -476,6 +500,7 @@ class BatchScheduler:
                     self._error(pod, e)
         c.metrics.observe("binding_latency_microseconds",
                           (time.monotonic() - bind_start) * 1e6)
+        to_assume = []
         for ok, (pod, host) in zip(committed, scheduled):
             if not ok:
                 continue
@@ -485,11 +510,12 @@ class BatchScheduler:
                     f"Successfully assigned {pod.metadata.name} to {host}")
             assumed = api.fast_replace(
                 pod, spec=api.fast_replace(pod.spec, node_name=host))
-            f.modeler.assume_pod(assumed)
+            to_assume.append(assumed)
             if self._inc is not None and not inc_assumed:
                 # count the binding into the persistent device state
                 # now; the watch echo dedupes via the ledger
                 self._inc.assume(assumed)
+        f.modeler.assume_pods(to_assume)
 
     def _error(self, pod: api.Pod, err: Exception) -> None:
         self.config.factory.error_func(pod, err)

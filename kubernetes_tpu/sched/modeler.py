@@ -56,18 +56,51 @@ class SimpleModeler:
         self.scheduled_pods = scheduled_pods
         self._assumed = _TTLStore(ttl, clock)
         self._lock = threading.RLock()
+        self._clock = clock
+        # forget tombstones, keyed (ns/name, uid): a confirm-reflector
+        # forget that races AHEAD of the committer's assume must win, or
+        # a pod deleted right after confirmation would sit assumed (and
+        # consume phantom capacity) until the TTL. uid-scoped so a
+        # recreated same-name pod assumes normally.
+        self._forgotten: Dict[Tuple[str, str], float] = {}
 
     def locked_action(self, fn):
         """(ref: modeler.go:47 actionLocker.LockedAction)"""
         with self._lock:
             return fn()
 
+    def _gc_tombstones(self, now: float) -> None:
+        ttl = self._assumed.ttl
+        if len(self._forgotten) > 4096:
+            self._forgotten = {k: ts for k, ts in self._forgotten.items()
+                               if now - ts <= ttl}
+
+    def _tombstoned(self, pod: api.Pod, now: float) -> bool:
+        ts = self._forgotten.get(
+            (meta_namespace_key(pod), pod.metadata.uid))
+        return ts is not None and now - ts <= self._assumed.ttl
+
     def assume_pod(self, pod: api.Pod) -> None:
         with self._lock:
-            self._assumed.add(pod)
+            if not self._tombstoned(pod, self._clock.time()):
+                self._assumed.add(pod)
+
+    def assume_pods(self, pods: List[api.Pod]) -> None:
+        """One lock acquisition for a whole committed tile (the per-pod
+        variant made the binder hold/drop the lock 8192 times per tile
+        while the confirm reflector's forgets queued behind it)."""
+        with self._lock:
+            now = self._clock.time()
+            for pod in pods:
+                if not self._tombstoned(pod, now):
+                    self._assumed.add(pod)
 
     def forget_pod(self, pod: api.Pod) -> None:
         with self._lock:
+            now = self._clock.time()
+            self._forgotten[(meta_namespace_key(pod),
+                             pod.metadata.uid)] = now
+            self._gc_tombstones(now)
             self._assumed.delete_key(meta_namespace_key(pod))
 
     def forget_pod_by_key(self, key: str) -> None:

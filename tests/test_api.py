@@ -408,3 +408,59 @@ def test_websocket_watch():
         sock.close()
     finally:
         srv.stop()
+
+
+# ------------------------------------------------------- batched create
+
+def test_registry_create_batch_matches_create():
+    reg = Registry()
+    out = reg.create_batch("pods", [mk_pod(f"cb-{i}") for i in range(4)])
+    assert len(out) == 4
+    for o in out:
+        assert o.metadata.uid and o.metadata.creation_timestamp
+        assert o.metadata.resource_version
+    # validation failure anywhere fails the whole batch before commit
+    bad = mk_pod("ok-1")
+    with pytest.raises(Invalid):
+        reg.create_batch("pods", [mk_pod("ok-0"),
+                                  mk_pod("Bad_Name!"), bad])
+    with pytest.raises(NotFound):
+        reg.get("pods", "ok-0", "default")
+    # generate_name works through the batch path
+    gen = mk_pod("")
+    gen.metadata.generate_name = "burst-"
+    created = reg.create_batch("pods", [gen])
+    assert created[0].metadata.name.startswith("burst-")
+    # services fall back to the serial path (allocator side effects)
+    svcs = reg.create_batch("services", [api.Service(
+        metadata=api.ObjectMeta(name="s1", namespace="default"),
+        spec=api.ServiceSpec(selector={"a": "b"},
+                             ports=[api.ServicePort(port=80)]))])
+    assert svcs[0].spec.cluster_ip not in ("", None)
+
+
+def test_http_create_batch(server):
+    c = HttpClient(server.url)
+    out = c.create_batch("pods", [mk_pod(f"hb-{i}") for i in range(3)])
+    assert [o.metadata.name for o in out] == ["hb-0", "hb-1", "hb-2"]
+    assert all(o.metadata.uid for o in out)
+    items, _ = c.list("pods")
+    assert len(items) == 3
+    # one watch event per pod still reaches watchers
+    w = c.watch("pods", "default", since_rev=0)
+    seen = [w.next(timeout=2) for _ in range(3)]
+    assert [e.object.metadata.name for e in seen] == \
+        ["hb-0", "hb-1", "hb-2"]
+    w.stop()
+
+
+def test_http_create_batch_mixed_namespaces(server):
+    c = HttpClient(server.url)
+    # registry auto-creates "default"; make the second namespace first
+    c.create("namespaces", api.Namespace(
+        metadata=api.ObjectMeta(name="ns-b")))
+    out = c.create_batch("pods", [mk_pod("mx-0", ns="default"),
+                                  mk_pod("mx-1", ns="ns-b"),
+                                  mk_pod("mx-2", ns="default")])
+    assert [(o.metadata.name, o.metadata.namespace) for o in out] == [
+        ("mx-0", "default"), ("mx-1", "ns-b"), ("mx-2", "default")]

@@ -209,3 +209,32 @@ def test_batch_scheduler_on_sharded_mesh_end_to_end():
     finally:
         sched.stop()
         factory.stop()
+
+
+def test_modeler_forget_wins_over_late_assume():
+    """A confirm-reflector forget that lands BEFORE the committer's
+    assume must not leave the pod assumed (phantom capacity until the
+    TTL): uid-scoped tombstones make the forget win, while a recreated
+    pod with a fresh uid assumes normally."""
+    from kubernetes_tpu.sched.modeler import SimpleModeler
+
+    class _EmptyLister:
+        def list(self, selector=None):
+            return []
+
+        def exists(self, pod):
+            return False
+
+    m = SimpleModeler(_EmptyLister(), _EmptyLister())
+    pod = api.Pod(metadata=api.ObjectMeta(
+        name="p1", namespace="default", uid="uid-1"),
+        spec=api.PodSpec(node_name="n1"))
+    m.forget_pod(pod)          # confirm+delete raced ahead
+    m.assume_pods([pod])       # late assume from the committer
+    assert m.list() == []
+    # a recreated same-name pod (new uid) is not blocked
+    pod2 = api.Pod(metadata=api.ObjectMeta(
+        name="p1", namespace="default", uid="uid-2"),
+        spec=api.PodSpec(node_name="n1"))
+    m.assume_pods([pod2])
+    assert [p.metadata.uid for p in m.list()] == ["uid-2"]

@@ -419,3 +419,47 @@ def test_notfound_message_includes_name():
     s = Store()
     with pytest.raises(NotFound, match="missing-key"):
         s.get("/registry/pods/default/missing-key")
+
+
+def test_create_batch_atomic_and_single_fanout():
+    s = Store()
+    w = s.watch("/registry/pods/")
+    pods = [make_pod(f"b{i}") for i in range(5)]
+    out = s.create_batch([(pod_key("default", p.metadata.name), p, None)
+                          for p in pods])
+    assert [int(o.metadata.resource_version) for o in out] == [1, 2, 3, 4, 5]
+    evs = [w.next(timeout=1) for _ in range(5)]
+    assert all(e.type == watchpkg.ADDED for e in evs)
+    assert [e.object.metadata.name for e in evs] == \
+        [f"b{i}" for i in range(5)]
+    # the whole batch occupied ONE queue slot (one send_many)
+    assert w._count == 0 and not w._dq
+
+    # pre-existing key fails the whole batch before anything commits
+    rev0 = s.current_revision
+    with pytest.raises(AlreadyExists):
+        s.create_batch([
+            (pod_key("default", "fresh"), make_pod("fresh"), None),
+            (pod_key("default", "b0"), make_pod("b0"), None)])
+    assert s.current_revision == rev0
+    with pytest.raises(NotFound):
+        s.get(pod_key("default", "fresh"))
+
+    # intra-batch duplicate keys are rejected too
+    with pytest.raises(AlreadyExists):
+        s.create_batch([
+            (pod_key("default", "dup"), make_pod("dup"), None),
+            (pod_key("default", "dup"), make_pod("dup"), None)])
+    w.stop()
+
+
+def test_create_batch_filtered_watch_sees_only_matching():
+    s = Store()
+    w = s.watch("/registry/pods/",
+                predicate=lambda p: p.metadata.name.endswith("0"))
+    s.create_batch([(pod_key("default", f"c{i}"), make_pod(f"c{i}"), None)
+                    for i in range(4)])
+    ev = w.next(timeout=1)
+    assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "c0"
+    assert w.next(timeout=0.1) is None
+    w.stop()

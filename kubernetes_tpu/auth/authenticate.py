@@ -119,26 +119,51 @@ def _b64url_decode(part: str) -> bytes:
 
 
 class JWTAuthenticator(Authenticator):
-    """OIDC-shaped bearer JWTs: signature + iss/aud/exp claims checked,
+    """OIDC bearer JWTs: signature + iss/aud/exp claims checked,
     identity from configurable claims.
 
     Reference: plugin/pkg/auth/authenticator/token/oidc (flags
-    --oidc-issuer-url/-client-id/-username-claim/-groups-claim).
-    Deliberate divergence, documented: the reference verifies RS256
-    against the provider's JWKS; the Python stdlib has no RSA, so this
-    verifies HS256 against a shared secret — same token format, claim
-    semantics, and flag surface, different signature algorithm (RS256
-    would gate on a crypto dependency)."""
+    --oidc-issuer-url/-client-id/-username-claim/-groups-claim;
+    oidc.go verifies RS256 against the provider's JWKS). RS256 is
+    verified here with pure-Python PKCS#1 v1.5 (auth/rsa.py) against a
+    JWKS document; HS256 against a shared secret stays for the local
+    identity-provider role. Algorithm dispatch is strict — an RS256
+    public key can never be used as an HS256 secret (the classic JWT
+    alg-confusion downgrade), because each algorithm only consults its
+    own key material and a missing secret/jwks rejects outright."""
 
-    def __init__(self, secret: bytes, issuer: str = "",
+    def __init__(self, secret: Optional[bytes] = None, issuer: str = "",
                  audience: str = "", username_claim: str = "sub",
-                 groups_claim: str = "groups", clock=None):
+                 groups_claim: str = "groups", clock=None,
+                 jwks: Optional[dict] = None):
         self.secret = secret
         self.issuer = issuer
         self.audience = audience
         self.username_claim = username_claim
         self.groups_claim = groups_claim
         self._now = clock or time.time
+        from . import rsa as rsapkg
+        self._rsa = rsapkg
+        self._rsa_keys = rsapkg.jwks_rsa_keys(jwks) if jwks else []
+
+    def _signature_ok(self, head: dict, parts: List[str]) -> bool:
+        alg = head.get("alg")
+        signing_input = f"{parts[0]}.{parts[1]}".encode()
+        sig = _b64url_decode(parts[2])
+        if alg == "HS256":
+            if not self.secret:
+                return False
+            expected = hmac.new(self.secret, signing_input,
+                                hashlib.sha256).digest()
+            return hmac.compare_digest(expected, sig)
+        if alg == "RS256":
+            kid = head.get("kid")
+            candidates = [(k, n, e) for k, n, e in self._rsa_keys
+                          if kid is None or k is None or k == kid]
+            return any(
+                self._rsa.verify_pkcs1v15_sha256(n, e, signing_input, sig)
+                for _k, n, e in candidates)
+        return False  # unknown or absent alg (incl. "none"): reject
 
     def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
         header = headers.get("Authorization", "")
@@ -151,13 +176,7 @@ class JWTAuthenticator(Authenticator):
         try:
             import json
             head = json.loads(_b64url_decode(parts[0]))
-            if head.get("alg") != "HS256":
-                return None, False
-            expected = hmac.new(
-                self.secret, f"{parts[0]}.{parts[1]}".encode(),
-                hashlib.sha256).digest()
-            if not hmac.compare_digest(expected,
-                                       _b64url_decode(parts[2])):
+            if not self._signature_ok(head, parts):
                 return None, False
             claims = json.loads(_b64url_decode(parts[1]))
         except (ValueError, binascii.Error):
@@ -186,18 +205,36 @@ class JWTAuthenticator(Authenticator):
                         groups=[str(g) for g in groups]), True
 
 
-def make_jwt(secret: bytes, claims: dict) -> str:
-    """Mint an HS256 JWT (tests + local identity provider role)."""
+def _b64url_encode_json(obj) -> str:
     import json
+    raw = json.dumps(obj, separators=(",", ":")).encode()
+    return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
 
-    def enc(obj) -> str:
-        raw = json.dumps(obj, separators=(",", ":")).encode()
-        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
 
-    head = enc({"alg": "HS256", "typ": "JWT"})
-    body = enc(claims)
+def make_jwt(secret: bytes, claims: dict, header: Optional[dict] = None
+             ) -> str:
+    """Mint an HS256 JWT (tests + local identity provider role).
+    `header` overrides let tests forge alg-confusion headers."""
+    head = _b64url_encode_json(header or {"alg": "HS256", "typ": "JWT"})
+    body = _b64url_encode_json(claims)
     sig = hmac.new(secret, f"{head}.{body}".encode(),
                    hashlib.sha256).digest()
+    return (f"{head}.{body}."
+            f"{base64.urlsafe_b64encode(sig).rstrip(b'=').decode()}")
+
+
+def make_jwt_rs256(key: Dict[str, int], claims: dict, kid: str = ""
+                   ) -> str:
+    """Mint an RS256 JWT with an auth.rsa keypair dict {'n','e','d'}
+    (tests + local identity provider role)."""
+    from . import rsa as rsapkg
+    header = {"alg": "RS256", "typ": "JWT"}
+    if kid:
+        header["kid"] = kid
+    head = _b64url_encode_json(header)
+    body = _b64url_encode_json(claims)
+    sig = rsapkg.sign_pkcs1v15_sha256(key["n"], key["d"],
+                                      f"{head}.{body}".encode())
     return (f"{head}.{body}."
             f"{base64.urlsafe_b64encode(sig).rstrip(b'=').decode()}")
 

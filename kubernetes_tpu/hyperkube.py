@@ -20,6 +20,7 @@ cleanly, and exits 0.
 from __future__ import annotations
 
 import argparse
+import json
 import signal
 import sys
 import threading
@@ -127,6 +128,14 @@ def run_apiserver(argv: List[str]) -> int:
     p.add_argument("--client-ca-file", default="",
                    help="verify client certs against this CA and enable "
                         "x509 authentication (ref: --client-ca-file)")
+    p.add_argument("--oidc-jwks-file", default="",
+                   help="JWKS document for RS256 ID-token verification "
+                        "(ref: --oidc-issuer-url + provider JWKS sync; "
+                        "zero-egress stand-in for the discovery fetch)")
+    p.add_argument("--oidc-issuer-url", default="")
+    p.add_argument("--oidc-client-id", default="")
+    p.add_argument("--oidc-username-claim", default="sub")
+    p.add_argument("--oidc-groups-claim", default="groups")
     args = p.parse_args(argv)
 
     from .master import Master, MasterConfig
@@ -142,7 +151,13 @@ def run_apiserver(argv: List[str]) -> int:
         max_in_flight=args.max_requests_inflight,
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_private_key_file,
-        tls_client_ca_file=args.client_ca_file)).start()
+        tls_client_ca_file=args.client_ca_file,
+        oidc_jwks=(json.load(open(args.oidc_jwks_file))
+                   if args.oidc_jwks_file else None),
+        oidc_issuer=args.oidc_issuer_url,
+        oidc_client_id=args.oidc_client_id,
+        oidc_username_claim=args.oidc_username_claim,
+        oidc_groups_claim=args.oidc_groups_claim)).start()
     return _serve_until_signal(f"apiserver ready {master.url}",
                                [master.stop])
 

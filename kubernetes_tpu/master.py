@@ -57,6 +57,15 @@ class MasterConfig:
     # (ref: plugin/pkg/auth/authenticator password/passwordfile, tokenfile)
     basic_auth_lines: Optional[List[str]] = None
     token_auth_lines: Optional[List[str]] = None
+    # OIDC (ref: --oidc-issuer-url/--oidc-client-id, oidc.go): RS256
+    # verified against a JWKS document (pure-Python PKCS#1 v1.5,
+    # auth/rsa.py); oidc_hs256_secret adds the local-IdP HS256 mode
+    oidc_jwks: Optional[dict] = None
+    oidc_issuer: str = ""
+    oidc_client_id: str = ""
+    oidc_username_claim: str = "sub"
+    oidc_groups_claim: str = "groups"
+    oidc_hs256_secret: Optional[bytes] = None
     # authz: AlwaysAllow | AlwaysDeny | ABAC (ref: --authorization-mode)
     authorization_mode: str = "AlwaysAllow"
     authorization_policy_lines: Optional[List[str]] = None
@@ -105,6 +114,13 @@ class Master:
         if cfg.token_auth_lines:
             authenticators.append(
                 TokenAuthenticator.from_lines(cfg.token_auth_lines))
+        if cfg.oidc_jwks or cfg.oidc_hs256_secret:
+            from .auth.authenticate import JWTAuthenticator
+            authenticators.append(JWTAuthenticator(
+                secret=cfg.oidc_hs256_secret, jwks=cfg.oidc_jwks,
+                issuer=cfg.oidc_issuer, audience=cfg.oidc_client_id,
+                username_claim=cfg.oidc_username_claim,
+                groups_claim=cfg.oidc_groups_claim))
         if not authenticators:
             authenticator = None
         elif len(authenticators) == 1:

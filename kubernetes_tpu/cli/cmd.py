@@ -114,6 +114,12 @@ def build_parser() -> argparse.ArgumentParser:
     ex = sub.add_parser("exec", help="execute a command in a container")
     ex.add_argument("pod")
     ex.add_argument("-c", "--container", default="")
+    ex.add_argument("-i", "--stdin", action="store_true",
+                    help="stream this terminal's stdin to the command "
+                         "(interactive exec over the websocket relay)")
+    ex.add_argument("-t", "--tty", action="store_true",
+                    help="accepted for kubectl parity (no pty is "
+                         "allocated; output is the merged stream)")
     ex.add_argument("cmd", nargs="+",
                     help="command and args (use -- before flags)")
 
@@ -952,10 +958,14 @@ class Kubectl:
         finally:
             fwd.stop()
 
-    def exec_cmd(self, ns, pod_name, container, cmd) -> int:
-        """Run a command in a container via the apiserver's node-proxy
-        exec relay (ref: kubectl exec -> kubelet /exec; output answered
-        in-band, our documented non-SPDY divergence)."""
+    def exec_cmd(self, ns, pod_name, container, cmd, stdin=False,
+                 stdin_stream=None) -> int:
+        """Run a command in a container. Non-interactive: the
+        apiserver's node-proxy exec relay (one-shot {exitCode, output}).
+        With -i: the websocket exec subresource streams output live,
+        feeds stdin, and propagates the real exit code (ref: kubectl
+        exec -> kubelet ExecInContainer, server.go:242; SPDY there,
+        RFC 6455 here)."""
         import json as jsonlib
         import urllib.parse as up
         pod = self.client.get("pods", pod_name, ns)
@@ -966,6 +976,9 @@ class Kubectl:
                 raise ApiError(
                     f"pod {pod_name!r} has several containers; use -c")
             container = pod.spec.containers[0].name
+        if stdin:
+            return self._exec_interactive(ns, pod_name, container, cmd,
+                                          stdin_stream)
         query = up.urlencode([("command", c) for c in cmd])
         raw = self.client.node_proxy(
             pod.spec.node_name,
@@ -973,6 +986,63 @@ class Kubectl:
         result = jsonlib.loads(raw)
         self.out.write(result.get("output", ""))
         return int(result.get("exitCode", 0))
+
+    def _exec_interactive(self, ns, pod_name, container, cmd,
+                          stdin_stream=None) -> int:
+        """The attach loop with an exec session at the far end: BINARY
+        frames are output, the final TEXT frame carries the exit code."""
+        import codecs
+        import json as jsonlib
+        import threading as _threading
+
+        from ..utils import wsstream
+        ws = self.client.exec_open(pod_name, ns, cmd, container,
+                                   stdin=True)
+        decode = codecs.getincrementaldecoder("utf-8")(
+            errors="replace").decode
+        exit_code = 0
+        try:
+            src = stdin_stream if stdin_stream is not None \
+                else sys.stdin.buffer
+
+            def pump_stdin():
+                try:
+                    while True:
+                        data = (src.read1(4096) if hasattr(src, "read1")
+                                else src.read(4096))
+                        if not data:
+                            wsstream.write_frame(
+                                ws.sendall, wsstream.EOF_MARKER,
+                                wsstream.TEXT, mask=True)
+                            return
+                        wsstream.write_frame(ws.sendall, data,
+                                             wsstream.BINARY, mask=True)
+                except (ConnectionError, OSError, ValueError):
+                    pass
+
+            _threading.Thread(target=pump_stdin, daemon=True).start()
+            while True:
+                opcode, payload = wsstream.read_frame(ws.recv)
+                if opcode == wsstream.CLOSE:
+                    return exit_code
+                if opcode == wsstream.BINARY and payload:
+                    self.out.write(decode(payload))
+                    if hasattr(self.out, "flush"):
+                        self.out.flush()
+                elif opcode == wsstream.TEXT and \
+                        payload != wsstream.EOF_MARKER:
+                    try:
+                        exit_code = int(
+                            jsonlib.loads(payload).get("exitCode", 0))
+                    except (ValueError, AttributeError):
+                        pass
+        except KeyboardInterrupt:
+            return exit_code
+        except (ConnectionError, OSError) as e:
+            self.err.write(f"error: exec transport: {e}\n")
+            return 1
+        finally:
+            ws.close()
 
     def version(self) -> None:
         self.out.write(f"Client Version: {VERSION}\n")
@@ -1076,7 +1146,7 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
                    follow=ns_args.follow)
         elif ns_args.command == "exec":
             return k.exec_cmd(ns, ns_args.pod, ns_args.container,
-                              ns_args.cmd)
+                              ns_args.cmd, stdin=ns_args.stdin)
         elif ns_args.command == "port-forward":
             return k.port_forward(ns, ns_args.pod, ns_args.mapping,
                                   ns_args.address)

@@ -22,6 +22,7 @@ interactive-stream upgrade is out of the TPU-native scope.
 from __future__ import annotations
 
 import json
+import subprocess
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -368,14 +369,107 @@ class KubeletServer:
             h.close_connection = True
 
     def _exec(self, h, path: str, query: dict) -> None:
+        """GET /exec/{ns}/{pod}/{container}?command=...[&stdin=true].
+
+        Plain GET: one-shot {exitCode, output} (the original exec
+        divergence). With a websocket upgrade and a runtime that
+        supports exec_start: INTERACTIVE exec (ref: pkg/kubelet/
+        server.go:242 ExecInContainer streaming over SPDY; RFC 6455
+        here) — output as binary frames, client binary frames to
+        stdin, EOF_MARKER half-closes stdin, and at process exit a
+        TEXT frame carrying {"exitCode": N} precedes CLOSE so the
+        client can propagate the code the way kubectl exec does."""
         ns, pod_name, container = self._split_target(path, "/exec/")
         pod = self._find_pod(ns, pod_name)
         cmd = query.get("command", [])
         if not cmd:
             return self._raw(h, 400, b"missing command", "text/plain")
-        code, output = self.runtime.exec_in_container(
-            pod.metadata.uid, container, cmd)
-        self._json(h, 200, {"exitCode": code, "output": output})
+        wants_ws = ("websocket" in h.headers.get("Upgrade", "").lower()
+                    and "upgrade" in h.headers.get("Connection",
+                                                   "").lower())
+        if wants_ws and not hasattr(self.runtime, "exec_start"):
+            # refuse BEFORE running anything: answering a websocket
+            # handshake with one-shot JSON would execute the command,
+            # then fail the upgrade — a 502 at the relay after real
+            # side effects (and a client retry re-runs the command)
+            return self._raw(h, 501,
+                             b"runtime does not support interactive exec",
+                             "text/plain")
+        if not wants_ws:
+            code, output = self.runtime.exec_in_container(
+                pod.metadata.uid, container, cmd)
+            return self._json(h, 200, {"exitCode": code, "output": output})
+        self._exec_interactive(h, pod, container, cmd, query)
+
+    def _exec_interactive(self, h, pod, container: str, cmd: list,
+                          query: dict) -> None:
+        from ..utils import wsstream
+
+        want_stdin = query.get("stdin", ["false"])[0] in ("true", "1")
+        try:
+            session = self.runtime.exec_start(
+                pod.metadata.uid, container, cmd, stdin=want_stdin)
+        except KeyError as e:
+            return self._raw(h, 404, str(e).encode(), "text/plain")
+        if not wsstream.server_handshake(h):
+            session.kill()
+            return
+        wlock = threading.Lock()
+
+        def write(b: bytes) -> None:
+            with wlock:  # output pump and the exit/CLOSE share the pipe
+                h.wfile.write(b)
+                h.wfile.flush()
+
+        def out_pump():
+            try:
+                while True:
+                    data = session.read()
+                    if not data:
+                        break
+                    wsstream.write_frame(write, data, wsstream.BINARY)
+                try:
+                    code = session.exit_code()
+                except subprocess.TimeoutExpired:
+                    # stdout EOF without exit (fd handed to a child /
+                    # closed deliberately): report the indeterminate
+                    # state rather than dying frame-less (a missing
+                    # exitCode frame decodes as success client-side)
+                    session.kill()
+                    code = -1
+                wsstream.write_frame(
+                    write, json.dumps({"exitCode": code}).encode(),
+                    wsstream.TEXT)
+            except (ConnectionError, OSError, ValueError):
+                pass
+            finally:
+                try:
+                    wsstream.write_frame(write, b"", wsstream.CLOSE)
+                except (ConnectionError, OSError, ValueError):
+                    pass
+
+        pump = threading.Thread(target=out_pump, daemon=True)
+        pump.start()
+        try:
+            while True:
+                opcode, payload = wsstream.read_frame(h.rfile.read)
+                if opcode == wsstream.CLOSE:
+                    break
+                if opcode == wsstream.TEXT and \
+                        payload == wsstream.EOF_MARKER:
+                    session.close_stdin()
+                    continue
+                if opcode == wsstream.BINARY and payload and want_stdin:
+                    try:
+                        session.write_stdin(payload)
+                    except OSError:
+                        break  # process gone / stdin closed
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            session.kill()
+            pump.join(timeout=5)
+            h.close_connection = True
 
     def _running_pods(self) -> dict:
         items = []

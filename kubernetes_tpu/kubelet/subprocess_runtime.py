@@ -53,6 +53,47 @@ class _Proc:
         self.env = env
 
 
+class ExecSession:
+    """One interactive exec'd process: live output reads, stdin writes,
+    exit code. read() blocks (callers pump it from a thread, exactly as
+    the attach output pump does)."""
+
+    def __init__(self, popen: subprocess.Popen):
+        self._popen = popen
+
+    def read(self, n: int = 65536) -> bytes:
+        """Next piece of merged stdout/stderr; b'' at process EOF."""
+        out = self._popen.stdout
+        return out.read1(n) if out is not None else b""
+
+    def write_stdin(self, data: bytes) -> None:
+        if self._popen.stdin is None:
+            raise OSError("exec session has no stdin")
+        self._popen.stdin.write(data)
+        self._popen.stdin.flush()
+
+    def close_stdin(self) -> None:
+        if self._popen.stdin is not None:
+            try:
+                self._popen.stdin.close()
+            except OSError:
+                pass
+
+    def running(self) -> bool:
+        return self._popen.poll() is None
+
+    def exit_code(self, timeout: float = 30.0) -> int:
+        return self._popen.wait(timeout=timeout)
+
+    def kill(self) -> None:
+        if self._popen.poll() is None:
+            try:
+                self._popen.kill()
+            except OSError:
+                pass
+        self.close_stdin()
+
+
 class SubprocessRuntime(Runtime):
     """(ref: the dockertools/manager.go role, OS-process transport)"""
 
@@ -205,6 +246,23 @@ class SubprocessRuntime(Runtime):
             if not any(uid == pod_uid for uid, _ in self._procs):
                 raise KeyError(f"pod {pod_uid!r} has no running container")
         return ("127.0.0.1", port)
+
+    def exec_start(self, pod_uid: str, name: str, cmd: List[str],
+                   stdin: bool = False) -> "ExecSession":
+        """Interactive exec: spawn the command in the container's
+        environment with live pipes (ref: pkg/kubelet/server.go:242
+        ExecInContainer streaming stdin/stdout over SPDY; the session
+        object is our transport-neutral half). stderr merges into
+        stdout — one output stream, our documented exec divergence."""
+        with self._lock:
+            proc = self._procs.get((pod_uid, name))
+        if proc is None:
+            raise KeyError(f"container {name!r} not found")
+        popen = subprocess.Popen(
+            cmd, cwd=self.root_dir, env=proc.env,
+            stdin=subprocess.PIPE if stdin else subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        return ExecSession(popen)
 
     def exec_in_container(self, pod_uid: str, name: str,
                           cmd: List[str]) -> Tuple[int, str]:

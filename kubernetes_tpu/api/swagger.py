@@ -90,26 +90,107 @@ def _is_extensions(resource: str) -> bool:
     return resource in EXTENSIONS_RESOURCES
 
 
-def ui_page() -> str:
-    """The /ui dashboard: live resource listing (pkg/ui's role)."""
-    rows = "\n".join(
+def _esc(s: Any) -> str:
+    return (str(s).replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _node_ready(node) -> str:
+    for c in node.status.conditions:
+        if c.type == "Ready":
+            return "Ready" if c.status == "True" else "NotReady"
+    return "Unknown"
+
+
+def ui_page(registry=None, namespace: str = "", limit: int = 500) -> str:
+    """The /ui dashboard (pkg/ui's role): a live server-rendered view of
+    nodes, pods (phase/host), and recent events straight from the watch
+    cache (the in-process store IS the cache), refreshing every 5s —
+    the reference's 17k-LoC go-bindata'd JS dashboard replaced by one
+    reflective page over the same data."""
+    index_rows = "\n".join(
         f'<tr><td><a href="{_href(name, info)}">{name}</a></td>'
         f"<td>{info.kind}</td>"
         f"<td>{'namespaced' if info.namespaced else 'cluster'}</td></tr>"
         for name, info in sorted(RESOURCES.items()))
+    cluster = ""
+    if registry is not None:
+        nodes, _ = registry.list("nodes", "")
+        pods, _ = registry.list("pods", namespace)
+        events, _ = registry.list("events", namespace)
+        phases: Dict[str, int] = {}
+        for p in pods:
+            phases[p.status.phase or "Unknown"] = \
+                phases.get(p.status.phase or "Unknown", 0) + 1
+        ready = sum(1 for n in nodes if _node_ready(n) == "Ready")
+        phase_sum = ", ".join(f"{_esc(k)}: {v}"
+                              for k, v in sorted(phases.items()))
+        pods_by_node: Dict[str, int] = {}
+        for p in pods:
+            if p.spec.node_name:
+                pods_by_node[p.spec.node_name] = \
+                    pods_by_node.get(p.spec.node_name, 0) + 1
+        node_rows = "\n".join(
+            f"<tr><td>{_esc(n.metadata.name)}</td>"
+            f"<td>{_node_ready(n)}</td>"
+            f"<td>{_esc(n.status.capacity.get('cpu', ''))}</td>"
+            f"<td>{_esc(n.status.capacity.get('memory', ''))}</td>"
+            f"<td>{pods_by_node.get(n.metadata.name, 0)}</td></tr>"
+            for n in nodes[:limit])
+        pod_rows = "\n".join(
+            f"<tr><td>{_esc(p.metadata.namespace)}</td>"
+            f"<td>{_esc(p.metadata.name)}</td>"
+            f"<td>{_esc(p.status.phase)}</td>"
+            f"<td>{_esc(p.spec.node_name) if p.spec.node_name else '&mdash;'}"
+            f"</td></tr>"
+            for p in pods[:limit])
+        recent = sorted(events, key=lambda e: e.last_timestamp or "",
+                        reverse=True)[:30]
+        event_rows = "\n".join(
+            f"<tr><td>{_esc(e.type)}</td><td>{_esc(e.reason)}</td>"
+            f"<td>{_esc(e.involved_object.kind)}/"
+            f"{_esc(e.involved_object.name)}</td>"
+            f"<td>{_esc(e.message)}</td><td>{e.count}</td></tr>"
+            for e in recent)
+        trunc_pods = (f"<p>showing {limit} of {len(pods)} pods</p>"
+                      if len(pods) > limit else "")
+        trunc_nodes = (f"<p>showing {limit} of {len(nodes)} nodes</p>"
+                       if len(nodes) > limit else "")
+        cluster = f"""
+<h2>Cluster</h2>
+<p>nodes: {ready}/{len(nodes)} ready &middot; pods: {len(pods)}
+ ({phase_sum or "none"})</p>
+<h2>Nodes</h2>
+<table><tr><th>name</th><th>status</th><th>cpu</th><th>memory</th>
+<th>pods</th></tr>
+{node_rows}
+</table>{trunc_nodes}
+<h2>Pods</h2>
+<table><tr><th>namespace</th><th>name</th><th>phase</th><th>node</th></tr>
+{pod_rows}
+</table>{trunc_pods}
+<h2>Recent events</h2>
+<table><tr><th>type</th><th>reason</th><th>object</th><th>message</th>
+<th>count</th></tr>
+{event_rows}
+</table>"""
     return f"""<!DOCTYPE html>
 <html><head><title>kubernetes_tpu</title>
+<meta http-equiv="refresh" content="5">
 <style>
  body {{ font-family: sans-serif; margin: 2em; }}
  table {{ border-collapse: collapse; }}
  td, th {{ border: 1px solid #ccc; padding: 4px 12px; }}
+ h2 {{ margin-top: 1.2em; }}
 </style></head>
 <body>
 <h1>kubernetes_tpu</h1>
-<p>API resources (<a href="/swaggerapi">swagger</a>,
+<p>(<a href="/swaggerapi">swagger</a>,
 <a href="/metrics">metrics</a>, <a href="/healthz">healthz</a>)</p>
+{cluster}
+<h2>API resources</h2>
 <table><tr><th>resource</th><th>kind</th><th>scope</th></tr>
-{rows}
+{index_rows}
 </table></body></html>"""
 
 

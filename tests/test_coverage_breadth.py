@@ -287,3 +287,49 @@ class TestComponentStatusesAndPodTemplates:
         registry.delete("podtemplates", "web-template", "default")
         with pytest.raises(Exception):
             registry.get("podtemplates", "web-template", "default")
+
+
+class TestLiveDashboard:
+    def test_ui_renders_live_cluster_state(self):
+        """/ui is a live dashboard (pkg/ui's role): created nodes, pods
+        (phase + host), and events appear in the rendered page."""
+        registry = Registry()
+        srv = ApiServer(registry).start()
+        try:
+            registry.create("nodes", api.Node(
+                metadata=api.ObjectMeta(name="dash-node"),
+                status=api.NodeStatus(
+                    capacity={"cpu": parse_quantity("4")},
+                    conditions=[api.NodeCondition(type="Ready",
+                                                  status="True")])))
+            registry.create("pods", api.Pod(
+                metadata=api.ObjectMeta(name="dash-pod",
+                                        namespace="default"),
+                spec=api.PodSpec(node_name="dash-node",
+                                 containers=[api.Container(name="c")]),
+                status=api.PodStatus(phase="Running")))
+            registry.create("events", api.Event(
+                metadata=api.ObjectMeta(name="dash-ev",
+                                        namespace="default"),
+                involved_object=api.ObjectReference(kind="Pod",
+                                                    name="dash-pod"),
+                reason="Scheduled", type="Normal",
+                message="assigned dash-pod to dash-node", count=1))
+            with urllib.request.urlopen(srv.url + "/ui", timeout=5) as r:
+                page = r.read().decode()
+            assert "dash-node" in page and "1/1 ready" in page
+            assert "dash-pod" in page and "Running" in page
+            assert "Scheduled" in page and "assigned dash-pod" in page
+            # XSS hygiene: object fields are escaped
+            registry.create("pods", api.Pod(
+                metadata=api.ObjectMeta(
+                    name="xss", namespace="default",
+                    labels={}),
+                spec=api.PodSpec(containers=[api.Container(name="c")]),
+                status=api.PodStatus(phase="<script>alert(1)</script>")))
+            with urllib.request.urlopen(srv.url + "/ui", timeout=5) as r:
+                page = r.read().decode()
+            assert "<script>alert(1)" not in page
+            assert "&lt;script&gt;" in page
+        finally:
+            srv.stop()

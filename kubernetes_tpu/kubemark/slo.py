@@ -1,0 +1,237 @@
+"""Density/latency SLOs over the hollow fleet.
+
+Reference: the e2e suite enforces hard latency gates —
+  - API calls: p99 < 1s   (test/e2e/metrics_util.go:41-47 apiCallLatency
+    thresholds, :194-200 HighLatencyRequests gate)
+  - Pod startup: p50 < 5s (test/e2e/metrics_util.go:224-225 +
+    density.go:203-208, latency.go:172 — create -> Running observed by
+    a watch)
+
+This module measures both over the same kubemark harness the
+throughput benchmark uses, but with the API surface served over REAL
+HTTP (the reference measures the apiserver, not an in-proc shortcut):
+pods are POSTed through the HTTP client, a prober thread issues
+GET/LIST calls throughout the run, and a watch records when each pod
+is first seen Running. check() applies the reference's gates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.client import HttpClient, InProcClient
+from ..api.registry import Registry
+from ..api.server import ApiServer
+from ..core import types as api
+from ..sched.batch import BatchScheduler
+from ..sched.factory import ConfigFactory
+from .benchmark import _bench_pod
+from .fleet import HollowFleet
+
+API_P99_LIMIT_S = 1.0      # ref: metrics_util.go:41-47
+STARTUP_P50_LIMIT_S = 5.0  # ref: metrics_util.go:224-225, density.go:203
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+@dataclass
+class SLOResult:
+    n_nodes: int
+    n_pods: int
+    running: int
+    elapsed_s: float
+    api_p50_s: float
+    api_p90_s: float
+    api_p99_s: float
+    api_calls: int
+    startup_p50_s: float
+    startup_p90_s: float
+    startup_p99_s: float
+    api_p99_limit_s: float = API_P99_LIMIT_S
+    startup_p50_limit_s: float = STARTUP_P50_LIMIT_S
+
+    @property
+    def api_ok(self) -> bool:
+        return self.api_p99_s < self.api_p99_limit_s
+
+    @property
+    def startup_ok(self) -> bool:
+        return self.startup_p50_s < self.startup_p50_limit_s
+
+    def check(self) -> None:
+        """Raise AssertionError when a gate is violated — the e2e
+        suite's hard-failure semantics (density.go asserts, not logs)."""
+        assert self.api_ok, (
+            f"API p99 {self.api_p99_s:.3f}s exceeds "
+            f"{self.api_p99_limit_s}s (ref metrics_util.go:194-200)")
+        assert self.startup_ok, (
+            f"pod startup p50 {self.startup_p50_s:.3f}s exceeds "
+            f"{self.startup_p50_limit_s}s (ref density.go:203-208)")
+
+    def as_dict(self) -> dict:
+        return {
+            "nodes": self.n_nodes, "pods": self.n_pods,
+            "running": self.running,
+            "elapsed_s": round(self.elapsed_s, 2),
+            "api_p50_ms": round(self.api_p50_s * 1e3, 2),
+            "api_p90_ms": round(self.api_p90_s * 1e3, 2),
+            "api_p99_ms": round(self.api_p99_s * 1e3, 2),
+            "api_calls": self.api_calls,
+            "startup_p50_s": round(self.startup_p50_s, 3),
+            "startup_p90_s": round(self.startup_p90_s, 3),
+            "startup_p99_s": round(self.startup_p99_s, 3),
+            "api_slo_ok": self.api_ok,
+            "startup_slo_ok": self.startup_ok,
+        }
+
+
+def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
+                    timeout_s: float = 300.0,
+                    max_pods_per_node: int = 40) -> SLOResult:
+    """Stand up master-over-HTTP + hollow fleet + batch scheduler, blast
+    pods, and measure the two SLO families until every pod is Running."""
+    import sys
+    sys.setswitchinterval(0.001)
+    registry = Registry()
+    server = ApiServer(registry, port=0).start()
+    inproc = InProcClient(registry)
+    http = HttpClient(server.url)
+
+    api_lat: List[float] = []
+    api_lock = threading.Lock()
+
+    def timed(fn, *a, **kw):
+        t0 = time.monotonic()
+        out = fn(*a, **kw)
+        with api_lock:
+            api_lat.append(time.monotonic() - t0)
+        return out
+
+    # fleet + scheduler ride the in-proc path (separate processes in a
+    # real deployment; the HTTP surface under measurement is the one
+    # the pod writers and probers hit, as in the reference's density
+    # run where the e2e client measures the apiserver)
+    fleet = HollowFleet(inproc, n_nodes, cpu="4", memory="32Gi",
+                        max_pods=max_pods_per_node,
+                        heartbeat_interval=60.0).run()
+    factory = ConfigFactory(inproc, rate_limit=False).start()
+    sched = BatchScheduler(factory.create_batch()).run()
+
+    created_at: Dict[str, float] = {}
+    running_at: Dict[str, float] = {}
+    all_running = threading.Event()
+    watcher = registry.watch("pods", "default")
+
+    def track_running():
+        # independent of created_at: a Running confirm can race ahead
+        # of the creating thread's bookkeeping, and a pod missed here
+        # would stall the run to its timeout
+        for ev in watcher:
+            pod = ev.object
+            name = pod.metadata.name
+            if (name.startswith("bench-pod-") and name not in running_at
+                    and ev.type != "DELETED"
+                    and pod.status.phase == "Running"):
+                running_at[name] = time.monotonic()
+                if len(running_at) >= n_pods:
+                    all_running.set()
+
+    stop_probe = threading.Event()
+
+    def prober():
+        """Steady background API load, measured: the reference's gate
+        covers every verb the cluster serves during density."""
+        i = 0
+        while not stop_probe.is_set():
+            try:
+                timed(http.list, "nodes")
+                timed(http.get, "namespaces", "default")
+                names = list(created_at)
+                if names:
+                    timed(http.get, "pods", names[i % len(names)])
+                i += 1
+            except Exception:
+                pass  # a failed probe still counted its latency
+            stop_probe.wait(0.02)
+
+    deadline = time.time() + timeout_s
+    try:
+        while time.time() < deadline and \
+                len(factory.node_lister.list()) < n_nodes:
+            time.sleep(0.05)
+        # warm the engine's compile cache at the run's real shapes (a
+        # live scheduler has warm caches; XLA compiles inside the
+        # measured window would bill ~seconds of compiler time to the
+        # first pods' startup SLO)
+        from .benchmark import _warmup_batch
+        _warmup_batch(sched, factory)
+        threading.Thread(target=track_running, daemon=True).start()
+        threading.Thread(target=prober, daemon=True).start()
+
+        start = time.monotonic()
+        chunk = 128
+        for base in range(0, n_pods, chunk):
+            pods = [_bench_pod(i) for i in range(base,
+                                                 min(base + chunk, n_pods))]
+            # creation time = just BEFORE the POST (the reference
+            # measures from pod creation, density.go), recorded first
+            # so a fast Running confirm can never outrun it
+            t0 = time.monotonic()
+            for p in pods:
+                created_at.setdefault(p.metadata.name, t0)
+            http.create_batch("pods", pods, "default")
+            with api_lock:
+                api_lat.append(time.monotonic() - t0)
+        all_running.wait(timeout=max(0.0, deadline - time.time()))
+        elapsed = time.monotonic() - start
+    finally:
+        stop_probe.set()
+        watcher.stop()
+        sched.stop()
+        factory.stop()
+        fleet.stop()
+        server.stop()
+
+    startups = sorted(running_at[n] - created_at[n]
+                      for n in running_at if n in created_at)
+    with api_lock:
+        lats = sorted(api_lat)
+    return SLOResult(
+        n_nodes=n_nodes, n_pods=n_pods, running=len(running_at),
+        elapsed_s=elapsed,
+        api_p50_s=_percentile(lats, 0.50),
+        api_p90_s=_percentile(lats, 0.90),
+        api_p99_s=_percentile(lats, 0.99),
+        api_calls=len(lats),
+        startup_p50_s=_percentile(startups, 0.50),
+        startup_p90_s=_percentile(startups, 0.90),
+        startup_p99_s=_percentile(startups, 0.99))
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    from ..utils.platform import ensure_live_platform
+    ensure_live_platform()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=3000)
+    ap.add_argument("--no-check", action="store_true")
+    args = ap.parse_args()
+    r = run_density_slo(args.nodes, args.pods)
+    print(json.dumps({"metric": "density_slo", **r.as_dict()}))
+    if not args.no_check:
+        r.check()
+
+
+if __name__ == "__main__":
+    main()

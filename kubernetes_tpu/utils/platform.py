@@ -1,0 +1,81 @@
+"""Accelerator platform probing for standalone entry points.
+
+The default platform may be a tunneled TPU whose wedged state hangs the
+FIRST dispatch (even backend creation) forever. Every standalone
+benchmark/driver entry must therefore probe the platform in a timed
+subprocess before any in-process jax dispatch, and fall back to CPU —
+recording which platform actually ran — rather than hang.
+(The same discipline __graft_entry__.dryrun_multichip applies.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_CHECKED_ENV = "KTPU_PLATFORM_CHECKED"
+_DIAG_ENV = "KTPU_PROBE_DIAG"
+
+
+def probe_default_platform(timeout: float = 180.0) -> bool:
+    """True iff a tiny dispatch completes on the default platform in a
+    clean subprocess within the timeout."""
+    probe = ("import jax, jax.numpy as jnp; "
+             "jnp.ones(4).sum().block_until_ready(); print('ok')")
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            timeout=timeout).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def probe_with_retries(attempts: int = 1, timeout: float = 180.0,
+                       backoff: float = 30.0) -> dict:
+    """The tunnel wedges for hours but recovers; retry the probe a few
+    times and return the diagnostics either way."""
+    import time
+    history = []
+    for i in range(attempts):
+        t0 = time.time()
+        ok = probe_default_platform(timeout)
+        history.append({"attempt": i + 1, "ok": ok,
+                        "elapsed_s": round(time.time() - t0, 1)})
+        if ok:
+            return {"healthy": True, "attempts": history}
+        if i + 1 < attempts:
+            time.sleep(backoff)
+    return {"healthy": False, "attempts": history}
+
+
+def ensure_live_platform(attempts: int = 1,
+                         timeout: float = 180.0) -> tuple:
+    """Probe the default platform; on failure re-exec this process with
+    JAX_PLATFORMS=cpu (the env var alone is not enough past the image's
+    sitecustomize platform pin, so the re-exec'd run must ALSO call
+    jax.config.update — done here when the marker env var is present).
+
+    -> (platform, probe_diagnostics): "default" or "cpu-fallback" plus
+    the retry history (both belong in every benchmark artifact so
+    numbers are attributable to hardware)."""
+    import json
+    if os.environ.get(_CHECKED_ENV):
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        diag = json.loads(os.environ.get(_DIAG_ENV, "{}") or "{}")
+        if plat:
+            import jax
+            jax.config.update("jax_platforms", plat)
+            return ("cpu-fallback" if plat == "cpu" else "default"), diag
+        return "default", diag
+    diag = probe_with_retries(attempts, timeout)
+    os.environ[_CHECKED_ENV] = "1"
+    os.environ[_DIAG_ENV] = json.dumps(diag)
+    if not diag["healthy"]:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        # orig_argv replays the exact invocation (`python -m pkg.mod`
+        # included — re-execing sys.argv[0] as a script would break
+        # relative imports for -m entry points)
+        os.execve(sys.executable, list(sys.orig_argv), env)
+    return "default", diag

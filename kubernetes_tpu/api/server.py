@@ -798,17 +798,28 @@ class ApiServer:
         try:
             if not wsstream.server_handshake(h):
                 return
+            # event writer and the drain thread's pongs share the pipe
+            wlock = threading.Lock()
+
+            def write(b: bytes) -> None:
+                with wlock:
+                    h.wfile.write(b)
+                    h.wfile.flush()
 
             def drain_client_frames():
-                """Read and discard client frames; a Close frame (or a
-                malformed/oversized one) stops the watcher, which makes
-                the write loop answer with its own Close."""
+                """Read client frames: answer Ping with Pong (RFC 6455
+                5.5.3, echoing the payload), stop the watcher on Close
+                (or a malformed/oversized frame), discard the rest like
+                the reference's Receive loop."""
                 try:
                     while True:
-                        opcode, _payload = wsstream.read_frame(
+                        opcode, payload = wsstream.read_frame(
                             h.rfile.read)
                         if opcode == wsstream.CLOSE:
                             break
+                        if opcode == wsstream.PING:
+                            wsstream.write_frame(write, payload,
+                                                 wsstream.PONG)
                 except (ConnectionError, OSError, ValueError):
                     pass
                 finally:
@@ -816,10 +827,6 @@ class ApiServer:
 
             threading.Thread(target=drain_client_frames,
                              daemon=True).start()
-
-            def write(b: bytes) -> None:
-                h.wfile.write(b)
-                h.wfile.flush()
 
             while True:
                 ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)

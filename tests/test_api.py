@@ -464,3 +464,30 @@ def test_http_create_batch_mixed_namespaces(server):
                                   mk_pod("mx-2", ns="default")])
     assert [(o.metadata.name, o.metadata.namespace) for o in out] == [
         ("mx-0", "default"), ("mx-1", "ns-b"), ("mx-2", "default")]
+
+
+def test_websocket_watch_answers_ping_with_pong():
+    """RFC 6455 5.5.2/5.5.3: a client Ping gets a Pong echoing the
+    payload (ref: the reference's wsstream handles control frames;
+    was DIVERGENCES #5 until this round)."""
+    from kubernetes_tpu.utils import wsstream
+
+    registry = Registry()
+    srv = ApiServer(registry, port=0).start()
+    try:
+        ws = wsstream.client_connect(
+            "127.0.0.1", srv.port, "/api/v1/pods?watch=true")
+        try:
+            wsstream.write_frame(ws.sendall, b"are-you-there",
+                                 wsstream.PING, mask=True)
+            ws.settimeout(5.0)
+            while True:
+                opcode, payload = wsstream.read_frame(ws.recv)
+                if opcode == wsstream.PONG:
+                    assert payload == b"are-you-there"
+                    break
+                assert opcode != wsstream.CLOSE, "closed without pong"
+        finally:
+            ws.close()
+    finally:
+        srv.stop()

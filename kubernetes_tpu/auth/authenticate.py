@@ -30,6 +30,19 @@ class Authenticator:
         raise NotImplementedError
 
 
+def _parse_basic(header: str):
+    """-> (user, password) from a Basic Authorization header, or None
+    (shared by every password authenticator so the parse can't drift)."""
+    if not header.startswith("Basic "):
+        return None
+    try:
+        decoded = base64.b64decode(header[6:]).decode()
+    except (binascii.Error, UnicodeDecodeError):
+        return None
+    user, _, password = decoded.partition(":")
+    return user, password
+
+
 class BasicAuthAuthenticator(Authenticator):
     """HTTP basic auth against a user->password map (ref:
     plugin/pkg/auth/authenticator/request/basicauth +
@@ -55,14 +68,10 @@ class BasicAuthAuthenticator(Authenticator):
         return cls(out)
 
     def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
-        header = headers.get("Authorization", "")
-        if not header.startswith("Basic "):
+        parsed = _parse_basic(headers.get("Authorization", ""))
+        if parsed is None:
             return None, False
-        try:
-            decoded = base64.b64decode(header[6:]).decode()
-        except (binascii.Error, UnicodeDecodeError):
-            return None, False
-        user, _, password = decoded.partition(":")
+        user, password = parsed
         entry = self.passwords.get(user)
         expected = entry[0] if entry is not None else ""
         # constant-time compare forecloses the timing side channel
@@ -271,6 +280,52 @@ class X509Authenticator(Authenticator):
         if not cn:
             return None, False
         return UserInfo(name=cn, groups=orgs), True
+
+
+class KeystonePasswordAuthenticator(Authenticator):
+    """Basic-auth credentials validated against an external identity
+    service speaking the Keystone v2 tokens API (POST {auth_url}/tokens
+    with passwordCredentials; any 2xx authenticates).
+
+    Reference: plugin/pkg/auth/authenticator/request/keystone/
+    keystone.go — AuthenticatePassword delegates the check to the
+    keystone endpoint and returns DefaultInfo{Name: username}. Same
+    https-only constraint (keystone.go NewKeystoneAuthenticator), with
+    an explicit escape hatch for tests."""
+
+    def __init__(self, auth_url: str, timeout: float = 10.0,
+                 allow_insecure_for_tests: bool = False):
+        if not auth_url:
+            raise ValueError("auth URL is empty")
+        if not auth_url.startswith("https") and not allow_insecure_for_tests:
+            raise ValueError(
+                "auth URL should be secure and start with https")
+        self.auth_url = auth_url.rstrip("/")
+        self.timeout = timeout
+
+    def _validate(self, username: str, password: str) -> bool:
+        import json as jsonlib
+        import urllib.error
+        import urllib.request
+        body = jsonlib.dumps({"auth": {"passwordCredentials": {
+            "username": username, "password": password}}}).encode()
+        req = urllib.request.Request(
+            self.auth_url + "/tokens", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return 200 <= r.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def authenticate(self, headers) -> Tuple[Optional[UserInfo], bool]:
+        parsed = _parse_basic(headers.get("Authorization", ""))
+        if parsed is None:
+            return None, False
+        user, password = parsed
+        if not user or not self._validate(user, password):
+            return None, False
+        return UserInfo(name=user), True
 
 
 class UnionAuthenticator(Authenticator):

@@ -136,6 +136,9 @@ def run_apiserver(argv: List[str]) -> int:
     p.add_argument("--oidc-client-id", default="")
     p.add_argument("--oidc-username-claim", default="sub")
     p.add_argument("--oidc-groups-claim", default="groups")
+    p.add_argument("--experimental-keystone-url", default="",
+                   help="delegate basic-auth to a keystone v2 endpoint "
+                        "(ref: --experimental-keystone-url)")
     args = p.parse_args(argv)
 
     from .master import Master, MasterConfig
@@ -157,7 +160,8 @@ def run_apiserver(argv: List[str]) -> int:
         oidc_issuer=args.oidc_issuer_url,
         oidc_client_id=args.oidc_client_id,
         oidc_username_claim=args.oidc_username_claim,
-        oidc_groups_claim=args.oidc_groups_claim)).start()
+        oidc_groups_claim=args.oidc_groups_claim,
+        keystone_url=args.experimental_keystone_url)).start()
     return _serve_until_signal(f"apiserver ready {master.url}",
                                [master.stop])
 

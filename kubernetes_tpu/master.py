@@ -66,6 +66,13 @@ class MasterConfig:
     oidc_username_claim: str = "sub"
     oidc_groups_claim: str = "groups"
     oidc_hs256_secret: Optional[bytes] = None
+    # ref: --experimental-keystone-url (keystone.go): basic-auth
+    # delegated to a keystone-v2-shaped endpoint
+    keystone_url: str = ""
+    # ref: master.go tunneler wiring (--ssh-user/--ssh-keyfile enable
+    # the SSH tunneler there): master->node traffic rides maintained
+    # tunnels, with a healthz gate on tunnel-sync age
+    enable_tunneler: bool = False
     # authz: AlwaysAllow | AlwaysDeny | ABAC (ref: --authorization-mode)
     authorization_mode: str = "AlwaysAllow"
     authorization_policy_lines: Optional[List[str]] = None
@@ -114,6 +121,10 @@ class Master:
         if cfg.token_auth_lines:
             authenticators.append(
                 TokenAuthenticator.from_lines(cfg.token_auth_lines))
+        if cfg.keystone_url:
+            from .auth.authenticate import KeystonePasswordAuthenticator
+            authenticators.append(
+                KeystonePasswordAuthenticator(cfg.keystone_url))
         if cfg.oidc_jwks or cfg.oidc_hs256_secret:
             from .auth.authenticate import JWTAuthenticator
             authenticators.append(JWTAuthenticator(
@@ -156,6 +167,39 @@ class Master:
         self.registry.add_component_probe(
             "controller-manager", _healthz_probe(CONTROLLER_MANAGER_PORT))
 
+        self.tunneler = None
+        if cfg.enable_tunneler:
+            from .api.relay import kubelet_base_for
+            from .api.tunneler import WsTunneler
+
+            def node_addresses():
+                import urllib.parse as _up
+                out = []
+                nodes, _rev = self.registry.list("nodes", "")
+                for node in nodes:
+                    try:
+                        base = kubelet_base_for(self.registry,
+                                                node.metadata.name)
+                    except Exception:
+                        continue
+                    split = _up.urlsplit(base)
+                    if split.hostname and split.port:
+                        out.append((node.metadata.name, split.hostname,
+                                    split.port))
+                return out
+
+            self.tunneler = WsTunneler()
+            self.tunneler.run(node_addresses)
+            # the tunnel-sync healthz gate (ref: master.go
+            # IsTunnelSyncHealthy wired into apiserver healthz)
+            self.registry.add_component_probe(
+                "tunneler",
+                lambda: ((True, "ok") if self.tunneler.healthy()
+                         else (False,
+                               f"tunnels last synced "
+                               f"{self.tunneler.seconds_since_sync()}s "
+                               f"ago (limit 600)")))
+
     @property
     def url(self) -> str:
         return self.server.url
@@ -170,5 +214,7 @@ class Master:
 
     def stop(self) -> None:
         self.server.stop()
+        if self.tunneler is not None:
+            self.tunneler.stop()
         if self.store is not None and hasattr(self.store, "close"):
             self.store.close()

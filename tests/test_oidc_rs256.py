@@ -159,3 +159,87 @@ class TestMasterOIDC:
             assert ei.value.code == 401
         finally:
             m.stop()
+
+
+class TestKeystoneAuthenticator:
+    """ref: plugin/pkg/auth/authenticator/request/keystone/keystone.go
+    — basic-auth delegated to a keystone-v2-shaped endpoint."""
+
+    def _mock_keystone(self):
+        import json as jsonlib
+        import threading
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = jsonlib.loads(self.rfile.read(n))
+                creds = body.get("auth", {}).get(
+                    "passwordCredentials", {})
+                ok = (creds.get("username") == "alice"
+                      and creds.get("password") == "horse-battery")
+                payload = jsonlib.dumps(
+                    {"access": {"token": {"id": "tok"}}}
+                    if ok else {"error": {"code": 401}}).encode()
+                self.send_response(200 if ok else 401)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    def test_keystone_accept_and_reject(self):
+        import base64 as b64
+
+        from kubernetes_tpu.auth.authenticate import (
+            KeystonePasswordAuthenticator)
+
+        ks = self._mock_keystone()
+        try:
+            auth = KeystonePasswordAuthenticator(
+                f"http://127.0.0.1:{ks.server_address[1]}/v2.0",
+                allow_insecure_for_tests=True)
+
+            def hdr(user, pw):
+                raw = b64.b64encode(f"{user}:{pw}".encode()).decode()
+                return {"Authorization": f"Basic {raw}"}
+
+            user, ok = auth.authenticate(hdr("alice", "horse-battery"))
+            assert ok and user.name == "alice"
+            _, ok = auth.authenticate(hdr("alice", "wrong"))
+            assert not ok
+            _, ok = auth.authenticate({"Authorization": "Bearer x"})
+            assert not ok
+        finally:
+            ks.shutdown()
+            ks.server_close()
+
+    def test_keystone_requires_https(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.auth.authenticate import (
+            KeystonePasswordAuthenticator)
+
+        with _pytest.raises(ValueError, match="https"):
+            KeystonePasswordAuthenticator("http://keystone.example")
+        KeystonePasswordAuthenticator("https://keystone.example")
+
+    def test_keystone_unreachable_rejects(self):
+        import base64 as b64
+
+        from kubernetes_tpu.auth.authenticate import (
+            KeystonePasswordAuthenticator)
+
+        auth = KeystonePasswordAuthenticator(
+            "http://127.0.0.1:9", timeout=0.5,
+            allow_insecure_for_tests=True)
+        raw = b64.b64encode(b"u:p").decode()
+        _, ok = auth.authenticate({"Authorization": f"Basic {raw}"})
+        assert not ok

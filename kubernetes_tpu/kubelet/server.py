@@ -143,6 +143,8 @@ class KubeletServer:
                 return self._exec(h, path, query)
             if path.startswith("/portForward/"):
                 return self._port_forward(h, path, query)
+            if path == "/tunnel":
+                return self._tunnel(h, query)
             if path.startswith("/attach/"):
                 return self._attach(h, path, query)
             self._raw(h, 404, f"not found: {path}".encode(), "text/plain")
@@ -366,6 +368,47 @@ class KubeletServer:
         finally:
             stop.set()
             pump.join(timeout=5)
+            h.close_connection = True
+
+    def _tunnel(self, h, query: dict) -> None:
+        """GET /tunnel?port=N[&host=...], websocket: the node leg of the
+        master->node tunneler (ref: pkg/master/tunneler.go — there the
+        master SSHs into the node and dials through sshd; here the
+        master opens a websocket and this endpoint dials on its
+        behalf). Targets are restricted to the node itself (loopback),
+        the SSH tunnel's healthz-and-kubelet use in the reference."""
+        import socket as _socket
+
+        from ..utils import wsstream
+
+        try:
+            port = int(query.get("port", ["0"])[0])
+        except ValueError:
+            port = 0
+        if not 0 < port < 65536:
+            return self._raw(h, 400, b"?port= required", "text/plain")
+        host = query.get("host", ["127.0.0.1"])[0]
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            return self._raw(h, 403,
+                             b"tunnel targets are node-local only",
+                             "text/plain")
+        try:
+            sock = _socket.create_connection((host, port), timeout=10)
+        except OSError as e:
+            return self._raw(h, 502, f"dial {host}:{port}: {e}".encode(),
+                             "text/plain")
+        sock.settimeout(None)
+        try:
+            if not wsstream.server_handshake(h):
+                return
+
+            def write(b: bytes) -> None:
+                h.wfile.write(b)
+                h.wfile.flush()
+
+            wsstream.bridge(h.rfile.read, write, sock, pod_side=True)
+        finally:
+            sock.close()
             h.close_connection = True
 
     def _exec(self, h, path: str, query: dict) -> None:

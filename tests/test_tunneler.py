@@ -1,0 +1,154 @@
+"""Master->node tunneler (ref: pkg/master/tunneler.go + the kubelet
+/tunnel leg): dial-through round trip, node-set sync, the 600s healthz
+gate, and the node-local-targets-only restriction."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.tunneler import (TUNNEL_SYNC_HEALTHZ_MAX_S,
+                                         WsTunneler)
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.kubelet.container import FakeRuntime
+from kubernetes_tpu.kubelet.server import KubeletServer
+from kubernetes_tpu.utils import wsstream
+
+
+@pytest.fixture()
+def kubelet():
+    srv = KubeletServer("tun-node", lambda: [], FakeRuntime(),
+                        lambda: {"cpu": parse_quantity("4")}).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def echo_server():
+    """A node-local TCP service the tunnel dials (sshd's direct-tcpip
+    target role)."""
+    listener = socket.create_server(("127.0.0.1", 0))
+    port = listener.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            with conn:
+                while True:
+                    data = conn.recv(4096)
+                    if not data:
+                        break
+                    conn.sendall(b"echo:" + data)
+
+    threading.Thread(target=serve, daemon=True).start()
+    yield port
+    stop.set()
+    listener.close()
+
+
+def _tunneler_for(kubelet, sync_interval=0.05, healthy_sleep=0.0):
+    t = WsTunneler(sync_interval=sync_interval,
+                   healthy_sleep=healthy_sleep, dial_timeout=2.0)
+    t.run(lambda: [("tun-node", "127.0.0.1", kubelet.port)])
+    deadline = time.time() + 10
+    while time.time() < deadline and t.tunnel_count() == 0:
+        time.sleep(0.02)
+    return t
+
+
+def test_dial_through_tunnel_roundtrip(kubelet, echo_server):
+    t = _tunneler_for(kubelet)
+    try:
+        assert t.tunnel_count() == 1
+        conn = t.dial("127.0.0.1", echo_server)
+        try:
+            conn.sendall(b"over the tunnel")
+            got = b""
+            while b"over the tunnel" not in got:
+                piece = conn.recv(4096)
+                if not piece:
+                    break
+                got += piece
+            assert got == b"echo:over the tunnel"
+        finally:
+            conn.close()
+    finally:
+        t.stop()
+
+
+def test_sync_health_gate(kubelet):
+    clock_now = [1000.0]
+
+    class FakeClock:
+        @staticmethod
+        def time():
+            return clock_now[0]
+
+    t = WsTunneler(sync_interval=0.05, healthy_sleep=0.0,
+                   dial_timeout=2.0, clock=FakeClock)
+    t.run(lambda: [("tun-node", "127.0.0.1", kubelet.port)])
+    deadline = time.time() + 10
+    while time.time() < deadline and t.tunnel_count() == 0:
+        time.sleep(0.02)
+    try:
+        assert t.healthy()
+        t.stop()  # loops halt; the sync timestamp goes stale
+        time.sleep(0.2)
+        clock_now[0] += TUNNEL_SYNC_HEALTHZ_MAX_S + 1
+        assert not t.healthy()
+        assert t.seconds_since_sync() > TUNNEL_SYNC_HEALTHZ_MAX_S
+    finally:
+        t.stop()
+
+
+def test_unreachable_node_never_becomes_tunnel():
+    t = WsTunneler(sync_interval=0.05, healthy_sleep=0.0,
+                   dial_timeout=0.3)
+    t.run(lambda: [("ghost", "127.0.0.1", 9)])  # discard port: refused
+    try:
+        time.sleep(0.5)
+        assert t.tunnel_count() == 0
+        with pytest.raises(ConnectionError):
+            t.dial("127.0.0.1", 80)
+    finally:
+        t.stop()
+
+
+def test_tunnel_endpoint_refuses_non_local_targets(kubelet):
+    with pytest.raises(ConnectionError):
+        # client_connect surfaces the 403 as a refused upgrade
+        wsstream.client_connect(
+            "127.0.0.1", kubelet.port,
+            "/tunnel?host=10.11.12.13&port=80", timeout=5)
+
+
+def test_master_tunneler_healthz_gate(kubelet):
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.master import Master, MasterConfig
+
+    m = Master(MasterConfig(port=0, enable_tunneler=True)).start()
+    try:
+        m.registry.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name="tun-node"),
+            status=api.NodeStatus(
+                addresses=[api.NodeAddress(type="InternalIP",
+                                           address="127.0.0.1")],
+                daemon_endpoints=api.NodeDaemonEndpoints(
+                    kubelet_endpoint=api.DaemonEndpoint(
+                        port=kubelet.port)))))
+        deadline = time.time() + 10
+        while time.time() < deadline and m.tunneler.tunnel_count() == 0:
+            time.sleep(0.05)
+        assert m.tunneler.tunnel_count() == 1
+        statuses, _ = m.registry.list("componentstatuses")
+        by_name = {s.metadata.name: s for s in statuses}
+        assert "tunneler" in by_name
+        cond = by_name["tunneler"].conditions[0]
+        assert cond.status == "True", cond
+    finally:
+        m.stop()

@@ -44,3 +44,110 @@ def stub_container_manager() -> ContainerManager:
     """(ref: NewStubContainerManager — no reservations; allocatable ==
     capacity, the hollow-node configuration)"""
     return ContainerManager()
+
+
+class ResourceEnforcer:
+    """Cgroup-role enforcement for the native (subprocess) runtime.
+
+    The reference's cm sets up cgroups and lets the kernel enforce
+    container memory limits (the cgroup OOM killer); process-group
+    containers have no cgroup, so this poller plays that role: for
+    every container that DECLARES a memory limit it reads the live
+    /proc-backed stats through the runtime, records that usage (the
+    usage()/node_usage() views cover enforced containers; the summary
+    API reads runtime.container_stats directly for everything), and
+    kills any container whose working set exceeds its limit — the
+    same "OOMKilled"-shaped outcome (exit by kill, restart policy
+    decides what happens next). Unlimited containers are skipped
+    entirely: no limit, no per-second /proc scan.
+
+    ref: pkg/kubelet/cm/container_manager_linux.go (cgroup setup) +
+    dockertools' memory limit plumbing into the container config.
+    """
+
+    def __init__(self, runtime, pods_provider,
+                 interval: float = 1.0, on_oom=None):
+        """pods_provider: () -> List[api.Pod] (the kubelet's bound-pod
+        view); on_oom: callback(pod_uid, container_name, usage_bytes,
+        limit_bytes) fired after an enforcement kill."""
+        import threading
+        self.runtime = runtime
+        self.pods_provider = pods_provider
+        self.interval = interval
+        self.on_oom = on_oom
+        self._usage: Dict[str, Dict[str, dict]] = {}  # uid -> name -> stats
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.oom_kills = 0
+
+    def start(self) -> "ResourceEnforcer":
+        import threading
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="resource-enforcer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def usage(self, pod_uid: str) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v)
+                    for k, v in self._usage.get(pod_uid, {}).items()}
+
+    def node_usage(self) -> dict:
+        """Aggregate live usage (the node-level summary line)."""
+        cpu = mem = 0
+        with self._lock:
+            for containers in self._usage.values():
+                for stats in containers.values():
+                    cpu += stats.get("cpu_usage_nano_cores", 0)
+                    mem += stats.get("memory_working_set_bytes", 0)
+        return {"cpu_usage_nano_cores": cpu,
+                "memory_working_set_bytes": mem}
+
+    def sweep_once(self) -> None:
+        """One poll+enforce pass (the loop's body; callable from tests
+        without timing dependence)."""
+        if not hasattr(self.runtime, "container_stats"):
+            return
+        pods = self.pods_provider() or []
+        fresh: Dict[str, Dict[str, dict]] = {}
+        for pod in pods:
+            uid = pod.metadata.uid
+            for container in pod.spec.containers:
+                limit = container.resources.limits.get("memory")
+                if limit is None:
+                    continue  # no limit, no scan
+                stats = self.runtime.container_stats(uid, container.name)
+                if not stats:
+                    continue
+                fresh.setdefault(uid, {})[container.name] = stats
+                limit_bytes = limit.value
+                used = stats.get("memory_working_set_bytes", 0)
+                if limit_bytes > 0 and used > limit_bytes:
+                    # the cgroup OOM-killer moment
+                    try:
+                        self.runtime.kill_container(uid, container.name)
+                    except Exception:
+                        continue
+                    self.oom_kills += 1
+                    if self.on_oom is not None:
+                        try:
+                            self.on_oom(uid, container.name, used,
+                                        limit_bytes)
+                        except Exception:
+                            pass
+        with self._lock:
+            self._usage = fresh
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sweep_once()
+            except Exception:
+                pass  # crash-only: next tick retries
+            self._stop.wait(self.interval)

@@ -17,6 +17,7 @@ RestartPolicy semantics (syncPod + computePodStatus):
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -40,6 +41,8 @@ def _rfc3339(epoch: float) -> str:
     return datetime.fromtimestamp(epoch, timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%SZ")
 
+
+logger = logging.getLogger(__name__)
 
 class _PodWorker:
     """One serial worker per pod (pod_workers.go:105 managePodLoop):
@@ -380,6 +383,20 @@ class Kubelet:
     def run(self) -> "Kubelet":
         self.status_manager.start()
         self.pleg.start()
+        # cgroup-role memory-limit enforcement for runtimes with live
+        # /proc stats (subprocess runtime); fakes lack container_stats
+        # and skip it (ref: pkg/kubelet/cm's cgroup limits)
+        self._enforcer = None
+        if hasattr(self.runtime, "container_stats"):
+            from .cm import ResourceEnforcer
+
+            def bound_pods():
+                with self._lock:
+                    return list(self._pods.values())
+
+            self._enforcer = ResourceEnforcer(
+                self.runtime, bound_pods,
+                on_oom=self._on_oom_kill).start()
         self._informer = Informer(
             self.client, "pods",
             field_selector=f"spec.nodeName={self.node_name}",
@@ -407,8 +424,19 @@ class Kubelet:
         self._threads = [t]
         return self
 
+    def _on_oom_kill(self, pod_uid: str, container: str, used: int,
+                     limit: int) -> None:
+        """An enforcement kill surfaces like cgroup OOM: the PLEG sees
+        the exit and the restart policy decides; the status trail says
+        why."""
+        logger.warning(
+            "memory limit exceeded: pod %s container %s used %d > %d",
+            pod_uid, container, used, limit)
+
     def stop(self) -> None:
         self._stop.set()
+        if getattr(self, "_enforcer", None) is not None:
+            self._enforcer.stop()
         if self._informer:
             self._informer.stop()
         for source in self._sources:

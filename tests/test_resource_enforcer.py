@@ -1,0 +1,105 @@
+"""Cgroup-role resource enforcement over the subprocess runtime
+(ref: pkg/kubelet/cm cgroup setup + the kernel OOM killer's role):
+live /proc accounting per container, and a memory-limit breach kills
+the container like cgroup OOM does."""
+
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.kubelet.cm import ResourceEnforcer
+from kubernetes_tpu.kubelet.subprocess_runtime import SubprocessRuntime
+
+
+def _pod(name, uid, command, mem_limit=""):
+    resources = api.ResourceRequirements()
+    if mem_limit:
+        resources = api.ResourceRequirements(
+            limits={"memory": parse_quantity(mem_limit)})
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=api.PodSpec(node_name="n1", containers=[
+            api.Container(name="main", image="img", command=command,
+                          resources=resources)]))
+
+
+@pytest.fixture()
+def runtime(tmp_path):
+    rt = SubprocessRuntime(root_dir=str(tmp_path))
+    yield rt
+    for rp in rt.get_pods():
+        rt.kill_pod(rp.uid)
+
+
+def test_usage_accounting_and_oom_kill(runtime):
+    hog = _pod("hog", "uid-hog", [
+        sys.executable, "-c",
+        "x = bytearray(64 * 1024 * 1024); import time; time.sleep(30)"],
+        mem_limit="16Mi")
+    modest = _pod("modest", "uid-ok", ["sleep", "30"], mem_limit="256Mi")
+    runtime.start_container(hog, hog.spec.containers[0])
+    runtime.start_container(modest, modest.spec.containers[0])
+    # let the hog actually allocate before the sweep
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        stats = runtime.container_stats("uid-hog", "main")
+        if stats.get("memory_working_set_bytes", 0) > 16 * 1024 * 1024:
+            break
+        time.sleep(0.1)
+
+    ooms = []
+    enforcer = ResourceEnforcer(
+        runtime, lambda: [hog, modest],
+        on_oom=lambda uid, name, used, limit: ooms.append(
+            (uid, name, used, limit)))
+    enforcer.sweep_once()
+
+    assert enforcer.oom_kills == 1
+    assert ooms and ooms[0][0] == "uid-hog" and ooms[0][1] == "main"
+    assert ooms[0][2] > ooms[0][3]  # used > limit
+    # the kill lands (SIGTERM -> process group); poll for exit
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            runtime.container_running("uid-hog", "main"):
+        time.sleep(0.1)
+    assert not runtime.container_running("uid-hog", "main")
+    assert runtime.container_running("uid-ok", "main")
+    # accounting captured both containers' live stats pre-kill
+    assert enforcer.usage("uid-ok").get("main", {}).get(
+        "memory_working_set_bytes", 0) > 0
+    node = enforcer.node_usage()
+    assert node["memory_working_set_bytes"] > 0
+
+
+def test_no_limit_means_no_enforcement(runtime):
+    pod = _pod("free", "uid-free", [
+        sys.executable, "-c",
+        "x = bytearray(32 * 1024 * 1024); import time; time.sleep(30)"])
+    runtime.start_container(pod, pod.spec.containers[0])
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if runtime.container_stats("uid-free", "main").get(
+                "memory_working_set_bytes", 0) > 32 * 1024 * 1024:
+            break
+        time.sleep(0.1)
+    enforcer = ResourceEnforcer(runtime, lambda: [pod])
+    enforcer.sweep_once()
+    assert enforcer.oom_kills == 0
+    assert runtime.container_running("uid-free", "main")
+
+
+def test_enforcer_loop_lifecycle(runtime):
+    pod = _pod("loop", "uid-loop", ["sleep", "30"], mem_limit="256Mi")
+    runtime.start_container(pod, pod.spec.containers[0])
+    enforcer = ResourceEnforcer(runtime, lambda: [pod],
+                                interval=0.05).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not enforcer.usage("uid-loop"):
+            time.sleep(0.05)
+        assert enforcer.usage("uid-loop")
+    finally:
+        enforcer.stop()

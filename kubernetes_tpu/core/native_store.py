@@ -113,6 +113,13 @@ def _load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.kv_create_batch.restype = ctypes.c_int64
+        lib.kv_create_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_double)]
         lib.kv_events.restype = ctypes.c_int64
         lib.kv_events.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                   ctypes.c_char_p, ctypes.c_char_p,
@@ -212,15 +219,40 @@ class NativeStore:
 
     def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]]
                      ) -> List[Any]:
-        """Batched create. The native store's watch fan-out is already
-        decoupled from writes (the pump thread drains kv_events_since),
-        so per-key kv_create calls don't pay a per-watcher cost the way
-        the in-memory store's synchronous fan-out does; a C-side batch
-        entry point would only save ctypes crossings. Not all-or-nothing:
-        a mid-batch AlreadyExists leaves earlier creates committed (the
-        in-memory Store.create_batch is atomic; callers that need
-        atomicity use it via --storage-backend memory)."""
-        return [self.create(k, o, ttl) for k, o, ttl in entries]
+        """Batched create in ONE engine pass (kv_create_batch):
+        all-or-nothing exactly like the in-memory Store.create_batch —
+        any pre-existing or intra-batch duplicate key fails the whole
+        batch before anything commits — with one lock window and
+        consecutive revisions C-side."""
+        if not entries:
+            return []
+        encoded = [(k, self._encode(o), ttl) for k, o, ttl in entries]
+        n = len(encoded)
+        keys = (ctypes.c_char_p * n)(*[k.encode() for k, _v, _t in encoded])
+        vals = (ctypes.c_char_p * n)(*[v for _k, v, _t in encoded])
+        val_lens = (ctypes.c_uint64 * n)(
+            *[len(v) for _k, v, _t in encoded])
+        ttls = (ctypes.c_double * n)(
+            *[float(t or 0) for _k, _v, t in encoded])
+        first = self._lib.kv_create_batch(self._h, n, keys, vals,
+                                          val_lens, ttls)
+        if first == ERR_EXISTS:
+            # re-raise with the precise key for the caller's message
+            for k, _v, _t in encoded:
+                try:
+                    self._get_raw(k)
+                except NotFound:
+                    continue
+                kind, name = self._key_name(k)
+                raise AlreadyExists(kind=kind, name=name)
+            kind, name = self._key_name(encoded[0][0])
+            raise AlreadyExists(kind=kind, name=name)
+        out = []
+        for i, (key, obj, _ttl) in enumerate(entries):
+            stamped = self._stamp(obj, first + i)
+            self._cache_put(key, first + i, stamped)
+            out.append(stamped)
+        return out
 
     def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         raw = self._encode(obj)

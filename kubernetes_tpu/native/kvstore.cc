@@ -26,6 +26,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -292,6 +293,35 @@ int64_t kv_batch(void* h, uint64_t n, const char** keys,
                             val_lens[i]);
     it->second.mod_rev = rev;
     s->emit(rev, EventType::Modified, it->first, rev, it->second.value);
+  }
+  return first_rev;
+}
+
+// Batched create: every key must be absent (including duplicates
+// WITHIN the batch) or nothing commits — the write-side analogue of
+// kv_batch. Returns the first assigned revision, or ERR_EXISTS.
+int64_t kv_create_batch(void* h, uint64_t n, const char** keys,
+                        const uint8_t** vals, const uint64_t* val_lens,
+                        const double* ttls) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  double now = now_seconds();
+  s->gc(now);
+  std::set<std::string> seen;
+  for (uint64_t i = 0; i < n; ++i) {
+    std::string k(keys[i]);
+    if (s->data.count(k) || !seen.insert(k).second) return ERR_EXISTS;
+  }
+  int64_t first_rev = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    std::string k(keys[i]);
+    uint64_t rev = s->bump();
+    if (first_rev == 0) first_rev = static_cast<int64_t>(rev);
+    Entry e{std::string(reinterpret_cast<const char*>(vals[i]),
+                        val_lens[i]),
+            rev, ttls[i] > 0 ? now + ttls[i] : 0};
+    s->data[k] = e;
+    s->emit(rev, EventType::Added, k, rev, e.value);
   }
   return first_rev;
 }

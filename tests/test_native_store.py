@@ -202,3 +202,44 @@ def test_registry_over_native_store():
         target=api.ObjectReference(kind="Node", name="n1"))
     client.bind(binding)
     assert client.get("pods", "web", "default").spec.node_name == "n1"
+
+
+def test_native_create_batch_atomic():
+    """kv_create_batch: one engine pass, consecutive revisions,
+    all-or-nothing on pre-existing AND intra-batch duplicate keys —
+    parity with the in-memory Store.create_batch."""
+    store = NativeStore()
+    pods = [mkpod(f"cb-{i}") for i in range(4)]
+    out = store.create_batch([
+        (key(f"cb-{i}"), p, None) for i, p in enumerate(pods)])
+    revs = [int(o.metadata.resource_version) for o in out]
+    assert revs == list(range(revs[0], revs[0] + 4))
+    for i in range(4):
+        assert store.get(key(f"cb-{i}")).metadata.name == f"cb-{i}"
+
+    rev0 = store.current_revision
+    with pytest.raises(AlreadyExists):
+        store.create_batch([
+            (key("fresh"), mkpod("fresh"), None),
+            (key("cb-0"), mkpod("cb-0"), None)])
+    assert store.current_revision == rev0
+    with pytest.raises(NotFound):
+        store.get(key("fresh"))
+
+    with pytest.raises(AlreadyExists):
+        store.create_batch([
+            (key("dup"), mkpod("dup"), None),
+            (key("dup"), mkpod("dup"), None)])
+
+    # events stream to a watcher like per-key creates
+    w = store.watch("/registry/pods/", since_rev=0)
+    seen = set()
+    for _ in range(40):
+        ev = w.next(timeout=2)
+        if ev is None:
+            break
+        seen.add(ev.object.metadata.name)
+        if len(seen) >= 4:
+            break
+    assert {f"cb-{i}" for i in range(4)} <= seen
+    w.stop()

@@ -1,0 +1,245 @@
+"""The OpenStack provider against a mock cloud serving the real wire
+shapes (ref: pkg/cloudprovider/providers/openstack/openstack.go): a
+keystone v2 tokens endpoint with a service catalog, nova servers +
+volume attachments, neutron LBaaS v1 pools/members/vips. The provider
+client code — auth, catalog resolution, re-auth on 401, the LB
+ensure/update/delete flows — is what's under test."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+import pytest
+
+from kubernetes_tpu.cloudprovider.openstack import (OpenStackError,
+                                                    OpenStackProvider)
+
+
+class MockCloud:
+    """keystone + nova + neutron on one port, in memory."""
+
+    def __init__(self):
+        self.token = "tok-1"
+        self.servers = [
+            {"id": "srv-1", "name": "node-a", "accessIPv4": "10.0.0.4",
+             "addresses": {"private": [{"addr": "192.168.0.4"}]}},
+            {"id": "srv-2", "name": "node-b", "accessIPv4": "",
+             "addresses": {"private": [{"addr": "192.168.0.5"}]}},
+        ]
+        self.pools = {}
+        self.members = {}
+        self.vips = {}
+        self.attachments = []  # (server_id, volume_id)
+        self.auth_count = 0
+        self.expire_next = False  # force one 401 to test re-auth
+        self._n = 0
+        self._lock = threading.Lock()
+        cloud = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, payload=None):
+                raw = json.dumps(payload).encode() \
+                    if payload is not None else b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _authed(self):
+                if cloud.expire_next:
+                    cloud.expire_next = False
+                    return False
+                return self.headers.get("X-Auth-Token") == cloud.token
+
+            def do_POST(self):
+                path = urlsplit(self.path).path
+                if path == "/v2.0/tokens":
+                    body = self._body()
+                    creds = body.get("auth", {}).get(
+                        "passwordCredentials", {})
+                    if creds.get("password") != "pw":
+                        return self._send(401, {"error": {"code": 401}})
+                    cloud.auth_count += 1
+                    base = f"http://127.0.0.1:{cloud.port}"
+                    return self._send(200, {"access": {
+                        "token": {"id": cloud.token},
+                        "serviceCatalog": [
+                            {"type": "compute", "endpoints": [
+                                {"publicURL": f"{base}/compute"}]},
+                            {"type": "network", "endpoints": [
+                                {"publicURL": f"{base}/network"}]},
+                        ]}})
+                if not self._authed():
+                    return self._send(401, {"error": {"code": 401}})
+                with cloud._lock:
+                    cloud._n += 1
+                    new_id = f"id-{cloud._n}"
+                if path == "/network/lb/pools":
+                    pool = {**self._body()["pool"], "id": new_id}
+                    cloud.pools[new_id] = pool
+                    return self._send(201, {"pool": pool})
+                if path == "/network/lb/members":
+                    member = {**self._body()["member"], "id": new_id}
+                    cloud.members[new_id] = member
+                    return self._send(201, {"member": member})
+                if path == "/network/lb/vips":
+                    vip = {**self._body()["vip"], "id": new_id,
+                           "address": "172.24.4.10"}
+                    cloud.vips[new_id] = vip
+                    return self._send(201, {"vip": vip})
+                if "/os-volume_attachments" in path:
+                    server_id = path.split("/")[3]
+                    vol = self._body()["volumeAttachment"]["volumeId"]
+                    cloud.attachments.append((server_id, vol))
+                    return self._send(200, {"volumeAttachment": {
+                        "id": vol, "serverId": server_id}})
+                return self._send(404)
+
+            def do_GET(self):
+                if not self._authed():
+                    return self._send(401, {"error": {"code": 401}})
+                split = urlsplit(self.path)
+                path, q = split.path, parse_qs(split.query)
+                if path == "/compute/servers/detail":
+                    name = q.get("name", [""])[0]
+                    servers = [s for s in cloud.servers
+                               if not name or name in s["name"]]
+                    return self._send(200, {"servers": servers})
+                if path == "/network/lb/vips":
+                    name = q.get("name", [""])[0]
+                    vips = [v for v in cloud.vips.values()
+                            if not name or v["name"] == name]
+                    return self._send(200, {"vips": vips})
+                if path == "/network/lb/pools":
+                    name = q.get("name", [""])[0]
+                    pools = [p for p in cloud.pools.values()
+                             if not name or p["name"] == name]
+                    return self._send(200, {"pools": pools})
+                if path == "/network/lb/members":
+                    pool_id = q.get("pool_id", [""])[0]
+                    members = [m for m in cloud.members.values()
+                               if not pool_id
+                               or m["pool_id"] == pool_id]
+                    return self._send(200, {"members": members})
+                return self._send(404)
+
+            def do_DELETE(self):
+                if not self._authed():
+                    return self._send(401, {"error": {"code": 401}})
+                path = urlsplit(self.path).path
+                rid = path.rsplit("/", 1)[-1]
+                if "/lb/vips/" in path and cloud.vips.pop(rid, None):
+                    return self._send(204)
+                if "/lb/members/" in path and \
+                        cloud.members.pop(rid, None):
+                    return self._send(204)
+                if "/lb/pools/" in path and cloud.pools.pop(rid, None):
+                    return self._send(204)
+                if "/os-volume_attachments/" in path:
+                    server_id = path.split("/")[3]
+                    cloud.attachments = [
+                        (s, v) for s, v in cloud.attachments
+                        if not (s == server_id and v == rid)]
+                    return self._send(204)
+                return self._send(404)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def auth_url(self):
+        return f"http://127.0.0.1:{self.port}/v2.0"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def cloud():
+    c = MockCloud()
+    yield c
+    c.stop()
+
+
+def _provider(cloud):
+    return OpenStackProvider(cloud.auth_url, "admin", "pw", "demo",
+                             region="RegionOne",
+                             availability_zone="nova-az1",
+                             subnet_id="subnet-1")
+
+
+def test_auth_catalog_and_instances(cloud):
+    p = _provider(cloud)
+    assert cloud.auth_count == 1
+    inst = p.instances()
+    assert inst.list_instances() == ["node-a", "node-b"]
+    assert inst.list_instances("node-a") == ["node-a"]
+    assert inst.node_addresses("node-a") == ["10.0.0.4", "192.168.0.4"]
+    assert inst.node_addresses("node-b") == ["192.168.0.5"]
+    assert inst.external_id("node-a") == "srv-1"
+    with pytest.raises(KeyError):
+        inst.node_addresses("ghost")
+
+
+def test_bad_password_fails_auth(cloud):
+    with pytest.raises(OpenStackError):
+        OpenStackProvider(cloud.auth_url, "admin", "wrong", "demo")
+
+
+def test_reauth_on_expired_token(cloud):
+    p = _provider(cloud)
+    cloud.expire_next = True  # one 401, then the retry must re-auth
+    assert p.instances().list_instances() == ["node-a", "node-b"]
+    assert cloud.auth_count == 2
+
+
+def test_lbaas_v1_lifecycle(cloud):
+    p = _provider(cloud)
+    lbs = p.load_balancers()
+    lb = lbs.ensure("svc-lb", "RegionOne", [80],
+                    ["192.168.0.4", "192.168.0.5"])
+    assert lb.external_ip == "172.24.4.10"
+    assert len(cloud.pools) == 1 and len(cloud.vips) == 1
+    assert len(cloud.members) == 2
+
+    got = lbs.get("svc-lb", "RegionOne")
+    assert got is not None and got.external_ip == "172.24.4.10"
+
+    # host set diff: one leaves, one joins (ref UpdateTCPLoadBalancer)
+    lbs.update_hosts("svc-lb", "RegionOne",
+                     ["192.168.0.5", "192.168.0.6"])
+    addrs = sorted(m["address"] for m in cloud.members.values())
+    assert addrs == ["192.168.0.5", "192.168.0.6"]
+
+    # multi-port rejected like openstack.go:659
+    with pytest.raises(OpenStackError):
+        lbs.ensure("multi", "RegionOne", [80, 443], [])
+
+    lbs.delete("svc-lb", "RegionOne")
+    assert not cloud.pools and not cloud.vips and not cloud.members
+    assert lbs.get("svc-lb", "RegionOne") is None
+
+
+def test_zone_and_volume_attachments(cloud):
+    p = _provider(cloud)
+    zone = p.get_zone()
+    assert zone.failure_domain == "nova-az1"
+    assert zone.region == "RegionOne"
+    assert p.routes() is None
+    p.attach_disk("vol-7", "node-a")
+    assert cloud.attachments == [("srv-1", "vol-7")]
+    p.detach_disk("vol-7", "node-a")
+    assert cloud.attachments == []

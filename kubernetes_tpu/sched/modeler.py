@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..core import labels as labelspkg
@@ -61,8 +62,11 @@ class SimpleModeler:
         # forget that races AHEAD of the committer's assume must win, or
         # a pod deleted right after confirmation would sit assumed (and
         # consume phantom capacity) until the TTL. uid-scoped so a
-        # recreated same-name pod assumes normally.
+        # recreated same-name pod assumes normally. Expiry rides an
+        # insertion-ordered deque so GC is O(expired) per forget — a
+        # full-dict rebuild was O(n^2) across a 30k-pod confirm storm.
         self._forgotten: Dict[Tuple[str, str], float] = {}
+        self._forgotten_order: deque = deque()
 
     def locked_action(self, fn):
         """(ref: modeler.go:47 actionLocker.LockedAction)"""
@@ -71,9 +75,11 @@ class SimpleModeler:
 
     def _gc_tombstones(self, now: float) -> None:
         ttl = self._assumed.ttl
-        if len(self._forgotten) > 4096:
-            self._forgotten = {k: ts for k, ts in self._forgotten.items()
-                               if now - ts <= ttl}
+        order = self._forgotten_order
+        while order and now - order[0][0] > ttl:
+            ts, key = order.popleft()
+            if self._forgotten.get(key) == ts:
+                del self._forgotten[key]
 
     def _tombstoned(self, pod: api.Pod, now: float) -> bool:
         ts = self._forgotten.get(
@@ -98,8 +104,9 @@ class SimpleModeler:
     def forget_pod(self, pod: api.Pod) -> None:
         with self._lock:
             now = self._clock.time()
-            self._forgotten[(meta_namespace_key(pod),
-                             pod.metadata.uid)] = now
+            key = (meta_namespace_key(pod), pod.metadata.uid)
+            self._forgotten[key] = now
+            self._forgotten_order.append((now, key))
             self._gc_tombstones(now)
             self._assumed.delete_key(meta_namespace_key(pod))
 

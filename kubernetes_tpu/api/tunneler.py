@@ -69,6 +69,114 @@ class TunnelConn:
         self._ws.settimeout(t)
 
 
+def http_get_over(conn: TunnelConn, host: str, path: str,
+                  timeout: float = 30.0):
+    """One HTTP GET over an open tunnel leg -> (status, content_type,
+    body). HTTP/1.0 with Connection: close keeps the framing trivial
+    (read to EOF) — the tunneled requests are the master's one-shot
+    node GETs (healthz, /pods, /stats), exactly the SSH tunnel's
+    traffic in the reference (master.go wires tunneler.Dial into the
+    node-proxy transport)."""
+    conn.settimeout(timeout)
+    conn.sendall(f"GET {path} HTTP/1.0\r\nHost: {host}\r\n"
+                 f"Connection: close\r\n\r\n".encode())
+    buf = b""
+    while True:
+        piece = conn.recv(65536)
+        if not piece:
+            break
+        buf += piece
+    head, _, body = buf.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    try:
+        status = int(lines[0].split()[1])
+    except (IndexError, ValueError):
+        raise ConnectionError(
+            f"malformed tunneled response: {lines[0][:100]!r}")
+    ctype = "text/plain"
+    for line in lines[1:]:
+        if line.lower().startswith(b"content-type:"):
+            ctype = line.split(b":", 1)[1].strip().decode()
+    return status, ctype, body
+
+
+def http_stream_over(conn: TunnelConn, host: str, path: str,
+                     timeout: float = 30.0):
+    """Streaming HTTP GET over a tunnel leg -> (status, content_type,
+    chunk iterator). The iterator yields body pieces as they arrive
+    until EOF (the follow-logs relay); the caller closes conn."""
+    conn.settimeout(timeout)
+    conn.sendall(f"GET {path} HTTP/1.0\r\nHost: {host}\r\n"
+                 f"Connection: close\r\n\r\n".encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        piece = conn.recv(65536)
+        if not piece:
+            break
+        buf += piece
+    head, _, leftover = buf.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    try:
+        status = int(lines[0].split()[1])
+    except (IndexError, ValueError):
+        raise ConnectionError(
+            f"malformed tunneled response: {lines[0][:100]!r}")
+    ctype = "text/plain"
+    chunked = False
+    for line in lines[1:]:
+        if line.lower().startswith(b"content-type:"):
+            ctype = line.split(b":", 1)[1].strip().decode()
+        elif line.lower().startswith(b"transfer-encoding:") and \
+                b"chunked" in line.lower():
+            chunked = True
+
+    def raw():
+        # a follow stream can sit quiet for minutes between pieces:
+        # the handshake timeout must not tear the body phase down
+        conn.settimeout(None)
+        if leftover:
+            yield leftover
+        while True:
+            piece = conn.recv(65536)
+            if not piece:
+                return
+            yield piece
+
+    if not chunked:
+        return status, ctype, raw()
+
+    def dechunked():
+        # the kubelet streams follow bodies chunked; relaying the raw
+        # framing would hand the client size lines as content — decode
+        # the inner layer and yield clean payload pieces
+        buf = b""
+        src = raw()
+        for piece in src:
+            buf += piece
+            while True:
+                nl = buf.find(b"\r\n")
+                if nl < 0:
+                    break
+                try:
+                    size = int(buf[:nl].split(b";")[0], 16)
+                except ValueError:
+                    raise ConnectionError(
+                        f"bad chunk size line: {buf[:nl][:40]!r}")
+                if size == 0:
+                    return
+                # need size bytes + trailing CRLF after the size line
+                while len(buf) < nl + 2 + size + 2:
+                    try:
+                        more = next(src)
+                    except StopIteration:
+                        return
+                    buf += more
+                yield buf[nl + 2:nl + 2 + size]
+                buf = buf[nl + 2 + size + 2:]
+
+    return status, ctype, dechunked()
+
+
 class Tunneler:
     """(ref: tunneler.go:36 Tunneler interface)"""
 

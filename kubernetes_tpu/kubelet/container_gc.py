@@ -1,0 +1,89 @@
+"""Dead-container garbage collection.
+
+Reference: pkg/kubelet/dockertools/container_gc.go + the policy in
+pkg/kubelet/container/container_gc.go — the engine daemon keeps dead
+container records (for logs and restart counts) and the kubelet prunes
+them: per (pod uid, container name) "evict unit" keep at most
+MaxPerPodContainer dead instances (newest win), enforce a global
+MaxContainers budget evicting oldest-first, skip anything younger than
+MinAge, and remove unidentified dead containers (non-kubelet names)
+outright. The subprocess/fake runtimes replace records in place (one
+per container name), so GC is only wired for runtimes that accumulate
+dead attempts and expose dead_containers()/remove_container() — the
+daemon runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ContainerGCPolicy:
+    """(ref: kubecontainer.ContainerGCPolicy; kubelet defaults
+    --minimum-container-ttl-duration=1m, --maximum-dead-containers-
+    per-container=2, --maximum-dead-containers=100)"""
+    min_age_seconds: float = 60.0
+    max_per_evict_unit: int = 2
+    max_dead_containers: int = 100
+
+
+class ContainerGC:
+    """(ref: dockertools.NewContainerGC + GarbageCollect)"""
+
+    def __init__(self, runtime, policy: ContainerGCPolicy = None):
+        self.runtime = runtime
+        self.policy = policy or ContainerGCPolicy()
+
+    @staticmethod
+    def supports(runtime) -> bool:
+        return (hasattr(runtime, "dead_containers")
+                and hasattr(runtime, "remove_container"))
+
+    def _remove(self, cid: str) -> None:
+        try:
+            self.runtime.remove_container(cid)
+        except Exception:
+            # already gone / daemon hiccup: next sweep retries
+            logger.warning("container GC: removing %s failed", cid,
+                           exc_info=True)
+
+    def garbage_collect(self) -> int:
+        """One sweep; -> number of containers removed."""
+        p = self.policy
+        cutoff = time.time() - p.min_age_seconds
+        units: Dict[Tuple[str, str], List[dict]] = {}
+        unidentified: List[dict] = []
+        removed = 0
+        for c in self.runtime.dead_containers():
+            if c.get("created", 0) > cutoff:
+                continue  # too young (ref: newestGCTime check)
+            if c.get("uid") and c.get("name"):
+                units.setdefault((c["uid"], c["name"]), []).append(c)
+            else:
+                unidentified.append(c)
+        for c in unidentified:
+            self._remove(c["id"])
+            removed += 1
+        # newest first within each unit; keep max_per_evict_unit
+        survivors: List[dict] = []
+        for unit, containers in units.items():
+            containers.sort(key=lambda c: c.get("created", 0),
+                            reverse=True)
+            for c in containers[p.max_per_evict_unit:]:
+                self._remove(c["id"])
+                removed += 1
+            survivors.extend(containers[:p.max_per_evict_unit])
+        # global budget: evict oldest across units
+        excess = len(survivors) - p.max_dead_containers
+        if excess > 0:
+            survivors.sort(key=lambda c: c.get("created", 0))
+            for c in survivors[:excess]:
+                self._remove(c["id"])
+                removed += 1
+        return removed

@@ -206,6 +206,28 @@ class DaemonRuntime(Runtime):
         return int(inspect.get("ExitCode", 0)), out.decode(
             errors="replace")
 
+    # ------------------------------------------------ container GC seam
+
+    def dead_containers(self) -> List[dict]:
+        """Every exited container the daemon still records, for the
+        kubelet's ContainerGC (ref: dockertools/container_gc.go
+        evictableContainers): [{id, uid, name, created}] with uid/name
+        empty for non-kubelet containers (removed outright by GC)."""
+        out = []
+        for c in self._list_containers():
+            if c.get("State") == "running":
+                continue
+            parsed = parse_container_name((c.get("Names") or [""])[0])
+            out.append({
+                "id": c["Id"],
+                "uid": parsed["uid"] if parsed else "",
+                "name": parsed["container"] if parsed else "",
+                "created": c.get("Created", 0)})
+        return out
+
+    def remove_container(self, cid: str) -> None:
+        self._do("DELETE", f"/containers/{cid}")
+
     def pod_port_address(self, pod_uid: str, port: int) -> Tuple[str, int]:
         """The daemon reports the container's address (inspect
         NetworkSettings); daemons running host-network answer

@@ -32,6 +32,9 @@ from .prober import Prober, ProberManager
 
 HOUSEKEEPING_PERIOD = 2.0  # kubelet.go housekeepingPeriod (2s)
 SYNC_PERIOD = 10.0
+# dead-container GC cadence (ref: kubelet.go StartGarbageCollection,
+# container GC on its own 1-minute loop — not every housekeeping tick)
+CONTAINER_GC_PERIOD = 60.0
 
 
 def _rfc3339(epoch: float) -> str:
@@ -119,6 +122,11 @@ class Kubelet:
         self._threads: List[threading.Thread] = []
         self._informer: Optional[Informer] = None
         self.max_restart_backoff = max_restart_backoff
+        from .container_gc import ContainerGC
+        self._container_gc = (ContainerGC(self.runtime)
+                              if ContainerGC.supports(self.runtime)
+                              else None)
+        self._last_container_gc = 0.0
 
     # --------------------------------------------------- pod accounting
 
@@ -359,9 +367,18 @@ class Kubelet:
                 self._housekeeping()
 
     def _housekeeping(self) -> None:
-        """Kill runtime pods whose API object is gone, and tear down
-        their orphaned volume dirs (kubelet.go HandlePodCleanups +
-        cleanupOrphanedPodDirs)."""
+        """Kill runtime pods whose API object is gone, tear down their
+        orphaned volume dirs (kubelet.go HandlePodCleanups +
+        cleanupOrphanedPodDirs), and prune dead containers on runtimes
+        that accumulate them (dockertools/container_gc.go)."""
+        now = time.time()
+        if self._container_gc is not None and \
+                now - self._last_container_gc >= CONTAINER_GC_PERIOD:
+            self._last_container_gc = now
+            try:
+                self._container_gc.garbage_collect()
+            except Exception:
+                pass  # next pass retries
         with self._lock:
             known = set(self._pods)
         for rp in self.runtime.get_pods():

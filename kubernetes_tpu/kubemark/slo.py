@@ -55,6 +55,11 @@ class SLOResult:
     startup_p50_s: float
     startup_p90_s: float
     startup_p99_s: float
+    # bulk creates measured separately: one 256-pod batch POST is not
+    # a representative per-request sample for the reference's API-call
+    # latency gate (metrics_util.go measures standard verbs)
+    batch_create_p99_s: float = 0.0
+    batch_creates: int = 0
     api_p99_limit_s: float = API_P99_LIMIT_S
     startup_p50_limit_s: float = STARTUP_P50_LIMIT_S
 
@@ -85,6 +90,9 @@ class SLOResult:
             "api_p90_ms": round(self.api_p90_s * 1e3, 2),
             "api_p99_ms": round(self.api_p99_s * 1e3, 2),
             "api_calls": self.api_calls,
+            "batch_create_p99_ms": round(self.batch_create_p99_s * 1e3,
+                                         2),
+            "batch_creates": self.batch_creates,
             "startup_p50_s": round(self.startup_p50_s, 3),
             "startup_p90_s": round(self.startup_p90_s, 3),
             "startup_p99_s": round(self.startup_p99_s, 3),
@@ -106,6 +114,7 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
     http = HttpClient(server.url)
 
     api_lat: List[float] = []
+    batch_lat: List[float] = []
     api_lock = threading.Lock()
 
     def timed(fn, *a, **kw):
@@ -188,8 +197,7 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
             for p in pods:
                 created_at.setdefault(p.metadata.name, t0)
             http.create_batch("pods", pods, "default")
-            with api_lock:
-                api_lat.append(time.monotonic() - t0)
+            batch_lat.append(time.monotonic() - t0)
         all_running.wait(timeout=max(0.0, deadline - time.time()))
         elapsed = time.monotonic() - start
     finally:
@@ -213,7 +221,9 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
         api_calls=len(lats),
         startup_p50_s=_percentile(startups, 0.50),
         startup_p90_s=_percentile(startups, 0.90),
-        startup_p99_s=_percentile(startups, 0.99))
+        startup_p99_s=_percentile(startups, 0.99),
+        batch_create_p99_s=_percentile(sorted(batch_lat), 0.99),
+        batch_creates=len(batch_lat))
 
 
 def main() -> None:

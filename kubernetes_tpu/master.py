@@ -190,6 +190,9 @@ class Master:
 
             self.tunneler = WsTunneler()
             self.tunneler.run(node_addresses)
+            # node-proxy GETs ride the tunnels (master.go wires
+            # tunneler.Dial into the proxy transport the same way)
+            self.server.tunnel_dial = self.tunneler.dial
             # the tunnel-sync healthz gate (ref: master.go
             # IsTunnelSyncHealthy wired into apiserver healthz)
             self.registry.add_component_probe(

@@ -65,14 +65,19 @@ def server_handshake(h) -> bool:
 def client_connect(host: str, port: int, path: str,
                    timeout: float = 30.0,
                    headers: Optional[Dict[str, str]] = None,
-                   ssl_context=None) -> socket.socket:
+                   ssl_context=None, sock=None) -> socket.socket:
     """Open a websocket as a client: TCP connect (TLS-wrapped when an
     ssl_context is given), HTTP upgrade carrying any extra headers
     (Authorization — the kubeconfig credential role). Returns the socket
-    positioned after the 101 response headers."""
-    sock = socket.create_connection((host, port), timeout=timeout)
+    positioned after the 101 response headers.
+
+    sock: an already-connected transport (anything with sendall/recv/
+    settimeout/close — e.g. a tunneler TunnelConn) to upgrade in place
+    instead of dialing; ssl_context is ignored then."""
+    if sock is None:
+        sock = socket.create_connection((host, port), timeout=timeout)
     try:
-        if ssl_context is not None:
+        if ssl_context is not None and isinstance(sock, socket.socket):
             sock = ssl_context.wrap_socket(sock, server_hostname=host)
         key = base64.b64encode(os.urandom(16)).decode()
         extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())

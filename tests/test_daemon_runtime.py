@@ -96,11 +96,13 @@ class MockDaemon:
                     with daemon._lock:
                         daemon._n += 1
                         cid = f"mock{daemon._n:04d}"
+                    import time as _time
                     daemon.containers[cid] = {
                         "Id": cid, "Names": [f"/{name}"],
                         "Image": body.get("Image", ""),
                         "Cmd": body.get("Cmd", []),
-                        "State": "created", "ExitCode": 0}
+                        "State": "created", "ExitCode": 0,
+                        "Created": _time.time()}
                     return self._send(201, {"Id": cid})
                 if path.endswith("/start") and "/exec/" not in path:
                     cid = path.split("/")[2]
@@ -276,3 +278,53 @@ def test_kubelet_sync_loop_drives_daemon(daemon):
         assert restarted, rt.get_pods()
     finally:
         kubelet.stop()
+
+
+def test_container_gc_prunes_dead_attempts(daemon):
+    """ref: dockertools/container_gc.go — keep the newest
+    max_per_evict_unit dead attempts per (pod, container), remove
+    unidentified dead containers, honor min_age and the global cap."""
+    from kubernetes_tpu.kubelet.container_gc import (ContainerGC,
+                                                     ContainerGCPolicy)
+
+    rt = DaemonRuntime(daemon.url)
+    pod = mk_pod()
+    # 4 dead attempts accumulate
+    for _ in range(4):
+        rc = rt.start_container(pod, pod.spec.containers[0])
+        rt.kill_container("uid-dp", "main")
+    # plus one running attempt (must survive) and one foreign corpse
+    rt.start_container(pod, pod.spec.containers[0])
+    daemon.containers["alien"] = {
+        "Id": "alien", "Names": ["/not-ours"], "Image": "x",
+        "State": "exited", "ExitCode": 0, "Created": 0}
+
+    gc = ContainerGC(rt, ContainerGCPolicy(min_age_seconds=0.0,
+                                           max_per_evict_unit=2))
+    assert ContainerGC.supports(rt)
+    removed = gc.garbage_collect()
+    assert removed == 3  # 2 oldest dead attempts + the alien
+    assert "alien" not in daemon.containers
+    dead = rt.dead_containers()
+    assert len(dead) == 2
+    # the newest dead attempts survive (attempts 2 and 3)
+    attempts = sorted(
+        parse_container_name(
+            daemon.containers[c["id"]]["Names"][0])["attempt"]
+        for c in dead)
+    assert attempts == [2, 3]
+    # running attempt untouched
+    assert any(c["State"] == "running"
+               for c in daemon.containers.values())
+    # min_age: fresh corpses are skipped
+    rt.kill_container("uid-dp", "main")
+    gc_young = ContainerGC(rt, ContainerGCPolicy(min_age_seconds=3600,
+                                                 max_per_evict_unit=0))
+    assert gc_young.garbage_collect() == 0
+
+    # global cap evicts oldest across units
+    gc_cap = ContainerGC(rt, ContainerGCPolicy(
+        min_age_seconds=0.0, max_per_evict_unit=10,
+        max_dead_containers=1))
+    gc_cap.garbage_collect()
+    assert len(rt.dead_containers()) == 1

@@ -21,7 +21,8 @@ in-process scheduler).
 
 Prints ONE JSON line. Stable schema (r03+): metric, value, unit,
 vs_baseline, e2e_elapsed_s, scheduled, nodes, pods,
-engine_only_pods_per_sec, platform, probe, pallas, slo.
+engine_only_pods_per_sec, platform, probe, pallas, slo; r04 adds tpu
+(opportunistic real-hardware evidence merged from tools/tpu_watch.py).
 """
 
 import argparse
@@ -63,6 +64,70 @@ def _pallas_status(platform: str) -> dict:
     if "PALLAS-MISMATCH" in res.stdout:
         return {"status": "ran", "parity": False}
     return {"status": "error", "tail": (res.stdout + res.stderr)[-400:]}
+
+
+def _await_capture_lock(max_wait: float = 300.0) -> None:
+    """If the opportunistic evidence capture (tools/tpu_watch.py) is
+    mid-run, wait for it to release the one tunneled chip rather than
+    measure under contention; stale locks (>45 min) are ignored."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".tpu_capture.lock")
+    deadline = time.time() + max_wait
+    while time.time() < deadline and os.path.exists(path):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if time.time() - rec.get("ts", 0) > 2700:
+                return
+        except (OSError, ValueError):
+            return
+        time.sleep(5)
+
+
+def _tpu_section():
+    """Merge the freshest opportunistic real-TPU evidence (captured by
+    tools/tpu_watch.py whenever the flaky tunnel opened mid-round) plus
+    a summary of the round's probe log — so the artifact carries real
+    hardware numbers even when the end-of-round probe fails, or proof
+    that the tunnel never opened."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    try:
+        with open(os.path.join(here, "TPU_EVIDENCE.json")) as f:
+            out["evidence"] = json.load(f)
+    except (OSError, ValueError):
+        out["evidence"] = None
+    # summarize only the LATEST watcher run (each round starts a fresh
+    # watcher, which logs an {"event": "start"} record) so a prior
+    # round's probes/evidence can't masquerade as this round's
+    probes = {"total": 0, "healthy": 0, "first_ts": None, "last_ts": None,
+              "watcher_start_ts": None, "errors": 0}
+    try:
+        with open(os.path.join(here, "TPU_PROBES.jsonl")) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                ev = rec.get("event")
+                if ev == "start":
+                    probes.update(total=0, healthy=0, first_ts=None,
+                                  last_ts=None, errors=0,
+                                  watcher_start_ts=rec.get("ts"))
+                elif ev == "probe":
+                    probes["total"] += 1
+                    probes["healthy"] += 1 if rec.get("ok") else 0
+                    probes["first_ts"] = probes["first_ts"] or rec.get("ts")
+                    probes["last_ts"] = rec.get("ts")
+                elif ev == "error":
+                    probes["errors"] += 1
+    except OSError:
+        pass
+    out["probes"] = probes
+    if out["evidence"] is not None and probes["watcher_start_ts"]:
+        out["evidence_stale"] = (
+            out["evidence"].get("ts_start", "") < probes["watcher_start_ts"])
+    return out
 
 
 def engine_only(n_nodes, n_pods):
@@ -127,6 +192,7 @@ def main():
 
     from kubernetes_tpu.utils.platform import ensure_live_platform
     platform, probe = ensure_live_platform(attempts=args.probe_attempts)
+    _await_capture_lock()
     from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
 
     r = run_scheduling_benchmark(args.nodes, args.pods, "batch")
@@ -158,7 +224,8 @@ def main():
         "platform": platform,
         "probe": probe,
         "pallas": pallas,
-        "slo": slo}))
+        "slo": slo,
+        "tpu": _tpu_section()}))
 
 
 if __name__ == "__main__":

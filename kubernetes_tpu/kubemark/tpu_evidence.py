@@ -1,0 +1,223 @@
+"""Opportunistic real-TPU evidence capture.
+
+The tunneled TPU on this box wedges for hours at a time (the FIRST
+dispatch hangs forever, including backend creation). Probing only at
+driver time produced three straight rounds of `platform: cpu-fallback`
+benchmarks with zero real-TPU artifacts. This module is the fix:
+``tools/tpu_watch.py`` probes the tunnel on a schedule for the whole
+round and, the moment a probe succeeds, runs this capture in a bounded
+subprocess. Results land in ``TPU_EVIDENCE.json`` (written section by
+section, atomic rename at each flush, so a mid-capture wedge still
+leaves partial evidence) and ``bench.py`` merges the freshest evidence
+into its JSON line as a ``tpu`` section even when its own end-of-round
+probe fails.
+
+Captured sections:
+
+- ``dispatch``: tiny-dispatch roundtrip latency percentiles (the tunnel
+  adds ~86ms per fetch; the tile pipeline is shaped around that).
+- ``engine``: engine-only scoring throughput at the 5k-node/30k-pod
+  north-star shape (BASELINE.json) via the production 8192-pod
+  ``run_chunked`` tile, plus the 1k/3k point.
+- ``pallas``: the predicate-filter kernel compiled and executed under
+  REAL Mosaic (interpret=False on a tpu backend), bit-compared against
+  the XLA probe — then a forced-rejection exercise: a genuinely
+  Mosaic-unloweable kernel is swapped into pallas_filter._filter_call
+  and BatchEngine.filter_masks must catch the real rejection, latch
+  ``_pallas_broken``, and return the XLA result (engine.py:528-544 has
+  never seen a real rejection before this).
+- ``e2e``: the full kubemark pipeline (registry + watch fan-out + FIFO
+  drain + incremental encode + device scan + batched CAS bind) on the
+  default platform, 5k nodes / 30k pods.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+
+
+def _utc() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class _Evidence:
+    """Accumulates sections, flushing the artifact after each one so a
+    tunnel wedge mid-capture loses only the in-flight section."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.doc = {"ts_start": _utc(), "complete": False, "sections": {}}
+
+    def flush(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.doc, f, indent=1)
+        os.replace(tmp, self.path)
+
+    def run_section(self, name: str, fn):
+        t0 = time.time()
+        try:
+            out = fn()
+            out["elapsed_s"] = round(time.time() - t0, 2)
+            out.setdefault("status", "ok")
+        except Exception:
+            out = {"status": "error",
+                   "elapsed_s": round(time.time() - t0, 2),
+                   "tail": traceback.format_exc()[-600:]}
+        self.doc["sections"][name] = out
+        self.flush()
+        return out
+
+
+def _section_platform() -> dict:
+    import jax
+    devs = jax.devices()
+    return {"backend": jax.default_backend(),
+            "devices": [str(d) for d in devs],
+            "n_devices": len(devs)}
+
+
+def _section_dispatch() -> dict:
+    """Roundtrip latency of a tiny dispatch+fetch, and device_put
+    bandwidth — the two numbers the tile pipeline is designed around."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x.sum())
+    x = jnp.ones(8)
+    f(x).block_until_ready()  # warm
+    lat = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        float(f(x))
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    host = np.ones((64, 1024, 1024), np.float32)  # 256 MiB
+    t0 = time.perf_counter()
+    jax.device_put(host).block_until_ready()
+    put_s = time.perf_counter() - t0
+    return {"roundtrip_ms": {"p50": round(lat[len(lat) // 2], 2),
+                             "p90": round(lat[int(len(lat) * 0.9)], 2),
+                             "min": round(lat[0], 2)},
+            "device_put_mb_per_s": round(host.nbytes / 2 ** 20 / put_s, 1)}
+
+
+def _section_engine() -> dict:
+    """Engine-only scoring throughput, the number three rounds of
+    cpu-fallback benches could never attribute to hardware."""
+    import bench  # repo-root module; watcher runs with cwd=/root/repo
+    out = {}
+    for n_nodes, n_pods in ((1000, 3000), (5000, 30000)):
+        rate, bound = bench.engine_only(n_nodes, n_pods)
+        out[f"{n_nodes}x{n_pods}"] = {
+            "pods_per_sec": round(rate, 1), "bound": bound}
+    return out
+
+
+def _tiny_enc():
+    from __graft_entry__ import _tiny_snapshot_inline
+
+    from kubernetes_tpu.sched.device import encode_snapshot
+    return encode_snapshot(_tiny_snapshot_inline(8, 16))
+
+
+def _section_pallas() -> dict:
+    """The predicate-filter kernel under real Mosaic + the latch test."""
+    import numpy as np
+
+    import jax
+
+    from kubernetes_tpu.sched.device import BatchEngine, pallas_filter
+
+    out: dict = {"backend": jax.default_backend()}
+    enc = _tiny_enc()
+    if not pallas_filter.supports(enc):
+        return {"status": "error", "tail": "tiny encoding unsupported"}
+    eng = BatchEngine()
+    ref_mask, _ = eng.probe(enc)
+    ref = np.asarray(ref_mask[:enc.n_pods]).astype(bool)
+
+    # 1) real Mosaic compile + run (interpret=False on the tpu backend)
+    masks = pallas_filter.filter_masks(enc)
+    out["mosaic_parity"] = bool(np.array_equal(np.asarray(masks), ref))
+    out["interpret"] = jax.default_backend() not in ("tpu",)
+
+    # 2) forced rejection: swap in a kernel the Pallas TPU lowering
+    # cannot handle (argsort has no Mosaic lowering rule) and prove a
+    # REAL rejection propagates as a catchable exception through
+    # BatchEngine.filter_masks, engages _pallas_broken, and still
+    # returns the XLA answer
+    import functools
+
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _bad_call(node_args, state_args, pod_args, interpret=False):
+        def bad_kernel(x_ref, o_ref):
+            o_ref[:] = jnp.argsort(x_ref[:], axis=-1).astype(jnp.int32)
+
+        x = jnp.ones((8, 128), jnp.float32)
+        return pl.pallas_call(
+            bad_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            interpret=False)(x)
+
+    orig = pallas_filter._filter_call
+    try:
+        # confirm the bad kernel raises on its own (a real rejection)
+        try:
+            _bad_call(None, None, None)
+            out["rejection_raised"] = False
+        except Exception as e:
+            out["rejection_raised"] = True
+            out["rejection_type"] = type(e).__name__
+            out["rejection_msg"] = str(e)[:200]
+        pallas_filter._filter_call = _bad_call
+        BatchEngine._pallas_broken = False
+        fb = eng.filter_masks(enc)
+        out["latch_engaged"] = bool(BatchEngine._pallas_broken)
+        out["latch_fallback_parity"] = bool(np.array_equal(
+            np.asarray(fb), ref))
+    finally:
+        pallas_filter._filter_call = orig
+        BatchEngine._pallas_broken = False
+    return out
+
+
+def _section_e2e() -> dict:
+    from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
+    r = run_scheduling_benchmark(5000, 30000, "batch")
+    return {"pods_per_sec": round(r.pods_per_sec, 1),
+            "elapsed_s": round(r.elapsed_s, 2),
+            "scheduled": r.scheduled, "nodes": r.n_nodes,
+            "pods": r.n_pods}
+
+
+def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="TPU_EVIDENCE.json")
+    ap.add_argument("--skip-e2e", action="store_true")
+    args = ap.parse_args()
+
+    ev = _Evidence(args.out)
+    ev.run_section("platform", _section_platform)
+    ev.run_section("dispatch", _section_dispatch)
+    ev.run_section("pallas", _section_pallas)
+    ev.run_section("engine", _section_engine)
+    if not args.skip_e2e:
+        ev.run_section("e2e", _section_e2e)
+    ev.doc["complete"] = True
+    ev.doc["ts_end"] = _utc()
+    ev.flush()
+    print(json.dumps({k: v.get("status") for k, v in
+                      ev.doc["sections"].items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Round-long TPU-tunnel watcher.
+
+Probes the tunneled TPU on a schedule (the tunnel wedges for hours,
+then recovers without notice) and, on the first healthy probe, runs
+the full evidence capture (kubernetes_tpu/kubemark/tpu_evidence.py)
+in a bounded subprocess. Re-captures hourly while the tunnel stays
+healthy so BENCH_r{N} merges the freshest numbers.
+
+Artifacts (all at the repo root):
+- TPU_PROBES.jsonl  — one line per probe/capture attempt, timestamped.
+  If the tunnel never opens all round, this file IS the evidence.
+- TPU_EVIDENCE.json — freshest successful capture (atomic, partial
+  sections survive a mid-capture wedge).
+- .tpu_capture.lock — held during capture so bench.py's headline run
+  and the capture never contend for the one tunneled chip.
+
+Start at round open:  nohup python tools/tpu_watch.py >/dev/null 2>&1 &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PROBE_LOG = os.path.join(REPO, "TPU_PROBES.jsonl")
+EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE.json")
+LOCK = os.path.join(REPO, ".tpu_capture.lock")
+
+PROBE_TIMEOUT = 120.0
+PROBE_INTERVAL = 600.0       # wedged: probe every 10 min
+CAPTURE_TIMEOUT = 2400.0
+HEALTHY_INTERVAL = 3600.0    # healthy: refresh evidence hourly
+FAILED_CAPTURE_INTERVAL = 900.0
+
+
+def log(rec: dict) -> None:
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def probe() -> bool:
+    from kubernetes_tpu.utils.platform import probe_default_platform
+    t0 = time.time()
+    ok = probe_default_platform(timeout=PROBE_TIMEOUT)
+    log({"event": "probe", "ok": ok,
+         "elapsed_s": round(time.time() - t0, 1)})
+    return ok
+
+
+def capture() -> bool:
+    t0 = time.time()
+    with open(LOCK, "w") as f:
+        json.dump({"pid": os.getpid(), "ts": time.time()}, f)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.kubemark.tpu_evidence",
+             "--out", EVIDENCE],
+            capture_output=True, text=True, cwd=REPO,
+            timeout=CAPTURE_TIMEOUT)
+        ok = res.returncode == 0
+        tail = (res.stdout + res.stderr)[-300:]
+    except subprocess.TimeoutExpired:
+        ok, tail = False, "capture timeout (tunnel wedged mid-run?)"
+    finally:
+        try:
+            os.unlink(LOCK)
+        except OSError:
+            pass
+    log({"event": "capture", "ok": ok,
+         "elapsed_s": round(time.time() - t0, 1), "tail": tail})
+    return ok
+
+
+def main() -> None:
+    log({"event": "start", "pid": os.getpid()})
+    while True:
+        # the probe log is the round's tunnel-health record: an
+        # unexpected error (spawn failure, disk full) must be logged
+        # and survived, not silently kill the watcher — a dead watcher
+        # is indistinguishable from a wedged-all-round tunnel
+        try:
+            if probe():
+                ok = capture()
+                time.sleep(HEALTHY_INTERVAL if ok
+                           else FAILED_CAPTURE_INTERVAL)
+            else:
+                time.sleep(PROBE_INTERVAL)
+        except Exception as e:  # noqa: BLE001
+            try:
+                log({"event": "error", "error": repr(e)[:300]})
+            except Exception:
+                pass
+            time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()

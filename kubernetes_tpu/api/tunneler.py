@@ -68,6 +68,22 @@ class TunnelConn:
     def settimeout(self, t) -> None:
         self._ws.settimeout(t)
 
+    def shutdown(self, how: int = socket.SHUT_RDWR) -> None:
+        """socket.shutdown analogue so relay teardown paths
+        (utils/wsstream.relay_ws) can unblock a peer pump thread:
+        best-effort CLOSE frame, then shut the underlying socket so a
+        blocked read returns immediately."""
+        try:
+            wsstream.write_frame(self._ws.sendall, b"", wsstream.CLOSE,
+                                 mask=True)
+        except (ConnectionError, OSError):
+            pass
+        self._eof = True
+        try:
+            self._ws.shutdown(how)
+        except OSError:
+            pass
+
 
 def http_get_over(conn: TunnelConn, host: str, path: str,
                   timeout: float = 30.0):
@@ -131,9 +147,12 @@ def http_stream_over(conn: TunnelConn, host: str, path: str,
             chunked = True
 
     def raw():
-        # a follow stream can sit quiet for minutes between pieces:
-        # the handshake timeout must not tear the body phase down
-        conn.settimeout(None)
+        # a follow stream can sit quiet for minutes between pieces, so
+        # the handshake timeout must not tear the body phase down — but
+        # a WEDGED node (a failure mode this deployment hits) must not
+        # pin an apiserver handler thread forever either: bound the
+        # idle gap at 15 min and let the timeout release the thread
+        conn.settimeout(900.0)
         if leftover:
             yield leftover
         while True:
@@ -300,9 +319,13 @@ class WsTunneler(Tunneler):
                 f"no healthy tunnel to {host!r} (targets must be "
                 f"tunneled nodes)")
         k_host, k_port = entry
+        # dial the node's REGISTERED kubelet address, not loopback: a
+        # kubelet bound only to its InternalIP serves nothing on
+        # 127.0.0.1, and the kubelet-side node-local check admits its
+        # own bind address (kubelet/server.py _tunnel)
         ws = wsstream.client_connect(
             k_host, k_port,
-            f"/tunnel?host=127.0.0.1&port={port}",
+            f"/tunnel?host={k_host}&port={port}",
             timeout=self.dial_timeout)
         return TunnelConn(ws)
 

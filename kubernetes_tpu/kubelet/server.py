@@ -388,7 +388,12 @@ class KubeletServer:
         if not 0 < port < 65536:
             return self._raw(h, 400, b"?port= required", "text/plain")
         host = query.get("host", ["127.0.0.1"])[0]
-        if host not in ("127.0.0.1", "localhost", "::1"):
+        # node-local only: loopback plus this kubelet's own bind
+        # address (the master's tunneler dials the node's registered
+        # address — a kubelet bound to its InternalIP is not reachable
+        # as 127.0.0.1 even from itself)
+        if host not in ("127.0.0.1", "localhost", "::1", self.host,
+                        self.node_name):
             return self._raw(h, 403,
                              b"tunnel targets are node-local only",
                              "text/plain")

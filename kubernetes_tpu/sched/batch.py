@@ -230,7 +230,12 @@ class BatchScheduler:
             ready = getattr(prev.assigned, "is_ready", lambda: True)
             while (len(pods) < self.config.tile_size and not ready()
                    and not self._stop.is_set()):
-                pod = f.pod_queue.pop(timeout=0.002)
+                # 20ms poll: long enough not to busy-spin the
+                # scheduling thread at ~500 wakeups/s against an empty
+                # queue for a whole device scan, short enough that the
+                # post-ready finalize lags the device by at most one
+                # poll (a full-tile scan runs far longer than 20ms)
+                pod = f.pod_queue.pop(timeout=0.02)
                 if pod is not None:
                     pods.append(pod)
         return pods

@@ -309,9 +309,12 @@ def relay_ws(down_read: Callable[[int], bytes],
     t.start()
     _pump_ws_to_ws(down_read, up_write, True)
     # downstream leg done (client closed or sent CLOSE): unblock the
-    # upstream reader so its pump can forward the final CLOSE and end
+    # upstream reader so its pump can forward the final CLOSE and end.
+    # up_sock may be a plain socket, a TunnelConn, or a _PrefixedSocket
+    # over either — anything socket-like; a missing shutdown must not
+    # turn teardown into a spurious 500 on the hijacked connection
     try:
         up_sock.shutdown(socket.SHUT_RDWR)
-    except OSError:
+    except (OSError, AttributeError):
         pass
     t.join(timeout=10)

@@ -264,3 +264,31 @@ def test_streaming_legs_ride_the_tunnel(tmp_path):
         m.stop()
         ksrv.stop()
         runtime.kill_pod("uid-tun")
+
+
+def test_tunnelconn_shutdown_unblocks_reader(kubelet, echo_server):
+    """relay_ws tears down with up_sock.shutdown(SHUT_RDWR); when the
+    upstream is a TunnelConn (tunneled portforward/attach/exec) that
+    must unblock the pump's blocked recv rather than raise
+    AttributeError into a spurious 500 (ADVICE r3, medium)."""
+    t = _tunneler_for(kubelet)
+    try:
+        conn = t.dial("127.0.0.1", echo_server)
+        got = []
+        blocked = threading.Event()
+
+        def reader():
+            blocked.set()
+            got.append(conn.recv(4096))  # blocks: echo sent nothing
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        blocked.wait(5)
+        time.sleep(0.1)
+        conn.shutdown(socket.SHUT_RDWR)  # must exist and unblock
+        th.join(timeout=5)
+        assert not th.is_alive(), "shutdown did not unblock recv"
+        assert got == [b""]
+        conn.close()
+    finally:
+        t.stop()

@@ -204,12 +204,29 @@ def main():
 
     slo = None
     if not args.skip_slo:
+        # the reference's density matrix at two points (3 and 30
+        # pods/node, test/e2e/density.go:203-208), 1000 nodes each;
+        # latency percentiles are server-side (see kubemark/slo.py)
         from kubernetes_tpu.kubemark.slo import run_density_slo
-        s = run_density_slo(n_nodes=1000, n_pods=3000)
-        slo = s.as_dict()
-        if args.verbose:
-            print(f"# slo api_p99={slo['api_p99_ms']}ms "
-                  f"startup_p50={slo['startup_p50_s']}s", file=sys.stderr)
+        points = []
+        for ppn in (3, 30):
+            s = run_density_slo(n_nodes=1000, n_pods=1000 * ppn)
+            points.append(s.as_dict())
+            if args.verbose:
+                print(f"# slo[{ppn}/node] api_p99="
+                      f"{points[-1]['api_p99_ms']}ms "
+                      f"calls={points[-1]['api_calls']} "
+                      f"startup_p50={points[-1]['startup_p50_s']}s",
+                      file=sys.stderr)
+        total_calls = sum(p["api_calls"] for p in points)
+        slo = {
+            "density_points": points,
+            "api_calls": total_calls,
+            "api_slo_ok": all(p["api_slo_ok"] for p in points),
+            "startup_slo_ok": all(p["startup_slo_ok"] for p in points),
+            # the matrix-wide floor: the 3/node point's window is only
+            # a few seconds (per-point validity stays reported above)
+            "api_samples_valid": total_calls >= 1000}
 
     print(json.dumps({
         "metric": "e2e_scheduling_throughput_5k_nodes",

@@ -39,14 +39,22 @@ _DNS1123_SUBDOMAIN_RE = re.compile(
 
 # uid generation: uuid4() reads os.urandom per call, which serializes hard
 # under concurrent creators (30 writer threads is the reference benchmark
-# shape); a urandom-seeded PRNG keeps uids unique and creation cheap
-_uid_rng = random.Random()
-_uid_lock = threading.Lock()
+# shape). A per-thread urandom-seeded PRNG keeps uids unique with NO
+# shared lock — the r3 profile showed 30 writers spending ~19% of the
+# create storm's runnable samples contending one RNG lock
+# (PROFILE_e2e.md registry.py:_new_uid).
+_uid_local = threading.local()
+
+
+def _uid_rng() -> random.Random:
+    rng = getattr(_uid_local, "rng", None)
+    if rng is None:
+        rng = _uid_local.rng = random.Random()  # seeds from os.urandom
+    return rng
 
 
 def _new_uid() -> str:
-    with _uid_lock:
-        bits = _uid_rng.getrandbits(128)
+    bits = _uid_rng().getrandbits(128)
     # format the RFC-4122 v4 shape directly: uuid.UUID's field validation
     # plus __str__ was ~7us per create under the 30-writer benchmark load
     bits = (bits & ~(0xF << 76)) | (0x4 << 76)   # version nibble
@@ -56,8 +64,7 @@ def _new_uid() -> str:
 
 
 def _name_suffix(n: int = 5) -> str:
-    with _uid_lock:
-        return "%0*x" % (n, _uid_rng.getrandbits(4 * n))
+    return "%0*x" % (n, _uid_rng().getrandbits(4 * n))
 
 
 def _dns1123(name: str) -> bool:
@@ -397,7 +404,13 @@ class Registry:
             ns, name, prepared = self._prepare_create(
                 info, resource, obj, namespace)
             entries.append((self.key(resource, ns, name), prepared, info.ttl))
-        return self.store.create_batch(entries)
+        # _prepare_create fresh-builds both the object and its metadata
+        # (fast_replace x2) and admission plugins only ever swap
+        # spec/status around that fresh metadata, so the store may
+        # stamp the revision in place instead of re-cloning both per
+        # object (the clone pair was most of the create storm's work
+        # under the store lock, PROFILE_e2e.md)
+        return self.store.create_batch(entries, owned_meta=True)
 
     def _service_allocate(self, obj: api.Service):
         """Assign cluster IP + node ports (ref: pkg/registry/service

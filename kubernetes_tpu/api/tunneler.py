@@ -48,7 +48,18 @@ class TunnelConn:
 
     def recv(self, n: int) -> bytes:
         while not self._buf and not self._eof:
-            opcode, payload = wsstream.read_frame(self._ws.recv)
+            try:
+                opcode, payload = wsstream.read_frame(self._ws.recv)
+            except TimeoutError:
+                # a settimeout() expiry is the caller's signal (the
+                # tunneled log-stream idle bound), NOT end-of-stream
+                raise
+            except (ConnectionError, OSError):
+                # socket semantics: a recv blocked across shutdown()
+                # (or an abruptly dead tunnel leg) reads EOF, it does
+                # not raise — the relay pumps treat b"" as done
+                self._eof = True
+                break
             if opcode == wsstream.CLOSE:
                 self._eof = True
                 break

@@ -217,13 +217,15 @@ class NativeStore:
         self._cache_put(key, rev, out)
         return out
 
-    def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]]
-                     ) -> List[Any]:
+    def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]],
+                     owned_meta: bool = False) -> List[Any]:
         """Batched create in ONE engine pass (kv_create_batch):
         all-or-nothing exactly like the in-memory Store.create_batch —
         any pre-existing or intra-batch duplicate key fails the whole
         batch before anything commits — with one lock window and
-        consecutive revisions C-side."""
+        consecutive revisions C-side. owned_meta as in
+        Store.create_batch: stamp the fresh caller-owned metadata in
+        place instead of a replace-clone pair per object."""
         if not entries:
             return []
         encoded = [(k, self._encode(o), ttl) for k, o, ttl in entries]
@@ -249,7 +251,11 @@ class NativeStore:
             raise AlreadyExists(kind=kind, name=name)
         out = []
         for i, (key, obj, _ttl) in enumerate(entries):
-            stamped = self._stamp(obj, first + i)
+            if owned_meta:
+                obj.metadata.resource_version = str(first + i)
+                stamped = obj
+            else:
+                stamped = self._stamp(obj, first + i)
             self._cache_put(key, first + i, stamped)
             out.append(stamped)
         return out

@@ -196,15 +196,22 @@ class Store:
             self._emit(rev, watchpkg.ADDED, key, obj, None)
             return obj
 
-    def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]]
-                     ) -> List[Any]:
+    def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]],
+                     owned_meta: bool = False) -> List[Any]:
         """Create many keys under ONE lock acquisition with one watch
         fan-out flush — the write-side analogue of batch() (the 30k-pod
         create storm was paying one lock + one per-watcher send per pod;
         ref: GuaranteedUpdate batching rationale, etcd_helper.go:449).
         All-or-nothing: any pre-existing key fails the whole batch
         before anything commits, so callers can retry object-by-object
-        to surface the precise conflict."""
+        to surface the precise conflict.
+
+        owned_meta=True: the caller guarantees every object AND its
+        .metadata were freshly allocated for this call and no other
+        reference sees them (the registry's _prepare_create contract) —
+        the revision is then stamped in place instead of through two
+        clone passes per object, which is most of what the create storm
+        used to do under the store lock (PROFILE_e2e.md)."""
         with self._lock:
             self._gc_expired()
             now = time.time()
@@ -219,7 +226,10 @@ class Store:
             batch_events: List[Tuple[str, watchpkg.Event, Any]] = []
             for key, obj, ttl in entries:
                 rev = self._bump()
-                obj = _with_rv(obj, rev)
+                if owned_meta:
+                    obj.metadata.resource_version = str(rev)
+                else:
+                    obj = _with_rv(obj, rev)
                 expiry = now + ttl if ttl else None
                 self._data[key] = (obj, rev, expiry)
                 if expiry is not None:

@@ -162,6 +162,10 @@ def run_apiserver(argv: List[str]) -> int:
         oidc_username_claim=args.oidc_username_claim,
         oidc_groups_claim=args.oidc_groups_claim,
         keystone_url=args.experimental_keystone_url)).start()
+    # freeze the booted master out of the young generations
+    # (utils/gctune.py) — the fan-out/serve path churns small objects
+    from .utils.gctune import tune_for_server
+    tune_for_server()
     return _serve_until_signal(f"apiserver ready {master.url}",
                                [master.stop])
 
@@ -185,6 +189,10 @@ def run_scheduler(argv: List[str]) -> int:
     # to schedule.
     import sys as _sys
     _sys.setswitchinterval(0.001)
+    # steady-state server GC posture (no cycles in the API types;
+    # see utils/gctune.py for the measurement behind it)
+    from .utils.gctune import tune_for_server
+    tune_for_server()
     _pin_jax_platform()
     from .api.client import HttpClient
     from .sched.api import policy_from_json

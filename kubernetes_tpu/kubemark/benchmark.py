@@ -38,6 +38,8 @@ class BenchmarkResult:
     elapsed_s: float          # create-start -> all pods bound
     pods_per_sec: float
     mode: str                 # "batch" | "serial"
+    started_at: float = 0.0   # epoch of create-start (profilers scope
+    #                           samples to [started_at, +elapsed_s])
 
 
 _BENCH_REQUESTS = {"cpu": parse_quantity("100m"),
@@ -93,12 +95,13 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                              ) -> BenchmarkResult:
     """Stand up master + fleet + scheduler, blast pods from 30 writers,
     measure time until every pod is bound (and optionally Running)."""
-    # scheduling throughput is this process's whole purpose: shorten the
-    # GIL slice so the scheduler thread isn't parked 5ms behind the 30
-    # writer threads at every device dispatch (same move the hyperkube
-    # scheduler entry makes for its dedicated process)
+    # GIL slice: r2 measured 1ms best (the scheduler thread parked
+    # behind 30 writers at every dispatch); after r4's contention fixes
+    # (thread-local uids, in-place rv stamping, informer-riding
+    # counter) the default 5ms wins — fewer forced handoffs across ~40
+    # threads — and tightens the run-to-run spread (A/B in PROFILE_e2e.md)
     import sys
-    sys.setswitchinterval(0.001)
+    sys.setswitchinterval(0.005)
     registry = registry or Registry()
     client = InProcClient(registry)
     fleet = HollowFleet(client, n_nodes, cpu="4", memory="32Gi",
@@ -127,28 +130,33 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
             # happens once per shape, not per tile
             _warmup_batch(sched, factory)
 
-        # watch-based bound counter: polling list() at scale steals the
-        # GIL from the writers and the scheduler; the reference waits on
-        # its ScheduledPodLister (a watch cache) for the same reason.
-        # Server-side field selector: only bound pods reach this queue
+        # the live-server GC posture (utils/gctune.py): the booted
+        # fleet + node caches freeze out of the young generations and
+        # gen-0 stops firing every ~700 allocations (it showed at ~25%
+        # of profile ticks via jax's per-collection callback). Applies
+        # to both modes — hyperkube server entries make the same move.
+        from ..utils.gctune import tuned_gc
+        gc_ctx = tuned_gc()
+        gc_ctx.__enter__()
+
+        # completion counter rides the scheduler's OWN scheduled-pod
+        # informer (exactly the reference: BenchmarkScheduling waits on
+        # the config's ScheduledPodLister, scheduler_test.go:278) — a
+        # separate watch would add a 4th pods watcher to every store
+        # fan-out inside the measured window
         bound = set()
         bound_lock = threading.Lock()
         all_bound = threading.Event()
-        watcher = client.watch("pods", "default",
-                               field_selector="spec.nodeName!=")
 
-        def count_bindings():
-            for ev in watcher:
-                pod = ev.object
-                if pod.metadata.name.startswith("bench-pod-") and \
-                        pod.spec.node_name and ev.type != "DELETED":
-                    with bound_lock:
-                        bound.add(pod.metadata.name)
-                        if len(bound) >= n_pods:
-                            all_bound.set()
+        def count_binding(pod):
+            if pod.metadata.name.startswith("bench-pod-") and \
+                    pod.spec.node_name:
+                with bound_lock:
+                    bound.add(pod.metadata.name)
+                    if len(bound) >= n_pods:
+                        all_bound.set()
 
-        counter = threading.Thread(target=count_bindings, daemon=True)
-        counter.start()
+        factory.scheduled_observers.append(count_binding)
 
         start = time.time()
         next_i = iter(range(n_pods))
@@ -182,7 +190,7 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
 
         all_bound.wait(timeout=max(0.0, deadline - time.time()))
         elapsed = time.time() - start
-        watcher.stop()
+        factory.scheduled_observers.remove(count_binding)
         with bound_lock:
             scheduled = len(bound)
 
@@ -201,8 +209,12 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
             n_nodes=n_nodes, n_pods=n_pods, scheduled=scheduled,
             running=running, elapsed_s=elapsed,
             pods_per_sec=scheduled / elapsed if elapsed > 0 else 0.0,
-            mode=mode)
+            mode=mode, started_at=start)
     finally:
+        try:
+            gc_ctx.__exit__(None, None, None)
+        except NameError:
+            pass  # serial mode / failure before the tuning point
         sched.stop()
         factory.stop()
         fleet.stop()

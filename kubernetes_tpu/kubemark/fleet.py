@@ -158,7 +158,8 @@ class HollowFleet:
                     break
                 batch.append(nxt)
             ts = api.now_rfc3339()
-            updated = [replace(p, status=self._running_status(p, ts))
+            updated = [api.fast_replace(p,
+                                        status=self._running_status(p, ts))
                        for p in batch]
             if len(updated) > 1:
                 try:

@@ -165,12 +165,18 @@ class ConfigFactory:
             store=self.pod_queue)
 
         # assigned pods -> ScheduledPodLister; forget modeler assumptions on
-        # add/delete (ref: factory.go:92-115 scheduledPodPopulator)
+        # add/delete (ref: factory.go:92-115 scheduledPodPopulator).
+        # scheduled_observers: external hooks (kubemark benchmark / SLO
+        # probes) ride THIS informer instead of opening their own watch —
+        # the reference benchmark likewise watches completion through the
+        # scheduler's ScheduledPodLister (scheduler_test.go:278), and a
+        # duplicate pods watch costs a per-event fan-out at 30k scale
+        self.scheduled_observers: List[Callable] = []
         self.scheduled_cache = ObjectCache()
         self.scheduled_reflector = Reflector(
             client, "pods", field_selector="spec.nodeName!=",
             store=self.scheduled_cache,
-            on_add=self._forget, on_delete=self._forget)
+            on_add=self._scheduled_added, on_delete=self._forget)
         self.scheduled_pod_lister = StoreToPodLister(self.scheduled_cache)
 
         # nodes (ref: createNodeLW :281 — spec.unschedulable=false)
@@ -196,6 +202,11 @@ class ConfigFactory:
 
     def _forget(self, pod: api.Pod) -> None:
         self.modeler.locked_action(lambda: self.modeler.forget_pod(pod))
+
+    def _scheduled_added(self, pod: api.Pod) -> None:
+        self._forget(pod)
+        for cb in self.scheduled_observers:
+            cb(pod)
 
     # ------------------------------------------------------------- wiring
 

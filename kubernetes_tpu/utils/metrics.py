@@ -79,6 +79,28 @@ class MetricsRegistry:
                 s = self._summaries[name][k] = _Summary()
             s.observe(value)
 
+    def summary_samples(self, name: str) -> Dict[tuple, List[float]]:
+        """-> {labels_key: sorted sample window} — lets a reader merge
+        windows across label sets for an all-traffic percentile (label
+        summaries cannot be merged from quantiles alone)."""
+        with self._lock:
+            return {k: list(s._samples)
+                    for k, s in self._summaries.get(name, {}).items()}
+
+    def summary_stats(self, name: str) -> Dict[dict, dict]:
+        """-> {labels_dict_as_tuple: {count, sum, p50, p90, p99}} for
+        one summary metric — the server-side read the SLO suite gates
+        on (the reference gates on apiserver metrics, not client
+        probes: test/e2e/metrics_util.go:194-200)."""
+        out = {}
+        with self._lock:
+            for k, s in self._summaries.get(name, {}).items():
+                out[k] = {"count": s.count, "sum": s.total,
+                          "p50": s.quantile(0.50),
+                          "p90": s.quantile(0.90),
+                          "p99": s.quantile(0.99)}
+        return out
+
     # ---------------------------------------------------------------- read
 
     def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:

@@ -14,7 +14,7 @@ from typing import Any, Dict, Optional, Type
 
 from . import types as api
 from .errors import BadRequest
-from .serde import from_wire, to_wire
+from .serde import from_wire, to_wire, wire_json
 
 API_VERSION = "v1"
 
@@ -79,6 +79,21 @@ class Scheme:
             "metadata": {"resourceVersion": resource_version},
             "items": [to_wire(i) for i in items],
         }
+
+    def encode_list_bytes(self, kind: str, items,
+                          resource_version: str = "") -> bytes:
+        """encode_list, bytes-for-the-wire, assembled from per-object
+        cached JSON fragments (serde.wire_json): a repeat LIST of an
+        unchanged 5k-node fleet reuses 5k cached strings instead of
+        5k reflective walks. Byte-identical to
+        json.dumps(encode_list(...)) (tests pin it)."""
+        head = json.dumps({
+            "kind": kind + "List",
+            "apiVersion": API_VERSION,
+            "metadata": {"resourceVersion": resource_version}})
+        return (head[:-1] + ', "items": ['
+                + ", ".join(wire_json(i) for i in items)
+                + "]}").encode()
 
     def deep_copy(self, obj: Any) -> Any:
         """Round-trip copy (the reference uses generated deep-copy; a codec

@@ -61,6 +61,18 @@ class Store:
         # O(expired) per write instead of a full-store scan (only events
         # carry TTLs; pods/nodes must not pay for them)
         self._expiry_heap: List[Tuple[float, str]] = []
+        # list-snapshot cache (the watch cache's LIST half,
+        # cacher.go:214): selector-free list() scans EVERY store entry
+        # and re-sorts per call — a 5k-node LIST against a 35k-entry
+        # store was most of that endpoint's warm latency. Cached sorted
+        # snapshots are invalidated per write via a resource-segment
+        # bucket (O(1) per write, no prefix scan).
+        self._list_cache: Dict[str, List[Any]] = {}
+        self._list_cache_seg: Dict[str, set] = {}
+        # resources that ever stored a TTL'd entry (events): their
+        # lists are never cached — expiry is passive, so a snapshot
+        # could serve an expired object with no write to invalidate it
+        self._ttl_segs: set = set()
 
     # ------------------------------------------------------------- helpers
 
@@ -76,9 +88,25 @@ class Store:
     def _expired(self, entry, now: float) -> bool:
         return entry[2] is not None and entry[2] <= now
 
+    @staticmethod
+    def _seg(key: str) -> str:
+        """'/registry/<resource>/' segment of a key — the invalidation
+        bucket for cached list snapshots."""
+        i = key.find("/", 10)  # first slash after "/registry/"
+        return key[:i + 1] if i > 0 else key
+
+    def _invalidate_lists(self, key: str) -> None:
+        """Drop cached list snapshots for the written key's resource
+        (caller holds the lock)."""
+        if not self._list_cache:
+            return
+        for p in self._list_cache_seg.pop(self._seg(key), ()):
+            self._list_cache.pop(p, None)
+
     def _record(self, rev: int, etype: str, key: str, obj: Any,
                 prev: Any) -> watchpkg.Event:
         """History-window bookkeeping for one committed write."""
+        self._invalidate_lists(key)
         if len(self._history) == self._history.maxlen:
             self._oldest_rev = self._history[0][0]
         self._history.append((rev, etype, key, obj, prev))
@@ -193,6 +221,7 @@ class Store:
             self._data[key] = (obj, rev, expiry)
             if expiry is not None:
                 heapq.heappush(self._expiry_heap, (expiry, key))
+                self._ttl_segs.add(self._seg(key))
             self._emit(rev, watchpkg.ADDED, key, obj, None)
             return obj
 
@@ -234,6 +263,7 @@ class Store:
                 self._data[key] = (obj, rev, expiry)
                 if expiry is not None:
                     heapq.heappush(self._expiry_heap, (expiry, key))
+                    self._ttl_segs.add(self._seg(key))
                 batch_events.append(
                     (key, self._record(rev, watchpkg.ADDED, key, obj, None),
                      None))
@@ -252,6 +282,7 @@ class Store:
             self._data[key] = (obj, rev, expiry)
             if expiry is not None:
                 heapq.heappush(self._expiry_heap, (expiry, key))
+                self._ttl_segs.add(self._seg(key))
             etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
             self._emit(rev, etype, key, obj, prev[0] if prev else None)
             return obj
@@ -373,8 +404,18 @@ class Store:
              ) -> Tuple[List[Any], int]:
         """All live objects under prefix, with the store revision at read
         time (the List + resourceVersion pair reflectors rely on,
-        ref: pkg/client/cache/reflector.go:225)."""
+        ref: pkg/client/cache/reflector.go:225). Selector-free lists of
+        resource-or-deeper prefixes serve from the snapshot cache; a
+        hit is consistent at the CURRENT revision because any write
+        under the prefix would have invalidated it (_record)."""
         with self._lock:
+            cacheable = (predicate is None and prefix.count("/") >= 3
+                         and self._seg(prefix) not in self._ttl_segs)
+            if cacheable:
+                cached = self._list_cache.get(prefix)
+                if cached is not None:
+                    # copy: callers filter/mutate their result lists
+                    return list(cached), self._rev
             now = time.time()
             items = [
                 e[0] for k, e in self._data.items()
@@ -383,6 +424,14 @@ class Store:
             if predicate is not None:
                 items = [o for o in items if predicate(o)]
             items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            if cacheable:
+                if len(self._list_cache) >= 64:
+                    self._list_cache.clear()
+                    self._list_cache_seg.clear()
+                self._list_cache[prefix] = items
+                self._list_cache_seg.setdefault(self._seg(prefix),
+                                                set()).add(prefix)
+                return list(items), self._rev
             return items, self._rev
 
     # ------------------------------------------------------------- watch

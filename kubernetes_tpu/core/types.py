@@ -52,6 +52,11 @@ def fast_replace(obj, **fields):
     new = object.__new__(type(obj))
     new.__dict__.update(obj.__dict__)
     new.__dict__.update(fields)
+    # a clone is a DIFFERENT object that still carries the original's
+    # resourceVersion until the store restamps it — serde.wire_json's
+    # rv-keyed fragment cache must not ride along or it would serve
+    # the original's bytes for the modified clone
+    new.__dict__.pop("_wire_json", None)
     return new
 
 

@@ -214,7 +214,7 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
         try:
             gc_ctx.__exit__(None, None, None)
         except NameError:
-            pass  # serial mode / failure before the tuning point
+            pass  # failure before the tuning point
         sched.stop()
         factory.stop()
         fleet.stop()

@@ -463,3 +463,45 @@ def test_create_batch_filtered_watch_sees_only_matching():
     assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "c0"
     assert w.next(timeout=0.1) is None
     w.stop()
+
+
+def test_list_snapshot_cache_semantics():
+    """The list-snapshot cache (cacher.go:214's LIST half) must be
+    invisible: identical results before/after caching, invalidated by
+    any write under the resource, and never engaged for TTL'd
+    resources (passive expiry has no invalidating write)."""
+    import time as _time
+
+    from kubernetes_tpu.core.store import Store
+    from kubernetes_tpu.core import types as api
+
+    s = Store()
+
+    def node(name):
+        return api.Node(metadata=api.ObjectMeta(name=name))
+
+    s.create("/registry/nodes/a", node("a"))
+    s.create("/registry/nodes/b", node("b"))
+    first, rev1 = s.list("/registry/nodes/")
+    again, rev2 = s.list("/registry/nodes/")   # cache hit
+    assert [o.metadata.name for o in again] == ["a", "b"]
+    assert rev2 == rev1
+    # the hit returns a fresh list object (callers mutate results)
+    again.append("sentinel")
+    assert len(s.list("/registry/nodes/")[0]) == 2
+    # a write under the prefix invalidates
+    s.create("/registry/nodes/c", node("c"))
+    assert [o.metadata.name for o in s.list("/registry/nodes/")[0]] == \
+        ["a", "b", "c"]
+    # a write under a DIFFERENT resource does not clobber correctness
+    s.create("/registry/services/default/x", api.Service(
+        metadata=api.ObjectMeta(name="x", namespace="default")))
+    assert len(s.list("/registry/nodes/")[0]) == 3
+    # TTL'd resources bypass the cache: expiry must be honored with
+    # no intervening write
+    s.create("/registry/events/default/e1", api.Event(
+        metadata=api.ObjectMeta(name="e1", namespace="default")),
+        ttl=0.05)
+    assert len(s.list("/registry/events/default/")[0]) == 1
+    _time.sleep(0.08)
+    assert len(s.list("/registry/events/default/")[0]) == 0

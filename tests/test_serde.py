@@ -1,0 +1,48 @@
+
+
+def test_encode_list_bytes_matches_encode_list():
+    """The fragment-assembled LIST bytes are exactly
+    json.dumps(encode_list(...)) — consumers must not be able to tell
+    the cache exists."""
+    import json
+
+    from kubernetes_tpu.core.scheme import default_scheme as s
+    from kubernetes_tpu.core import types as api
+
+    nodes = [api.Node(metadata=api.ObjectMeta(name=f"n{i}",
+                                              resource_version=str(i + 1)))
+             for i in range(5)]
+    expect = json.dumps(s.encode_list("Node", nodes, "42")).encode()
+    got = s.encode_list_bytes("Node", nodes, "42")
+    assert got == expect
+    # second pass serves from the per-object cache — still identical
+    assert s.encode_list_bytes("Node", nodes, "42") == expect
+    # empty list
+    assert s.encode_list_bytes("Node", [], "7") == \
+        json.dumps(s.encode_list("Node", [], "7")).encode()
+
+
+def test_wire_json_cache_invalidates_on_clone_and_restamp():
+    """A fast_replace clone shares metadata (same rv) but differs in
+    content — it must NOT inherit the original's cached fragment; an
+    in-place rv restamp must also invalidate."""
+    import json
+
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.core.serde import to_wire, wire_json
+
+    pod = api.Pod(metadata=api.ObjectMeta(name="p", namespace="d",
+                                          resource_version="5"),
+                  spec=api.PodSpec(containers=[
+                      api.Container(name="c", image="i")]))
+    first = wire_json(pod)
+    assert "_wire_json" in pod.__dict__
+    clone = api.fast_replace(pod, spec=api.fast_replace(
+        pod.spec, node_name="n1"))
+    assert "_wire_json" not in clone.__dict__
+    got = json.loads(wire_json(clone))
+    assert got["spec"]["nodeName"] == "n1"
+    # in-place restamp (the store's owned_meta path) changes rv -> miss
+    pod.metadata.resource_version = "6"
+    assert json.loads(wire_json(pod))["metadata"]["resourceVersion"] == "6"
+    assert wire_json(pod) != first or '"5"' not in first

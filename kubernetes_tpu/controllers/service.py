@@ -144,33 +144,46 @@ class RouteController:
             nodes, _ = self.client.list("nodes")
         except Exception:
             return 0
-        existing = {r.name: r for r in routes.list_routes()}
+        # reconcile by TARGET INSTANCE, not route name
+        # (routecontroller.go:73 routeMap[route.TargetInstance]): the
+        # route's cloud-side name is provider-internal — EC2 routes
+        # have none at all (identity = destination CIDR), GCE names
+        # are mangled — so node association is the only portable key
+        existing = routes.list_routes()
+        by_target = {r.target_instance: r for r in existing}
+        node_cidrs = {}
+        refreshed = set()  # targets re-created THIS pass: their stale
+        #                    entry in `existing` must not be GC'd again
         actions = 0
-        wanted = set()
         for node in nodes:
             if not node.spec.pod_cidr:
                 # no CIDR assigned yet: nothing to route (the reference
                 # waits for the node controller's CIDR allocation)
                 continue
-            name = f"route-{node.metadata.name}"
-            wanted.add(name)
+            name = node.metadata.name
             cidr = node.spec.pod_cidr
-            route = existing.get(name)
+            node_cidrs[name] = cidr
+            route = by_target.get(name)
             if route is None or route.destination_cidr != cidr:
                 if route is not None:
                     # CIDR reassigned: drop the stale route first — the
-                    # Routes contract doesn't promise overwrite-by-name
-                    routes.delete_route(name)
+                    # Routes contract doesn't promise overwrite
+                    routes.delete_route(route.name)
                 routes.create_route(Route(
-                    name=name, target_instance=node.metadata.name,
+                    name=f"route-{name}", target_instance=name,
                     destination_cidr=cidr))
+                refreshed.add(name)
                 actions += 1
-        for name, route in existing.items():
+        for route in existing:
+            if route.target_instance in refreshed:
+                continue
             # only GC routes INSIDE the cluster CIDR — operator routes
-            # are not ours to delete (routecontroller.go's filter)
-            if name not in wanted and \
+            # are not ours to delete (routecontroller.go
+            # isResponsibleForRoute)
+            if node_cidrs.get(route.target_instance) != \
+                    route.destination_cidr and \
                     self._in_cluster_cidr(route.destination_cidr):
-                routes.delete_route(name)
+                routes.delete_route(route.name)
                 actions += 1
         return actions
 

@@ -1,9 +1,13 @@
-"""Userspace-mode proxier: a real TCP proxy with round-robin balancing.
+"""Userspace-mode proxier: real TCP and UDP proxies with round-robin
+balancing.
 
-Reference: pkg/proxy/userspace/{proxier,roundrobin}.go — one listening
-socket per service port, NextEndpoint round-robins across the service's
-endpoints (with optional client-IP session affinity), bytes shuttled
-both ways. Functional in-process: connections really balance.
+Reference: pkg/proxy/userspace/{proxier,roundrobin,proxysocket}.go —
+one listening socket per service port, NextEndpoint round-robins across
+the service's endpoints (with optional client-IP session affinity).
+TCP shuttles bytes both ways per accepted connection; UDP tracks
+clients in a conntrack cache with an idle timeout (udpIdleTimeout,
+proxier.go:88,140) and pumps replies back through the service socket.
+Functional in-process: connections and datagrams really balance.
 """
 
 from __future__ import annotations
@@ -148,14 +152,125 @@ class _PortProxy:
             pass
 
 
+class _UdpPortProxy:
+    """One UDP service socket with per-client connection tracking.
+
+    Reference: proxysocket.go udpProxySocket + clientCache — datagrams
+    from a new client dial a balanced backend (a connected UDP socket);
+    replies pump back through the service socket to that client; an
+    idle client expires after udpIdleTimeout (proxier.go:88,140 — the
+    conntrack entry's lifetime) and its backend socket closes. DNS —
+    the canonical kubernetes service — rides this path."""
+
+    BUF = 4096  # proxysocket.go:199 whole-packet buffer
+
+    def __init__(self, balancer: RoundRobinLoadBalancer,
+                 key: Tuple[str, str, str], host: str = "127.0.0.1",
+                 port: int = 0, idle_timeout: float = 10.0):
+        self.balancer = balancer
+        self.key = key
+        self.idle_timeout = idle_timeout
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        # client addr -> connected backend socket (the clientCache)
+        self._clients: Dict[Tuple[str, int], socket.socket] = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def active_clients(self) -> int:
+        with self._lock:
+            return len(self._clients)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, cli = self.sock.recvfrom(self.BUF)
+            except OSError:
+                return
+            backend = self._backend_for(cli)
+            if backend is None:
+                continue  # no endpoints: drop, like the reference
+            try:
+                backend.send(data)
+            except OSError:
+                with self._lock:
+                    self._clients.pop(cli, None)
+
+    def _backend_for(self, cli: Tuple[str, int]
+                     ) -> Optional[socket.socket]:
+        with self._lock:
+            backend = self._clients.get(cli)
+            if backend is not None:
+                return backend
+            target = self.balancer.next_endpoint(self.key, cli[0])
+            if target is None:
+                return None
+            host, _, port = target.rpartition(":")
+            backend = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                backend.connect((host, int(port)))
+            except OSError:
+                backend.close()
+                return None
+            # the idle bound IS the conntrack TTL: each reply resets
+            # it; expiry closes the backend and forgets the client
+            backend.settimeout(self.idle_timeout)
+            self._clients[cli] = backend
+            threading.Thread(target=self._reply_pump,
+                             args=(cli, backend), daemon=True).start()
+            return backend
+
+    def _reply_pump(self, cli: Tuple[str, int],
+                    backend: socket.socket) -> None:
+        """(proxysocket.go proxyClient — replies ride the SERVICE
+        socket so they come from the address the client sent to)"""
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = backend.recv(self.BUF)
+                except socket.timeout:
+                    return  # idle conntrack expiry
+                if not data:
+                    return
+                self.sock.sendto(data, cli)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                if self._clients.get(cli) is backend:
+                    del self._clients[cli]
+            backend.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for backend in clients:
+            try:
+                backend.close()
+            except OSError:
+                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
 class UserspaceProxier:
     """(ref: userspace/proxier.go Proxier — OnServiceUpdate opens/closes
     port proxies; localhost ports stand in for the service portal IPs)"""
 
     def __init__(self, client=None,
-                 balancer: Optional[RoundRobinLoadBalancer] = None):
+                 balancer: Optional[RoundRobinLoadBalancer] = None,
+                 udp_idle_timeout: float = 10.0):
         self.balancer = balancer or RoundRobinLoadBalancer()
-        self._proxies: Dict[Tuple[str, str, str], _PortProxy] = {}
+        self.udp_idle_timeout = udp_idle_timeout
+        self._proxies: Dict[Tuple[str, str, str], object] = {}
         self._lock = threading.Lock()
         self._service_config = None
         self._endpoints_config = None
@@ -166,21 +281,30 @@ class UserspaceProxier:
                 client, self.balancer.on_endpoints_update)
 
     def on_service_update(self, services: List[api.Service]) -> None:
-        wanted: Dict[Tuple[str, str, str], api.Service] = {}
+        # proto rides the wanted-map so a port that changes protocol
+        # (proxier.go treats that as close-and-reopen) gets a fresh
+        # proxy of the right kind
+        wanted: Dict[Tuple[str, str, str], str] = {}
         for svc in services:
             for port in svc.spec.ports:
                 key = (svc.metadata.namespace, svc.metadata.name,
                        port.name or "")
-                wanted[key] = svc
+                wanted[key] = (port.protocol or "TCP").upper()
                 self.balancer.set_session_affinity(
                     key, svc.spec.session_affinity == "ClientIP")
         with self._lock:
-            for key in list(self._proxies):
-                if key not in wanted:
+            for key, proxy in list(self._proxies.items()):
+                is_udp = isinstance(proxy, _UdpPortProxy)
+                want = wanted.get(key)
+                if want is None or (want == "UDP") != is_udp:
                     self._proxies.pop(key).close()
-            for key in wanted:
+            for key, proto in wanted.items():
                 if key not in self._proxies:
-                    self._proxies[key] = _PortProxy(self.balancer, key)
+                    self._proxies[key] = (
+                        _UdpPortProxy(self.balancer, key,
+                                      idle_timeout=self.udp_idle_timeout)
+                        if proto == "UDP"
+                        else _PortProxy(self.balancer, key))
 
     def port_for(self, namespace: str, name: str, port_name: str = ""
                  ) -> Optional[int]:

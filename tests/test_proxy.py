@@ -187,3 +187,161 @@ class TestUserspaceProxy:
         finally:
             srv_a.close()
             srv_b.close()
+
+
+def udp_svc(name, port=53, port_name="dns"):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ServiceSpec(
+            cluster_ip="10.0.0.53",
+            ports=[api.ServicePort(name=port_name, port=port,
+                                   protocol="UDP")]))
+
+
+class _UdpEcho:
+    """The reference's own UDP test pattern (proxier_test.go
+    udpEchoServer): echo each datagram back prefixed with the server's
+    identity so balancing is observable."""
+
+    def __init__(self, tag):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.tag = tag
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            try:
+                data, addr = self.sock.recvfrom(4096)
+            except OSError:
+                return
+            self.sock.sendto(self.tag.encode() + b":" + data, addr)
+
+    def close(self):
+        self.sock.close()
+
+
+class TestUdpProxy:
+    """UDP service proxying (ref: pkg/proxy/userspace/proxier.go:88,140
+    udpIdleTimeout conntrack + proxysocket.go udpProxySocket; DNS — the
+    canonical kubernetes service — is UDP)."""
+
+    def _roundtrip(self, sock, port, payload, timeout=5.0):
+        sock.sendto(payload, ("127.0.0.1", port))
+        sock.settimeout(timeout)
+        data, _ = sock.recvfrom(4096)
+        return data
+
+    def test_udp_echo_round_trip_and_client_pinning(self):
+        e1, e2 = _UdpEcho("srv1"), _UdpEcho("srv2")
+        try:
+            p = UserspaceProxier(udp_idle_timeout=5.0)
+            p.balancer.on_endpoints_update([
+                eps("dns", ["127.0.0.1"], port=e1.port, port_name="dns"),
+            ])
+            # two distinct backends need distinct ips normally; with
+            # loopback-only tests, point the subsets at both ports
+            p.balancer._endpoints[("default", "dns", "dns")] = [
+                f"127.0.0.1:{e1.port}", f"127.0.0.1:{e2.port}"]
+            p.on_service_update([udp_svc("dns")])
+            port = p.port_for("default", "dns", "dns")
+            assert port
+
+            c1 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            c2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                r1a = self._roundtrip(c1, port, b"one")
+                # the conntrack entry pins a client to its backend
+                # (clientCache) — every datagram from c1 lands on the
+                # SAME server
+                r1b = self._roundtrip(c1, port, b"two")
+                assert r1a.split(b":")[0] == r1b.split(b":")[0]
+                assert r1a.endswith(b":one") and r1b.endswith(b":two")
+                # a second client round-robins to the OTHER backend
+                r2 = self._roundtrip(c2, port, b"three")
+                assert r2.split(b":")[0] != r1a.split(b":")[0]
+            finally:
+                c1.close()
+                c2.close()
+                p.stop()
+        finally:
+            e1.close()
+            e2.close()
+
+    def test_udp_idle_timeout_expires_conntrack(self):
+        e1 = _UdpEcho("srv1")
+        try:
+            p = UserspaceProxier(udp_idle_timeout=0.25)  # proxier_test.go
+            #                      shrinks udpIdleTimeout the same way
+            p.balancer.on_endpoints_update([
+                eps("dns", ["127.0.0.1"], port=e1.port, port_name="dns")])
+            p.on_service_update([udp_svc("dns")])
+            port = p.port_for("default", "dns", "dns")
+            proxy = p._proxies[("default", "dns", "dns")]
+
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                assert self._roundtrip(c, port, b"hi").endswith(b":hi")
+                assert proxy.active_clients() == 1
+                deadline = time.time() + 5
+                while proxy.active_clients() and time.time() < deadline:
+                    time.sleep(0.05)
+                assert proxy.active_clients() == 0, \
+                    "idle conntrack entry never expired"
+                # a fresh datagram re-dials transparently
+                assert self._roundtrip(c, port, b"again").endswith(
+                    b":again")
+            finally:
+                c.close()
+                p.stop()
+        finally:
+            e1.close()
+
+    def test_udp_service_without_endpoints_drops(self):
+        p = UserspaceProxier()
+        p.on_service_update([udp_svc("dns")])
+        port = p.port_for("default", "dns", "dns")
+        c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            c.sendto(b"void", ("127.0.0.1", port))
+            c.settimeout(0.3)
+            with pytest.raises(socket.timeout):
+                c.recvfrom(4096)
+        finally:
+            c.close()
+            p.stop()
+
+    def test_protocol_change_reopens_proxy(self):
+        """A port flipping TCP<->UDP must get a fresh proxy of the
+        right kind (proxier.go close-and-reopen semantics)."""
+        p = UserspaceProxier()
+        tcp_svc = svc("flip", "10.0.0.9", port_name="p")
+        p.on_service_update([tcp_svc])
+        first = p._proxies[("default", "flip", "p")]
+        udp = api.Service(
+            metadata=api.ObjectMeta(name="flip", namespace="default"),
+            spec=api.ServiceSpec(cluster_ip="10.0.0.9", ports=[
+                api.ServicePort(name="p", port=80, protocol="UDP")]))
+        p.on_service_update([udp])
+        second = p._proxies[("default", "flip", "p")]
+        assert first is not second
+        assert second.active_clients() == 0  # it's the UDP kind
+        p.stop()
+
+    def test_iptables_udp_dnat_rules(self):
+        """The iptables mode DNATs UDP services with -p udp matchers
+        (the reference's nodeports/clusterIP rules are per-protocol)."""
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([udp_svc("dns")])
+        p.on_endpoints_update([eps("dns", ["10.244.0.2"], port=5353,
+                                   port_name="dns")])
+        chain = service_chain("default", "dns", "dns")
+        jumps = ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        assert any("udp" in r and "10.0.0.53/32" in r and chain in r
+                   for r in jumps)
+        dnats = [r for c in ipt.list_chains(TABLE_NAT)
+                 if c.startswith("KUBE-SEP-")
+                 for r in ipt.list_rules(TABLE_NAT, c) if "DNAT" in r]
+        assert any("udp" in r and "10.244.0.2:5353" in r for r in dnats)

@@ -333,6 +333,30 @@ def run_kubectl(argv: List[str]) -> int:
     return kubectl_main(argv)
 
 
+def run_migrate_storage(argv: List[str]) -> int:
+    """Rewrite every stored object through the current codec against a
+    live apiserver (ref: hack/test-update-storage-objects.sh — the
+    kubectl get | kubectl replace loop; kubernetes_tpu serves one wire
+    version, so this normalizes legacy/unknown fields rather than
+    converting between versions — core/migrate.py)."""
+    import json as _json
+
+    p = argparse.ArgumentParser(prog="migrate-storage")
+    p.add_argument("--master", required=True)
+    p.add_argument("--resources", default="",
+                   help="comma-separated subset (default: everything)")
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .core.migrate import migrate_via_api
+
+    _wait_for_master(args.master)
+    resources = [r for r in args.resources.split(",") if r] or None
+    report = migrate_via_api(HttpClient(args.master), resources)
+    print(_json.dumps(report.as_dict()))
+    return 1 if report.failed else 0
+
+
 COMPONENTS = {
     "apiserver": run_apiserver,
     "kube-apiserver": run_apiserver,
@@ -345,6 +369,7 @@ COMPONENTS = {
     "proxy": run_proxy,
     "kube-proxy": run_proxy,
     "kubectl": run_kubectl,
+    "migrate-storage": run_migrate_storage,
 }
 
 

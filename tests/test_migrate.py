@@ -1,0 +1,113 @@
+"""Storage-version migration (ref: hack/test-update-storage-objects.sh
++ pkg/conversion): every stored object re-encoded through the current
+codec, with a transform hook for true shape changes. The native-store
+case is the real one — it holds serialized bytes, so a legacy JSON
+shape written by an 'older build' must come out normalized."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.migrate import (migratable_resources,
+                                         migrate_store, migrate_via_api)
+from kubernetes_tpu.core.store import Store
+
+
+def _pod(name, labels=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")]))
+
+
+def test_migrate_python_store_rewrites_and_bumps_rv():
+    registry = Registry()
+    client = InProcClient(registry)
+    created = client.create("pods", _pod("p1"))
+    client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+    report = migrate_store(registry.store)
+    assert report.scanned == report.rewritten == 2
+    assert not report.failed
+    assert report.by_prefix == {"pods": 1, "nodes": 1}
+    after = client.get("pods", "p1", "default")
+    assert int(after.metadata.resource_version) > \
+        int(created.metadata.resource_version)
+    # idempotent: a second run rewrites again, no semantic change
+    report2 = migrate_store(registry.store)
+    assert report2.rewritten == 2 and not report2.failed
+
+
+def test_migrate_applies_transform():
+    """The transform hook is the conversion function's seat — e.g. a
+    label rename across 'versions'."""
+    registry = Registry()
+    client = InProcClient(registry)
+    client.create("pods", _pod("p1", labels={"old-tier": "web"}))
+
+    def rename_label(obj):
+        if getattr(obj.metadata, "labels", {}).get("old-tier"):
+            labels = dict(obj.metadata.labels)
+            labels["tier"] = labels.pop("old-tier")
+            return api.fast_replace(
+                obj, metadata=api.fast_replace(obj.metadata,
+                                               labels=labels))
+        return obj
+
+    report = migrate_store(registry.store, transform=rename_label)
+    assert report.rewritten >= 1
+    after = client.get("pods", "p1", "default")
+    assert after.metadata.labels == {"tier": "web"}
+
+
+def test_migrate_native_store_normalizes_legacy_bytes():
+    """The real storage rewrite: raw JSON with a legacy unknown field
+    (written by an 'older build') sits in the native store; migration
+    re-encodes it in the current shape."""
+    from kubernetes_tpu.core.native_store import (NativeStore,
+                                                  native_available)
+    if not native_available():
+        pytest.skip("no native toolchain")
+
+    store = NativeStore()
+    legacy = {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "old-pod", "namespace": "default",
+                     "uid": "u-1"},
+        "spec": {"containers": [{"name": "c", "image": "i"}],
+                 "legacyHostDir": "/data"},   # dropped field of yore
+        "currentState": {"status": "Running"},  # pre-v1 status block
+    }
+    raw = json.dumps(legacy).encode()
+    key = b"/registry/pods/default/old-pod"
+    rev = store._lib.kv_create(store._h, key, raw, len(raw), 0.0)
+    assert rev > 0
+
+    report = migrate_store(store, resources=["pods"])
+    assert report.rewritten == 1, report.as_dict()
+    stored, _rev = store._get_raw(key.decode())
+    data = json.loads(stored)
+    assert "legacyHostDir" not in data.get("spec", {})
+    assert "currentState" not in data
+    assert data["metadata"]["name"] == "old-pod"
+    # and the object reads back as a current-shape Pod
+    pod = store.get(key.decode())
+    assert pod.spec.containers[0].image == "i"
+
+
+def test_migrate_via_api_replaces_everything():
+    registry = Registry()
+    client = InProcClient(registry)
+    client.create("pods", _pod("p1"))
+    client.create("services", api.Service(
+        metadata=api.ObjectMeta(name="svc", namespace="default"),
+        spec=api.ServiceSpec(selector={"a": "b"})))
+    report = migrate_via_api(client)
+    assert report.scanned >= 2
+    # every scanned object PUT back (the default namespace object and
+    # any auto-provisioned companions ride along)
+    assert report.rewritten == report.scanned
+    assert not report.failed
+    assert "componentstatuses" not in migratable_resources()

@@ -72,7 +72,7 @@ def migrate_store(store, transform: Optional[Callable] = None,
     layout and is stored AS the carrier type, so the standard walk
     covers the declarations while custom objects re-encode through
     their carrier on read.)"""
-    from ..api.registry import RESOURCES
+    from ..api.registry import RESOURCES, Registry
 
     report = MigrationReport()
     for seg in (resources or migratable_resources()):
@@ -80,13 +80,18 @@ def migrate_store(store, transform: Optional[Callable] = None,
         if info is None:
             report.failed.append(f"{seg}: unknown resource")
             continue
-        items, _rev = store.list(f"/registry/{seg}/")
+        try:
+            items, _rev = store.list(f"/registry/{seg}/")
+        except Exception as e:
+            # a corrupt value fails the whole segment's decode (list
+            # is the only enumeration the store API affords) — report
+            # it and KEEP WALKING the other resources
+            report.failed.append(f"/registry/{seg}/: list: {e!r}")
+            continue
         for obj in items:
             report.scanned += 1
             meta = obj.metadata
-            # the registry's one key layout (Registry.key): cluster-
-            # scoped objects carry an empty namespace segment
-            key = f"/registry/{seg}/{meta.namespace}/{meta.name}"
+            key = Registry.key(seg, meta.namespace, meta.name)
             try:
                 def rewrite(cur, _t=transform):
                     return _t(cur) if _t is not None else cur
@@ -96,7 +101,50 @@ def migrate_store(store, transform: Optional[Callable] = None,
                 report.by_prefix[seg] = report.by_prefix.get(seg, 0) + 1
             except Exception as e:  # keep walking; report stragglers
                 report.failed.append(f"{key}: {e!r}")
+    # custom-object data rides its own /registry/thirdparty/ layout
+    # (registry.third_party_key): enumerate via the stored TPR
+    # declarations so at-rest custom resources get rewritten too
+    if resources is None:
+        _migrate_third_party(store, transform, report)
     return report
+
+
+def _migrate_third_party(store, transform, report: MigrationReport
+                         ) -> None:
+    from ..api.registry import extract_group_and_kind
+
+    try:
+        tprs, _ = store.list("/registry/thirdpartyresources/")
+    except Exception as e:
+        report.failed.append(f"thirdpartyresources: list: {e!r}")
+        return
+    for tpr in tprs:
+        try:
+            _kind, group, plural = extract_group_and_kind(tpr)
+        except Exception as e:
+            report.failed.append(
+                f"tpr {tpr.metadata.name}: {e!r}")
+            continue
+        prefix = f"/registry/thirdparty/{group}/{plural}/"
+        try:
+            items, _ = store.list(prefix)
+        except Exception as e:
+            report.failed.append(f"{prefix}: list: {e!r}")
+            continue
+        for obj in items:
+            report.scanned += 1
+            meta = obj.metadata
+            key = f"{prefix}{meta.namespace}/{meta.name}"
+            try:
+                def rewrite(cur, _t=transform):
+                    return _t(cur) if _t is not None else cur
+
+                store.guaranteed_update(key, rewrite)
+                report.rewritten += 1
+                report.by_prefix["thirdparty"] = \
+                    report.by_prefix.get("thirdparty", 0) + 1
+            except Exception as e:
+                report.failed.append(f"{key}: {e!r}")
 
 
 def migrate_via_api(client, resources: Optional[List[str]] = None

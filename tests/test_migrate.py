@@ -111,3 +111,50 @@ def test_migrate_via_api_replaces_everything():
     assert report.rewritten == report.scanned
     assert not report.failed
     assert "componentstatuses" not in migratable_resources()
+
+
+def test_migrate_covers_third_party_data_and_survives_corruption():
+    """Custom-object data under /registry/thirdparty/ is rewritten too
+    (its own storage layout), and a corrupt segment reports + keeps
+    walking instead of aborting the whole migration."""
+    registry = Registry()
+    client = InProcClient(registry)
+    client.create("thirdpartyresources", api.ThirdPartyResource(
+        metadata=api.ObjectMeta(name="cron-tab.example.com"),
+        versions=[api.APIVersionEntry(name="v1")]))
+    registry.third_party_create(
+        "example.com", "crontabs",
+        api.ThirdPartyResourceData(
+            metadata=api.ObjectMeta(name="job1", namespace="default"),
+            data={"spec": {"cron": "* * * * *"}}),
+        "default")
+    client.create("pods", _pod("p1"))
+
+    seen = []
+
+    def spy(obj):
+        seen.append(type(obj).__name__)
+        return obj
+
+    report = migrate_store(registry.store, transform=spy)
+    assert report.by_prefix.get("thirdparty") == 1
+    assert "ThirdPartyResourceData" in seen
+    assert not report.failed
+
+    # a store whose pods segment raises must not abort nodes/others
+    class BrokenList:
+        def __init__(self, store):
+            self._s = store
+
+        def list(self, prefix, predicate=None):
+            if prefix.startswith("/registry/pods/"):
+                raise ValueError("corrupt value in segment")
+            return self._s.list(prefix, predicate)
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+    report2 = migrate_store(BrokenList(registry.store))
+    assert any("corrupt" in f for f in report2.failed)
+    assert report2.by_prefix.get("thirdpartyresources") == 1
+    assert report2.rewritten >= 2  # tpr decl + custom object survived

@@ -1,0 +1,16 @@
+"""Soak smoke (ref: test/soak/): steady-state churn with hard leak
+gates — RSS, watcher list, store keys, tombstones, threads must hold
+between the warm baseline and the end. CI runs a shortened window with
+a small watch-history budget (so the window's by-design fill finishes
+before the baseline); the full 10-minute default-window figure runs
+via `python -m kubernetes_tpu.kubemark.soak` (SOAK.json artifact)."""
+
+from kubernetes_tpu.kubemark.soak import run_soak
+
+
+def test_soak_smoke_bounded_state():
+    r = run_soak(duration_s=45.0, n_nodes=100, pods_per_cycle=100,
+                 sample_every_s=2.0, history_window=10_000)
+    assert r.cycles >= 2, (r.cycles, r.duration_s)
+    assert r.pods_churned >= 200
+    r.check()  # the leak gates ARE the test

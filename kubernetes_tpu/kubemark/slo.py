@@ -136,9 +136,14 @@ class SLOResult:
 
 def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
                     timeout_s: float = 600.0,
-                    max_pods_per_node: int = 40) -> SLOResult:
+                    max_pods_per_node: int = 40,
+                    node_cpu: str = "4") -> SLOResult:
     """Stand up master-over-HTTP + hollow fleet + batch scheduler, blast
-    pods, and measure the two SLO families until every pod is Running."""
+    pods, and measure the two SLO families until every pod is Running.
+    node_cpu scales the hollow nodes for the high density tiers (100
+    bench pods x 100m does not fit a 4-CPU node; the reference's
+    50/100-pods-per-node tiers run on clusters sized for them,
+    density.go:203-208)."""
     registry = Registry()
     metrics = MetricsRegistry()   # per-run registry: no cross-run mixing
     server = ApiServer(registry, port=0, metrics=metrics).start()
@@ -149,7 +154,7 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
     # real deployment; the HTTP surface under measurement is the one
     # the pod writers and probers hit, as in the reference's density
     # run where the e2e client measures the apiserver)
-    fleet = HollowFleet(inproc, n_nodes, cpu="4", memory="32Gi",
+    fleet = HollowFleet(inproc, n_nodes, cpu=node_cpu, memory="32Gi",
                         max_pods=max_pods_per_node,
                         heartbeat_interval=60.0).run()
     factory = ConfigFactory(inproc, rate_limit=False).start()

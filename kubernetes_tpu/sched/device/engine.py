@@ -543,9 +543,37 @@ class BatchEngine:
         mask, _ = self.probe(enc)
         return np.asarray(mask[:enc.n_pods]).astype(bool)
 
+    @property
+    def spans_processes(self) -> bool:
+        """True when the mesh crosses OS processes (multi-host: each
+        process owns a slice of the global device set — the DCN
+        deployment shape; jax.distributed must be initialized)."""
+        return self.mesh is not None and jax.process_count() > 1
+
+    def _place_global(self, args):
+        """Host pytrees -> global jax.Arrays for a multi-process mesh.
+
+        Single-process jit accepts host numpy and shards it; across
+        processes the committed arrays span non-addressable devices,
+        so each process must contribute its addressable shards
+        explicitly. Every process runs the SAME encode (the scheduler
+        replicates host state, exactly like multi-host data loading
+        where each host materializes its slice), so the callback just
+        serves the local index windows of the shared host array."""
+        shardings = _node_shardings(self.mesh, self.node_axis)
+
+        def put(host, sh):
+            host = np.asarray(host)
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx, _h=host: _h[idx])
+
+        return jax.tree_util.tree_map(put, args, shardings)
+
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
         """-> (assigned node indices i32[P] (-1 = no fit), final state)."""
         node, state, pods = self.device_args(enc)
+        if self.spans_processes:
+            node, state, pods = self._place_global((node, state, pods))
         run = self._get_run(*self._enc_flags(enc))
         final_state, assigned = run(node, state, pods)
         return np.asarray(assigned), final_state

@@ -1,0 +1,23 @@
+"""Multi-HOST device mesh: the sharded scheduling scan across OS
+processes joined by jax.distributed (gloo collectives on CPU — the
+same jax.distributed + Mesh code path multi-host TPU pods use, with
+ICI/DCN as the transport). Complements dryrun_multichip's
+single-process virtual mesh: here the argmax genuinely reduces across
+process boundaries and bindings must stay bit-equal."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_mesh_binding_parity():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "dryrun_multihost.py"),
+         "--procs", "2"],
+        capture_output=True, text=True, timeout=360, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert '"multihost_dryrun_ok": true' in out.stdout, out.stdout

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Multi-HOST dryrun: the sharded scheduling scan across OS processes.
+
+dryrun_multichip proves the single-process multi-device mesh; this
+proves the DCN shape — two (or more) separate processes, each owning a
+slice of the global device set, joined by jax.distributed into ONE
+mesh. The batch engine's node-axis sharding then makes its per-step
+argmax reduce ACROSS processes (gloo collectives on CPU, the exact
+lowering slot ICI/DCN collectives fill on real multi-host TPU — the
+jax.distributed + Mesh code path is identical, only the transport
+differs). Bindings are asserted bit-equal to a single-process run of
+the same encode.
+
+Launcher:  python tools/dryrun_multihost.py [--procs 2]
+Worker:    python tools/dryrun_multihost.py --worker <id> --procs N \
+               --port P   (spawned by the launcher)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEVICES_PER_PROC = 4
+
+
+def worker(proc_id: int, nprocs: int, port: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={DEVICES_PER_PROC}"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs, process_id=proc_id)
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from __graft_entry__ import _tiny_snapshot_inline
+    from kubernetes_tpu.sched.device import BatchEngine, encode_snapshot
+
+    n_global = jax.device_count()
+    assert n_global == nprocs * DEVICES_PER_PROC, n_global
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engine = BatchEngine(mesh=mesh)
+    assert engine.spans_processes
+
+    # identical encode on every host (deterministic snapshot) — the
+    # replicated-host-state model of a real multi-host scheduler
+    snap = _tiny_snapshot_inline(n_nodes=2 * n_global, n_pending=12)
+    enc = encode_snapshot(snap, node_pad_to=n_global)
+    assigned, _state = engine.run(enc)
+    assigned = np.asarray(assigned[:enc.n_pods])
+
+    # single-process reference: same encode, no mesh, local device
+    single = BatchEngine()
+    expect, _ = single.run(enc)
+    expect = np.asarray(expect[:enc.n_pods])
+    assert np.array_equal(assigned, expect), (assigned, expect)
+    assert int((assigned >= 0).sum()) > 0, "nothing scheduled"
+    print(f"WORKER-{proc_id}-PARITY-OK "
+          f"{json.dumps(assigned.tolist())}", flush=True)
+
+
+def launch(nprocs: int) -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(i), "--procs", str(nprocs), "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+        for i in range(nprocs)]
+    outs = []
+    ok = True
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            ok = False
+        outs.append(out)
+        if p.returncode != 0 or f"WORKER-{i}-PARITY-OK" not in out:
+            ok = False
+            print(f"worker {i} rc={p.returncode}\n{err[-2000:]}",
+                  file=sys.stderr)
+    # every process must agree on the bindings (the scan's argmax
+    # reduced across processes — divergence means a broken collective)
+    lines = [line for out in outs for line in out.splitlines()
+             if "PARITY-OK" in line]
+    payloads = {line.split(" ", 1)[1] for line in lines}
+    if len(payloads) != 1:
+        ok = False
+        print(f"processes disagree: {payloads}", file=sys.stderr)
+    print(json.dumps({"multihost_dryrun_ok": ok, "processes": nprocs,
+                      "global_devices": nprocs * DEVICES_PER_PROC}))
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", type=int, default=None)
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    if args.worker is not None:
+        worker(args.worker, args.procs, args.port)
+        return 0
+    return launch(args.procs)
+
+
+if __name__ == "__main__":
+    main()

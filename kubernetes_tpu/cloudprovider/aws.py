@@ -223,7 +223,17 @@ class AwsLoadBalancers(LoadBalancers):
             raise
         return root.find(".//LoadBalancerDescriptions/member")
 
-    def _lb_of(self, desc: ET.Element, region: str) -> LoadBalancer:
+    def _id_to_node_map(self) -> Dict[str, str]:
+        out = {}
+        for inst in self._i._describe():
+            iid = inst.findtext("instanceId")
+            if iid:
+                out[iid] = inst.findtext("privateDnsName") or iid
+        return out
+
+    def _lb_of(self, desc: ET.Element, region: str,
+               id_to_node: Optional[Dict[str, str]] = None
+               ) -> LoadBalancer:
         name = desc.findtext("LoadBalancerName") or ""
         ports = sorted(int(p.text) for p in desc.findall(
             ".//ListenerDescriptions/member/Listener/LoadBalancerPort"))
@@ -233,12 +243,10 @@ class AwsLoadBalancers(LoadBalancers):
         # service controller diffs them against node names to decide
         # whether to reconcile) — map ELB's instance IDs back, like
         # aws.go's instance<->node translation everywhere at the API
-        # boundary
-        id_to_node = {}
-        for inst in self._i._describe():
-            iid = inst.findtext("instanceId")
-            if iid:
-                id_to_node[iid] = inst.findtext("privateDnsName") or iid
+        # boundary; list() shares one DescribeInstances across all
+        # LBs instead of N+1 calls per sync
+        if id_to_node is None:
+            id_to_node = self._id_to_node_map()
         return LoadBalancer(
             name=name, region=region,
             external_ip=desc.findtext("DNSName") or "",
@@ -252,8 +260,10 @@ class AwsLoadBalancers(LoadBalancers):
 
     def list(self) -> List[LoadBalancer]:
         root = self._c.call("elb", "DescribeLoadBalancers")
-        return [self._lb_of(d, self._c.region) for d in
-                root.findall(".//LoadBalancerDescriptions/member")]
+        members = root.findall(".//LoadBalancerDescriptions/member")
+        id_to_node = self._id_to_node_map() if members else {}
+        return [self._lb_of(d, self._c.region, id_to_node)
+                for d in members]
 
     def _ensure_security_group(self, name: str, ports: List[int]) -> str:
         """(aws.go:1493 ensureSecurityGroup + :1385 ingress rules —
@@ -271,19 +281,23 @@ class AwsLoadBalancers(LoadBalancers):
             root = self._c.call("ec2", "DescribeSecurityGroups", {
                 "Filter": [{"Name": "group-name", "Value": [sg_name]}]})
             sg_id = root.findtext(".//securityGroupInfo/item/groupId") or ""
-        perms = [{"IpProtocol": "tcp", "FromPort": p, "ToPort": p,
-                  "IpRanges": {"item": [{"CidrIp": "0.0.0.0/0"}]}}
-                 for p in ports]
-        try:
-            self._c.call("ec2", "AuthorizeSecurityGroupIngress", {
-                "GroupId": sg_id, "IpPermissions": {"item": perms}})
-        except AwsError as e:
-            # re-ensuring over a leftover group (delete() tolerates SG
-            # cleanup races, so orphans are an expected state) finds
-            # the rules already present — that IS the desired state
-            # (aws.go ensureSecurityGroupIngress treats it as success)
-            if "InvalidPermission.Duplicate" not in str(e):
-                raise
+        # one authorize per port, each tolerating Duplicate: EC2 fails
+        # a whole multi-permission authorize when ANY rule pre-exists,
+        # and re-ensuring over a leftover group (delete() tolerates SG
+        # cleanup races) or a listener change must still land the NEW
+        # ports (aws.go ensureSecurityGroupIngress treats
+        # already-present as success)
+        for p in ports:
+            try:
+                self._c.call("ec2", "AuthorizeSecurityGroupIngress", {
+                    "GroupId": sg_id, "IpPermissions": {"item": [
+                        {"IpProtocol": "tcp", "FromPort": p,
+                         "ToPort": p,
+                         "IpRanges": {"item": [
+                             {"CidrIp": "0.0.0.0/0"}]}}]}})
+            except AwsError as e:
+                if "InvalidPermission.Duplicate" not in str(e):
+                    raise
         return sg_id
 
     def ensure(self, name: str, region: str, ports: List[int],
@@ -295,7 +309,26 @@ class AwsLoadBalancers(LoadBalancers):
             raise AwsError(
                 f"requested load balancer region {region!r} does not "
                 f"match cluster region {self._c.region!r}")  # :1630
-        if self._describe(name) is not None:
+        desc = self._describe(name)
+        if desc is not None:
+            have_ports = sorted(int(p.text) for p in desc.findall(
+                ".//ListenerDescriptions/member/Listener"
+                "/LoadBalancerPort"))
+            if have_ports != sorted(ports):
+                # listener reconcile (aws.go:1690-1744: the reference
+                # diffs listeners and deletes/creates them through the
+                # ELB listener verbs)
+                if have_ports:
+                    self._c.call("elb", "DeleteLoadBalancerListeners", {
+                        "LoadBalancerName": name,
+                        "LoadBalancerPorts": {"member": have_ports}})
+                self._c.call("elb", "CreateLoadBalancerListeners", {
+                    "LoadBalancerName": name,
+                    "Listeners": {"member": [
+                        {"Protocol": "TCP", "LoadBalancerPort": p,
+                         "InstanceProtocol": "TCP", "InstancePort": p}
+                        for p in ports]}})
+                self._ensure_security_group(name, ports)
             self.update_hosts(name, region, hosts)
             got = self.get(name, region)
             assert got is not None

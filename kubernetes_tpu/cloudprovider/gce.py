@@ -239,10 +239,17 @@ class GceLoadBalancers(LoadBalancers):
         allowing the service ports; each mutation is an async op)"""
         existing = self.get(name, region)
         if existing is not None:
-            self.update_hosts(name, region, hosts)
-            got = self.get(name, region)
-            assert got is not None
-            return got
+            if sorted(existing.ports) != sorted(ports):
+                # a forwarding rule's port range is immutable — the
+                # reference deletes and recreates on mismatch
+                # (gce.go:500 forwardingRuleNeedsUpdate -> :427 delete
+                # + recreate path)
+                self.delete(name, region)
+            else:
+                self.update_hosts(name, region, hosts)
+                got = self.get(name, region)
+                assert got is not None
+                return got
         if not ports:
             raise GceError("no ports specified for GCE load balancer")
         port_range = f"{min(ports)}-{max(ports)}"  # gce.go:616-637

@@ -71,8 +71,11 @@ class ServiceController:
                 continue
             wanted.add(lb_name)
             lb = balancers.get(lb_name, region)
-            ports = [p.port for p in svc.spec.ports]
-            if lb is None or lb.ports != ports or lb.hosts != hosts:
+            # order-insensitive: providers report ports sorted (ELB
+            # listeners and GCE rules have no spec order to preserve)
+            ports = sorted(p.port for p in svc.spec.ports)
+            if lb is None or sorted(lb.ports) != ports \
+                    or lb.hosts != hosts:
                 lb = balancers.ensure(lb_name, region, ports, hosts)
                 actions += 1
             ingress = [lb.external_ip]

@@ -35,6 +35,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from .errors import NotFound
+
 REGISTRY_PREFIX = "/registry/"
 
 
@@ -99,6 +101,11 @@ def migrate_store(store, transform: Optional[Callable] = None,
                 store.guaranteed_update(key, rewrite)
                 report.rewritten += 1
                 report.by_prefix[seg] = report.by_prefix.get(seg, 0) + 1
+            except NotFound:
+                # deleted (or TTL-expired: events) between list and
+                # rewrite — the race migrate_via_api also tolerates;
+                # a gone object needs no migration
+                pass
             except Exception as e:  # keep walking; report stragglers
                 report.failed.append(f"{key}: {e!r}")
     # custom-object data rides its own /registry/thirdparty/ layout

@@ -389,11 +389,13 @@ class KubeletServer:
             return self._raw(h, 400, b"?port= required", "text/plain")
         host = query.get("host", ["127.0.0.1"])[0]
         # node-local only: loopback plus this kubelet's own bind
-        # address (the master's tunneler dials the node's registered
+        # ADDRESS (the master's tunneler dials the node's registered
         # address — a kubelet bound to its InternalIP is not reachable
-        # as 127.0.0.1 even from itself)
-        if host not in ("127.0.0.1", "localhost", "::1", self.host,
-                        self.node_name):
+        # as 127.0.0.1 even from itself). The node NAME is
+        # deliberately NOT accepted: it would be resolved through DNS
+        # at dial time, and a name that resolves elsewhere would turn
+        # this endpoint into an open proxy
+        if host not in ("127.0.0.1", "localhost", "::1", self.host):
             return self._raw(h, 403,
                              b"tunnel targets are node-local only",
                              "text/plain")

@@ -256,6 +256,11 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
                       for n in running_at if n in created_at)
 
     # ---- server-side API latency read-out (us -> s) ----
+    # ':batch' endpoints are REPORTED in api_verbs but excluded from
+    # both the gate (api_ok) and the merged all-traffic percentiles —
+    # one 128-pod batch POST is not a single-request sample, and the
+    # merged number doubles as api_ok's fallback when no endpoint
+    # reaches the per-endpoint sample floor
     verb_stats: Dict[str, dict] = {}
     merged: List[float] = []
     for labels, stats in metrics.summary_stats(LATENCY_METRIC).items():
@@ -266,10 +271,14 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
             "p50_ms": round(stats["p50"] / 1e3, 2),
             "p90_ms": round(stats["p90"] / 1e3, 2),
             "p99_ms": round(stats["p99"] / 1e3, 2)}
-    for samples in metrics.summary_samples(LATENCY_METRIC).values():
+    for labels, samples in metrics.summary_samples(
+            LATENCY_METRIC).items():
+        if dict(labels).get("resource", "").endswith(":batch"):
+            continue
         merged.extend(samples)
     merged.sort()
-    total_calls = sum(v["count"] for v in verb_stats.values())
+    total_calls = sum(v["count"] for k, v in verb_stats.items()
+                      if not k.endswith(":batch"))
 
     return SLOResult(
         n_nodes=n_nodes, n_pods=n_pods, running=len(running_at),

@@ -177,6 +177,12 @@ class _UdpPortProxy:
         self._stop = threading.Event()
         # client addr -> connected backend socket (the clientCache)
         self._clients: Dict[Tuple[str, int], socket.socket] = {}
+        # client addr -> monotonic stamp of the LAST datagram either
+        # direction (the conntrack deadline the reference resets on
+        # every client write AND every reply, proxysocket.go
+        # SetDeadline) — reply-pump recv timeouts consult it so a
+        # one-way flow (statsd-style) never expires mid-stream
+        self._last_seen: Dict[Tuple[str, int], float] = {}
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -190,15 +196,20 @@ class _UdpPortProxy:
             try:
                 data, cli = self.sock.recvfrom(self.BUF)
             except OSError:
-                return
+                if self._stop.is_set() or self.sock.fileno() < 0:
+                    return
+                continue  # transient (ENOBUFS/ICMP noise): keep serving
             backend = self._backend_for(cli)
             if backend is None:
                 continue  # no endpoints: drop, like the reference
             try:
                 backend.send(data)
+                with self._lock:
+                    self._last_seen[cli] = time.monotonic()
             except OSError:
                 with self._lock:
                     self._clients.pop(cli, None)
+                    self._last_seen.pop(cli, None)
 
     def _backend_for(self, cli: Tuple[str, int]
                      ) -> Optional[socket.socket]:
@@ -216,10 +227,12 @@ class _UdpPortProxy:
             except OSError:
                 backend.close()
                 return None
-            # the idle bound IS the conntrack TTL: each reply resets
-            # it; expiry closes the backend and forgets the client
+            # the idle bound IS the conntrack TTL: traffic in either
+            # direction resets it; expiry closes the backend and
+            # forgets the client
             backend.settimeout(self.idle_timeout)
             self._clients[cli] = backend
+            self._last_seen[cli] = time.monotonic()
             threading.Thread(target=self._reply_pump,
                              args=(cli, backend), daemon=True).start()
             return backend
@@ -227,15 +240,22 @@ class _UdpPortProxy:
     def _reply_pump(self, cli: Tuple[str, int],
                     backend: socket.socket) -> None:
         """(proxysocket.go proxyClient — replies ride the SERVICE
-        socket so they come from the address the client sent to)"""
+        socket so they come from the address the client sent to).
+        A recv timeout only expires the entry when the whole flow —
+        including client->backend datagrams — has been idle for the
+        TTL; an empty datagram is legal UDP payload, not EOF."""
         try:
             while not self._stop.is_set():
                 try:
                     data = backend.recv(self.BUF)
                 except socket.timeout:
-                    return  # idle conntrack expiry
-                if not data:
-                    return
+                    with self._lock:
+                        seen = self._last_seen.get(cli, 0.0)
+                    if time.monotonic() - seen >= self.idle_timeout:
+                        return  # idle conntrack expiry
+                    continue    # one-way flow still alive: keep waiting
+                with self._lock:
+                    self._last_seen[cli] = time.monotonic()
                 self.sock.sendto(data, cli)
         except OSError:
             pass
@@ -243,6 +263,7 @@ class _UdpPortProxy:
             with self._lock:
                 if self._clients.get(cli) is backend:
                     del self._clients[cli]
+                self._last_seen.pop(cli, None)
             backend.close()
 
     def close(self) -> None:
@@ -250,6 +271,7 @@ class _UdpPortProxy:
         with self._lock:
             clients = list(self._clients.values())
             self._clients.clear()
+            self._last_seen.clear()
         for backend in clients:
             try:
                 backend.close()

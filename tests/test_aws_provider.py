@@ -321,6 +321,38 @@ class MockAws:
             def _a_DeregisterInstancesFromLoadBalancer(self, form):
                 self._reg(form, False)
 
+            def _a_CreateLoadBalancerListeners(self, form):
+                lb = cloud.elbs.get(form["LoadBalancerName"])
+                if lb is None:
+                    return self._err("LoadBalancerNotFound",
+                                     form["LoadBalancerName"])
+                i = 1
+                while f"Listeners.member.{i}.LoadBalancerPort" in form:
+                    lb["listeners"].append({
+                        "port": int(
+                            form[f"Listeners.member.{i}"
+                                 f".LoadBalancerPort"]),
+                        "proto": form.get(
+                            f"Listeners.member.{i}.Protocol", "")})
+                    i += 1
+                self._send(200, _xml(
+                    "CreateLoadBalancerListenersResponse", ""))
+
+            def _a_DeleteLoadBalancerListeners(self, form):
+                lb = cloud.elbs.get(form["LoadBalancerName"])
+                if lb is None:
+                    return self._err("LoadBalancerNotFound",
+                                     form["LoadBalancerName"])
+                drop = set()
+                i = 1
+                while f"LoadBalancerPorts.member.{i}" in form:
+                    drop.add(int(form[f"LoadBalancerPorts.member.{i}"]))
+                    i += 1
+                lb["listeners"] = [l for l in lb["listeners"]
+                                   if l["port"] not in drop]
+                self._send(200, _xml(
+                    "DeleteLoadBalancerListenersResponse", ""))
+
             def _a_DeleteLoadBalancer(self, form):
                 cloud.elbs.pop(form["LoadBalancerName"], None)
                 self._send(200, _xml("DeleteLoadBalancerResponse", ""))
@@ -564,3 +596,21 @@ def test_service_controller_converges_on_aws(cloud):
     sc = ServiceController(client, p)
     assert sc.sync_once() >= 1
     assert sc.sync_once() == 0, "unchanged state must not reconcile"
+
+
+def test_port_change_reconciles_listeners(cloud):
+    """A service port change rewrites the ELB listeners
+    (aws.go:1690-1744 listener diff) and opens the new port's ingress;
+    the view then converges."""
+    p = _provider(cloud)
+    lbs = p.load_balancers()
+    lbs.ensure("svc-port", "us-east-1", [80], ["node-a.internal"])
+    lb = lbs.ensure("svc-port", "us-east-1", [443],
+                    ["node-a.internal"])
+    assert lb.ports == [443]
+    assert [l["port"] for l in cloud.elbs["svc-port"]["listeners"]] \
+        == [443]
+    sg = [g for g in cloud.sgs.values()
+          if g["name"] == "k8s-elb-svc-port"][0]
+    assert set(sg["perms"]) == {80, 443}
+    assert lbs.get("svc-port", "us-east-1").ports == [443]

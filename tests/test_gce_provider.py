@@ -433,3 +433,19 @@ def test_service_controller_converges_on_gce(cloud):
     sc = ServiceController(client, p)
     assert sc.sync_once() >= 1
     assert sc.sync_once() == 0, "unchanged state must not reconcile"
+
+
+def test_port_change_reconciles_rule_and_firewall(cloud):
+    """A service port change must land in the cloud (gce.go:500 —
+    forwarding rules are immutable, so delete + recreate) and then
+    CONVERGE (second ensure is hands-only)."""
+    p = _provider(cloud)
+    lbs = p.load_balancers()
+    lbs.ensure("aport", REGION, [80], ["node-a"])
+    lb = lbs.ensure("aport", REGION, [443], ["node-a"])
+    assert lb.ports == [443]
+    assert cloud.forwarding_rules["aport"]["portRange"] == "443-443"
+    assert cloud.firewalls["k8s-fw-aport"]["allowed"][0]["ports"] == \
+        ["443"]
+    got = lbs.get("aport", REGION)
+    assert got.ports == [443]

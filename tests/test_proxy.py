@@ -345,3 +345,71 @@ class TestUdpProxy:
                  if c.startswith("KUBE-SEP-")
                  for r in ipt.list_rules(TABLE_NAT, c) if "DNAT" in r]
         assert any("udp" in r and "10.244.0.2:5353" in r for r in dnats)
+
+
+class TestUdpConntrackSemantics:
+    def test_one_way_flow_never_expires_mid_stream(self):
+        """Client->backend traffic must refresh the conntrack TTL
+        (the reference resets the deadline on every datagram,
+        proxysocket.go) — a statsd-style one-way flow outliving the
+        idle timeout stays pinned to ONE backend."""
+        # a silent sink: pure one-way traffic, no replies ever
+        sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sink.bind(("127.0.0.1", 0))
+        try:
+            p = UserspaceProxier(udp_idle_timeout=0.3)
+            p.balancer.on_endpoints_update([
+                eps("dns", ["127.0.0.1"], port=sink.getsockname()[1],
+                    port_name="dns")])
+            p.on_service_update([udp_svc("dns")])
+            port = p.port_for("default", "dns", "dns")
+            proxy = p._proxies[("default", "dns", "dns")]
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                end = time.time() + 1.0  # > 3x the idle timeout
+                while time.time() < end:
+                    c.sendto(b"tick", ("127.0.0.1", port))
+                    time.sleep(0.05)
+                assert proxy.active_clients() == 1, \
+                    "one-way flow expired mid-stream"
+            finally:
+                c.close()
+                p.stop()
+        finally:
+            sink.close()
+
+    def test_empty_datagram_is_payload_not_eof(self):
+        """A zero-length reply is legal UDP and must be forwarded,
+        not treated as stream end."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        srv.bind(("127.0.0.1", 0))
+
+        def empty_echo():
+            while True:
+                try:
+                    _d, addr = srv.recvfrom(4096)
+                except OSError:
+                    return
+                srv.sendto(b"", addr)   # empty datagram reply
+
+        threading.Thread(target=empty_echo, daemon=True).start()
+        try:
+            p = UserspaceProxier(udp_idle_timeout=5.0)
+            p.balancer.on_endpoints_update([
+                eps("dns", ["127.0.0.1"], port=srv.getsockname()[1],
+                    port_name="dns")])
+            p.on_service_update([udp_svc("dns")])
+            port = p.port_for("default", "dns", "dns")
+            proxy = p._proxies[("default", "dns", "dns")]
+            c = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                c.sendto(b"ping", ("127.0.0.1", port))
+                c.settimeout(5.0)
+                data, _ = c.recvfrom(4096)
+                assert data == b""          # the empty reply arrived
+                assert proxy.active_clients() == 1  # entry survived
+            finally:
+                c.close()
+                p.stop()
+        finally:
+            srv.close()

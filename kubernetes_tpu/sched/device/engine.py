@@ -561,13 +561,16 @@ class BatchEngine:
         where each host materializes its slice), so the callback just
         serves the local index windows of the shared host array."""
         shardings = _node_shardings(self.mesh, self.node_axis)
+        return self._put_tree(args, shardings)
 
+    @staticmethod
+    def _put_tree(tree, sharding_tree):
         def put(host, sh):
             host = np.asarray(host)
             return jax.make_array_from_callback(
                 host.shape, sh, lambda idx, _h=host: _h[idx])
 
-        return jax.tree_util.tree_map(put, args, shardings)
+        return jax.tree_util.tree_map(put, tree, sharding_tree)
 
     def run(self, enc: EncodeResult) -> Tuple[np.ndarray, State]:
         """-> (assigned node indices i32[P] (-1 = no fit), final state)."""
@@ -596,6 +599,16 @@ class BatchEngine:
         and the returned assignment array materializes on first
         np.asarray."""
         node, state, pods = self.device_args(enc)
+        multiproc = self.spans_processes
+        if multiproc:
+            # multi-host: chunks slice HOST pytrees, then each piece
+            # (and the node/state constants once) is placed globally;
+            # the carry stays an on-device global array between chunks
+            node_sh, state_sh, pods_sh = _node_shardings(self.mesh,
+                                                         self.node_axis)
+            node = self._put_tree(node, node_sh)
+            if state_override is None:
+                state = self._put_tree(state, state_sh)
         if state_override is not None:
             state = state_override
         run = self._get_run(*self._enc_flags(enc))
@@ -610,8 +623,16 @@ class BatchEngine:
                         [np.asarray(a),
                          np.zeros((chunk - n,) + a.shape[1:], a.dtype)]),
                     piece)
+            if multiproc:
+                piece = self._put_tree(piece, pods_sh)
             state, assigned = run(node, state, piece)
-            outs.append(assigned)
+            # replicated outputs are addressable per process; host
+            # concat avoids an out-of-jit op over global arrays
+            outs.append(np.asarray(assigned) if multiproc else assigned)
+        if multiproc:
+            flat = (np.concatenate(outs)[:p] if outs
+                    else np.zeros(0, np.int32))
+            return flat, state
         flat = jnp.concatenate(outs)[:p] if outs else jnp.zeros(0, jnp.int32)
         if block:
             return np.asarray(flat), state

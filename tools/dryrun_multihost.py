@@ -64,6 +64,20 @@ def worker(proc_id: int, nprocs: int, port: int) -> None:
     expect = np.asarray(expect[:enc.n_pods])
     assert np.array_equal(assigned, expect), (assigned, expect)
     assert int((assigned >= 0).sum()) > 0, "nothing scheduled"
+
+    # the PRODUCTION pipeline path across processes: run_chunked
+    # executes the pod axis as fixed-size chunks with the carry
+    # threaded between dispatches as an ON-DEVICE GLOBAL array — the
+    # cross-host state never round-trips through a host. Must be
+    # bit-equal to the one-shot scan and to the single-process
+    # chunked run.
+    half = max(1, enc.n_pods // 2)
+    chained, _carry = engine.run_chunked(enc, half)
+    exp_chunked, _ = single.run_chunked(enc, half)
+    chained = np.asarray(chained)[:enc.n_pods]
+    assert np.array_equal(chained, np.asarray(exp_chunked)[:enc.n_pods])
+    assert np.array_equal(chained, expect)
+
     print(f"WORKER-{proc_id}-PARITY-OK "
           f"{json.dumps(assigned.tolist())}", flush=True)
 

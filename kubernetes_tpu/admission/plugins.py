@@ -290,9 +290,19 @@ class SecurityContextDeny(Interface):
         if pod.spec.host_network:
             raise Forbidden("pod.spec.hostNetwork is forbidden")
         for c in pod.spec.containers:
-            if getattr(c, "privileged", False):
+            sc = getattr(c, "security_context", None)
+            if getattr(c, "privileged", False) or \
+                    (sc is not None and sc.privileged):
                 raise Forbidden(
                     f"privileged container {c.name!r} is forbidden")
+            # the reference's scdeny also rejects user/capability
+            # requests (plugin/pkg/admission/securitycontext/scdeny:
+            # SecurityContext.RunAsUser / SELinuxOptions are denied)
+            if sc is not None and (sc.run_as_user is not None
+                                   or sc.capabilities is not None):
+                raise Forbidden(
+                    f"container {c.name!r}: security context "
+                    f"user/capability requests are forbidden")
 
 
 # the InitialResources usage history: image -> {"cpu"|"memory": milli}.

@@ -305,10 +305,29 @@ class Probe:
 
 
 @dataclass
+class Capabilities:
+    """(ref: pkg/api/types.go Capabilities — linux capability names to
+    grant/revoke at container create)"""
+    add: List[str] = field(default_factory=list)
+    drop: List[str] = field(default_factory=list)
+
+
+@dataclass
+class SecurityContext:
+    """(ref: pkg/api/types.go SecurityContext; applied at the runtime
+    boundary by kubelet/securitycontext.py, policed by the
+    SecurityContextDeny admission plugin)"""
+    capabilities: Optional[Capabilities] = None
+    privileged: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    run_as_non_root: Optional[bool] = None
+
+
+@dataclass
 class Container:
-    """privileged is the security-context surface the SecurityContextDeny
-    admission plugin polices (the reference nests it in
-    SecurityContext.Privileged)."""
+    """privileged is the flat pre-SecurityContext surface kept for
+    wire compat; the reference nests it (SecurityContext.Privileged) —
+    both are honored (kubelet/securitycontext.effective_privileged)."""
     name: str = ""
     image: str = ""
     command: List[str] = field(default_factory=list)
@@ -319,6 +338,7 @@ class Container:
     volume_mounts: List[VolumeMount] = field(default_factory=list)
     image_pull_policy: str = ""
     privileged: bool = False
+    security_context: Optional[SecurityContext] = None
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
     # ref: pkg/api/types.go:813 Container.Stdin — only stdin:true
@@ -408,6 +428,10 @@ class PodSpec:
     service_account_name: str = ""
     node_name: str = ""
     host_network: bool = False
+    # ref: pkg/api/types.go PodSpec.ImagePullSecrets — resolved by the
+    # kubelet into a docker keyring (kubelet/credentialprovider.py)
+    image_pull_secrets: List[LocalObjectReference] = field(
+        default_factory=list)
     affinity: Optional[Affinity] = None
 
 

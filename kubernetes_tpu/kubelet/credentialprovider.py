@@ -1,0 +1,181 @@
+"""Docker registry credential resolution — the image-pull keyring.
+
+Reference: pkg/credentialprovider (keyring.go DockerKeyring — registry
+URL index with longest-prefix lookup; config.go DockerConfig /
+DockerConfigEntry — the .dockercfg JSON shape with either
+username/password or a base64 "auth" blob) and the kubelet's
+per-pod resolution (kubelet.go getPullSecretsForPod →
+credentialprovider.MakeDockerKeyring over kubernetes.io/dockercfg
+secrets). The daemon runtime then carries the matched credential to
+the engine as the X-Registry-Auth header on /images/create — the
+docker remote API's wire shape.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+DEFAULT_REGISTRY = "index.docker.io"
+DOCKERCFG_SECRET_TYPE = "kubernetes.io/dockercfg"
+DOCKERCFG_KEY = ".dockercfg"
+
+
+@dataclass(frozen=True)
+class DockerCredential:
+    username: str = ""
+    password: str = ""
+    email: str = ""
+
+    def registry_auth_header(self) -> str:
+        """The X-Registry-Auth payload (base64 JSON) the docker remote
+        API takes on /images/create."""
+        return base64.b64encode(json.dumps({
+            "username": self.username, "password": self.password,
+            "email": self.email}).encode()).decode()
+
+
+def _entry_credential(entry: dict) -> Optional[DockerCredential]:
+    """One .dockercfg entry -> credential (config.go
+    DockerConfigEntry: explicit username/password, or an 'auth' blob
+    of base64('user:pass'))."""
+    user = entry.get("username", "")
+    pwd = entry.get("password", "")
+    if not (user or pwd) and entry.get("auth"):
+        try:
+            decoded = base64.b64decode(entry["auth"]).decode()
+        except Exception:
+            return None
+        user, _, pwd = decoded.partition(":")
+    if not (user or pwd):
+        return None
+    return DockerCredential(username=user, password=pwd,
+                            email=entry.get("email", ""))
+
+
+def _normalize_registry(url: str) -> str:
+    """Strip scheme + trailing slash: the keyring matches on the host
+    [/path] part (keyring.go urlsToMatch)."""
+    for scheme in ("https://", "http://"):
+        if url.startswith(scheme):
+            url = url[len(scheme):]
+    return url.rstrip("/")
+
+
+def parse_dockercfg(cfg: dict) -> Dict[str, DockerCredential]:
+    """.dockercfg JSON -> {registry: credential}. Accepts both the
+    bare map and the newer {"auths": {...}} wrapper."""
+    if "auths" in cfg and isinstance(cfg["auths"], dict):
+        cfg = cfg["auths"]
+    out: Dict[str, DockerCredential] = {}
+    for registry, entry in cfg.items():
+        if not isinstance(entry, dict):
+            continue
+        cred = _entry_credential(entry)
+        if cred is not None:
+            out[_normalize_registry(registry)] = cred
+    return out
+
+
+def image_registry(image: str) -> str:
+    """The registry part of an image reference: 'reg.example.com/a/b'
+    -> 'reg.example.com'; bare 'nginx' / 'library/nginx' -> docker
+    hub (keyring.go's default-registry behavior)."""
+    first = image.split("/", 1)[0]
+    if "/" in image and ("." in first or ":" in first
+                        or first == "localhost"):
+        return first
+    return DEFAULT_REGISTRY
+
+
+class DockerKeyring:
+    """Longest-prefix registry credential index (keyring.go
+    BasicDockerKeyring: most-specific match wins — 'reg.io/team'
+    beats 'reg.io')."""
+
+    def __init__(self):
+        self._index: Dict[str, DockerCredential] = {}
+        self._lock = threading.Lock()
+
+    def add(self, registry: str, cred: DockerCredential) -> None:
+        with self._lock:
+            self._index[_normalize_registry(registry)] = cred
+
+    def add_dockercfg(self, cfg: dict) -> None:
+        for registry, cred in parse_dockercfg(cfg).items():
+            self.add(registry, cred)
+
+    def lookup(self, image: str) -> List[DockerCredential]:
+        """Credentials to TRY, most specific first; empty means pull
+        anonymously (keyring.go Lookup returns found=false)."""
+        target = _normalize_registry(image_registry(image))
+        # strip the TAG only — the last ':' of the final path segment;
+        # a registry port ('localhost:5000/x') is not a tag
+        head, sep, last = image.rpartition("/")
+        repo_path = head + sep + last.split(":", 1)[0]
+        with self._lock:
+            matches = []
+            for registry, cred in self._index.items():
+                # exact-registry match, or a path-scoped entry with a
+                # REAL path boundary ('reg.io/team' must not serve
+                # 'reg.io/teammate/...' — that would hand one tenant's
+                # credential to a sibling path)
+                if target == registry or repo_path == registry or \
+                        repo_path.startswith(registry + "/"):
+                    matches.append((len(registry), cred))
+        matches.sort(key=lambda t: -t[0])
+        return [c for _l, c in matches]
+
+
+def keyring_from_secrets(secrets) -> DockerKeyring:
+    """kubernetes.io/dockercfg secrets -> keyring (the
+    MakeDockerKeyring half: data['.dockercfg'] is base64 JSON)."""
+    kr = DockerKeyring()
+    for secret in secrets:
+        if getattr(secret, "type", "") != DOCKERCFG_SECRET_TYPE:
+            continue
+        raw = (secret.data or {}).get(DOCKERCFG_KEY, "")
+        try:
+            kr.add_dockercfg(json.loads(base64.b64decode(raw).decode()))
+        except Exception:
+            continue  # a malformed secret must not block the others
+    return kr
+
+
+def pull_secrets_for_pod(client, pod) -> list:
+    """Resolve pod.spec.imagePullSecrets by name in the pod's
+    namespace, skipping the missing (kubelet.go getPullSecretsForPod
+    logs-and-continues on absent secrets; transient API errors are
+    LOGGED, not silently degraded to an anonymous pull)."""
+    import logging
+    from ..core.errors import NotFound
+
+    out = []
+    for ref in getattr(pod.spec, "image_pull_secrets", []) or []:
+        try:
+            out.append(client.get("secrets", ref.name,
+                                  pod.metadata.namespace))
+        except NotFound:
+            continue
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "resolving imagePullSecret %s/%s failed",
+                pod.metadata.namespace, ref.name, exc_info=True)
+            continue
+    return out
+
+
+def runtime_puller(runtime, client):
+    """The composed image-pull seam for ImageManager: resolve the
+    pod's imagePullSecrets into a keyring and hand the pull (with
+    credentials) to the runtime — EnsureImageExists' full reference
+    flow (image_puller.go + kubelet.go getPullSecretsForPod)."""
+    def pull(image: str, pod) -> None:
+        keyring = keyring_from_secrets(
+            pull_secrets_for_pod(client, pod))
+        runtime.pull_image(image, keyring)
+
+    return pull

@@ -75,13 +75,14 @@ class DaemonRuntime(Runtime):
     # ------------------------------------------------------------ wire
 
     def _do(self, method: str, path: str, body: Optional[dict] = None,
-            raw: bool = False):
+            raw: bool = False, headers: Optional[dict] = None):
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         try:
             payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
+            hdrs = {"Content-Type": "application/json"} if payload else {}
+            hdrs.update(headers or {})
+            conn.request(method, path, body=payload, headers=hdrs)
             resp = conn.getresponse()
             data = resp.read()
             if resp.status >= 400:
@@ -147,18 +148,49 @@ class DaemonRuntime(Runtime):
             out.append(c)
         return out
 
+    def pull_image(self, image: str, keyring=None) -> None:
+        """POST /images/create with the registry credential riding the
+        X-Registry-Auth header (the docker remote API's auth shape;
+        ref: dockertools/docker.go Pull + credentialprovider keyring
+        lookup). Credentials are tried most-specific-first; an empty
+        keyring pulls anonymously."""
+        creds = keyring.lookup(image) if keyring is not None else []
+        attempts = creds or [None]
+        last = None
+        for cred in attempts:
+            headers = ({"X-Registry-Auth": cred.registry_auth_header()}
+                       if cred is not None else None)
+            try:
+                self._do(
+                    "POST",
+                    f"/images/create?fromImage="
+                    f"{urllib.parse.quote(image)}",
+                    headers=headers)
+                return
+            except DaemonError as e:
+                last = e
+        raise last
+
     def start_container(self, pod: api.Pod, container: api.Container
                         ) -> RuntimeContainer:
         prior = self._find(pod.metadata.uid, container.name)
         attempt = max((c["_parsed"]["attempt"] for c in prior),
                       default=-1) + 1
         cname = build_container_name(pod, container, attempt)
+        body = {"Image": container.image,
+                "Cmd": list(container.command) + list(container.args),
+                "Env": [f"{e.name}={e.value}" for e in container.env],
+                "OpenStdin": bool(container.stdin),
+                "HostConfig": {}}
+        # the runtime half of the security context (pkg/securitycontext
+        # provider.go Modify{Container,Host}Config)
+        from .securitycontext import (apply_to_container_config,
+                                      apply_to_host_config)
+        apply_to_container_config(container, body)
+        apply_to_host_config(container, body["HostConfig"])
         created = self._do(
             "POST", f"/containers/create?name={urllib.parse.quote(cname)}",
-            body={"Image": container.image,
-                  "Cmd": list(container.command) + list(container.args),
-                  "Env": [f"{e.name}={e.value}" for e in container.env],
-                  "OpenStdin": bool(container.stdin)})
+            body=body)
         cid = created["Id"]
         self._do("POST", f"/containers/{cid}/start")
         return RuntimeContainer(

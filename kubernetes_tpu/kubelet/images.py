@@ -33,6 +33,22 @@ class ImageManager:
                  recorder=None):
         self.puller = puller or (lambda image: None)
         self.recorder = recorder
+        # the puller seam takes (image) or (image, pod): the pod form
+        # lets a runtime-backed puller resolve imagePullSecrets into a
+        # registry credential (kubelet/credentialprovider.py). Only
+        # REQUIRED parameters count — a puller with an optional second
+        # arg (retries=3, or a bound runtime method whose second slot
+        # is a keyring) must not receive a Pod in it.
+        import inspect
+        try:
+            params = inspect.signature(self.puller).parameters.values()
+            required = [p for p in params
+                        if p.default is inspect.Parameter.empty
+                        and p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD)]
+            self._puller_takes_pod = len(required) >= 2
+        except (TypeError, ValueError):
+            self._puller_takes_pod = False
         self._lock = threading.Lock()
         self._present: Dict[str, float] = {}  # image -> last-used ts
 
@@ -54,7 +70,10 @@ class ImageManager:
                 f"present with pull policy of Never")
         if policy == "IfNotPresent" and present:
             return
-        self.puller(image)
+        if self._puller_takes_pod:
+            self.puller(image, pod)
+        else:
+            self.puller(image)
         if self.recorder is not None:
             self.recorder.eventf(pod, "Normal", "Pulled",
                                  f"Successfully pulled image {image!r}")

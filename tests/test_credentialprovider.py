@@ -1,0 +1,284 @@
+"""Image-pull credentials + runtime security context.
+
+Reference: pkg/credentialprovider (keyring.go longest-prefix registry
+lookup, config.go .dockercfg parsing), kubelet.go getPullSecretsForPod,
+dockertools' X-Registry-Auth pull header, and pkg/securitycontext
+provider.go applying RunAsUser/Privileged/Capabilities at container
+create."""
+
+import base64
+import json
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet.credentialprovider import (
+    DEFAULT_REGISTRY, DockerCredential, DockerKeyring, image_registry,
+    keyring_from_secrets, parse_dockercfg, pull_secrets_for_pod)
+
+
+def _b64(s):
+    return base64.b64encode(s.encode()).decode()
+
+
+class TestDockercfgParsing:
+    def test_username_password_and_auth_blob(self):
+        cfg = {
+            "https://reg.example.com": {"username": "u1",
+                                        "password": "p1",
+                                        "email": "u1@x"},
+            "quay.io": {"auth": _b64("u2:p2")},
+            "broken.io": {"auth": "!!!not-base64!!!"},
+        }
+        creds = parse_dockercfg(cfg)
+        assert creds["reg.example.com"] == DockerCredential(
+            "u1", "p1", "u1@x")
+        assert creds["quay.io"].username == "u2"
+        assert creds["quay.io"].password == "p2"
+        assert "broken.io" not in creds
+
+    def test_auths_wrapper(self):
+        cfg = {"auths": {"ghcr.io": {"username": "u", "password": "p"}}}
+        assert parse_dockercfg(cfg)["ghcr.io"].username == "u"
+
+
+class TestKeyringLookup:
+    def test_longest_prefix_wins(self):
+        kr = DockerKeyring()
+        kr.add("reg.io", DockerCredential("base", "b"))
+        kr.add("reg.io/team", DockerCredential("team", "t"))
+        got = kr.lookup("reg.io/team/app:v1")
+        assert [c.username for c in got] == ["team", "base"]
+        assert [c.username for c in kr.lookup("reg.io/other:v1")] == \
+            ["base"]
+
+    def test_bare_image_resolves_docker_hub(self):
+        assert image_registry("nginx") == DEFAULT_REGISTRY
+        assert image_registry("library/nginx") == DEFAULT_REGISTRY
+        assert image_registry("reg.example.com/a/b") == \
+            "reg.example.com"
+        assert image_registry("localhost/x") == "localhost"
+        kr = DockerKeyring()
+        kr.add("index.docker.io", DockerCredential("hub", "h"))
+        assert [c.username for c in kr.lookup("nginx:latest")] == ["hub"]
+
+    def test_no_match_means_anonymous(self):
+        assert DockerKeyring().lookup("anything") == []
+
+
+class TestSecretsResolution:
+    def _secret(self, name, registry, user, pwd,
+                type_=u"kubernetes.io/dockercfg"):
+        cfg = {registry: {"username": user, "password": pwd}}
+        return api.Secret(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            type=type_,
+            data={".dockercfg": _b64(json.dumps(cfg))})
+
+    def test_keyring_from_dockercfg_secrets(self):
+        kr = keyring_from_secrets([
+            self._secret("a", "reg.io", "u", "p"),
+            self._secret("opaque", "x.io", "q", "r", type_="Opaque"),
+        ])
+        assert [c.username for c in kr.lookup("reg.io/app")] == ["u"]
+        assert kr.lookup("x.io/app") == []  # wrong secret type skipped
+
+    def test_pull_secrets_for_pod_skips_missing(self):
+        from kubernetes_tpu.api.client import InProcClient
+        from kubernetes_tpu.api.registry import Registry
+
+        client = InProcClient(Registry())
+        client.create("secrets", self._secret("pull-1", "reg.io",
+                                              "u", "p"))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(
+                containers=[api.Container(name="c", image="i")],
+                image_pull_secrets=[
+                    api.LocalObjectReference(name="pull-1"),
+                    api.LocalObjectReference(name="ghost")]))
+        secrets = pull_secrets_for_pod(client, pod)
+        assert [s.metadata.name for s in secrets] == ["pull-1"]
+
+
+class TestRuntimeIntegration:
+    """The wire half against the mock docker daemon."""
+
+    @pytest.fixture()
+    def daemon(self):
+        from tests.test_daemon_runtime import MockDaemon
+        d = MockDaemon()
+        yield d
+        d.stop()
+
+    def test_pull_sends_registry_auth(self, daemon):
+        from kubernetes_tpu.kubelet.daemon_runtime import DaemonRuntime
+        daemon.protected["reg.io"] = ("alice", "s3cret")
+        rt = DaemonRuntime(daemon.url)
+        kr = DockerKeyring()
+        kr.add("reg.io", DockerCredential("alice", "s3cret"))
+        rt.pull_image("reg.io/app:v1", kr)
+        image, auth = daemon.pulls[-1]
+        assert image == "reg.io/app:v1"
+        assert json.loads(base64.b64decode(auth))["username"] == "alice"
+
+    def test_pull_wrong_creds_fails(self, daemon):
+        from kubernetes_tpu.kubelet.daemon_runtime import (DaemonError,
+                                                           DaemonRuntime)
+        daemon.protected["reg.io"] = ("alice", "s3cret")
+        rt = DaemonRuntime(daemon.url)
+        kr = DockerKeyring()
+        kr.add("reg.io", DockerCredential("mallory", "guess"))
+        with pytest.raises(DaemonError):
+            rt.pull_image("reg.io/app:v1", kr)
+        # anonymous against an open registry succeeds
+        rt.pull_image("open.io/app:v1", DockerKeyring())
+
+    def test_security_context_reaches_host_config(self, daemon):
+        from kubernetes_tpu.kubelet.daemon_runtime import DaemonRuntime
+        rt = DaemonRuntime(daemon.url)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="scp", namespace="default",
+                                    uid="uid-sc"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                security_context=api.SecurityContext(
+                    run_as_user=1001,
+                    privileged=True,
+                    capabilities=api.Capabilities(
+                        add=["NET_ADMIN"], drop=["MKNOD"])))]))
+        rt.start_container(pod, pod.spec.containers[0])
+        (rec,) = daemon.containers.values()
+        assert rec["User"] == "1001"
+        assert rec["HostConfig"]["Privileged"] is True
+        assert rec["HostConfig"]["CapAdd"] == ["NET_ADMIN"]
+        assert rec["HostConfig"]["CapDrop"] == ["MKNOD"]
+
+
+class TestAdmissionSCDeny:
+    def test_denies_run_as_user_and_capabilities(self):
+        from kubernetes_tpu.admission import (Attributes, Forbidden,
+                                              Operation)
+        from kubernetes_tpu.admission.plugins import SecurityContextDeny
+
+        plugin = SecurityContextDeny(None)
+
+        def pod_with(sc):
+            return api.Pod(
+                metadata=api.ObjectMeta(name="p", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="i", security_context=sc)]))
+
+        for sc in (api.SecurityContext(run_as_user=0),
+                   api.SecurityContext(privileged=True),
+                   api.SecurityContext(
+                       capabilities=api.Capabilities(add=["SYS_ADMIN"]))):
+            with pytest.raises(Forbidden):
+                plugin.admit(Attributes(
+                    operation=Operation.CREATE, resource="pods",
+                    namespace="default", name="p",
+                    object=pod_with(sc)))
+        # a plain pod passes
+        plugin.admit(Attributes(
+            operation=Operation.CREATE, resource="pods",
+            namespace="default", name="p", object=pod_with(None)))
+
+
+def test_image_manager_passes_pod_to_two_arg_puller():
+    from kubernetes_tpu.kubelet.images import ImageManager
+
+    seen = []
+    mgr = ImageManager(puller=lambda image, pod: seen.append(
+        (image, pod.metadata.name)))
+    pod = api.Pod(metadata=api.ObjectMeta(name="pp", namespace="d"),
+                  spec=api.PodSpec(containers=[
+                      api.Container(name="c", image="img:v1")]))
+    mgr.ensure_image_exists(pod, pod.spec.containers[0])
+    assert seen == [("img:v1", "pp")]
+
+
+def test_runtime_puller_composition(tmp_path):
+    """ImageManager -> runtime_puller -> secrets -> keyring ->
+    X-Registry-Auth: the full EnsureImageExists flow end to end."""
+    from tests.test_daemon_runtime import MockDaemon
+
+    from kubernetes_tpu.api.client import InProcClient
+    from kubernetes_tpu.api.registry import Registry
+    from kubernetes_tpu.kubelet.credentialprovider import runtime_puller
+    from kubernetes_tpu.kubelet.daemon_runtime import DaemonRuntime
+    from kubernetes_tpu.kubelet.images import ImageManager
+
+    daemon = MockDaemon()
+    try:
+        daemon.protected["reg.io"] = ("alice", "s3cret")
+        client = InProcClient(Registry())
+        cfg = {"reg.io": {"username": "alice", "password": "s3cret"}}
+        client.create("secrets", api.Secret(
+            metadata=api.ObjectMeta(name="pull", namespace="default"),
+            type="kubernetes.io/dockercfg",
+            data={".dockercfg": _b64(json.dumps(cfg))}))
+        rt = DaemonRuntime(daemon.url)
+        mgr = ImageManager(puller=runtime_puller(rt, client))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(
+                containers=[api.Container(name="c",
+                                          image="reg.io/app:v1")],
+                image_pull_secrets=[
+                    api.LocalObjectReference(name="pull")]))
+        mgr.ensure_image_exists(pod, pod.spec.containers[0])
+        image, auth = daemon.pulls[-1]
+        assert image == "reg.io/app:v1"
+        assert json.loads(base64.b64decode(auth))["password"] == \
+            "s3cret"
+    finally:
+        daemon.stop()
+
+
+def test_keyring_path_boundary_and_registry_ports():
+    """Review regressions: a path-scoped entry must not serve a
+    sibling path that shares a string prefix (credential leakage),
+    and a registry PORT is not a tag."""
+    kr = DockerKeyring()
+    kr.add("reg.io/team", DockerCredential("team", "t"))
+    assert kr.lookup("reg.io/teammate/app:v1") == []
+    assert [c.username for c in kr.lookup("reg.io/team/app:v1")] == \
+        ["team"]
+    kr2 = DockerKeyring()
+    kr2.add("localhost:5000/team", DockerCredential("u", "p"))
+    assert [c.username
+            for c in kr2.lookup("localhost:5000/team/app:v1")] == ["u"]
+
+
+def test_optional_second_arg_puller_stays_one_arg():
+    from kubernetes_tpu.kubelet.images import ImageManager
+
+    seen = []
+    mgr = ImageManager(puller=lambda image, retries=3: seen.append(
+        (image, retries)))
+    pod = api.Pod(metadata=api.ObjectMeta(name="p", namespace="d"),
+                  spec=api.PodSpec(containers=[
+                      api.Container(name="c", image="img:v1")]))
+    mgr.ensure_image_exists(pod, pod.spec.containers[0])
+    assert seen == [("img:v1", 3)]  # the Pod never lands in retries
+
+
+def test_run_as_non_root_enforced():
+    from kubernetes_tpu.kubelet.securitycontext import \
+        apply_to_container_config
+
+    def c(sc):
+        return api.Container(name="c", image="i", security_context=sc)
+
+    with pytest.raises(ValueError):
+        apply_to_container_config(
+            c(api.SecurityContext(run_as_non_root=True)), {})
+    with pytest.raises(ValueError):
+        apply_to_container_config(
+            c(api.SecurityContext(run_as_non_root=True,
+                                  run_as_user=0)), {})
+    cfg = {}
+    apply_to_container_config(
+        c(api.SecurityContext(run_as_non_root=True, run_as_user=7)),
+        cfg)
+    assert cfg["User"] == "7"

@@ -30,6 +30,8 @@ class MockDaemon:
         self.execs = {}        # exec id -> {Cmd, ExitCode, Output}
         self.calls = []
         self.logs = {}         # container id -> text
+        self.pulls = []        # (image, X-Registry-Auth header)
+        self.protected = {}    # registry -> (user, password) required
         self._n = 0
         self._lock = threading.Lock()
         daemon = self
@@ -90,6 +92,24 @@ class MockDaemon:
                 parsed = urlsplit(self.path)
                 path = parsed.path
                 daemon.calls.append(("POST", path))
+                if path == "/images/create":
+                    q = parse_qs(parsed.query)
+                    image = q.get("fromImage", [""])[0]
+                    auth = self.headers.get("X-Registry-Auth", "")
+                    daemon.pulls.append((image, auth))
+                    registry = image.split("/", 1)[0]
+                    need = daemon.protected.get(registry)
+                    if need is not None:
+                        import base64 as _b64
+                        try:
+                            got = json.loads(_b64.b64decode(auth))
+                        except Exception:
+                            got = {}
+                        if (got.get("username"),
+                                got.get("password")) != need:
+                            return self._send(
+                                500, {"message": "unauthorized"})
+                    return self._send(200, {"status": "pulled"})
                 if path == "/containers/create":
                     body = self._body()
                     name = parse_qs(parsed.query).get("name", [""])[0]
@@ -101,6 +121,8 @@ class MockDaemon:
                         "Id": cid, "Names": [f"/{name}"],
                         "Image": body.get("Image", ""),
                         "Cmd": body.get("Cmd", []),
+                        "User": body.get("User", ""),
+                        "HostConfig": body.get("HostConfig", {}),
                         "State": "created", "ExitCode": 0,
                         "Created": _time.time()}
                     return self._send(201, {"Id": cid})

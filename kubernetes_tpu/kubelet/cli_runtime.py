@@ -1,0 +1,489 @@
+"""CLI/unit-file runtime: the kubelet driving a container CLI + unit
+supervisor — the rkt process shape.
+
+Reference: pkg/kubelet/rkt/rkt.go (1,534 LoC). Where the engine-daemon
+adapter (daemon_runtime.py) is an HTTP CLIENT of a long-lived daemon,
+this boundary is exec-a-CLI + systemd units, and it is POD-granular:
+
+- one pod = one prepared CLI pod = one service unit. `prepare` turns
+  the whole pod spec into an immutable prepared pod and returns its
+  uuid (rkt.go:630 preparePod / makePodManifest :424); the unit's
+  ExecStart is `<cli> run-prepared <uuid>` (rkt.go:694) and the unit
+  file carries the kubernetes identity in an [X-Kubernetes] section
+  (rkt.go:695-700 writes id/name/namespace as unit options).
+- starting any container of a not-running pod (re)launches the WHOLE
+  pod: the reference's SyncPod restarts the entire pod when any
+  container needs a change (rkt.go:1156-1219 restartPod) because a
+  prepared pod is immutable. The attempt counter therefore advances
+  per POD generation and every app in a generation shares it.
+- killing a container stops the whole unit (v1.1 rkt has no per-app
+  kill; KillPod stops the unit after touching the service file so GC
+  defers, rkt.go:982-1006). The restart policy revives the pod on the
+  next sync.
+- pod state is reconstructed from the unit files + the CLI's status
+  (rkt.go:937 GetPods = read service files + rkt pod states); logs
+  come from the unit journal (GetContainerLogs -> journalctl -u);
+  exec is `<cli> enter` (rkt.go ExecInContainer); images are fetched
+  with `<cli> fetch` (rkt.go:1093 PullImage — registry auth rides the
+  CLI's own config dir, writeDockerAuthConfig :1049, not flags).
+- GarbageCollect = reset-failed + remove inactive service files +
+  per-uuid `<cli> gc` (rkt.go:1221-1260), min-age gated by the unit
+  file mtime the stop path touches. The reference finishes with a
+  global `rkt gc`; here collection is strictly per-uuid (at generation
+  replacement, kill, and sweep) so kept corpses and pods mid-prepare
+  are never reaped out from under the kubelet.
+
+The CLI binary itself is the external runtime (rkt's role); tests run
+the real adapter + real unit supervisor against a fake CLI the way the
+daemon tests run a fake engine daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shlex
+import subprocess
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import types as api
+from .container import (ContainerState, Runtime, RuntimeContainer,
+                        RuntimePod, tail_text)
+from .unitd import ACTIVE, UnitManager
+
+UNIT_PREFIX = "k8s_"  # makePodServiceFileName (rkt.go:214)
+K8S_SECTION = "X-Kubernetes"
+MIN_VERSION = (0, 8, 0)  # rkt.go:56 minimum binary version gate
+
+
+def unit_name_for(pod_uid: str) -> str:
+    """(ref: makePodServiceFileName rkt.go:214)"""
+    return f"{UNIT_PREFIX}{pod_uid}.service"
+
+
+def _should_restart(policy: str, exit_code: int) -> bool:
+    """Per-app restart decision, mirrored from the kubelet's syncPod —
+    the pod-granular runtime must apply it itself because a whole-pod
+    restart re-runs EVERY app (rkt.go:1156 SyncPod consults the
+    RestartPolicy per app before deciding to restartPod)."""
+    if policy == "Never":
+        return False
+    if policy == "OnFailure":
+        return exit_code != 0
+    return True  # Always
+
+
+class CliError(RuntimeError):
+    def __init__(self, message: str, rc: int = 1, output: str = ""):
+        super().__init__(message)
+        self.rc = rc
+        self.output = output
+
+
+class CliRuntime(Runtime):
+    """Runtime implemented over a container CLI + unit supervisor."""
+
+    def __init__(self, cli: List[str], unit_dir: str,
+                 min_version: Tuple[int, ...] = MIN_VERSION,
+                 unit_manager: Optional[UnitManager] = None,
+                 cli_timeout: float = 30.0,
+                 status_cache_ttl: float = 0.5,
+                 auth_dir: Optional[str] = None):
+        self.cli = list(cli)
+        self.units = unit_manager or UnitManager(unit_dir)
+        self.auth_dir = auth_dir or os.path.join(unit_dir, "auth.d")
+        self.cli_timeout = cli_timeout
+        # every status read execs the CLI; the PLEG + status manager +
+        # probers would stack subprocesses without a freshness window
+        # (ref: pkg/kubelet/container/runtime_cache.go — the kubelet
+        # caches GetPods with a TTL for exactly this reason)
+        self._status_cache_ttl = status_cache_ttl
+        self._status_cache: Dict[str, Tuple[float, Optional[dict]]] = {}
+        # version gate at construction (rkt.go:132-183 New refuses to
+        # run against a too-old binary or supervisor)
+        ver = self.version()
+        parsed = tuple(int(p) for p in ver.split("."))
+        width = max(len(parsed), len(min_version))
+        parsed += (0,) * (width - len(parsed))
+        min_padded = tuple(min_version) + (0,) * (width - len(min_version))
+        if parsed < min_padded:
+            raise CliError(
+                f"cli version {ver} older than required "
+                f"{'.'.join(map(str, min_version))}")
+
+    # ------------------------------------------------------------- wire
+
+    def _run(self, *args: str, input_text: Optional[str] = None) -> str:
+        """Exec the CLI; nonzero exit raises (ref: runCommand
+        rkt.go:201-212)."""
+        try:
+            proc = subprocess.run(
+                self.cli + list(args), input=input_text,
+                capture_output=True, text=True, timeout=self.cli_timeout)
+        except subprocess.TimeoutExpired as e:
+            # a hung CLI must surface as a CliError like every other
+            # failure: callers above (PLEG relist, housekeeping) treat
+            # anything else as fatal to their threads
+            raise CliError(f"{' '.join(args[:2])} timed out after "
+                           f"{self.cli_timeout}s") from e
+        if proc.returncode != 0:
+            raise CliError(
+                f"{' '.join(args[:2])} failed: "
+                f"{(proc.stderr or proc.stdout).strip()[:300]}",
+                rc=proc.returncode, output=proc.stdout)
+        return proc.stdout
+
+    def version(self) -> str:
+        """Parse `<cli> version` (ref: rkt.go:1043 Version)."""
+        out = self._run("version")
+        m = re.search(r"Version:\s*([0-9]+(?:\.[0-9]+)*)", out)
+        if not m:
+            raise CliError(f"unparseable version output: {out[:120]!r}")
+        return m.group(1)
+
+    # ------------------------------------------------------ pod records
+
+    def _records(self) -> List[dict]:
+        """Every kubelet-owned unit file, parsed
+        (ref: GetPods rkt.go:937 reads the service directory)."""
+        out = []
+        for name in self.units.unit_names():
+            if not name.startswith(UNIT_PREFIX):
+                continue  # foreign units are invisible to the kubelet
+            rec = self._record(name)
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    def _record(self, unit: str) -> Optional[dict]:
+        try:
+            opts = {(s, k): v for s, k, v in self.units.read_unit(unit)}
+        except FileNotFoundError:
+            return None
+        uid = opts.get((K8S_SECTION, "POD_UID"))
+        if not uid:
+            return None
+        return {
+            "unit": unit, "uid": uid,
+            "name": opts.get((K8S_SECTION, "POD_NAME"), ""),
+            "namespace": opts.get((K8S_SECTION, "POD_NAMESPACE"), ""),
+            "uuid": opts.get((K8S_SECTION, "PREPARED_UUID"), ""),
+            "attempt": int(opts.get((K8S_SECTION, "ATTEMPT"), "0")),
+        }
+
+    def _record_for(self, pod_uid: str) -> Optional[dict]:
+        unit = unit_name_for(pod_uid)
+        if not self.units.has_unit(unit):
+            return None
+        return self._record(unit)
+
+    def _status(self, uuid: str, fresh: bool = False) -> Optional[dict]:
+        """App states for a prepared pod via `<cli> status`
+        (ref: convertRktPod rkt.go:817 reads rkt's pod state). Served
+        from the TTL cache unless fresh=True (runtime_cache.go role)."""
+        if not fresh:
+            cached = self._status_cache.get(uuid)
+            if cached and time.time() - cached[0] < self._status_cache_ttl:
+                return cached[1]
+        try:
+            out = self._run("status", uuid)
+        except CliError:
+            self._status_cache[uuid] = (time.time(), None)
+            return None
+        try:
+            status = json.loads(out)
+        except ValueError:
+            status = None
+        self._status_cache[uuid] = (time.time(), status)
+        return status
+
+    def _forget_status(self, uuid: str) -> None:
+        self._status_cache.pop(uuid, None)
+
+    # ---------------------------------------------------------- Runtime
+
+    def get_pods(self) -> List[RuntimePod]:
+        pods: List[RuntimePod] = []
+        for rec in self._records():
+            status = self._status(rec["uuid"]) or {"apps": {}}
+            unit_active = self.units.unit_state(rec["unit"]) == ACTIVE
+            rp = RuntimePod(uid=rec["uid"], name=rec["name"],
+                            namespace=rec["namespace"])
+            for app_name, app in status.get("apps", {}).items():
+                running = app.get("state") == "running" and unit_active
+                if app.get("state") == "running" and not unit_active:
+                    # the unit died before the pod process could record
+                    # exits (SIGKILL path): reconcile against the
+                    # supervisor's view, like readServiceFile cross-
+                    # checking systemd state (rkt.go:890)
+                    exit_code = 137
+                else:
+                    exit_code = int(app.get("exit_code") or 0)
+                rp.containers.append(RuntimeContainer(
+                    id=f"{rec['uuid']}:{app_name}", name=app_name,
+                    image=app.get("image", ""),
+                    state=(ContainerState.RUNNING if running
+                           else ContainerState.EXITED),
+                    started_at=float(app.get("started_at") or 0.0),
+                    finished_at=float(app.get("finished_at") or 0.0),
+                    exit_code=exit_code,
+                    restart_count=rec["attempt"]))
+            pods.append(rp)
+        return pods
+
+    def _make_manifest(self, pod: api.Pod) -> dict:
+        """Appc-style pod manifest from the spec (ref: makePodManifest
+        rkt.go:424 + setApp :335 — exec is command+args, environment is
+        name/value pairs; kubernetes identity rides annotations)."""
+        apps = []
+        for c in pod.spec.containers:
+            apps.append({
+                "name": c.name,
+                "image": c.image,
+                "app": {
+                    "exec": list(c.command) + list(c.args),
+                    "environment": [{"name": e.name, "value": e.value}
+                                    for e in c.env],
+                },
+            })
+        return {
+            "acVersion": "0.7.4",
+            "acKind": "PodManifest",
+            "apps": apps,
+            "annotations": [
+                {"name": "k8s.io/pod-uid", "value": pod.metadata.uid},
+                {"name": "k8s.io/pod-name", "value": pod.metadata.name},
+                {"name": "k8s.io/pod-namespace",
+                 "value": pod.metadata.namespace},
+            ],
+        }
+
+    def start_container(self, pod: api.Pod, container: api.Container
+                        ) -> RuntimeContainer:
+        """Pod-granular start: if this container's app is already
+        running in the current pod generation, this is a no-op (the
+        generation launched it); otherwise the WHOLE pod restarts as a
+        new generation (ref: SyncPod rkt.go:1156-1219 — any restartable
+        container change -> restartPod)."""
+        uid = pod.metadata.uid
+        rec = self._record_for(uid)
+        if rec is not None:
+            status = self._status(rec["uuid"], fresh=True) or {"apps": {}}
+            app = status.get("apps", {}).get(container.name)
+            if (app is not None and app.get("state") == "running"
+                    and self.units.unit_state(rec["unit"]) == ACTIVE):
+                return RuntimeContainer(
+                    id=f"{rec['uuid']}:{container.name}",
+                    name=container.name, image=container.image,
+                    state=ContainerState.RUNNING,
+                    restart_count=rec["attempt"])
+            if (app is not None and app.get("state") == "exited"
+                    and not _should_restart(
+                        pod.spec.restart_policy,
+                        int(app.get("exit_code") or 0))):
+                # the app already ran in this generation and the policy
+                # forbids another run; a whole-pod restart here (e.g.
+                # for a sibling that raced to completion before the
+                # kubelet's first snapshot) would re-execute it
+                return RuntimeContainer(
+                    id=f"{rec['uuid']}:{container.name}",
+                    name=container.name, image=container.image,
+                    state=ContainerState.EXITED,
+                    exit_code=int(app.get("exit_code") or 0),
+                    restart_count=rec["attempt"])
+        attempt = rec["attempt"] + 1 if rec is not None else 0
+        unit = unit_name_for(uid)
+        if rec is not None:
+            self.units.stop_unit(unit)
+            # the superseded generation's prepared data is dead weight
+            # the moment a new uuid takes the unit over (logs live in
+            # the unit journal, status in the new uuid): collect it now
+            # rather than leaving it for a global sweep — a global
+            # `gc` could reap KEPT corpses and pods mid-prepare
+            if rec["uuid"]:
+                self._forget_status(rec["uuid"])
+                try:
+                    self._run("gc", "--uuid", rec["uuid"])
+                except CliError:
+                    pass
+        uuid = self._run("prepare", "--stdin-manifest",
+                         input_text=json.dumps(
+                             self._make_manifest(pod))).strip()
+        exec_start = " ".join(
+            shlex.quote(a) for a in self.cli + ["run-prepared", uuid])
+        self.units.write_unit(unit, [
+            ("Unit", "Description",
+             f"{pod.metadata.namespace}/{pod.metadata.name}"),
+            ("Service", "ExecStart", exec_start),
+            (K8S_SECTION, "POD_UID", uid),
+            (K8S_SECTION, "POD_NAME", pod.metadata.name),
+            (K8S_SECTION, "POD_NAMESPACE", pod.metadata.namespace),
+            (K8S_SECTION, "PREPARED_UUID", uuid),
+            (K8S_SECTION, "ATTEMPT", str(attempt)),
+        ])
+        self.units.restart_unit(unit)
+        # the pod process records every app "running" at launch; wait
+        # for that first status so same-sync start_container calls for
+        # sibling containers see the new generation (RunPod returns
+        # only after systemd starts the unit, rkt.go:774-806)
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            status = self._status(uuid, fresh=True)
+            if status and status.get("apps"):
+                break
+            if self.units.unit_state(unit) != ACTIVE:
+                # the unit may have run to COMPLETION between polls (a
+                # fast one-shot pod): its final status still counts as
+                # started; only a statusless death is a start failure
+                status = self._status(uuid, fresh=True)
+                if status and status.get("apps"):
+                    break
+                raise CliError(f"pod unit {unit} died at start: "
+                               f"{self.units.journal(unit, 5)!r}")
+            time.sleep(0.02)
+        return RuntimeContainer(
+            id=f"{uuid}:{container.name}", name=container.name,
+            image=container.image, state=ContainerState.RUNNING,
+            restart_count=attempt)
+
+    def kill_container(self, pod_uid: str, name: str) -> None:
+        """No per-app kill exists at this boundary: stop the whole unit
+        and let the restart policy revive the pod (ref: rkt.go:982
+        KillPod; SyncPod's whole-pod restart on liveness failure)."""
+        self.kill_pod(pod_uid, remove=False)
+
+    def kill_pod(self, pod_uid: str, remove: bool = True) -> None:
+        """Stop the unit; with remove=True also drop the unit file and
+        prepared-pod data (the Runtime contract here folds the GC's
+        removal in, like daemon_runtime.kill_pod). remove=False keeps
+        the corpse for logs/status and touches the service file so the
+        min-age GC defers (rkt.go:991-999)."""
+        unit = unit_name_for(pod_uid)
+        if not self.units.has_unit(unit):
+            return
+        rec = self._record(unit)
+        self.units.stop_unit(unit)
+        if rec and rec["uuid"]:
+            self._forget_status(rec["uuid"])
+        if not remove:
+            self.units.touch(unit)
+            return
+        self.units.remove_unit(unit)
+        if rec and rec["uuid"]:
+            try:
+                self._run("gc", "--uuid", rec["uuid"])
+            except CliError:
+                pass  # prepared data already gone
+
+    def get_container_logs(self, pod_uid: str, name: str,
+                           tail_lines: int = 0) -> str:
+        """Logs ride the unit journal; the pod process tags each line
+        with its app name, so per-container logs are a journal filter
+        (ref: GetContainerLogs -> journalctl -u <unit>)."""
+        rec = self._record_for(pod_uid)
+        if rec is None:
+            raise KeyError(f"pod {pod_uid!r} not found")
+        status = self._status(rec["uuid"]) or {"apps": {}}
+        if name not in status.get("apps", {}):
+            raise KeyError(f"container {name!r} not found")
+        prefix = f"{name}: "
+        lines = [ln[len(prefix):] + "\n"
+                 for ln in self.units.journal(rec["unit"]).splitlines()
+                 if ln.startswith(prefix)]
+        return tail_text("".join(lines), tail_lines)
+
+    def exec_in_container(self, pod_uid: str, name: str,
+                          cmd: List[str]) -> Tuple[int, str]:
+        """(ref: ExecInContainer -> `rkt enter --app=<name> <uuid>`)"""
+        rec = self._record_for(pod_uid)
+        if rec is None:
+            raise KeyError(f"pod {pod_uid!r} not found")
+        status = self._status(rec["uuid"], fresh=True) or {"apps": {}}
+        app = status.get("apps", {}).get(name)
+        if app is None or app.get("state") != "running":
+            raise KeyError(f"container {name!r} not running")
+        try:
+            proc = subprocess.run(
+                self.cli + ["enter", f"--app={name}", rec["uuid"], "--"]
+                + list(cmd),
+                capture_output=True, text=True, timeout=self.cli_timeout,
+                stdin=subprocess.DEVNULL)
+        except subprocess.TimeoutExpired:
+            # same convention as subprocess_runtime: timeout is exit
+            # 124 + message, never a raw exception into the server
+            return 124, f"exec timed out after {self.cli_timeout}s"
+        return proc.returncode, proc.stdout + proc.stderr
+
+    def pull_image(self, image: str, keyring=None) -> None:
+        """(ref: PullImage rkt.go:1093 — `rkt fetch`). Registry
+        credentials are not flags: the reference writes them into the
+        CLI's auth config dir before fetching (writeDockerAuthConfig
+        rkt.go:1049, the /etc/rkt/auth.d shape); this adapter does the
+        same into its auth_dir so imagePullSecrets actually reach the
+        fetch."""
+        if keyring is not None:
+            creds = keyring.lookup(image)
+            if creds:
+                from .credentialprovider import image_registry
+                # most specific credential wins (keyring order)
+                self._write_auth_config(image_registry(image), creds[0])
+        self._run("fetch", image)
+
+    def _write_auth_config(self, registry: str, cred) -> None:
+        """One dockerAuth config file per registry (rkt.go:1049-1091
+        writes {rktKind: dockerAuth, registries, credentials})."""
+        os.makedirs(self.auth_dir, exist_ok=True)
+        path = os.path.join(self.auth_dir,
+                            f"{registry.replace('/', '_')}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "rktKind": "dockerAuth",
+                "rktVersion": "v1",
+                "registries": [registry],
+                "credentials": {"user": cred.username,
+                                "password": cred.password},
+            }, f)
+        os.replace(tmp, path)
+
+    def pod_port_address(self, pod_uid: str, port: int) -> Tuple[str, int]:
+        """Pods at this boundary run host-networked (the fake CLI's
+        apps are host processes), so ports are loopback-reachable."""
+        return ("127.0.0.1", port)
+
+    # --------------------------------------------------------------- GC
+
+    def garbage_collect(self, keep_uids: Iterable[str] = (),
+                        min_age_seconds: float = 60.0) -> int:
+        """(ref: GarbageCollect rkt.go:1221-1260 — reset failed units,
+        remove inactive service files, gc the removed units' prepared
+        pods; superseded generations are collected at replacement time
+        in start_container, so no global sweep is needed.)
+        keep_uids guards pods the kubelet still desires: the reference
+        swept every inactive unit, which could re-trigger a completed
+        pod's start under a restart-from-missing sync — the desired-set
+        guard closes that hole while keeping the sweep shape. The
+        min-age gate reads the service-file mtime the stop path
+        touches (rkt.go:991)."""
+        keep = set(keep_uids)
+        removed = 0
+        self.units.reset_failed()
+        for rec in self._records():
+            if rec["uid"] in keep:
+                continue
+            unit = rec["unit"]
+            if self.units.unit_state(unit) == ACTIVE:
+                continue
+            if self.units.unit_age(unit) < min_age_seconds:
+                continue
+            self.units.remove_unit(unit)
+            if rec["uuid"]:
+                self._forget_status(rec["uuid"])
+                try:
+                    self._run("gc", "--uuid", rec["uuid"])
+                except CliError:
+                    pass
+            removed += 1
+        return removed

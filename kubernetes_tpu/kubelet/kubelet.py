@@ -126,6 +126,11 @@ class Kubelet:
         self._container_gc = (ContainerGC(self.runtime)
                               if ContainerGC.supports(self.runtime)
                               else None)
+        # pod-granular runtimes (cli_runtime) GC their own unit files
+        # instead of per-container records (rkt.go:1221 GarbageCollect,
+        # driven from the kubelet's GC loop like the container GC)
+        self._pod_gc = (self._container_gc is None
+                        and hasattr(self.runtime, "garbage_collect"))
         self._last_container_gc = 0.0
 
     # --------------------------------------------------- pod accounting
@@ -372,15 +377,25 @@ class Kubelet:
         cleanupOrphanedPodDirs), and prune dead containers on runtimes
         that accumulate them (dockertools/container_gc.go)."""
         now = time.time()
-        if self._container_gc is not None and \
+        with self._lock:
+            known = set(self._pods)
+        if (self._container_gc is not None or self._pod_gc) and \
                 now - self._last_container_gc >= CONTAINER_GC_PERIOD:
             self._last_container_gc = now
             try:
-                self._container_gc.garbage_collect()
+                if self._container_gc is not None:
+                    self._container_gc.garbage_collect()
+                else:
+                    # desired pods are never swept, even when their
+                    # unit is between generations (see cli_runtime
+                    # garbage_collect)
+                    self.runtime.garbage_collect(keep_uids=known)
             except Exception:
                 pass  # next pass retries
-        with self._lock:
-            known = set(self._pods)
+            # GC can be slow (CLI execs): re-snapshot so pods bound
+            # meanwhile aren't killed as orphans below
+            with self._lock:
+                known = set(self._pods)
         for rp in self.runtime.get_pods():
             if rp.uid not in known:
                 self.runtime.kill_pod(rp.uid)

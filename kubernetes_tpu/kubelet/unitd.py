@@ -1,0 +1,295 @@
+"""Unit manager — the systemd role the CLI-shaped runtime drives.
+
+Reference: pkg/kubelet/rkt/rkt.go — the rkt runtime never supervises
+processes itself; it writes a systemd service file per pod
+(preparePod rkt.go:626-729, unit options built via go-systemd's
+newUnitOption rkt.go:592) and then drives systemd over dbus:
+RestartUnit with the "replace" mode (rkt.go:806), StopUnit
+(rkt.go:1000), ListUnits + ResetFailed during GarbageCollect
+(rkt.go:1221-1260), and reads the unit's journal for logs
+(journalctl -u role). This module is that supervisor boundary for the
+TPU-native kubelet: units are INI files in a directory, ExecStart is
+spawned as a real OS process group, and the unit's combined
+stdout/stderr is its journal file.
+
+The unit FILE's mtime is load-bearing exactly as in the reference:
+KillPod touches the service file so a freshly stopped pod is not
+immediately garbage-collected (rkt.go:991-999); the GC's min-age check
+reads it back.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .container import tail_text
+
+UnitOption = Tuple[str, str, str]  # (section, key, value)
+
+ACTIVE = "active"        # ExecStart process is running
+INACTIVE = "inactive"    # never started here, or exited 0, or reset
+FAILED = "failed"        # exited nonzero / killed
+
+
+def _proc_start_time(pid: int) -> str:
+    """/proc starttime (field 22) — a (pid, starttime) pair survives
+    PID recycling; a bare pid does not."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rpartition(")")[2].split()[19]
+    except (OSError, IndexError):
+        return ""
+
+
+def _pgroup_alive(pid: int) -> bool:
+    """True while the process group leader is a live (non-zombie)
+    process. killpg(pid, 0) alone is not enough: an exited-but-unreaped
+    leader (possible when adopter and spawner share a process, as in
+    tests) still accepts signal 0; /proc state distinguishes it."""
+    try:
+        os.killpg(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # field 3 (after the parenthesized comm, which may contain
+            # spaces) is the state letter
+            state = f.read().rpartition(")")[2].split()[0]
+        return state != "Z"
+    except (OSError, IndexError):
+        return True  # no /proc: trust the signal probe
+
+
+class UnitManager:
+    """Filesystem-backed unit supervisor (the systemdInterface seam)."""
+
+    def __init__(self, unit_dir: str):
+        os.makedirs(unit_dir, exist_ok=True)
+        self.unit_dir = unit_dir
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- adoption
+
+    def _pid_path(self, name: str) -> str:
+        return self._path(name) + ".pid"
+
+    def _adopted_pid(self, name: str) -> Optional[int]:
+        """A live process group from a PREVIOUS manager (the systemd
+        property the reference leans on: units outlive the kubelet, and
+        a restarted kubelet re-attaches instead of double-launching).
+        The pid rides a pidfile next to the unit; liveness is probed
+        with signal 0 against the process group."""
+        with self._lock:
+            if name in self._procs:
+                return None  # tracked in-process, not adopted
+        try:
+            with open(self._pid_path(name)) as f:
+                fields = f.read().split()
+                pid = int(fields[0])
+                start_time = fields[1] if len(fields) > 1 else ""
+        except (OSError, ValueError, IndexError):
+            return None
+        if not _pgroup_alive(pid):
+            return None
+        # identity check: a recycled pid must not be adopted (or
+        # killed) as if it were the unit (start-time pairing)
+        if start_time and _proc_start_time(pid) != start_time:
+            return None
+        return pid
+
+    # ------------------------------------------------------- unit files
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.unit_dir, name)
+
+    def _journal_path(self, name: str) -> str:
+        return self._path(name) + ".journal"
+
+    def write_unit(self, name: str, options: List[UnitOption]) -> None:
+        """Serialize ordered unit options into an INI-style service file
+        (ref: unit.Serialize over newUnitOption lists, rkt.go:684-701).
+        Atomic: a reader never sees a half-written unit."""
+        lines: List[str] = []
+        current: Optional[str] = None
+        for section, key, value in options:
+            if section != current:
+                if lines:
+                    lines.append("")
+                lines.append(f"[{section}]")
+                current = section
+            lines.append(f"{key}={value}")
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, self._path(name))
+
+    def read_unit(self, name: str) -> List[UnitOption]:
+        """Parse a service file back into ordered (section, key, value)
+        options (ref: readServiceFile rkt.go:890-935)."""
+        options: List[UnitOption] = []
+        section = ""
+        with open(self._path(name)) as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.startswith("[") and line.endswith("]"):
+                    section = line[1:-1]
+                    continue
+                key, _, value = line.partition("=")
+                options.append((section, key, value))
+        return options
+
+    def unit_option(self, name: str, section: str, key: str,
+                    default: Optional[str] = None) -> Optional[str]:
+        for sec, k, v in self.read_unit(name):
+            if sec == section and k == key:
+                return v
+        return default
+
+    def unit_names(self) -> List[str]:
+        return sorted(f for f in os.listdir(self.unit_dir)
+                      if f.endswith(".service"))
+
+    def has_unit(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def unit_age(self, name: str) -> float:
+        return time.time() - os.path.getmtime(self._path(name))
+
+    def touch(self, name: str) -> None:
+        """Update the service file's mtime — the reference's trick for
+        deferring GC of a just-stopped pod (rkt.go:991-999)."""
+        os.utime(self._path(name), None)
+
+    # -------------------------------------------------------- lifecycle
+
+    def restart_unit(self, name: str) -> None:
+        """'replace' semantics (rkt.go:806 RestartUnit(name, "replace")):
+        stop whatever instance is running, then start a fresh one from
+        the CURRENT service file's ExecStart."""
+        self.stop_unit(name)
+        exec_start = self.unit_option(name, "Service", "ExecStart")
+        if not exec_start:
+            raise ValueError(f"unit {name!r} has no ExecStart")
+        argv = shlex.split(exec_start)
+        journal = open(self._journal_path(name), "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, stdout=journal, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, start_new_session=True)
+        finally:
+            journal.close()  # the child owns the descriptor now
+        with open(self._pid_path(name), "w") as f:
+            f.write(f"{proc.pid} {_proc_start_time(proc.pid)}")
+        with self._lock:
+            self._procs[name] = proc
+
+    def stop_unit(self, name: str, grace: float = 5.0) -> None:
+        """SIGTERM the unit's process group, escalate to SIGKILL after
+        the grace period (systemd's default stop behavior; the rkt pod
+        process forwards the signal to its apps)."""
+        with self._lock:
+            proc = self._procs.get(name)
+        if proc is not None:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    proc.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    proc.wait()
+            # the leader may be gone while group members survive (a
+            # crashed pod process leaves its apps behind): sweep the
+            # group unconditionally before declaring the unit stopped
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            return
+        # adopted process group from a previous manager instance
+        pid = self._adopted_pid(name)
+        if pid is None:
+            return
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            if not _pgroup_alive(pid):
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def unit_state(self, name: str) -> str:
+        """active | inactive | failed, from the supervised process.
+        A unit started by a PREVIOUS manager whose process group still
+        lives reads ACTIVE via its pidfile (systemd's re-attach
+        property); one that is gone reads inactive — the state the
+        reference's GC sweeps (rkt.go:1230-1243)."""
+        with self._lock:
+            proc = self._procs.get(name)
+        if proc is None:
+            return ACTIVE if self._adopted_pid(name) is not None \
+                else INACTIVE
+        rc = proc.poll()
+        if rc is None:
+            return ACTIVE
+        return INACTIVE if rc == 0 else FAILED
+
+    def list_units(self) -> Dict[str, str]:
+        """(ref: systemd ListUnits, rkt.go:1231)"""
+        return {name: self.unit_state(name) for name in self.unit_names()}
+
+    def reset_failed(self) -> None:
+        """Clear failed-state records (systemctl reset-failed; the
+        reference calls it first thing in GarbageCollect, rkt.go:1222)."""
+        with self._lock:
+            for name in list(self._procs):
+                proc = self._procs[name]
+                rc = proc.poll()
+                if rc is not None and rc != 0:
+                    del self._procs[name]
+
+    def remove_unit(self, name: str) -> None:
+        """Stop + delete the service file and its journal
+        (ref: GC's os.Remove of inactive service files, rkt.go:1250-1253)."""
+        self.stop_unit(name)
+        with self._lock:
+            self._procs.pop(name, None)
+        for path in (self._path(name), self._journal_path(name),
+                     self._pid_path(name)):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    # ---------------------------------------------------------- journal
+
+    def journal(self, name: str, tail_lines: int = 0) -> str:
+        """The unit's captured stdout/stderr (journalctl -u role — the
+        reference reads pod logs straight from the journal because the
+        pod's apps write there, rkt.go GetContainerLogs)."""
+        try:
+            with open(self._journal_path(name), "rb") as f:
+                text = f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+        return tail_text(text, tail_lines)

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""A container-CLI fake for the CliRuntime tests — the rkt role.
+
+NOT product code: the CLI binary is the external runtime in this
+boundary (rkt itself), so the fake plays its part the way MockDaemon
+plays docker-engine's in test_daemon_runtime.py. The real adapter code
+(kubernetes_tpu/kubelet/cli_runtime.py + unitd.py) is what's under
+test; this script gives it a wire-faithful counterpart:
+
+  version                          -> "fake-rkt Version: X.Y.Z"
+  prepare --stdin-manifest         -> reads an appc pod manifest on
+                                      stdin, stores it, prints a uuid
+  run-prepared <uuid>              -> the pod PROCESS (the unit's
+                                      ExecStart): spawns every app as
+                                      a real child, tags each output
+                                      line "<app>: " (journal role),
+                                      records app states in
+                                      status.json, forwards SIGTERM
+  status <uuid>                    -> status.json as JSON
+  list                             -> every pod's uuid + state
+  enter --app=A <uuid> -- cmd...   -> run cmd, exit with its rc
+  fetch <image>                    -> record the image as fetched
+  gc [--uuid U]                    -> remove exited prepared pods
+
+Apps run as host processes (like the subprocess runtime's containers),
+so kubelet tests observe real crashes, real exit codes, real logs.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid as uuidlib
+
+
+def pods_root(base):
+    return os.path.join(base, "pods")
+
+
+def pod_dir(base, uuid):
+    return os.path.join(pods_root(base), uuid)
+
+
+def write_json_atomic(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def read_json(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def cmd_version(base, argv):
+    print("fake-rkt Version: 1.4.0")
+    print("appc Version: 0.7.4")
+    return 0
+
+
+def cmd_prepare(base, argv):
+    if "--stdin-manifest" not in argv:
+        print("prepare: only --stdin-manifest supported", file=sys.stderr)
+        return 1
+    manifest = json.load(sys.stdin)
+    uuid = uuidlib.uuid4().hex[:16]
+    d = pod_dir(base, uuid)
+    os.makedirs(d)
+    write_json_atomic(os.path.join(d, "manifest.json"), manifest)
+    write_json_atomic(os.path.join(d, "status.json"),
+                      {"state": "prepared", "apps": {}})
+    print(uuid)
+    return 0
+
+
+def cmd_run_prepared(base, argv):
+    uuid = argv[0]
+    d = pod_dir(base, uuid)
+    manifest = read_json(os.path.join(d, "manifest.json"))
+    status_path = os.path.join(d, "status.json")
+    status = {"state": "running", "apps": {}}
+    procs = {}
+    for app in manifest.get("apps", []):
+        spec = app.get("app", {})
+        env = dict(os.environ)
+        env.update({e["name"]: e["value"]
+                    for e in spec.get("environment", [])})
+        p = subprocess.Popen(
+            spec.get("exec") or ["true"], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        procs[app["name"]] = p
+        status["apps"][app["name"]] = {
+            "state": "running", "image": app.get("image", ""),
+            "pid": p.pid, "started_at": time.time(), "exit_code": None}
+    write_json_atomic(status_path, status)
+
+    def on_term(signum, frame):
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    lock = threading.Lock()
+    exit_codes = {}
+
+    def pump(name, p):
+        for line in p.stdout:
+            sys.stdout.write(f"{name}: {line}")
+            sys.stdout.flush()
+
+    def reap(name, p):
+        # apps exit in ANY order; each is recorded the moment it does
+        # (a sequential wait would stall status updates for every app
+        # behind a still-running sibling)
+        rc = p.wait()
+        if rc < 0:
+            rc = 128 - rc  # killed by signal -> 128+N, shell convention
+        with lock:
+            exit_codes[name] = rc
+            status["apps"][name].update(
+                state="exited", exit_code=rc, finished_at=time.time())
+            write_json_atomic(status_path, status)
+
+    pumpers = [threading.Thread(target=pump, args=item, daemon=True)
+               for item in procs.items()]
+    reapers = [threading.Thread(target=reap, args=item)
+               for item in procs.items()]
+    for t in pumpers + reapers:
+        t.start()
+    for t in reapers:
+        t.join()
+    for t in pumpers:
+        t.join(timeout=2)
+    overall = 1 if any(rc != 0 for rc in exit_codes.values()) else 0
+    status["state"] = "exited"
+    write_json_atomic(status_path, status)
+    return overall
+
+
+def cmd_status(base, argv):
+    path = os.path.join(pod_dir(base, argv[0]), "status.json")
+    if not os.path.exists(path):
+        print(f"no such pod {argv[0]}", file=sys.stderr)
+        return 1
+    print(json.dumps(read_json(path)))
+    return 0
+
+
+def cmd_list(base, argv):
+    out = []
+    root = pods_root(base)
+    for uuid in (os.listdir(root) if os.path.isdir(root) else []):
+        try:
+            st = read_json(os.path.join(root, uuid, "status.json"))
+        except (OSError, ValueError):
+            continue
+        out.append({"uuid": uuid, "state": st.get("state", "unknown")})
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_enter(base, argv):
+    app = None
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a.startswith("--app="):
+            app = a.split("=", 1)[1]
+        elif a == "--":
+            rest = list(it)
+            break
+        else:
+            uuid = a
+    path = os.path.join(pod_dir(base, uuid), "status.json")
+    if not os.path.exists(path):
+        print(f"no such pod {uuid}", file=sys.stderr)
+        return 1
+    st = read_json(path)
+    if st.get("apps", {}).get(app, {}).get("state") != "running":
+        print(f"app {app} not running", file=sys.stderr)
+        return 1
+    r = subprocess.run(rest, capture_output=True, text=True)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    return r.returncode
+
+
+def cmd_fetch(base, argv):
+    with open(os.path.join(base, "fetched.txt"), "a") as f:
+        f.write(argv[0] + "\n")
+    print("sha512-" + uuidlib.uuid4().hex)
+    return 0
+
+
+def cmd_gc(base, argv):
+    target = None
+    if argv and argv[0] == "--uuid":
+        target = argv[1]
+    root = pods_root(base)
+    for uuid in (os.listdir(root) if os.path.isdir(root) else []):
+        if target is not None and uuid != target:
+            continue
+        try:
+            st = read_json(os.path.join(root, uuid, "status.json"))
+        except (OSError, ValueError):
+            st = {}
+        if target is not None or st.get("state") in ("exited", "prepared"):
+            shutil.rmtree(os.path.join(root, uuid), ignore_errors=True)
+    return 0
+
+
+COMMANDS = {
+    "version": cmd_version,
+    "prepare": cmd_prepare,
+    "run-prepared": cmd_run_prepared,
+    "status": cmd_status,
+    "list": cmd_list,
+    "enter": cmd_enter,
+    "fetch": cmd_fetch,
+    "gc": cmd_gc,
+}
+
+
+def main(argv):
+    base = None
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a == "--dir":
+            base = next(it)
+        elif a.startswith("--dir="):
+            base = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if base is None or not rest:
+        print("usage: fake_rkt.py --dir DATA <command> ...",
+              file=sys.stderr)
+        return 2
+    os.makedirs(base, exist_ok=True)
+    cmd = COMMANDS.get(rest[0])
+    if cmd is None:
+        print(f"unknown command {rest[0]!r}", file=sys.stderr)
+        return 2
+    return cmd(base, rest[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

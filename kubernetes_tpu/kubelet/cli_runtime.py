@@ -101,6 +101,10 @@ class CliRuntime(Runtime):
         # caches GetPods with a TTL for exactly this reason)
         self._status_cache_ttl = status_cache_ttl
         self._status_cache: Dict[str, Tuple[float, Optional[dict]]] = {}
+        # uuids whose per-uuid gc failed transiently; retried by the
+        # next garbage_collect sweep (there is deliberately no global
+        # gc to backstop them)
+        self._orphan_uuids: set = set()
         # version gate at construction (rkt.go:132-183 New refuses to
         # run against a too-old binary or supervisor)
         ver = self.version()
@@ -304,10 +308,7 @@ class CliRuntime(Runtime):
             # `gc` could reap KEPT corpses and pods mid-prepare
             if rec["uuid"]:
                 self._forget_status(rec["uuid"])
-                try:
-                    self._run("gc", "--uuid", rec["uuid"])
-                except CliError:
-                    pass
+                self._gc_uuid(rec["uuid"])
         uuid = self._run("prepare", "--stdin-manifest",
                          input_text=json.dumps(
                              self._make_manifest(pod))).strip()
@@ -370,12 +371,13 @@ class CliRuntime(Runtime):
         if not remove:
             self.units.touch(unit)
             return
-        self.units.remove_unit(unit)
+        # gc the prepared data BEFORE dropping the unit record: the
+        # unit file is the only pointer to the uuid, so a failed gc
+        # after removal would leak the pod directory unreachably (the
+        # orphan set backstops a transient failure either way)
         if rec and rec["uuid"]:
-            try:
-                self._run("gc", "--uuid", rec["uuid"])
-            except CliError:
-                pass  # prepared data already gone
+            self._gc_uuid(rec["uuid"])
+        self.units.remove_unit(unit)
 
     def get_container_logs(self, pod_uid: str, name: str,
                            tail_lines: int = 0) -> str:
@@ -434,11 +436,13 @@ class CliRuntime(Runtime):
     def _write_auth_config(self, registry: str, cred) -> None:
         """One dockerAuth config file per registry (rkt.go:1049-1091
         writes {rktKind: dockerAuth, registries, credentials})."""
-        os.makedirs(self.auth_dir, exist_ok=True)
+        os.makedirs(self.auth_dir, mode=0o700, exist_ok=True)
         path = os.path.join(self.auth_dir,
                             f"{registry.replace('/', '_')}.json")
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        # plaintext registry password: owner-only, like /etc/rkt/auth.d
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump({
                 "rktKind": "dockerAuth",
                 "rktVersion": "v1",
@@ -470,6 +474,8 @@ class CliRuntime(Runtime):
         keep = set(keep_uids)
         removed = 0
         self.units.reset_failed()
+        for uuid in list(self._orphan_uuids):
+            self._gc_uuid(uuid)  # retry transiently-failed collections
         for rec in self._records():
             if rec["uid"] in keep:
                 continue
@@ -478,12 +484,19 @@ class CliRuntime(Runtime):
                 continue
             if self.units.unit_age(unit) < min_age_seconds:
                 continue
-            self.units.remove_unit(unit)
             if rec["uuid"]:
                 self._forget_status(rec["uuid"])
-                try:
-                    self._run("gc", "--uuid", rec["uuid"])
-                except CliError:
-                    pass
+                self._gc_uuid(rec["uuid"])
+            self.units.remove_unit(unit)
             removed += 1
         return removed
+
+    def _gc_uuid(self, uuid: str) -> None:
+        """Collect one prepared pod; a failure parks the uuid in the
+        orphan set for the next sweep instead of leaking it."""
+        try:
+            self._run("gc", "--uuid", uuid)
+        except CliError:
+            self._orphan_uuids.add(uuid)
+        else:
+            self._orphan_uuids.discard(uuid)

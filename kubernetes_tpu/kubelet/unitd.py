@@ -73,6 +73,7 @@ class UnitManager:
         os.makedirs(unit_dir, exist_ok=True)
         self.unit_dir = unit_dir
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._start_times: Dict[str, str] = {}  # name -> leader starttime
         self._lock = threading.Lock()
 
     # ------------------------------------------------------- adoption
@@ -99,8 +100,13 @@ class UnitManager:
         if not _pgroup_alive(pid):
             return None
         # identity check: a recycled pid must not be adopted (or
-        # killed) as if it were the unit (start-time pairing)
-        if start_time and _proc_start_time(pid) != start_time:
+        # killed) as if it were the unit (start-time pairing). An
+        # EMPTY observed start time with a live group means the leader
+        # died but group members survive — the pgid cannot have been
+        # recycled while the group lives, so it is still ours and must
+        # remain adoptable (else leader-crash orphans leak forever).
+        observed = _proc_start_time(pid)
+        if start_time and observed and observed != start_time:
             return None
         return pid
 
@@ -187,10 +193,12 @@ class UnitManager:
                 stdin=subprocess.DEVNULL, start_new_session=True)
         finally:
             journal.close()  # the child owns the descriptor now
+        leader_start = _proc_start_time(proc.pid)
         with open(self._pid_path(name), "w") as f:
-            f.write(f"{proc.pid} {_proc_start_time(proc.pid)}")
+            f.write(f"{proc.pid} {leader_start}")
         with self._lock:
             self._procs[name] = proc
+            self._start_times[name] = leader_start
 
     def stop_unit(self, name: str, grace: float = 5.0) -> None:
         """SIGTERM the unit's process group, escalate to SIGKILL after
@@ -214,7 +222,17 @@ class UnitManager:
                     proc.wait()
             # the leader may be gone while group members survive (a
             # crashed pod process leaves its apps behind): sweep the
-            # group unconditionally before declaring the unit stopped
+            # group before declaring the unit stopped. Identity-guard
+            # it: if /proc shows a DIFFERENT process now owning the
+            # pid, our group is fully gone and the pid was recycled —
+            # killing it would hit an innocent process group. (A live
+            # group pins its pgid against recycling, so an empty or
+            # matching observation is safely ours.)
+            with self._lock:
+                recorded = self._start_times.get(name, "")
+            observed = _proc_start_time(proc.pid)
+            if recorded and observed and observed != recorded:
+                return
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
@@ -274,6 +292,7 @@ class UnitManager:
         self.stop_unit(name)
         with self._lock:
             self._procs.pop(name, None)
+            self._start_times.pop(name, None)
         for path in (self._path(name), self._journal_path(name),
                      self._pid_path(name)):
             try:

@@ -122,7 +122,7 @@ def test_leader_crash_sweeps_group_survivors(tmp_path):
     leader = um._procs["g.service"]
     leader.wait(timeout=10)  # leader exits 0; `sleep 60` survives
     from kubernetes_tpu.kubelet.unitd import _pgroup_alive
-    assert wait_for(lambda: _pgroup_alive(leader.pid) or True)
+    assert wait_for(lambda: _pgroup_alive(leader.pid))
     um.stop_unit("g.service")
     # the sweep's SIGKILL is asynchronous: poll for group death
     assert wait_for(lambda: not _pgroup_alive(leader.pid))
@@ -253,11 +253,13 @@ def test_logs_exec_fetch(tmp_path):
     kr.add("reg.example.com", DockerCredential(username="u",
                                                password="p"))
     rt.pull_image("reg.example.com/team/app:v1", keyring=kr)
-    cfg = _json.loads(
-        (tmp_path / "units" / "auth.d" /
-         "reg.example.com.json").read_text())
+    auth_path = tmp_path / "units" / "auth.d" / "reg.example.com.json"
+    cfg = _json.loads(auth_path.read_text())
     assert cfg["credentials"] == {"user": "u", "password": "p"}
     assert cfg["registries"] == ["reg.example.com"]
+    # plaintext password: owner-only file in an owner-only dir
+    assert (auth_path.stat().st_mode & 0o777) == 0o600
+    assert (auth_path.parent.stat().st_mode & 0o777) == 0o700
     rt.kill_pod("uid-cp")
 
 
@@ -330,9 +332,22 @@ def test_gc_sweeps_inactive_units(tmp_path):
     # min-age defers fresh corpses (mtime gate, rkt.go:991)
     assert rt.garbage_collect(min_age_seconds=3600.0) == 0
     assert rt.units.has_unit(unit)
-    # undesired + old enough -> unit file and prepared data both go
+    # a transiently-failing per-uuid gc parks the uuid for retry
+    # instead of leaking the prepared data unreachably
+    real_run = rt._run
+
+    def flaky_run(*args, **kw):
+        if args and args[0] == "gc":
+            raise CliError("simulated gc wedge")
+        return real_run(*args, **kw)
+
+    rt._run = flaky_run
     assert rt.garbage_collect(min_age_seconds=0.0) == 1
-    assert not rt.units.has_unit(unit)
+    assert not rt.units.has_unit(unit)  # unit record swept...
+    assert len(rt._orphan_uuids) == 1   # ...uuid parked, not lost
+    rt._run = real_run
+    rt.garbage_collect(min_age_seconds=0.0)  # retry collects it
+    assert rt._orphan_uuids == set()
     assert rt.get_pods() == []
     pods_root = tmp_path / "rktdata" / "pods"
     assert not any(pods_root.iterdir()) if pods_root.exists() else True

@@ -52,6 +52,14 @@ def _filter_kernel(n_real_nodes: int,
                    out):
     j = pl.program_id(1)
 
+    # Mosaic note: no boolean splats or boolean accumulators in this
+    # body. `jnp.zeros(..., bool_)` / `ones(..., bool_)` materialize as
+    # i8 vectors that Mosaic then `arith.trunci`s to i1 — a lowering it
+    # rejects ("Unsupported target bitwidth for truncation", observed
+    # on real v5e, TPU_EVIDENCE.json r4). Bitset conflicts therefore
+    # accumulate in u32 and compare to zero ONCE; i1 values only ever
+    # come from comparisons.
+
     # ---- PodFitsResources (predicates.go:192-222) ----
     fits_count = pod_count[:] < pod_cap[:]                      # [1, BN]
     cap_c = cpu_cap[:]
@@ -59,37 +67,41 @@ def _filter_kernel(n_real_nodes: int,
     free_cpu = (cap_c == 0) | (cap_c - cpu_used[:] >= preq_cpu[:])
     free_mem = (cap_m == 0) | (cap_m - mem_used[:] >= preq_mem[:])
     not_exceeded = (exceed_cpu[:] == 0) & (exceed_mem[:] == 0)
-    res_ok = jnp.where(pzero[:] != 0, fits_count,
-                       fits_count & not_exceeded & free_cpu & free_mem)
+    # where(zero_req, fits_count, fits_count & rest)
+    #   == fits_count & (zero_req | rest)
+    res_ok = fits_count & ((pzero[:] != 0)
+                           | (not_exceeded & free_cpu & free_mem))
 
     # ---- PodFitsHostPorts (predicates.go:403-415) ----
     pw = pports.shape[1]
-    port_conflict = jnp.zeros(out.shape, jnp.bool_)
+    port_acc = jnp.zeros(out.shape, jnp.uint32)
     for w in range(pw):
-        port_conflict |= (port_bits_t[w:w + 1, :]
-                          & pports[:, w:w + 1]) != 0
+        port_acc = port_acc | (port_bits_t[w:w + 1, :]
+                               & pports[:, w:w + 1])
+    port_ok = port_acc == 0
 
     # ---- MatchNodeSelector (predicates.go:250 via label bitsets) ----
     lw = psel.shape[1]
-    sel_ok = jnp.ones(out.shape, jnp.bool_)
+    sel_acc = jnp.zeros(out.shape, jnp.uint32)
     for w in range(lw):
-        sel_ok &= (psel[:, w:w + 1] & ~labels_t[w:w + 1, :]) == 0
+        sel_acc = sel_acc | (psel[:, w:w + 1] & ~labels_t[w:w + 1, :])
+    sel_ok = sel_acc == 0
 
     # ---- NoDiskConflict (predicates.go:127-137) ----
     kw = pqany.shape[1]
-    disk_conflict = jnp.zeros(out.shape, jnp.bool_)
+    disk_acc = jnp.zeros(out.shape, jnp.uint32)
     for w in range(kw):
-        disk_conflict |= ((disk_any_t[w:w + 1, :] & pqany[:, w:w + 1])
-                          | (disk_rw_t[w:w + 1, :]
-                             & pqrw[:, w:w + 1])) != 0
+        disk_acc = disk_acc | (disk_any_t[w:w + 1, :] & pqany[:, w:w + 1]) \
+                            | (disk_rw_t[w:w + 1, :] & pqrw[:, w:w + 1])
+    disk_ok = disk_acc == 0
 
     # ---- PodFitsHost (predicates.go:258) ----
     node_idx = j * BN + jax.lax.broadcasted_iota(jnp.int32, out.shape, 1)
     host_ok = (phost[:] == -1) | (node_idx == phost[:])
 
     mask = ((valid[:] != 0) & (pvalid[:] != 0) & res_ok
-            & jnp.logical_not(port_conflict) & sel_ok & host_ok
-            & jnp.logical_not(disk_conflict) & (static_mask[:] != 0)
+            & port_ok & sel_ok & host_ok & disk_ok
+            & (static_mask[:] != 0)
             & (node_idx < n_real_nodes))
     out[:] = mask.astype(jnp.int32)
 
@@ -145,10 +157,14 @@ def _filter_call(node_args, state_args, pod_args, interpret=False):
     grid = (p_pad // BP, n_pad // BN)
 
     def nspec(a):
-        return pl.BlockSpec((a.shape[0], BN), lambda i, j: (0, j))
+        # index maps must return uniformly-typed block indices: a bare
+        # python 0 traces i64 next to the i32 grid index and Mosaic's
+        # AOT path rejects the (i64, i32) func.return (observed on
+        # real v5e); i * 0 stays i32
+        return pl.BlockSpec((a.shape[0], BN), lambda i, j: (i * 0, j))
 
     def pspec(a):
-        return pl.BlockSpec((BP, a.shape[1]), lambda i, j: (i, 0))
+        return pl.BlockSpec((BP, a.shape[1]), lambda i, j: (i, j * 0))
 
     out = pl.pallas_call(
         functools.partial(_filter_kernel, n),

@@ -289,10 +289,12 @@ class SecurityContextDeny(Interface):
         pod: api.Pod = attributes.object
         if pod.spec.host_network:
             raise Forbidden("pod.spec.hostNetwork is forbidden")
+        from ..kubelet.securitycontext import effective_privileged
         for c in pod.spec.containers:
             sc = getattr(c, "security_context", None)
-            if getattr(c, "privileged", False) or \
-                    (sc is not None and sc.privileged):
+            # same flat-or-nested resolution the runtime grants by —
+            # admission and enforcement must police one predicate
+            if effective_privileged(c):
                 raise Forbidden(
                     f"privileged container {c.name!r} is forbidden")
             # the reference's scdeny also rejects user/capability

@@ -112,9 +112,13 @@ class DockerKeyring:
         """Credentials to TRY, most specific first; empty means pull
         anonymously (keyring.go Lookup returns found=false)."""
         target = _normalize_registry(image_registry(image))
-        # strip the TAG only — the last ':' of the final path segment;
-        # a registry port ('localhost:5000/x') is not a tag
-        head, sep, last = image.rpartition("/")
+        # strip a DIGEST suffix first ('...@sha256:...'), then the TAG
+        # — the last ':' of the final path segment; a registry port
+        # ('localhost:5000/x') is not a tag, and without the digest
+        # strip 'app@sha256:x' would keep 'app@sha256' and miss every
+        # path-scoped credential
+        ref = image.split("@", 1)[0]
+        head, sep, last = ref.rpartition("/")
         repo_path = head + sep + last.split(":", 1)[0]
         with self._lock:
             matches = []
@@ -178,4 +182,7 @@ def runtime_puller(runtime, client):
             pull_secrets_for_pod(client, pod))
         runtime.pull_image(image, keyring)
 
+    # explicit protocol flag for ImageManager (wrapper-proof, unlike
+    # arity inference)
+    pull.takes_pod = True
     return pull

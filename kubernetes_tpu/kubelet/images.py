@@ -35,20 +35,30 @@ class ImageManager:
         self.recorder = recorder
         # the puller seam takes (image) or (image, pod): the pod form
         # lets a runtime-backed puller resolve imagePullSecrets into a
-        # registry credential (kubelet/credentialprovider.py). Only
-        # REQUIRED parameters count — a puller with an optional second
-        # arg (retries=3, or a bound runtime method whose second slot
-        # is a keyring) must not receive a Pod in it.
-        import inspect
-        try:
-            params = inspect.signature(self.puller).parameters.values()
-            required = [p for p in params
-                        if p.default is inspect.Parameter.empty
-                        and p.kind in (p.POSITIONAL_ONLY,
-                                       p.POSITIONAL_OR_KEYWORD)]
-            self._puller_takes_pod = len(required) >= 2
-        except (TypeError, ValueError):
-            self._puller_takes_pod = False
+        # registry credential (kubelet/credentialprovider.py). An
+        # explicit `takes_pod` attribute on the puller wins (set by
+        # runtime_puller; survives wrappers that forward it); arity
+        # inference is only the fallback, and counts REQUIRED
+        # positional params so an optional second arg (retries=3, a
+        # bound keyring slot) never receives a Pod. *args wrappers
+        # without the attribute infer takes_pod=False — wrap with
+        # functools.wraps-style attribute forwarding or set the flag.
+        explicit = getattr(puller, "takes_pod", None) \
+            if puller is not None else None
+        if explicit is not None:
+            self._puller_takes_pod = bool(explicit)
+        else:
+            import inspect
+            try:
+                params = inspect.signature(
+                    self.puller).parameters.values()
+                required = [p for p in params
+                            if p.default is inspect.Parameter.empty
+                            and p.kind in (p.POSITIONAL_ONLY,
+                                           p.POSITIONAL_OR_KEYWORD)]
+                self._puller_takes_pod = len(required) >= 2
+            except (TypeError, ValueError):
+                self._puller_takes_pod = False
         self._lock = threading.Lock()
         self._present: Dict[str, float] = {}  # image -> last-used ts
 

@@ -282,3 +282,48 @@ def test_run_as_non_root_enforced():
         c(api.SecurityContext(run_as_non_root=True, run_as_user=7)),
         cfg)
     assert cfg["User"] == "7"
+
+
+def test_lookup_digest_reference_matches_path_scoped_entry():
+    """An @sha256 digest ref must resolve path-scoped credentials the
+    same way a tag ref does (the digest is stripped before the
+    tag-strip, else 'app@sha256' poisons the repo path)."""
+    from kubernetes_tpu.kubelet.credentialprovider import (
+        DockerCredential, DockerKeyring)
+    kr = DockerKeyring()
+    kr.add("reg.io/team/app", DockerCredential(username="u",
+                                               password="p"))
+    by_tag = kr.lookup("reg.io/team/app:v1")
+    by_digest = kr.lookup("reg.io/team/app@sha256:" + "a" * 64)
+    assert [c.username for c in by_tag] == ["u"]
+    assert [c.username for c in by_digest] == ["u"]
+    # path boundary still enforced
+    assert kr.lookup("reg.io/teammate/app@sha256:" + "a" * 64) == []
+
+
+def test_image_manager_honors_explicit_takes_pod_flag():
+    """A *args wrapper around a (image, pod) puller forwards the
+    explicit takes_pod flag; arity inference alone would misclassify
+    it and strand every pull in a TypeError backoff loop."""
+    from kubernetes_tpu.kubelet.images import ImageManager
+
+    calls = []
+
+    def inner(image, pod):
+        calls.append((image, pod))
+
+    def wrapper(*a):
+        return inner(*a)
+
+    wrapper.takes_pod = True
+    mgr = ImageManager(puller=wrapper)
+    assert mgr._puller_takes_pod
+    pod = object()
+
+    class C:
+        image = "img:v1"
+        name = "c"
+        image_pull_policy = "Always"
+
+    mgr.ensure_image_exists(pod, C())
+    assert calls == [("img:v1", pod)]

@@ -158,8 +158,15 @@ def _print_kind_table(kind: str, objs: List[Any], out,
         if with_namespace:
             row = [obj.metadata.namespace] + row
         rows.append(row)
-    widths = [max(len(headers[i]), max(len(r[i]) for r in rows))
-              for i in range(len(headers))]
+    emit_table(headers, rows, out)
+
+
+def emit_table(headers: List[str], rows: List[List[str]], out) -> None:
+    """The one aligned-columns renderer (kind tables and
+    custom-columns both use it)."""
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows
+              else len(h)
+              for i, h in enumerate(headers)]
     out.write("   ".join(h.ljust(widths[i])
                          for i, h in enumerate(headers)).rstrip() + "\n")
     for r in rows:
@@ -242,5 +249,41 @@ def print_objects(objs: List[Any], output: str, scheme, out,
             out.write((json.dumps(value)
                        if isinstance(value, (dict, list))
                        else str(value)) + "\n")
+    elif output.startswith("custom-columns="):
+        print_custom_columns(objs, output[len("custom-columns="):],
+                             scheme, out)
     else:
         print_table(objs, scheme, out, with_namespace=with_namespace)
+
+
+def print_custom_columns(objs: List[Any], spec: str, scheme,
+                         out) -> None:
+    """-o custom-columns=NAME:.metadata.name,PHASE:.status.phase
+    (ref: pkg/kubectl/custom_column_printer.go — header row, one
+    jsonpath-addressed cell per column, '<none>' for misses)."""
+    columns = []
+    for part in spec.split(","):
+        header, _, expr = part.partition(":")
+        if not header or not expr:
+            raise ValueError(
+                f"custom-columns: bad column spec {part!r} "
+                "(want HEADER:.json.path)")
+        columns.append((header, expr))
+    rows = []
+    for obj in objs:
+        data = scheme.encode_dict(obj)
+        row = []
+        for _header, expr in columns:
+            try:
+                value = jsonpath_get(data, expr)
+            except (KeyError, IndexError, TypeError,
+                    ValueError):
+                value = None  # absent path -> <none>, not an error
+            if value is None:
+                row.append("<none>")  # custom_column_printer.go miss
+            elif isinstance(value, (dict, list)):
+                row.append(json.dumps(value))
+            else:
+                row.append(str(value))
+        rows.append(row)
+    emit_table([h for h, _ in columns], rows, out)

@@ -57,10 +57,16 @@ class _Session:
             "tenantName": self.tenant}}
         data = self._raw_request("POST", self.auth_url + "/tokens", body,
                                  token=False)
-        access = data.get("access", {})
+        self._consume_access(data)
+
+    def _consume_access(self, data) -> None:
+        """Token + region-matched service catalog from a keystone v2
+        access response — shared by every auth flavor (password here,
+        RAX-KSKEY api key in rackspace.py)."""
+        access = (data or {}).get("access", {})
         self.token = access.get("token", {}).get("id", "")
         if not self.token:
-            raise OpenStackError("keystone returned no token")
+            raise OpenStackError("identity service returned no token")
         for svc in access.get("serviceCatalog", []):
             eps = svc.get("endpoints") or []
             if not eps:

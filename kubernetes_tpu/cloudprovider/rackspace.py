@@ -1,0 +1,192 @@
+"""Wire-real Rackspace cloud provider.
+
+Reference: pkg/cloudprovider/providers/rackspace/rackspace.go (388
+LoC) — OpenStack-derived but NOT the same provider: auth goes to the
+Rackspace identity service where an api-key maps to the RAX-KSKEY
+apiKeyCredentials extension (Config.Global.ApiKey ->
+gophercloud.AuthOptions.APIKey, rackspace.go:101-114; password auth
+remains the fallback), and only Instances + Zones are supported
+(TCPLoadBalancer/Routes answer "not supported", rackspace.go:370-382).
+
+Instance lookups carry the reference's quirks faithfully:
+- List filters server-side by name AND Status=ACTIVE
+  (rackspace.go:161-166).
+- getServerByName treats an IP-shaped name as an ADDRESS lookup
+  (rackspace.go:239-241 -> getServerByAddress :206), matching against
+  the first private addr, first public addr, accessIPv4, accessIPv6
+  (serverHasAddress :190-204); more than one match is an error.
+- Otherwise the name matches as an ANCHORED case-insensitive regex
+  over the server list (gophercloud's rackspace servers list; the
+  multiple-results error is kept).
+- NodeAddresses = first private addr, else first public, else
+  accessIPv4, else accessIPv6 (getAddressByName :298-321, firstAddr
+  :277-296 reads the runtime-typed address blob).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import re
+import urllib.parse
+from typing import List, Optional
+
+from .cloud import CloudProvider, Instances, Zone, Zones
+from .openstack import OpenStackError, _Session
+
+
+class RackspaceError(RuntimeError):
+    pass
+
+
+class _RackspaceSession(_Session):
+    """Keystone v2 session whose auth body speaks the RAX-KSKEY
+    apiKeyCredentials extension when an api key is configured
+    (rackspace.go toAuthOptions maps ApiKey; password is the
+    fallback)."""
+
+    def __init__(self, auth_url: str, username: str, api_key: str = "",
+                 password: str = "", tenant: str = "",
+                 timeout: float = 15.0, region: str = ""):
+        super().__init__(auth_url, username, password, tenant,
+                         timeout=timeout, region=region)
+        self.api_key = api_key
+
+    def authenticate(self) -> None:
+        if not self.api_key:
+            return super().authenticate()
+        body = {"auth": {
+            "RAX-KSKEY:apiKeyCredentials": {
+                "username": self.username, "apiKey": self.api_key}}}
+        if self.tenant:
+            body["auth"]["tenantName"] = self.tenant
+        data = self._raw_request("POST", self.auth_url + "/tokens",
+                                 body, token=False)
+        self._consume_access(data)
+
+
+def _first_addr(netblob) -> str:
+    """(ref: firstAddr rackspace.go:277-296 — the runtime-typed
+    addresses blob: [{'addr': ...}, ...])"""
+    if not isinstance(netblob, list) or not netblob:
+        return ""
+    props = netblob[0]
+    if not isinstance(props, dict):
+        return ""
+    addr = props.get("addr", "")
+    return addr if isinstance(addr, str) else ""
+
+
+def _server_address(srv: dict) -> str:
+    """(ref: getAddressByName rackspace.go:298-321 address ladder)"""
+    addresses = srv.get("addresses", {}) or {}
+    for blob in (addresses.get("private"), addresses.get("public")):
+        addr = _first_addr(blob)
+        if addr:
+            return addr
+    return srv.get("accessIPv4", "") or srv.get("accessIPv6", "")
+
+
+def _server_has_address(srv: dict, ip: str) -> bool:
+    """(ref: serverHasAddress rackspace.go:190-204)"""
+    addresses = srv.get("addresses", {}) or {}
+    return ip in (
+        _first_addr(addresses.get("private")),
+        _first_addr(addresses.get("public")),
+        srv.get("accessIPv4", ""),
+        srv.get("accessIPv6", ""))
+
+
+class RackspaceInstances(Instances):
+    def __init__(self, session: _RackspaceSession):
+        self._s = session
+
+    def _list_servers(self, name_filter: str = "") -> List[dict]:
+        path = "/servers/detail"
+        if name_filter:
+            path += "?" + urllib.parse.urlencode(
+                {"name": name_filter, "status": "ACTIVE"})
+        data = self._s.request("GET", "compute", path) or {}
+        return data.get("servers", [])
+
+    def _server_by_name(self, name: str) -> dict:
+        """(ref: getServerByName rackspace.go:239-275 — IP-shaped
+        names resolve by address; otherwise anchored ci regex, with
+        multiple matches an error)"""
+        try:
+            ipaddress.ip_address(name)
+        except ValueError:
+            pass
+        else:
+            return self._server_by_address(name)
+        pattern = re.compile(f"^{re.escape(name)}$", re.IGNORECASE)
+        matches = [s for s in self._list_servers(name)
+                   if pattern.match(s.get("name", ""))]
+        if not matches:
+            raise RackspaceError(f"instance {name!r} not found")
+        if len(matches) > 1:
+            raise RackspaceError(f"multiple results for {name!r}")
+        return matches[0]
+
+    def _server_by_address(self, ip: str) -> dict:
+        """(ref: getServerByAddress rackspace.go:206-237)"""
+        matches = [s for s in self._list_servers()
+                   if _server_has_address(s, ip)]
+        if not matches:
+            raise RackspaceError(f"no instance with address {ip!r}")
+        if len(matches) > 1:
+            raise RackspaceError(f"multiple results for {ip!r}")
+        return matches[0]
+
+    def node_addresses(self, name: str) -> List[str]:
+        addr = _server_address(self._server_by_name(name))
+        if not addr:
+            raise RackspaceError(f"no address found for {name!r}")
+        return [addr]
+
+    def external_id(self, name: str) -> str:
+        return self._server_by_name(name).get("id", "")
+
+    def instance_id(self, name: str) -> str:
+        return self._server_by_name(name).get("id", "")
+
+    def list_instances(self, name_filter: str = "") -> List[str]:
+        """(ref: List rackspace.go:161-189 — server-side name +
+        ACTIVE-status filter)"""
+        return [s.get("name", "")
+                for s in self._list_servers(name_filter)
+                if s.get("status", "ACTIVE") == "ACTIVE"]
+
+    def current_node_name(self, hostname: str) -> str:
+        return hostname  # rackspace.go:352-354
+
+
+class RackspaceProvider(CloudProvider, Zones):
+    """(ref: Rackspace rackspace.go:127-144; only Instances + Zones
+    are supported, rackspace.go:356-388)"""
+
+    name = "rackspace"
+
+    def __init__(self, auth_url: str, username: str, api_key: str = "",
+                 password: str = "", tenant: str = "", region: str = ""):
+        self._session = _RackspaceSession(
+            auth_url, username, api_key=api_key, password=password,
+            tenant=tenant, region=region)
+        self._session.authenticate()
+        self.region = region
+
+    def instances(self) -> Optional[Instances]:
+        return RackspaceInstances(self._session)
+
+    def load_balancers(self):
+        return None  # rackspace.go:370-372: not supported
+
+    def zones(self) -> Optional[Zones]:
+        return self
+
+    def get_zone(self) -> Zone:
+        """(ref: GetZone rackspace.go:384-388 — the configured region,
+        no failure domain)"""
+        return Zone(failure_domain="", region=self.region)
+
+    def routes(self):
+        return None  # rackspace.go:380-382

@@ -73,6 +73,25 @@ class TestGet:
                                "jsonpath={.spec.nodeName}")
         assert out.strip() == "n1"
 
+    def test_custom_columns(self, cluster):
+        _, client = cluster
+        client.create("pods", mkpod("web"), "default")
+        client.create("pods", mkpod("db"), "default")
+        code, out, _ = run_cli(
+            client, "get", "pods", "-o",
+            "custom-columns=NAME:.metadata.name,NODE:.spec.nodeName,"
+            "MISSING:.status.podIP")
+        lines = out.splitlines()
+        assert lines[0].split() == ["NAME", "NODE", "MISSING"]
+        body = {tuple(ln.split()) for ln in lines[1:]}
+        # unset fields print <none> (custom_column_printer.go)
+        assert body == {("web", "n1", "<none>"),
+                        ("db", "n1", "<none>")}
+        # malformed column spec is an error, not a silent table
+        code, _, err = run_cli(client, "get", "pods", "-o",
+                               "custom-columns=NAMEONLY")
+        assert code != 0
+
     def test_output_name(self, cluster):
         _, client = cluster
         client.create("pods", mkpod("w"), "default")

@@ -510,13 +510,24 @@ class Kubectl:
         # delete the old RC only once its scale-down has been OBSERVED
         # (status.replicas from the RC manager) — deleting earlier orphans
         # the pods it hadn't removed yet (rolling_updater.go waits on each
-        # resize before the final cleanup)
-        deadline = time.time() + 30
+        # resize before the final cleanup). Generous: a starved RC
+        # manager (1-core box under full-suite load) can need minutes
+        deadline = time.time() + 90
+        drained = False
         while time.time() < deadline:
             fresh = self.client.get("replicationcontrollers", old_name, ns)
             if fresh.status.replicas == 0:
+                drained = True
                 break
             time.sleep(0.1)
+        if not drained:
+            # deleting an undrained RC orphans its remaining pods with a
+            # misleading success message; fail loudly instead and leave
+            # both RCs for the operator (rolling_updater.go errors on
+            # its resize waits the same way)
+            raise ApiError(
+                f"timed out waiting for {old_name} to scale down; "
+                f"not deleting it")
         self.client.delete("replicationcontrollers", old_name, ns)
         self.out.write(
             f"Update succeeded. Deleting {old_name}\n")

@@ -270,14 +270,14 @@ class TestMutations:
         self.rc(client, replicas=2)
         mgr = ReplicationManager(client).run()
         try:
-            deadline = time.time() + 10
+            deadline = time.time() + 30
             while time.time() < deadline and len(
                     client.list("pods", "default")[0]) < 2:
                 time.sleep(0.05)
             code, out, _ = run_cli(client, "rolling-update", "web",
                                    "web-v2", "--image", "img:v2")
             assert code == 0
-            deadline = time.time() + 40  # generous: suite runs under load
+            deadline = time.time() + 90  # generous: suite runs under load
             def settled():
                 pods = client.list("pods", "default")[0]
                 return (len(pods) == 2 and all(

@@ -99,6 +99,15 @@ def _tpu_section():
             out["evidence"] = json.load(f)
     except (OSError, ValueError):
         out["evidence"] = None
+    # per-section best across captures (cumulative; every entry stamped
+    # with its source-capture ts) — the demonstrated ceiling alongside
+    # the freshest run; best_stale below marks whether any capture this
+    # round actually contributed
+    try:
+        with open(os.path.join(here, "TPU_EVIDENCE_BEST.json")) as f:
+            out["best"] = json.load(f)
+    except (OSError, ValueError):
+        out["best"] = None
     # summarize only the LATEST watcher run (each round starts a fresh
     # watcher, which logs an {"event": "start"} record) so a prior
     # round's probes/evidence can't masquerade as this round's
@@ -129,6 +138,9 @@ def _tpu_section():
     if out["evidence"] is not None and probes["watcher_start_ts"]:
         out["evidence_stale"] = (
             out["evidence"].get("ts_start", "") < probes["watcher_start_ts"])
+    if out["best"] is not None and probes["watcher_start_ts"]:
+        out["best_stale"] = (
+            out["best"].get("ts_updated", "") < probes["watcher_start_ts"])
     return out
 
 

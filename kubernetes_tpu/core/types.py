@@ -260,9 +260,25 @@ class ResourceRequirements:
 
 
 @dataclass
+class ObjectFieldSelector:
+    """Selects a field of the enclosing pod (ref: pkg/api/types.go
+    ObjectFieldSelector; resolved by kubelet/envvars.py)."""
+    api_version: str = "v1"
+    field_path: str = ""
+
+
+@dataclass
+class EnvVarSource:
+    """(ref: pkg/api/types.go:670 EnvVarSource — v1.1 has only
+    FieldRef)"""
+    field_ref: Optional[ObjectFieldSelector] = None
+
+
+@dataclass
 class EnvVar:
     name: str = ""
     value: str = ""
+    value_from: Optional[EnvVarSource] = None
 
 
 @dataclass

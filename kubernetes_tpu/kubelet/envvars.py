@@ -1,0 +1,198 @@
+"""Service-discovery env vars, $(var) expansion, and field-path values.
+
+Every container starts with environment variables locating every
+service visible to its pod — the `{NAME}_SERVICE_HOST` /
+`{NAME}_SERVICE_PORT` pairs plus the docker-links-compatible
+`{NAME}_PORT_*` family (ref: pkg/kubelet/envvars/envvars.go:31-108
+FromServices), projected by namespace the way the reference kubelet
+does it (ref: pkg/kubelet/kubelet.go:1340-1390 getServiceEnvVarMap: the
+pod's own namespace plus the master "kubernetes" service). Declared
+values run through the reference's `$(VAR)` expansion algorithm (ref:
+third_party/golang/expansion/expand.go) and `valueFrom.fieldRef`
+resolves downward-API field paths (ref: pkg/kubelet/kubelet.go:1453
+podFieldSelectorRuntimeValue; pkg/fieldpath/fieldpath.go:38
+ExtractFieldPathAsString).
+
+Deliberate divergence: the reference emits the residual service vars in
+Go-map iteration order (nondeterministic); here they are sorted by
+service name so container environments are bit-reproducible — the same
+determinism stance the device engine takes on tie-breaks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from ..core import types as api
+
+# services in this namespace that every pod sees regardless of its own
+# namespace (kubelet.go:1338 masterServices)
+MASTER_SERVICES = ("kubernetes",)
+
+
+def _mangle(name: str) -> str:
+    # (envvars.go:66 makeEnvVariableName)
+    return name.upper().replace("-", "_")
+
+
+def _has_cluster_ip(svc: api.Service) -> bool:
+    # headless or unallocated services produce no env vars
+    # (envvars.go:38-42; api.IsServiceIPSet)
+    return bool(svc.spec.cluster_ip) and svc.spec.cluster_ip != "None"
+
+
+def from_services(services: Iterable[api.Service]) -> List[api.EnvVar]:
+    """The env-var list for one container, given its visible services
+    (envvars.go:31 FromServices)."""
+    result: List[api.EnvVar] = []
+    for svc in services:
+        if not _has_cluster_ip(svc) or not svc.spec.ports:
+            continue
+        prefix = _mangle(svc.metadata.name)
+        result.append(api.EnvVar(name=prefix + "_SERVICE_HOST",
+                                 value=svc.spec.cluster_ip))
+        # first port gets the backwards-compatible name; named ports get
+        # a suffixed variant (only the first may be unnamed)
+        port_name = prefix + "_SERVICE_PORT"
+        result.append(api.EnvVar(name=port_name,
+                                 value=str(svc.spec.ports[0].port)))
+        for sp in svc.spec.ports:
+            if sp.name:
+                result.append(api.EnvVar(
+                    name=port_name + "_" + _mangle(sp.name),
+                    value=str(sp.port)))
+        result.extend(_link_vars(prefix, svc))
+    return result
+
+
+def _link_vars(prefix: str, svc: api.Service) -> List[api.EnvVar]:
+    """Docker-compatible link variables (envvars.go:75-108
+    makeLinkVariables)."""
+    out: List[api.EnvVar] = []
+    ip = svc.spec.cluster_ip
+    for i, sp in enumerate(svc.spec.ports):
+        proto = sp.protocol or "TCP"
+        url = f"{proto.lower()}://{ip}:{sp.port}"
+        if i == 0:
+            # docker special-cases the first port
+            out.append(api.EnvVar(name=prefix + "_PORT", value=url))
+        pp = f"{prefix}_PORT_{sp.port}_{proto.upper()}"
+        out.append(api.EnvVar(name=pp, value=url))
+        out.append(api.EnvVar(name=pp + "_PROTO", value=proto.lower()))
+        out.append(api.EnvVar(name=pp + "_PORT", value=str(sp.port)))
+        out.append(api.EnvVar(name=pp + "_ADDR", value=ip))
+    return out
+
+
+def service_env_map(services: Iterable[api.Service], namespace: str,
+                    master_service_namespace: str = "default"
+                    ) -> Dict[str, str]:
+    """Project the cluster's services onto what a pod in ``namespace``
+    should see (kubelet.go:1341 getServiceEnvVarMap): everything in its
+    own namespace, plus the master services from the master namespace —
+    with the pod-namespace definition winning a name collision."""
+    chosen: Dict[str, api.Service] = {}
+    for svc in services:
+        if not _has_cluster_ip(svc):
+            continue
+        name = svc.metadata.name
+        if svc.metadata.namespace == namespace:
+            chosen[name] = svc  # always wins (kubelet.go:1371-1373)
+        elif (svc.metadata.namespace == master_service_namespace
+              and name in MASTER_SERVICES):
+            chosen.setdefault(name, svc)
+    ordered = sorted(chosen.values(), key=lambda s: s.metadata.name)
+    return {e.name: e.value for e in from_services(ordered)}
+
+
+def expand(value: str, *maps: Dict[str, str]) -> str:
+    """``$(VAR)`` expansion (third_party/golang/expansion/expand.go):
+    ``$$`` escapes to ``$``, earlier maps shadow later ones, and an
+    unresolvable reference is left intact."""
+    buf: List[str] = []
+    i, n = 0, len(value)
+    while i < n:
+        ch = value[i]
+        if ch == "$" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "$":
+                buf.append("$")
+                i += 2
+                continue
+            if nxt == "(":
+                close = value.find(")", i + 2)
+                if close != -1:
+                    name = value[i + 2:close]
+                    for m in maps:
+                        if name in m:
+                            buf.append(m[name])
+                            break
+                    else:
+                        buf.append(value[i:close + 1])
+                    i = close + 1
+                    continue
+                # incomplete reference: "$(" passes through literally
+                buf.append("$(")
+                i += 2
+                continue
+            # operator not starting an expression: both chars literal
+            buf.append("$" + nxt)
+            i += 2
+            continue
+        buf.append(ch)
+        i += 1
+    return "".join(buf)
+
+
+def _format_map(m: Dict[str, str]) -> str:
+    # (fieldpath.go:28 formatMap — %q quoting so embedded quotes,
+    # backslashes and newlines can't forge extra key=value lines;
+    # sorted here for reproducibility where Go map order is random)
+    import json
+    return "".join(f"{k}={json.dumps(v)}\n" for k, v in sorted(m.items()))
+
+
+def extract_field_path(pod: api.Pod, field_path: str) -> str:
+    """Downward-API field paths for env (kubelet.go:1453
+    podFieldSelectorRuntimeValue + fieldpath.go:38)."""
+    if field_path == "status.podIP":
+        return pod.status.pod_ip
+    if field_path == "metadata.name":
+        return pod.metadata.name
+    if field_path == "metadata.namespace":
+        return pod.metadata.namespace
+    if field_path == "metadata.labels":
+        return _format_map(pod.metadata.labels)
+    if field_path == "metadata.annotations":
+        return _format_map(pod.metadata.annotations)
+    raise ValueError(f"unsupported fieldPath: {field_path}")
+
+
+def make_environment(pod: api.Pod, container: api.Container,
+                     services: Iterable[api.Service],
+                     master_service_namespace: str = "default"
+                     ) -> List[api.EnvVar]:
+    """The final environment for one container start (kubelet.go:1393
+    makeEnvironmentVariables): declared vars in declaration order —
+    values expanded against earlier declarations then service env,
+    ``fieldRef`` sources resolved — followed by the remaining service
+    vars (sorted; see module docstring)."""
+    service_env = service_env_map(services, pod.metadata.namespace,
+                                  master_service_namespace)
+    tmp_env: Dict[str, str] = {}
+    result: List[api.EnvVar] = []
+    for ev in container.env:
+        # a declared var shadows the generated service var outright
+        # (kubelet.go:1428 delete(serviceEnv, envVar.Name))
+        service_env.pop(ev.name, None)
+        runtime_val = ev.value
+        if runtime_val:
+            runtime_val = expand(runtime_val, tmp_env, service_env)
+        elif ev.value_from is not None and ev.value_from.field_ref is not None:
+            runtime_val = extract_field_path(
+                pod, ev.value_from.field_ref.field_path)
+        tmp_env[ev.name] = runtime_val
+        result.append(api.EnvVar(name=ev.name, value=runtime_val))
+    for name in sorted(service_env):
+        result.append(api.EnvVar(name=name, value=service_env[name]))
+    return result

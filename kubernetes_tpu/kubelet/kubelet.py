@@ -92,7 +92,8 @@ class Kubelet:
                  max_restart_backoff: float = 10.0,
                  volume_mgr=None, image_manager=None,
                  manifest_path: Optional[str] = None,
-                 manifest_url: Optional[str] = None):
+                 manifest_url: Optional[str] = None,
+                 master_service_namespace: str = "default"):
         """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
         before containers start and torn down on deletion (kubelet.go
         syncPod mountExternalVolumes). image_manager: pull-policy
@@ -121,6 +122,12 @@ class Kubelet:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._informer: Optional[Informer] = None
+        # service watch feeding the env-var projection (kubelet.go:245
+        # serviceLister); None until run() — containers started before
+        # the first sync just get their declared env, the reference's
+        # documented pod-vs-service race (kubelet.go:1400-1403)
+        self._service_informer: Optional[Informer] = None
+        self.master_service_namespace = master_service_namespace
         self.max_restart_backoff = max_restart_backoff
         from .container_gc import ContainerGC
         self._container_gc = (ContainerGC(self.runtime)
@@ -229,7 +236,8 @@ class Kubelet:
                     # pull policy gates the start (image_puller.go
                     # EnsureImageExists)
                     self.image_manager.ensure_image_exists(pod, container)
-                self.runtime.start_container(pod, container)
+                self.runtime.start_container(
+                    pod, self._container_with_env(pod, container))
                 self._backoff.pop(key, None)
                 self._backoff.pop(f"{key}#d", None)  # full delay reset
             except Exception:
@@ -241,6 +249,30 @@ class Kubelet:
         delay = min(prev * 2, self.max_restart_backoff)
         self._backoff[key] = now + delay
         self._backoff[f"{key}#d"] = delay
+
+    def make_environment(self, pod: api.Pod, container: api.Container
+                         ) -> List[api.EnvVar]:
+        """The container's final env: declared vars ($(var)-expanded,
+        fieldRef-resolved) + service-discovery vars (kubelet.go:1393
+        makeEnvironmentVariables; kubelet/envvars.py)."""
+        from .envvars import make_environment
+        services: List[api.Service] = []
+        if self._service_informer is not None:
+            services = self._service_informer.cache.list()
+        return make_environment(pod, container, services,
+                                self.master_service_namespace)
+
+    def _container_with_env(self, pod: api.Pod,
+                            container: api.Container) -> api.Container:
+        """A copy of the container spec carrying the resolved env, so
+        every runtime (subprocess/daemon/cli/fake) starts it with the
+        same environment without knowing how it was built. The env is
+        deliberately not part of any restart-decision identity — a
+        service change must not restart running containers
+        (kubelet.go:1395-1398 note)."""
+        import dataclasses
+        return dataclasses.replace(
+            container, env=self.make_environment(pod, container))
 
     @staticmethod
     def _should_restart(policy: str, exit_code: int) -> bool:
@@ -429,6 +461,17 @@ class Kubelet:
             self._enforcer = ResourceEnforcer(
                 self.runtime, bound_pods,
                 on_oom=self._on_oom_kill).start()
+        # services BEFORE pods (kubelet.go:245 starts the service watch
+        # at construction): a pod synced ahead of the service cache
+        # would start its containers with an empty service-env
+        # projection, and env is never recomputed for a running
+        # container. All namespaces: the per-pod-namespace projection
+        # happens at env construction (envvars.service_env_map).
+        self._service_informer = Informer(self.client, "services").start()
+        deadline = time.time() + 5.0
+        while (not self._service_informer.has_synced
+               and time.time() < deadline):
+            time.sleep(0.01)
         self._informer = Informer(
             self.client, "pods",
             field_selector=f"spec.nodeName={self.node_name}",
@@ -471,6 +514,8 @@ class Kubelet:
             self._enforcer.stop()
         if self._informer:
             self._informer.stop()
+        if self._service_informer:
+            self._service_informer.stop()
         for source in self._sources:
             source.stop()
         self.pleg.stop()

@@ -268,8 +268,15 @@ def merge_best(doc: dict, best_path: str) -> None:
                 < old["roundtrip_ms"]["p50"]):
             bs["dispatch"] = dict(disp, ts=ts)
             changed = True
-    if _ok("platform") and bs.get("platform") != dict(
-            secs["platform"], ts=bs.get("platform", {}).get("ts")):
+    def _content(rec):
+        # per-capture jitter fields must not count as a content change
+        # (they would bump ts_updated — the best_stale signal — on
+        # every capture)
+        return {k: v for k, v in (rec or {}).items()
+                if k not in ("ts", "elapsed_s", "status")}
+
+    if _ok("platform") and _content(bs.get("platform")) != _content(
+            secs["platform"]):
         bs["platform"] = dict(secs["platform"], ts=ts)
         changed = True
     pal = _ok("pallas")
@@ -281,10 +288,10 @@ def merge_best(doc: dict, best_path: str) -> None:
                     bool(rec.get("latch_fallback_parity")),
                     bool(rec.get("rejection_raised")))
         old = bs.get("pallas")
-        if old is None or _quality(pal) >= _quality(old):
-            if old is None or dict(old, ts=None) != dict(pal, ts=None):
-                bs["pallas"] = dict(pal, ts=ts)
-                changed = True
+        if (old is None or _quality(pal) >= _quality(old)) \
+                and _content(old) != _content(pal):
+            bs["pallas"] = dict(pal, ts=ts)
+            changed = True
     if changed:
         best["ts_updated"] = _utc()
         _atomic_write_json(best_path, best)

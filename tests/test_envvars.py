@@ -1,0 +1,220 @@
+"""Service-discovery env vars, $(var) expansion, fieldRef env sources
+(ref: pkg/kubelet/envvars/envvars.go + envvars_test.go,
+third_party/golang/expansion/expand.go,
+pkg/kubelet/kubelet.go:1340-1461)."""
+
+import time
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.envvars import (expand, extract_field_path,
+                                            from_services,
+                                            make_environment,
+                                            service_env_map)
+
+
+def wait_until(cond, timeout=20.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def mksvc(name, cluster_ip, ports, namespace="default"):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace=namespace),
+        spec=api.ServiceSpec(cluster_ip=cluster_ip, ports=[
+            api.ServicePort(name=n, port=p, protocol=proto)
+            for n, p, proto in ports]))
+
+
+class TestFromServices:
+    def test_full_var_family(self):
+        # mirrors envvars_test.go TestFromServices' zookeeper fixture
+        svc = mksvc("zookeeper", "1.2.3.4",
+                    [("", 2181, "TCP"), ("leader", 2888, "TCP")])
+        got = {e.name: e.value for e in from_services([svc])}
+        assert got == {
+            "ZOOKEEPER_SERVICE_HOST": "1.2.3.4",
+            "ZOOKEEPER_SERVICE_PORT": "2181",
+            "ZOOKEEPER_SERVICE_PORT_LEADER": "2888",
+            "ZOOKEEPER_PORT": "tcp://1.2.3.4:2181",
+            "ZOOKEEPER_PORT_2181_TCP": "tcp://1.2.3.4:2181",
+            "ZOOKEEPER_PORT_2181_TCP_PROTO": "tcp",
+            "ZOOKEEPER_PORT_2181_TCP_PORT": "2181",
+            "ZOOKEEPER_PORT_2181_TCP_ADDR": "1.2.3.4",
+            "ZOOKEEPER_PORT_2888_TCP": "tcp://1.2.3.4:2888",
+            "ZOOKEEPER_PORT_2888_TCP_PROTO": "tcp",
+            "ZOOKEEPER_PORT_2888_TCP_PORT": "2888",
+            "ZOOKEEPER_PORT_2888_TCP_ADDR": "1.2.3.4",
+        }
+
+    def test_dash_mangling_and_udp(self):
+        svc = mksvc("simple-dns", "9.8.7.6", [("dns", 53, "UDP")])
+        got = {e.name: e.value for e in from_services([svc])}
+        assert got["SIMPLE_DNS_SERVICE_HOST"] == "9.8.7.6"
+        assert got["SIMPLE_DNS_PORT"] == "udp://9.8.7.6:53"
+        assert got["SIMPLE_DNS_PORT_53_UDP_PROTO"] == "udp"
+
+    def test_headless_and_ipless_services_skipped(self):
+        assert from_services([
+            mksvc("headless", "None", [("", 80, "TCP")]),
+            mksvc("pending", "", [("", 80, "TCP")])]) == []
+
+
+class TestServiceEnvMap:
+    def test_namespace_projection(self):
+        services = [
+            mksvc("db", "10.0.0.1", [("", 5432, "TCP")], namespace="prod"),
+            mksvc("db", "10.0.0.2", [("", 5432, "TCP")], namespace="dev"),
+            mksvc("kubernetes", "10.0.0.3", [("", 443, "TCP")],
+                  namespace="default"),
+            mksvc("other", "10.0.0.4", [("", 80, "TCP")],
+                  namespace="default"),
+        ]
+        m = service_env_map(services, "prod")
+        # own-namespace db, not dev's; master kubernetes service leaks
+        # in from the master namespace; unrelated default services don't
+        assert m["DB_SERVICE_HOST"] == "10.0.0.1"
+        assert m["KUBERNETES_SERVICE_HOST"] == "10.0.0.3"
+        assert "OTHER_SERVICE_HOST" not in m
+
+    def test_pod_namespace_wins_name_collision(self):
+        services = [
+            mksvc("kubernetes", "10.0.0.3", [("", 443, "TCP")],
+                  namespace="default"),
+            mksvc("kubernetes", "10.9.9.9", [("", 443, "TCP")],
+                  namespace="prod"),
+        ]
+        m = service_env_map(services, "prod")
+        assert m["KUBERNETES_SERVICE_HOST"] == "10.9.9.9"
+
+
+class TestExpansion:
+    def test_cases(self):
+        ctx = {"VAR_A": "A", "VAR_B": "B", "VAR_EMPTY": ""}
+        cases = [
+            ("$(VAR_A)", "A"),
+            ("___$(VAR_B)___", "___B___"),
+            ("$(VAR_A)$(VAR_B)", "AB"),
+            ("$$(VAR_A)", "$(VAR_A)"),          # escaped operator
+            ("$$$(VAR_A)", "$A"),               # escape then expand
+            ("$(MISSING)", "$(MISSING)"),       # unresolved left intact
+            ("$(VAR_EMPTY)", ""),
+            ("$(incomplete", "$(incomplete"),
+            ("trailing$", "trailing$"),
+            ("$x", "$x"),
+            ("()", "()"),
+        ]
+        for value, want in cases:
+            assert expand(value, ctx) == want, value
+
+    def test_earlier_map_shadows_later(self):
+        assert expand("$(X)", {"X": "first"}, {"X": "second"}) == "first"
+
+
+class TestFieldPath:
+    def test_paths(self):
+        pod = api.Pod(metadata=api.ObjectMeta(
+            name="p", namespace="ns", labels={"a": "1", "b": "2"},
+            annotations={"k": "v"}),
+            status=api.PodStatus(pod_ip="10.1.2.3"))
+        assert extract_field_path(pod, "metadata.name") == "p"
+        assert extract_field_path(pod, "metadata.namespace") == "ns"
+        assert extract_field_path(pod, "status.podIP") == "10.1.2.3"
+        assert extract_field_path(pod, "metadata.labels") == \
+            'a="1"\nb="2"\n'
+        assert extract_field_path(pod, "metadata.annotations") == 'k="v"\n'
+
+    def test_quotes_and_newlines_escaped(self):
+        # a quote/newline in an annotation value must not forge extra
+        # key=value lines (fieldpath.go formatMap %q)
+        pod = api.Pod(metadata=api.ObjectMeta(
+            annotations={"a": 'x"y', "b": "l1\nl2"}))
+        got = extract_field_path(pod, "metadata.annotations")
+        assert got == 'a="x\\"y"\nb="l1\\nl2"\n'
+
+
+class TestMakeEnvironment:
+    def _pod(self, env):
+        return api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default",
+                                    uid="u1"),
+            spec=api.PodSpec(node_name="n1", containers=[
+                api.Container(name="c", image="i", env=env)]),
+            status=api.PodStatus(pod_ip="10.1.1.1"))
+
+    def test_declared_order_expansion_and_service_tail(self):
+        svc = mksvc("db", "10.0.0.1", [("", 5432, "TCP")])
+        pod = self._pod([
+            api.EnvVar(name="A", value="a"),
+            api.EnvVar(name="B", value="$(A)-$(DB_SERVICE_HOST)"),
+        ])
+        env = make_environment(pod, pod.spec.containers[0], [svc])
+        names = [e.name for e in env]
+        # declared vars first, in declaration order; service vars after
+        assert names[:2] == ["A", "B"]
+        byname = {e.name: e.value for e in env}
+        assert byname["B"] == "a-10.0.0.1"
+        assert byname["DB_SERVICE_HOST"] == "10.0.0.1"
+
+    def test_declared_var_shadows_service_var(self):
+        svc = mksvc("db", "10.0.0.1", [("", 5432, "TCP")])
+        pod = self._pod([api.EnvVar(name="DB_SERVICE_HOST",
+                                    value="override")])
+        env = make_environment(pod, pod.spec.containers[0], [svc])
+        assert [e.value for e in env if e.name == "DB_SERVICE_HOST"] == \
+            ["override"]
+
+    def test_field_ref_source(self):
+        pod = self._pod([api.EnvVar(
+            name="MY_POD_IP",
+            value_from=api.EnvVarSource(field_ref=api.ObjectFieldSelector(
+                field_path="status.podIP")))])
+        env = make_environment(pod, pod.spec.containers[0], [])
+        assert env == [api.EnvVar(name="MY_POD_IP", value="10.1.1.1")]
+
+
+class TestKubeletServiceEnv:
+    def test_started_container_gets_service_and_fieldref_env(self):
+        registry = Registry()
+        client = InProcClient(registry)
+        started = {}
+
+        class RecordingRuntime(FakeRuntime):
+            def start_container(self, pod, container):
+                started[container.name] = list(container.env)
+                return super().start_container(pod, container)
+
+        client.create("services", mksvc(
+            "redis-master", "10.0.0.11", [("", 6379, "TCP")]), "default")
+        kubelet = Kubelet(client, "n1", runtime=RecordingRuntime()).run()
+        try:
+            assert wait_until(
+                lambda: kubelet._service_informer.has_synced)
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="web", namespace="default",
+                                        uid="u-env"),
+                spec=api.PodSpec(node_name="n1", containers=[
+                    api.Container(name="c", image="i", env=[
+                        api.EnvVar(name="WHOAMI",
+                                   value_from=api.EnvVarSource(
+                                       field_ref=api.ObjectFieldSelector(
+                                           field_path="metadata.name"))),
+                        api.EnvVar(name="REDIS",
+                                   value="$(REDIS_MASTER_SERVICE_HOST)"),
+                    ])]),
+                status=api.PodStatus(phase="Pending"))
+            client.create("pods", pod, "default")
+            assert wait_until(lambda: "c" in started)
+            env = {e.name: e.value for e in started["c"]}
+            assert env["WHOAMI"] == "web"
+            assert env["REDIS"] == "10.0.0.11"
+            assert env["REDIS_MASTER_SERVICE_HOST"] == "10.0.0.11"
+            assert env["REDIS_MASTER_PORT"] == "tcp://10.0.0.11:6379"
+        finally:
+            kubelet.stop()

@@ -90,6 +90,23 @@ def test_no_improvement_does_not_bump_ts_updated(tmp_path):
     assert doc["sections"]["e2e"]["ts"] == "t1"
 
 
+def test_identical_recapture_does_not_bump_ts_updated(tmp_path):
+    # only jitter fields (elapsed_s) differ between the two captures:
+    # the best file must not be rewritten, or best_stale always reads
+    # fresh
+    path = str(tmp_path / "best.json")
+    doc1 = _doc("t1", 40000.0, 3700.0, 71.0)
+    for s in doc1["sections"].values():
+        s["elapsed_s"] = 1.0
+    merge_best(doc1, path)
+    ts1 = json.load(open(path))["ts_updated"]
+    doc2 = _doc("t2", 40000.0, 3700.0, 71.0)
+    for s in doc2["sections"].values():
+        s["elapsed_s"] = 2.0
+    merge_best(doc2, path)
+    assert json.load(open(path))["ts_updated"] == ts1
+
+
 def test_merge_tolerates_missing_and_corrupt_best_file(tmp_path):
     path = str(tmp_path / "best.json")
     with open(path, "w") as f:

@@ -1,0 +1,1 @@
+from .server import ClusterDNS, DEFAULT_CLUSTER_DOMAIN  # noqa: F401

@@ -1,0 +1,326 @@
+"""Cluster DNS: the kube-dns addon role as one process.
+
+The reference runs two containers (ref: cluster/addons/dns): kube2sky
+watches services/endpoints and writes skydns records into etcd, and
+skydns serves DNS from them. Here both roles collapse into one server
+fed directly by services + endpoints informers — no etcd hop, no
+record-sync lag beyond the watch itself (DIVERGENCES #16).
+
+Served schema (ref: cluster/addons/dns/README.md):
+
+- A ``{svc}.{ns}.svc.{domain}`` → the service's cluster IP; for
+  headless services (clusterIP "None") → one A record per ready
+  endpoint address.
+- SRV ``_{port}._{proto}.{svc}.{ns}.svc.{domain}`` → (10, 10, port,
+  ``{svc}.{ns}.svc.{domain}``) for each *named* port.
+- A ``{a-b-c-d}.{ns}.pod.{domain}`` → a.b.c.d (pods get synthesized
+  ip-derived names; enabled by default like the addon).
+- Names under the cluster domain that exist but lack the queried type
+  → NODATA (NOERROR, zero answers); unknown names → NXDOMAIN; queries
+  outside the cluster domain → SERVFAIL, or relayed verbatim to an
+  ``upstream`` resolver when one is configured (the skydns forwarding
+  role).
+
+Wire protocol is real RFC 1035 over both UDP and length-prefixed TCP
+(DNS's canonical transports — the UDP proxy path this repo grew in
+round 4 exists exactly because of this service). Responses compress
+the owner name with a pointer to the question (0xC00C).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..api.cache import Informer
+from ..core import types as api
+
+DEFAULT_CLUSTER_DOMAIN = "cluster.local"
+
+TYPE_A = 1
+TYPE_CNAME = 5
+TYPE_SRV = 33
+CLASS_IN = 1
+
+RCODE_NOERROR = 0
+RCODE_SERVFAIL = 2
+RCODE_NXDOMAIN = 3
+RCODE_NOTIMP = 4
+
+_TTL = 30  # skydns default TTL for kube records
+
+
+# ------------------------------------------------------------ wire codec
+
+def encode_name(name: str) -> bytes:
+    name = name.rstrip(".")
+    if not name:  # the root name encodes as a lone terminator
+        return b"\x00"
+    out = b""
+    for label in name.split("."):
+        raw = label.encode("ascii")
+        if not 0 < len(raw) < 64:
+            raise ValueError(f"bad label in {name!r}")
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00"
+
+
+def decode_name(buf: bytes, off: int) -> Tuple[str, int]:
+    """Returns (name, next offset). Follows compression pointers."""
+    labels: List[str] = []
+    jumped = False
+    end = off
+    seen = 0
+    while True:
+        if off >= len(buf):
+            raise ValueError("truncated name")
+        length = buf[off]
+        if length & 0xC0 == 0xC0:  # pointer
+            if off + 1 >= len(buf):
+                raise ValueError("truncated pointer")
+            ptr = ((length & 0x3F) << 8) | buf[off + 1]
+            if not jumped:
+                end = off + 2
+            off = ptr
+            jumped = True
+            seen += 1
+            if seen > 64:
+                raise ValueError("pointer loop")
+            continue
+        off += 1
+        if length == 0:
+            if not jumped:
+                end = off
+            return ".".join(labels), end
+        labels.append(buf[off:off + length].decode("ascii"))
+        off += length
+
+
+def parse_query(data: bytes) -> Tuple[int, str, int, int]:
+    """Returns (id, qname, qtype, qclass) for a single-question query."""
+    if len(data) < 12:
+        raise ValueError("short packet")
+    qid, flags, qd, _an, _ns, _ar = struct.unpack("!HHHHHH", data[:12])
+    if flags & 0x8000:
+        raise ValueError("not a query")
+    if qd != 1:
+        raise ValueError("expected one question")
+    qname, off = decode_name(data, 12)
+    if off + 4 > len(data):
+        raise ValueError("truncated question")
+    qtype, qclass = struct.unpack("!HH", data[off:off + 4])
+    return qid, qname, qtype, qclass
+
+
+def build_response(qid: int, qname: str, qtype: int, qclass: int,
+                   answers: List[bytes], rcode: int) -> bytes:
+    # QR=1, AA=1, RD echoed off; the question section is echoed verbatim
+    flags = 0x8400 | (rcode & 0xF)
+    head = struct.pack("!HHHHHH", qid, flags, 1, len(answers), 0, 0)
+    question = encode_name(qname) + struct.pack("!HH", qtype, qclass)
+    return head + question + b"".join(answers)
+
+
+def rr_a(ip: str) -> bytes:
+    return (b"\xc0\x0c" + struct.pack("!HHIH", TYPE_A, CLASS_IN, _TTL, 4)
+            + socket.inet_aton(ip))
+
+
+def rr_srv(port: int, target: str) -> bytes:
+    rdata = struct.pack("!HHH", 10, 10, port) + encode_name(target)
+    return (b"\xc0\x0c" + struct.pack("!HHIH", TYPE_SRV, CLASS_IN, _TTL,
+                                      len(rdata)) + rdata)
+
+
+# ------------------------------------------------------------- the server
+
+class ClusterDNS:
+    """Serves the cluster schema from live service/endpoints caches.
+
+    client: any list/watch client (InProc or HTTP). upstream: optional
+    ``(host, port)`` resolver that queries outside the cluster domain
+    are relayed to verbatim (skydns's forwarding role); without one
+    they answer SERVFAIL so resolvers fail over per resolv.conf.
+    """
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
+                 cluster_domain: str = DEFAULT_CLUSTER_DOMAIN,
+                 upstream: Optional[Tuple[str, int]] = None,
+                 serve_pod_records: bool = True):
+        self.client = client
+        self.cluster_domain = cluster_domain.strip(".").lower()
+        self.upstream = upstream
+        self.serve_pod_records = serve_pod_records
+        self._services = Informer(client, "services")
+        self._endpoints = Informer(client, "endpoints")
+        dns = self
+
+        class _UDPHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                reply = dns.handle_packet(data)
+                if reply is not None:
+                    sock.sendto(reply, self.client_address)
+
+        class _TCPHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                raw = self.request.recv(2)
+                if len(raw) < 2:
+                    return
+                (n,) = struct.unpack("!H", raw)
+                data = b""
+                while len(data) < n:
+                    chunk = self.request.recv(n - len(data))
+                    if not chunk:
+                        return
+                    data += chunk
+                reply = dns.handle_packet(data)
+                if reply is not None:
+                    self.request.sendall(struct.pack("!H", len(reply))
+                                         + reply)
+
+        self._udp = socketserver.ThreadingUDPServer((host, port),
+                                                    _UDPHandler)
+        self._udp.daemon_threads = True
+        self.port = self._udp.server_address[1]
+        # same port on TCP (the DNS convention)
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, self.port), _TCPHandler, bind_and_activate=False)
+        self._tcp.allow_reuse_address = True
+        self._tcp.daemon_threads = True
+        self._tcp.server_bind()
+        self._tcp.server_activate()
+        self.host = host
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "ClusterDNS":
+        self._services.start()
+        self._endpoints.start()
+        for srv in (self._udp, self._tcp):
+            t = threading.Thread(target=srv.serve_forever, daemon=True,
+                                 name=f"cluster-dns-{self.port}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        for srv in (self._udp, self._tcp):
+            srv.shutdown()
+            srv.server_close()
+        self._services.stop()
+        self._endpoints.stop()
+
+    # -------------------------------------------------------- resolution
+
+    def handle_packet(self, data: bytes) -> Optional[bytes]:
+        try:
+            qid, qname, qtype, qclass = parse_query(data)
+        except ValueError:
+            return None  # unparseable: drop, like a lost datagram
+        lname = qname.rstrip(".").lower()
+        if not (lname == self.cluster_domain
+                or lname.endswith("." + self.cluster_domain)):
+            if self.upstream is not None:
+                relayed = self._relay_upstream(data)
+                if relayed is not None:
+                    return relayed
+            return build_response(qid, qname, qtype, qclass, [],
+                                  RCODE_SERVFAIL)
+        if qclass != CLASS_IN:
+            return build_response(qid, qname, qtype, qclass, [],
+                                  RCODE_NOTIMP)
+        answers, exists = self.resolve(lname, qtype)
+        rcode = RCODE_NOERROR if exists else RCODE_NXDOMAIN
+        return build_response(qid, qname, qtype, qclass, answers, rcode)
+
+    def resolve(self, lname: str, qtype: int) -> Tuple[List[bytes], bool]:
+        """Returns (answer RRs, name exists). Empty+exists = NODATA."""
+        rel = lname[:-len(self.cluster_domain)].strip(".") \
+            if lname != self.cluster_domain else ""
+        labels = rel.split(".") if rel else []
+        # {svc}.{ns}.svc  |  _{port}._{proto}.{svc}.{ns}.svc
+        if len(labels) == 3 and labels[2] == "svc":
+            svc = self._service(labels[1], labels[0])
+            if svc is None:
+                return [], False
+            return (self._service_a(svc) if qtype == TYPE_A else []), True
+        if (len(labels) == 5 and labels[4] == "svc"
+                and labels[0].startswith("_")
+                and labels[1].startswith("_")):
+            svc = self._service(labels[3], labels[2])
+            if svc is None:
+                return [], False
+            port = self._named_port(svc, labels[0][1:], labels[1][1:])
+            if port is None:
+                return [], False
+            if qtype != TYPE_SRV:
+                return [], True
+            target = (f"{svc.metadata.name}.{svc.metadata.namespace}"
+                      f".svc.{self.cluster_domain}")
+            return [rr_srv(port, target)], True
+        # {a-b-c-d}.{ns}.pod
+        if (len(labels) == 3 and labels[2] == "pod"
+                and self.serve_pod_records):
+            ip = labels[0].replace("-", ".")
+            try:
+                socket.inet_aton(ip)
+            except OSError:
+                return [], False
+            if ip.count(".") != 3:
+                return [], False
+            return ([rr_a(ip)] if qtype == TYPE_A else []), True
+        # the zone itself and intermediate names (ns.svc.domain, svc.
+        # domain, domain) exist so resolv.conf search-path probing gets
+        # NODATA rather than NXDOMAIN on its way to the full name
+        if len(labels) <= 2:
+            return [], True
+        return [], False
+
+    # --------------------------------------------------------- records
+
+    def _service(self, namespace: str, name: str) -> Optional[api.Service]:
+        for svc in self._services.cache.list():
+            if (svc.metadata.name.lower() == name
+                    and svc.metadata.namespace.lower() == namespace):
+                return svc
+        return None
+
+    def _service_a(self, svc: api.Service) -> List[bytes]:
+        ip = svc.spec.cluster_ip
+        if ip and ip != "None":
+            return [rr_a(ip)]
+        # headless: one A per endpoint address, deterministic order
+        ips = set()
+        for ep in self._endpoints.cache.list():
+            if (ep.metadata.name == svc.metadata.name
+                    and ep.metadata.namespace == svc.metadata.namespace):
+                for subset in ep.subsets:
+                    for addr in subset.addresses:
+                        ips.add(addr.ip)
+        return [rr_a(ip) for ip in sorted(ips)]
+
+    @staticmethod
+    def _named_port(svc: api.Service, port_name: str,
+                    proto: str) -> Optional[int]:
+        for sp in svc.spec.ports:
+            if (sp.name and sp.name.lower() == port_name
+                    and (sp.protocol or "TCP").lower() == proto):
+                return sp.port
+        return None
+
+    # -------------------------------------------------------- forwarding
+
+    def _relay_upstream(self, data: bytes) -> Optional[bytes]:
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.settimeout(2.0)
+                s.sendto(data, self.upstream)
+                reply, _ = s.recvfrom(4096)
+                return reply
+        except OSError:
+            return None

@@ -297,6 +297,39 @@ def run_hollow_fleet(argv: List[str]) -> int:
         f"hollow-fleet ready nodes={args.num_nodes}", [fleet.stop])
 
 
+def run_dns(argv: List[str]) -> int:
+    """Cluster DNS (ref: cluster/addons/dns — the kube2sky + skydns
+    pair as one informer-fed server; DIVERGENCES #16)."""
+    p = argparse.ArgumentParser(prog="dns")
+    p.add_argument("--master", required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10053)
+    p.add_argument("--cluster-domain", default="cluster.local")
+    p.add_argument("--upstream", default="",
+                   help="host:port resolver for out-of-domain queries")
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .dns import ClusterDNS
+
+    upstream = None
+    if args.upstream:
+        host, sep, port = args.upstream.rpartition(":")
+        if not sep:
+            host, port = args.upstream, "53"
+        if not host or not port.isdigit():
+            p.error(f"--upstream must be host[:port], got "
+                    f"{args.upstream!r}")
+        upstream = (host, int(port))
+    _wait_for_master(args.master)
+    dns = ClusterDNS(HttpClient(args.master), host=args.host,
+                     port=args.port, cluster_domain=args.cluster_domain,
+                     upstream=upstream).start()
+    return _serve_until_signal(
+        f"dns ready {args.host}:{dns.port} domain={args.cluster_domain}",
+        [dns.stop])
+
+
 def run_proxy(argv: List[str]) -> int:
     """(ref: cmd/kube-proxy + the hollow --morph=proxy,
     cmd/kubemark/hollow-node.go:80: fake iptables backing the real
@@ -370,6 +403,8 @@ COMPONENTS = {
     "kube-proxy": run_proxy,
     "kubectl": run_kubectl,
     "migrate-storage": run_migrate_storage,
+    "dns": run_dns,
+    "kube-dns": run_dns,
 }
 
 

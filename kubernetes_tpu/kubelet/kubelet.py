@@ -18,6 +18,7 @@ RestartPolicy semantics (syncPod + computePodStatus):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -35,6 +36,23 @@ SYNC_PERIOD = 10.0
 # dead-container GC cadence (ref: kubelet.go StartGarbageCollection,
 # container GC on its own 1-minute loop — not every housekeeping tick)
 CONTAINER_GC_PERIOD = 60.0
+
+
+def _parse_resolv_conf(text: str) -> "tuple[List[str], List[str]]":
+    """nameserver/search lines of a resolv.conf (kubelet.go:1530
+    parseResolvConf; later `search` lines replace earlier ones, the
+    resolver's own rule)."""
+    nameservers: List[str] = []
+    searches: List[str] = []
+    for line in text.splitlines():
+        fields = line.split("#", 1)[0].split()
+        if not fields:
+            continue
+        if fields[0] == "nameserver" and len(fields) >= 2:
+            nameservers.append(fields[1])
+        elif fields[0] == "search":
+            searches = fields[1:]
+    return nameservers, searches
 
 
 def _rfc3339(epoch: float) -> str:
@@ -93,7 +111,10 @@ class Kubelet:
                  volume_mgr=None, image_manager=None,
                  manifest_path: Optional[str] = None,
                  manifest_url: Optional[str] = None,
-                 master_service_namespace: str = "default"):
+                 master_service_namespace: str = "default",
+                 cluster_dns: Optional[str] = None,
+                 cluster_domain: str = "",
+                 resolver_config: str = "/etc/resolv.conf"):
         """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
         before containers start and torn down on deletion (kubelet.go
         syncPod mountExternalVolumes). image_manager: pull-policy
@@ -128,6 +149,12 @@ class Kubelet:
         # documented pod-vs-service race (kubelet.go:1400-1403)
         self._service_informer: Optional[Informer] = None
         self.master_service_namespace = master_service_namespace
+        # --cluster-dns / --cluster-domain / --resolv-conf
+        # (kubelet.go:180,648; getClusterDNS :1465)
+        self.cluster_dns = cluster_dns
+        self.cluster_domain = cluster_domain
+        self.resolver_config = resolver_config
+        self._resolv_cache = None  # (mtime, nameservers, searches)
         self.max_restart_backoff = max_restart_backoff
         from .container_gc import ContainerGC
         self._container_gc = (ContainerGC(self.runtime)
@@ -221,6 +248,14 @@ class Kubelet:
                 self._note_backoff(key, now)
                 self._publish_status(pod)
                 return
+        if hasattr(self.runtime, "set_pod_dns"):
+            # materialize the pod's resolver config before any container
+            # starts (the dockertools --dns/--dns-search role; idempotent)
+            try:
+                ns, search = self.get_cluster_dns(pod)
+                self.runtime.set_pod_dns(uid, ns, search)
+            except Exception:
+                logging.exception("set_pod_dns %s", uid)
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
             if rc is not None and rc.state == ContainerState.RUNNING:
@@ -249,6 +284,49 @@ class Kubelet:
         delay = min(prev * 2, self.max_restart_backoff)
         self._backoff[key] = now + delay
         self._backoff[f"{key}#d"] = delay
+
+    def get_cluster_dns(self, pod: api.Pod
+                        ) -> "tuple[List[str], List[str]]":
+        """(nameservers, search domains) for a pod (kubelet.go:1465
+        getClusterDNS): ClusterFirst pods get only the cluster DNS with
+        the {ns}.svc.{domain} / svc.{domain} / {domain} search ladder
+        prepended to the host's; other pods (or ClusterFirst with no
+        --cluster-dns configured — the MissingClusterDNS fallback) get
+        the host resolver's settings."""
+        host_dns: List[str] = []
+        host_search: List[str] = []
+        if self.resolver_config:
+            # memoized by mtime: this runs on every pod sync tick
+            try:
+                mtime = os.stat(self.resolver_config).st_mtime
+                cached = self._resolv_cache
+                if cached is not None and cached[0] == mtime:
+                    host_dns, host_search = cached[1], cached[2]
+                else:
+                    with open(self.resolver_config) as f:
+                        host_dns, host_search = _parse_resolv_conf(
+                            f.read())
+                    self._resolv_cache = (mtime, host_dns, host_search)
+            except OSError:
+                pass
+        cluster_first = (pod.spec.dns_policy or "ClusterFirst") \
+            == "ClusterFirst"
+        if cluster_first and not self.cluster_dns:
+            logging.warning(
+                "pod %s wants ClusterFirst DNS but no --cluster-dns is "
+                "configured; falling back to host DNS",
+                pod.metadata.name)
+            cluster_first = False
+        if not cluster_first:
+            if not self.resolver_config:
+                # empty --resolv-conf: the documented "use the local
+                # resolver" stance (kubelet.go:1494-1503)
+                return ["127.0.0.1"], ["."]
+            return host_dns, host_search
+        search = ([f"{pod.metadata.namespace}.svc.{self.cluster_domain}",
+                   f"svc.{self.cluster_domain}", self.cluster_domain]
+                  if self.cluster_domain else []) + host_search
+        return [self.cluster_dns], search
 
     def make_environment(self, pod: api.Pod, container: api.Container
                          ) -> List[api.EnvVar]:

@@ -116,7 +116,35 @@ class SubprocessRuntime(Runtime):
                                     else ["sleep", "3600"])
         self._procs: Dict[Tuple[str, str], _Proc] = {}  # (uid, name)
         self._pods: Dict[str, api.Pod] = {}
+        self._resolv: Dict[str, str] = {}  # uid -> resolv.conf path
+        self._resolv_text: Dict[str, str] = {}  # uid -> written content
         self._lock = threading.Lock()
+
+    def set_pod_dns(self, pod_uid: str, nameservers: List[str],
+                    searches: List[str]) -> None:
+        """Materialize the pod's resolver config (the kubelet's
+        --cluster-dns role). A process pod has no network namespace to
+        bind /etc/resolv.conf into, so the file lands at
+        ``{root}/{uid}-resolv.conf`` and each container gets
+        RESOLV_CONF pointing at it — DNS-aware entrypoints consume it
+        (res_init-style libc reload is a container concern either way;
+        the reference has the same caveat for running containers)."""
+        path = os.path.join(self.root_dir, f"{pod_uid}-resolv.conf")
+        text = "".join(f"nameserver {ns}\n" for ns in nameservers)
+        if searches:
+            text += "search " + " ".join(searches) + "\n"
+        with self._lock:
+            unchanged = (self._resolv.get(pod_uid) == path
+                         and self._resolv_text.get(pod_uid) == text)
+        if unchanged:
+            return  # called every sync tick; skip byte-identical writes
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+        with self._lock:
+            self._resolv[pod_uid] = path
+            self._resolv_text[pod_uid] = text
 
     # ------------------------------------------------------- Runtime API
 
@@ -140,6 +168,13 @@ class SubprocessRuntime(Runtime):
             if container.command else self.default_command
         env = {**os.environ,
                **{e.name: e.value for e in container.env}}
+        with self._lock:
+            resolv = self._resolv.get(uid)
+        if resolv is not None and not any(
+                e.name == "RESOLV_CONF" for e in container.env):
+            # only an explicit container env entry may override — an
+            # inherited host RESOLV_CONF must not mask the pod's config
+            env["RESOLV_CONF"] = resolv
         log_path = os.path.join(
             self.root_dir, f"{uid}-{container.name}.log")
         with self._lock:
@@ -191,6 +226,13 @@ class SubprocessRuntime(Runtime):
             for key in [k for k in self._procs if k[0] == pod_uid]:
                 del self._procs[key]
             self._pods.pop(pod_uid, None)
+            resolv = self._resolv.pop(pod_uid, None)
+            self._resolv_text.pop(pod_uid, None)
+        if resolv is not None:
+            try:
+                os.unlink(resolv)
+            except OSError:
+                pass
 
     def container_log_path(self, pod_uid: str, name: str) -> str:
         """The captured log file (the follow-stream seam the kubelet

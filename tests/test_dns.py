@@ -1,0 +1,302 @@
+"""Cluster DNS: the kube-dns addon schema over real RFC 1035 wire
+(ref: cluster/addons/dns/README.md, skydns/kube2sky roles), and the
+kubelet's ClusterFirst resolver config (kubelet.go:1465 getClusterDNS).
+
+Queries are hand-crafted packets over stdlib sockets — independent of
+the server's own codec — so the wire format itself is under test.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.dns import ClusterDNS
+from kubernetes_tpu.kubelet.kubelet import _parse_resolv_conf
+
+TYPE_A, TYPE_SRV, TYPE_AAAA = 1, 33, 28
+
+
+def build_query(qid, name, qtype):
+    head = struct.pack("!HHHHHH", qid, 0x0100, 1, 0, 0, 0)
+    q = b""
+    for label in name.rstrip(".").split("."):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack("!HH", qtype, 1)
+    return head + q
+
+
+def parse_reply(data, qname):
+    qid, flags, qd, an, ns, ar = struct.unpack("!HHHHHH", data[:12])
+    rcode = flags & 0xF
+    assert flags & 0x8000, "QR bit must be set"
+    # skip the echoed question
+    off = 12
+    while data[off] != 0:
+        off += 1 + data[off]
+    off += 1 + 4
+    answers = []
+    for _ in range(an):
+        assert data[off:off + 2] == b"\xc0\x0c"  # name pointer
+        atype, aclass, ttl, rdlen = struct.unpack(
+            "!HHIH", data[off + 2:off + 12])
+        rdata = data[off + 12:off + 12 + rdlen]
+        answers.append((atype, rdata))
+        off += 12 + rdlen
+    return qid, rcode, answers
+
+
+def udp_query(port, name, qtype, qid=0x1234):
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(5.0)
+        s.sendto(build_query(qid, name, qtype), ("127.0.0.1", port))
+        data, _ = s.recvfrom(4096)
+    rid, rcode, answers = parse_reply(data, name)
+    assert rid == qid
+    return rcode, answers
+
+
+def tcp_query(port, name, qtype, qid=0x4321):
+    q = build_query(qid, name, qtype)
+    with socket.create_connection(("127.0.0.1", port), timeout=5.0) as s:
+        s.sendall(struct.pack("!H", len(q)) + q)
+        raw = s.recv(2)
+        (n,) = struct.unpack("!H", raw)
+        data = b""
+        while len(data) < n:
+            data += s.recv(n - len(data))
+    rid, rcode, answers = parse_reply(data, name)
+    assert rid == qid
+    return rcode, answers
+
+
+def a_ips(answers):
+    return sorted(socket.inet_ntoa(rd) for t, rd in answers
+                  if t == TYPE_A)
+
+
+def wait_until(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+@pytest.fixture()
+def dns_env():
+    registry = Registry()
+    client = InProcClient(registry)
+    client.create("services", api.Service(
+        metadata=api.ObjectMeta(name="redis-master", namespace="default"),
+        spec=api.ServiceSpec(cluster_ip="10.0.0.11", ports=[
+            api.ServicePort(name="client", port=6379, protocol="TCP")])),
+        "default")
+    client.create("services", api.Service(
+        metadata=api.ObjectMeta(name="peers", namespace="prod"),
+        spec=api.ServiceSpec(cluster_ip="None", ports=[
+            api.ServicePort(name="peer", port=7000, protocol="TCP")])),
+        "prod")
+    client.create("endpoints", api.Endpoints(
+        metadata=api.ObjectMeta(name="peers", namespace="prod"),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip="10.244.1.5"),
+                       api.EndpointAddress(ip="10.244.2.6")],
+            ports=[api.EndpointPort(name="peer", port=7000)])]), "prod")
+    dns = ClusterDNS(client, port=0).start()
+    assert wait_until(lambda: dns._services.has_synced
+                      and dns._endpoints.has_synced)
+    yield client, dns
+    dns.stop()
+
+
+class TestClusterSchema:
+    def test_service_a_record(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "redis-master.default.svc.cluster.local", TYPE_A)
+        assert rcode == 0
+        assert a_ips(answers) == ["10.0.0.11"]
+
+    def test_headless_service_resolves_to_endpoints(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "peers.prod.svc.cluster.local", TYPE_A)
+        assert rcode == 0
+        assert a_ips(answers) == ["10.244.1.5", "10.244.2.6"]
+
+    def test_srv_named_port(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "_client._tcp.redis-master.default.svc.cluster.local",
+            TYPE_SRV)
+        assert rcode == 0
+        (atype, rdata), = answers
+        assert atype == TYPE_SRV
+        prio, weight, port = struct.unpack("!HHH", rdata[:6])
+        assert (prio, weight, port) == (10, 10, 6379)
+        # target is the service name, uncompressed
+        assert b"redis-master" in rdata[6:]
+
+    def test_pod_record(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "10-244-3-7.default.pod.cluster.local", TYPE_A)
+        assert rcode == 0
+        assert a_ips(answers) == ["10.244.3.7"]
+
+    def test_unknown_service_nxdomain(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "nope.default.svc.cluster.local", TYPE_A)
+        assert rcode == 3 and answers == []
+
+    def test_existing_name_wrong_type_nodata(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = udp_query(
+            dns.port, "redis-master.default.svc.cluster.local", TYPE_AAAA)
+        assert rcode == 0 and answers == []
+
+    def test_search_ladder_intermediates_are_nodata(self, dns_env):
+        # a resolver walking ns.svc.domain/svc.domain/domain must see
+        # NODATA (not NXDOMAIN) on intermediate names
+        _, dns = dns_env
+        for name in ("default.svc.cluster.local", "svc.cluster.local",
+                     "cluster.local"):
+            rcode, answers = udp_query(dns.port, name, TYPE_A)
+            assert (rcode, answers) == (0, []), name
+
+    def test_out_of_domain_servfail_without_upstream(self, dns_env):
+        _, dns = dns_env
+        rcode, _ = udp_query(dns.port, "example.com", TYPE_A)
+        assert rcode == 2
+
+    def test_tcp_transport(self, dns_env):
+        _, dns = dns_env
+        rcode, answers = tcp_query(
+            dns.port, "redis-master.default.svc.cluster.local", TYPE_A)
+        assert rcode == 0
+        assert a_ips(answers) == ["10.0.0.11"]
+
+    def test_watch_driven_updates(self, dns_env):
+        client, dns = dns_env
+        client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="late", namespace="default"),
+            spec=api.ServiceSpec(cluster_ip="10.0.0.99", ports=[
+                api.ServicePort(port=80)])), "default")
+        assert wait_until(lambda: udp_query(
+            dns.port, "late.default.svc.cluster.local", TYPE_A)[1])
+        client.delete("services", "late", "default")
+        assert wait_until(lambda: udp_query(
+            dns.port, "late.default.svc.cluster.local", TYPE_A)[0] == 3)
+
+
+class TestUpstreamForwarding:
+    def test_out_of_domain_relayed(self):
+        # a fake upstream resolver that answers every query 1.2.3.4
+        up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        up.bind(("127.0.0.1", 0))
+        up_port = up.getsockname()[1]
+
+        def serve_one():
+            data, addr = up.recvfrom(4096)
+            qid = struct.unpack("!H", data[:2])[0]
+            # echo question, add one A answer
+            head = struct.pack("!HHHHHH", qid, 0x8180, 1, 1, 0, 0)
+            q = data[12:]
+            ans = (b"\xc0\x0c" + struct.pack("!HHIH", 1, 1, 60, 4)
+                   + socket.inet_aton("1.2.3.4"))
+            up.sendto(head + q + ans, addr)
+
+        t = threading.Thread(target=serve_one, daemon=True)
+        t.start()
+        registry = Registry()
+        dns = ClusterDNS(InProcClient(registry), port=0,
+                         upstream=("127.0.0.1", up_port)).start()
+        try:
+            rcode, answers = udp_query(dns.port, "example.com", TYPE_A)
+            assert rcode == 0
+            assert a_ips(answers) == ["1.2.3.4"]
+        finally:
+            dns.stop()
+            up.close()
+
+
+class TestKubeletDNSConfig:
+    def _kubelet(self, tmp_path, **kw):
+        from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+        resolv = tmp_path / "resolv.conf"
+        resolv.write_text("nameserver 8.8.8.8\nsearch corp.example\n")
+        return Kubelet(InProcClient(Registry()), "n1",
+                       runtime=FakeRuntime(),
+                       resolver_config=str(resolv), **kw)
+
+    def _pod(self, policy=""):
+        return api.Pod(metadata=api.ObjectMeta(
+            name="p", namespace="prod", uid="u1"),
+            spec=api.PodSpec(dns_policy=policy))
+
+    def test_cluster_first_search_ladder(self, tmp_path):
+        kl = self._kubelet(tmp_path, cluster_dns="10.0.0.10",
+                           cluster_domain="cluster.local")
+        ns, search = kl.get_cluster_dns(self._pod("ClusterFirst"))
+        assert ns == ["10.0.0.10"]
+        assert search == ["prod.svc.cluster.local", "svc.cluster.local",
+                          "cluster.local", "corp.example"]
+
+    def test_default_policy_uses_host(self, tmp_path):
+        kl = self._kubelet(tmp_path, cluster_dns="10.0.0.10",
+                           cluster_domain="cluster.local")
+        ns, search = kl.get_cluster_dns(self._pod("Default"))
+        assert ns == ["8.8.8.8"] and search == ["corp.example"]
+
+    def test_cluster_first_without_cluster_dns_falls_back(self, tmp_path):
+        kl = self._kubelet(tmp_path)
+        ns, search = kl.get_cluster_dns(self._pod("ClusterFirst"))
+        assert ns == ["8.8.8.8"] and search == ["corp.example"]
+
+    def test_parse_resolv_conf(self):
+        ns, search = _parse_resolv_conf(
+            "# comment\nnameserver 1.1.1.1\nnameserver 2.2.2.2\n"
+            "search a.example b.example\nsearch c.example\n")
+        assert ns == ["1.1.1.1", "2.2.2.2"]
+        assert search == ["c.example"]  # later search replaces earlier
+
+
+class TestSubprocessRuntimeResolvConf:
+    def test_resolv_file_written_and_env_injected(self, tmp_path):
+        from kubernetes_tpu.kubelet.subprocess_runtime import \
+            SubprocessRuntime
+        rt = SubprocessRuntime(str(tmp_path))
+        rt.set_pod_dns("u1", ["10.0.0.10"],
+                       ["prod.svc.cluster.local", "cluster.local"])
+        path = tmp_path / "u1-resolv.conf"
+        assert path.read_text() == (
+            "nameserver 10.0.0.10\n"
+            "search prod.svc.cluster.local cluster.local\n")
+        pod = api.Pod(metadata=api.ObjectMeta(name="p", namespace="d",
+                                              uid="u1"),
+                      spec=api.PodSpec(containers=[]))
+        container = api.Container(
+            name="c", image="i",
+            command=["/bin/sh", "-c", "echo RESOLV=$RESOLV_CONF"])
+        rt.start_container(pod, container)
+        deadline = time.time() + 10
+        log = ""
+        while time.time() < deadline:
+            try:
+                log = rt.get_container_logs("u1", "c")
+            except Exception:
+                log = ""
+            if "RESOLV=" in log:
+                break
+            time.sleep(0.05)
+        assert f"RESOLV={path}" in log
+        rt.kill_pod("u1")
+        assert not path.exists()  # cleaned up with the pod

@@ -28,11 +28,15 @@ from .serviceaccount import ServiceAccountsController, TokensController
 
 class ControllerManager:
     def __init__(self, client, metrics_source=None, recorder=None,
-                 pod_gc_threshold: int = 12500, cloud=None):
+                 pod_gc_threshold: int = 12500, cloud=None,
+                 allocate_node_cidrs: bool = False,
+                 cluster_cidr: str = "10.244.0.0/16"):
         self.controllers: List = [
             EndpointsController(client),
             ReplicationManager(client, recorder=recorder),
-            NodeController(client),
+            NodeController(client, recorder=recorder,
+                           allocate_node_cidrs=allocate_node_cidrs,
+                           cluster_cidr=cluster_cidr),
             PodGCController(client, threshold=pod_gc_threshold),
             NamespaceController(client),
             ResourceQuotaController(client),
@@ -48,7 +52,8 @@ class ControllerManager:
                 HorizontalController(client, metrics_source))
         if cloud is not None:
             self.controllers.append(ServiceController(client, cloud))
-            self.controllers.append(RouteController(client, cloud))
+            self.controllers.append(RouteController(
+                client, cloud, cluster_cidr=cluster_cidr))
 
     def run(self) -> "ControllerManager":
         for c in self.controllers:

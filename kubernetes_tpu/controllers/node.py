@@ -43,7 +43,24 @@ class NodeController:
                  monitor_grace_period: float = 40.0,
                  pod_eviction_timeout: float = 300.0,
                  eviction_qps: float = 0.1, eviction_burst: int = 10,
-                 clock: Optional[Clock] = None, recorder=None):
+                 clock: Optional[Clock] = None, recorder=None,
+                 allocate_node_cidrs: bool = False,
+                 cluster_cidr: str = ""):
+        """allocate_node_cidrs + cluster_cidr: assign each node a /24
+        pod CIDR from the cluster range (nodecontroller.go:62,137
+        --allocate-node-cidrs; the route controller consumes
+        node.spec.pod_cidr)."""
+        if allocate_node_cidrs:
+            if not cluster_cidr:
+                raise ValueError(
+                    "allocate_node_cidrs requires cluster_cidr "
+                    "(nodecontroller.go:137-139)")
+            import ipaddress
+            # fail at construction, not in the monitor thread — a lazy
+            # ValueError would kill health monitoring cluster-wide
+            ipaddress.ip_network(cluster_cidr)
+        self.allocate_node_cidrs = allocate_node_cidrs
+        self.cluster_cidr = cluster_cidr
         self.client = client
         self.monitor_period = monitor_period
         self.monitor_grace_period = monitor_grace_period
@@ -159,6 +176,45 @@ class NodeController:
             except Exception:
                 pass
 
+    # -- pod CIDR allocation ----------------------------------------------
+
+    def reconcile_node_cidrs(self, nodes) -> None:
+        """Assign a free /24 from the cluster CIDR to every node that
+        lacks one (nodecontroller.go:476 reconcileNodeCIDRs). Unlike
+        the reference — which regenerates len(nodes) candidate CIDRs
+        each sync and pops from a random set — allocation here walks
+        the subnets in address order, so assignments are deterministic
+        and the pool isn't capped at the current node count."""
+        import ipaddress
+        used = {n.spec.pod_cidr for n in nodes if n.spec.pod_cidr}
+        free = None  # lazy: the common case is every node assigned
+        for node in nodes:
+            if node.spec.pod_cidr:
+                continue
+            if free is None:
+                cluster = ipaddress.ip_network(self.cluster_cidr)
+                subnets = (cluster.subnets(new_prefix=24)
+                           if cluster.prefixlen <= 24 else iter(()))
+                free = (str(s) for s in subnets if str(s) not in used)
+            cidr = next(free, None)
+            if cidr is None:
+                if self.recorder:
+                    self.recorder.eventf(
+                        node, "Normal", "CIDRNotAvailable",
+                        "Node %s status is now: CIDRNotAvailable",
+                        node.metadata.name)
+                continue
+            node.spec.pod_cidr = cidr
+            try:
+                self.client.update("nodes", node)
+            except Exception:
+                node.spec.pod_cidr = ""
+                if self.recorder:
+                    self.recorder.eventf(
+                        node, "Normal", "CIDRAssignmentFailed",
+                        "Node %s status is now: CIDRAssignmentFailed",
+                        node.metadata.name)
+
     # -- control loop -----------------------------------------------------
 
     def monitor_once(self) -> None:
@@ -166,6 +222,8 @@ class NodeController:
             nodes, _ = self.client.list("nodes")
         except Exception:
             return
+        if self.allocate_node_cidrs:
+            self.reconcile_node_cidrs(nodes)
         now = self.clock.now()
         names = {n.metadata.name for n in nodes}
         # deleted nodes: evict their pods (monitorNodeStatus :378-382)

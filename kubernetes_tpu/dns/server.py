@@ -167,9 +167,12 @@ class ClusterDNS:
 
         class _TCPHandler(socketserver.BaseRequestHandler):
             def handle(self):
-                raw = self.request.recv(2)
-                if len(raw) < 2:
-                    return
+                raw = b""
+                while len(raw) < 2:  # the prefix can arrive split too
+                    chunk = self.request.recv(2 - len(raw))
+                    if not chunk:
+                        return
+                    raw += chunk
                 (n,) = struct.unpack("!H", raw)
                 data = b""
                 while len(data) < n:
@@ -284,11 +287,10 @@ class ClusterDNS:
     # --------------------------------------------------------- records
 
     def _service(self, namespace: str, name: str) -> Optional[api.Service]:
-        for svc in self._services.cache.list():
-            if (svc.metadata.name.lower() == name
-                    and svc.metadata.namespace.lower() == namespace):
-                return svc
-        return None
+        # keyed cache lookup, not a scan — this is the hottest path of
+        # a server every pod's resolver points at (object names are
+        # already lowercase per DNS-1123, matching the lowered qname)
+        return self._services.cache.get_by_key(f"{namespace}/{name}")
 
     def _service_a(self, svc: api.Service) -> List[bytes]:
         ip = svc.spec.cluster_ip
@@ -296,12 +298,12 @@ class ClusterDNS:
             return [rr_a(ip)]
         # headless: one A per endpoint address, deterministic order
         ips = set()
-        for ep in self._endpoints.cache.list():
-            if (ep.metadata.name == svc.metadata.name
-                    and ep.metadata.namespace == svc.metadata.namespace):
-                for subset in ep.subsets:
-                    for addr in subset.addresses:
-                        ips.add(addr.ip)
+        ep = self._endpoints.cache.get_by_key(
+            f"{svc.metadata.namespace}/{svc.metadata.name}")
+        if ep is not None:
+            for subset in ep.subsets:
+                for addr in subset.addresses:
+                    ips.add(addr.ip)
         return [rr_a(ip) for ip in sorted(ips)]
 
     @staticmethod

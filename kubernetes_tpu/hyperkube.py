@@ -234,13 +234,20 @@ def run_controller_manager(argv: List[str]) -> int:
     """(ref: cmd/kube-controller-manager/app/controllermanager.go:284)"""
     p = argparse.ArgumentParser(prog="controller-manager")
     p.add_argument("--master", required=True)
+    p.add_argument("--allocate-node-cidrs", action="store_true",
+                   help="assign each node a pod CIDR from "
+                        "--cluster-cidr (controllermanager.go:228)")
+    p.add_argument("--cluster-cidr", default="10.244.0.0/16")
     args = p.parse_args(argv)
 
     from .api.client import HttpClient
     from .controllers.manager import ControllerManager
 
     _wait_for_master(args.master)
-    manager = ControllerManager(HttpClient(args.master)).run()
+    manager = ControllerManager(
+        HttpClient(args.master),
+        allocate_node_cidrs=args.allocate_node_cidrs,
+        cluster_cidr=args.cluster_cidr).run()
     return _serve_until_signal(
         "controller-manager ready",
         [manager.stop, _start_healthz("controller-manager")])

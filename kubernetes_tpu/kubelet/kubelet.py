@@ -308,7 +308,12 @@ class Kubelet:
                             f.read())
                     self._resolv_cache = (mtime, host_dns, host_search)
             except OSError:
-                pass
+                # transiently unreadable (non-atomic rewrite by the
+                # host's network manager): keep the last good parse
+                # rather than materializing a zero-nameserver config
+                if self._resolv_cache is not None:
+                    host_dns = self._resolv_cache[1]
+                    host_search = self._resolv_cache[2]
         cluster_first = (pod.spec.dns_policy or "ClusterFirst") \
             == "ClusterFirst"
         if cluster_first and not self.cluster_dns:

@@ -261,6 +261,75 @@ class TestNodeController:
         assert len(client.list("pods", "default")[0]) == 0
 
 
+class TestNodeCIDRAllocation:
+    """(ref: pkg/controller/node/nodecontroller.go:476
+    reconcileNodeCIDRs; --allocate-node-cidrs)"""
+
+    def _nc(self, client, **kw):
+        kw.setdefault("allocate_node_cidrs", True)
+        kw.setdefault("cluster_cidr", "10.244.0.0/16")
+        return NodeController(client, clock=FakeClock(start=1000.0), **kw)
+
+    def test_assigns_free_slash24s_deterministically(self, cluster):
+        _, client = cluster
+        for name in ("n1", "n2", "n3"):
+            client.create("nodes", ready_node(name))
+        self._nc(client).monitor_once()
+        cidrs = {n.metadata.name: n.spec.pod_cidr
+                 for n in client.list("nodes")[0]}
+        assert cidrs == {"n1": "10.244.0.0/24", "n2": "10.244.1.0/24",
+                         "n3": "10.244.2.0/24"}
+
+    def test_existing_assignments_kept_and_skipped(self, cluster):
+        _, client = cluster
+        pre = ready_node("n1")
+        pre.spec.pod_cidr = "10.244.0.0/24"
+        client.create("nodes", pre)
+        client.create("nodes", ready_node("n2"))
+        self._nc(client).monitor_once()
+        cidrs = {n.metadata.name: n.spec.pod_cidr
+                 for n in client.list("nodes")[0]}
+        assert cidrs["n1"] == "10.244.0.0/24"
+        assert cidrs["n2"] == "10.244.1.0/24"
+
+    def test_exhaustion_records_event(self, cluster):
+        _, client = cluster
+        events = []
+
+        class Recorder:
+            def eventf(self, obj, etype, reason, fmt, *args):
+                events.append(reason)
+
+        # a /30 cluster range has zero /24 subnets
+        nc = self._nc(client, cluster_cidr="10.244.0.0/30",
+                      recorder=Recorder())
+        client.create("nodes", ready_node("n1"))
+        nc.monitor_once()
+        assert client.get("nodes", "n1").spec.pod_cidr == ""
+        assert "CIDRNotAvailable" in events
+
+    def test_flag_requires_cluster_cidr(self, cluster):
+        _, client = cluster
+        with pytest.raises(ValueError):
+            NodeController(client, allocate_node_cidrs=True,
+                           cluster_cidr="")
+
+    def test_route_controller_consumes_allocation(self, cluster):
+        # allocation -> route reconcile, the pairing
+        # controllermanager.go:316-324 warns about
+        from kubernetes_tpu.cloudprovider import FakeCloudProvider
+        from kubernetes_tpu.controllers.service import RouteController
+        _, client = cluster
+        client.create("nodes", ready_node("n1"))
+        self._nc(client).monitor_once()
+        cloud = FakeCloudProvider()
+        rc = RouteController(client, cloud)
+        rc.sync_once()
+        routes = cloud.routes().list_routes("")
+        assert [(r.target_instance, r.destination_cidr)
+                for r in routes] == [("n1", "10.244.0.0/24")]
+
+
 def running_pod(name, ip, labels, ready=True, ns="default"):
     p = pending_pod(name, labels=labels)
     p.metadata.namespace = ns

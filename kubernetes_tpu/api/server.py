@@ -33,8 +33,8 @@ from typing import Any, Optional, Tuple
 from ..auth.authenticate import authenticate_request
 from ..auth.authorize import AuthorizerAttributes
 from ..core.errors import (ApiError, BadGateway, BadRequest, Forbidden,
-                           MethodNotSupported, NotFound, TooManyRequests,
-                           Unauthorized)
+                           MethodNotSupported, NotFound, ServiceUnavailable,
+                           TooManyRequests, Unauthorized)
 from ..core.scheme import Scheme, default_scheme
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .registry import RESOURCES, Registry
@@ -63,6 +63,13 @@ def _authz_target(path: str):
         return "", ""  # bare group discovery (/apis/extensions/v1beta1)
     if parts[0] == "watch":
         parts = parts[1:]
+    if (parts and parts[0] == "proxy" and len(parts) >= 4
+            and parts[1] == "namespaces"):
+        # a namespaced proxy request authorizes against the proxied
+        # resource IN its namespace — an unscoped 'proxy' grant must
+        # not reach every namespace, and a namespace-confined policy
+        # must cover its own pods/services proxying
+        return parts[3], parts[2]
     if parts and parts[0] == "namespaces" and len(parts) >= 3 \
             and parts[2] not in ("status", "finalize"):
         return parts[2], parts[1]
@@ -367,6 +374,18 @@ class ApiServer:
             # ?command=)
             raw_q = urllib.parse.urlsplit(h.path).query
             return self._proxy_node(h, parts[2], "/".join(parts[3:]), raw_q)
+        # pod/service proxy:
+        # /api/v1/proxy/namespaces/{ns}/{pods|services}/{id[:port]}/...
+        # (ref: apiserver ProxyHandler + pod/strategy.go:199 +
+        # service/rest.go:288 ResourceLocation)
+        if (parts[0] == "proxy" and len(parts) >= 5
+                and parts[1] == "namespaces"
+                and parts[3] in ("pods", "services")):
+            if method != "GET":
+                raise MethodNotSupported(f"{parts[3]} proxy supports GET")
+            raw_q = urllib.parse.urlsplit(h.path).query
+            return self._proxy_workload(h, parts[3], parts[2], parts[4],
+                                        "/".join(parts[5:]), raw_q)
         resource = parts[0]
         name = parts[1] if len(parts) > 1 else ""
         sub = parts[2] if len(parts) > 2 else ""
@@ -837,6 +856,74 @@ class ApiServer:
         exec_admission(self.registry, rest)
         base = self._kubelet_base(node_name)
         self._relay(h, f"{base}/{rest}"
+                    + (f"?{raw_query}" if raw_query else ""))
+
+    @staticmethod
+    def _split_name_port(ident: str) -> "tuple[str, str]":
+        """'name', 'name:port' or 'http:name:port' (util
+        SplitSchemeNamePort; only the http scheme is served here)."""
+        bits = ident.split(":")
+        if len(bits) == 1:
+            return bits[0], ""
+        if len(bits) == 2:
+            return bits[0], bits[1]
+        if len(bits) == 3 and bits[0] == "http":
+            return bits[1], bits[2]
+        raise BadRequest(f"invalid proxy request {ident!r}")
+
+    def _proxy_workload(self, h, resource: str, namespace: str,
+                        ident: str, rest: str, raw_query: str) -> None:
+        """Locate the backend for a pod/service proxy request and relay
+        (ref: pkg/registry/pod/strategy.go:199 ResourceLocation — pod
+        IP, port defaulting to the first declared container port;
+        pkg/registry/service/rest.go:288 — resolve a port number to its
+        service-port name, then pick a ready endpoint carrying it)."""
+        import random
+        name, port = self._split_name_port(ident)
+        if resource == "pods":
+            pod = self.registry.get("pods", name, namespace)
+            if not port:
+                for c in pod.spec.containers:
+                    if c.ports:
+                        port = str(c.ports[0].container_port)
+                        break
+            if not pod.status.pod_ip or not port:
+                raise ServiceUnavailable(
+                    f"pod {name!r} has no address/port to proxy to")
+            if not port.isdigit():
+                raise BadRequest(
+                    f"pod proxy port must be numeric, got {port!r}")
+            host, hport = pod.status.pod_ip, int(port)
+        else:
+            svc = self.registry.get("services", name, namespace)
+            port_name = port
+            if port.isdigit():  # number -> declared port's name
+                match = [sp for sp in svc.spec.ports
+                         if sp.port == int(port)]
+                if not match:
+                    raise ServiceUnavailable(
+                        f"no service port {port} found for service "
+                        f"{name!r}")
+                port_name = match[0].name
+            elif not port:
+                if len(svc.spec.ports) != 1:
+                    raise BadRequest(
+                        f"service {name!r} has multiple ports; specify "
+                        f"one as {name}:port")
+                port_name = svc.spec.ports[0].name
+            eps = self.registry.get("endpoints", name, namespace)
+            candidates = []
+            for subset in eps.subsets:
+                for ep_port in subset.ports:
+                    if ep_port.name == port_name:
+                        candidates += [(a.ip, ep_port.port)
+                                       for a in subset.addresses]
+            if not candidates:
+                raise ServiceUnavailable(
+                    f"no endpoints available for service {name!r}")
+            # random pick spreads load like rest.go:322's random subset
+            host, hport = random.choice(candidates)
+        self._relay(h, f"http://{host}:{hport}/{rest}"
                     + (f"?{raw_query}" if raw_query else ""))
 
     # -------------------------------------------------------------- watch

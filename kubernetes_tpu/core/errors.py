@@ -90,11 +90,18 @@ class BadGateway(ApiError):
     reason = "BadGateway"
 
 
+class ServiceUnavailable(ApiError):
+    """No backend can take the proxied request (ref:
+    errors.NewServiceUnavailable, pkg/registry/service/rest.go:320)."""
+    code = 503
+    reason = "ServiceUnavailable"
+
+
 def from_status(status: dict) -> ApiError:
     reason = status.get("reason", "")
     for cls in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest,
                 MethodNotSupported, Unauthorized, Forbidden, TooManyRequests,
-                Expired, BadGateway):
+                Expired, BadGateway, ServiceUnavailable):
         if cls.reason == reason:
             err = cls(status.get("message", ""))
             details = status.get("details") or {}

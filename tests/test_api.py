@@ -491,3 +491,143 @@ def test_websocket_watch_answers_ping_with_pong():
             ws.close()
     finally:
         srv.stop()
+
+
+# -------------------------------------------------- pod/service proxy
+
+class TestWorkloadProxy:
+    """/api/v1/proxy/namespaces/{ns}/{pods|services}/{id[:port]}/...
+    (ref: pkg/registry/pod/strategy.go:199 + service/rest.go:288
+    ResourceLocation; apiserver ProxyHandler)."""
+
+    @pytest.fixture()
+    def backend(self):
+        # a live HTTP backend playing the pod
+        from http.server import (BaseHTTPRequestHandler, ThreadingHTTPServer)
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = f"backend:{self.path}".encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield httpd.server_address[1]
+        httpd.shutdown()
+        httpd.server_close()
+
+    def _get(self, server, path):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(server.url + path,
+                                        timeout=5) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_pod_proxy_defaults_to_first_container_port(self, server,
+                                                        backend):
+        c = HttpClient(server.url)
+        pod = mk_pod("web-1")
+        pod.spec.containers[0].ports = [
+            api.ContainerPort(container_port=backend)]
+        c.create("pods", pod)
+        pod = c.get("pods", "web-1")
+        pod.status.pod_ip = "127.0.0.1"
+        c.update_status("pods", pod)
+        status, body = self._get(
+            server, "/api/v1/proxy/namespaces/default/pods/web-1/"
+                    "healthz?x=1")
+        assert status == 200
+        assert body == "backend:/healthz?x=1"
+
+    def test_pod_proxy_explicit_port(self, server, backend):
+        c = HttpClient(server.url)
+        c.create("pods", mk_pod("web-2"))
+        pod = c.get("pods", "web-2")
+        pod.status.pod_ip = "127.0.0.1"
+        c.update_status("pods", pod)
+        status, body = self._get(
+            server,
+            f"/api/v1/proxy/namespaces/default/pods/web-2:{backend}/ok")
+        assert status == 200 and body == "backend:/ok"
+
+    def test_pod_proxy_without_address_is_503(self, server):
+        c = HttpClient(server.url)
+        c.create("pods", mk_pod("web-3"))
+        status, _ = self._get(
+            server, "/api/v1/proxy/namespaces/default/pods/web-3:80/x")
+        assert status == 503
+
+    def test_service_proxy_via_endpoints(self, server, backend):
+        c = HttpClient(server.url)
+        c.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc", namespace="default"),
+            spec=api.ServiceSpec(ports=[
+                api.ServicePort(name="http", port=80)])))
+        c.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="svc", namespace="default"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                ports=[api.EndpointPort(name="http", port=backend)])]))
+        # by port name, by port number, and defaulted (single port)
+        for ident in ("svc:http", "svc:80", "svc"):
+            status, body = self._get(
+                server,
+                f"/api/v1/proxy/namespaces/default/services/{ident}/hi")
+            assert (status, body) == (200, "backend:/hi"), ident
+
+    def test_service_proxy_no_endpoints_is_503(self, server):
+        c = HttpClient(server.url)
+        c.create("services", api.Service(
+            metadata=api.ObjectMeta(name="lone", namespace="default"),
+            spec=api.ServiceSpec(ports=[
+                api.ServicePort(name="http", port=80)])))
+        c.create("endpoints", api.Endpoints(
+            metadata=api.ObjectMeta(name="lone", namespace="default")))
+        status, _ = self._get(
+            server,
+            "/api/v1/proxy/namespaces/default/services/lone:http/x")
+        assert status == 503
+
+    def test_unknown_service_port_number_is_503(self, server):
+        c = HttpClient(server.url)
+        c.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc2", namespace="default"),
+            spec=api.ServiceSpec(ports=[
+                api.ServicePort(name="http", port=80)])))
+        status, _ = self._get(
+            server, "/api/v1/proxy/namespaces/default/services/svc2:81/x")
+        assert status == 503
+
+    def test_proxy_authz_attributes_resource_in_namespace(self):
+        # an ABAC policy scoped to a namespace must govern its proxy
+        # traffic (the reference's request-info attribution)
+        from kubernetes_tpu.api.server import _authz_target
+        assert _authz_target(
+            "/api/v1/proxy/namespaces/team-a/pods/p:80/x") == \
+            ("pods", "team-a")
+        assert _authz_target(
+            "/api/v1/proxy/namespaces/team-a/services/s/x") == \
+            ("services", "team-a")
+        assert _authz_target("/api/v1/proxy/nodes/n1/healthz") == \
+            ("proxy", "")
+
+    def test_pod_proxy_non_numeric_port_is_400(self, server):
+        c = HttpClient(server.url)
+        c.create("pods", mk_pod("web-4"))
+        pod = c.get("pods", "web-4")
+        pod.status.pod_ip = "127.0.0.1"
+        c.update_status("pods", pod)
+        status, _ = self._get(
+            server, "/api/v1/proxy/namespaces/default/pods/web-4:http/x")
+        assert status == 400

@@ -247,8 +247,12 @@ class ResourceQuota(Interface):
 
 
 class ServiceAccountPlugin(Interface):
-    """Default and validate pod service accounts
-    (ref: plugin/pkg/admission/serviceaccount)."""
+    """Default and validate pod service accounts, and mount the
+    account's API token secret into every container
+    (ref: plugin/pkg/admission/serviceaccount/admission.go:88,150,339;
+    DefaultAPITokenMountPath :48)."""
+
+    TOKEN_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
 
     def __init__(self, registry):
         self.registry = registry
@@ -263,13 +267,71 @@ class ServiceAccountPlugin(Interface):
         if not pod.spec.service_account_name:
             pod.spec.service_account_name = "default"
         try:
-            self.registry.get("serviceaccounts",
-                              pod.spec.service_account_name,
-                              attributes.namespace)
+            sa = self.registry.get("serviceaccounts",
+                                   pod.spec.service_account_name,
+                                   attributes.namespace)
         except NotFound:
             raise Forbidden(
                 f"service account {attributes.namespace}/"
                 f"{pod.spec.service_account_name} does not exist")
+        self._mount_token(sa, pod)
+
+    def _referenced_token(self, sa: api.ServiceAccount) -> str:
+        """First referenced secret that exists AND is a
+        service-account-token typed secret for this account
+        (admission.go getReferencedServiceAccountToken /
+        serviceaccount.IsServiceAccountToken) — a stray non-token
+        reference must not get mounted at the credentials path."""
+        for ref in sa.secrets:
+            if not ref.name:
+                continue
+            try:
+                secret = self.registry.get("secrets", ref.name,
+                                           sa.metadata.namespace)
+            except NotFound:
+                continue
+            if (secret.type == "kubernetes.io/service-account-token"
+                    and secret.metadata.annotations.get(
+                        "kubernetes.io/service-account.name")
+                    == sa.metadata.name):
+                return ref.name
+        return ""
+
+    def _mount_token(self, sa: api.ServiceAccount, pod: api.Pod) -> None:
+        """(admission.go:339 mountServiceAccountToken) The first
+        referenced token secret becomes a read-only secret volume
+        mounted at the well-known path in every container that doesn't
+        already mount something there. No token yet (the tokens
+        controller hasn't caught up) -> admit without one, like the
+        reference's MountServiceAccountToken w/o RequireAPIToken."""
+        token = self._referenced_token(sa)
+        if not token:
+            return
+        vol_name = ""
+        names = set()
+        for v in pod.spec.volumes:
+            names.add(v.name)
+            if v.secret is not None and v.secret.secret_name == token:
+                vol_name = v.name
+        if not vol_name:
+            vol_name = token
+            n = 0
+            while vol_name in names:  # uniquify (SimpleNameGenerator)
+                n += 1
+                vol_name = f"{token}-{n}"
+        mounted_any = False
+        for c in pod.spec.containers:
+            if any(m.mount_path == self.TOKEN_MOUNT_PATH
+                   for m in c.volume_mounts):
+                continue  # an existing mount at the path wins
+            c.volume_mounts.append(api.VolumeMount(
+                name=vol_name, mount_path=self.TOKEN_MOUNT_PATH,
+                read_only=True))
+            mounted_any = True
+        if mounted_any and vol_name not in names:
+            pod.spec.volumes.append(api.Volume(
+                name=vol_name,
+                secret=api.SecretVolumeSource(secret_name=token)))
 
 
 class SecurityContextDeny(Interface):

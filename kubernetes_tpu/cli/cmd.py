@@ -20,7 +20,7 @@ from ..core import types as api
 from ..core.errors import AlreadyExists, ApiError, NotFound
 from ..core.scheme import default_scheme
 from .describe import describe
-from .printers import print_objects
+from .printers import jsonpath_get, print_objects
 from .resource import (load_manifest, parse_resource_args,
                        resource_for_object)
 
@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--field-selector", dest="field_selector", default="")
     g.add_argument("--all-namespaces", action="store_true")
     g.add_argument("-w", "--watch", action="store_true")
+    g.add_argument("--sort-by", dest="sort_by", default="",
+                   help="jsonpath expression to sort the list by, "
+                        "e.g. '{.metadata.name}'")
 
     d = sub.add_parser("describe", help="show details of a resource")
     d.add_argument("args", nargs="+")
@@ -245,7 +248,7 @@ class Kubectl:
     # ------------------------------------------------------------- verbs
 
     def get(self, ns, args, output="", selector="", field_selector="",
-            all_namespaces=False, watch=False) -> None:
+            all_namespaces=False, watch=False, sort_by="") -> None:
         targets = parse_resource_args(args)
         objs = []
         names: List[str] = []
@@ -260,6 +263,8 @@ class Kubectl:
             else:
                 objs.append(self.client.get(resource, name, list_ns))
                 names.append(resource)
+        if sort_by:
+            objs, names = self._sort_objects(objs, names, sort_by)
         print_objects(objs, output, self.scheme, self.out,
                       resource_names=names, with_namespace=all_namespaces)
         if watch and len(targets) == 1 and targets[0][1] is None:
@@ -280,6 +285,30 @@ class Kubectl:
                 pass
             finally:
                 w.stop()
+
+    def _sort_objects(self, objs, names, sort_by):
+        """--sort-by='{.field.path}' (ref: pkg/kubectl/sorting_printer.go
+        SortingPrinter: a jsonpath field extracted per object keys the
+        sort; missing fields sort first, mixed types by type name)."""
+        def key(pair):
+            try:
+                val = jsonpath_get(self.scheme.encode_dict(pair[0]),
+                                   sort_by)
+            except (KeyError, IndexError, TypeError, ValueError):
+                # the wire omits default-valued fields; absent (or an
+                # expression this jsonpath subset can't evaluate)
+                # sorts first like a zero value
+                val = None
+            if val is None:
+                return (0, "", "")
+            if isinstance(val, bool):
+                return (1, "bool", str(val))
+            if isinstance(val, (int, float)):
+                return (1, "number", val)
+            return (1, type(val).__name__, str(val))
+
+        order = sorted(zip(objs, names), key=key)
+        return [o for o, _ in order], [n for _, n in order]
 
     def describe(self, ns, args) -> None:
         for resource, name in parse_resource_args(args):
@@ -1122,7 +1151,7 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
         if ns_args.command == "get":
             k.get(ns, ns_args.args, ns_args.output, ns_args.selector,
                   ns_args.field_selector, ns_args.all_namespaces,
-                  ns_args.watch)
+                  ns_args.watch, ns_args.sort_by)
         elif ns_args.command == "describe":
             k.describe(ns, ns_args.args)
         elif ns_args.command == "create":

@@ -191,6 +191,80 @@ class TestServiceAccountAndSCDeny:
         created = r.create("pods", mkpod("p"))
         assert created.spec.service_account_name == "default"
 
+    @staticmethod
+    def _token_secret(r, name="default-token", sa="default"):
+        r.create("secrets", api.Secret(
+            metadata=api.ObjectMeta(
+                name=name, namespace="default",
+                annotations={"kubernetes.io/service-account.name": sa}),
+            type="kubernetes.io/service-account-token",
+            data={"token": "t0k"}))
+
+    def test_token_secret_mounted_into_every_container(self):
+        # (ref: plugin/pkg/admission/serviceaccount/admission.go:339
+        # mountServiceAccountToken + DefaultAPITokenMountPath :48)
+        r = wired_registry("ServiceAccount")
+        self._token_secret(r)
+        r.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default"),
+            secrets=[api.ObjectReference(kind="Secret",
+                                         name="default-token")]))
+        pod = mkpod("p")
+        pod.spec.containers.append(api.Container(name="side", image="i"))
+        created = r.create("pods", pod)
+        path = "/var/run/secrets/kubernetes.io/serviceaccount"
+        for c in created.spec.containers:
+            mounts = [m for m in c.volume_mounts if m.mount_path == path]
+            assert len(mounts) == 1 and mounts[0].read_only, c.name
+            assert mounts[0].name == "default-token"
+        vols = [v for v in created.spec.volumes
+                if v.secret and v.secret.secret_name == "default-token"]
+        assert len(vols) == 1
+
+    def test_existing_mount_at_token_path_wins(self):
+        r = wired_registry("ServiceAccount")
+        self._token_secret(r)
+        r.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default"),
+            secrets=[api.ObjectReference(name="default-token")]))
+        pod = mkpod("p")
+        pod.spec.containers[0].volume_mounts = [api.VolumeMount(
+            name="mine",
+            mount_path="/var/run/secrets/kubernetes.io/serviceaccount")]
+        created = r.create("pods", pod)
+        assert [m.name for m in created.spec.containers[0].volume_mounts] \
+            == ["mine"]
+        # no token volume added since nothing needed it
+        assert not any(v.secret and v.secret.secret_name ==
+                       "default-token" for v in created.spec.volumes)
+
+    def test_no_token_yet_admits_without_mount(self):
+        r = wired_registry("ServiceAccount")
+        r.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default",
+                                    namespace="default")))
+        created = r.create("pods", mkpod("p"))
+        assert created.spec.containers[0].volume_mounts == []
+
+    def test_non_token_or_missing_references_skipped(self):
+        # a stray non-token (or dangling) reference must never land at
+        # the credentials path (admission.go
+        # getReferencedServiceAccountToken + IsServiceAccountToken)
+        r = wired_registry("ServiceAccount")
+        r.create("secrets", api.Secret(
+            metadata=api.ObjectMeta(name="tls-cert",
+                                    namespace="default"),
+            type="Opaque", data={"crt": "x"}))
+        self._token_secret(r, name="real-token")
+        r.create("serviceaccounts", api.ServiceAccount(
+            metadata=api.ObjectMeta(name="default", namespace="default"),
+            secrets=[api.ObjectReference(name="gone"),
+                     api.ObjectReference(name="tls-cert"),
+                     api.ObjectReference(name="real-token")]))
+        created = r.create("pods", mkpod("p"))
+        mounts = created.spec.containers[0].volume_mounts
+        assert [m.name for m in mounts] == ["real-token"]
+
     def test_scdeny_blocks_privileged(self):
         r = wired_registry("SecurityContextDeny")
         with pytest.raises(CoreForbidden):

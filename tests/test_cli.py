@@ -618,3 +618,42 @@ class TestConfigCommand:
         assert main(["config", "use-context", "nope"],
                     out=out, err=err) == 1
         assert "no context" in err.getvalue()
+
+
+class TestSortBy:
+    """--sort-by jsonpath sorting (ref: pkg/kubectl/sorting_printer.go)."""
+
+    def test_sort_by_name_and_numeric_field(self, cluster):
+        _, client = cluster
+        for name, replicas in (("zeta", 1), ("alpha", 5), ("mid", 3)):
+            client.create("replicationcontrollers",
+                          api.ReplicationController(
+                              metadata=api.ObjectMeta(name=name,
+                                                      namespace="default"),
+                              spec=api.ReplicationControllerSpec(
+                                  replicas=replicas,
+                                  selector={"app": name})))
+        code, out, _ = run_cli(client, "get", "rc",
+                               "--sort-by", "{.metadata.name}",
+                               "-o", "name")
+        assert code == 0
+        assert [l.split("/")[-1] for l in out.strip().splitlines()] == \
+            ["alpha", "mid", "zeta"]
+        code, out, _ = run_cli(client, "get", "rc",
+                               "--sort-by", "{.spec.replicas}",
+                               "-o", "name")
+        assert code == 0
+        assert [l.split("/")[-1] for l in out.strip().splitlines()] == \
+            ["zeta", "mid", "alpha"]
+
+    def test_missing_field_sorts_first(self, cluster):
+        _, client = cluster
+        labeled = mkpod("b-labeled", labels={"rank": "1"})
+        client.create("pods", labeled)
+        client.create("pods", mkpod("a-unlabeled"))
+        code, out, _ = run_cli(client, "get", "pods",
+                               "--sort-by", "{.metadata.labels.rank}",
+                               "-o", "name")
+        assert code == 0
+        assert [l.split("/")[-1] for l in out.strip().splitlines()] == \
+            ["a-unlabeled", "b-labeled"]

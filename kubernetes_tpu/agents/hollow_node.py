@@ -196,7 +196,6 @@ class HollowKubelet:
         self.status_manager = StatusManager(client)
         self._informer: Optional[Informer] = None
         self._stop = threading.Event()
-        self._hb_thread: Optional[threading.Thread] = None
         # the node's remote surface (ref: hollow nodes run the REAL
         # kubelet server in kubemark, hollow_kubelet.go:35); port lands
         # in NodeStatus.daemon_endpoints for the apiserver proxy
@@ -210,6 +209,20 @@ class HollowKubelet:
                 node_name, self.runtime.pods, self.runtime,
                 self._capacity,
                 container_manager=self.container_manager)
+        # registration/heartbeat machinery shared with the real kubelet
+        # process (kubelet/registration.py)
+        from ..kubelet.registration import NodeRegistration
+        self._registration = NodeRegistration(
+            client, node_name, self._capacity,
+            allocatable=lambda: self.container_manager.allocatable(
+                self._capacity()),
+            daemon_port=lambda: (self.server.port
+                                 if self.server is not None else 0),
+            host=(self.server.host if self.server is not None
+                  else "127.0.0.1"),
+            heartbeat_interval=heartbeat_interval,
+            labels=self.labels, kubelet_version="hollow",
+            runtime_version="fake://0")
 
     # -- node object ------------------------------------------------------
 
@@ -218,79 +231,11 @@ class HollowKubelet:
                 "memory": parse_quantity(self.memory),
                 "pods": parse_quantity(str(self.max_pods))}
 
-    def _conditions(self) -> List[api.NodeCondition]:
-        ts = api.now_rfc3339()
-        return [
-            api.NodeCondition(type="Ready", status="True",
-                              reason="KubeletReady",
-                              last_heartbeat_time=ts),
-            api.NodeCondition(type="OutOfDisk", status="False",
-                              reason="KubeletHasSufficientDisk",
-                              last_heartbeat_time=ts),
-        ]
-
-    def _endpoints(self) -> api.NodeDaemonEndpoints:
-        port = self.server.port if self.server is not None else 0
-        return api.NodeDaemonEndpoints(
-            kubelet_endpoint=api.DaemonEndpoint(port=port))
-
-    def _addresses(self) -> List[api.NodeAddress]:
-        if self.server is None:
-            return []
-        return [api.NodeAddress(type="InternalIP",
-                                address=self.server.host)]
-
-    def _node_object(self) -> api.Node:
-        return api.Node(
-            metadata=api.ObjectMeta(name=self.node_name, labels=self.labels),
-            status=api.NodeStatus(
-                capacity=self._capacity(),
-                allocatable=self.container_manager.allocatable(
-                    self._capacity()),
-                conditions=self._conditions(),
-                addresses=self._addresses(),
-                daemon_endpoints=self._endpoints(),
-                node_info=api.NodeSystemInfo(
-                    kubelet_version="hollow",
-                    container_runtime_version="fake://0")))
-
     def register(self) -> None:
-        try:
-            self.client.create("nodes", self._node_object())
-        except Exception:
-            # already registered from a prior life (or transient failure —
-            # the heartbeat loop re-registers on NotFound): refresh status
-            self._heartbeat_once()
+        self._registration.register()
 
     def _heartbeat_once(self) -> None:
-        try:
-            node = self.client.get("nodes", self.node_name)
-            # stored objects are frozen: build a new status, never mutate
-            # the store/cache-resident one in place (core/store.py contract)
-            updated = replace(node, status=replace(
-                node.status, capacity=self._capacity(),
-                allocatable=self.container_manager.allocatable(
-                    self._capacity()),
-                conditions=self._conditions(),
-                addresses=self._addresses(),
-                daemon_endpoints=self._endpoints()))
-            self.client.update_status("nodes", updated)
-        except NotFound:
-            # node object deleted (e.g. by a node controller) or initial
-            # create never landed: re-register, like the real kubelet
-            try:
-                self.client.create("nodes", self._node_object())
-            except Exception:
-                pass
-        except Exception:
-            pass  # apiserver hiccup: next tick retries (crash-only)
-
-    def _heartbeat_loop(self) -> None:
-        while not self._stop.is_set():
-            self._stop.wait(self.heartbeat_interval)
-            if self._stop.is_set():
-                return
-            self._heartbeat_once()
+        self._registration.heartbeat_once()
 
     # -- pod sync ---------------------------------------------------------
 
@@ -321,21 +266,18 @@ class HollowKubelet:
     def run(self) -> "HollowKubelet":
         if self.server is not None:
             self.server.start()
-        self.register()
         self.status_manager.start()
         self._informer = Informer(
             self.client, "pods",
             field_selector=f"spec.nodeName={self.node_name}",
             on_add=self._on_pod_add, on_update=self._on_pod_update,
             on_delete=self._on_pod_delete).start()
-        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
-                                           daemon=True,
-                                           name=f"hb-{self.node_name}")
-        self._hb_thread.start()
+        self._registration.run()  # register + heartbeat loop
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self._registration.stop()
         if self._informer:
             self._informer.stop()
         self.status_manager.stop()

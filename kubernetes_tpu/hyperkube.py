@@ -19,6 +19,7 @@ cleanly, and exits 0.
 
 from __future__ import annotations
 
+import os
 import argparse
 import json
 import signal
@@ -253,6 +254,76 @@ def run_controller_manager(argv: List[str]) -> int:
         [manager.stop, _start_healthz("controller-manager")])
 
 
+def run_kubelet(argv: List[str]) -> int:
+    """The REAL kubelet process: subprocess runtime (pods as process
+    groups), volumes, image manager, kubelet HTTP server, node
+    registration + heartbeats, lifecycle events, cluster-DNS resolver
+    config (ref: cmd/kubelet/app/server.go RunKubelet)."""
+    p = argparse.ArgumentParser(prog="kubelet")
+    p.add_argument("--master", required=True)
+    p.add_argument("--name", required=True)
+    p.add_argument("--root-dir", default="")
+    p.add_argument("--port", type=int, default=0,
+                   help="kubelet server port (0 = ephemeral)")
+    p.add_argument("--cpu", default="4")
+    p.add_argument("--memory", default="8Gi")
+    p.add_argument("--max-pods", type=int, default=110)
+    p.add_argument("--manifest-path", default="")
+    p.add_argument("--manifest-url", default="")
+    p.add_argument("--cluster-dns", default="")
+    p.add_argument("--cluster-domain", default="")
+    p.add_argument("--resolv-conf", default="/etc/resolv.conf")
+    p.add_argument("--heartbeat-interval", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    from .api.client import HttpClient
+    from .api.record import ClientEventSink, EventBroadcaster
+    from .core import types as api
+    from .core.quantity import parse_quantity
+    from .kubelet import Kubelet
+    from .kubelet.images import ImageManager
+    from .kubelet.registration import NodeRegistration
+    from .kubelet.server import KubeletServer
+    from .kubelet.subprocess_runtime import SubprocessRuntime
+    from .volume.plugins import VolumeHost, new_default_plugin_mgr
+
+    _wait_for_master(args.master)
+    client = HttpClient(args.master)
+    broadcaster = EventBroadcaster().start_recording_to_sink(
+        ClientEventSink(client))
+    recorder = broadcaster.new_recorder(api.EventSource(
+        component="kubelet", host=args.name))
+    runtime = SubprocessRuntime(args.root_dir or None)
+    volume_root = os.path.join(runtime.root_dir, "volumes")
+
+    def capacity():
+        return {"cpu": parse_quantity(args.cpu),
+                "memory": parse_quantity(args.memory),
+                "pods": parse_quantity(str(args.max_pods))}
+
+    kubelet = Kubelet(
+        client, args.name, runtime=runtime,
+        volume_mgr=new_default_plugin_mgr(
+            VolumeHost(volume_root, client=client)),
+        image_manager=ImageManager(recorder=recorder),
+        manifest_path=args.manifest_path or None,
+        manifest_url=args.manifest_url or None,
+        cluster_dns=args.cluster_dns or None,
+        cluster_domain=args.cluster_domain,
+        resolver_config=args.resolv_conf,
+        recorder=recorder)
+    server = KubeletServer(args.name, kubelet.get_pods, runtime,
+                           capacity, port=args.port).start()
+    registration = NodeRegistration(
+        client, args.name, capacity,
+        daemon_port=lambda: server.port, host=server.host,
+        heartbeat_interval=args.heartbeat_interval).run()
+    kubelet.run()
+    return _serve_until_signal(
+        f"kubelet ready {args.name} port={server.port}",
+        [kubelet.stop, registration.stop, server.stop])
+
+
 def run_hollow_node(argv: List[str]) -> int:
     """(ref: cmd/kubemark/hollow-node.go:80 --morph=kubelet)"""
     p = argparse.ArgumentParser(prog="hollow-node")
@@ -404,6 +475,7 @@ COMPONENTS = {
     "kube-scheduler": run_scheduler,
     "controller-manager": run_controller_manager,
     "kube-controller-manager": run_controller_manager,
+    "kubelet": run_kubelet,
     "hollow-node": run_hollow_node,
     "hollow-fleet": run_hollow_fleet,
     "proxy": run_proxy,

@@ -114,7 +114,8 @@ class Kubelet:
                  master_service_namespace: str = "default",
                  cluster_dns: Optional[str] = None,
                  cluster_domain: str = "",
-                 resolver_config: str = "/etc/resolv.conf"):
+                 resolver_config: str = "/etc/resolv.conf",
+                 recorder=None):
         """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
         before containers start and torn down on deletion (kubelet.go
         syncPod mountExternalVolumes). image_manager: pull-policy
@@ -155,6 +156,10 @@ class Kubelet:
         self.cluster_domain = cluster_domain
         self.resolver_config = resolver_config
         self._resolv_cache = None  # (mtime, nameservers, searches)
+        # container lifecycle events (the reference records Started/
+        # Failed/Killing/BackOff through record.EventRecorder;
+        # dockertools manager.go + kubelet.go syncPod)
+        self.recorder = recorder
         self.max_restart_backoff = max_restart_backoff
         from .container_gc import ContainerGC
         self._container_gc = (ContainerGC(self.runtime)
@@ -275,8 +280,22 @@ class Kubelet:
                     pod, self._container_with_env(pod, container))
                 self._backoff.pop(key, None)
                 self._backoff.pop(f"{key}#d", None)  # full delay reset
-            except Exception:
+                if self.recorder:
+                    # (dockertools manager.go "Started")
+                    self.recorder.eventf(
+                        pod, "Normal", "Started",
+                        "Started container %s", container.name)
+            except Exception as e:
                 self._note_backoff(key, now)
+                if self.recorder:
+                    reason = "BackOff" if rc is not None else "Failed"
+                    self.recorder.eventf(
+                        pod, "Warning", reason,
+                        "Error starting container %s: %s"
+                        if reason == "Failed"
+                        else "Back-off restarting failed container %s"
+                             " (%s)",
+                        container.name, e)
         self._publish_status(pod)
 
     def _note_backoff(self, key: str, now: float) -> None:
@@ -380,6 +399,12 @@ class Kubelet:
                          message: str) -> None:
         """Liveness failure -> kill; restart policy decides revival
         (prober feeds syncPod in the reference the same way)."""
+        if self.recorder:
+            # (kubelet.go "Killing" + prober "Unhealthy")
+            self.recorder.eventf(pod, "Warning", "Unhealthy",
+                                 "Liveness probe failed: %s", message)
+            self.recorder.eventf(pod, "Normal", "Killing",
+                                 "Killing container %s", container_name)
         self.runtime.kill_container(pod.metadata.uid, container_name)
         current = self._pods.get(pod.metadata.uid)
         if current is not None:

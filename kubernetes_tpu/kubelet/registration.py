@@ -1,0 +1,119 @@
+"""Node registration + status heartbeat for the real kubelet process.
+
+The reference kubelet registers its Node object and then synchronizes
+NodeStatus on a timer (ref: pkg/kubelet/kubelet.go registerWithApiserver
+/ syncNodeStatus, status conditions Ready/OutOfDisk, daemon endpoints,
+node info). The kubemark hollow agent (`agents/hollow_node.py`) carries
+its own copy of this loop tuned for fleet multiplexing; this one serves
+the single real-kubelet process (`hyperkube kubelet`) with injectable
+capacity/port providers.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional
+
+from ..core import types as api
+from ..core.errors import NotFound
+
+
+class NodeRegistration:
+    """Register the Node and keep its status fresh; re-register when
+    the node object disappears (crash-only, like the heartbeat loop of
+    the reference kubelet)."""
+
+    def __init__(self, client, node_name: str,
+                 capacity: Callable[[], Dict],
+                 allocatable: Optional[Callable[[], Dict]] = None,
+                 daemon_port: Callable[[], int] = lambda: 0,
+                 host: str = "127.0.0.1",
+                 heartbeat_interval: float = 10.0,
+                 labels: Optional[Dict[str, str]] = None,
+                 kubelet_version: str = "v1.1.0-tpu",
+                 runtime_version: str = "proc://1"):
+        self.client = client
+        self.node_name = node_name
+        self.capacity = capacity
+        self.allocatable = allocatable or capacity
+        self.daemon_port = daemon_port
+        self.host = host
+        self.heartbeat_interval = heartbeat_interval
+        self.labels = dict(labels or {})
+        self.kubelet_version = kubelet_version
+        self.runtime_version = runtime_version
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _conditions(self) -> List[api.NodeCondition]:
+        ts = api.now_rfc3339()
+        return [
+            api.NodeCondition(type="Ready", status="True",
+                              reason="KubeletReady",
+                              last_heartbeat_time=ts),
+            api.NodeCondition(type="OutOfDisk", status="False",
+                              reason="KubeletHasSufficientDisk",
+                              last_heartbeat_time=ts),
+        ]
+
+    def _status(self) -> api.NodeStatus:
+        # addresses only when a kubelet server actually listens (port
+        # nonzero) — a hollow node without its HTTP surface must not
+        # advertise a dialable address
+        return api.NodeStatus(
+            capacity=self.capacity(),
+            allocatable=self.allocatable(),
+            conditions=self._conditions(),
+            addresses=([api.NodeAddress(type="InternalIP",
+                                        address=self.host)]
+                       if self.daemon_port() else []),
+            daemon_endpoints=api.NodeDaemonEndpoints(
+                kubelet_endpoint=api.DaemonEndpoint(
+                    port=self.daemon_port())),
+            node_info=api.NodeSystemInfo(
+                kubelet_version=self.kubelet_version,
+                container_runtime_version=self.runtime_version))
+
+    def _node_object(self) -> api.Node:
+        return api.Node(
+            metadata=api.ObjectMeta(name=self.node_name,
+                                    labels=self.labels),
+            status=self._status())
+
+    def register(self) -> None:
+        try:
+            self.client.create("nodes", self._node_object())
+        except Exception:
+            self.heartbeat_once()  # already registered: refresh status
+
+    def heartbeat_once(self) -> None:
+        try:
+            node = self.client.get("nodes", self.node_name)
+            self.client.update_status(
+                "nodes", replace(node, status=self._status()))
+        except NotFound:
+            try:
+                self.client.create("nodes", self._node_object())
+            except Exception:
+                pass
+        except Exception:
+            pass  # apiserver hiccup: next tick retries
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.heartbeat_interval)
+            if self._stop.is_set():
+                return
+            self.heartbeat_once()
+
+    def run(self) -> "NodeRegistration":
+        self.register()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"node-status-{self.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -240,3 +240,72 @@ def test_migrate_storage_component():
         assert after.spec.containers[0].image == "img"
     finally:
         terminate(api_proc)
+
+
+def test_real_kubelet_process_runs_pod_and_records_events():
+    """The `hyperkube kubelet` entry: a real kubelet process (subprocess
+    runtime) registers its Node, runs a bound pod's container as an OS
+    process, publishes Running, serves its HTTP surface, and records
+    lifecycle events (ref: cmd/kubelet/app/server.go RunKubelet)."""
+    import json as _json
+    import urllib.request
+
+    apiserver = spawn("apiserver", "--port", "0")
+    kubelet = None
+    try:
+        url = wait_ready(apiserver).split()[-1]
+        client = HttpClient(url)
+        client.create("namespaces",
+                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        kubelet = spawn("kubelet", "--master", url, "--name", "real-1",
+                        "--cluster-dns", "10.0.0.10",
+                        "--cluster-domain", "cluster.local")
+        ready = wait_ready(kubelet)
+        port = int(ready.split("port=")[-1])
+        node = client.get("nodes", "real-1")
+        assert node.status.daemon_endpoints.kubelet_endpoint.port == port
+        assert any(c.type == "Ready" and c.status == "True"
+                   for c in node.status.conditions)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="real-pod", namespace="default"),
+            spec=api.PodSpec(
+                node_name="real-1", restart_policy="Never",
+                containers=[api.Container(
+                    name="c", image="img",
+                    command=["/bin/sh", "-c", "echo ran; sleep 30"])]),
+            status=api.PodStatus(phase="Pending"))
+        client.create("pods", pod, "default")
+        deadline = time.time() + 60
+        phase = ""
+        while time.time() < deadline and phase != "Running":
+            phase = client.get("pods", "real-pod", "default").status.phase
+            time.sleep(0.2)
+        assert phase == "Running"
+        # the kubelet HTTP surface serves the bound pod
+        pods = _json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/pods", timeout=10))
+        assert any(p["metadata"]["name"] == "real-pod"
+                   for p in pods["items"])
+        # a Started lifecycle event reached the apiserver
+        deadline = time.time() + 30
+        reasons = set()
+        while time.time() < deadline and "Started" not in reasons:
+            events, _ = client.list("events", "default")
+            reasons = {e.reason for e in events}
+            time.sleep(0.2)
+        assert "Started" in reasons, reasons
+        # delete the pod so the kubelet kills its process group — the
+        # sleep must not outlive the test as an orphan
+        client.delete("pods", "real-pod", "default")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pods = _json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/runningpods", timeout=10))
+            if not pods.get("items"):
+                break
+            time.sleep(0.2)
+        assert not pods.get("items"), pods
+    finally:
+        if kubelet is not None:
+            assert terminate(kubelet) == 0
+        assert terminate(apiserver) == 0

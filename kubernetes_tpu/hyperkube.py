@@ -274,6 +274,17 @@ def run_kubelet(argv: List[str]) -> int:
     p.add_argument("--cluster-domain", default="")
     p.add_argument("--resolv-conf", default="/etc/resolv.conf")
     p.add_argument("--heartbeat-interval", type=float, default=10.0)
+    p.add_argument("--network-plugin", default="",
+                   help="network plugin name; empty = host-address "
+                        "(process pods share the host netns, so the "
+                        "node's own address is theirs)")
+    p.add_argument("--node-ip", default="127.0.0.1",
+                   help="this node's reachable address — the pod IP "
+                        "the default network plugin reports")
+    p.add_argument("--network-plugin-dir",
+                   default="/usr/libexec/kubernetes/kubelet-plugins"
+                           "/net/exec/",
+                   help="exec plugin directory (exec.go contract)")
     args = p.parse_args(argv)
 
     from .api.client import HttpClient
@@ -282,6 +293,7 @@ def run_kubelet(argv: List[str]) -> int:
     from .core.quantity import parse_quantity
     from .kubelet import Kubelet
     from .kubelet.images import ImageManager
+    from .kubelet.network import ExecNetworkPlugin, HostNetworkPlugin
     from .kubelet.registration import NodeRegistration
     from .kubelet.server import KubeletServer
     from .kubelet.subprocess_runtime import SubprocessRuntime
@@ -311,7 +323,11 @@ def run_kubelet(argv: List[str]) -> int:
         cluster_dns=args.cluster_dns or None,
         cluster_domain=args.cluster_domain,
         resolver_config=args.resolv_conf,
-        recorder=recorder)
+        recorder=recorder,
+        network_plugin=(ExecNetworkPlugin(args.network_plugin_dir,
+                                          args.network_plugin)
+                        if args.network_plugin
+                        else HostNetworkPlugin(args.node_ip)))
     server = KubeletServer(args.name, kubelet.get_pods, runtime,
                            capacity, port=args.port).start()
     registration = NodeRegistration(

@@ -115,7 +115,7 @@ class Kubelet:
                  cluster_dns: Optional[str] = None,
                  cluster_domain: str = "",
                  resolver_config: str = "/etc/resolv.conf",
-                 recorder=None):
+                 recorder=None, network_plugin=None):
         """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
         before containers start and torn down on deletion (kubelet.go
         syncPod mountExternalVolumes). image_manager: pull-policy
@@ -160,6 +160,19 @@ class Kubelet:
         # Failed/Killing/BackOff through record.EventRecorder;
         # dockertools manager.go + kubelet.go syncPod)
         self.recorder = recorder
+        # pod network setup/teardown/status (pkg/kubelet/network;
+        # kubelet/network.py). None keeps legacy behavior (no setup,
+        # placeholder pod IP).
+        self.network_plugin = network_plugin
+        if network_plugin is not None:
+            # fail fast: a misconfigured plugin must abort kubelet
+            # construction (the reference aborts plugin selection on an
+            # init error), not yield a node that can never start a pod
+            network_plugin.init()
+        # uid -> (namespace, name) with network set up; kept on failed
+        # teardown so housekeeping retries (like _mounted for volumes)
+        self._networked: Dict[str, "tuple[str, str]"] = {}
+        self._pod_ips: Dict[str, str] = {}  # uid -> plugin-reported IP
         self.max_restart_backoff = max_restart_backoff
         from .container_gc import ContainerGC
         self._container_gc = (ContainerGC(self.runtime)
@@ -215,6 +228,19 @@ class Kubelet:
         if worker:
             worker.stop()
         self.prober_manager.remove_pod(uid)
+        if self.network_plugin is not None and uid in self._networked:
+            # teardown before the pod is killed (exec.go: teardown
+            # before the infra container dies); a failed teardown stays
+            # tracked so housekeeping retries (like _mounted)
+            try:
+                self.network_plugin.tear_down_pod(
+                    pod.metadata.namespace, pod.metadata.name, uid)
+            except Exception:
+                logging.exception("network teardown %s", uid)
+            else:
+                with self._lock:
+                    self._networked.pop(uid, None)
+                    self._pod_ips.pop(uid, None)
         self.runtime.kill_pod(uid)
         if self.volume_mgr is not None and uid in self._mounted:
             try:
@@ -235,23 +261,32 @@ class Kubelet:
         by_name = {c.name: c for c in runtime_pod.containers} \
             if runtime_pod else {}
         now = time.time()
-        if self.volume_mgr is not None:
-            # volumes mount before any container starts, EVERY sync —
-            # set_up is idempotent and a spec update may declare new
-            # volumes (kubelet.go syncPod mountExternalVolumes); failure
-            # holds the whole pod in backoff, not just one container
-            key = f"{uid}/#volumes"
+        def _gated_setup(kind: str, setup) -> bool:
+            """Pod-wide setup step before any container start: failure
+            holds the WHOLE pod in backoff (kubelet.go syncPod
+            mountExternalVolumes / the infra-container network hook).
+            Returns False when the sync must stop here."""
+            key = f"{uid}/#{kind}"
             if self._backoff.get(key, 0) > now:
-                return
+                return False
             try:
-                self.volume_mgr.set_up_pod_volumes(pod)
-                with self._lock:
-                    self._mounted.add(uid)
+                setup()
                 self._backoff.pop(key, None)
                 self._backoff.pop(f"{key}#d", None)
+                return True
             except Exception:
                 self._note_backoff(key, now)
                 self._publish_status(pod)
+                return False
+
+        if self.volume_mgr is not None:
+            # EVERY sync — set_up is idempotent and a spec update may
+            # declare new volumes
+            def _volumes():
+                self.volume_mgr.set_up_pod_volumes(pod)
+                with self._lock:
+                    self._mounted.add(uid)
+            if not _gated_setup("volumes", _volumes):
                 return
         if hasattr(self.runtime, "set_pod_dns"):
             # materialize the pod's resolver config before any container
@@ -261,6 +296,17 @@ class Kubelet:
                 self.runtime.set_pod_dns(uid, ns, search)
             except Exception:
                 logging.exception("set_pod_dns %s", uid)
+        if self.network_plugin is not None and uid not in self._networked:
+            # network setup precedes every container (exec.go: setup
+            # after infra create, before other containers)
+            def _network():
+                self.network_plugin.set_up_pod(
+                    pod.metadata.namespace, pod.metadata.name, uid)
+                with self._lock:
+                    self._networked[uid] = (pod.metadata.namespace,
+                                            pod.metadata.name)
+            if not _gated_setup("network", _network):
+                return
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
             if rc is not None and rc.state == ContainerState.RUNNING:
@@ -460,10 +506,32 @@ class Kubelet:
             conditions=[api.PodCondition(
                 type="Ready", status="True" if all_ready else "False")],
             host_ip="10.0.0.1",
-            pod_ip=pod.status.pod_ip or "10.244.0.2",
+            pod_ip=self._pod_ip(pod),
             start_time=start_time,
             container_statuses=statuses)
         self.status_manager.set_pod_status(pod, status)
+
+    def _pod_ip(self, pod: api.Pod) -> str:
+        """The plugin-reported IP overrides what the runtime/apiserver
+        carries (plugins.go:63-66 PodNetworkStatus note); cached per
+        pod — the reference polls Status at intervals, not per
+        publish."""
+        uid = pod.metadata.uid
+        if self.network_plugin is not None and uid in self._networked:
+            with self._lock:
+                cached = self._pod_ips.get(uid)
+            if cached:
+                return cached
+            try:
+                ip = self.network_plugin.status(
+                    pod.metadata.namespace, pod.metadata.name, uid)
+            except Exception:
+                ip = None
+            if ip:
+                with self._lock:
+                    self._pod_ips[uid] = ip
+                return ip
+        return pod.status.pod_ip or "10.244.0.2"
 
     @staticmethod
     def _pod_phase(pod: api.Pod, total: int, running: int, succeeded: int,
@@ -549,6 +617,18 @@ class Kubelet:
                     continue  # stays tracked: next pass retries
                 with self._lock:
                     self._mounted.discard(uid)
+        if self.network_plugin is not None:
+            with self._lock:
+                net_orphaned = {u: nn for u, nn in self._networked.items()
+                                if u not in known}
+            for uid, (ns, name) in net_orphaned.items():
+                try:
+                    self.network_plugin.tear_down_pod(ns, name, uid)
+                except Exception:
+                    continue  # stays tracked: next pass retries
+                with self._lock:
+                    self._networked.pop(uid, None)
+                    self._pod_ips.pop(uid, None)
 
     # -------------------------------------------------------- lifecycle
 

@@ -1,0 +1,116 @@
+"""Kubelet network plugins.
+
+The reference kubelet delegates pod network setup/teardown/status to a
+named plugin (ref: pkg/kubelet/network/plugins.go NetworkPlugin —
+Init/SetUpPod/TearDownPod/Status, PodNetworkStatus carrying the pod IP
+that overrides what the runtime reports) with an executable-script
+implementation (ref: pkg/kubelet/network/exec/exec.go: run
+``<dir>/<name>/<name> init|setup|teardown|status`` with
+``<pod_namespace> <pod_name> <container_id>``; status prints a
+PodNetworkStatus JSON; vendored names escape ``/`` as ``~``).
+
+Here the same seam carries two implementations: the exec plugin with
+the reference's exact argv/JSON contract, and a loopback plugin — the
+truthful default for subprocess pods, which share the host network
+namespace and are reachable on 127.0.0.1 (so portforward, the
+apiserver pod proxy, and downward-API status.podIP all work against
+real addresses).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import Optional
+
+
+class NetworkPlugin:
+    """(plugins.go:44 NetworkPlugin)"""
+
+    name = ""
+
+    def init(self) -> None:
+        pass
+
+    def set_up_pod(self, namespace: str, name: str, pod_id: str) -> None:
+        raise NotImplementedError
+
+    def tear_down_pod(self, namespace: str, name: str,
+                      pod_id: str) -> None:
+        raise NotImplementedError
+
+    def status(self, namespace: str, name: str,
+               pod_id: str) -> Optional[str]:
+        """The pod's primary IP, or None to defer to the runtime
+        (exec.go status contract)."""
+        raise NotImplementedError
+
+
+class HostNetworkPlugin(NetworkPlugin):
+    """Process pods live in the host network namespace, so their
+    reachable address IS the node's own (the plugins.go no-op default
+    with a truthful Status — unlike a placeholder, this address works
+    from other nodes too: endpoints/DNS/proxy built from it route to
+    the host the processes actually listen on)."""
+
+    name = "host"
+
+    def __init__(self, node_ip: str = "127.0.0.1"):
+        self.node_ip = node_ip
+
+    def set_up_pod(self, namespace, name, pod_id):
+        pass
+
+    def tear_down_pod(self, namespace, name, pod_id):
+        pass
+
+    def status(self, namespace, name, pod_id):
+        return self.node_ip
+
+
+class ExecNetworkPlugin(NetworkPlugin):
+    """Shell out to the operator's plugin executable (exec.go:105-170).
+
+    plugin_name may be vendored ("mycompany/mysdn" →
+    ``mycompany~mysdn/mysdn``)."""
+
+    def __init__(self, plugin_dir: str, plugin_name: str,
+                 timeout: float = 30.0):
+        self.name = plugin_name
+        escaped = plugin_name.replace("/", "~")
+        base = plugin_name.rsplit("/", 1)[-1]
+        self.exec_path = os.path.join(plugin_dir, escaped, base)
+        self.timeout = timeout
+
+    def _run(self, *args: str) -> str:
+        out = subprocess.run(
+            [self.exec_path, *args], capture_output=True, text=True,
+            timeout=self.timeout)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"network plugin {self.name!r} {args[0]}: "
+                f"rc={out.returncode} {out.stdout}{out.stderr}".strip())
+        return out.stdout
+
+    def init(self) -> None:
+        self._run("init")
+
+    def set_up_pod(self, namespace, name, pod_id):
+        self._run("setup", namespace, name, pod_id)
+
+    def tear_down_pod(self, namespace, name, pod_id):
+        self._run("teardown", namespace, name, pod_id)
+
+    def status(self, namespace, name, pod_id):
+        out = self._run("status", namespace, name, pod_id).strip()
+        if not out:
+            return None  # defer to the runtime (exec.go:152-156)
+        doc = json.loads(out)
+        kind = doc.get("kind", "")
+        if kind and kind != "PodNetworkStatus":
+            raise ValueError(
+                f"invalid kind {kind!r} in network status for pod "
+                f"{name!r} (want PodNetworkStatus)")
+        ip = doc.get("ip", "")
+        return ip or None

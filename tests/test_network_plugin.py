@@ -1,0 +1,209 @@
+"""Kubelet network plugins (ref: pkg/kubelet/network/plugins.go +
+exec/exec.go: the <dir>/<name>/<name> init|setup|teardown|status
+executable contract, PodNetworkStatus IP overriding the runtime)."""
+
+import json
+import os
+import stat
+import time
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.network import (ExecNetworkPlugin,
+                                            HostNetworkPlugin)
+
+
+def wait_until(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def write_plugin(tmp_path, name="mysdn", ip="10.9.8.7", fail_setup=False):
+    """A real executable plugin script recording its invocations."""
+    plugin_dir = tmp_path / name
+    plugin_dir.mkdir()
+    log = tmp_path / "calls.log"
+    script = plugin_dir / name
+    script.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+if [ "$1" = "setup" ] && [ "{fail_setup}" = "True" ]; then
+  echo boom >&2; exit 1
+fi
+if [ "$1" = "status" ]; then
+  echo '{{"kind": "PodNetworkStatus", "ip": "{ip}"}}'
+fi
+exit 0
+""")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(tmp_path), log
+
+
+class TestExecPlugin:
+    def test_argv_contract_and_status_ip(self, tmp_path):
+        plugin_dir, log = write_plugin(tmp_path)
+        p = ExecNetworkPlugin(plugin_dir, "mysdn")
+        p.init()
+        p.set_up_pod("ns1", "pod1", "uid-1")
+        assert p.status("ns1", "pod1", "uid-1") == "10.9.8.7"
+        p.tear_down_pod("ns1", "pod1", "uid-1")
+        calls = log.read_text().splitlines()
+        assert calls == ["init", "setup ns1 pod1 uid-1",
+                         "status ns1 pod1 uid-1",
+                         "teardown ns1 pod1 uid-1"]
+
+    def test_vendored_name_escaping(self, tmp_path):
+        # mycompany/mysdn -> mycompany~mysdn/mysdn (exec.go vendoring)
+        vdir = tmp_path / "mycompany~mysdn"
+        vdir.mkdir()
+        script = vdir / "mysdn"
+        script.write_text("#!/bin/sh\nexit 0\n")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        p = ExecNetworkPlugin(str(tmp_path), "mycompany/mysdn")
+        p.set_up_pod("ns", "p", "u")  # resolves and runs
+
+    def test_nonzero_exit_raises(self, tmp_path):
+        plugin_dir, _ = write_plugin(tmp_path, fail_setup=True)
+        p = ExecNetworkPlugin(plugin_dir, "mysdn")
+        try:
+            p.set_up_pod("ns", "p", "u")
+        except RuntimeError as e:
+            assert "boom" in str(e)
+        else:
+            raise AssertionError("expected RuntimeError")
+
+    def test_bad_kind_rejected(self, tmp_path):
+        plugin_dir = tmp_path / "badkind"
+        plugin_dir.mkdir()
+        script = plugin_dir / "badkind"
+        script.write_text(
+            '#!/bin/sh\necho \'{"kind": "Wrong", "ip": "1.2.3.4"}\'\n')
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        p = ExecNetworkPlugin(str(tmp_path), "badkind")
+        try:
+            p.status("ns", "p", "u")
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("expected ValueError")
+
+    def test_empty_status_defers_to_runtime(self, tmp_path):
+        plugin_dir = tmp_path / "quiet"
+        plugin_dir.mkdir()
+        script = plugin_dir / "quiet"
+        script.write_text("#!/bin/sh\nexit 0\n")
+        script.chmod(script.stat().st_mode | stat.S_IEXEC)
+        p = ExecNetworkPlugin(str(tmp_path), "quiet")
+        assert p.status("ns", "p", "u") is None
+
+
+class TestKubeletIntegration:
+    def _pod(self, uid="u-net"):
+        return api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default",
+                                    uid=uid),
+            spec=api.PodSpec(node_name="n1", containers=[
+                api.Container(name="c", image="i")]),
+            status=api.PodStatus(phase="Pending"))
+
+    def test_plugin_ip_lands_in_pod_status(self, tmp_path):
+        plugin_dir, log = write_plugin(tmp_path, ip="10.77.0.5")
+        registry = Registry()
+        client = InProcClient(registry)
+        kubelet = Kubelet(client, "n1", runtime=FakeRuntime(),
+                          network_plugin=ExecNetworkPlugin(plugin_dir,
+                                                           "mysdn")).run()
+        try:
+            client.create("pods", self._pod())
+            assert wait_until(lambda: client.get(
+                "pods", "p", "default").status.pod_ip == "10.77.0.5")
+            client.delete("pods", "p", "default")
+            assert wait_until(lambda: any(
+                l.startswith("teardown") for l in
+                log.read_text().splitlines()))
+        finally:
+            kubelet.stop()
+
+    def test_setup_failure_holds_pod_pending(self, tmp_path):
+        plugin_dir, _ = write_plugin(tmp_path, fail_setup=True)
+        registry = Registry()
+        client = InProcClient(registry)
+        runtime = FakeRuntime()
+        kubelet = Kubelet(client, "n1", runtime=runtime,
+                          network_plugin=ExecNetworkPlugin(plugin_dir,
+                                                           "mysdn")).run()
+        try:
+            client.create("pods", self._pod(uid="u-fail"))
+            time.sleep(0.5)
+            # no container may start before the network is up
+            assert runtime.get_pods() == []
+            assert client.get("pods", "p",
+                              "default").status.phase == "Pending"
+        finally:
+            kubelet.stop()
+
+    def test_host_default_reports_node_address(self):
+        # process pods share the host netns: their reachable address is
+        # the node's own, which works from OTHER nodes too (unlike a
+        # placeholder or loopback)
+        registry = Registry()
+        client = InProcClient(registry)
+        kubelet = Kubelet(client, "n1", runtime=FakeRuntime(),
+                          network_plugin=HostNetworkPlugin(
+                              "192.0.2.7")).run()
+        try:
+            client.create("pods", self._pod(uid="u-host"))
+            assert wait_until(lambda: client.get(
+                "pods", "p", "default").status.pod_ip == "192.0.2.7")
+        finally:
+            kubelet.stop()
+
+    def test_misconfigured_plugin_fails_kubelet_construction(self,
+                                                             tmp_path):
+        # the reference aborts plugin selection on init error; a node
+        # that runs but can never start pods is worse than a crash
+        import pytest
+        with pytest.raises(Exception):
+            Kubelet(InProcClient(Registry()), "n1",
+                    runtime=FakeRuntime(),
+                    network_plugin=ExecNetworkPlugin(
+                        str(tmp_path), "no-such-plugin"))
+
+    def test_failed_teardown_retried_by_housekeeping(self, tmp_path):
+        # teardown failure keeps the pod tracked; the housekeeping
+        # sweep retries until the plugin succeeds (the _mounted
+        # pattern, kubelet.go cleanupOrphanedPodDirs)
+        plugin_dir, log = write_plugin(tmp_path)
+        registry = Registry()
+        client = InProcClient(registry)
+        plugin = ExecNetworkPlugin(plugin_dir, "mysdn")
+        fails = {"n": 1}
+        real = plugin.tear_down_pod
+
+        def flaky(ns, name, uid):
+            if fails["n"]:
+                fails["n"] -= 1
+                raise RuntimeError("ipam down")
+            real(ns, name, uid)
+
+        plugin.tear_down_pod = flaky
+        kubelet = Kubelet(client, "n1", runtime=FakeRuntime(),
+                          network_plugin=plugin).run()
+        try:
+            client.create("pods", self._pod(uid="u-flaky"))
+            assert wait_until(
+                lambda: "u-flaky" in kubelet._networked)
+            client.delete("pods", "p", "default")
+            # first teardown failed; the uid stays tracked
+            assert wait_until(lambda: fails["n"] == 0)
+            kubelet._housekeeping()
+            assert "u-flaky" not in kubelet._networked
+            assert any(l.startswith("teardown") for l in
+                       log.read_text().splitlines())
+        finally:
+            kubelet.stop()

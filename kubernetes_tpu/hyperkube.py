@@ -285,6 +285,10 @@ def run_kubelet(argv: List[str]) -> int:
                    default="/usr/libexec/kubernetes/kubelet-plugins"
                            "/net/exec/",
                    help="exec plugin directory (exec.go contract)")
+    p.add_argument("--shaper-interface", default="",
+                   help="enable tc bandwidth shaping on this interface "
+                        "(kubernetes.io/{in,e}gress-bandwidth pod "
+                        "annotations; needs tc + NET_ADMIN)")
     args = p.parse_args(argv)
 
     from .api.client import HttpClient
@@ -292,6 +296,7 @@ def run_kubelet(argv: List[str]) -> int:
     from .core import types as api
     from .core.quantity import parse_quantity
     from .kubelet import Kubelet
+    from .kubelet.bandwidth import TCShaper
     from .kubelet.images import ImageManager
     from .kubelet.network import ExecNetworkPlugin, HostNetworkPlugin
     from .kubelet.registration import NodeRegistration
@@ -327,7 +332,9 @@ def run_kubelet(argv: List[str]) -> int:
         network_plugin=(ExecNetworkPlugin(args.network_plugin_dir,
                                           args.network_plugin)
                         if args.network_plugin
-                        else HostNetworkPlugin(args.node_ip)))
+                        else HostNetworkPlugin(args.node_ip)),
+        shaper=(TCShaper(args.shaper_interface)
+                if args.shaper_interface else None))
     server = KubeletServer(args.name, kubelet.get_pods, runtime,
                            capacity, port=args.port).start()
     registration = NodeRegistration(

@@ -1,0 +1,251 @@
+"""Pod bandwidth shaping.
+
+The reference kubelet reads the ``kubernetes.io/ingress-bandwidth`` /
+``kubernetes.io/egress-bandwidth`` pod annotations and programs an HTB
+queueing discipline through the ``tc`` tool (ref:
+pkg/util/bandwidth/linux.go tcShaper — per-CIDR u32 filters into
+per-rate classes under the ``1:`` root; pkg/kubelet/kubelet.go:3287
+validateBandwidthIsReasonable, :3297 extractBandwidthResources,
+:1730 syncNetworkStatus reconcile + :1826 cleanupBandwidthLimits).
+
+The tc implementation here speaks the same command surface through an
+injectable runner (the reference injects exec.Interface and tests
+against canned outputs, linux_test.go) — a real ``tc`` binary works
+unchanged; tests use a fake runner. A recording FakeShaper plays the
+fake_shaper.go role for kubelet-level tests.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import subprocess
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core import types as api
+from ..core.quantity import Quantity, parse_quantity
+
+INGRESS_ANNOTATION = "kubernetes.io/ingress-bandwidth"
+EGRESS_ANNOTATION = "kubernetes.io/egress-bandwidth"
+
+_MIN_BPS = 1_000                  # 1kbit (kubelet.go:3285 minRsrc)
+_MAX_BPS = 1_000_000_000_000_000  # 1Pbit (maxRsrc)
+
+
+def _validate(q: Quantity, which: str) -> None:
+    if q.value < _MIN_BPS:
+        raise ValueError(f"{which} bandwidth is unreasonably small "
+                         f"(< 1kbit)")
+    if q.value > _MAX_BPS:
+        raise ValueError(f"{which} bandwidth is unreasonably large "
+                         f"(> 1Pbit)")
+
+
+def extract_pod_bandwidth(pod: api.Pod
+                          ) -> Tuple[Optional[Quantity],
+                                     Optional[Quantity]]:
+    """(ingress, egress) from the pod's annotations, validated
+    (kubelet.go:3297 extractBandwidthResources)."""
+    ingress = egress = None
+    raw = pod.metadata.annotations.get(INGRESS_ANNOTATION)
+    if raw:
+        ingress = parse_quantity(raw)
+        _validate(ingress, "ingress")
+    raw = pod.metadata.annotations.get(EGRESS_ANNOTATION)
+    if raw:
+        egress = parse_quantity(raw)
+        _validate(egress, "egress")
+    return ingress, egress
+
+
+class Shaper:
+    """(interfaces.go BandwidthShaper)"""
+
+    def reconcile_interface(self) -> None:
+        """Ensure the root queueing discipline exists."""
+        raise NotImplementedError
+
+    def reconcile_cidr(self, cidr: str, egress: Optional[Quantity],
+                       ingress: Optional[Quantity]) -> None:
+        raise NotImplementedError
+
+    def get_cidrs(self) -> List[str]:
+        raise NotImplementedError
+
+    def reset(self, cidr: str) -> None:
+        raise NotImplementedError
+
+
+class FakeShaper(Shaper):
+    """(fake_shaper.go) — records calls, serves canned CIDRs."""
+
+    def __init__(self):
+        self.limits: Dict[str, Tuple[Optional[Quantity],
+                                     Optional[Quantity]]] = {}
+        self.resets: List[str] = []
+
+    def reconcile_interface(self) -> None:
+        pass
+
+    def reconcile_cidr(self, cidr, egress, ingress) -> None:
+        self.limits[cidr] = (egress, ingress)
+
+    def get_cidrs(self) -> List[str]:
+        return sorted(self.limits)
+
+    def reset(self, cidr: str) -> None:
+        self.resets.append(cidr)
+        self.limits.pop(cidr, None)
+
+
+def hex_cidr(cidr: str) -> str:
+    """Text CIDR -> tc's hex match form, masked (linux.go hexCIDR:
+    1.2.3.4/16 -> hex(1.2.0.0)/ffff0000)."""
+    net = ipaddress.ip_network(cidr, strict=False)
+    return (net.network_address.packed.hex()
+            + "/" + net.netmask.packed.hex())
+
+
+def ascii_cidr(hexed: str) -> str:
+    """The opposite (linux.go asciiCIDR)."""
+    ip_part, _, mask_part = hexed.partition("/")
+    ip = ipaddress.ip_address(bytes.fromhex(ip_part))
+    prefix = bin(int(mask_part, 16)).count("1")
+    return f"{ip}/{prefix}"
+
+
+def _default_runner(args: List[str]) -> str:
+    out = subprocess.run(args, capture_output=True, text=True,
+                         timeout=30.0)
+    if out.returncode != 0:
+        raise RuntimeError(f"{' '.join(args)}: rc={out.returncode} "
+                           f"{out.stdout}{out.stderr}".strip())
+    return out.stdout
+
+
+class TCShaper(Shaper):
+    """HTB shaping via tc (linux.go tcShaper). runner executes one
+    command argv and returns stdout, raising on nonzero exit."""
+
+    def __init__(self, iface: str,
+                 runner: Optional[Callable[[List[str]], str]] = None):
+        self.iface = iface
+        self._run = runner or _default_runner
+
+    def reconcile_interface(self) -> None:
+        # (linux.go ReconcileInterface: add the root htb qdisc once)
+        out = self._run(["tc", "qdisc", "show", "dev", self.iface])
+        if "htb 1:" in out:
+            return
+        self._run(["tc", "qdisc", "add", "dev", self.iface, "root",
+                   "handle", "1:", "htb", "default", "30"])
+
+    def _next_class_id(self) -> int:
+        # (linux.go nextClassID: first free 1:N)
+        out = self._run(["tc", "class", "show", "dev", self.iface])
+        used = set()
+        for line in out.splitlines():
+            parts = line.split()
+            # class htb 1:1 root prio 0 rate 1000Kbit ...
+            if len(parts) >= 3 and parts[0] == "class":
+                used.add(parts[2])
+        n = 1
+        while f"1:{n}" in used:
+            n += 1
+        return n
+
+    def _make_class(self, rate_kbit: str) -> int:
+        cls = self._next_class_id()
+        self._run(["tc", "class", "add", "dev", self.iface,
+                   "parent", "1:", "classid", f"1:{cls}",
+                   "htb", "rate", rate_kbit])
+        return cls
+
+    @staticmethod
+    def _kbit(q: Quantity) -> str:
+        return f"{q.value // 1000}kbit"  # (linux.go makeKBitString)
+
+    # u32 match offsets in the IP header: dst at 16, src at 12
+    _OFFSET = {"dst": "16", "src": "12"}
+
+    def _find_cidr_filter(self, cidr: str, direction: str
+                          ) -> Optional[Tuple[str, str]]:
+        """(flowid, filter handle) of the u32 filter matching the CIDR
+        in one direction (linux.go findCIDRClass, made per-direction so
+        a partially-programmed pod can be completed)."""
+        out = self._run(["tc", "filter", "show", "dev", self.iface])
+        spec = f"match {hex_cidr(cidr)} at {self._OFFSET[direction]}"
+        header: List[str] = []
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("filter"):
+                header = line.split()
+                continue
+            if spec in line and header:
+                # filter parent 1: protocol ip pref 1 u32 fh 800::800
+                # order 2048 key ht 800 bkt 0 flowid 1:1
+                fh = header[header.index("fh") + 1] \
+                    if "fh" in header else ""
+                flow = header[header.index("flowid") + 1] \
+                    if "flowid" in header else ""
+                return flow, fh
+        return None
+
+    def _class_rates(self) -> Dict[str, str]:
+        out = self._run(["tc", "class", "show", "dev", self.iface])
+        rates = {}
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 3 and parts[0] == "class" \
+                    and "rate" in parts:
+                rates[parts[2]] = parts[parts.index("rate") + 1]
+        return rates
+
+    def _del_filter(self, flow: str, fh: str) -> None:
+        self._run(["tc", "filter", "del", "dev", self.iface,
+                   "parent", "1:", "proto", "ip", "prio", "1",
+                   "handle", fh, "u32"])
+        self._run(["tc", "class", "del", "dev", self.iface,
+                   "parent", "1:", "classid", flow])
+
+    def reconcile_cidr(self, cidr, egress, ingress) -> None:
+        """Each direction idempotent on its own, and a changed
+        annotation reprograms the class (the reference's ReconcileCIDR
+        early-returns on any existing filter, which strands the second
+        direction after a partial failure and never applies rate
+        edits)."""
+        # ingress = traffic TO the pod (match dst); egress = FROM (src)
+        for want, direction in ((ingress, "dst"), (egress, "src")):
+            if want is None:
+                continue
+            rate = self._kbit(want)
+            existing = self._find_cidr_filter(cidr, direction)
+            if existing is not None:
+                flow, fh = existing
+                # tc displays "1000Kbit" for an input of "1000kbit"
+                current = (self._class_rates().get(flow) or "").lower()
+                if current == rate.lower():
+                    continue  # already programmed at this rate
+                self._del_filter(flow, fh)
+            cls = self._make_class(rate)
+            self._run(["tc", "filter", "add", "dev", self.iface,
+                       "protocol", "ip", "parent", "1:0", "prio", "1",
+                       "u32", "match", "ip", direction, cidr,
+                       "flowid", f"1:{cls}"])
+
+    def get_cidrs(self) -> List[str]:
+        # (linux.go GetCIDRs: every u32 match in the filter table)
+        out = self._run(["tc", "filter", "show", "dev", self.iface])
+        cidrs = []
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("match"):
+                cidrs.append(ascii_cidr(line.split()[1]))
+        return sorted(set(cidrs))
+
+    def reset(self, cidr: str) -> None:
+        # (linux.go Reset: delete the filter(s) and their classes —
+        # both directions)
+        for direction in ("dst", "src"):
+            found = self._find_cidr_filter(cidr, direction)
+            if found is not None:
+                self._del_filter(*found)

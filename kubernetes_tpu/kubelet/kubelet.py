@@ -115,7 +115,7 @@ class Kubelet:
                  cluster_dns: Optional[str] = None,
                  cluster_domain: str = "",
                  resolver_config: str = "/etc/resolv.conf",
-                 recorder=None, network_plugin=None):
+                 recorder=None, network_plugin=None, shaper=None):
         """volume_mgr: a volume.VolumePluginMgr — pod volumes are set up
         before containers start and torn down on deletion (kubelet.go
         syncPod mountExternalVolumes). image_manager: pull-policy
@@ -173,6 +173,15 @@ class Kubelet:
         # teardown so housekeeping retries (like _mounted for volumes)
         self._networked: Dict[str, "tuple[str, str]"] = {}
         self._pod_ips: Dict[str, str] = {}  # uid -> plugin-reported IP
+        # pod bandwidth shaping (kubelet.go:652 shaper; bandwidth.py).
+        # None + annotated pod -> UndefinedShaper event, like the
+        # reference (kubelet.go:1751)
+        self.shaper = shaper
+        if shaper is not None:
+            try:
+                shaper.reconcile_interface()
+            except Exception:
+                logging.exception("shaper interface reconcile")
         self.max_restart_backoff = max_restart_backoff
         from .container_gc import ContainerGC
         self._container_gc = (ContainerGC(self.runtime)
@@ -307,6 +316,7 @@ class Kubelet:
                                             pod.metadata.name)
             if not _gated_setup("network", _network):
                 return
+        self._reconcile_bandwidth(pod)
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
             if rc is not None and rc.state == ContainerState.RUNNING:
@@ -343,6 +353,54 @@ class Kubelet:
                              " (%s)",
                         container.name, e)
         self._publish_status(pod)
+
+    def _reconcile_bandwidth(self, pod: api.Pod) -> None:
+        """Program the pod's bandwidth limits when annotated
+        (kubelet.go:1730 syncNetworkStatus bandwidth leg)."""
+        from .bandwidth import (EGRESS_ANNOTATION, INGRESS_ANNOTATION,
+                                extract_pod_bandwidth)
+        ann = pod.metadata.annotations
+        if (INGRESS_ANNOTATION not in ann
+                and EGRESS_ANNOTATION not in ann):
+            return
+        try:
+            ingress, egress = extract_pod_bandwidth(pod)
+        except ValueError as e:
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Warning", "InvalidBandwidth", "%s", e)
+            return
+        if ingress is None and egress is None:
+            return
+        if pod.spec.host_network or getattr(
+                self.network_plugin, "shared_host_address", False):
+            # shaping keys on the pod's ip/32; a host-netns pod's
+            # address is the NODE's — limiting it would throttle
+            # everything on the node (kubelet.go:1735-1736 applies the
+            # same refusal to hostNetwork pods)
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Warning", "HostNetworkNotSupported",
+                    "Bandwidth shaping is not currently supported on "
+                    "the host network")
+            return
+        if self.shaper is None:
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Warning", "NilShaper",
+                    "Pod requests bandwidth shaping, but the shaper "
+                    "is undefined")
+            return
+        with self._lock:
+            ip = self._pod_ips.get(pod.metadata.uid)
+        ip = ip or pod.status.pod_ip
+        if not ip:
+            return  # no address yet; the next sync retries
+        try:
+            self.shaper.reconcile_cidr(f"{ip}/32", egress, ingress)
+        except Exception:
+            logging.exception("bandwidth reconcile %s",
+                              pod.metadata.uid)
 
     def _note_backoff(self, key: str, now: float) -> None:
         prev = self._backoff.get(f"{key}#d", 0.5)
@@ -629,6 +687,37 @@ class Kubelet:
                 with self._lock:
                     self._networked.pop(uid, None)
                     self._pod_ips.pop(uid, None)
+        if self.shaper is not None:
+            self._cleanup_bandwidth_limits()
+
+    def _cleanup_bandwidth_limits(self) -> None:
+        """Drop shaping for CIDRs no pod owns anymore (kubelet.go:1826
+        cleanupBandwidthLimits)."""
+        from .bandwidth import extract_pod_bandwidth
+        try:
+            current = self.shaper.get_cidrs()
+        except Exception:
+            return
+        possible = set()
+        with self._lock:
+            pods = list(self._pods.values())
+            ips = dict(self._pod_ips)
+        for pod in pods:
+            try:
+                ingress, egress = extract_pod_bandwidth(pod)
+            except ValueError:
+                continue
+            if ingress is None and egress is None:
+                continue
+            ip = ips.get(pod.metadata.uid) or pod.status.pod_ip
+            if ip:
+                possible.add(f"{ip}/32")
+        for cidr in current:
+            if cidr not in possible:
+                try:
+                    self.shaper.reset(cidr)
+                except Exception:
+                    pass  # next pass retries
 
     # -------------------------------------------------------- lifecycle
 

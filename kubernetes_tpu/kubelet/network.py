@@ -55,6 +55,10 @@ class HostNetworkPlugin(NetworkPlugin):
     the host the processes actually listen on)."""
 
     name = "host"
+    # pods do NOT own unique addresses: per-pod address-keyed features
+    # (bandwidth shaping on ip/32) must treat them like host-network
+    # pods or they'd program the node's own address
+    shared_host_address = True
 
     def __init__(self, node_ip: str = "127.0.0.1"):
         self.node_ip = node_ip

@@ -1,0 +1,268 @@
+"""Pod bandwidth shaping (ref: pkg/util/bandwidth linux.go/fake_shaper,
+kubelet.go:1730,1826,3287-3317 — annotation extraction, tc HTB command
+surface against an injected exec, kubelet reconcile + cleanup)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.record import FakeRecorder
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.bandwidth import (FakeShaper, TCShaper,
+                                              ascii_cidr,
+                                              extract_pod_bandwidth,
+                                              hex_cidr)
+
+
+def wait_until(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def mkpod(name="p", uid="u-bw", annotations=None, host_network=False,
+          pod_ip="10.20.30.40"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid,
+                                annotations=annotations or {}),
+        spec=api.PodSpec(node_name="n1", host_network=host_network,
+                         containers=[api.Container(name="c", image="i")]),
+        status=api.PodStatus(phase="Pending", pod_ip=pod_ip))
+
+
+class TestExtraction:
+    def test_both_annotations_parsed(self):
+        pod = mkpod(annotations={
+            "kubernetes.io/ingress-bandwidth": "10M",
+            "kubernetes.io/egress-bandwidth": "1M"})
+        ingress, egress = extract_pod_bandwidth(pod)
+        assert ingress.value == 10_000_000
+        assert egress.value == 1_000_000
+
+    def test_unannotated_pod_is_none_none(self):
+        assert extract_pod_bandwidth(mkpod()) == (None, None)
+
+    def test_bounds_enforced(self):
+        with pytest.raises(ValueError):
+            extract_pod_bandwidth(mkpod(annotations={
+                "kubernetes.io/ingress-bandwidth": "10"}))  # < 1kbit
+        with pytest.raises(ValueError):
+            extract_pod_bandwidth(mkpod(annotations={
+                "kubernetes.io/egress-bandwidth": "10P"}))  # > 1Pbit
+
+
+class TestHexCIDR:
+    def test_round_trip_and_masking(self):
+        # 1.2.3.4/16 masks to 1.2.0.0 (linux.go hexCIDR doc)
+        assert hex_cidr("1.2.3.4/16") == "01020000/ffff0000"
+        assert ascii_cidr("01020000/ffff0000") == "1.2.0.0/16"
+        assert hex_cidr("10.20.30.40/32") == "0a141e28/ffffffff"
+        assert ascii_cidr(hex_cidr("10.20.30.40/32")) == "10.20.30.40/32"
+
+
+class FakeTC:
+    """A stateful tc emulator serving the exact output shapes the
+    shaper parses (the linux_test.go canned-exec pattern, but live)."""
+
+    def __init__(self):
+        self.qdiscs = []
+        self.classes = {}       # classid -> rate
+        self.filters = []       # (fh, flowid, hexmatch)
+        self.calls = []
+        self._fh = 0x800
+
+    def __call__(self, args):
+        self.calls.append(" ".join(args))
+        assert args[0] == "tc"
+        area, verb = args[1], args[2]
+        if (area, verb) == ("qdisc", "show"):
+            return "".join(f"qdisc {q} 1: root refcnt 2\n"
+                           for q in self.qdiscs)
+        if (area, verb) == ("qdisc", "add"):
+            self.qdiscs.append("htb")
+            return ""
+        if (area, verb) == ("class", "show"):
+            return "".join(
+                f"class htb {cid} root prio 0 rate {rate} ceil {rate} "
+                f"burst 1600b cburst 1600b\n"
+                for cid, rate in self.classes.items())
+        if (area, verb) == ("class", "add"):
+            self.classes[args[args.index("classid") + 1]] = \
+                args[args.index("rate") + 1]
+            return ""
+        if (area, verb) == ("class", "del"):
+            self.classes.pop(args[args.index("classid") + 1], None)
+            return ""
+        if (area, verb) == ("filter", "show"):
+            out = []
+            for fh, flow, hexmatch, offset in self.filters:
+                out.append(
+                    f"filter parent 1: protocol ip pref 1 u32 fh {fh} "
+                    f"order 2048 key ht 800 bkt 0 flowid {flow}")
+                out.append(f"  match {hexmatch} at {offset}")
+            return "\n".join(out) + ("\n" if out else "")
+        if (area, verb) == ("filter", "add"):
+            from kubernetes_tpu.kubelet.bandwidth import hex_cidr as hc
+            if "dst" in args:
+                cidr, offset = args[args.index("dst") + 1], 16
+            else:
+                cidr, offset = args[args.index("src") + 1], 12
+            self._fh += 1
+            self.filters.append((f"800::{self._fh:x}",
+                                 args[args.index("flowid") + 1],
+                                 hc(cidr), offset))
+            return ""
+        if (area, verb) == ("filter", "del"):
+            fh = args[args.index("handle") + 1]
+            self.filters = [f for f in self.filters if f[0] != fh]
+            return ""
+        raise AssertionError(f"unexpected tc call: {args}")
+
+
+class TestTCShaper:
+    def test_interface_reconcile_is_once(self):
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_interface()
+        s.reconcile_interface()
+        assert tc.calls.count(
+            "tc qdisc add dev eth0 root handle 1: htb default 30") == 1
+
+    def test_limit_programs_classes_and_filters(self):
+        from kubernetes_tpu.core.quantity import parse_quantity
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_interface()
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("1M"),
+                         parse_quantity("10M"))
+        # ingress (to the pod) matches dst, egress matches src
+        assert any("match ip dst 10.20.30.40/32" in c for c in tc.calls)
+        assert any("match ip src 10.20.30.40/32" in c for c in tc.calls)
+        assert sorted(tc.classes.values()) == ["10000kbit", "1000kbit"]
+        assert s.get_cidrs() == ["10.20.30.40/32"]
+        # idempotent: a second reconcile adds nothing
+        n = len(tc.calls)
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("1M"),
+                         parse_quantity("10M"))
+        assert not any("add" in c for c in tc.calls[n:])
+
+    def test_partial_failure_recovers_per_direction(self):
+        # ingress programmed, egress add failed: the next reconcile
+        # completes the missing direction instead of early-returning
+        from kubernetes_tpu.core.quantity import parse_quantity
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_cidr("10.20.30.40/32", None, parse_quantity("10M"))
+        assert len(tc.filters) == 1  # dst only
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("1M"),
+                         parse_quantity("10M"))
+        assert len(tc.filters) == 2  # src joined, dst untouched
+        assert sorted(tc.classes.values()) == ["10000kbit", "1000kbit"]
+
+    def test_rate_change_reprograms_class(self):
+        from kubernetes_tpu.core.quantity import parse_quantity
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("1M"), None)
+        assert list(tc.classes.values()) == ["1000kbit"]
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("100M"), None)
+        assert list(tc.classes.values()) == ["100000kbit"]
+        assert len(tc.filters) == 1
+
+    def test_reset_removes_filter_and_class(self):
+        from kubernetes_tpu.core.quantity import parse_quantity
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_cidr("10.20.30.40/32", None, parse_quantity("10M"))
+        assert s.get_cidrs() == ["10.20.30.40/32"]
+        s.reset("10.20.30.40/32")
+        assert s.get_cidrs() == []
+        assert tc.classes == {}
+
+
+class TestKubeletShaping:
+    def _kubelet(self, client, shaper, recorder=None):
+        return Kubelet(client, "n1", runtime=FakeRuntime(),
+                       shaper=shaper, recorder=recorder).run()
+
+    def test_annotated_pod_gets_limited_and_cleaned_up(self):
+        client = InProcClient(Registry())
+        shaper = FakeShaper()
+        kubelet = self._kubelet(client, shaper)
+        try:
+            client.create("pods", mkpod(annotations={
+                "kubernetes.io/egress-bandwidth": "5M"}))
+            assert wait_until(
+                lambda: "10.20.30.40/32" in shaper.limits)
+            egress, _ = shaper.limits["10.20.30.40/32"]
+            assert egress.value == 5_000_000
+            client.delete("pods", "p", "default")
+            assert wait_until(lambda: "u-bw" not in kubelet._pods)
+            kubelet._housekeeping()
+            assert shaper.resets == ["10.20.30.40/32"]
+        finally:
+            kubelet.stop()
+
+    def test_host_network_pod_records_event_not_limit(self):
+        client = InProcClient(Registry())
+        shaper = FakeShaper()
+        rec = FakeRecorder()
+        kubelet = self._kubelet(client, shaper, recorder=rec)
+        try:
+            client.create("pods", mkpod(host_network=True, annotations={
+                "kubernetes.io/egress-bandwidth": "5M"}))
+            assert wait_until(lambda: any(
+                "HostNetworkNotSupported" in e for e in rec.events))
+            assert shaper.limits == {}
+        finally:
+            kubelet.stop()
+
+    def test_no_shaper_records_event(self):
+        client = InProcClient(Registry())
+        rec = FakeRecorder()
+        kubelet = self._kubelet(client, None, recorder=rec)
+        try:
+            client.create("pods", mkpod(annotations={
+                "kubernetes.io/ingress-bandwidth": "5M"}))
+            assert wait_until(lambda: any(
+                "NilShaper" in e for e in rec.events))
+        finally:
+            kubelet.stop()
+
+    def test_shared_host_address_plugin_refuses_shaping(self):
+        # the default HostNetworkPlugin reports the NODE's address for
+        # every pod; shaping ip/32 would throttle the whole node
+        from kubernetes_tpu.kubelet.network import HostNetworkPlugin
+        client = InProcClient(Registry())
+        shaper = FakeShaper()
+        rec = FakeRecorder()
+        kubelet = Kubelet(client, "n1", runtime=FakeRuntime(),
+                          shaper=shaper, recorder=rec,
+                          network_plugin=HostNetworkPlugin(
+                              "10.0.0.1")).run()
+        try:
+            client.create("pods", mkpod(annotations={
+                "kubernetes.io/egress-bandwidth": "1M"}))
+            assert wait_until(lambda: any(
+                "HostNetworkNotSupported" in e for e in rec.events))
+            assert shaper.limits == {}
+        finally:
+            kubelet.stop()
+
+    def test_invalid_annotation_records_event(self):
+        client = InProcClient(Registry())
+        rec = FakeRecorder()
+        kubelet = self._kubelet(client, FakeShaper(), recorder=rec)
+        try:
+            client.create("pods", mkpod(annotations={
+                "kubernetes.io/ingress-bandwidth": "1"}))
+            assert wait_until(lambda: any(
+                "InvalidBandwidth" in e for e in rec.events))
+        finally:
+            kubelet.stop()

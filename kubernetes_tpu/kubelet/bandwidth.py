@@ -164,6 +164,20 @@ class TCShaper(Shaper):
     def _kbit(q: Quantity) -> str:
         return f"{q.value // 1000}kbit"  # (linux.go makeKBitString)
 
+    @staticmethod
+    def _rate_bps(rate: str) -> int:
+        """tc normalizes display units (input '10000kbit' shows as
+        '10Mbit'); compare rates numerically, not textually."""
+        r = rate.strip().lower()
+        for suffix, mult in (("gbit", 10 ** 9), ("mbit", 10 ** 6),
+                             ("kbit", 10 ** 3), ("bit", 1)):
+            if r.endswith(suffix):
+                try:
+                    return int(float(r[:-len(suffix)]) * mult)
+                except ValueError:
+                    return -1
+        return -1
+
     # u32 match offsets in the IP header: dst at 16, src at 12
     _OFFSET = {"dst": "16", "src": "12"}
 
@@ -215,15 +229,18 @@ class TCShaper(Shaper):
         edits)."""
         # ingress = traffic TO the pod (match dst); egress = FROM (src)
         for want, direction in ((ingress, "dst"), (egress, "src")):
+            existing = self._find_cidr_filter(cidr, direction)
             if want is None:
+                if existing is not None:
+                    # annotation removed: drop the stale direction
+                    self._del_filter(*existing)
                 continue
             rate = self._kbit(want)
-            existing = self._find_cidr_filter(cidr, direction)
             if existing is not None:
                 flow, fh = existing
-                # tc displays "1000Kbit" for an input of "1000kbit"
-                current = (self._class_rates().get(flow) or "").lower()
-                if current == rate.lower():
+                current = self._rate_bps(
+                    self._class_rates().get(flow, ""))
+                if current == self._rate_bps(rate):
                     continue  # already programmed at this rate
                 self._del_filter(flow, fh)
             cls = self._make_class(rate)

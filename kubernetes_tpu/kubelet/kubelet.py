@@ -36,6 +36,10 @@ SYNC_PERIOD = 10.0
 # dead-container GC cadence (ref: kubelet.go StartGarbageCollection,
 # container GC on its own 1-minute loop — not every housekeeping tick)
 CONTAINER_GC_PERIOD = 60.0
+# published when no network plugin supplies a real address (the hollow
+# convention); NEVER a valid shaping target — every unplumbed pod
+# shares it
+PLACEHOLDER_POD_IP = "10.244.0.2"
 
 
 def _parse_resolv_conf(text: str) -> "tuple[List[str], List[str]]":
@@ -177,6 +181,7 @@ class Kubelet:
         # None + annotated pod -> UndefinedShaper event, like the
         # reference (kubelet.go:1751)
         self.shaper = shaper
+        self._shaped: Dict[str, tuple] = {}  # uid -> converged target
         if shaper is not None:
             try:
                 shaper.reconcile_interface()
@@ -391,16 +396,27 @@ class Kubelet:
                     "Pod requests bandwidth shaping, but the shaper "
                     "is undefined")
             return
+        uid = pod.metadata.uid
         with self._lock:
-            ip = self._pod_ips.get(pod.metadata.uid)
+            ip = self._pod_ips.get(uid)
         ip = ip or pod.status.pod_ip
-        if not ip:
-            return  # no address yet; the next sync retries
+        if not ip or ip == PLACEHOLDER_POD_IP:
+            # no REAL per-pod address: shaping the shared placeholder
+            # would make annotated pods clobber each other's limits
+            return
+        desired = (ip,
+                   ingress.value if ingress is not None else None,
+                   egress.value if egress is not None else None)
+        with self._lock:
+            if self._shaped.get(uid) == desired:
+                return  # converged: skip the tc probes entirely
         try:
             self.shaper.reconcile_cidr(f"{ip}/32", egress, ingress)
         except Exception:
-            logging.exception("bandwidth reconcile %s",
-                              pod.metadata.uid)
+            logging.exception("bandwidth reconcile %s", uid)
+        else:
+            with self._lock:
+                self._shaped[uid] = desired
 
     def _note_backoff(self, key: str, now: float) -> None:
         prev = self._backoff.get(f"{key}#d", 0.5)
@@ -589,7 +605,7 @@ class Kubelet:
                 with self._lock:
                     self._pod_ips[uid] = ip
                 return ip
-        return pod.status.pod_ip or "10.244.0.2"
+        return pod.status.pod_ip or PLACEHOLDER_POD_IP
 
     @staticmethod
     def _pod_phase(pod: api.Pod, total: int, running: int, succeeded: int,
@@ -712,6 +728,10 @@ class Kubelet:
             ip = ips.get(pod.metadata.uid) or pod.status.pod_ip
             if ip:
                 possible.add(f"{ip}/32")
+        with self._lock:
+            for uid in set(self._shaped) - set(
+                    p.metadata.uid for p in pods):
+                self._shaped.pop(uid, None)
         for cidr in current:
             if cidr not in possible:
                 try:

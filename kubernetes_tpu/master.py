@@ -211,11 +211,97 @@ class Master:
     def port(self) -> int:
         return self.server.port
 
+    # ------------------------------------------------ bootstrap loops
+    # (ref: pkg/master/controller.go — the core controller that creates
+    # the "default" namespace and the "kubernetes" master service, and
+    # reconciles that service's endpoints to the live apiservers)
+
+    def _bootstrap_once(self) -> None:
+        import ipaddress
+        from dataclasses import replace as _replace
+
+        from .core import types as api
+        from .core.errors import AlreadyExists, NotFound
+
+        # 1. the namespace holding the master services (:133
+        # CreateNamespaceIfNeeded)
+        try:
+            self.registry.create("namespaces", api.Namespace(
+                metadata=api.ObjectMeta(name="default")))
+        except AlreadyExists:
+            pass
+        # 2. the kubernetes service on the first IP of the service range
+        # (:187 CreateOrUpdateMasterServiceIfNeeded; the reference pins
+        # the range's base address)
+        net = ipaddress.ip_network(self.config.service_cidr)
+        master_ip = str(net.network_address + 1)
+        port_name = "https" if self.config.tls_cert_file else "http"
+        try:
+            self.registry.get("services", "kubernetes", "default")
+        except NotFound:
+            try:
+                self.registry.create("services", api.Service(
+                    metadata=api.ObjectMeta(name="kubernetes",
+                                            namespace="default",
+                                            labels={"component":
+                                                    "apiserver",
+                                                    "provider":
+                                                    "kubernetes"}),
+                    spec=api.ServiceSpec(
+                        cluster_ip=master_ip,
+                        session_affinity="ClientIP",
+                        ports=[api.ServicePort(name=port_name,
+                                               port=self.server.port)])),
+                    "default")
+            except AlreadyExists:
+                pass
+        # 3. endpoints always carry this apiserver (:226
+        # ReconcileEndpoints, master_count=1 form: exactly our address)
+        want = api.Endpoints(
+            metadata=api.ObjectMeta(name="kubernetes",
+                                    namespace="default"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip=self.config.host)],
+                ports=[api.EndpointPort(name=port_name,
+                                        port=self.server.port)])])
+        try:
+            current = self.registry.get("endpoints", "kubernetes",
+                                        "default")
+            if current.subsets != want.subsets:
+                self.registry.update(
+                    "endpoints", _replace(current,
+                                          subsets=want.subsets),
+                    "default")
+        except NotFound:
+            try:
+                self.registry.create("endpoints", want, "default")
+            except AlreadyExists:
+                pass
+
+    def _bootstrap_loop(self) -> None:
+        while not self._bootstrap_stop.wait(10.0):
+            try:
+                self._bootstrap_once()
+            except Exception:
+                pass  # next tick retries (crash-only)
+
     def start(self) -> "Master":
+        import threading
         self.server.start()
+        self._bootstrap_stop = threading.Event()
+        try:
+            self._bootstrap_once()
+        except Exception:
+            pass  # the loop retries
+        self._bootstrap_thread = threading.Thread(
+            target=self._bootstrap_loop, daemon=True,
+            name="master-bootstrap")
+        self._bootstrap_thread.start()
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_bootstrap_stop", None) is not None:
+            self._bootstrap_stop.set()
         self.server.stop()
         if self.tunneler is not None:
             self.tunneler.stop()

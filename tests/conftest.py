@@ -24,3 +24,15 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_report_header(config):
     return f"jax devices: {jax.devices()}"
+
+
+def ensure_default_namespace(client):
+    """The master bootstrap pre-creates "default" (the
+    pkg/master/controller.go role); tolerate either order."""
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.core.errors import AlreadyExists
+    try:
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+    except AlreadyExists:
+        pass

@@ -175,6 +175,26 @@ class TestTCShaper:
         assert list(tc.classes.values()) == ["100000kbit"]
         assert len(tc.filters) == 1
 
+    def test_removed_annotation_drops_stale_direction(self):
+        from kubernetes_tpu.core.quantity import parse_quantity
+        tc = FakeTC()
+        s = TCShaper("eth0", runner=tc)
+        s.reconcile_cidr("10.20.30.40/32", parse_quantity("1M"),
+                         parse_quantity("10M"))
+        assert len(tc.filters) == 2
+        # egress annotation removed: its filter+class must go
+        s.reconcile_cidr("10.20.30.40/32", None, parse_quantity("10M"))
+        assert len(tc.filters) == 1
+        assert list(tc.classes.values()) == ["10000kbit"]
+
+    def test_rate_compare_is_numeric_across_tc_display_units(self):
+        # real tc shows '10000kbit' input as '10Mbit'
+        assert TCShaper._rate_bps("10Mbit") == 10_000_000
+        assert TCShaper._rate_bps("10000kbit") == 10_000_000
+        assert TCShaper._rate_bps("1500Kbit") == 1_500_000
+        assert TCShaper._rate_bps("750bit") == 750
+        assert TCShaper._rate_bps("garbage") == -1
+
     def test_reset_removes_filter_and_class(self):
         from kubernetes_tpu.core.quantity import parse_quantity
         tc = FakeTC()

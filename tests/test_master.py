@@ -6,6 +6,7 @@ import urllib.request
 
 import pytest
 
+from tests.conftest import ensure_default_namespace
 from kubernetes_tpu.api.client import HttpClient
 from kubernetes_tpu.core import types as api
 from kubernetes_tpu.core.errors import ApiError, BadRequest
@@ -16,8 +17,7 @@ def test_default_master_serves():
     m = Master().start()
     try:
         client = HttpClient(m.url)
-        client.create("namespaces",
-                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        ensure_default_namespace(client)
         client.create("pods", api.Pod(
             metadata=api.ObjectMeta(name="p1", namespace="default"),
             spec=api.PodSpec(containers=[api.Container(name="c",
@@ -42,8 +42,7 @@ def test_master_with_admission_and_auth():
         assert e.value.code == 401
         client = HttpClient(m.url,
                             headers={"Authorization": "Bearer sekrit"})
-        client.create("namespaces",
-                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        ensure_default_namespace(client)
         # NamespaceLifecycle: creating into a missing namespace is rejected
         with pytest.raises(ApiError):
             client.create("pods", api.Pod(
@@ -58,8 +57,7 @@ def test_master_native_backend_roundtrip():
     m = Master(MasterConfig(storage_backend="native")).start()
     try:
         client = HttpClient(m.url)
-        client.create("namespaces",
-                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        ensure_default_namespace(client)
         client.create("pods", api.Pod(
             metadata=api.ObjectMeta(name="native-pod", namespace="default"),
             spec=api.PodSpec(containers=[api.Container(name="c",
@@ -108,5 +106,31 @@ def test_readonly_user_cannot_reach_exec_proxy():
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req, timeout=5)
         assert e.value.code == 404
+    finally:
+        m.stop()
+
+
+def test_master_bootstraps_kubernetes_service_and_endpoints():
+    """(ref: pkg/master/controller.go — default namespace, the
+    "kubernetes" service on the service range's first IP, endpoints
+    reconciled to this apiserver)"""
+    m = Master().start()
+    try:
+        client = HttpClient(m.url)
+        assert client.get("namespaces", "default").metadata.name == \
+            "default"
+        svc = client.get("services", "kubernetes", "default")
+        assert svc.spec.cluster_ip == "10.0.0.1"  # range base + 1
+        assert svc.spec.ports[0].port == m.port
+        eps = client.get("endpoints", "kubernetes", "default")
+        assert eps.subsets[0].addresses[0].ip == m.config.host
+        assert eps.subsets[0].ports[0].port == m.port
+        # a drifted endpoints record heals on the reconcile tick
+        # (ReconcileEndpoints: we ALWAYS carry our own address)
+        from dataclasses import replace
+        client.update("endpoints", replace(eps, subsets=[]), "default")
+        m._bootstrap_once()
+        eps = client.get("endpoints", "kubernetes", "default")
+        assert eps.subsets[0].addresses[0].ip == m.config.host
     finally:
         m.stop()

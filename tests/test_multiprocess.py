@@ -17,6 +17,7 @@ import time
 
 import pytest
 
+from tests.conftest import ensure_default_namespace
 from kubernetes_tpu.api.client import HttpClient
 from kubernetes_tpu.core import types as api
 from kubernetes_tpu.core.quantity import parse_quantity
@@ -169,8 +170,7 @@ def test_kubectl_against_live_apiserver():
     try:
         url = wait_ready(apiserver).split()[-1]
         client = HttpClient(url)
-        client.create("namespaces",
-                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        ensure_default_namespace(client)
         client.create("pods", bench_pod(0), "default")
         out = subprocess.run(
             [sys.executable, "-m", "kubernetes_tpu", "kubectl",
@@ -255,8 +255,7 @@ def test_real_kubelet_process_runs_pod_and_records_events():
     try:
         url = wait_ready(apiserver).split()[-1]
         client = HttpClient(url)
-        client.create("namespaces",
-                      api.Namespace(metadata=api.ObjectMeta(name="default")))
+        ensure_default_namespace(client)
         kubelet = spawn("kubelet", "--master", url, "--name", "real-1",
                         "--cluster-dns", "10.0.0.10",
                         "--cluster-domain", "cluster.local")

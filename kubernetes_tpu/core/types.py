@@ -307,12 +307,28 @@ class TCPSocketAction:
 
 
 @dataclass
-class Probe:
-    """(ref: pkg/api/types.go Probe — a Handler + timing knobs; the
-    exec handler's field is literally named `exec`, matching the wire)"""
+class Handler:
+    """One action (ref: pkg/api/types.go:816 Handler — the union probes
+    and lifecycle hooks share)."""
     exec: Optional[ExecAction] = None
     http_get: Optional[HTTPGetAction] = None
     tcp_socket: Optional[TCPSocketAction] = None
+
+
+@dataclass
+class Lifecycle:
+    """(ref: pkg/api/types.go:831 Lifecycle — PostStart runs right
+    after a container starts and kills it on failure; PreStop runs
+    before a requested kill)"""
+    post_start: Optional[Handler] = None
+    pre_stop: Optional[Handler] = None
+
+
+@dataclass
+class Probe(Handler):
+    """(ref: pkg/api/types.go Probe — literally a Handler embedded
+    with timing knobs; inheriting keeps one copy of the action union
+    and the identical wire shape)"""
     initial_delay_seconds: int = 0
     timeout_seconds: int = 1
     period_seconds: int = 10
@@ -357,6 +373,7 @@ class Container:
     security_context: Optional[SecurityContext] = None
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
+    lifecycle: Optional[Lifecycle] = None
     # ref: pkg/api/types.go:813 Container.Stdin — only stdin:true
     # containers get a stdin pipe to attach to
     stdin: bool = False

@@ -28,6 +28,7 @@ from ..agents.hollow_node import StatusManager
 from ..api.cache import Informer
 from ..core import types as api
 from .container import ContainerState, FakeRuntime, Runtime, RuntimePod
+from .lifecycle import HandlerRunner, HookError
 from .pleg import GenericPLEG
 from .prober import Prober, ProberManager
 
@@ -164,6 +165,8 @@ class Kubelet:
         # Failed/Killing/BackOff through record.EventRecorder;
         # dockertools manager.go + kubelet.go syncPod)
         self.recorder = recorder
+        # PostStart/PreStop hook runner (pkg/kubelet/lifecycle)
+        self._hooks = HandlerRunner(self.runtime)
         # pod network setup/teardown/status (pkg/kubelet/network;
         # kubelet/network.py). None keeps legacy behavior (no setup,
         # placeholder pod IP).
@@ -242,6 +245,25 @@ class Kubelet:
         if worker:
             worker.stop()
         self.prober_manager.remove_pod(uid)
+        self.status_manager.forget(pod)
+        # the blocking tail (PreStop hooks can run for seconds) happens
+        # off the informer dispatch thread so one slow deletion can't
+        # stall every other pod's event processing — the reference
+        # scopes kills to per-pod workers the same way
+        threading.Thread(target=self._tear_down_pod, args=(pod,),
+                         daemon=True,
+                         name=f"pod-teardown-{uid[:8]}").start()
+
+    def _tear_down_pod(self, pod: api.Pod) -> None:
+        """PreStop hooks → network teardown → kill → volumes, in the
+        deletion order the reference keeps; failures stay tracked for
+        housekeeping retries."""
+        uid = pod.metadata.uid
+        for container in pod.spec.containers:
+            try:
+                self._run_pre_stop(pod, container.name)
+            except Exception:
+                logging.exception("pre-stop %s/%s", uid, container.name)
         if self.network_plugin is not None and uid in self._networked:
             # teardown before the pod is killed (exec.go: teardown
             # before the infra container dies); a failed teardown stays
@@ -264,7 +286,6 @@ class Kubelet:
             else:
                 with self._lock:
                     self._mounted.discard(uid)
-        self.status_manager.forget(pod)
 
     # ----------------------------------------------------------- syncPod
 
@@ -339,6 +360,11 @@ class Kubelet:
                     self.image_manager.ensure_image_exists(pod, container)
                 self.runtime.start_container(
                     pod, self._container_with_env(pod, container))
+                if (container.lifecycle is not None
+                        and container.lifecycle.post_start is not None):
+                    # a failed PostStart kills the container and fails
+                    # the start (manager.go:1474-1481)
+                    self._run_post_start(pod, container)
                 self._backoff.pop(key, None)
                 self._backoff.pop(f"{key}#d", None)  # full delay reset
                 if self.recorder:
@@ -358,6 +384,55 @@ class Kubelet:
                              " (%s)",
                         container.name, e)
         self._publish_status(pod)
+
+    def _hook_ip(self, pod: api.Pod) -> str:
+        """The pod IP for httpGet hooks — NEVER the shared placeholder
+        (the hook runner fails fast on an empty host and the start is
+        retried once a real address exists)."""
+        ip = self._pod_ip(pod)
+        return "" if ip == PLACEHOLDER_POD_IP else ip
+
+    def _run_post_start(self, pod: api.Pod,
+                        container: api.Container) -> None:
+        try:
+            self._hooks.run(pod, container,
+                            container.lifecycle.post_start,
+                            pod_ip=self._hook_ip(pod))
+        except HookError as e:
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Warning", "FailedPostStartHook",
+                    "PostStart hook for %s failed: %s",
+                    container.name, e)
+            self.runtime.kill_container(pod.metadata.uid,
+                                        container.name)
+            raise  # the start failed: backoff like any start error
+
+    def _run_pre_stop(self, pod: api.Pod,
+                      container_name: str) -> None:
+        """Best-effort PreStop before an intentional kill
+        (manager.go:1360 KillContainerInPod)."""
+        spec = next((c for c in pod.spec.containers
+                     if c.name == container_name), None)
+        if (spec is None or spec.lifecycle is None
+                or spec.lifecycle.pre_stop is None):
+            return
+        rp = self._runtime_pod(pod.metadata.uid)
+        running = rp is not None and any(
+            c.name == container_name
+            and c.state == ContainerState.RUNNING
+            for c in rp.containers)
+        if not running:
+            return
+        try:
+            self._hooks.run(pod, spec, spec.lifecycle.pre_stop,
+                            pod_ip=self._hook_ip(pod))
+        except HookError as e:
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Warning", "FailedPreStopHook",
+                    "PreStop hook for %s failed: %s",
+                    container_name, e)
 
     def _reconcile_bandwidth(self, pod: api.Pod) -> None:
         """Program the pod's bandwidth limits when annotated
@@ -525,6 +600,7 @@ class Kubelet:
                                  "Liveness probe failed: %s", message)
             self.recorder.eventf(pod, "Normal", "Killing",
                                  "Killing container %s", container_name)
+        self._run_pre_stop(pod, container_name)
         self.runtime.kill_container(pod.metadata.uid, container_name)
         current = self._pods.get(pod.metadata.uid)
         if current is not None:

@@ -1,0 +1,83 @@
+"""Container lifecycle hook runner.
+
+The reference runs PostStart right after a container starts (a failure
+kills the container and fails the start, dockertools/manager.go:1474-
+1481) and PreStop before an intentional kill (manager.go:1360); the
+handlers are the probe union minus TCP (ref:
+pkg/kubelet/lifecycle/handlers.go:49 HandlerRunner.Run — exec runs in
+the container, httpGet hits the pod, anything else is an invalid
+handler).
+
+One sharpening over the reference: a nonzero exec exit fails the hook
+(v1.1's docker exec path surfaced only transport errors, silently
+ignoring exit codes — a well-known reference wart).
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from ..core import types as api
+
+
+class HookError(Exception):
+    pass
+
+
+class HandlerRunner:
+    """(handlers.go:34 NewHandlerRunner; the runtime plays the
+    command-runner, the pod IP comes from the kubelet)"""
+
+    def __init__(self, runtime, timeout: float = 30.0):
+        self.runtime = runtime
+        self.timeout = timeout
+
+    def run(self, pod: api.Pod, container: api.Container,
+            handler: api.Handler, pod_ip: str = "") -> None:
+        """Raises HookError when the hook fails."""
+        if handler.exec is not None:
+            try:
+                code, output = self.runtime.exec_in_container(
+                    pod.metadata.uid, container.name,
+                    list(handler.exec.command))
+            except Exception as e:
+                raise HookError(f"exec hook: {e}") from e
+            if code != 0:
+                raise HookError(
+                    f"exec hook exited {code}: {output[-300:]}")
+            return
+        if handler.http_get is not None:
+            g = handler.http_get
+            host = g.host or pod_ip or pod.status.pod_ip
+            if not host:
+                raise HookError("httpGet hook: pod has no IP yet")
+            port = self._resolve_port(g.port, container)
+            url = (f"{(g.scheme or 'HTTP').lower()}://{host}:{port}"
+                   f"{g.path or '/'}")
+            try:
+                # any completed response is success; only a failed
+                # request fails the hook (handlers.go runHTTPHandler)
+                urllib.request.urlopen(url, timeout=self.timeout).close()
+            except urllib.error.HTTPError:
+                return  # a status-coded reply IS a completed request
+            except Exception as e:
+                raise HookError(f"httpGet hook {url}: {e}") from e
+            return
+        raise HookError(f"invalid handler: {handler}")
+
+    @staticmethod
+    def _resolve_port(ref, container: api.Container) -> int:
+        """int | numeric string | named container port
+        (handlers.go:69 resolvePort; empty defaults to 80)."""
+        if ref in (None, ""):
+            return 80
+        if isinstance(ref, int):
+            return ref
+        s = str(ref)
+        if s.isdigit():
+            return int(s)
+        for p in container.ports:
+            if p.name == s:
+                return p.container_port
+        raise HookError(f"couldn't find port {s!r} in container "
+                        f"{container.name!r}")

@@ -1,0 +1,175 @@
+"""Container lifecycle hooks (ref: pkg/kubelet/lifecycle/handlers.go
+HandlerRunner, dockertools/manager.go:1360 PreStop / :1474 PostStart —
+a failed PostStart kills the container and fails the start; PreStop
+runs best-effort before intentional kills)."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.record import FakeRecorder
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+from kubernetes_tpu.kubelet.lifecycle import HandlerRunner, HookError
+
+
+def wait_until(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def mkpod(containers, uid="u-lc", pod_ip="127.0.0.1"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default", uid=uid),
+        spec=api.PodSpec(node_name="n1", containers=containers),
+        status=api.PodStatus(phase="Pending", pod_ip=pod_ip))
+
+
+class RecordingExecRuntime(FakeRuntime):
+    def __init__(self, exec_rc=0):
+        super().__init__()
+        self.execs = []
+        self.exec_rc = exec_rc
+
+    def exec_in_container(self, pod_uid, name, cmd):
+        self.execs.append((pod_uid, name, list(cmd)))
+        return self.exec_rc, "hook output"
+
+
+class TestHandlerRunner:
+    def test_exec_handler_runs_in_container(self):
+        rt = RecordingExecRuntime()
+        pod = mkpod([api.Container(name="c", image="i")])
+        rt.start_container(pod, pod.spec.containers[0])
+        HandlerRunner(rt).run(pod, pod.spec.containers[0],
+                              api.Handler(exec=api.ExecAction(
+                                  command=["sync-data", "--now"])))
+        assert rt.execs == [("u-lc", "c", ["sync-data", "--now"])]
+
+    def test_exec_nonzero_exit_fails_hook(self):
+        rt = RecordingExecRuntime(exec_rc=3)
+        pod = mkpod([api.Container(name="c", image="i")])
+        rt.start_container(pod, pod.spec.containers[0])
+        with pytest.raises(HookError):
+            HandlerRunner(rt).run(pod, pod.spec.containers[0],
+                                  api.Handler(exec=api.ExecAction(
+                                      command=["boom"])))
+
+    def test_http_handler_hits_the_pod(self):
+        hits = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append(self.path)
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            port = httpd.server_address[1]
+            container = api.Container(name="c", image="i", ports=[
+                api.ContainerPort(name="admin", container_port=port)])
+            pod = mkpod([container])
+            # named-port resolution (handlers.go:69 resolvePort)
+            HandlerRunner(FakeRuntime()).run(
+                pod, container, api.Handler(http_get=api.HTTPGetAction(
+                    path="/drain", port="admin")), pod_ip="127.0.0.1")
+            assert hits == ["/drain"]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_http_connection_failure_fails_hook(self):
+        pod = mkpod([api.Container(name="c", image="i")])
+        with pytest.raises(HookError):
+            HandlerRunner(FakeRuntime(), timeout=1.0).run(
+                pod, pod.spec.containers[0],
+                api.Handler(http_get=api.HTTPGetAction(
+                    path="/", port=1)), pod_ip="127.0.0.1")
+
+    def test_empty_handler_invalid(self):
+        pod = mkpod([api.Container(name="c", image="i")])
+        with pytest.raises(HookError):
+            HandlerRunner(FakeRuntime()).run(pod, pod.spec.containers[0],
+                                             api.Handler())
+
+
+class TestKubeletHooks:
+    def test_post_start_runs_after_start(self):
+        client = InProcClient(Registry())
+        rt = RecordingExecRuntime()
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            client.create("pods", mkpod([api.Container(
+                name="c", image="i",
+                lifecycle=api.Lifecycle(post_start=api.Handler(
+                    exec=api.ExecAction(command=["warm-cache"]))))]))
+            assert wait_until(lambda: rt.execs)
+            assert rt.execs[0][2] == ["warm-cache"]
+            assert wait_until(lambda: client.get(
+                "pods", "p", "default").status.phase == "Running")
+        finally:
+            kubelet.stop()
+
+    def test_failed_post_start_kills_container_and_records_event(self):
+        client = InProcClient(Registry())
+        rt = RecordingExecRuntime(exec_rc=1)
+        rec = FakeRecorder()
+        kubelet = Kubelet(client, "n1", runtime=rt, recorder=rec).run()
+        try:
+            client.create("pods", mkpod([api.Container(
+                name="c", image="i",
+                lifecycle=api.Lifecycle(post_start=api.Handler(
+                    exec=api.ExecAction(command=["boom"]))))]))
+            assert wait_until(lambda: any(
+                "FailedPostStartHook" in e for e in rec.events))
+            # the container was killed, not left running
+            assert rt.running_containers("u-lc") == []
+        finally:
+            kubelet.stop()
+
+    def test_pre_stop_runs_on_deletion(self):
+        client = InProcClient(Registry())
+        rt = RecordingExecRuntime()
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            client.create("pods", mkpod([api.Container(
+                name="c", image="i",
+                lifecycle=api.Lifecycle(pre_stop=api.Handler(
+                    exec=api.ExecAction(command=["graceful-drain"]))))]))
+            assert wait_until(lambda: rt.running_containers("u-lc"))
+            client.delete("pods", "p", "default")
+            assert wait_until(lambda: ("u-lc", "c", ["graceful-drain"])
+                              in rt.execs)
+            assert wait_until(
+                lambda: rt.running_containers("u-lc") == [])
+        finally:
+            kubelet.stop()
+
+    def test_pre_stop_runs_on_liveness_kill(self):
+        client = InProcClient(Registry())
+        rt = RecordingExecRuntime()
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            pod = mkpod([api.Container(
+                name="c", image="i",
+                lifecycle=api.Lifecycle(pre_stop=api.Handler(
+                    exec=api.ExecAction(command=["drain"]))))])
+            client.create("pods", pod)
+            assert wait_until(lambda: rt.running_containers("u-lc"))
+            kubelet._liveness_failed(pod, "c", "probe failed")
+            assert ("u-lc", "c", ["drain"]) in rt.execs
+        finally:
+            kubelet.stop()

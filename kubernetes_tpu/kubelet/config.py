@@ -96,13 +96,18 @@ class _PollingSource:
 
     def _static_defaults(self, pod: api.Pod, origin: str) -> api.Pod:
         """(ref: file.go/http.go applyDefaults: deterministic uid from
-        the origin, name suffixed with the node name, default ns)"""
+        the origin, name suffixed with the node name, default ns, the
+        config-source annotation the kubelet keys static-pod handling
+        on — kubetypes.ConfigSourceAnnotation)"""
         digest = hashlib.sha1(origin.encode()).hexdigest()[:16]
+        annotations = dict(pod.metadata.annotations)
+        annotations["kubernetes.io/config.source"] = self.name
         meta = api.fast_replace(
             pod.metadata,
             uid=pod.metadata.uid or digest,
             name=f"{pod.metadata.name}-{self.node_name}",
-            namespace=pod.metadata.namespace or "default")
+            namespace=pod.metadata.namespace or "default",
+            annotations=annotations)
         spec = api.fast_replace(pod.spec, node_name=self.node_name)
         return api.fast_replace(pod, metadata=meta, spec=spec)
 

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 from ..agents.hollow_node import StatusManager
 from ..api.cache import Informer
 from ..core import types as api
+from ..core.errors import AlreadyExists
 from .container import ContainerState, FakeRuntime, Runtime, RuntimePod
 from .lifecycle import HandlerRunner, HookError
 from .pleg import GenericPLEG
@@ -41,6 +42,20 @@ CONTAINER_GC_PERIOD = 60.0
 # convention); NEVER a valid shaping target — every unplumbed pod
 # shares it
 PLACEHOLDER_POD_IP = "10.244.0.2"
+# static-pod machinery (ref: pkg/kubelet/types annotations +
+# pkg/kubelet/mirror_client.go): file/http pods carry config.source;
+# their apiserver reflections carry config.mirror and are NEVER run
+CONFIG_SOURCE_ANNOTATION = "kubernetes.io/config.source"
+CONFIG_MIRROR_ANNOTATION = "kubernetes.io/config.mirror"
+
+
+def is_static_pod(pod: api.Pod) -> bool:
+    return pod.metadata.annotations.get(CONFIG_SOURCE_ANNOTATION) in (
+        "file", "http")
+
+
+def is_mirror_pod(pod: api.Pod) -> bool:
+    return CONFIG_MIRROR_ANNOTATION in pod.metadata.annotations
 
 
 def _parse_resolv_conf(text: str) -> "tuple[List[str], List[str]]":
@@ -136,6 +151,9 @@ class Kubelet:
         self.manifest_url = manifest_url
         self._sources = []
         self._mounted: set = set()  # pod uids with volumes set up
+        self._mirrored: set = set()  # static pod uids with mirrors
+        self._tearing_down: set = set()  # uids mid-async-teardown
+        self._deadline_failed: set = set()  # uids already failed
         self.pleg = GenericPLEG(self.runtime)
         self.prober_manager = ProberManager(
             prober or Prober(), on_liveness_failure=self._liveness_failed,
@@ -221,12 +239,16 @@ class Kubelet:
 
     def handle_pod_addition(self, pod: api.Pod) -> None:
         """(kubelet.go:2394 HandlePodAdditions)"""
+        if is_mirror_pod(pod):
+            return  # the apiserver reflection of a static pod: never run
         with self._lock:
             self._pods[pod.metadata.uid] = pod
         self.prober_manager.add_pod(pod)
         self._worker_for(pod).update(pod)
 
     def handle_pod_update(self, old: api.Pod, pod: api.Pod) -> None:
+        if is_mirror_pod(pod):
+            return
         with self._lock:
             self._pods[pod.metadata.uid] = pod
         # refresh the probers' pod view (pod IP, new probes on spec change)
@@ -234,11 +256,30 @@ class Kubelet:
         self._worker_for(pod).update(pod)
 
     def handle_pod_deletion(self, pod: api.Pod) -> None:
+        if is_mirror_pod(pod):
+            # deleting the reflection never kills the static pod — but
+            # un-note it so the next resync recreates it (out-of-band
+            # `kubectl delete` of a mirror heals)
+            with self._lock:
+                self._mirrored.discard(pod.metadata.annotations.get(
+                    CONFIG_MIRROR_ANNOTATION, ""))
+            return
+        if is_static_pod(pod):
+            # drop the apiserver reflection with the source's pod
+            # (mirror_client.go DeleteMirrorPod)
+            try:
+                self.client.delete("pods", pod.metadata.name,
+                                   pod.metadata.namespace)
+            except Exception:
+                pass
+            with self._lock:
+                self._mirrored.discard(pod.metadata.uid)
         uid = pod.metadata.uid
         with self._lock:
             self._pods.pop(uid, None)
             worker = self._workers.pop(uid, None)
             self._start_times.pop(uid, None)
+            self._deadline_failed.discard(uid)
             for key in [k for k in self._backoff
                         if k.startswith(f"{uid}/")]:
                 del self._backoff[key]
@@ -249,7 +290,11 @@ class Kubelet:
         # the blocking tail (PreStop hooks can run for seconds) happens
         # off the informer dispatch thread so one slow deletion can't
         # stall every other pod's event processing — the reference
-        # scopes kills to per-pod workers the same way
+        # scopes kills to per-pod workers the same way. The uid is
+        # marked mid-teardown so housekeeping's orphan sweep doesn't
+        # kill the containers out from under a running PreStop hook.
+        with self._lock:
+            self._tearing_down.add(uid)
         threading.Thread(target=self._tear_down_pod, args=(pod,),
                          daemon=True,
                          name=f"pod-teardown-{uid[:8]}").start()
@@ -259,11 +304,25 @@ class Kubelet:
         deletion order the reference keeps; failures stay tracked for
         housekeeping retries."""
         uid = pod.metadata.uid
+        try:
+            self._tear_down_pod_inner(pod)
+        finally:
+            with self._lock:
+                self._tearing_down.discard(uid)
+
+    def _tear_down_pod_inner(self, pod: api.Pod) -> None:
+        uid = pod.metadata.uid
         for container in pod.spec.containers:
             try:
                 self._run_pre_stop(pod, container.name)
             except Exception:
                 logging.exception("pre-stop %s/%s", uid, container.name)
+        with self._lock:
+            if uid in self._pods:
+                # re-added during the hooks (a static pod's manifest
+                # restored): this teardown is stale — killing now would
+                # destroy the NEW incarnation
+                return
         if self.network_plugin is not None and uid in self._networked:
             # teardown before the pod is killed (exec.go: teardown
             # before the infra container dies); a failed teardown stays
@@ -292,6 +351,33 @@ class Kubelet:
     def sync_pod(self, pod: api.Pod) -> None:
         """(kubelet.go:1597 syncPod, against the runtime's view)"""
         uid = pod.metadata.uid
+        if is_static_pod(pod):
+            # keep the apiserver reflection alive so the static pod is
+            # visible (and carries status) cluster-wide; the periodic
+            # resync retries a failed create (mirror_client.go
+            # CreateMirrorPod, kubelet.go syncPod mirror leg)
+            self._ensure_mirror_pod(pod)
+        if self._past_active_deadline(pod):
+            # (kubelet.go:1926 pastActiveDeadline -> the pod fails with
+            # DeadlineExceeded and its containers die) — once; the
+            # resync must not re-record the event every 10s forever
+            with self._lock:
+                if uid in self._deadline_failed:
+                    return
+                self._deadline_failed.add(uid)
+            if self.recorder:
+                self.recorder.eventf(
+                    pod, "Normal", "DeadlineExceeded",
+                    "Pod was active on the node longer than specified "
+                    "deadline")
+            self.runtime.kill_pod(uid)
+            self.status_manager.set_pod_status(pod, api.PodStatus(
+                phase=api.POD_FAILED, reason="DeadlineExceeded",
+                message="Pod was active on the node longer than "
+                        "specified deadline",
+                start_time=pod.status.start_time,
+                pod_ip=pod.status.pod_ip))
+            return
         runtime_pod = self._runtime_pod(uid)
         by_name = {c.name: c for c in runtime_pod.containers} \
             if runtime_pod else {}
@@ -384,6 +470,47 @@ class Kubelet:
                              " (%s)",
                         container.name, e)
         self._publish_status(pod)
+
+    def _ensure_mirror_pod(self, pod: api.Pod) -> None:
+        """Create the static pod's apiserver reflection once
+        (mirror_client.go:41 CreateMirrorPod: the mirror annotation
+        carries the static pod's identity)."""
+        with self._lock:
+            if pod.metadata.uid in self._mirrored:
+                return
+        import dataclasses
+        annotations = dict(pod.metadata.annotations)
+        annotations[CONFIG_MIRROR_ANNOTATION] = pod.metadata.uid
+        mirror = dataclasses.replace(
+            pod, metadata=dataclasses.replace(
+                pod.metadata, uid="", resource_version="",
+                annotations=annotations))
+        try:
+            self.client.create("pods", mirror, pod.metadata.namespace)
+        except AlreadyExists:
+            pass
+        except Exception:
+            return  # transient: the periodic resync retries
+        with self._lock:
+            self._mirrored.add(pod.metadata.uid)
+
+    def _past_active_deadline(self, pod: api.Pod) -> bool:
+        """(kubelet.go:1926 pastActiveDeadline)"""
+        ads = pod.spec.active_deadline_seconds
+        if not ads:
+            return False
+        start = (pod.status.start_time
+                 or self._start_times.get(pod.metadata.uid))
+        if not start:
+            return False
+        from datetime import datetime, timezone
+        try:
+            started = datetime.strptime(
+                start, "%Y-%m-%dT%H:%M:%SZ").replace(
+                tzinfo=timezone.utc).timestamp()
+        except ValueError:
+            return False
+        return time.time() - started > ads
 
     def _hook_ip(self, pod: api.Pod) -> str:
         """The pod IP for httpGet hooks — NEVER the shared placeholder
@@ -754,8 +881,12 @@ class Kubelet:
             # meanwhile aren't killed as orphans below
             with self._lock:
                 known = set(self._pods)
+        with self._lock:
+            tearing = set(self._tearing_down)
         for rp in self.runtime.get_pods():
-            if rp.uid not in known:
+            if rp.uid not in known and rp.uid not in tearing:
+                # mid-teardown pods are the deletion thread's to kill —
+                # sweeping them here would race a running PreStop hook
                 self.runtime.kill_pod(rp.uid)
         if self.volume_mgr is not None:
             with self._lock:

@@ -48,7 +48,10 @@ class HandlerRunner:
             return
         if handler.http_get is not None:
             g = handler.http_get
-            host = g.host or pod_ip or pod.status.pod_ip
+            # pod_ip is the caller's AUTHORITATIVE address (the kubelet
+            # filters out the shared placeholder); no fallback to the
+            # possibly-placeholder status field
+            host = g.host or pod_ip
             if not host:
                 raise HookError("httpGet hook: pod has no IP yet")
             port = self._resolve_port(g.port, container)

@@ -208,3 +208,102 @@ def test_empty_volume_source_roundtrips_presence():
     assert vol["emptyDir"] == {}
     back = default_scheme.decode_dict(wire)
     assert back.spec.volumes[0].empty_dir is not None
+
+
+class TestMirrorPodsAndDeadline:
+    """Static pods reflect onto the apiserver as mirror pods and the
+    mirror is never run (ref: pkg/kubelet/mirror_client.go, kubetypes
+    annotations); ActiveDeadlineSeconds fails an overdue pod
+    (kubelet.go:1926 pastActiveDeadline)."""
+
+    def _env(self, tmp_path=None):
+        import time as _time
+
+        from kubernetes_tpu.api.client import InProcClient
+        from kubernetes_tpu.api.registry import Registry
+        from kubernetes_tpu.kubelet import FakeRuntime, Kubelet
+
+        registry = Registry()
+        client = InProcClient(registry)
+        runtime = FakeRuntime()
+        kw = {}
+        if tmp_path is not None:
+            kw["manifest_path"] = str(tmp_path)
+        kubelet = Kubelet(client, "n1", runtime=runtime, **kw).run()
+
+        def wait_until(cond, timeout=20.0):
+            deadline = _time.time() + timeout
+            while _time.time() < deadline:
+                if cond():
+                    return True
+                _time.sleep(0.02)
+            return cond()
+
+        return client, runtime, kubelet, wait_until
+
+    def test_static_pod_gets_mirror_and_status(self, tmp_path):
+        import json as _json
+        (tmp_path / "static.json").write_text(_json.dumps({
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "static-web", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "i"}]}}))
+        client, runtime, kubelet, wait_until = self._env(tmp_path)
+        try:
+            # the mirror appears on the apiserver, carries the mirror
+            # annotation, and reaches Running through the status path
+            assert wait_until(lambda: any(
+                p.metadata.name == "static-web-n1"
+                for p in client.list("pods", "default")[0]))
+            mirror = client.get("pods", "static-web-n1", "default")
+            assert "kubernetes.io/config.mirror" in \
+                mirror.metadata.annotations
+            assert wait_until(lambda: client.get(
+                "pods", "static-web-n1",
+                "default").status.phase == "Running")
+            # exactly ONE runtime pod: the mirror was not run as a
+            # second copy by the apiserver informer
+            assert len(runtime.get_pods()) == 1
+        finally:
+            kubelet.stop()
+
+    def test_mirror_deleted_with_manifest(self, tmp_path):
+        import json as _json
+        manifest = tmp_path / "static.json"
+        manifest.write_text(_json.dumps({
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "gone", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "i"}]}}))
+        client, runtime, kubelet, wait_until = self._env(tmp_path)
+        try:
+            assert wait_until(lambda: any(
+                p.metadata.name == "gone-n1"
+                for p in client.list("pods", "default")[0]))
+            manifest.unlink()
+            assert wait_until(lambda: not any(
+                p.metadata.name == "gone-n1"
+                for p in client.list("pods", "default")[0]))
+            assert wait_until(lambda: runtime.get_pods() == [])
+        finally:
+            kubelet.stop()
+
+    def test_active_deadline_fails_pod(self):
+        from kubernetes_tpu.core import types as api
+        client, runtime, kubelet, wait_until = self._env()
+        try:
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="slow", namespace="default",
+                                        uid="u-dl"),
+                spec=api.PodSpec(
+                    node_name="n1", active_deadline_seconds=1,
+                    containers=[api.Container(name="c", image="i")]),
+                status=api.PodStatus(
+                    phase="Pending",
+                    start_time="2000-01-01T00:00:00Z"))
+            client.create("pods", pod)
+            assert wait_until(lambda: client.get(
+                "pods", "slow", "default").status.phase == "Failed")
+            got = client.get("pods", "slow", "default")
+            assert got.status.reason == "DeadlineExceeded"
+            assert wait_until(lambda: runtime.get_pods() == [])
+        finally:
+            kubelet.stop()

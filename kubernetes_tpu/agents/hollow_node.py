@@ -93,7 +93,10 @@ class FakeRuntime:
         return out
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
+        if previous:
+            raise KeyError('hollow runtime keeps no previous logs')
         with self._lock:
             for key, pod in self._pods.items():
                 if pod.metadata.uid != pod_uid or key not in self._running:

@@ -81,7 +81,8 @@ class Client:
         raise NotImplementedError
 
     def pod_logs(self, name: str, namespace: str = "default",
-                 container: str = "", tail_lines: int = 0) -> str:
+                 container: str = "", tail_lines: int = 0,
+                 previous: bool = False) -> str:
         """Container logs via the pod `log` subresource (the apiserver
         relays to the node's kubelet server)."""
         raise NotImplementedError
@@ -138,13 +139,17 @@ class InProcClient(Client):
         return self.registry.bind_batch(bindings, namespace)
 
     def pod_logs(self, name, namespace="default", container="",
-                 tail_lines=0):
+                 tail_lines=0, previous=False):
         # even in-proc, the kubelet is across the network: resolve the
         # node's daemon endpoint and fetch (same relay ApiServer does)
         from .relay import container_log_url, fetch_kubelet
+        params = []
+        if tail_lines:
+            params.append(f"tailLines={tail_lines}")
+        if previous:
+            params.append("previous=true")
         url = container_log_url(
-            self.registry, namespace, name, container,
-            f"tailLines={tail_lines}" if tail_lines else "")
+            self.registry, namespace, name, container, "&".join(params))
         return fetch_kubelet(url).decode()
 
     def node_proxy(self, node_name, path):
@@ -483,10 +488,12 @@ class HttpClient(Client):
         return [self._decode({**i, "kind": "Pod"}) for i in data["items"]]
 
     def pod_logs(self, name, namespace="default", container="",
-                 tail_lines=0):
+                 tail_lines=0, previous=False):
         query = {"container": container}
         if tail_lines:
             query["tailLines"] = str(tail_lines)
+        if previous:
+            query["previous"] = "true"
         url = self._url("pods", namespace, name, "log", query)
         resp = self._do("GET", url, stream=True)
         try:

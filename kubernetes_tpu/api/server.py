@@ -746,7 +746,8 @@ class ApiServer:
     def _serve_pod_log(self, h, namespace: str, name: str,
                        query: dict) -> None:
         from .relay import container_log_url
-        params = {k: query[k] for k in ("tailLines", "follow")
+        params = {k: query[k] for k in ("tailLines", "follow",
+                                        "previous")
                   if k in query}
         url = container_log_url(self.registry, namespace, name,
                                 query.get("container", ""),

@@ -113,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("pod")
     lg.add_argument("container", nargs="?", default="")
     lg.add_argument("-f", "--follow", action="store_true")
+    lg.add_argument("-p", "--previous", action="store_true",
+                    help="print the logs of the previous terminated "
+                         "container instance")
 
     ex = sub.add_parser("exec", help="execute a command in a container")
     ex.add_argument("pod")
@@ -609,10 +612,26 @@ class Kubectl:
         self.client.create("horizontalpodautoscalers", hpa, ns)
         self.out.write(f"horizontalpodautoscalers/{name} autoscaled\n")
 
-    def logs(self, ns, pod_name, container="", follow=False) -> None:
+    def logs(self, ns, pod_name, container="", follow=False,
+             previous=False) -> None:
         """Stream from the node's kubelet via the pod log subresource
         (the kubelet log endpoint, server.go:242). Nodes that serve no
         kubelet endpoint fall back to a container-state summary."""
+        from ..core.errors import BadRequest
+        if follow and previous:
+            raise BadRequest("only one of follow (-f) or previous (-p) "
+                             "may be specified")
+        if previous:
+            # -p must error loudly when no previous instance exists —
+            # the state-summary fallback below would mask it
+            try:
+                self.out.write(self.client.pod_logs(
+                    pod_name, ns, container, previous=True))
+            except KeyError as e:
+                raise NotFound(
+                    f"previous terminated container for pod "
+                    f"{pod_name!r} not found") from e
+            return
         try:
             if follow:
                 for piece in self.client.pod_logs_stream(
@@ -1183,7 +1202,7 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
                         ns_args.cpu_percent)
         elif ns_args.command == "logs":
             k.logs(ns, ns_args.pod, ns_args.container,
-                   follow=ns_args.follow)
+                   follow=ns_args.follow, previous=ns_args.previous)
         elif ns_args.command == "exec":
             return k.exec_cmd(ns, ns_args.pod, ns_args.container,
                               ns_args.cmd, stdin=ns_args.stdin)

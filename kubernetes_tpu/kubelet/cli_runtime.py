@@ -380,10 +380,13 @@ class CliRuntime(Runtime):
         self.units.remove_unit(unit)
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
         """Logs ride the unit journal; the pod process tags each line
         with its app name, so per-container logs are a journal filter
         (ref: GetContainerLogs -> journalctl -u <unit>)."""
+        if previous:
+            raise KeyError('unit journals keep no previous generation')
         rec = self._record_for(pod_uid)
         if rec is None:
             raise KeyError(f"pod {pod_uid!r} not found")

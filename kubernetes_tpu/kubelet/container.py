@@ -69,9 +69,11 @@ class Runtime:
         raise NotImplementedError
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
         """(ref: kubecontainer.Runtime GetContainerLogs, served by the
-        kubelet's /containerLogs endpoint, server.go:242)"""
+        kubelet's /containerLogs endpoint, server.go:242; previous=True
+        is the last terminated instance — kubectl logs -p)"""
         raise NotImplementedError
 
     def exec_in_container(self, pod_uid: str, name: str,
@@ -145,7 +147,10 @@ class FakeRuntime(Runtime):
             self._pods.pop(pod_uid, None)
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
+        if previous:
+            raise KeyError('fake runtime keeps no previous logs')
         with self._lock:
             text = self._logs.get((pod_uid, name))
             if text is None:

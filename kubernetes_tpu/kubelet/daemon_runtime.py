@@ -210,7 +210,10 @@ class DaemonRuntime(Runtime):
             self._do("DELETE", f"/containers/{c['Id']}")
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
+        if previous:
+            raise KeyError('daemon adapter keeps no previous logs')
         found = self._find(pod_uid, name)
         if not found:
             raise KeyError(f"container {name!r} not found")

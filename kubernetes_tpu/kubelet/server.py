@@ -174,10 +174,13 @@ class KubeletServer:
         pod = self._find_pod(ns, pod_name)
         tail = int(query.get("tailLines", ["0"])[0])
         follow = query.get("follow", ["false"])[0] in ("true", "1")
-        if follow and hasattr(self.runtime, "container_log_path"):
+        previous = query.get("previous", ["false"])[0] in ("true", "1")
+        if follow and not previous \
+                and hasattr(self.runtime, "container_log_path"):
             return self._follow_logs(h, pod.metadata.uid, container, tail)
         text = self.runtime.get_container_logs(pod.metadata.uid, container,
-                                               tail_lines=tail)
+                                               tail_lines=tail,
+                                               previous=previous)
         self._raw(h, 200, text.encode(), "text/plain")
 
     def _follow_logs(self, h, uid: str, container: str,

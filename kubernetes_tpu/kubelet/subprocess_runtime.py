@@ -181,6 +181,14 @@ class SubprocessRuntime(Runtime):
             prior = self._procs.get((uid, container.name))
             restart_count = (prior.record.restart_count + 1
                              if prior is not None else 0)
+            if prior is not None and os.path.exists(log_path):
+                # a restart rotates the dead instance's log so `kubectl
+                # logs --previous` can reach it (the docker runtime
+                # keeps the terminated container's log the same way)
+                try:
+                    os.replace(log_path, log_path + ".prev")
+                except OSError:
+                    pass
             log = open(log_path, "ab")
             try:
                 # each container leads its own session so kill targets the
@@ -268,15 +276,22 @@ class SubprocessRuntime(Runtime):
                 pass
 
     def get_container_logs(self, pod_uid: str, name: str,
-                           tail_lines: int = 0) -> str:
+                           tail_lines: int = 0,
+                           previous: bool = False) -> str:
+        """previous=True reads the last terminated instance's rotated
+        log (kubectl logs -p; ref: server.go containerLogs ?previous)."""
         with self._lock:
             proc = self._procs.get((pod_uid, name))
         if proc is None:
             raise KeyError(f"container {name!r} not found")
+        path = proc.log_path + (".prev" if previous else "")
         try:
-            with open(proc.log_path, "rb") as f:
+            with open(path, "rb") as f:
                 text = f.read().decode(errors="replace")
         except FileNotFoundError:
+            if previous:
+                raise KeyError(
+                    f"no previous instance of container {name!r}")
             text = ""
         return tail_text(text, tail_lines)
 

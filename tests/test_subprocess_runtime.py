@@ -278,3 +278,56 @@ def test_pause_is_the_default_command(tmp_path):
         assert proc.wait(timeout=10) == 0  # clean exit, like pause.asm
     finally:
         rt.kill_pod("u-p")
+
+
+class TestPreviousLogs:
+    """Log rotation on restart + the ?previous read (kubectl logs -p;
+    ref: server.go containerLogs previous, docker's terminated-
+    container log retention)."""
+
+    def test_restart_rotates_and_previous_reads_old_instance(self,
+                                                             tmp_path):
+        import time as _time
+
+        from kubernetes_tpu.core import types as api
+        from kubernetes_tpu.kubelet.subprocess_runtime import \
+            SubprocessRuntime
+        rt = SubprocessRuntime(str(tmp_path))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="d", uid="u-r"),
+            spec=api.PodSpec(containers=[]))
+        c = api.Container(name="c", image="i",
+                          command=["/bin/sh", "-c", "echo first"])
+        rt.start_container(pod, c)
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                "first" not in rt.get_container_logs("u-r", "c"):
+            _time.sleep(0.05)
+        # restart with different output: the old log rotates
+        c2 = api.Container(name="c", image="i",
+                           command=["/bin/sh", "-c", "echo second"])
+        rt.start_container(pod, c2)
+        while _time.time() < deadline and \
+                "second" not in rt.get_container_logs("u-r", "c"):
+            _time.sleep(0.05)
+        assert "second" in rt.get_container_logs("u-r", "c")
+        assert "first" not in rt.get_container_logs("u-r", "c")
+        prev = rt.get_container_logs("u-r", "c", previous=True)
+        assert "first" in prev and "second" not in prev
+        rt.kill_pod("u-r")
+
+    def test_previous_without_restart_is_not_found(self, tmp_path):
+        import pytest
+
+        from kubernetes_tpu.core import types as api
+        from kubernetes_tpu.kubelet.subprocess_runtime import \
+            SubprocessRuntime
+        rt = SubprocessRuntime(str(tmp_path))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="d", uid="u-n"),
+            spec=api.PodSpec(containers=[]))
+        rt.start_container(pod, api.Container(
+            name="c", image="i", command=["/bin/sh", "-c", "sleep 5"]))
+        with pytest.raises(KeyError):
+            rt.get_container_logs("u-n", "c", previous=True)
+        rt.kill_pod("u-n")

@@ -374,6 +374,8 @@ class Container:
     liveness_probe: Optional[Probe] = None
     readiness_probe: Optional[Probe] = None
     lifecycle: Optional[Lifecycle] = None
+    # ref: pkg/api/types.go:804 + :153 TerminationMessagePathDefault
+    termination_message_path: str = "/dev/termination-log"
     # ref: pkg/api/types.go:813 Container.Stdin — only stdin:true
     # containers get a stdin pipe to attach to
     stdin: bool = False
@@ -388,6 +390,7 @@ class ContainerStateRunning:
 class ContainerStateTerminated:
     exit_code: int = 0
     reason: str = ""
+    message: str = ""  # the termination message (types.go Terminated)
     started_at: str = ""
     finished_at: str = ""
 

@@ -40,6 +40,7 @@ class RuntimeContainer:
     finished_at: float = 0.0
     exit_code: int = 0
     restart_count: int = 0
+    message: str = ""  # termination message read at exit
 
 
 @dataclass

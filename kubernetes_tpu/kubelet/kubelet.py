@@ -770,7 +770,8 @@ class Kubelet:
                     restart_count=rc.restart_count, container_id=rc.id,
                     state=api.ContainerState(
                         terminated=api.ContainerStateTerminated(
-                            exit_code=rc.exit_code))))
+                            exit_code=rc.exit_code,
+                            message=rc.message))))
         phase = self._pod_phase(pod, len(pod.spec.containers), n_running,
                                 n_succeeded, n_failed)
         all_ready = (phase == api.POD_RUNNING

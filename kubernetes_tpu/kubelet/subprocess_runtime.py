@@ -46,11 +46,13 @@ def _build_pause() -> Optional[str]:
 
 class _Proc:
     def __init__(self, popen: subprocess.Popen, record: RuntimeContainer,
-                 log_path: str, env: Dict[str, str]):
+                 log_path: str, env: Dict[str, str],
+                 term_path: str = ""):
         self.popen = popen
         self.record = record
         self.log_path = log_path
         self.env = env
+        self.term_path = term_path
 
 
 class ExecSession:
@@ -175,6 +177,24 @@ class SubprocessRuntime(Runtime):
             # only an explicit container env entry may override — an
             # inherited host RESOLV_CONF must not mask the pod's config
             env["RESOLV_CONF"] = resolv
+        # termination-message file (types.go:804 TerminationMessagePath):
+        # process pods share one filesystem, so the declared path maps to
+        # a per-container file exported as TERMINATION_MESSAGE_PATH —
+        # the container writes its dying words there and the kubelet
+        # reads them into terminated.message
+        term_path = ""
+        if container.termination_message_path:
+            term_path = os.path.join(
+                self.root_dir, f"{uid}-{container.name}-term.msg")
+            # an explicit container env entry wins — and the reader
+            # must follow the SAME path the container was told
+            term_path = env.setdefault("TERMINATION_MESSAGE_PATH",
+                                       term_path)
+            try:
+                # never inherit the previous instance's dying words
+                os.unlink(term_path)
+            except OSError:
+                pass
         log_path = os.path.join(
             self.root_dir, f"{uid}-{container.name}.log")
         with self._lock:
@@ -212,8 +232,8 @@ class SubprocessRuntime(Runtime):
                 id=f"proc://{popen.pid}", name=container.name,
                 image=container.image, state=ContainerState.RUNNING,
                 started_at=time.time(), restart_count=restart_count)
-            self._procs[(uid, container.name)] = _Proc(popen, record,
-                                                       log_path, env)
+            self._procs[(uid, container.name)] = _Proc(
+                popen, record, log_path, env, term_path)
             self._pods[uid] = pod
             return RuntimeContainer(**vars(record))
 
@@ -396,6 +416,16 @@ class SubprocessRuntime(Runtime):
         proc.record.finished_at = time.time()
         # negative returncode = killed by signal; report 128+N like docker
         proc.record.exit_code = rc if rc >= 0 else 128 - rc
+        if proc.term_path:
+            # the container's dying words (types.go:804; surfaced in
+            # terminated.message by the kubelet's status publisher)
+            try:
+                with open(proc.term_path, "r", errors="replace") as f:
+                    # bounded read: the file is untrusted container
+                    # output (the reference caps the message too)
+                    proc.record.message = f.read(4096).strip()
+            except OSError:
+                pass
 
     def _reap_locked(self) -> None:
         for proc in self._procs.values():

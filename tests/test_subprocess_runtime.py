@@ -331,3 +331,48 @@ class TestPreviousLogs:
         with pytest.raises(KeyError):
             rt.get_container_logs("u-n", "c", previous=True)
         rt.kill_pod("u-n")
+
+
+class TestTerminationMessage:
+    """(ref: pkg/api/types.go:804 TerminationMessagePath + :153 default
+    /dev/termination-log; process pods get a per-container file via
+    TERMINATION_MESSAGE_PATH, read into terminated.message at exit)"""
+
+    def test_dying_words_reach_pod_status(self, tmp_path):
+        import time as _time
+
+        from kubernetes_tpu.api.client import InProcClient
+        from kubernetes_tpu.api.registry import Registry
+        from kubernetes_tpu.core import types as api
+        from kubernetes_tpu.kubelet import Kubelet
+        from kubernetes_tpu.kubelet.subprocess_runtime import \
+            SubprocessRuntime
+        client = InProcClient(Registry())
+        rt = SubprocessRuntime(str(tmp_path))
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            pod = api.Pod(
+                metadata=api.ObjectMeta(name="p", namespace="default",
+                                        uid="u-t"),
+                spec=api.PodSpec(
+                    node_name="n1", restart_policy="Never",
+                    containers=[api.Container(
+                        name="c", image="i",
+                        command=["/bin/sh", "-c",
+                                 'echo "out of disk" > '
+                                 '"$TERMINATION_MESSAGE_PATH"; '
+                                 'exit 3'])]),
+                status=api.PodStatus(phase="Pending"))
+            client.create("pods", pod)
+            deadline = _time.time() + 20
+            msg = None
+            while _time.time() < deadline and not msg:
+                got = client.get("pods", "p", "default")
+                for cs in got.status.container_statuses:
+                    t = cs.state.terminated
+                    if t is not None and t.message:
+                        msg = (t.exit_code, t.message)
+                _time.sleep(0.1)
+            assert msg == (3, "out of disk"), msg
+        finally:
+            kubelet.stop()

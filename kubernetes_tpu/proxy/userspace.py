@@ -293,6 +293,10 @@ class UserspaceProxier:
         self.balancer = balancer or RoundRobinLoadBalancer()
         self.udp_idle_timeout = udp_idle_timeout
         self._proxies: Dict[Tuple[str, str, str], object] = {}
+        self._node_proxies: Dict[Tuple[str, str, str], object] = {}
+        self._last_wanted: Dict[Tuple[str, str, str],
+                                Tuple[str, int]] = {}
+        self._stopped = threading.Event()
         self._lock = threading.Lock()
         self._service_config = None
         self._endpoints_config = None
@@ -305,28 +309,67 @@ class UserspaceProxier:
     def on_service_update(self, services: List[api.Service]) -> None:
         # proto rides the wanted-map so a port that changes protocol
         # (proxier.go treats that as close-and-reopen) gets a fresh
-        # proxy of the right kind
-        wanted: Dict[Tuple[str, str, str], str] = {}
+        # proxy of the right kind; node_port rides it too — a NodePort
+        # service ALSO listens on its fixed node port (proxier.go
+        # openNodePort: the userspace mode claims host node ports)
+        wanted: Dict[Tuple[str, str, str], "tuple[str, int]"] = {}
         for svc in services:
             for port in svc.spec.ports:
                 key = (svc.metadata.namespace, svc.metadata.name,
                        port.name or "")
-                wanted[key] = (port.protocol or "TCP").upper()
+                wanted[key] = ((port.protocol or "TCP").upper(),
+                               port.node_port or 0)
                 self.balancer.set_session_affinity(
                     key, svc.spec.session_affinity == "ClientIP")
         with self._lock:
+            self._last_wanted = wanted
             for key, proxy in list(self._proxies.items()):
                 is_udp = isinstance(proxy, _UdpPortProxy)
                 want = wanted.get(key)
-                if want is None or (want == "UDP") != is_udp:
+                if want is None or (want[0] == "UDP") != is_udp:
                     self._proxies.pop(key).close()
-            for key, proto in wanted.items():
+            for key, node_proxy in list(self._node_proxies.items()):
+                is_udp = isinstance(node_proxy, _UdpPortProxy)
+                want = wanted.get(key)
+                if (want is None or want[1] != node_proxy.port
+                        or (want[0] == "UDP") != is_udp):
+                    # gone, renumbered, or protocol-flipped: close (the
+                    # reopen below gets the right kind)
+                    self._node_proxies.pop(key).close()
+            for key, (proto, node_port) in wanted.items():
                 if key not in self._proxies:
                     self._proxies[key] = (
                         _UdpPortProxy(self.balancer, key,
                                       idle_timeout=self.udp_idle_timeout)
                         if proto == "UDP"
                         else _PortProxy(self.balancer, key))
+            self._open_node_ports_locked()
+
+    def _open_node_ports_locked(self) -> None:
+        """Claim fixed node ports for NodePort services, both protocols
+        (proxier.go openNodePort); a failed bind is logged and retried
+        by the periodic timer — the config feed alone is change-driven
+        and would never revisit it."""
+        import logging
+        for key, (proto, node_port) in self._last_wanted.items():
+            if not node_port or key in self._node_proxies:
+                continue
+            try:
+                self._node_proxies[key] = (
+                    _UdpPortProxy(self.balancer, key, port=node_port,
+                                  idle_timeout=self.udp_idle_timeout)
+                    if proto == "UDP"
+                    else _PortProxy(self.balancer, key, port=node_port))
+            except OSError as e:
+                logging.warning("node port %d for %s: %s", node_port,
+                                "/".join(key[:2]), e)
+
+    def _node_port_retry_loop(self) -> None:
+        while not self._stopped.wait(10.0):
+            with self._lock:
+                if any(np and k not in self._node_proxies
+                       for k, (_, np) in self._last_wanted.items()):
+                    self._open_node_ports_locked()
 
     def port_for(self, namespace: str, name: str, port_name: str = ""
                  ) -> Optional[int]:
@@ -335,14 +378,18 @@ class UserspaceProxier:
             return proxy.port if proxy else None
 
     def run(self) -> "UserspaceProxier":
-        """Start the watch-driven feeds (requires a client)."""
+        """Start the watch-driven feeds (requires a client) and the
+        node-port bind retry timer."""
         if self._service_config:
             self._service_config.start()
         if self._endpoints_config:
             self._endpoints_config.start()
+        threading.Thread(target=self._node_port_retry_loop,
+                         daemon=True, name="nodeport-retry").start()
         return self
 
     def stop(self) -> None:
+        self._stopped.set()
         if self._service_config:
             self._service_config.stop()
         if self._endpoints_config:
@@ -351,3 +398,6 @@ class UserspaceProxier:
             for proxy in self._proxies.values():
                 proxy.close()
             self._proxies.clear()
+            for proxy in self._node_proxies.values():
+                proxy.close()
+            self._node_proxies.clear()

@@ -413,3 +413,128 @@ class TestUdpConntrackSemantics:
                 p.stop()
         finally:
             srv.close()
+
+
+def test_userspace_nodeport_listener():
+    """A NodePort service ALSO listens on its fixed node port
+    (proxier.go openNodePort for the userspace mode)."""
+    import socket as _socket
+
+    from kubernetes_tpu.proxy.userspace import UserspaceProxier
+
+    # a backend echo server
+    backend = _socket.socket()
+    backend.bind(("127.0.0.1", 0))
+    backend.listen(8)
+    bport = backend.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = backend.accept()
+            except OSError:
+                return
+            data = conn.recv(100)
+            conn.sendall(b"np:" + data)
+            conn.close()
+
+    import threading as _threading
+    _threading.Thread(target=serve, daemon=True).start()
+
+    # pick a free node port
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    node_port = probe.getsockname()[1]
+    probe.close()
+
+    p = UserspaceProxier()
+    try:
+        p.balancer.on_endpoints_update([api.Endpoints(
+            metadata=api.ObjectMeta(name="svc", namespace="default"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                ports=[api.EndpointPort(name="http", port=bport)])])])
+        p.on_service_update([api.Service(
+            metadata=api.ObjectMeta(name="svc", namespace="default"),
+            spec=api.ServiceSpec(type="NodePort", ports=[
+                api.ServicePort(name="http", port=80,
+                                node_port=node_port)]))])
+        with _socket.create_connection(("127.0.0.1", node_port),
+                                       timeout=5) as c:
+            c.sendall(b"hello")
+            c.shutdown(_socket.SHUT_WR)
+            got = b""
+            while True:
+                piece = c.recv(100)
+                if not piece:
+                    break
+                got += piece
+        assert got == b"np:hello"
+        # removing the node port closes the listener
+        p.on_service_update([api.Service(
+            metadata=api.ObjectMeta(name="svc", namespace="default"),
+            spec=api.ServiceSpec(ports=[
+                api.ServicePort(name="http", port=80)]))])
+        import time as _time
+        deadline = _time.time() + 5
+        refused = False
+        while _time.time() < deadline and not refused:
+            try:
+                _socket.create_connection(("127.0.0.1", node_port),
+                                          timeout=1).close()
+                _time.sleep(0.05)
+            except OSError:
+                refused = True
+        assert refused
+    finally:
+        p.stop()
+        backend.close()
+
+
+def test_userspace_udp_nodeport_listener():
+    """UDP NodePort services claim their node port too (proxier.go
+    openNodePort covers both protocols)."""
+    import socket as _socket
+    import threading as _threading
+
+    from kubernetes_tpu.proxy.userspace import UserspaceProxier
+
+    backend = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    backend.bind(("127.0.0.1", 0))
+    bport = backend.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                data, addr = backend.recvfrom(100)
+            except OSError:
+                return
+            backend.sendto(b"udp:" + data, addr)
+
+    _threading.Thread(target=serve, daemon=True).start()
+    probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    probe.bind(("127.0.0.1", 0))
+    node_port = probe.getsockname()[1]
+    probe.close()
+
+    p = UserspaceProxier(udp_idle_timeout=5.0)
+    try:
+        p.balancer.on_endpoints_update([api.Endpoints(
+            metadata=api.ObjectMeta(name="dns", namespace="default"),
+            subsets=[api.EndpointSubset(
+                addresses=[api.EndpointAddress(ip="127.0.0.1")],
+                ports=[api.EndpointPort(name="dns", port=bport,
+                                        protocol="UDP")])])])
+        p.on_service_update([api.Service(
+            metadata=api.ObjectMeta(name="dns", namespace="default"),
+            spec=api.ServiceSpec(type="NodePort", ports=[
+                api.ServicePort(name="dns", port=53, protocol="UDP",
+                                node_port=node_port)]))])
+        with _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM) as c:
+            c.settimeout(5.0)
+            c.sendto(b"query", ("127.0.0.1", node_port))
+            got, _ = c.recvfrom(100)
+        assert got == b"udp:query"
+    finally:
+        p.stop()
+        backend.close()

@@ -85,6 +85,16 @@ def _start_healthz(component: str):
         return lambda: None
 
 
+def _make_recorder(client, component: str, host: str = ""):
+    """One event recorder posting to the apiserver (the per-binary
+    EventBroadcaster wiring every reference component repeats)."""
+    from .api.record import ClientEventSink, EventBroadcaster
+    from .core import types as api
+    return EventBroadcaster().start_recording_to_sink(
+        ClientEventSink(client)).new_recorder(
+        api.EventSource(component=component, host=host))
+
+
 def _serve_until_signal(ready_line: str, stop_fns) -> int:
     """Print the READY line, then park until SIGTERM/SIGINT and unwind."""
     stop_event = threading.Event()
@@ -203,7 +213,11 @@ def run_scheduler(argv: List[str]) -> int:
 
     _wait_for_master(args.master)
     client = HttpClient(args.master)
-    factory = ConfigFactory(client, rate_limit=not args.no_rate_limit).start()
+    # FailedScheduling and friends as first-class events (the reference
+    # scheduler's recorder, scheduler.go Error func)
+    factory = ConfigFactory(client, rate_limit=not args.no_rate_limit,
+                            recorder=_make_recorder(
+                                client, "scheduler")).start()
 
     policy = None
     if args.policy_config_file:
@@ -245,8 +259,11 @@ def run_controller_manager(argv: List[str]) -> int:
     from .controllers.manager import ControllerManager
 
     _wait_for_master(args.master)
+    client = HttpClient(args.master)
+    # controllers record first-class events (SuccessfulCreate, eviction
+    # notices, ...) like the reference's per-controller recorders
     manager = ControllerManager(
-        HttpClient(args.master),
+        client, recorder=_make_recorder(client, "controller-manager"),
         allocate_node_cidrs=args.allocate_node_cidrs,
         cluster_cidr=args.cluster_cidr).run()
     return _serve_until_signal(
@@ -292,8 +309,6 @@ def run_kubelet(argv: List[str]) -> int:
     args = p.parse_args(argv)
 
     from .api.client import HttpClient
-    from .api.record import ClientEventSink, EventBroadcaster
-    from .core import types as api
     from .core.quantity import parse_quantity
     from .kubelet import Kubelet
     from .kubelet.bandwidth import TCShaper
@@ -306,10 +321,7 @@ def run_kubelet(argv: List[str]) -> int:
 
     _wait_for_master(args.master)
     client = HttpClient(args.master)
-    broadcaster = EventBroadcaster().start_recording_to_sink(
-        ClientEventSink(client))
-    recorder = broadcaster.new_recorder(api.EventSource(
-        component="kubelet", host=args.name))
+    recorder = _make_recorder(client, "kubelet", host=args.name)
     runtime = SubprocessRuntime(args.root_dir or None)
     volume_root = os.path.join(runtime.root_dir, "volumes")
 

@@ -1,0 +1,124 @@
+"""The operator's first session, end-to-end as real processes: the
+README "Run it" block — apiserver + scheduler + controller-manager +
+hollow fleet, driven purely through kubectl (run → get → scale →
+expose → describe → delete), every object flowing through the full
+watch/schedule/bind/confirm machinery (ref: the cmd/integration
+single-binary smoke test's role, integration.go:72-102, done across
+real process boundaries)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+       "PYTHONFAULTHANDLER": "1"}
+
+
+def spawn(*args):
+    return subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=ENV)
+
+
+def kubectl(url, *args):
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu", "kubectl", "-s", url,
+         *args], capture_output=True, text=True, cwd=REPO, env=ENV,
+        timeout=60)
+    return out.returncode, out.stdout, out.stderr
+
+
+def wait_until(cond, timeout=90.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.5)
+    return cond()
+
+
+@pytest.mark.slow
+def test_operator_journey():
+    procs = []
+    try:
+        apiserver = spawn("apiserver", "--port", "0")
+        procs.append(apiserver)
+        ready = apiserver.stdout.readline()
+        assert " ready" in ready, f"apiserver died before READY: {ready!r}"
+        url = ready.split()[-1]
+        for component in (
+                spawn("scheduler", "--master", url, "--mode", "batch",
+                      "--no-rate-limit"),
+                spawn("controller-manager", "--master", url),
+                spawn("hollow-fleet", "--master", url,
+                      "--num-nodes", "5", "--heartbeat-interval", "30")):
+            procs.append(component)
+            assert " ready" in component.stdout.readline()
+
+        # run: an RC materializes pods, the scheduler binds them, the
+        # fleet confirms Running
+        rc, _, err = kubectl(url, "run", "web", "--image=nginx",
+                             "--replicas=3")
+        assert rc == 0, err
+
+        def running():
+            _, out, _ = kubectl(url, "get", "pods", "-l", "run=web")
+            return out.count("Running") == 3
+
+        assert wait_until(running), kubectl(url, "get", "pods")[1]
+
+        # scale up through the CLI scaler
+        rc, _, err = kubectl(url, "scale", "rc", "web", "--replicas=5")
+        assert rc == 0, err
+        assert wait_until(lambda: kubectl(
+            url, "get", "pods", "-l", "run=web")[1].count("Running")
+            == 5)
+
+        # expose: a service + endpoints joined by the controllers
+        rc, _, err = kubectl(url, "expose", "rc", "web", "--port=80")
+        assert rc == 0, err
+
+        def endpoints_ready():
+            _, out, _ = kubectl(url, "get", "endpoints", "web",
+                                "-o", "json")
+            return out.count('"ip"') >= 5
+
+        assert wait_until(endpoints_ready)
+
+        # describe shows the service with its cluster IP
+        rc, out, _ = kubectl(url, "describe", "service", "web")
+        assert rc == 0 and "10.0.0." in out
+
+        # the bootstrapped master service is visible too
+        rc, out, _ = kubectl(url, "get", "services")
+        assert rc == 0 and "kubernetes" in out
+
+        # events tell the story (scheduler + RC manager recorded them)
+        rc, out, _ = kubectl(url, "get", "events")
+        assert rc == 0 and "SuccessfulCreate" in out
+
+        # teardown: stop scales down and deletes
+        rc, _, err = kubectl(url, "stop", "rc", "web")
+        assert rc == 0, err
+        assert wait_until(lambda: "web" not in kubectl(
+            url, "get", "pods")[1])
+    finally:
+        # teardown must never bury a body assertion: kill stragglers
+        # and report them without raising (pytest would otherwise show
+        # the teardown failure instead of the informative one)
+        for proc in reversed(procs):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in reversed(procs):
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                print(f"teardown: {proc.args} needed SIGKILL",
+                      file=sys.stderr)

@@ -22,6 +22,7 @@ interactive-stream upgrade is out of the TPU-native scope.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import threading
 import urllib.parse
@@ -67,7 +68,8 @@ class KubeletServer:
                  container_manager: Optional[ContainerManager] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  scheme: Scheme = default_scheme,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 node_log_dir: str = "/var/log"):
         self.node_name = node_name
         self.pod_provider = pod_provider
         self.runtime = runtime
@@ -76,6 +78,8 @@ class KubeletServer:
         self.cm = container_manager or stub_container_manager()
         self.scheme = scheme
         self.metrics = metrics or global_metrics
+        # /logs/ root (server.go:303 serves /var/log)
+        self.node_log_dir = node_log_dir
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,6 +89,10 @@ class KubeletServer:
                 pass
 
             def do_GET(self):
+                server.handle(self)
+
+            def do_POST(self):
+                # the reference registers /run for POST (server.go:247)
                 server.handle(self)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
@@ -147,6 +155,10 @@ class KubeletServer:
                 return self._tunnel(h, query)
             if path.startswith("/attach/"):
                 return self._attach(h, path, query)
+            if path.startswith("/run/"):
+                return self._run(h, path, query)
+            if path == "/logs" or path.startswith("/logs/"):
+                return self._node_logs(h, path)
             self._raw(h, 404, f"not found: {path}".encode(), "text/plain")
         except KeyError as e:
             self._raw(h, 404, str(e).encode(), "text/plain")
@@ -168,6 +180,54 @@ class KubeletServer:
         if len(parts) != 3 or not all(parts):
             raise KeyError(f"want {prefix}{{ns}}/{{pod}}/{{container}}")
         return parts  # ns, pod, container
+
+    def _run(self, h, path: str, query: dict) -> None:
+        """GET/POST /run/{ns}/{pod}/{container}?cmd=a&cmd=b — run one
+        command in a running container, answer its combined output
+        (ref: server.go:247 /run -> RunInContainer; the reference also
+        accepts cmd as a single space-split param)."""
+        ns, pod_name, container = self._split_target(path, "/run/")
+        pod = self._find_pod(ns, pod_name)
+        cmd = query.get("cmd", [])
+        if len(cmd) == 1 and " " in cmd[0]:
+            cmd = cmd[0].split()
+        if not cmd:
+            return self._raw(h, 400, b"missing ?cmd=", "text/plain")
+        code, output = self.runtime.exec_in_container(
+            pod.metadata.uid, container, cmd)
+        self._raw(h, 200 if code == 0 else 500, output.encode(),
+                  "text/plain")
+
+    def _node_logs(self, h, path: str) -> None:
+        """GET /logs/ — browse the node's log directory (ref:
+        server.go:303 /logs/ serving /var/log). Directory listings are
+        plain text; files stream as-is. Traversal is clamped to the
+        root."""
+        rel = path[len("/logs"):].lstrip("/")
+        root = os.path.realpath(self.node_log_dir)
+        target = os.path.realpath(os.path.join(root, rel))
+        if not (target == root or target.startswith(root + os.sep)):
+            return self._raw(h, 403, b"forbidden", "text/plain")
+        if os.path.isdir(target):
+            entries = sorted(os.listdir(target))
+            body = "".join(
+                e + ("/" if os.path.isdir(os.path.join(target, e))
+                     else "") + "\n" for e in entries)
+            return self._raw(h, 200, body.encode(), "text/plain")
+        try:
+            size = os.path.getsize(target)
+            f = open(target, "rb")
+        except OSError:
+            return self._raw(h, 404, b"no such log", "text/plain")
+        with f:
+            # stream in chunks: node logs can be gigabytes and one
+            # slurped bytes object per request would balloon RSS
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain")
+            h.send_header("Content-Length", str(size))
+            h.end_headers()
+            import shutil
+            shutil.copyfileobj(f, h.wfile, length=65536)
 
     def _container_logs(self, h, path: str, query: dict) -> None:
         ns, pod_name, container = self._split_target(path, "/containerLogs/")

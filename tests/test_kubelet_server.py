@@ -225,3 +225,47 @@ def test_kubectl_exec_through_relay():
     finally:
         kubelet.stop()
         apiserver.stop()
+
+
+def test_run_and_node_logs_endpoints(tmp_path):
+    """/run one-shot command + /logs/ node log browser
+    (ref: server.go:247 /run, :303 /logs/)."""
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.kubelet.container import FakeRuntime
+    from kubernetes_tpu.kubelet.server import KubeletServer
+
+    (tmp_path / "syslog").write_text("node boot ok\n")
+    (tmp_path / "pods").mkdir()
+    runtime = FakeRuntime()
+    pod = api.Pod(metadata=api.ObjectMeta(name="p", namespace="default",
+                                          uid="u-run"),
+                  spec=api.PodSpec(containers=[api.Container(
+                      name="c", image="i")]))
+    runtime.start_container(pod, pod.spec.containers[0])
+    srv = KubeletServer("n1", lambda: [pod], runtime, lambda: {},
+                        node_log_dir=str(tmp_path)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        out = urllib.request.urlopen(
+            base + "/run/default/p/c?cmd=uptime&cmd=-p",
+            timeout=5).read().decode()
+        assert "uptime -p" in out  # FakeRuntime echoes the exec argv
+        listing = urllib.request.urlopen(
+            base + "/logs/", timeout=5).read().decode()
+        assert "syslog" in listing and "pods/" in listing
+        body = urllib.request.urlopen(
+            base + "/logs/syslog", timeout=5).read().decode()
+        assert body == "node boot ok\n"
+        # traversal is clamped
+        try:
+            urllib.request.urlopen(base + "/logs/../../etc/passwd",
+                                   timeout=5)
+            raised = None
+        except urllib.error.HTTPError as e:
+            raised = e.code
+        assert raised in (403, 404)
+    finally:
+        srv.stop()

@@ -28,6 +28,7 @@ raw runs recorded).
 """
 
 import argparse
+import calendar
 import json
 import os
 import subprocess
@@ -135,12 +136,26 @@ def _tpu_section():
     except OSError:
         pass
     out["probes"] = probes
+    # staleness is relative to the LATEST watcher instance; a mid-round
+    # driver restart starts a fresh watcher, so a same-round capture
+    # from before the restart reads "stale" — the age fields
+    # disambiguate (hours-old ≠ last-round-old)
+    def _age_s(ts: str):
+        try:
+            return round(time.time() - calendar.timegm(
+                time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")), 1)
+        except (ValueError, TypeError):
+            return None
+
     if out["evidence"] is not None and probes["watcher_start_ts"]:
         out["evidence_stale"] = (
             out["evidence"].get("ts_start", "") < probes["watcher_start_ts"])
+        out["evidence_age_s"] = _age_s(
+            out["evidence"].get("ts_start", ""))
     if out["best"] is not None and probes["watcher_start_ts"]:
         out["best_stale"] = (
             out["best"].get("ts_updated", "") < probes["watcher_start_ts"])
+        out["best_age_s"] = _age_s(out["best"].get("ts_updated", ""))
     return out
 
 

@@ -302,6 +302,9 @@ def run_kubelet(argv: List[str]) -> int:
                    default="/usr/libexec/kubernetes/kubelet-plugins"
                            "/net/exec/",
                    help="exec plugin directory (exec.go contract)")
+    p.add_argument("--node-log-dir", default="/var/log",
+                   help="directory served at the kubelet's /logs/ "
+                        "(server.go:303); empty disables")
     p.add_argument("--shaper-interface", default="",
                    help="enable tc bandwidth shaping on this interface "
                         "(kubernetes.io/{in,e}gress-bandwidth pod "
@@ -348,7 +351,8 @@ def run_kubelet(argv: List[str]) -> int:
         shaper=(TCShaper(args.shaper_interface)
                 if args.shaper_interface else None))
     server = KubeletServer(args.name, kubelet.get_pods, runtime,
-                           capacity, port=args.port).start()
+                           capacity, port=args.port,
+                           node_log_dir=args.node_log_dir).start()
     registration = NodeRegistration(
         client, args.name, capacity,
         daemon_port=lambda: server.port, host=server.host,

@@ -69,7 +69,7 @@ class KubeletServer:
                  host: str = "127.0.0.1", port: int = 0,
                  scheme: Scheme = default_scheme,
                  metrics: Optional[MetricsRegistry] = None,
-                 node_log_dir: str = "/var/log"):
+                 node_log_dir: str = ""):
         self.node_name = node_name
         self.pod_provider = pod_provider
         self.runtime = runtime
@@ -78,7 +78,9 @@ class KubeletServer:
         self.cm = container_manager or stub_container_manager()
         self.scheme = scheme
         self.metrics = metrics or global_metrics
-        # /logs/ root (server.go:303 serves /var/log)
+        # /logs/ root (server.go:303 serves /var/log). Opt-in: hollow
+        # nodes and tests must not silently serve the real host's logs
+        # cluster-wide through the node proxy
         self.node_log_dir = node_log_dir
         server = self
 
@@ -92,7 +94,15 @@ class KubeletServer:
                 server.handle(self)
 
             def do_POST(self):
-                # the reference registers /run for POST (server.go:247)
+                # the reference registers /run for POST (server.go:247).
+                # Drain the body first: unread bytes would be parsed as
+                # the NEXT request line on this keep-alive connection
+                length = int(self.headers.get("Content-Length") or 0)
+                while length > 0:
+                    chunk = self.rfile.read(min(length, 65536))
+                    if not chunk:
+                        break
+                    length -= len(chunk)
                 server.handle(self)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
@@ -203,6 +213,9 @@ class KubeletServer:
         server.go:303 /logs/ serving /var/log). Directory listings are
         plain text; files stream as-is. Traversal is clamped to the
         root."""
+        if not self.node_log_dir:
+            return self._raw(h, 404, b"node log serving disabled",
+                             "text/plain")
         rel = path[len("/logs"):].lstrip("/")
         root = os.path.realpath(self.node_log_dir)
         target = os.path.realpath(os.path.join(root, rel))
@@ -221,13 +234,28 @@ class KubeletServer:
             return self._raw(h, 404, b"no such log", "text/plain")
         with f:
             # stream in chunks: node logs can be gigabytes and one
-            # slurped bytes object per request would balloon RSS
+            # slurped bytes object per request would balloon RSS.
+            # Copy EXACTLY size bytes — a concurrently growing file
+            # must not overrun the declared Content-Length and desync
+            # the keep-alive connection — and a mid-stream read error
+            # can only drop the connection, never write a second
+            # response into the body
             h.send_response(200)
             h.send_header("Content-Type", "text/plain")
             h.send_header("Content-Length", str(size))
             h.end_headers()
-            import shutil
-            shutil.copyfileobj(f, h.wfile, length=65536)
+            remaining = size
+            try:
+                while remaining > 0:
+                    chunk = f.read(min(remaining, 65536))
+                    if not chunk:
+                        break
+                    h.wfile.write(chunk)
+                    remaining -= len(chunk)
+            except OSError:
+                pass
+            if remaining:
+                h.close_connection = True  # short body: can't reuse
 
     def _container_logs(self, h, path: str, query: dict) -> None:
         ns, pod_name, container = self._split_target(path, "/containerLogs/")

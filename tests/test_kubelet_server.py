@@ -259,6 +259,18 @@ def test_run_and_node_logs_endpoints(tmp_path):
         body = urllib.request.urlopen(
             base + "/logs/syslog", timeout=5).read().decode()
         assert body == "node boot ok\n"
+        # hollow/default servers keep /logs off (no real-host leak)
+        from kubernetes_tpu.kubelet.server import KubeletServer as KS
+        off = KS("n2", lambda: [], runtime, lambda: {}).start()
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{off.port}/logs/", timeout=5)
+            disabled = None
+        except urllib.error.HTTPError as e:
+            disabled = e.code
+        finally:
+            off.stop()
+        assert disabled == 404
         # traversal is clamped
         try:
             urllib.request.urlopen(base + "/logs/../../etc/passwd",

@@ -28,6 +28,7 @@ def describe_pod(pod: api.Pod, events) -> str:
     _kv(out, "Status", pod.status.phase)
     _kv(out, "IP", pod.status.pod_ip or "<none>")
     out.append("Containers:")
+    statuses = {cs.name: cs for cs in pod.status.container_statuses}
     for c in pod.spec.containers:
         out.append(f"  {c.name}:")
         out.append(f"    Image:\t{c.image}")
@@ -36,6 +37,30 @@ def describe_pod(pod: api.Pod, events) -> str:
             out.append("    Requests:")
             for r, q in sorted(req.items()):
                 out.append(f"      {r}:\t{q}")
+        cs = statuses.get(c.name)
+        if cs is not None:
+            # the state block the reference describer prints, incl.
+            # the termination message and exit code
+            if cs.state.running is not None:
+                out.append("    State:\tRunning")
+                if cs.state.running.started_at:
+                    out.append(f"      Started:\t"
+                               f"{cs.state.running.started_at}")
+            elif cs.state.terminated is not None:
+                t = cs.state.terminated
+                out.append("    State:\tTerminated")
+                out.append(f"      Exit Code:\t{t.exit_code}")
+                if t.reason:
+                    out.append(f"      Reason:\t{t.reason}")
+                if t.message:
+                    out.append(f"      Message:\t{t.message}")
+            elif cs.state.waiting is not None:
+                out.append("    State:\tWaiting")
+                if cs.state.waiting.reason:
+                    out.append(f"      Reason:\t"
+                               f"{cs.state.waiting.reason}")
+            out.append(f"    Ready:\t{cs.ready}")
+            out.append(f"    Restart Count:\t{cs.restart_count}")
     _append_events(out, events)
     return "\n".join(out)
 

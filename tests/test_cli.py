@@ -657,3 +657,20 @@ class TestSortBy:
         assert code == 0
         assert [l.split("/")[-1] for l in out.strip().splitlines()] == \
             ["a-unlabeled", "b-labeled"]
+
+
+def test_describe_pod_shows_container_state_and_message(cluster):
+    _, client = cluster
+    pod = mkpod("dead", phase="Failed")
+    pod.status.container_statuses = [api.ContainerStatus(
+        name="c", ready=False, restart_count=2,
+        state=api.ContainerState(
+            terminated=api.ContainerStateTerminated(
+                exit_code=7, message="fatal: cache corrupt")))]
+    client.create("pods", pod)
+    code, out, _ = run_cli(client, "describe", "pod", "dead")
+    assert code == 0
+    assert "Terminated" in out
+    assert "Exit Code:\t7" in out
+    assert "fatal: cache corrupt" in out
+    assert "Restart Count:\t2" in out

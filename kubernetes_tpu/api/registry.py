@@ -499,7 +499,10 @@ class Registry:
             # must not slow a GET of etcd-0
             return self._component_statuses([name])[0]
         info = self.info(resource)
-        ns = namespace or ("default" if info.namespaced else "")
+        # cluster-scoped resources ignore a caller-supplied namespace
+        # (the CLI defaults one for every request; HttpClient._url
+        # drops it, the in-proc path must too)
+        ns = (namespace or "default") if info.namespaced else ""
         try:
             return self.store.get(self.key(resource, ns, name))
         except NotFound:
@@ -541,6 +544,8 @@ class Registry:
              label_selector: str = "", field_selector: str = ""
              ) -> Tuple[List[Any], int]:
         info = self.info(resource)
+        if not info.namespaced:
+            namespace = ""  # cluster-scoped: a defaulted ns must not filter
         lsel = labelspkg.parse(label_selector) if label_selector else None
         fsel = fieldspkg.parse(field_selector) if field_selector else None
 
@@ -663,14 +668,14 @@ class Registry:
         (GuaranteedUpdate semantics, etcd_helper.go:449), for callers that
         must be atomic against concurrent writers (quota admission)."""
         info = self.info(resource)
-        ns = namespace or ("default" if info.namespaced else "")
+        ns = (namespace or "default") if info.namespaced else ""
         return self.store.guaranteed_update(self.key(resource, ns, name), fn)
 
     def delete(self, resource: str, name: str, namespace: str = "") -> Any:
         if resource == "componentstatuses":
             raise MethodNotSupported("componentstatuses is read-only")
         info = self.info(resource)
-        ns = namespace or ("default" if info.namespaced else "")
+        ns = (namespace or "default") if info.namespaced else ""
         if self.admission:
             self.admission("DELETE", resource, None, ns, name)
         if resource == "namespaces":
@@ -796,6 +801,8 @@ class Registry:
                 if fsel is not None and not fsel.matches(fields_of(o)):
                     return False
                 return True
+        if not self.info(resource).namespaced:
+            namespace = ""  # cluster-scoped (same rule as list)
         return self.store.watch(self.prefix(resource, namespace), since_rev,
                                 predicate=pred)
 

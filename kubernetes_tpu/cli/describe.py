@@ -50,6 +50,10 @@ def describe_pod(pod: api.Pod, events) -> str:
                 t = cs.state.terminated
                 out.append("    State:\tTerminated")
                 out.append(f"      Exit Code:\t{t.exit_code}")
+                if t.started_at:
+                    out.append(f"      Started:\t{t.started_at}")
+                if t.finished_at:
+                    out.append(f"      Finished:\t{t.finished_at}")
                 if t.reason:
                     out.append(f"      Reason:\t{t.reason}")
                 if t.message:

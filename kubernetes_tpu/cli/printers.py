@@ -130,7 +130,7 @@ def _generic_row(obj: Any) -> List[str]:
 
 
 def print_table(objs: List[Any], scheme, out,
-                with_namespace=False) -> None:
+                with_namespace=False, wide=False) -> None:
     """One table section per kind, kinds in first-seen order (kubectl
     prints `get pods,svc` as stacked per-kind tables)."""
     groups: Dict[str, List[Any]] = {}
@@ -144,17 +144,34 @@ def print_table(objs: List[Any], scheme, out,
     for i, kind in enumerate(order):
         if i:
             out.write("\n")
-        _print_kind_table(kind, groups[kind], out, with_namespace)
+        _print_kind_table(kind, groups[kind], out, with_namespace, wide)
+
+
+# -o wide extras per kind (resource_printer.go's wide columns)
+WIDE_COLUMNS = {
+    "Pod": (["IP", "NODE"],
+            lambda p: [p.status.pod_ip or "<none>",
+                       p.spec.node_name or "<none>"]),
+    "Node": (["ADDRESSES", "VERSION"],
+             lambda n: [",".join(a.address for a in n.status.addresses)
+                        or "<none>",
+                        n.status.node_info.kubelet_version or "<none>"]),
+}
 
 
 def _print_kind_table(kind: str, objs: List[Any], out,
-                      with_namespace: bool) -> None:
+                      with_namespace: bool, wide: bool = False) -> None:
     headers, fn = COLUMNS.get(kind, (["NAME", "AGE"], _generic_row))
+    wide_headers, wide_fn = (WIDE_COLUMNS.get(kind, ([], None))
+                             if wide else ([], None))
+    headers = list(headers) + wide_headers
     if with_namespace:
         headers = ["NAMESPACE"] + headers
     rows = []
     for obj in objs:
         row = fn(obj)
+        if wide_fn is not None:
+            row = row + wide_fn(obj)
         if with_namespace:
             row = [obj.metadata.namespace] + row
         rows.append(row)
@@ -224,7 +241,8 @@ def jsonpath_get(data: Any, path: str) -> Any:
 
 def print_objects(objs: List[Any], output: str, scheme, out,
                   resource_names=None, with_namespace=False) -> None:
-    """output: '' (table) | json | yaml | name | jsonpath=<expr>"""
+    """output: '' (table) | wide | json | yaml | name |
+    jsonpath=<expr> | custom-columns=<spec>"""
     if output == "json":
         if len(objs) == 1:
             payload = scheme.encode_dict(objs[0])
@@ -253,7 +271,8 @@ def print_objects(objs: List[Any], output: str, scheme, out,
         print_custom_columns(objs, output[len("custom-columns="):],
                              scheme, out)
     else:
-        print_table(objs, scheme, out, with_namespace=with_namespace)
+        print_table(objs, scheme, out, with_namespace=with_namespace,
+                    wide=(output == "wide"))
 
 
 def print_custom_columns(objs: List[Any], spec: str, scheme,

@@ -771,7 +771,11 @@ class Kubelet:
                     state=api.ContainerState(
                         terminated=api.ContainerStateTerminated(
                             exit_code=rc.exit_code,
-                            message=rc.message))))
+                            message=rc.message,
+                            started_at=(_rfc3339(rc.started_at)
+                                        if rc.started_at else ""),
+                            finished_at=(_rfc3339(rc.finished_at)
+                                         if rc.finished_at else "")))))
         phase = self._pod_phase(pod, len(pod.spec.containers), n_running,
                                 n_succeeded, n_failed)
         all_ready = (phase == api.POD_RUNNING

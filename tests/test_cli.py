@@ -674,3 +674,27 @@ def test_describe_pod_shows_container_state_and_message(cluster):
     assert "Exit Code:\t7" in out
     assert "fatal: cache corrupt" in out
     assert "Restart Count:\t2" in out
+
+
+def test_get_output_wide(cluster):
+    _, client = cluster
+    pod = mkpod("w1")
+    pod.status.pod_ip = "10.244.9.9"
+    client.create("pods", pod)
+    code, out, _ = run_cli(client, "get", "pods", "-o", "wide")
+    assert code == 0
+    head, row = out.strip().splitlines()[:2]
+    assert "IP" in head and "NODE" in head
+    assert "10.244.9.9" in row and "n1" in row
+
+
+def test_cluster_scoped_resources_ignore_defaulted_namespace(cluster):
+    # `kubectl get nodes` defaults -n default like every command; the
+    # cluster-scoped path must not namespace-filter it away
+    _, client = cluster
+    client.create("nodes", api.Node(
+        metadata=api.ObjectMeta(name="n-scope")))
+    code, out, _ = run_cli(client, "get", "nodes")
+    assert code == 0 and "n-scope" in out
+    code, out, _ = run_cli(client, "describe", "node", "n-scope")
+    assert code == 0 and "n-scope" in out

@@ -239,3 +239,23 @@ def test_pv_pvc_crud():
     assert len(got) == 1
     r.delete("persistentvolumeclaims", "c1", "default")
     r.delete("persistentvolumes", "pv1")
+
+
+def test_node_port_out_of_range_rejected():
+    """An explicit nodePort outside --service-node-port-range fails
+    validation (observed as a 422 over HTTP; ref: the port allocator's
+    30000-32767 default)."""
+    registry = Registry()
+    with pytest.raises(Invalid):
+        registry.create("services",
+                        svc("bad", stype="NodePort", node_port=20000),
+                        "default")
+    # in-range is accepted AND reserved: a second claim must fail
+    created = registry.create(
+        "services", svc("ok", stype="NodePort", node_port=30500),
+        "default")
+    assert created.spec.ports[0].node_port == 30500
+    with pytest.raises(Invalid):
+        registry.create("services",
+                        svc("clash", stype="NodePort", node_port=30500),
+                        "default")

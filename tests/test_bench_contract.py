@@ -1,0 +1,55 @@
+"""The driver-facing bench.py contract: its helper functions must not
+rot between rounds (the driver runs `python bench.py` unattended and
+records the one JSON line; a broken helper would surface only as a
+missing round artifact)."""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_engine_only_small_shape():
+    rate, bound = bench.engine_only(50, 100)
+    assert bound == 100
+    assert rate > 0
+
+
+def test_tpu_section_shape():
+    t = bench._tpu_section()
+    assert "probes" in t and "evidence" in t and "best" in t
+    probes = t["probes"]
+    for key in ("total", "healthy", "watcher_start_ts"):
+        assert key in probes
+    # the merged artifacts are either absent or well-formed JSON docs
+    if t["evidence"] is not None:
+        assert "sections" in t["evidence"]
+        # the age key appears only when a watcher start record exists
+        # AND the ts parses; when present it must be numeric
+        if "evidence_age_s" in t and t["evidence_age_s"] is not None:
+            assert isinstance(t["evidence_age_s"], (int, float))
+    if t["best"] is not None:
+        assert "sections" in t["best"]
+
+
+def test_pallas_status_skip_path():
+    assert bench._pallas_status("cpu") == {
+        "status": "skipped", "reason": "cpu-fallback platform"}
+
+
+def test_bench_artifact_history_parseable():
+    """Every committed BENCH_r*.json stays loadable with the stable
+    keys the judge compares across rounds."""
+    for name in sorted(os.listdir(REPO)):
+        if not (name.startswith("BENCH_r") and name.endswith(".json")):
+            continue
+        with open(os.path.join(REPO, name)) as f:
+            doc = json.load(f)
+        parsed = doc.get("parsed")
+        if parsed:  # driver wrapper format
+            for key in ("metric", "value", "unit"):
+                assert key in parsed, (name, key)

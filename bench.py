@@ -159,9 +159,15 @@ def _tpu_section():
     return out
 
 
-def engine_only(n_nodes, n_pods):
+def engine_only(n_nodes, n_pods, plain=False, speculative=None):
     """Device scan throughput on a prebuilt snapshot (encode excluded:
-    the live pipeline encodes incrementally, measured by the e2e number)."""
+    the live pipeline encodes incrementally, measured by the e2e number).
+
+    plain=True drops the service so the batch runs the node-local tier —
+    the tier the live e2e pipeline actually executes (its bench pods
+    have no services/RCs) and the one where the speculative engine
+    engages; `speculative` pins the engine choice for A/B runs
+    (None = the engine's platform default)."""
     from kubernetes_tpu.core import types as api
     from kubernetes_tpu.core.quantity import Quantity
     from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
@@ -194,8 +200,12 @@ def engine_only(n_nodes, n_pods):
                     "cpu": Quantity(100),
                     "memory": Quantity(500 * mi * 1000)}))]))
         for j in range(n_pods)]
+    if plain:
+        services = []
+        for p in pods:
+            p.metadata.labels = {}
     snap = ClusterSnapshot(nodes=nodes, services=services, pending_pods=pods)
-    engine = BatchEngine()
+    engine = BatchEngine(speculative=speculative)
     enc = encode_snapshot(snap, node_pad_to=engine.n_shards,
                           pod_pad_to=((n_pods + 8191) // 8192) * 8192)
     # chunked at the production tile shape: one compiled [8192] program

@@ -134,6 +134,26 @@ def _section_engine() -> dict:
     return out
 
 
+def _section_engine_spec() -> dict:
+    """Node-local-tier A/B: speculative parallel-assign + conflict
+    repair vs the sequential scan (SURVEY.md section 7 step 4's two
+    branches, head to head). This is the tier the live e2e pipeline
+    actually runs — its bench pods carry no services/RCs — so the
+    winner here is what the north-star batch pays per pod."""
+    import bench
+    out = {}
+    for n_nodes, n_pods in ((1000, 3000), (5000, 30000)):
+        rec = {}
+        for name, spec in (("scan", False), ("spec", True)):
+            rate, bound = bench.engine_only(n_nodes, n_pods, plain=True,
+                                            speculative=spec)
+            rec[name] = {"pods_per_sec": round(rate, 1), "bound": bound}
+        rec["winner"] = ("spec" if rec["spec"]["pods_per_sec"]
+                         >= rec["scan"]["pods_per_sec"] else "scan")
+        out[f"{n_nodes}x{n_pods}"] = rec
+    return out
+
+
 def _tiny_enc():
     from __graft_entry__ import _tiny_snapshot_inline
 
@@ -255,6 +275,28 @@ def merge_best(doc: dict, best_path: str) -> None:
             if old is None or rec["pods_per_sec"] > old["pods_per_sec"]:
                 tgt[shape] = dict(rec, ts=ts)
                 changed = True
+    spec_ab = _ok("engine_spec")
+    if spec_ab:
+        tgt = bs.setdefault("engine_spec", {})
+        for shape, rec in spec_ab.items():
+            if not isinstance(rec, dict) or "scan" not in rec:
+                continue
+            old = tgt.get(shape)
+            merged = {}
+            for eng_name in ("scan", "spec"):
+                new_e = rec.get(eng_name) or {}
+                old_e = (old or {}).get(eng_name) or {}
+                merged[eng_name] = (dict(new_e, ts=ts)
+                                    if new_e.get("pods_per_sec", -1)
+                                    > old_e.get("pods_per_sec", -1)
+                                    else old_e)
+            merged["winner"] = ("spec"
+                                if merged["spec"].get("pods_per_sec", -1)
+                                >= merged["scan"].get("pods_per_sec", -1)
+                                else "scan")
+            if merged != old:
+                tgt[shape] = merged
+                changed = True
     e2e = _ok("e2e")
     if e2e:
         old = bs.get("e2e")
@@ -288,7 +330,11 @@ def merge_best(doc: dict, best_path: str) -> None:
                     bool(rec.get("latch_fallback_parity")),
                     bool(rec.get("rejection_raised")))
         old = bs.get("pallas")
-        if (old is None or _quality(pal) >= _quality(old)) \
+        # per-field non-regression, not lexicographic: a capture that
+        # improves an earlier bit but regresses a later one must not
+        # replace a fully-validated record
+        if (old is None or all(n >= o for n, o in zip(_quality(pal),
+                                                      _quality(old)))) \
                 and _content(old) != _content(pal):
             bs["pallas"] = dict(pal, ts=ts)
             changed = True
@@ -312,6 +358,9 @@ def main() -> None:
     ev.run_section("engine", _section_engine)
     if not args.skip_e2e:
         ev.run_section("e2e", _section_e2e)
+    # diagnostic A/B last: its four full-shape runs must never eat the
+    # headline e2e section's share of the watcher's capture budget
+    ev.run_section("engine_spec", _section_engine_spec)
     ev.doc["complete"] = True
     ev.doc["ts_end"] = _utc()
     ev.flush()

@@ -135,15 +135,22 @@ def _floordiv_exact(num: jax.Array, den: jax.Array,
 
 def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
                     anti_weight: int, state: State, pod,
-                    has_aff: bool = True, has_spread: bool = True
+                    has_aff: bool = True, has_spread: bool = True,
+                    iota: Optional[jax.Array] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Predicate mask + priority totals for ONE pod against `state`.
 
     The shared core of the scan step and the extender sidecar's
     filter/prioritize probe (plugin/pkg/scheduler/extender.go:95,119 —
-    the extender server answers per-pod, stateless between requests)."""
+    the extender server answers per-pod, stateless between requests).
+
+    `iota` overrides the node indices the lanes stand for (the
+    speculative repair pass rescores a GATHERED lane set, so lane i is
+    node iota[i], not node i — HostName matching must use the real
+    index)."""
     n = node.valid.shape[0]
-    iota = jnp.arange(n, dtype=jnp.int32)
+    if iota is None:
+        iota = jnp.arange(n, dtype=jnp.int32)
     # score dtype follows the resource arrays: i64 normally, i32 when the
     # encoder narrowed (exact gcd rescale of memory + bounds checks make
     # the narrow math bit-identical — see tables._maybe_narrow)
@@ -263,6 +270,34 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
     return mask, total
 
 
+def _commit_node_local(state: State, pod, j: jax.Array,
+                       fit_any: jax.Array):
+    """The node-local half of the assume-pod commit (modeler.go:113):
+    scatter the pod's resources/ports/disks onto the picked lane.
+    Shared by the scan step and the speculative repair step — the spec
+    engine's contract is bit-identity with the scan, so the commit
+    semantics must have exactly one implementation.
+
+    -> (dict of updated node-local State fields, add32 for the callers'
+    global-tier updates)."""
+    add = jnp.where(fit_any, jnp.ones((), state.cpu_used.dtype),
+                    jnp.zeros((), state.cpu_used.dtype))
+    add32 = add.astype(jnp.int32)
+    fields = dict(
+        cpu_used=state.cpu_used.at[j].add(add * pod.req_cpu),
+        mem_used=state.mem_used.at[j].add(add * pod.req_mem),
+        nz_cpu=state.nz_cpu.at[j].add(add * pod.nz_cpu),
+        nz_mem=state.nz_mem.at[j].add(add * pod.nz_mem),
+        pod_count=state.pod_count.at[j].add(add32),
+        port_bits=state.port_bits.at[j].set(
+            state.port_bits[j] | jnp.where(fit_any, pod.ports, 0)),
+        disk_any=state.disk_any.at[j].set(
+            state.disk_any[j] | jnp.where(fit_any, pod.sany, 0)),
+        disk_rw=state.disk_rw.at[j].set(
+            state.disk_rw[j] | jnp.where(fit_any, pod.srw, 0)))
+    return fields, add32
+
+
 def _step(node: NodeConst, weights: Tuple[int, int, int],
           anti_weight: int, state: State, pod,
           has_aff: bool = True, has_spread: bool = True
@@ -289,22 +324,10 @@ def _step(node: NodeConst, weights: Tuple[int, int, int],
     # step's state write is O(1) instead of O(nodes) (the state arrays
     # are ~the same size as the score reads — this halves per-step HBM
     # traffic). A no-fit step scatters a zero delta at lane 0.
-    add = jnp.where(fit_any, jnp.ones((), state.cpu_used.dtype),
-                    jnp.zeros((), state.cpu_used.dtype))
-    add32 = add.astype(jnp.int32)
     j = jnp.maximum(pick, 0)
+    fields, add32 = _commit_node_local(state, pod, j, fit_any)
     new_state = State(
-        cpu_used=state.cpu_used.at[j].add(add * pod.req_cpu),
-        mem_used=state.mem_used.at[j].add(add * pod.req_mem),
-        nz_cpu=state.nz_cpu.at[j].add(add * pod.nz_cpu),
-        nz_mem=state.nz_mem.at[j].add(add * pod.nz_mem),
-        pod_count=state.pod_count.at[j].add(add32),
-        port_bits=state.port_bits.at[j].set(
-            state.port_bits[j] | jnp.where(fit_any, pod.ports, 0)),
-        disk_any=state.disk_any.at[j].set(
-            state.disk_any[j] | jnp.where(fit_any, pod.sany, 0)),
-        disk_rw=state.disk_rw.at[j].set(
-            state.disk_rw[j] | jnp.where(fit_any, pod.srw, 0)),
+        **fields,
         spread=state.spread.at[:, j].add(add32 * pod.member)
         if has_spread else state.spread,
         aff_count=_aff_count_update(node, state, pod, pick, fit_any)
@@ -359,6 +382,179 @@ def _make_probe(weights: Tuple[int, int, int], anti_weight: int = 0,
     return probe
 
 
+# ---------------------------------------------------------------------------
+# Speculative tile-parallel assign + conflict repair (SURVEY.md section 7
+# step 4's second branch). The sequential scan pays a full [N]-wide
+# predicate+priority pipeline per pod (~60 ops x N lanes x P steps, and on
+# TPU a measured ~25us/step loop floor — 0.74s for the 30k-pod north-star
+# batch on its own). The speculative engine splits the work:
+#
+#   1. parallel pass: ONE batched vmap scores every pod in the chunk
+#      against the chunk-start ("frozen") state — the expensive pipeline
+#      runs once, fully vectorized, as [P, N] instead of P sequential
+#      [N] steps.
+#   2. repair pass: a lax.scan whose per-step body is tiny. For pod k the
+#      true sequential-state score differs from the frozen row ONLY on
+#      nodes some earlier pod in the chunk committed to (scoring is
+#      node-local when the spread / inter-pod-affinity / service-anti
+#      tiers are inactive — each node's mask+score reads that node's
+#      state and nothing global). So the exact argmax is
+#        max( masked argmax of the frozen row over UNTOUCHED nodes,
+#             exact rescore of the <=k touched lanes ).
+#      The first is one select+argmax over a precomputed row; the second
+#      is the full formula on a gathered [chunk]-lane set.
+#
+# The result is BIT-IDENTICAL to the sequential scan (same composite
+# encoding, same tie-break, disjoint touched/untouched sets can never
+# tie because composite = total*n + tie_rank is injective per node), so
+# the scan's oracle-parity gate transfers. Eligibility is decided per
+# encode: any active global tier (has_aff / has_spread / anti_weight)
+# falls back to the scan — exactly the tiers whose scores are not
+# node-local.
+# ---------------------------------------------------------------------------
+
+def _make_spec_pass(weights: Tuple[int, int, int]):
+    """Batched frozen-state composite scores: -> i[P, N] (-1 = no fit)."""
+    def spec_pass(node: NodeConst, state: State, pods: PodXs):
+        n = node.valid.shape[0]
+
+        def one(pod):
+            mask, total = _mask_and_score(node, weights, 0, state, pod,
+                                          has_aff=False, has_spread=False)
+            return jnp.where(mask, total * n + node.tie_rank,
+                             jnp.full((), -1, total.dtype))
+
+        return jax.vmap(one)(pods)
+    return spec_pass
+
+
+def _gather_lanes(node: NodeConst, state: State, tidx: jax.Array,
+                  lane_valid: jax.Array) -> Tuple[NodeConst, State]:
+    """Node constants + mutable state at lanes tidx (clamped indices;
+    invalid lanes are masked out via node.valid). Fields unused by the
+    node-local tier keep their ungathered arrays — _mask_and_score with
+    has_aff=False/has_spread=False/anti_weight=0 never reads them and
+    XLA removes the dead bindings."""
+    g = NodeConst(
+        valid=node.valid[tidx] & lane_valid,
+        cpu_cap=node.cpu_cap[tidx], mem_cap=node.mem_cap[tidx],
+        pod_cap=node.pod_cap[tidx], labels=node.labels[tidx],
+        tie_rank=node.tie_rank[tidx],
+        exceed_cpu=node.exceed_cpu[tidx], exceed_mem=node.exceed_mem[tidx],
+        offgrid_max=node.offgrid_max, aff_dom=node.aff_dom,
+        zone_id=node.zone_id, zone_scratch=node.zone_scratch,
+        static_mask=node.static_mask[tidx],
+        static_score=node.static_score[tidx])
+    s = State(
+        cpu_used=state.cpu_used[tidx], mem_used=state.mem_used[tidx],
+        nz_cpu=state.nz_cpu[tidx], nz_mem=state.nz_mem[tidx],
+        pod_count=state.pod_count[tidx], port_bits=state.port_bits[tidx],
+        disk_any=state.disk_any[tidx], disk_rw=state.disk_rw[tidx],
+        spread=state.spread, aff_count=state.aff_count,
+        aff_total=state.aff_total, svc_count=state.svc_count,
+        svc_total=state.svc_total)
+    return g, s
+
+
+def _spec_step(node: NodeConst, weights: Tuple[int, int, int],
+               carry, x):
+    """One repair step: exact sequential argmax for pod k from
+    (frozen row over untouched nodes) + (rescored touched lanes),
+    then the same O(1) scatter commit as the scan step."""
+    state, touched, touched_idx, k = carry
+    pod, row = x
+    n = node.valid.shape[0]
+    t = touched_idx.shape[0]
+    neg = jnp.full((), -1, row.dtype)
+
+    # untouched nodes: frozen scores are exact (node-local tier)
+    frozen = jnp.where(touched, neg, row)
+    fi = jnp.argmax(frozen).astype(jnp.int32)
+    fv = frozen[fi]
+
+    # touched lanes: exact rescore against current state
+    lane_valid = (jnp.arange(t, dtype=jnp.int32) < k) & (touched_idx >= 0)
+    tidx = jnp.maximum(touched_idx, 0)
+    gnode, gstate = _gather_lanes(node, state, tidx, lane_valid)
+    mask_t, total_t = _mask_and_score(gnode, weights, 0, gstate, pod,
+                                      has_aff=False, has_spread=False,
+                                      iota=tidx)
+    comp_t = jnp.where(mask_t, total_t * n + gnode.tie_rank, neg)
+    tl = jnp.argmax(comp_t)
+    tv = comp_t[tl]
+    ti = tidx[tl]
+
+    pick = jnp.where(tv > fv, ti, fi)
+    fit_any = jnp.maximum(tv, fv) >= 0
+    assigned = jnp.where(fit_any, pick, jnp.int32(-1))
+
+    # commit: the scan step's scatter update, global tiers carried
+    # through untouched (the spec path only runs when they're inactive)
+    j = jnp.maximum(pick, 0)
+    fields, _add32 = _commit_node_local(state, pod, j, fit_any)
+    new_state = State(
+        **fields,
+        spread=state.spread, aff_count=state.aff_count,
+        aff_total=state.aff_total, svc_count=state.svc_count,
+        svc_total=state.svc_total)
+    touched = touched.at[j].set(touched[j] | fit_any)
+    touched_idx = touched_idx.at[k].set(assigned)
+    return (new_state, touched, touched_idx, k + 1), assigned
+
+
+# The repair step is small enough that loop overhead shows again; a mild
+# unroll amortizes it without the compile-time cost of the full scan's
+# body x4 (the repair body is ~10x smaller).
+SPEC_UNROLL = 4
+
+# Repair-block width: the pod axis splits into blocks of this size; each
+# block gets a fresh parallel pass against the live carry state (so
+# frozen rows are never stale across blocks) and its repair steps gather
+# at most this many touched lanes. Smaller blocks shrink the per-step
+# rescore (on TPU that is the emulated-f64 cost of the Balanced formula,
+# the scan step's dominant term); larger blocks amortize the parallel
+# pass's dispatch. 256 balances the two at bench shapes.
+SPEC_BLOCK = 256
+
+
+def _make_spec_run(weights: Tuple[int, int, int], block: int = SPEC_BLOCK):
+    """Same (node, state, pods) -> (final_state, assigned) signature as
+    _make_run — drop-in for the scan wherever the encode is eligible."""
+    spec_pass = _make_spec_pass(weights)
+
+    def run(node: NodeConst, state: State, pods: PodXs):
+        p = pods.valid.shape[0]
+        b = min(block, p) if p else 1
+        pad = (-p) % b
+        if pad:
+            # pad the pod axis to a block multiple with invalid pods —
+            # they score -1 everywhere and never commit
+            pods = jax.tree_util.tree_map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]), pods)
+        nb = (p + pad) // b
+        pods_b = jax.tree_util.tree_map(
+            lambda a: a.reshape((nb, b) + a.shape[1:]), pods)
+        n = node.valid.shape[0]
+
+        def outer(state, pblock):
+            comp = spec_pass(node, state, pblock)               # [b, N]
+            touched = jnp.zeros(n, bool)
+            tidx0 = jnp.full((b,), -1, jnp.int32)
+
+            def step(carry, x):
+                return _spec_step(node, weights, carry, x)
+
+            (state2, _, _, _), assigned = jax.lax.scan(
+                step, (state, touched, tidx0, jnp.int32(0)),
+                (pblock, comp), unroll=SPEC_UNROLL)
+            return state2, assigned
+
+        final_state, assigned = jax.lax.scan(outer, state, pods_b)
+        return final_state, assigned.reshape(nb * b)[:p]
+    return run
+
+
 def _node_shardings(mesh: Mesh, axis: str):
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
@@ -392,7 +588,7 @@ class BatchEngine:
 
     def __init__(self, weights: Tuple[int, int, int] = DEFAULT_WEIGHTS,
                  mesh: Optional[Mesh] = None, node_axis: str = "nodes",
-                 policy=None):
+                 policy=None, speculative: Optional[bool] = None):
         ensure_x64()
         self.weights = tuple(int(w) for w in weights)
         self.mesh = mesh
@@ -401,26 +597,50 @@ class BatchEngine:
         self._anti_weight = (policy.anti_affinity_weight
                              if policy is not None
                              and policy.needs_anti_affinity else 0)
+        # speculative parallel-assign + repair replaces the scan whenever
+        # the encode's tiers are node-local (bit-identical results — see
+        # the _make_spec_run block). None = auto: on for TPU backends
+        # (where the scan pays a ~25us/step loop floor and the repair
+        # step cuts the emulated-f64 lane count ~20x), off for CPU
+        # (measured A/B: the scan wins there — CPU step cost tracks op
+        # count, not lane count) and off under a mesh (the repair
+        # gathers would cross shards). Resolved lazily at first run so
+        # constructing an engine never forces backend init.
+        self._speculative = speculative
         # jitted variants keyed by (has_aff, has_spread): inactive tiers
         # (no affinity terms / no spread groups in the batch) compile out
         # entirely rather than running on dummy [1, N] arrays every step
         self._runs = {}
         self._run = self._get_run(True, True)
 
+    @property
+    def speculative(self) -> bool:
+        if self.mesh is not None:
+            return False
+        if self._speculative is None:
+            self._speculative = jax.default_backend() == "tpu"
+        return self._speculative
+
     def _get_run(self, has_aff: bool, has_spread: bool):
-        key = (has_aff, has_spread)
+        spec = (not has_aff and not has_spread and not self._anti_weight
+                and self.speculative)
+        key = ("spec",) if spec else (has_aff, has_spread)
         cached = self._runs.get(key)
         if cached is not None:
             return cached
-        run = _make_run(self.weights, self._anti_weight,
-                        has_aff=has_aff, has_spread=has_spread)
-        if self.mesh is not None:
-            shardings = _node_shardings(self.mesh, self.node_axis)
-            jitted = jax.jit(
-                run, in_shardings=shardings,
-                out_shardings=(shardings[1], NamedSharding(self.mesh, P())))
+        if spec:
+            jitted = jax.jit(_make_spec_run(self.weights))
         else:
-            jitted = jax.jit(run)
+            run = _make_run(self.weights, self._anti_weight,
+                            has_aff=has_aff, has_spread=has_spread)
+            if self.mesh is not None:
+                shardings = _node_shardings(self.mesh, self.node_axis)
+                jitted = jax.jit(
+                    run, in_shardings=shardings,
+                    out_shardings=(shardings[1],
+                                   NamedSharding(self.mesh, P())))
+            else:
+                jitted = jax.jit(run)
         self._runs[key] = jitted
         return jitted
 

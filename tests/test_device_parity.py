@@ -291,3 +291,64 @@ def test_mesh_chained_pipeline_matches_single_run():
     assert np.array_equal(np.concatenate([a1[:16], a2[:16]]), aall[:32])
     # and the host ledger absorbed both tiles exactly
     assert int(inc.pod_count.sum()) == 32
+
+
+# ---------------------------------------------------------------------------
+# Speculative parallel-assign + conflict-repair engine (engine._make_spec_run,
+# SURVEY.md section 7 step 4's second branch): must be BIT-IDENTICAL to the
+# sequential scan — and hence the oracle — whenever it engages (node-local
+# tiers only), and must fall back to the scan when any global tier
+# (spread / inter-pod affinity / service-anti) is active.
+# ---------------------------------------------------------------------------
+
+def _spread_free(snap: ClusterSnapshot) -> ClusterSnapshot:
+    """The rand_cluster fixture always carries services/RCs (spread tier
+    on -> scan path); strip them so the speculative path engages."""
+    return ClusterSnapshot(nodes=snap.nodes, existing_pods=snap.existing_pods,
+                           services=[], controllers=[],
+                           pending_pods=snap.pending_pods)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_speculative_matches_scan_and_oracle(seed):
+    snap = _spread_free(rand_cluster(seed))
+    spec = BatchEngine(speculative=True).schedule(snap)[0]
+    scan = BatchEngine(speculative=False).schedule(snap)[0]
+    assert spec == scan
+    assert spec == oracle_schedule(snap)
+
+
+def test_speculative_tight_capacity_and_no_fit():
+    # heavy oversubscription: repair steps see touched-lane wins AND
+    # no-fit pods (assigned -1 -> touched_idx sentinel lanes)
+    snap = _spread_free(rand_cluster(41, n_nodes=3, n_existing=5,
+                                     n_pending=60))
+    spec = BatchEngine(speculative=True).schedule(snap)[0]
+    assert spec == BatchEngine(speculative=False).schedule(snap)[0]
+    assert spec == oracle_schedule(snap)
+
+
+def test_speculative_chunked_matches_scan_chunked():
+    """run_chunked parity incl. a chunk size that is not a SPEC_BLOCK
+    multiple (the internal pad path) and the cross-chunk state carry."""
+    import numpy as np
+    from kubernetes_tpu.sched.device.tables import encode_snapshot
+    snap = _spread_free(rand_cluster(5, n_nodes=20, n_existing=10,
+                                     n_pending=300))
+    enc = encode_snapshot(snap)
+    # chunk 300 > SPEC_BLOCK and not a block multiple: each piece pads
+    # internally (pad = 212 invalid pods) — the _make_spec_run pad branch
+    a_scan, _ = BatchEngine(speculative=False).run_chunked(enc, 300)
+    a_spec, _ = BatchEngine(speculative=True).run_chunked(enc, 300)
+    assert np.array_equal(a_scan, a_spec)
+
+
+def test_speculative_falls_back_on_global_tiers():
+    """A snapshot with spread groups must take the scan path (the
+    speculative engine's node-local premise fails there) and still
+    match the oracle."""
+    snap = rand_cluster(3)  # services + RCs present -> has_spread
+    eng = BatchEngine(speculative=True)
+    got = eng.schedule(snap)[0]
+    assert ("spec",) not in eng._runs
+    assert got == oracle_schedule(snap)

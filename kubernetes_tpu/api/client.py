@@ -40,6 +40,20 @@ class Client:
         bind_batch). Default: sequential creates."""
         return [self.create(resource, o, namespace) for o in objs]
 
+    def create_from_template(self, resource: str, template: Any,
+                             names: List[str], namespace: str = ""
+                             ) -> List[Any]:
+        """Columnar bulk create: one template object, many names
+        (registry.create_from_template). Default: expand client-side
+        into a create_batch — any Client gets the semantics, the
+        in-proc registry gets the fast path."""
+        from ..core.types import fast_replace
+        return self.create_batch(
+            resource,
+            [fast_replace(template,
+                          metadata=fast_replace(template.metadata, name=n))
+             for n in names], namespace)
+
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         raise NotImplementedError
 
@@ -77,6 +91,16 @@ class Client:
         # reference wire protocol; the in-proc client overrides this).
         return [self.bind(b, namespace) for b in bindings]
 
+    def bind_batch_hosts(self, assignments: List[Tuple[str, str, str]]
+                         ) -> List[Any]:
+        """Columnar bind: (namespace, name, host) rows. Default:
+        expand into Binding objects; the in-proc client hands the rows
+        straight to the registry."""
+        return self.bind_batch([api.Binding(
+            metadata=api.ObjectMeta(namespace=ns, name=name),
+            target=api.ObjectReference(kind="Node", name=host))
+            for ns, name, host in assignments])
+
     def finalize_namespace(self, obj: api.Namespace) -> Any:
         raise NotImplementedError
 
@@ -108,6 +132,10 @@ class InProcClient(Client):
     def create_batch(self, resource, objs, namespace=""):
         return self.registry.create_batch(resource, objs, namespace)
 
+    def create_from_template(self, resource, template, names, namespace=""):
+        return self.registry.create_from_template(resource, template,
+                                                  names, namespace)
+
     def get(self, resource, name, namespace=""):
         return self.registry.get(resource, name, namespace)
 
@@ -137,6 +165,9 @@ class InProcClient(Client):
 
     def bind_batch(self, bindings, namespace=""):
         return self.registry.bind_batch(bindings, namespace)
+
+    def bind_batch_hosts(self, assignments):
+        return self.registry.bind_batch_hosts(assignments)
 
     def pod_logs(self, name, namespace="default", container="",
                  tail_lines=0, previous=False):

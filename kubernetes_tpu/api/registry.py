@@ -412,6 +412,68 @@ class Registry:
         # under the store lock, PROFILE_e2e.md)
         return self.store.create_batch(entries, owned_meta=True)
 
+    def create_from_template(self, resource: str, template: Any,
+                             names: List[str], namespace: str = ""
+                             ) -> List[Any]:
+        """Columnar bulk create — the host half of the array-first
+        design (SURVEY.md section 7 hard part 3; PROFILE_e2e.md's
+        ~80us/pod interpreter floor). One validation pass on the
+        template, then per name only a fresh ObjectMeta (name, uid,
+        shared timestamp) around the template's spec/status, which the
+        created objects SHARE. Sharing is safe under the framework's
+        replace-don't-mutate contract: every write path (store rv
+        stamping, binding assignment, status updates) clones via
+        fast_replace and the store's owned_meta stamping touches only
+        the per-object fresh metadata.
+
+        Falls back to the per-object create path when admission chains
+        or create-time side effects (services' allocators, TPRs) need
+        to see each object individually."""
+        info = self.info(resource)
+        if (self.admission or resource in
+                ("componentstatuses", "bindings", "services",
+                 "thirdpartyresources", "namespaces")):
+            return self.create_batch(
+                resource,
+                [api.fast_replace(
+                    template,
+                    metadata=api.fast_replace(template.metadata, name=n))
+                 for n in names], namespace)
+        if not names:
+            return []
+        if not isinstance(template, info.cls):
+            raise BadRequest(
+                f"expected {info.kind}, got {type(template).__name__}")
+        ns = self._namespace_for(info, template, namespace)
+        ts = api.now_rfc3339()
+        tm = template.metadata
+        # template-wide validation once, against a representative row
+        rep = api.fast_replace(
+            template, metadata=api.fast_replace(
+                tm, name=names[0], namespace=ns, uid="template",
+                creation_timestamp=ts, resource_version=""))
+        if info.validate:
+            info.validate(rep)
+        # one RFC-4122-shaped random base, consecutive uids off it: the
+        # per-row cost is one hex format instead of a fresh getrandbits
+        base = _uid_rng().getrandbits(128)
+        key_prefix = self.key(resource, ns, "")
+        entries = []
+        fr = api.fast_replace
+        for i, name in enumerate(names):
+            if not _dns1123(name):
+                raise Invalid(f"metadata.name: invalid value {name!r}")
+            bits = base + i
+            bits = (bits & ~(0xF << 76)) | (0x4 << 76)
+            bits = (bits & ~(0x3 << 62)) | (0x2 << 62)
+            h = "%032x" % bits
+            meta = fr(tm, name=name, namespace=ns,
+                      uid=f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}",
+                      creation_timestamp=ts, resource_version="")
+            entries.append((key_prefix + name, fr(template, metadata=meta),
+                            info.ttl))
+        return self.store.create_batch(entries, owned_meta=True)
+
     def _service_allocate(self, obj: api.Service):
         """Assign cluster IP + node ports (ref: pkg/registry/service
         rest.go Create: headless "None" skips IP; explicit requests are
@@ -814,14 +876,18 @@ class Registry:
         bind_batch so validation + annotation-merge semantics can't drift
         (ref: pkg/registry/pod/etcd/etcd.go:121 BindingREST.Create ->
         assignPod -> setPodHostAndAnnotations)."""
-        ns = binding.metadata.namespace or namespace or "default"
-        name = binding.metadata.name
+        return Registry._assign_op(
+            binding.metadata.namespace or namespace or "default",
+            binding.metadata.name, binding.target.name,
+            dict(binding.metadata.annotations))
+
+    @staticmethod
+    def _assign_op(ns: str, name: str, host: str,
+                   annotations: Dict[str, str]):
         if not name:
             raise Invalid("binding.metadata.name: required value")
-        host = binding.target.name
         if not host:
             raise Invalid("binding.target.name: required value")
-        annotations = dict(binding.metadata.annotations)
 
         def assign(pod: api.Pod, rv: str = "") -> api.Pod:
             """wants_rv: with a pre-assigned resourceVersion the stamped
@@ -864,6 +930,19 @@ class Registry:
         for b in bindings:
             ns, name, assign = self._binding_op(b, namespace)
             ops.append((self.key("pods", ns, name), assign))
+        return self.store.batch(ops)
+
+    def bind_batch_hosts(self, assignments: List[Tuple[str, str, str]]
+                         ) -> List[api.Pod]:
+        """bind_batch without the Binding carrier objects: (namespace,
+        name, host) rows straight from the batch scheduler's tile —
+        the columnar commit half of the host hot path. CAS/assignment
+        semantics are _assign_op's, identical to bind()."""
+        ops = []
+        for ns, name, host in assignments:
+            ns2, name2, assign = self._assign_op(ns or "default", name,
+                                                 host, {})
+            ops.append((self.key("pods", ns2, name2), assign))
         return self.store.batch(ops)
 
     # ------------------------------------------- third-party resources

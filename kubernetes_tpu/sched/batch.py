@@ -478,23 +478,25 @@ class BatchScheduler:
         it in locked_action for snapshot ordering."""
         c = self.config
         f = c.factory
-        bindings = [api.Binding(
-            metadata=api.ObjectMeta(namespace=p.metadata.namespace,
-                                    name=p.metadata.name),
-            target=api.ObjectReference(kind="Node", name=h))
-            for p, h in scheduled]
+        # columnar commit: (ns, name, host) rows, no Binding carrier
+        # objects on the hot path (client.bind_batch_hosts expands them
+        # only for wire transports)
+        rows = [(p.metadata.namespace, p.metadata.name, h)
+                for p, h in scheduled]
         bind_start = time.monotonic()
-        committed: List[bool] = [False] * len(bindings)
+        committed: List[bool] = [False] * len(rows)
         try:
-            f.client.bind_batch(bindings)
-            committed = [True] * len(bindings)
+            f.client.bind_batch_hosts(rows)
+            committed = [True] * len(rows)
         except Exception:
             # all-or-nothing tile failed (e.g. a pod got bound by
             # another scheduler mid-flight): degrade to per-pod CAS so
             # one conflict doesn't waste the whole tile
-            for i, b in enumerate(bindings):
+            for i, (ns, name, host) in enumerate(rows):
                 try:
-                    f.client.bind(b)
+                    f.client.bind(api.Binding(
+                        metadata=api.ObjectMeta(namespace=ns, name=name),
+                        target=api.ObjectReference(kind="Node", name=host)))
                     committed[i] = True
                 except Exception as e:
                     pod = scheduled[i][0]

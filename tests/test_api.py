@@ -631,3 +631,81 @@ class TestWorkloadProxy:
         status, _ = self._get(
             server, "/api/v1/proxy/namespaces/default/pods/web-4:http/x")
         assert status == 400
+
+
+def test_registry_create_from_template():
+    """Columnar bulk create: per-name fresh metadata (uid/ts/rv) around
+    a SHARED spec/status, validated once; invalid names fail the whole
+    batch before commit; admission registries fall back per-object."""
+    reg = Registry()
+    tpl = mk_pod("ignored")
+    out = reg.create_from_template("pods", tpl,
+                                   [f"row-{i}" for i in range(6)])
+    assert [o.metadata.name for o in out] == [f"row-{i}" for i in range(6)]
+    assert len({o.metadata.uid for o in out}) == 6
+    assert all(o.metadata.resource_version for o in out)
+    # columnar contract: spec/status shared, metadata fresh
+    assert out[0].spec is out[1].spec
+    assert out[0].metadata is not out[1].metadata
+    # round-trips through the normal read path
+    got = reg.get("pods", "row-3", "default")
+    assert got.spec.containers[0].name == tpl.spec.containers[0].name
+    # a bad name anywhere commits nothing
+    with pytest.raises(Invalid):
+        reg.create_from_template("pods", tpl, ["good-0", "Bad_Name!"])
+    with pytest.raises(NotFound):
+        reg.get("pods", "good-0", "default")
+    # template validation runs once but still gates the batch
+    bad_tpl = mk_pod("x")
+    bad_tpl.spec.containers = []
+    with pytest.raises(Invalid):
+        reg.create_from_template("pods", bad_tpl, ["y"])
+    # an admission chain forces the per-object path (plugins may
+    # rewrite each object individually)
+    seen = []
+
+    def admit(op, resource, obj, ns, name):
+        seen.append(name)
+        return obj
+
+    reg2 = Registry(admission=admit)
+    out2 = reg2.create_from_template("pods", tpl, ["a-0", "a-1"])
+    assert seen == ["a-0", "a-1"]
+    assert out2[0].metadata.uid != out2[1].metadata.uid
+
+
+def test_registry_bind_batch_hosts_matches_bind_batch():
+    r = Registry()
+    for i in range(4):
+        r.create("pods", mk_pod(f"bh{i}"))
+    pods = r.bind_batch_hosts([("default", f"bh{i}", f"n{i}")
+                               for i in range(3)])
+    assert [p.spec.node_name for p in pods] == ["n0", "n1", "n2"]
+    # same conflict semantics as bind()
+    with pytest.raises(Conflict):
+        r.bind_batch_hosts([("default", "bh0", "elsewhere")])
+    with pytest.raises(NotFound):
+        r.bind_batch_hosts([("default", "ghost", "n1")])
+    with pytest.raises(Invalid):
+        r.bind_batch_hosts([("default", "bh3", "")])
+
+
+def test_store_empty_batches_are_noops():
+    """Empty tiles reach the store (a no-fit scheduling cycle commits
+    an empty bind list) and must be no-ops, not IndexErrors."""
+    r = Registry()
+    assert r.store.batch([]) == []
+    assert r.store.create_batch([]) == []
+    assert r.bind_batch_hosts([]) == []
+    assert r.create_batch("pods", []) == []
+
+
+def test_create_from_template_namespaces_get_finalizer():
+    """Per-kind create defaulting (the kubernetes finalizer) must hold
+    through the columnar path — namespaces take the per-object road."""
+    r = Registry()
+    out = r.create_from_template(
+        "namespaces",
+        api.Namespace(metadata=api.ObjectMeta(name="t")),
+        ["ns-a", "ns-b"])
+    assert all(o.spec.finalizers == ["kubernetes"] for o in out)

@@ -264,10 +264,15 @@ def main():
                       f"startup_p50={points[-1]['startup_p50_s']}s",
                       file=sys.stderr)
         total_calls = sum(p["api_calls"] for p in points)
+        # null-coupled gate: a starved point reports api_slo_ok null
+        # (kubemark/slo.py api_ok) and poisons the matrix verdict to
+        # null — never true-on-starved-samples
+        per_point = [p["api_slo_ok"] for p in points]
         slo = {
             "density_points": points,
             "api_calls": total_calls,
-            "api_slo_ok": all(p["api_slo_ok"] for p in points),
+            "api_slo_ok": (None if any(v is None for v in per_point)
+                           else all(per_point)),
             "startup_slo_ok": all(p["startup_slo_ok"] for p in points),
             # the matrix-wide floor: the 3/node point's window is only
             # a few seconds (per-point validity stays reported above)

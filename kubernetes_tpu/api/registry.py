@@ -46,6 +46,18 @@ _DNS1123_SUBDOMAIN_RE = re.compile(
 _uid_local = threading.local()
 
 
+# Resources whose creation has side effects or verb rewrites beyond the
+# plain store write (services allocate IPs/ports, bindings are a verb,
+# TPRs mount storage, componentstatuses are computed): batch paths must
+# take the per-object create() road for these.
+CREATE_SIDE_EFFECT_RESOURCES = ("componentstatuses", "bindings",
+                                "services", "thirdpartyresources")
+# ...and the template fast path must ALSO route kinds with per-kind
+# create defaulting through _prepare_create (namespaces gain the
+# kubernetes finalizer there).
+TEMPLATE_FALLBACK_RESOURCES = CREATE_SIDE_EFFECT_RESOURCES + ("namespaces",)
+
+
 def _uid_rng() -> random.Random:
     rng = getattr(_uid_local, "rng", None)
     if rng is None:
@@ -395,8 +407,7 @@ class Registry:
         create-time side effects outside the store (services' IP/port
         allocators, bindings, TPR mounting) fall back to the serial
         path object-by-object."""
-        if resource in ("componentstatuses", "bindings", "services",
-                        "thirdpartyresources"):
+        if resource in CREATE_SIDE_EFFECT_RESOURCES:
             return [self.create(resource, o, namespace) for o in objs]
         info = self.info(resource)
         entries = []
@@ -430,14 +441,16 @@ class Registry:
         or create-time side effects (services' allocators, TPRs) need
         to see each object individually."""
         info = self.info(resource)
-        if (self.admission or resource in
-                ("componentstatuses", "bindings", "services",
-                 "thirdpartyresources", "namespaces")):
+        if self.admission or resource in TEMPLATE_FALLBACK_RESOURCES:
+            # uid/resource_version cleared so a server-fetched template
+            # expands exactly like the fast path: fresh identity per row
             return self.create_batch(
                 resource,
                 [api.fast_replace(
                     template,
-                    metadata=api.fast_replace(template.metadata, name=n))
+                    metadata=api.fast_replace(template.metadata, name=n,
+                                              uid="",
+                                              resource_version=""))
                  for n in names], namespace)
         if not names:
             return []

@@ -37,12 +37,22 @@ def fetch_kubelet(url: str, timeout: float = 30.0) -> bytes:
         raise BadGateway(f"kubelet unreachable: {e}")
 
 
-def fetch_kubelet_response(url: str, timeout: float = 30.0):
-    """GET for a verbatim HTTP relay -> (status, content_type, body):
-    kubelet statuses pass through untouched; only transport failures
-    become 502 (what the ApiServer proxy forwards)."""
+def fetch_kubelet_response(url: str, timeout: float = 30.0,
+                           method: str = "GET",
+                           body: "bytes | None" = None,
+                           content_type: str = ""):
+    """Any-method verbatim HTTP relay -> (status, content_type, body):
+    backend statuses pass through untouched; only transport failures
+    become 502 (what the ApiServer proxy forwards). The reference's
+    ProxyHandler relays every verb with the request body intact
+    (pkg/apiserver/proxy.go:52 ServeHTTP — no method filter)."""
+    headers = {}
+    if content_type:
+        headers["Content-Type"] = content_type
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
             return (resp.status, resp.headers.get("Content-Type",
                                                   "text/plain"),
                     resp.read())

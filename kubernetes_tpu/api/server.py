@@ -102,6 +102,11 @@ class ApiServer:
         # ref: --max-requests-inflight (cmd/kube-apiserver/app/server.go),
         # MaxInFlightLimit pkg/apiserver/handlers.go:76
         self._inflight = threading.BoundedSemaphore(max_in_flight)
+        # (resource, ns, selectors) -> (segment write version, response
+        # bytes): whole-LIST responses reused verbatim between writes
+        # to that resource (the watch cache's LIST half at the byte
+        # tier; see the GET list handler)
+        self._list_bytes_cache: dict = {}
         self.authenticator = authenticator
         self.authorizer = authorizer
         self.request_log = request_log
@@ -127,6 +132,12 @@ class ApiServer:
 
             def do_DELETE(self):
                 server.handle(self, "DELETE")
+
+            def do_PATCH(self):
+                # served for the any-method proxy relay
+                # (pkg/apiserver/proxy.go:52 has no verb filter);
+                # non-proxy PATCH paths answer MethodNotSupported
+                server.handle(self, "PATCH")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
@@ -290,6 +301,14 @@ class ApiServer:
             from .swagger import swagger_api
             return self._send_json(h, 200, swagger_api(self.url))
         if path in ("/ui", "/ui/"):
+            # the client-side dashboard (pkg/ui role): a static shell —
+            # no cluster data is rendered server-side; the app lists and
+            # watches through the public REST API from the browser
+            from .ui_app import UI_APP_HTML
+            return self._send_raw(h, 200, UI_APP_HTML.encode(),
+                                  "text/html; charset=utf-8")
+        if path in ("/ui/server", "/ui/server/"):
+            # the server-rendered variant stays for curl-style use
             from .swagger import ui_page
             return self._send_raw(
                 h, 200,
@@ -367,13 +386,17 @@ class ApiServer:
         # node proxy: /api/v1/proxy/nodes/{name}/{kubelet path...}
         # (ref: pkg/apiserver ProxyHandler + master.go "proxy/nodes")
         if parts[0] == "proxy" and len(parts) >= 3 and parts[1] == "nodes":
-            if method != "GET":
-                raise MethodNotSupported("node proxy supports GET")
-            # forward the ORIGINAL query string: the flattened `query`
-            # dict drops repeated params (kubelet /exec takes repeated
-            # ?command=)
+            # any-method relay (ref: pkg/apiserver/proxy.go:52
+            # ServeHTTP has no method filter — kubectl proxy write
+            # round-trips are a reference capability). Forward the
+            # ORIGINAL query string: the flattened `query` dict drops
+            # repeated params (kubelet /exec takes repeated ?command=)
             raw_q = urllib.parse.urlsplit(h.path).query
-            return self._proxy_node(h, parts[2], "/".join(parts[3:]), raw_q)
+            return self._proxy_node(h, parts[2], "/".join(parts[3:]),
+                                    raw_q, method=method,
+                                    body=self._proxy_body(h, method),
+                                    ctype=h.headers.get("Content-Type",
+                                                        ""))
         # pod/service proxy:
         # /api/v1/proxy/namespaces/{ns}/{pods|services}/{id[:port]}/...
         # (ref: apiserver ProxyHandler + pod/strategy.go:199 +
@@ -381,11 +404,13 @@ class ApiServer:
         if (parts[0] == "proxy" and len(parts) >= 5
                 and parts[1] == "namespaces"
                 and parts[3] in ("pods", "services")):
-            if method != "GET":
-                raise MethodNotSupported(f"{parts[3]} proxy supports GET")
             raw_q = urllib.parse.urlsplit(h.path).query
             return self._proxy_workload(h, parts[3], parts[2], parts[4],
-                                        "/".join(parts[5:]), raw_q)
+                                        "/".join(parts[5:]), raw_q,
+                                        method=method,
+                                        body=self._proxy_body(h, method),
+                                        ctype=h.headers.get("Content-Type",
+                                                            ""))
         resource = parts[0]
         name = parts[1] if len(parts) > 1 else ""
         sub = parts[2] if len(parts) > 2 else ""
@@ -407,20 +432,55 @@ class ApiServer:
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
+                info = Registry.info(resource)
+                # segment version read BEFORE the list: a write landing
+                # between the list and a version read taken after it
+                # would cache these (pre-write) bytes under the
+                # post-write version — readers would then reuse stale
+                # bytes. Read-before instead: the same interleave now
+                # caches under the OLD version, which the next reader
+                # sees as expired and rebuilds (a wasted cache slot,
+                # never a stale serve).
+                # never byte-cache TTL'd resources (events expire
+                # passively — no write bumps the version) or computed
+                # ones (componentstatuses is probed live per request;
+                # its segment version would sit at 0 forever and pin
+                # the first response)
+                wv = (getattr(self.registry.store, "write_version", None)
+                      if not info.ttl
+                      and resource != "componentstatuses" else None)
+                seg_ver = (wv(Registry.prefix(resource)) if wv is not None
+                           else None)
                 items, rev = self.registry.list(
                     resource, namespace,
                     query.get("labelSelector", ""),
                     query.get("fieldSelector", ""))
-                info = Registry.info(resource)
-                # fragment-cached assembly: a 5k-node LIST was ~1.9s of
-                # reflective encode per request (over the 1s API SLO by
-                # itself); repeat lists of unchanged objects now reuse
-                # per-object cached JSON (serde.wire_json)
-                return self._send_raw(
-                    h, 200,
-                    self.scheme.encode_list_bytes(info.kind, items,
-                                                  str(rev)),
-                    "application/json")
+                # two cache tiers: per-object fragments (serde.wire_json
+                # — a 5k-node LIST was ~1.9s of reflective encode before
+                # them) and, below, the WHOLE response body keyed by
+                # (list args, revision): repeated LISTs between writes
+                # reduce to a socket write. On a contended 1-core box
+                # the assembly pass alone (fragment joins, ~10-25ms at
+                # 5k nodes) multiplied by GIL queuing into
+                # p99-gate-breaking seconds (DENSITY.json 5000x30).
+                # TTL'd resources (events) expire passively — no write
+                # bumps the segment version, so their bytes never cache
+                # (wv None above)
+                ck = (resource, namespace,
+                      query.get("labelSelector", ""),
+                      query.get("fieldSelector", ""))
+                cached = self._list_bytes_cache.get(ck)
+                if (seg_ver is not None and cached is not None
+                        and cached[0] == seg_ver):
+                    body = cached[1]
+                else:
+                    body = self.scheme.encode_list_bytes(info.kind, items,
+                                                         str(rev))
+                    if seg_ver is not None:
+                        if len(self._list_bytes_cache) >= 32:
+                            self._list_bytes_cache.clear()
+                        self._list_bytes_cache[ck] = (seg_ver, body)
+                return self._send_raw(h, 200, body, "application/json")
             obj = self.registry.get(resource, name, namespace)
             return self._send_json(h, 200, self.scheme.encode_dict(obj))
 
@@ -611,24 +671,28 @@ class ApiServer:
                 raise
         return wsstream.client_connect(host, port, path)
 
-    def _relay(self, h, url: str) -> None:
+    def _relay(self, h, url: str, method: str = "GET",
+               body: "bytes | None" = None, ctype: str = "") -> None:
         if self.tunnel_dial is not None:
             parsed = urllib.parse.urlsplit(url)
             host, port = parsed.hostname, parsed.port or 80
             path = parsed.path + (f"?{parsed.query}" if parsed.query
                                   else "")
-            from .tunneler import http_get_over
+            from .tunneler import http_request_over
             conn = self._tunnel_conn(host, port)
             try:
-                status, ctype, body = http_get_over(conn, host, path)
+                status, rtype, rbody = http_request_over(
+                    conn, host, path, method=method, body=body,
+                    content_type=ctype)
             except (ConnectionError, OSError, ValueError) as e:
                 raise BadGateway(f"tunneled relay {host}: {e}")
             finally:
                 conn.close()
-            return self._send_raw(h, status, body, ctype)
+            return self._send_raw(h, status, rbody, rtype)
         from .relay import fetch_kubelet_response
-        status, ctype, body = fetch_kubelet_response(url)
-        self._send_raw(h, status, body, ctype)
+        status, rtype, rbody = fetch_kubelet_response(
+            url, method=method, body=body, content_type=ctype)
+        self._send_raw(h, status, rbody, rtype)
 
     def _serve_port_forward(self, h, namespace: str, name: str,
                             query: dict) -> None:
@@ -849,15 +913,36 @@ class ApiServer:
             gone.set()
             upstream.close()
 
+    @staticmethod
+    def _proxy_body(h, method: str) -> "bytes | None":
+        """Request body for a proxied write (the reference's proxy
+        streams it; one-shot reads serve the same verbs here).
+        Chunked uploads are rejected rather than half-read: ignoring
+        Transfer-Encoding would forward an empty body AND leave the
+        chunk bytes on the keep-alive socket to be misparsed as the
+        next request line."""
+        if method in ("GET", "HEAD"):
+            return None
+        if "chunked" in (h.headers.get("Transfer-Encoding") or "").lower():
+            h.close_connection = True
+            raise BadRequest(
+                "proxied writes require Content-Length "
+                "(chunked request bodies are not supported)")
+        length = int(h.headers.get("Content-Length") or 0)
+        return h.rfile.read(length) if length else b""
+
     def _proxy_node(self, h, node_name: str, rest: str,
-                    raw_query: str) -> None:
+                    raw_query: str, method: str = "GET",
+                    body: "bytes | None" = None,
+                    ctype: str = "") -> None:
         from .relay import exec_admission
         # exec admission (DenyExecOnPrivileged): the relay is the
         # CONNECT moment (ref: plugin/pkg/admission/exec)
         exec_admission(self.registry, rest)
         base = self._kubelet_base(node_name)
         self._relay(h, f"{base}/{rest}"
-                    + (f"?{raw_query}" if raw_query else ""))
+                    + (f"?{raw_query}" if raw_query else ""),
+                    method=method, body=body, ctype=ctype)
 
     @staticmethod
     def _split_name_port(ident: str) -> "tuple[str, str]":
@@ -873,7 +958,10 @@ class ApiServer:
         raise BadRequest(f"invalid proxy request {ident!r}")
 
     def _proxy_workload(self, h, resource: str, namespace: str,
-                        ident: str, rest: str, raw_query: str) -> None:
+                        ident: str, rest: str, raw_query: str,
+                        method: str = "GET",
+                        body: "bytes | None" = None,
+                        ctype: str = "") -> None:
         """Locate the backend for a pod/service proxy request and relay
         (ref: pkg/registry/pod/strategy.go:199 ResourceLocation — pod
         IP, port defaulting to the first declared container port;
@@ -925,7 +1013,8 @@ class ApiServer:
             # random pick spreads load like rest.go:322's random subset
             host, hport = random.choice(candidates)
         self._relay(h, f"http://{host}:{hport}/{rest}"
-                    + (f"?{raw_query}" if raw_query else ""))
+                    + (f"?{raw_query}" if raw_query else ""),
+                    method=method, body=body, ctype=ctype)
 
     # -------------------------------------------------------------- watch
 

@@ -98,15 +98,29 @@ class TunnelConn:
 
 def http_get_over(conn: TunnelConn, host: str, path: str,
                   timeout: float = 30.0):
-    """One HTTP GET over an open tunnel leg -> (status, content_type,
-    body). HTTP/1.0 with Connection: close keeps the framing trivial
-    (read to EOF) — the tunneled requests are the master's one-shot
-    node GETs (healthz, /pods, /stats), exactly the SSH tunnel's
-    traffic in the reference (master.go wires tunneler.Dial into the
-    node-proxy transport)."""
+    """One HTTP GET over an open tunnel leg (see http_request_over)."""
+    return http_request_over(conn, host, path, timeout=timeout)
+
+
+def http_request_over(conn: TunnelConn, host: str, path: str,
+                      timeout: float = 30.0, method: str = "GET",
+                      body: "bytes | None" = None,
+                      content_type: str = ""):
+    """One HTTP request over an open tunnel leg -> (status,
+    content_type, body). HTTP/1.0 with Connection: close keeps the
+    framing trivial (read to EOF) — the tunneled requests are the
+    master's one-shot node calls (healthz, /pods, /stats, and the
+    any-method proxy relay), exactly the SSH tunnel's traffic in the
+    reference (master.go wires tunneler.Dial into the node-proxy
+    transport; pkg/apiserver/proxy.go:52 relays every verb)."""
     conn.settimeout(timeout)
-    conn.sendall(f"GET {path} HTTP/1.0\r\nHost: {host}\r\n"
-                 f"Connection: close\r\n\r\n".encode())
+    head = (f"{method} {path} HTTP/1.0\r\nHost: {host}\r\n"
+            f"Connection: close\r\n")
+    if body is not None:
+        head += f"Content-Length: {len(body)}\r\n"
+        if content_type:
+            head += f"Content-Type: {content_type}\r\n"
+    conn.sendall(head.encode() + b"\r\n" + (body or b""))
     buf = b""
     while True:
         piece = conn.recv(65536)

@@ -46,6 +46,9 @@ class ApiProxy:
             def do_DELETE(self):
                 self._relay("DELETE")
 
+            def do_PATCH(self):
+                self._relay("PATCH")
+
         self.httpd = ThreadingHTTPServer((address, port), Handler)
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]

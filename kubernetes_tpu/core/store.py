@@ -73,6 +73,13 @@ class Store:
         # lists are never cached — expiry is passive, so a snapshot
         # could serve an expired object with no write to invalidate it
         self._ttl_segs: set = set()
+        # per-segment write counter: a LIST response is reusable
+        # verbatim while its resource segment has seen no writes, even
+        # as OTHER resources advance the global revision (the apiserver
+        # keys whole-response byte caches on this; serving the older
+        # embedded resourceVersion stays sound because no events exist
+        # for this segment between the two revisions)
+        self._seg_writes: Dict[str, int] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -103,9 +110,17 @@ class Store:
         for p in self._list_cache_seg.pop(self._seg(key), ()):
             self._list_cache.pop(p, None)
 
+    def write_version(self, prefix: str) -> int:
+        """Writes ever committed under the prefix's resource segment —
+        the validity token for cached LIST response bytes."""
+        with self._lock:
+            return self._seg_writes.get(self._seg(prefix), 0)
+
     def _record(self, rev: int, etype: str, key: str, obj: Any,
                 prev: Any) -> watchpkg.Event:
         """History-window bookkeeping for one committed write."""
+        seg = self._seg(key)
+        self._seg_writes[seg] = self._seg_writes.get(seg, 0) + 1
         self._invalidate_lists(key)
         if len(self._history) == self._history.maxlen:
             self._oldest_rev = self._history[0][0]
@@ -433,6 +448,9 @@ class Store:
                 out_append(new_obj)
             if staged:
                 self._rev = staged[-1][4]
+                for seg in segs:
+                    self._seg_writes[seg] = \
+                        self._seg_writes.get(seg, 0) + 1
                 if self._list_cache:
                     for seg in segs:
                         for p in self._list_cache_seg.pop(seg, ()):

@@ -78,13 +78,25 @@ class SLOResult:
         return self.api_calls >= MIN_API_SAMPLES
 
     @property
-    def api_ok(self) -> bool:
+    def api_ok(self) -> Optional[bool]:
         """The reference gate: NO (verb, resource) endpoint with a
         meaningful sample count runs p99 over the limit
         (metrics_util.go:194-200 counts violations per endpoint).
         ':batch' endpoints are reported but not gated — one 128-pod
         batch POST is not a representative single-request sample
-        (the server labels them out, api/server.py)."""
+        (the server labels them out, api/server.py).
+
+        COUPLED to the sample floor (the r3/r4 lesson, finally wired
+        in): a starved window returns None — a percentile gate that
+        'passed' on too few samples proves nothing and must never
+        read true."""
+        if not self.api_samples_valid:
+            return None
+        return self._api_gate()
+
+    def _api_gate(self) -> bool:
+        """The latency comparison alone, no sample-floor coupling —
+        check() applies its own (possibly relaxed) floor first."""
         worst = max((v["p99_ms"] for k, v in self.api_verbs.items()
                      if v["count"] >= MIN_ENDPOINT_SAMPLES
                      and not k.endswith(":batch")),
@@ -105,7 +117,7 @@ class SLOResult:
         assert self.api_calls >= min_samples, (
             f"API latency gate saw only {self.api_calls} samples "
             f"(need {min_samples})")
-        assert self.api_ok, (
+        assert self._api_gate(), (
             f"an API endpoint's p99 exceeds {self.api_p99_limit_s}s: "
             + str({k: v for k, v in self.api_verbs.items()
                    if v['p99_ms'] >= self.api_p99_limit_s * 1e3}))

@@ -55,3 +55,25 @@ def test_slo_check_raises_on_violation():
         startup_p50_s=1.0, startup_p90_s=2.0, startup_p99_s=3.0)
     with pytest.raises(AssertionError, match="6 samples"):
         starved.check()
+
+
+def test_api_gate_null_on_starved_samples():
+    """The r4 verdict's coupling bug: a starved sample window must
+    surface api_slo_ok as None (JSON null), never true."""
+    starved = SLOResult(
+        n_nodes=1, n_pods=1, running=1, elapsed_s=1.0,
+        api_p50_s=0.001, api_p90_s=0.002, api_p99_s=0.003, api_calls=257,
+        startup_p50_s=1.0, startup_p90_s=2.0, startup_p99_s=3.0,
+        api_verbs={"GET pods": {"count": 200, "p50_ms": 1.0,
+                                "p90_ms": 2.0, "p99_ms": 3.0}})
+    assert not starved.api_samples_valid
+    assert starved.api_ok is None
+    assert starved.as_dict()["api_slo_ok"] is None
+    # the same latencies with a full window gate true
+    full = SLOResult(
+        n_nodes=1, n_pods=1, running=1, elapsed_s=1.0,
+        api_p50_s=0.001, api_p90_s=0.002, api_p99_s=0.003, api_calls=5000,
+        startup_p50_s=1.0, startup_p90_s=2.0, startup_p99_s=3.0,
+        api_verbs={"GET pods": {"count": 5000, "p50_ms": 1.0,
+                                "p90_ms": 2.0, "p99_ms": 3.0}})
+    assert full.api_ok is True

@@ -278,6 +278,29 @@ def main():
             # a few seconds (per-point validity stays reported above)
             "api_samples_valid": total_calls >= MIN_API_SAMPLES}
 
+    # regenerate the multi-host DCN-path proof every round (4 procs x 2
+    # virtual CPU devices, bindings asserted bit-equal across
+    # processes) — a standing artifact, not a one-time capture
+    multihost = None
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "dryrun_multihost.py"),
+             "--procs", "4", "--out",
+             os.path.join(repo, "MULTIHOST.json")],
+            capture_output=True, text=True, timeout=600, cwd=repo)
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                multihost = json.loads(line)
+                break
+        if multihost is None:
+            multihost = {"multihost_dryrun_ok": False,
+                         "error": proc.stderr[-500:]}
+    except Exception as e:
+        multihost = {"multihost_dryrun_ok": False, "error": str(e)[:500]}
+
     print(json.dumps({
         "metric": "e2e_scheduling_throughput_5k_nodes",
         "value": round(r.pods_per_sec, 1),
@@ -293,6 +316,7 @@ def main():
         "probe": probe,
         "pallas": pallas,
         "slo": slo,
+        "multihost": multihost,
         "tpu": _tpu_section()}))
 
 

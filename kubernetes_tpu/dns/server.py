@@ -78,6 +78,13 @@ def decode_name(buf: bytes, off: int) -> Tuple[str, int]:
         if off >= len(buf):
             raise ValueError("truncated name")
         length = buf[off]
+        if length & 0xC0 == 0x40 or length & 0xC0 == 0x80:
+            # 0x40/0x80 high bits are reserved (RFC 1035 4.1.4 allows
+            # only 00 = label, 11 = pointer): treating them as label
+            # lengths would admit labels >63 bytes that encode_name
+            # later rejects INSIDE build_response — a malformed query
+            # must fail here, in the parse step handle_packet drops
+            raise ValueError(f"reserved label length 0x{length:02x}")
         if length & 0xC0 == 0xC0:  # pointer
             if off + 1 >= len(buf):
                 raise ValueError("truncated pointer")

@@ -459,6 +459,12 @@ def run_proxy(argv: List[str]) -> int:
                    help="fake iptables (the kubemark hollow-proxy morph; "
                         "without it, iptables mode execs the real binary "
                         "and needs netfilter privileges)")
+    p.add_argument("--nodeport-bind-address", default="",
+                   help="address NodePort listeners bind (userspace "
+                        "mode); empty = all interfaces, like the "
+                        "reference's claimNodePort — pass 127.0.0.1 to "
+                        "keep node ports loopback-only (the kube-proxy "
+                        "--bind-address role)")
     args = p.parse_args(argv)
 
     from .api.client import HttpClient
@@ -468,7 +474,8 @@ def run_proxy(argv: List[str]) -> int:
     client = HttpClient(args.master)
     if args.proxy_mode == "userspace":
         from .proxy.userspace import UserspaceProxier
-        proxier = UserspaceProxier(client).run()
+        proxier = UserspaceProxier(
+            client, node_address=args.nodeport_bind_address).run()
     else:
         from .proxy.proxier import IPTablesProxier
         ipt = FakeIPTables() if args.hollow else ExecIPTables()

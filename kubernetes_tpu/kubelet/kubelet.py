@@ -370,6 +370,15 @@ class Kubelet:
                     pod, "Normal", "DeadlineExceeded",
                     "Pod was active on the node longer than specified "
                     "deadline")
+            # intentional kills run PreStop hooks first, like every
+            # other kill path (dockertools/manager.go:1360
+            # killContainerInPod runs the hook before the stop)
+            for container in pod.spec.containers:
+                try:
+                    self._run_pre_stop(pod, container.name)
+                except Exception:
+                    logging.exception("pre-stop %s/%s", uid,
+                                      container.name)
             self.runtime.kill_pod(uid)
             self.status_manager.set_pod_status(pod, api.PodStatus(
                 phase=api.POD_FAILED, reason="DeadlineExceeded",
@@ -411,12 +420,17 @@ class Kubelet:
                 return
         if hasattr(self.runtime, "set_pod_dns"):
             # materialize the pod's resolver config before any container
-            # starts (the dockertools --dns/--dns-search role; idempotent)
-            try:
+            # starts (the dockertools --dns/--dns-search role;
+            # idempotent). A failure is a pod-wide setup failure like
+            # volumes/network: the sync stops and backs off instead of
+            # starting containers with no resolver config (the
+            # reference returns the getClusterDNS error from syncPod,
+            # kubelet.go:1465)
+            def _dns():
                 ns, search = self.get_cluster_dns(pod)
                 self.runtime.set_pod_dns(uid, ns, search)
-            except Exception:
-                logging.exception("set_pod_dns %s", uid)
+            if not _gated_setup("dns", _dns):
+                return
         if self.network_plugin is not None and uid not in self._networked:
             # network setup precedes every container (exec.go: setup
             # after infra create, before other containers)
@@ -651,10 +665,15 @@ class Kubelet:
             except OSError:
                 # transiently unreadable (non-atomic rewrite by the
                 # host's network manager): keep the last good parse
-                # rather than materializing a zero-nameserver config
-                if self._resolv_cache is not None:
-                    host_dns = self._resolv_cache[1]
-                    host_search = self._resolv_cache[2]
+                # rather than materializing a zero-nameserver config.
+                # With NO previous parse there is nothing safe to
+                # serve — propagate so the pod sync backs off and
+                # retries instead of starting the pod with broken DNS
+                # (the reference returns the error, kubelet.go:1465)
+                if self._resolv_cache is None:
+                    raise
+                host_dns = self._resolv_cache[1]
+                host_search = self._resolv_cache[2]
         cluster_first = (pod.spec.dns_policy or "ClusterFirst") \
             == "ClusterFirst"
         if cluster_first and not self.cluster_dns:

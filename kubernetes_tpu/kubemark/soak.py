@@ -96,6 +96,14 @@ class SoakResult:
         assert f["store_keys"] <= b["store_keys"] + KEY_GROWTH_LIMIT, (
             f"store keys grew {b['store_keys']} -> {f['store_keys']} "
             f"(deleted pods accreting?)")
+        if "ledger" in f:
+            assert f["ledger"] <= b.get("ledger", 0) + KEY_GROWTH_LIMIT, (
+                f"incremental-encoder ledger grew {b.get('ledger')} -> "
+                f"{f['ledger']} (deleted pods not removed from the "
+                f"device state)")
+            assert f.get("ledger_unknown_node", 0) <= \
+                b.get("ledger_unknown_node", 0) + KEY_GROWTH_LIMIT, (
+                "unknown-node bucket accreting")
         mid = len(self.samples) // 2
         first_peak = max(s["tombstones"] for s in self.samples[:mid + 1])
         second_peak = max(s["tombstones"] for s in self.samples[mid:])
@@ -146,6 +154,14 @@ def run_soak(duration_s: float = 600.0, n_nodes: int = 200,
         with modeler._lock:
             tombs = len(modeler._forgotten)
             assumed = len(modeler._assumed._items)
+        inc = sched._inc
+        if inc is not None:
+            with inc._lock:
+                ledger = len(inc.pods)
+                unknown = sum(len(v) for v in
+                              inc.unknown_node_pods.values())
+        else:
+            ledger = unknown = 0
         samples.append({
             "t": round(time.time() - t0, 1),
             "rss_kb": _rss_kb(),
@@ -153,6 +169,8 @@ def run_soak(duration_s: float = 600.0, n_nodes: int = 200,
             "store_keys": keys,
             "tombstones": tombs,
             "assumed": assumed,
+            "ledger": ledger,
+            "ledger_unknown_node": unknown,
             "threads": threading.active_count()})
 
     def wait_until(cond, timeout_s: float = 120.0) -> bool:
@@ -185,9 +203,10 @@ def run_soak(duration_s: float = 600.0, n_nodes: int = 200,
             base = cycles * pods_per_cycle
             names = [f"bench-pod-{base + i:06d}"
                      for i in range(pods_per_cycle)]
-            client.create_batch(
-                "pods", [_bench_pod(base + i)
-                         for i in range(pods_per_cycle)], "default")
+            # columnar create: the production writers' path (template
+            # + name rows) soaks too, not just object-per-pod creates
+            client.create_from_template("pods", _bench_pod(0), names,
+                                        "default")
 
             def all_running():
                 pods, _ = registry.list("pods", "default")
@@ -238,11 +257,25 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=200)
     ap.add_argument("--pods-per-cycle", type=int, default=200)
     ap.add_argument("--no-check", action="store_true")
+    ap.add_argument("--out", default="",
+                    help="write the result JSON to this file as well")
     args = ap.parse_args()
     r = run_soak(args.minutes * 60.0, args.nodes, args.pods_per_cycle)
-    print(json.dumps({"metric": "soak", **r.as_dict()}))
-    if not args.no_check:
+    doc = {"metric": "soak", "nodes": args.nodes,
+           "pods_per_cycle": args.pods_per_cycle, **r.as_dict()}
+    try:
         r.check()
+        doc["gates"] = {"ok": True}
+    except AssertionError as e:
+        doc["gates"] = {"ok": False, "reason": str(e)}
+    # the artifact records failures too — a failed round must not
+    # leave the previous round's ok:true on disk
+    if args.out:
+        from .tpu_evidence import _atomic_write_json
+        _atomic_write_json(args.out, doc)
+    print(json.dumps(doc))
+    if not args.no_check and not doc["gates"]["ok"]:
+        raise SystemExit(f"soak gate failed: {doc['gates']['reason']}")
 
 
 if __name__ == "__main__":

@@ -289,9 +289,15 @@ class UserspaceProxier:
 
     def __init__(self, client=None,
                  balancer: Optional[RoundRobinLoadBalancer] = None,
-                 udp_idle_timeout: float = 10.0):
+                 udp_idle_timeout: float = 10.0,
+                 node_address: str = ""):
         self.balancer = balancer or RoundRobinLoadBalancer()
         self.udp_idle_timeout = udp_idle_timeout
+        # NodePort listeners bind this address; "" = wildcard, so node
+        # ports are reachable from other hosts like the reference's
+        # claimNodePort (proxier.go) — portal-port proxies stay on
+        # loopback (they stand in for virtual service IPs)
+        self.node_address = node_address
         self._proxies: Dict[Tuple[str, str, str], object] = {}
         self._node_proxies: Dict[Tuple[str, str, str], object] = {}
         self._last_wanted: Dict[Tuple[str, str, str],
@@ -357,9 +363,11 @@ class UserspaceProxier:
             try:
                 self._node_proxies[key] = (
                     _UdpPortProxy(self.balancer, key, port=node_port,
+                                  host=self.node_address,
                                   idle_timeout=self.udp_idle_timeout)
                     if proto == "UDP"
-                    else _PortProxy(self.balancer, key, port=node_port))
+                    else _PortProxy(self.balancer, key, port=node_port,
+                                    host=self.node_address))
             except OSError as e:
                 logging.warning("node port %d for %s: %s", node_port,
                                 "/".join(key[:2]), e)

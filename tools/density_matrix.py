@@ -83,11 +83,8 @@ def main() -> None:
         "gate_coupling": "api_slo_ok is null unless every point met the "
                          "server-side sample floor (kubemark/slo.py)",
     }
-    tmp = args.out + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
-    os.replace(tmp, args.out)
+    from kubernetes_tpu.kubemark.tpu_evidence import _atomic_write_json
+    _atomic_write_json(args.out, doc)
     print(json.dumps({"out": args.out, "api_slo_ok": doc["api_slo_ok"],
                       "startup_slo_ok": doc["startup_slo_ok"]}))
 

@@ -11,9 +11,14 @@ jax.distributed + Mesh code path is identical, only the transport
 differs). Bindings are asserted bit-equal to a single-process run of
 the same encode.
 
-Launcher:  python tools/dryrun_multihost.py [--procs 2]
+Launcher:  python tools/dryrun_multihost.py [--procs 4]
+               [--devices-per-proc 2] [--out MULTIHOST.json]
 Worker:    python tools/dryrun_multihost.py --worker <id> --procs N \
                --port P   (spawned by the launcher)
+
+The launcher writes MULTIHOST.json so the DCN-path proof is a standing
+per-round artifact (bench.py regenerates it every round), not a
+one-time capture.
 """
 
 import argparse
@@ -25,13 +30,14 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DEVICES_PER_PROC = 4
+DEVICES_PER_PROC = 2
 
 
-def worker(proc_id: int, nprocs: int, port: int) -> None:
+def worker(proc_id: int, nprocs: int, port: int,
+           devices_per_proc: int = DEVICES_PER_PROC) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = \
-        f"--xla_force_host_platform_device_count={DEVICES_PER_PROC}"
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
@@ -46,7 +52,7 @@ def worker(proc_id: int, nprocs: int, port: int) -> None:
     from kubernetes_tpu.sched.device import BatchEngine, encode_snapshot
 
     n_global = jax.device_count()
-    assert n_global == nprocs * DEVICES_PER_PROC, n_global
+    assert n_global == nprocs * devices_per_proc, n_global
     mesh = Mesh(np.array(jax.devices()), ("nodes",))
     engine = BatchEngine(mesh=mesh)
     assert engine.spans_processes
@@ -82,15 +88,18 @@ def worker(proc_id: int, nprocs: int, port: int) -> None:
           f"{json.dumps(assigned.tolist())}", flush=True)
 
 
-def launch(nprocs: int) -> int:
+def launch(nprocs: int, devices_per_proc: int = DEVICES_PER_PROC,
+           out_path: str = "") -> int:
     import socket
+    import time
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
-             str(i), "--procs", str(nprocs), "--port", str(port)],
+             str(i), "--procs", str(nprocs), "--port", str(port),
+             "--devices-per-proc", str(devices_per_proc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
         for i in range(nprocs)]
@@ -116,21 +125,32 @@ def launch(nprocs: int) -> int:
     if len(payloads) != 1:
         ok = False
         print(f"processes disagree: {payloads}", file=sys.stderr)
-    print(json.dumps({"multihost_dryrun_ok": ok, "processes": nprocs,
-                      "global_devices": nprocs * DEVICES_PER_PROC}))
+    doc = {"multihost_dryrun_ok": ok, "processes": nprocs,
+           "devices_per_proc": devices_per_proc,
+           "global_devices": nprocs * devices_per_proc,
+           "bindings_agree_across_processes": len(payloads) == 1,
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    if out_path:
+        from kubernetes_tpu.kubemark.tpu_evidence import _atomic_write_json
+        _atomic_write_json(out_path, doc)
+    print(json.dumps(doc))
     return 0 if ok else 1
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--worker", type=int, default=None)
-    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--procs", type=int, default=4)
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--devices-per-proc", type=int,
+                    default=DEVICES_PER_PROC)
+    ap.add_argument("--out", default="")
     args = ap.parse_args()
     if args.worker is not None:
-        worker(args.worker, args.procs, args.port)
+        worker(args.worker, args.procs, args.port,
+               args.devices_per_proc)
         return 0
-    return launch(args.procs)
+    return launch(args.procs, args.devices_per_proc, args.out)
 
 
 if __name__ == "__main__":

@@ -300,6 +300,11 @@ def main():
                          "error": proc.stderr[-500:]}
     except Exception as e:
         multihost = {"multihost_dryrun_ok": False, "error": str(e)[:500]}
+    if not multihost.get("multihost_dryrun_ok"):
+        # a failed round must not leave the previous round's ok:true
+        # artifact on disk (same contract as the soak artifact)
+        from kubernetes_tpu.kubemark.tpu_evidence import _atomic_write_json
+        _atomic_write_json(os.path.join(repo, "MULTIHOST.json"), multihost)
 
     print(json.dumps({
         "metric": "e2e_scheduling_throughput_5k_nodes",

@@ -178,8 +178,11 @@ class HollowFleet:
             # confirm them Running in ONE batched store pass instead of
             # per-pod writes fighting the GIL (per-object semantics are
             # unchanged; see registry.update_status_batch)
+            # 1024 bounds the store-lock window (an 8k-pod status tile
+            # held the lock long enough to push concurrent API reads
+            # over the latency SLO; see sched/batch.py commit_chunk)
             batch = [pod]
-            while len(batch) < 4096:
+            while len(batch) < 1024:
                 try:
                     nxt = self._status_q.get_nowait()
                 except queue.Empty:

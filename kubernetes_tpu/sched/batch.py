@@ -485,26 +485,36 @@ class BatchScheduler:
                 for p, h in scheduled]
         bind_start = time.monotonic()
         committed: List[bool] = [False] * len(rows)
-        try:
-            f.client.bind_batch_hosts(rows)
-            committed = [True] * len(rows)
-        except Exception:
-            # all-or-nothing tile failed (e.g. a pod got bound by
-            # another scheduler mid-flight): degrade to per-pod CAS so
-            # one conflict doesn't waste the whole tile
-            for i, (ns, name, host) in enumerate(rows):
-                try:
-                    f.client.bind(api.Binding(
-                        metadata=api.ObjectMeta(namespace=ns, name=name),
-                        target=api.ObjectReference(kind="Node", name=host)))
-                    committed[i] = True
-                except Exception as e:
-                    pod = scheduled[i][0]
-                    if f.recorder is not None:
-                        f.recorder.eventf(pod, "Normal",
-                                          "FailedScheduling",
-                                          f"Binding rejected: {e}")
-                    self._error(pod, e)
+        # commit in bounded sub-batches: one 8k-pod store window holds
+        # the store lock for hundreds of ms and every concurrent API
+        # read queues behind it (the 5k-density GET-nodes p99). Each
+        # sub-batch keeps all-or-nothing CAS semantics; the per-pod
+        # fallback scopes a conflict to its sub-batch.
+        commit_chunk = 1024
+        for lo in range(0, len(rows), commit_chunk):
+            part = rows[lo:lo + commit_chunk]
+            try:
+                f.client.bind_batch_hosts(part)
+                committed[lo:lo + len(part)] = [True] * len(part)
+            except Exception:
+                # sub-batch failed (e.g. a pod got bound by another
+                # scheduler mid-flight): degrade to per-pod CAS so one
+                # conflict doesn't waste the rest
+                for i, (ns, name, host) in enumerate(part, start=lo):
+                    try:
+                        f.client.bind(api.Binding(
+                            metadata=api.ObjectMeta(namespace=ns,
+                                                    name=name),
+                            target=api.ObjectReference(kind="Node",
+                                                       name=host)))
+                        committed[i] = True
+                    except Exception as e:
+                        pod = scheduled[i][0]
+                        if f.recorder is not None:
+                            f.recorder.eventf(pod, "Normal",
+                                              "FailedScheduling",
+                                              f"Binding rejected: {e}")
+                        self._error(pod, e)
         c.metrics.observe("binding_latency_microseconds",
                           (time.monotonic() - bind_start) * 1e6)
         to_assume = []

@@ -136,7 +136,8 @@ def _floordiv_exact(num: jax.Array, den: jax.Array,
 def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
                     anti_weight: int, state: State, pod,
                     has_aff: bool = True, has_spread: bool = True,
-                    iota: Optional[jax.Array] = None
+                    iota: Optional[jax.Array] = None,
+                    spread_max_override: Optional[jax.Array] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Predicate mask + priority totals for ONE pod against `state`.
 
@@ -233,7 +234,15 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
     if has_spread:
         gid = jnp.maximum(pod.group_id, 0)
         counts = state.spread[gid]
-        max_count = jnp.maximum(jnp.max(counts), node.offgrid_max[gid])
+        # spread_max_override: the speculative repair rescored a
+        # GATHERED lane set whose local max is not the global one — it
+        # passes the block-start per-group max (exact while the
+        # group's max-exceeded flag is unset; see _spec_step)
+        if spread_max_override is None:
+            max_count = jnp.maximum(jnp.max(counts),
+                                    node.offgrid_max[gid])
+        else:
+            max_count = spread_max_override[gid]
         spread_f = (10.0 * (max_count - counts).astype(jnp.float64)
                     / jnp.maximum(max_count, 1).astype(jnp.float64))
         spread = jnp.where((pod.group_id < 0) | (max_count == 0),
@@ -413,14 +422,16 @@ def _make_probe(weights: Tuple[int, int, int], anti_weight: int = 0,
 # node-local.
 # ---------------------------------------------------------------------------
 
-def _make_spec_pass(weights: Tuple[int, int, int]):
+def _make_spec_pass(weights: Tuple[int, int, int],
+                    has_spread: bool = False):
     """Batched frozen-state composite scores: -> i[P, N] (-1 = no fit)."""
     def spec_pass(node: NodeConst, state: State, pods: PodXs):
         n = node.valid.shape[0]
 
         def one(pod):
             mask, total = _mask_and_score(node, weights, 0, state, pod,
-                                          has_aff=False, has_spread=False)
+                                          has_aff=False,
+                                          has_spread=has_spread)
             return jnp.where(mask, total * n + node.tie_rank,
                              jnp.full((), -1, total.dtype))
 
@@ -450,56 +461,89 @@ def _gather_lanes(node: NodeConst, state: State, tidx: jax.Array,
         nz_cpu=state.nz_cpu[tidx], nz_mem=state.nz_mem[tidx],
         pod_count=state.pod_count[tidx], port_bits=state.port_bits[tidx],
         disk_any=state.disk_any[tidx], disk_rw=state.disk_rw[tidx],
-        spread=state.spread, aff_count=state.aff_count,
+        spread=state.spread[:, tidx], aff_count=state.aff_count,
         aff_total=state.aff_total, svc_count=state.svc_count,
         svc_total=state.svc_total)
     return g, s
 
 
 def _spec_step(node: NodeConst, weights: Tuple[int, int, int],
-               carry, x):
+               carry, x, has_spread: bool = False):
     """One repair step: exact sequential argmax for pod k from
     (frozen row over untouched nodes) + (rescored touched lanes),
-    then the same O(1) scatter commit as the scan step."""
-    state, touched, touched_idx, k = carry
+    then the same O(1) scatter commit as the scan step.
+
+    Spread tier (has_spread): the frozen row stays exact on untouched
+    nodes only while the pod's group max-count equals its block-start
+    value — commits can only RAISE counts, so a per-group flag latches
+    the first time any count exceeds the block-start max, and flagged
+    groups' pods take a full-width rescore (the scan step's math)
+    under lax.cond. Unflagged groups rescore touched lanes with the
+    block-start max as the override — exact by the latch invariant."""
+    state, touched, touched_idx, k, flag, max_start = carry
     pod, row = x
     n = node.valid.shape[0]
     t = touched_idx.shape[0]
     neg = jnp.full((), -1, row.dtype)
 
-    # untouched nodes: frozen scores are exact (node-local tier)
-    frozen = jnp.where(touched, neg, row)
-    fi = jnp.argmax(frozen).astype(jnp.int32)
-    fv = frozen[fi]
+    def fast(_):
+        # untouched nodes: frozen scores exact (node-local + unflagged
+        # spread); touched lanes: exact rescore against current state
+        frozen = jnp.where(touched, neg, row)
+        fi = jnp.argmax(frozen).astype(jnp.int32)
+        fv = frozen[fi]
+        lane_valid = (jnp.arange(t, dtype=jnp.int32) < k) \
+            & (touched_idx >= 0)
+        tidx = jnp.maximum(touched_idx, 0)
+        gnode, gstate = _gather_lanes(node, state, tidx, lane_valid)
+        mask_t, total_t = _mask_and_score(
+            gnode, weights, 0, gstate, pod, has_aff=False,
+            has_spread=has_spread, iota=tidx,
+            spread_max_override=max_start if has_spread else None)
+        comp_t = jnp.where(mask_t, total_t * n + gnode.tie_rank, neg)
+        tl = jnp.argmax(comp_t)
+        tv = comp_t[tl]
+        ti = tidx[tl]
+        return (jnp.where(tv > fv, ti, fi).astype(jnp.int32),
+                jnp.maximum(tv, fv) >= 0)
 
-    # touched lanes: exact rescore against current state
-    lane_valid = (jnp.arange(t, dtype=jnp.int32) < k) & (touched_idx >= 0)
-    tidx = jnp.maximum(touched_idx, 0)
-    gnode, gstate = _gather_lanes(node, state, tidx, lane_valid)
-    mask_t, total_t = _mask_and_score(gnode, weights, 0, gstate, pod,
-                                      has_aff=False, has_spread=False,
-                                      iota=tidx)
-    comp_t = jnp.where(mask_t, total_t * n + gnode.tie_rank, neg)
-    tl = jnp.argmax(comp_t)
-    tv = comp_t[tl]
-    ti = tidx[tl]
+    if has_spread:
+        def slow(_):
+            # group max moved since block start: the frozen row is
+            # globally stale for this pod — full-width rescore against
+            # current state (exactly the scan step's selection math)
+            mask, total = _mask_and_score(node, weights, 0, state, pod,
+                                          has_aff=False, has_spread=True)
+            composite = jnp.where(mask, total * n + node.tie_rank, neg)
+            pick = jnp.argmax(composite).astype(jnp.int32)
+            return pick, composite[pick] >= 0
 
-    pick = jnp.where(tv > fv, ti, fi)
-    fit_any = jnp.maximum(tv, fv) >= 0
+        stale = (pod.group_id >= 0) & flag[jnp.maximum(pod.group_id, 0)]
+        pick, fit_any = jax.lax.cond(stale, slow, fast, operand=None)
+    else:
+        pick, fit_any = fast(None)
     assigned = jnp.where(fit_any, pick, jnp.int32(-1))
 
-    # commit: the scan step's scatter update, global tiers carried
-    # through untouched (the spec path only runs when they're inactive)
+    # commit: the scan step's scatter update; spread counts join when
+    # the tier is active, other global tiers stay untouched (the spec
+    # path never runs with them)
     j = jnp.maximum(pick, 0)
-    fields, _add32 = _commit_node_local(state, pod, j, fit_any)
+    fields, add32 = _commit_node_local(state, pod, j, fit_any)
+    if has_spread:
+        new_spread = state.spread.at[:, j].add(add32 * pod.member)
+        flag = flag | (fit_any & (pod.member > 0)
+                       & (state.spread[:, j] + pod.member > max_start))
+    else:
+        new_spread = state.spread
     new_state = State(
         **fields,
-        spread=state.spread, aff_count=state.aff_count,
+        spread=new_spread, aff_count=state.aff_count,
         aff_total=state.aff_total, svc_count=state.svc_count,
         svc_total=state.svc_total)
     touched = touched.at[j].set(touched[j] | fit_any)
     touched_idx = touched_idx.at[k].set(assigned)
-    return (new_state, touched, touched_idx, k + 1), assigned
+    return ((new_state, touched, touched_idx, k + 1, flag, max_start),
+            assigned)
 
 
 # The repair step is small enough that loop overhead shows again; a mild
@@ -517,10 +561,11 @@ SPEC_UNROLL = 4
 SPEC_BLOCK = 256
 
 
-def _make_spec_run(weights: Tuple[int, int, int], block: int = SPEC_BLOCK):
+def _make_spec_run(weights: Tuple[int, int, int],
+                   has_spread: bool = False, block: int = SPEC_BLOCK):
     """Same (node, state, pods) -> (final_state, assigned) signature as
     _make_run — drop-in for the scan wherever the encode is eligible."""
-    spec_pass = _make_spec_pass(weights)
+    spec_pass = _make_spec_pass(weights, has_spread)
 
     def run(node: NodeConst, state: State, pods: PodXs):
         p = pods.valid.shape[0]
@@ -536,17 +581,25 @@ def _make_spec_run(weights: Tuple[int, int, int], block: int = SPEC_BLOCK):
         pods_b = jax.tree_util.tree_map(
             lambda a: a.reshape((nb, b) + a.shape[1:]), pods)
         n = node.valid.shape[0]
+        g = state.spread.shape[0]
 
         def outer(state, pblock):
             comp = spec_pass(node, state, pblock)               # [b, N]
             touched = jnp.zeros(n, bool)
             tidx0 = jnp.full((b,), -1, jnp.int32)
+            # block-start per-group max counts (the latch reference
+            # for the spread tier; see _spec_step)
+            max_start = jnp.maximum(jnp.max(state.spread, axis=1),
+                                    node.offgrid_max)               # [G]
+            flag = jnp.zeros(g, bool)
 
             def step(carry, x):
-                return _spec_step(node, weights, carry, x)
+                return _spec_step(node, weights, carry, x,
+                                  has_spread=has_spread)
 
-            (state2, _, _, _), assigned = jax.lax.scan(
-                step, (state, touched, tidx0, jnp.int32(0)),
+            (state2, _, _, _, _, _), assigned = jax.lax.scan(
+                step, (state, touched, tidx0, jnp.int32(0), flag,
+                       max_start),
                 (pblock, comp), unroll=SPEC_UNROLL)
             return state2, assigned
 
@@ -622,14 +675,17 @@ class BatchEngine:
         return self._speculative
 
     def _get_run(self, has_aff: bool, has_spread: bool):
-        spec = (not has_aff and not has_spread and not self._anti_weight
+        # speculative covers the node-local tiers AND the spread tier
+        # (block-start-max latch); inter-pod affinity and service-anti
+        # scores move globally per commit — those keep the scan
+        spec = (not has_aff and not self._anti_weight
                 and self.speculative)
-        key = ("spec",) if spec else (has_aff, has_spread)
+        key = ("spec", has_spread) if spec else (has_aff, has_spread)
         cached = self._runs.get(key)
         if cached is not None:
             return cached
         if spec:
-            jitted = jax.jit(_make_spec_run(self.weights))
+            jitted = jax.jit(_make_spec_run(self.weights, has_spread))
         else:
             run = _make_run(self.weights, self._anti_weight,
                             has_aff=has_aff, has_spread=has_spread)

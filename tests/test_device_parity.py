@@ -318,6 +318,43 @@ def test_speculative_matches_scan_and_oracle(seed):
     assert spec == oracle_schedule(snap)
 
 
+@pytest.mark.parametrize("seed", range(6))
+def test_speculative_spread_tier_matches_scan_and_oracle(seed):
+    """The spread tier rides the speculative engine via the
+    block-start-max latch (stale groups take the full-rescore cond
+    branch) — bit parity must hold with services/RCs active."""
+    snap = rand_cluster(seed)  # services + RCs -> has_spread
+    eng = BatchEngine(speculative=True)
+    spec = eng.schedule(snap)[0]
+    assert ("spec", True) in eng._runs  # the spread spec program ran
+    scan = BatchEngine(speculative=False).schedule(snap)[0]
+    assert spec == scan
+    assert spec == oracle_schedule(snap)
+
+
+def test_speculative_spread_latch_exercised():
+    """Pods of ONE service landing on few nodes push group counts past
+    the block-start max inside a block — the latch must fire and the
+    slow path must keep parity (identical pods amplify ties)."""
+    nodes = [make_node(f"n-{i:02d}", 4000, 2048 * MI, 110)
+             for i in range(3)]
+    pods = [api.Pod(
+        metadata=api.ObjectMeta(name=f"w-{j:03d}", namespace="default",
+                                labels={"app": "web"}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(requests={
+                "cpu": mq(10), "memory": bq(MI)}))]))
+        for j in range(40)]
+    svcs = [api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(selector={"app": "web"}))]
+    snap = ClusterSnapshot(nodes=nodes, services=svcs, pending_pods=pods)
+    spec = BatchEngine(speculative=True).schedule(snap)[0]
+    assert spec == BatchEngine(speculative=False).schedule(snap)[0]
+    assert spec == oracle_schedule(snap)
+
+
 def test_speculative_tight_capacity_and_no_fit():
     # heavy oversubscription: repair steps see touched-lane wins AND
     # no-fit pods (assigned -1 -> touched_idx sentinel lanes)
@@ -343,12 +380,23 @@ def test_speculative_chunked_matches_scan_chunked():
     assert np.array_equal(a_scan, a_spec)
 
 
-def test_speculative_falls_back_on_global_tiers():
-    """A snapshot with spread groups must take the scan path (the
-    speculative engine's node-local premise fails there) and still
-    match the oracle."""
-    snap = rand_cluster(3)  # services + RCs present -> has_spread
+def test_speculative_falls_back_on_affinity():
+    """Inter-pod affinity scores move globally per commit — those
+    batches must take the scan path and still match the oracle."""
+    term = api.PodAffinityTerm(label_selector={"app": "web"},
+                               topology_key="zone")
+    nodes = [make_node(f"n-{i:02d}", 4000, 2048 * MI, 110,
+                       labels={"zone": f"z{i % 2}"}) for i in range(4)]
+    pods = [api.Pod(
+        metadata=api.ObjectMeta(name=f"a-{j:02d}", namespace="default",
+                                labels={"app": "web"}),
+        spec=api.PodSpec(
+            containers=[api.Container(name="c", image="i")],
+            affinity=api.Affinity(pod_affinity=api.PodAffinity(
+                required_during_scheduling=[term]))))
+        for j in range(6)]
+    snap = ClusterSnapshot(nodes=nodes, pending_pods=pods)
     eng = BatchEngine(speculative=True)
-    got = eng.schedule(snap)[0]
-    assert ("spec",) not in eng._runs
-    assert got == oracle_schedule(snap)
+    eng.schedule(snap)[0]
+    assert not any(k[0] == "spec" for k in eng._runs
+                   if isinstance(k, tuple))

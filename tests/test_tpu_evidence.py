@@ -114,3 +114,26 @@ def test_merge_tolerates_missing_and_corrupt_best_file(tmp_path):
     merge_best(_doc("t1", 40000.0, 3700.0, 71.0), path)
     best = json.load(open(path))["sections"]
     assert best["e2e"]["ts"] == "t1"
+
+
+def test_crossover_section_math(monkeypatch, tmp_path):
+    """Rate-vs-rate crossover: the TPU term embeds dispatch already, so
+    the verdict is a per-shape rate comparison; the CPU reference is
+    cached as a box constant, not re-measured per capture."""
+    from kubernetes_tpu.kubemark import tpu_evidence as ev
+
+    cache = tmp_path / ev._CPU_RATE_CACHE
+    cache.write_text(
+        '{"1000x3000": 120000.0, "5000x30000": 28000.0, "ts": "t"}')
+    monkeypatch.setattr(ev.os.path, "dirname",
+                        lambda p, _d=ev.os.path.dirname: str(tmp_path))
+    sections = {"engine": {
+        "1000x3000": {"pods_per_sec": 60000.0},
+        "5000x30000": {"pods_per_sec": 200000.0}}}
+    out = ev._section_crossover(sections)
+    assert out["shapes"]["5000x30000"]["tpu_wins"] is True
+    assert out["shapes"]["1000x3000"]["tpu_wins"] is False
+    assert "5000x30000: device wins" in out["verdict"]
+    assert "1000x3000: cpu-fallback wins" in out["verdict"]
+    # missing engine section -> skipped, not crash
+    assert ev._section_crossover({})["status"] == "skipped"

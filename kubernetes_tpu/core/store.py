@@ -69,6 +69,13 @@ class Store:
         # bucket (O(1) per write, no prefix scan).
         self._list_cache: Dict[str, List[Any]] = {}
         self._list_cache_seg: Dict[str, set] = {}
+        # per-cached-prefix key -> position map: a MODIFIED write (same
+        # key set, same (ns, name) sort order) PATCHES the snapshot
+        # element in place instead of dropping the whole snapshot — a
+        # 5k-node heartbeat sweep otherwise forces every subsequent
+        # LIST into a full bucket re-scan + re-sort for a minute
+        # (DENSITY.json 5000x30's GET-nodes tail)
+        self._list_cache_idx: Dict[str, Dict[str, int]] = {}
         # resources that ever stored a TTL'd entry (events): their
         # lists are never cached — expiry is passive, so a snapshot
         # could serve an expired object with no write to invalidate it
@@ -124,6 +131,33 @@ class Store:
             return
         for p in self._list_cache_seg.pop(self._seg(key), ()):
             self._list_cache.pop(p, None)
+            self._list_cache_idx.pop(p, None)
+
+    def _patch_lists(self, key: str, obj: Any) -> None:
+        """A value-only write (MODIFIED: key set and sort order
+        unchanged) swaps the object into any cached snapshot covering
+        it; snapshots that predate the key fall back to invalidation.
+        Safe against outstanding readers: list() hands out COPIES, so
+        an in-place element swap never mutates a caller's list."""
+        if not self._list_cache:
+            return
+        seg = self._seg(key)
+        prefixes = self._list_cache_seg.get(seg)
+        if not prefixes:
+            return
+        drop = []
+        for p in prefixes:
+            if not key.startswith(p):
+                continue
+            pos = self._list_cache_idx.get(p, {}).get(key)
+            if pos is None:
+                drop.append(p)
+                continue
+            self._list_cache[p][pos] = obj
+        for p in drop:
+            self._list_cache.pop(p, None)
+            self._list_cache_idx.pop(p, None)
+            prefixes.discard(p)
 
     def write_version(self, prefix: str) -> int:
         """Writes ever committed under the prefix's resource segment —
@@ -136,7 +170,10 @@ class Store:
         """History-window bookkeeping for one committed write."""
         seg = self._seg(key)
         self._seg_writes[seg] = self._seg_writes.get(seg, 0) + 1
-        self._invalidate_lists(key)
+        if etype == watchpkg.MODIFIED:
+            self._patch_lists(key, obj)
+        else:
+            self._invalidate_lists(key)
         if len(self._history) == self._history.maxlen:
             self._oldest_rev = self._history[0][0]
         self._history.append((rev, etype, key, obj, prev))
@@ -473,9 +510,10 @@ class Store:
                     self._seg_writes[seg] = \
                         self._seg_writes.get(seg, 0) + 1
                 if self._list_cache:
-                    for seg in segs:
-                        for p in self._list_cache_seg.pop(seg, ()):
-                            self._list_cache.pop(p, None)
+                    # all batch events are MODIFIED: patch snapshots in
+                    # place (key set and sort order unchanged)
+                    for key, new_obj, _stored, _exp, _rev in staged:
+                        self._patch_lists(key, new_obj)
             # one send per watcher for the whole tile, not per object
             # (the fan-out was ~half the measured binding commit cost)
             self._fanout(batch_events)
@@ -522,6 +560,29 @@ class Store:
             else:
                 keys = [k for k in self._data if k.startswith(prefix)]
             data = self._data
+            if cacheable:
+                # (key, obj) pairs survive the sort so the snapshot's
+                # key->position index can be built for in-place
+                # MODIFIED patching; uncacheable paths (predicates,
+                # coarse prefixes, TTL segs) skip the pair overhead
+                pairs = []
+                for k in keys:
+                    e = data[k]
+                    if not self._expired(e, now):
+                        pairs.append((k, e[0]))
+                pairs.sort(key=lambda ko: (ko[1].metadata.namespace,
+                                           ko[1].metadata.name))
+                items = [o for _k, o in pairs]
+                if len(self._list_cache) >= 64:
+                    self._list_cache.clear()
+                    self._list_cache_seg.clear()
+                    self._list_cache_idx.clear()
+                self._list_cache[prefix] = items
+                self._list_cache_idx[prefix] = {
+                    k: i for i, (k, _o) in enumerate(pairs)}
+                self._list_cache_seg.setdefault(self._seg(prefix),
+                                                set()).add(prefix)
+                return list(items), self._rev
             items = []
             for k in keys:
                 e = data[k]
@@ -529,15 +590,8 @@ class Store:
                     items.append(e[0])
             if predicate is not None:
                 items = [o for o in items if predicate(o)]
-            items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
-            if cacheable:
-                if len(self._list_cache) >= 64:
-                    self._list_cache.clear()
-                    self._list_cache_seg.clear()
-                self._list_cache[prefix] = items
-                self._list_cache_seg.setdefault(self._seg(prefix),
-                                                set()).add(prefix)
-                return list(items), self._rev
+            items.sort(key=lambda o: (o.metadata.namespace,
+                                      o.metadata.name))
             return items, self._rev
 
     # ------------------------------------------------------------- watch

@@ -156,6 +156,15 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
     bench pods x 100m does not fit a 4-CPU node; the reference's
     50/100-pods-per-node tiers run on clusters sized for them,
     density.go:203-208)."""
+    # a LATENCY benchmark wants short GIL slices: with ~40 runnable
+    # threads at the throughput-tuned 5ms interval, one API request can
+    # queue behind 200ms+ of scheduler/binder slices — the GET-nodes
+    # p99 tail at 5k density was exactly that. 1ms trades a little
+    # throughput for request-latency fairness (the reference's
+    # apiserver is its own OS-scheduled process; this is the in-proc
+    # analogue).
+    import sys as _sys
+    _sys.setswitchinterval(0.001)
     registry = Registry()
     metrics = MetricsRegistry()   # per-run registry: no cross-run mixing
     server = ApiServer(registry, port=0, metrics=metrics).start()

@@ -252,6 +252,12 @@ def main() -> None:
     import argparse
     import json
 
+    # probe-or-fallback BEFORE any jax touch: a wedged tunnel must
+    # degrade the soak to the CPU platform, not kill it at import
+    # (the same ensure_live_platform every bench entry uses)
+    from ..utils.platform import ensure_live_platform
+    ensure_live_platform()
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--minutes", type=float, default=10.0)
     ap.add_argument("--nodes", type=int, default=200)

@@ -505,3 +505,53 @@ def test_list_snapshot_cache_semantics():
     assert len(s.list("/registry/events/default/")[0]) == 1
     _time.sleep(0.08)
     assert len(s.list("/registry/events/default/")[0]) == 0
+
+
+def test_list_snapshot_patched_in_place_on_modify():
+    """MODIFIED writes patch cached list snapshots (key set and order
+    unchanged) instead of invalidating them; creates/deletes still
+    invalidate. The heartbeat-sweep LIST tail depends on this."""
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.core.store import Store
+
+    def mk(name, phase="Pending"):
+        return api.Pod(metadata=api.ObjectMeta(name=name, namespace="d"),
+                       spec=api.PodSpec(), status=api.PodStatus(phase=phase))
+
+    s = Store()
+    for i in range(5):
+        s.create(f"/registry/pods/d/p{i}", mk(f"p{i}"))
+    items, _ = s.list("/registry/pods/d/")     # snapshot cached
+    assert [p.metadata.name for p in items] == [f"p{i}" for i in range(5)]
+    # a status update must appear in the next (cached) list
+    s.guaranteed_update("/registry/pods/d/p2",
+                        lambda p: api.fast_replace(
+                            p, status=api.PodStatus(phase="Running")))
+    assert "/registry/pods/d/" in s._list_cache  # snapshot survived
+    items2, _ = s.list("/registry/pods/d/")
+    assert [p.metadata.name for p in items2] == \
+        [f"p{i}" for i in range(5)]             # order unchanged
+    assert items2[2].status.phase == "Running"  # patched element
+    # the earlier copy is untouched (point-in-time semantics)
+    assert items[2].status.phase == "Pending"
+    # a create invalidates (key set changed)
+    s.create("/registry/pods/d/p9", mk("p9"))
+    assert "/registry/pods/d/" not in s._list_cache
+    items3, _ = s.list("/registry/pods/d/")
+    assert len(items3) == 6
+    # batch (all MODIFIED) patches every element
+    def bump(p, rv=""):
+        new = api.fast_replace(p, status=api.PodStatus(phase="Running"))
+        if rv:
+            new = api.fast_replace(new, metadata=api.fast_replace(
+                new.metadata, resource_version=rv))
+        return new
+    bump.wants_rv = True
+    s.batch([(f"/registry/pods/d/p{i}", bump) for i in range(5)])
+    items4, _ = s.list("/registry/pods/d/")
+    assert all(p.status.phase == "Running" for p in items4
+               if p.metadata.name != "p9")
+    # delete invalidates
+    s.delete("/registry/pods/d/p9")
+    items5, _ = s.list("/registry/pods/d/")
+    assert len(items5) == 5

@@ -291,8 +291,11 @@ class TestComponentStatusesAndPodTemplates:
 
 class TestLiveDashboard:
     def test_ui_renders_live_cluster_state(self):
-        """/ui is a live dashboard (pkg/ui's role): created nodes, pods
-        (phase + host), and events appear in the rendered page."""
+        """pkg/ui's role, round-5 shape: /ui is a CLIENT-SIDE app (a
+        static shell that lists + watches through the public REST API
+        — no cluster data is server-rendered into it), and the
+        server-rendered view lives at /ui/server with nodes, pods
+        (phase + host), and events in the page."""
         registry = Registry()
         srv = ApiServer(registry).start()
         try:
@@ -316,6 +319,11 @@ class TestLiveDashboard:
                 reason="Scheduled", type="Normal",
                 message="assigned dash-pod to dash-node", count=1))
             with urllib.request.urlopen(srv.url + "/ui", timeout=5) as r:
+                shell = r.read().decode()
+            assert "dash-node" not in shell          # static shell
+            assert "/api/v1/watch/" in shell         # live data path
+            with urllib.request.urlopen(srv.url + "/ui/server",
+                                        timeout=5) as r:
                 page = r.read().decode()
             assert "dash-node" in page and "1/1 ready" in page
             assert "dash-pod" in page and "Running" in page
@@ -327,7 +335,10 @@ class TestLiveDashboard:
                     labels={}),
                 spec=api.PodSpec(containers=[api.Container(name="c")]),
                 status=api.PodStatus(phase="<script>alert(1)</script>")))
-            with urllib.request.urlopen(srv.url + "/ui", timeout=5) as r:
+            # server-rendered page escapes object fields; the /ui app
+            # escapes client-side (its esc() before innerHTML)
+            with urllib.request.urlopen(srv.url + "/ui/server",
+                                        timeout=5) as r:
                 page = r.read().decode()
             assert "<script>alert(1)" not in page
             assert "&lt;script&gt;" in page

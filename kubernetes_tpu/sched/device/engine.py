@@ -652,13 +652,15 @@ class BatchEngine:
                              and policy.needs_anti_affinity else 0)
         # speculative parallel-assign + repair replaces the scan whenever
         # the encode's tiers are node-local (bit-identical results — see
-        # the _make_spec_run block). None = auto: on for TPU backends
-        # (where the scan pays a ~25us/step loop floor and the repair
-        # step cuts the emulated-f64 lane count ~20x), off for CPU
-        # (measured A/B: the scan wins there — CPU step cost tracks op
-        # count, not lane count) and off under a mesh (the repair
-        # gathers would cross shards). Resolved lazily at first run so
-        # constructing an engine never forces backend init.
+        # the _make_spec_run block). None = auto: OFF on every backend.
+        # The TPU-on hypothesis (scan pays a ~25us/step loop floor the
+        # repair pass amortizes) was refuted by the real-v5e A/B
+        # (TPU_EVIDENCE.json engine_spec): scan 51.7k vs spec 16.7k
+        # pods/s at 5000x30000-plain, scan ahead at every shape/tier —
+        # the block-wide vmap rescore moves more HBM per committed pod
+        # than the scan's chained carry. Spec remains an explicit knob
+        # for A/B; off under a mesh regardless (the repair gathers
+        # would cross shards).
         self._speculative = speculative
         # jitted variants keyed by (has_aff, has_spread): inactive tiers
         # (no affinity terms / no spread groups in the batch) compile out
@@ -671,7 +673,7 @@ class BatchEngine:
         if self.mesh is not None:
             return False
         if self._speculative is None:
-            self._speculative = jax.default_backend() == "tpu"
+            self._speculative = False
         return self._speculative
 
     def _get_run(self, has_aff: bool, has_spread: bool):

@@ -655,7 +655,7 @@ class BatchEngine:
         # the _make_spec_run block). None = auto: OFF on every backend.
         # The TPU-on hypothesis (scan pays a ~25us/step loop floor the
         # repair pass amortizes) was refuted by the real-v5e A/B
-        # (TPU_EVIDENCE.json engine_spec): scan 51.7k vs spec 16.7k
+        # (TPU_EVIDENCE.json engine_spec): scan 52.5k vs spec 16.6k
         # pods/s at 5000x30000-plain, scan ahead at every shape/tier —
         # the block-wide vmap rescore moves more HBM per committed pod
         # than the scan's chained carry. Spec remains an explicit knob
@@ -670,11 +670,7 @@ class BatchEngine:
 
     @property
     def speculative(self) -> bool:
-        if self.mesh is not None:
-            return False
-        if self._speculative is None:
-            self._speculative = False
-        return self._speculative
+        return self.mesh is None and bool(self._speculative)
 
     def _get_run(self, has_aff: bool, has_spread: bool):
         # speculative covers the node-local tiers AND the spread tier

@@ -73,17 +73,9 @@ def _await_capture_lock(max_wait: float = 300.0) -> None:
     """If the opportunistic evidence capture (tools/tpu_watch.py) is
     mid-run, wait for it to release the one tunneled chip rather than
     measure under contention; stale locks (>45 min) are ignored."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        ".tpu_capture.lock")
+    from kubernetes_tpu.kubemark.tpu_evidence import foreign_chip_lock_fresh
     deadline = time.time() + max_wait
-    while time.time() < deadline and os.path.exists(path):
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-            if time.time() - rec.get("ts", 0) > 2700:
-                return
-        except (OSError, ValueError):
-            return
+    while time.time() < deadline and foreign_chip_lock_fresh():
         time.sleep(5)
 
 
@@ -232,6 +224,39 @@ def main():
     from kubernetes_tpu.utils.platform import ensure_live_platform
     platform, probe = ensure_live_platform(attempts=args.probe_attempts)
     _await_capture_lock()
+    # hold the lock for the whole headline run so the round-long watcher
+    # (tools/tpu_watch.py) defers its next opportunistic capture instead
+    # of contending for the one chip mid-measurement; released at exit
+    # (ownership-checked: a late-finishing capture cannot delete our
+    # hold, nor we a lock another process has since written). If a
+    # capture still holds the lock after the bounded wait, proceed
+    # WITHOUT taking it — never stomp a live holder's record.
+    import atexit
+    import threading
+    from kubernetes_tpu.kubemark.tpu_evidence import (refresh_chip_lock,
+                                                      release_chip_lock,
+                                                      try_acquire_chip_lock)
+    if try_acquire_chip_lock(who="bench"):
+        atexit.register(release_chip_lock)
+        # heartbeat: a slow run (wedged tunnel, slow SLO sweep) must not
+        # age past the 45-min staleness window and lose the chip to the
+        # watcher's reclaim mid-measurement
+        hb_stop = threading.Event()
+
+        def _hb():
+            while not hb_stop.wait(600.0):
+                refresh_chip_lock()
+        hb_thread = threading.Thread(target=_hb,
+                                     name="chip-lock-heartbeat",
+                                     daemon=True)
+        hb_thread.start()
+
+        def _hb_join():
+            # joined BEFORE release (atexit is LIFO): a heartbeat caught
+            # mid-refresh must not resurrect the lock after the unlink
+            hb_stop.set()
+            hb_thread.join(timeout=5.0)
+        atexit.register(_hb_join)
     from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
 
     # best of two: the box shows ±20% run-to-run noise (shared-host
@@ -243,8 +268,35 @@ def main():
     if args.verbose:
         print(f"# e2e {r.scheduled}/{r.n_pods} in {r.elapsed_s:.2f}s",
               file=sys.stderr)
-    engine_rate, _ = engine_only(args.nodes, args.pods)
+    engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
+
+    import jax
+    if (platform == "default" and jax.default_backend() == "tpu"
+            and (args.nodes, args.pods) == (5000, 30000)):
+        # the headline run IS a real-TPU measurement at the evidence
+        # suite's north-star shape — fold it into the per-section BEST
+        # artifact so the demonstrated ceiling reflects every on-chip
+        # run, not only the watcher's captures. Gated on the REAL
+        # backend, not probe success: a cpu-default box also reports
+        # platform "default" and must never masquerade as chip evidence
+        from kubernetes_tpu.kubemark.tpu_evidence import merge_best
+        here = os.path.dirname(os.path.abspath(__file__))
+        merge_best(
+            {"sections": {
+                 "e2e": {"status": "ok",
+                         "pods_per_sec": round(r.pods_per_sec, 1),
+                         "elapsed_s": round(r.elapsed_s, 2),
+                         "runs_pods_per_sec": [round(x.pods_per_sec, 1)
+                                               for x in runs],
+                         "scheduled": r.scheduled, "nodes": r.n_nodes,
+                         "pods": r.n_pods, "source": "bench"},
+                 "engine": {"status": "ok",
+                            "5000x30000": {
+                                "pods_per_sec": round(engine_rate, 1),
+                                "bound": engine_bound,
+                                "source": "bench"}}}},
+            os.path.join(here, "TPU_EVIDENCE_BEST.json"))
 
     slo = None
     if not args.skip_slo:

@@ -655,7 +655,7 @@ class BatchEngine:
         # the _make_spec_run block). None = auto: OFF on every backend.
         # The TPU-on hypothesis (scan pays a ~25us/step loop floor the
         # repair pass amortizes) was refuted by the real-v5e A/B
-        # (TPU_EVIDENCE.json engine_spec): scan 52.5k vs spec 16.6k
+        # (TPU_EVIDENCE_BEST.json engine_spec): scan 52.5k vs spec 16.6k
         # pods/s at 5000x30000-plain, scan ahead at every shape/tier —
         # the block-wide vmap rescore moves more HBM per committed pod
         # than the scan's chained carry. Spec remains an explicit knob

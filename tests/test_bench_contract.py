@@ -53,3 +53,73 @@ def test_bench_artifact_history_parseable():
         if parsed:  # driver wrapper format
             for key in ("metric", "value", "unit"):
                 assert key in parsed, (name, key)
+
+
+def test_chip_lock_ownership_protocol(monkeypatch, tmp_path):
+    """The advisory chip lock coordinating bench.py and tools/tpu_watch.py:
+    acquire writes this pid, release unlinks ONLY a lock this process
+    owns (a late-finishing capture must not delete the bench run's
+    hold), and foreign freshness ignores our own and stale records."""
+    from kubernetes_tpu.kubemark import tpu_evidence as ev
+
+    lock = tmp_path / ".tpu_capture.lock"
+    monkeypatch.setattr(ev, "chip_lock_path", lambda: str(lock))
+
+    assert not ev.foreign_chip_lock_fresh()
+    assert ev.try_acquire_chip_lock(who="test")
+    # our own fresh lock is not "foreign", and re-acquire succeeds
+    assert not ev.foreign_chip_lock_fresh()
+    assert ev.try_acquire_chip_lock(who="test")
+    rec = json.loads(lock.read_text())
+    assert rec["pid"] == os.getpid() and rec["who"] == "test"
+
+    # another process's fresh lock IS foreign: acquire refuses it,
+    # and release leaves it alone
+    lock.write_text(json.dumps({"pid": rec["pid"] + 1,
+                                "ts": rec["ts"]}))
+    assert ev.foreign_chip_lock_fresh()
+    assert not ev.try_acquire_chip_lock(who="late")
+    ev.release_chip_lock()
+    assert lock.exists(), "released a lock owned by another process"
+
+    # a stale foreign lock (crashed holder) does not defer anyone and
+    # is reclaimed by acquire
+    lock.write_text(json.dumps({"pid": rec["pid"] + 1,
+                                "ts": rec["ts"] - 10_000}))
+    assert not ev.foreign_chip_lock_fresh()
+    assert ev.try_acquire_chip_lock(who="reclaim")
+
+    # our own lock releases cleanly
+    ev.release_chip_lock()
+    assert not lock.exists()
+
+
+def test_chip_lock_reclaim_and_heartbeat(monkeypatch, tmp_path):
+    """Stale-lock reclaim is atomic (rename-aside) and refresh re-stamps
+    only a lock this process owns."""
+    import time as _time
+
+    from kubernetes_tpu.kubemark import tpu_evidence as ev
+
+    lock = tmp_path / ".tpu_capture.lock"
+    monkeypatch.setattr(ev, "chip_lock_path", lambda: str(lock))
+
+    # reclaim a stale foreign lock via the rename path
+    lock.write_text(json.dumps({"pid": os.getpid() + 1,
+                                "ts": _time.time() - 10_000}))
+    assert ev.try_acquire_chip_lock(who="reclaimer")
+    rec = json.loads(lock.read_text())
+    assert rec["pid"] == os.getpid()
+    assert not list(tmp_path.glob("*.reclaim.*")), "claim temp leaked"
+
+    # heartbeat: refresh moves ts forward for the owner...
+    old_ts = rec["ts"]
+    _time.sleep(0.01)
+    ev.refresh_chip_lock()
+    assert json.loads(lock.read_text())["ts"] >= old_ts
+
+    # ...but never touches a foreign record
+    foreign = {"pid": os.getpid() + 1, "ts": _time.time()}
+    lock.write_text(json.dumps(foreign))
+    ev.refresh_chip_lock()
+    assert json.loads(lock.read_text()) == foreign

@@ -12,8 +12,10 @@ Artifacts (all at the repo root):
   If the tunnel never opens all round, this file IS the evidence.
 - TPU_EVIDENCE.json — freshest successful capture (atomic, partial
   sections survive a mid-capture wedge).
-- .tpu_capture.lock — held during capture so bench.py's headline run
-  and the capture never contend for the one tunneled chip.
+- .tpu_capture.lock — the shared advisory chip lock
+  (kubernetes_tpu.kubemark.tpu_evidence chip-lock helpers): captures
+  take it via atomic test-and-set and DEFER when bench.py's headline
+  run holds it, so the two never contend for the one tunneled chip.
 
 Start at round open:  nohup python tools/tpu_watch.py >/dev/null 2>&1 &
 """
@@ -29,7 +31,6 @@ sys.path.insert(0, REPO)
 
 PROBE_LOG = os.path.join(REPO, "TPU_PROBES.jsonl")
 EVIDENCE = os.path.join(REPO, "TPU_EVIDENCE.json")
-LOCK = os.path.join(REPO, ".tpu_capture.lock")
 
 PROBE_TIMEOUT = 120.0
 PROBE_INTERVAL = 600.0       # wedged: probe every 10 min
@@ -57,9 +58,14 @@ def probe() -> bool:
 
 
 def capture() -> bool:
+    from kubernetes_tpu.kubemark.tpu_evidence import (
+        release_chip_lock, try_acquire_chip_lock)
     t0 = time.time()
-    with open(LOCK, "w") as f:
-        json.dump({"pid": os.getpid(), "ts": time.time()}, f)
+    if not try_acquire_chip_lock(who="tpu_watch"):
+        # bench.py's headline run (or a manual capture) holds the chip —
+        # defer rather than contend for the one tunneled device
+        log({"event": "capture-deferred", "reason": "foreign lock held"})
+        return False
     try:
         res = subprocess.run(
             [sys.executable, "-m", "kubernetes_tpu.kubemark.tpu_evidence",
@@ -71,10 +77,7 @@ def capture() -> bool:
     except subprocess.TimeoutExpired:
         ok, tail = False, "capture timeout (tunnel wedged mid-run?)"
     finally:
-        try:
-            os.unlink(LOCK)
-        except OSError:
-            pass
+        release_chip_lock()
     log({"event": "capture", "ok": ok,
          "elapsed_s": round(time.time() - t0, 1), "tail": tail})
     return ok

@@ -89,14 +89,35 @@ class ApiServer:
                  metrics: Optional[MetricsRegistry] = None,
                  authenticator=None, authorizer=None, request_log=None,
                  tls_cert_file: str = "", tls_key_file: str = "",
-                 tls_client_ca_file: str = ""):
+                 tls_client_ca_file: str = "",
+                 runtime_config: Optional[dict] = None):
         """tls_cert_file/tls_key_file: serve HTTPS (the reference's
         --tls-cert-file/--tls-private-key-file secure port).
         tls_client_ca_file: additionally request client certificates
         verified against this CA (--client-ca-file); the verified peer
         subject reaches authenticators as the X-Peer-Certificate
-        pseudo-header (auth.X509Authenticator consumes it)."""
+        pseudo-header (auth.X509Authenticator consumes it).
+
+        runtime_config: the reference's --runtime-config ConfigurationMap
+        (cmd/kube-apiserver/app/server.go:244, parsed :427
+        parseRuntimeConfig): `api/v1=false` and
+        `apis/extensions/v1beta1=false` disable a whole group-version,
+        `apis/extensions/v1beta1/<resource>=false` one extensions
+        resource; `api/all=false` turns every version off except those
+        explicitly re-enabled. Disabled surfaces 404 and vanish from
+        discovery. `api/legacy` is accepted (no pre-v1 wire versions
+        exist here to govern)."""
         self.registry = registry
+        rc = dict(runtime_config or {})
+        all_default = rc.get("api/all", True)
+        self._v1_enabled = rc.get("api/v1", all_default)
+        self._ext_enabled = rc.get("apis/extensions/v1beta1", all_default)
+        prefix = "apis/extensions/v1beta1/"
+        self._disabled_resources = {
+            k[len(prefix):] for k, v in rc.items()
+            if k.startswith(prefix) and not v}
+        self._rc_gating = (not self._v1_enabled or not self._ext_enabled
+                           or bool(self._disabled_resources))
         self.scheme = scheme
         self.metrics = metrics or global_metrics
         # ref: --max-requests-inflight (cmd/kube-apiserver/app/server.go),
@@ -316,12 +337,14 @@ class ApiServer:
                         namespace=query.get("namespace", "")).encode(),
                 "text/html; charset=utf-8")
         if path == "/api":
-            return self._send_json(h, 200, {"kind": "APIVersions",
-                                            "versions": ["v1"]})
+            return self._send_json(h, 200, {
+                "kind": "APIVersions",
+                "versions": ["v1"] if self._v1_enabled else []})
         if path == "/apis":
             groups = [{"name": "extensions",
                        "versions": [{"groupVersion": "extensions/v1beta1",
-                                     "version": "v1beta1"}]}]
+                                     "version": "v1beta1"}]}] \
+                if self._ext_enabled else []
             for g, kinds in sorted(
                     self.registry.third_party_groups().items()):
                 versions = sorted({v for _, v in kinds.values()})
@@ -332,6 +355,8 @@ class ApiServer:
                                             "groups": groups})
         from .registry import EXTENSIONS_RESOURCES
         if path in ("/api/v1", ""):
+            if not self._v1_enabled:
+                raise NotFound(name="api/v1 disabled by --runtime-config")
             return self._send_json(h, 200, {
                 "kind": "APIResourceList", "groupVersion": "v1",
                 "resources": [
@@ -339,22 +364,32 @@ class ApiServer:
                     for n, i in sorted(RESOURCES.items())
                     if n not in EXTENSIONS_RESOURCES]})
         if path == "/apis/extensions/v1beta1":
+            if not self._ext_enabled:
+                raise NotFound(
+                    name="extensions/v1beta1 disabled by --runtime-config")
             return self._send_json(h, 200, {
                 "kind": "APIResourceList",
                 "groupVersion": "extensions/v1beta1",
                 "resources": [
                     {"name": n, "namespaced": i.namespaced, "kind": i.kind}
                     for n, i in sorted(RESOURCES.items())
-                    if n in EXTENSIONS_RESOURCES]})
+                    if n in EXTENSIONS_RESOURCES
+                    and n not in self._disabled_resources]})
 
         parts = [p for p in path.split("/") if p]
         # strip "api/v1" or "apis/extensions/v1beta1" (one flat registry
         # serves both groups; the reference mounts the extensions group at
-        # its own prefix, master.go:1049)
+        # its own prefix, master.go:1049) — enforcing --runtime-config
+        # group/resource switches at the mount point
         if len(parts) >= 3 and parts[0] == "apis" and \
                 parts[1] == "extensions" and parts[2] == "v1beta1":
+            if not self._ext_enabled:
+                raise NotFound(
+                    name="extensions/v1beta1 disabled by --runtime-config")
             parts = parts[3:]
         elif len(parts) >= 2 and parts[0] == "api" and parts[1] == "v1":
+            if not self._v1_enabled:
+                raise NotFound(name="api/v1 disabled by --runtime-config")
             parts = parts[2:]
         elif parts[0] == "apis" and len(parts) >= 2:
             # dynamic third-party groups (master.go:972
@@ -364,6 +399,23 @@ class ApiServer:
             raise NotFound(f"path {path!r} not found")
         if not parts:
             raise NotFound(f"path {path!r} not found")
+
+        if self._rc_gating:
+            # one flat registry serves BOTH mounts, so group/resource
+            # switches must classify the TARGET resource, not trust the
+            # prefix the caller picked (else a disabled group remains
+            # reachable by swapping prefixes, or a disabled resource via
+            # the legacy watch/ path). _authz_target is the one path
+            # grammar (watch/proxy prefixes, the namespaces
+            # status/finalize carve-out) — reuse it, don't re-derive it.
+            res, _ = _authz_target(path)
+            if res in EXTENSIONS_RESOURCES:
+                if not self._ext_enabled or res in self._disabled_resources:
+                    raise NotFound(
+                        name=f"{res} disabled by --runtime-config")
+            elif res and not self._v1_enabled:
+                raise NotFound(
+                    name=f"{res} (api/v1) disabled by --runtime-config")
 
         namespace = ""
         if (parts[0] == "namespaces" and len(parts) >= 3

@@ -116,6 +116,31 @@ def _serve_until_signal(ready_line: str, stop_fns) -> int:
 
 # ------------------------------------------------------------- components
 
+def _parse_runtime_config(spec: str) -> "dict | None":
+    """'k1=false,k2,k3=true' -> {k1: False, k2: True, ...}; a bare key
+    means true, matching the reference's ConfigurationMap.Set
+    (pkg/util/configuration_map.go)."""
+    out = {}
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, _, val = pair.partition("=")
+        val = val.strip().lower()
+        if val in ("", "true", "1"):
+            out[key.strip()] = True
+        elif val in ("false", "0"):
+            out[key.strip()] = False
+        else:
+            # fail at startup like the reference's boolean parse; a typo
+            # ("=flase") must not silently invert into the permissive
+            # setting
+            raise SystemExit(
+                f"--runtime-config: invalid boolean {val!r} for "
+                f"{key.strip()!r}")
+    return out or None
+
+
 def run_apiserver(argv: List[str]) -> int:
     """(ref: cmd/kube-apiserver/app/server.go:358 APIServer.Run)"""
     p = argparse.ArgumentParser(prog="apiserver")
@@ -150,6 +175,13 @@ def run_apiserver(argv: List[str]) -> int:
     p.add_argument("--experimental-keystone-url", default="",
                    help="delegate basic-auth to a keystone v2 endpoint "
                         "(ref: --experimental-keystone-url)")
+    p.add_argument("--runtime-config", default="",
+                   help="comma-separated key=value pairs turning API "
+                        "versions/resources on or off: api/v1, "
+                        "apis/extensions/v1beta1, "
+                        "apis/extensions/v1beta1/<resource>; api/all "
+                        "and api/legacy are special keys "
+                        "(ref: server.go:244)")
     args = p.parse_args(argv)
 
     from .master import Master, MasterConfig
@@ -163,6 +195,7 @@ def run_apiserver(argv: List[str]) -> int:
         authorization_policy_lines=_read_lines(args.authorization_policy_file),
         service_cidr=args.service_cluster_ip_range,
         max_in_flight=args.max_requests_inflight,
+        runtime_config=_parse_runtime_config(args.runtime_config),
         tls_cert_file=args.tls_cert_file,
         tls_key_file=args.tls_private_key_file,
         tls_client_ca_file=args.client_ca_file,

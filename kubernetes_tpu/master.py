@@ -14,7 +14,7 @@ in tests and benchmarks).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .admission import registry_hook
 from .admission.plugins import new_from_plugins
@@ -84,6 +84,11 @@ class MasterConfig:
     tls_cert_file: str = ""
     tls_key_file: str = ""
     tls_client_ca_file: str = ""
+    # ref: --runtime-config (server.go:244): group-version / per-resource
+    # on-off switches, e.g. {"apis/extensions/v1beta1": False} or
+    # {"apis/extensions/v1beta1/jobs": False}; "api/all" covers every
+    # version
+    runtime_config: Optional[Dict[str, bool]] = None
 
 
 class Master:
@@ -155,7 +160,8 @@ class Master:
                                 authorizer=authorizer,
                                 tls_cert_file=cfg.tls_cert_file,
                                 tls_key_file=cfg.tls_key_file,
-                                tls_client_ca_file=cfg.tls_client_ca_file)
+                                tls_client_ca_file=cfg.tls_client_ca_file,
+                                runtime_config=cfg.runtime_config)
 
         # componentstatus probes at the components' conventional healthz
         # ports (ref: master.go getServersToValidate: scheduler :10251,

@@ -47,17 +47,10 @@ class Client:
         (registry.create_from_template). Default: expand client-side
         into a create_batch — any Client gets the semantics, the
         in-proc registry gets the fast path."""
-        from ..core.types import fast_replace
-        # uid/resource_version cleared: a server-fetched template must
-        # expand into rows with fresh identities, like the in-proc
-        # registry fast path stamps
-        return self.create_batch(
-            resource,
-            [fast_replace(template,
-                          metadata=fast_replace(template.metadata, name=n,
-                                                uid="",
-                                                resource_version=""))
-             for n in names], namespace)
+        from ..core.types import expand_template_rows
+        return self.create_batch(resource,
+                                 expand_template_rows(template, names),
+                                 namespace)
 
     def get(self, resource: str, name: str, namespace: str = "") -> Any:
         raise NotImplementedError

@@ -442,16 +442,9 @@ class Registry:
         to see each object individually."""
         info = self.info(resource)
         if self.admission or resource in TEMPLATE_FALLBACK_RESOURCES:
-            # uid/resource_version cleared so a server-fetched template
-            # expands exactly like the fast path: fresh identity per row
             return self.create_batch(
-                resource,
-                [api.fast_replace(
-                    template,
-                    metadata=api.fast_replace(template.metadata, name=n,
-                                              uid="",
-                                              resource_version=""))
-                 for n in names], namespace)
+                resource, api.expand_template_rows(template, names),
+                namespace)
         if not names:
             return []
         if not isinstance(template, info.cls):

@@ -224,6 +224,11 @@ class ApiServer:
         # flag must reset here or every request after one batch POST
         # would be mislabeled ':batch' (and dropped from the SLO gate)
         h._batch_request = False
+        # per-request body-consumption marker (same per-connection
+        # handler object reuse hazard as _batch_request): _send_error's
+        # keep-alive framing guard must not trust an earlier request's
+        # flag
+        h._body_consumed = False
         parsed = urllib.parse.urlsplit(h.path)
         path = parsed.path.rstrip("/")
         query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
@@ -522,16 +527,27 @@ class ApiServer:
                       query.get("labelSelector", ""),
                       query.get("fieldSelector", ""))
                 cached = self._list_bytes_cache.get(ck)
+                # a hit must also still be WATCHABLE: the cached bytes
+                # embed the resourceVersion the list was built at, and a
+                # write-quiet resource's segment version never moves
+                # while busier segments roll the shared watch window
+                # forward — serving an aged-out rev forever would
+                # livelock that resource's list->watch->410 recovery
+                # loop (clients re-list, get the same stale rev, 410
+                # again). Rebuilding re-embeds the current rev.
+                floor_fn = getattr(self.registry.store, "watch_floor",
+                                   None)
+                floor = floor_fn() if floor_fn is not None else 0
                 if (seg_ver is not None and cached is not None
-                        and cached[0] == seg_ver):
-                    body = cached[1]
+                        and cached[0] == seg_ver and cached[1] >= floor):
+                    body = cached[2]
                 else:
                     body = self.scheme.encode_list_bytes(info.kind, items,
                                                          str(rev))
                     if seg_ver is not None:
                         if len(self._list_bytes_cache) >= 32:
                             self._list_bytes_cache.clear()
-                        self._list_bytes_cache[ck] = (seg_ver, body)
+                        self._list_bytes_cache[ck] = (seg_ver, rev, body)
                 return self._send_raw(h, 200, body, "application/json")
             obj = self.registry.get(resource, name, namespace)
             return self._send_json(h, 200, self.scheme.encode_dict(obj))
@@ -980,8 +996,19 @@ class ApiServer:
             raise BadRequest(
                 "proxied writes require Content-Length "
                 "(chunked request bodies are not supported)")
-        length = int(h.headers.get("Content-Length") or 0)
-        return h.rfile.read(length) if length else b""
+        try:
+            length = int(h.headers.get("Content-Length") or 0)
+        except ValueError:
+            h.close_connection = True
+            raise BadRequest("invalid Content-Length")
+        if length < 0:
+            # rfile.read(-1) would block on the keep-alive socket until
+            # the client hangs up, pinning an in-flight slot
+            h.close_connection = True
+            raise BadRequest("invalid Content-Length")
+        body = h.rfile.read(length) if length else b""
+        h._body_consumed = True
+        return body
 
     def _proxy_node(self, h, node_name: str, rest: str,
                     raw_query: str, method: str = "GET",
@@ -1186,10 +1213,15 @@ class ApiServer:
 
     @staticmethod
     def _read_body(h) -> dict:
-        length = int(h.headers.get("Content-Length") or 0)
-        if not length:
+        try:
+            length = int(h.headers.get("Content-Length") or 0)
+        except ValueError:
+            h.close_connection = True
+            raise BadRequest("invalid Content-Length")
+        if length <= 0:
             raise BadRequest("empty request body")
         raw = h.rfile.read(length)
+        h._body_consumed = True
         try:
             return json.loads(raw)
         except json.JSONDecodeError as e:
@@ -1200,6 +1232,18 @@ class ApiServer:
                        "application/json")
 
     def _send_error(self, h, err: ApiError) -> None:
+        # an error can fire before a body-bearing request's body was
+        # read (e.g. PATCH to a non-proxy path -> MethodNotSupported);
+        # leftover body bytes would desync HTTP/1.1 keep-alive framing —
+        # the next request on the connection parses mid-body. Close
+        # unless a body reader ran to completion (a 409 AFTER the read
+        # keeps its keep-alive — conflict-heavy CAS traffic must not
+        # pay a reconnect per retry).
+        if (h.command not in ("GET", "HEAD")
+                and not getattr(h, "_body_consumed", False)
+                and (h.headers.get("Content-Length")
+                     or h.headers.get("Transfer-Encoding"))):
+            h.close_connection = True
         try:
             self._send_json(h, err.code, err.status())
         except (BrokenPipeError, ConnectionResetError, OSError):

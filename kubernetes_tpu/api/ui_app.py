@@ -134,10 +134,13 @@ function render() {
   for (const n of state.nodes.values()) {
     if (nf && !n.metadata.name.toLowerCase().includes(nf)) continue;
     nodeTotal++;
-    if (nodeRows.length < MAX_ROWS)
+    if (nodeRows.length < MAX_ROWS) {
+      // n.status itself may be absent: the wire encoder omits empty
+      // fields, and a node can list before its first status write
+      const cap = (n.status && n.status.capacity) || {};
       nodeRows.push([n.metadata.name, nodeReady(n),
-                     (n.status.capacity || {}).cpu || "",
-                     (n.status.capacity || {}).memory || ""]);
+                     cap.cpu || "", cap.memory || ""]);
+    }
   }
   renderTable(document.getElementById("nodes"),
               ["name", "status", "cpu", "memory"], nodeRows, nodeTotal);

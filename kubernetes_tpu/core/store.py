@@ -165,6 +165,14 @@ class Store:
         with self._lock:
             return self._seg_writes.get(self._seg(prefix), 0)
 
+    def watch_floor(self) -> int:
+        """Smallest resourceVersion a watch can still start from without
+        410 Expired. Cached LIST bytes embedding an older rev must be
+        rebuilt, or a write-quiet resource's list->watch loop livelocks
+        once busier segments roll the shared history window past it."""
+        with self._lock:
+            return self._oldest_rev
+
     def _record(self, rev: int, etype: str, key: str, obj: Any,
                 prev: Any) -> watchpkg.Event:
         """History-window bookkeeping for one committed write."""

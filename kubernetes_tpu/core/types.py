@@ -63,6 +63,23 @@ def fast_replace(obj, **fields):
 _now_cache = (0, "")  # (unix second, formatted) — timestamps have 1s grain
 
 
+
+def expand_template_rows(template, names):
+    """One template object -> rows with fresh per-row identity: name
+    stamped, uid/resource_version/creation_timestamp cleared so the
+    create path restamps them. A server-fetched template must not leak
+    its source object's identity — or its age: keeping the fetched
+    creation_timestamp would make brand-new rows sort as hours old for
+    anything ordering by creation time. One implementation shared by
+    Client.create_from_template and the registry's fallback path, so
+    identity-reset semantics cannot drift between them."""
+    return [fast_replace(template,
+                         metadata=fast_replace(template.metadata, name=n,
+                                               uid="",
+                                               resource_version="",
+                                               creation_timestamp=""))
+            for n in names]
+
 def now_rfc3339() -> str:
     global _now_cache
     t = int(time.time())

@@ -164,6 +164,7 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
     # apiserver is its own OS-scheduled process; this is the in-proc
     # analogue).
     import sys as _sys
+    _prev_si = _sys.getswitchinterval()
     _sys.setswitchinterval(0.001)
     registry = Registry()
     metrics = MetricsRegistry()   # per-run registry: no cross-run mixing
@@ -266,6 +267,9 @@ def run_density_slo(n_nodes: int = 1000, n_pods: int = 3000,
         all_running.wait(timeout=max(0.0, deadline - time.time()))
         elapsed = time.monotonic() - start
     finally:
+        # restore the caller's GIL slice: this knob is process-wide and
+        # bench.py measures throughput after the SLO sweep
+        _sys.setswitchinterval(_prev_si)
         stop_probe.set()
         watcher.stop()
         sched.stop()

@@ -955,3 +955,44 @@ def test_runtime_config_flag_parsing():
             "api/legacy": True}
     with _pytest.raises(SystemExit):
         _parse_runtime_config("api/v1=flase")
+
+
+def test_list_byte_cache_stays_watchable():
+    """A write-quiet resource's cached LIST bytes must be rebuilt once
+    the shared watch window rolls past their embedded resourceVersion —
+    serving the stale rev forever would livelock that resource's
+    list->watch->410 recovery loop (clients re-list, get the same aged
+    bytes, 410 again, while pods churn the global rev)."""
+    import json as jsonlib
+    import urllib.request
+
+    from kubernetes_tpu.core.store import Store
+
+    reg = Registry(store=Store(window=32))
+    srv = ApiServer(reg, port=0).start()
+    try:
+        base = srv.url
+        reg.create("services", api.Service(
+            metadata=api.ObjectMeta(name="svc-1", namespace="default"),
+            spec=api.ServiceSpec(ports=[api.ServicePort(port=80)])))
+
+        def list_rev():
+            with urllib.request.urlopen(
+                    base + "/api/v1/services", timeout=5) as r:
+                return int(jsonlib.load(r)["metadata"]["resourceVersion"])
+
+        rev1 = list_rev()
+        assert list_rev() == rev1  # byte-cache hit while still watchable
+
+        # churn an unrelated segment far past the watch window
+        for i in range(40):
+            reg.create("pods", mk_pod(f"churn-{i}"))
+        assert reg.store.watch_floor() > rev1
+
+        rev2 = list_rev()
+        assert rev2 > rev1, "cache served an aged-out resourceVersion"
+        # the re-listed rev must start a watch without 410 Expired
+        w = reg.watch("services", since_rev=rev2)
+        w.stop()
+    finally:
+        srv.stop()

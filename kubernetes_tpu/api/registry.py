@@ -139,6 +139,39 @@ class ResourceInfo:
 
 RESOURCES: Dict[str, ResourceInfo] = {}
 
+_FIELD_GETTER_MAPS = {
+    api.pod_resource_fields: api.POD_FIELD_GETTERS,
+    api.node_resource_fields: api.NODE_FIELD_GETTERS,
+    api.generic_resource_fields: api.GENERIC_FIELD_GETTERS,
+}
+
+
+def _compile_field_pred(info: "ResourceInfo", fsel):
+    """Direct-attribute matcher for a parsed field selector, or None.
+
+    The dict path (fsel.matches(info.fields_fn(o))) allocates one
+    throwaway field map per object-version; the scheduler's watch pair
+    (spec.nodeName= / !=) pays that on every event of a 30k-pod commit
+    fan-out, and a node-scoped kubelet LIST pays it per stored pod.
+    When every term's key has a registered getter the selector compiles
+    to attribute reads — same semantics (missing keys read as "" via
+    the dict path's .get default only for keys NO getter covers, which
+    is exactly when this returns None and the dict path runs)."""
+    getters = _FIELD_GETTER_MAPS.get(info.fields_fn)
+    if getters is None:
+        return None
+    try:
+        terms = [(getters[k], v, neg) for k, v, neg in fsel.terms]
+    except KeyError:
+        return None
+
+    def matches(o) -> bool:
+        for g, v, neg in terms:
+            if (g(o) == v) == neg:
+                return False
+        return True
+    return matches
+
 
 def _register(info: ResourceInfo) -> None:
     RESOURCES[info.name] = info
@@ -617,11 +650,17 @@ class Registry:
         lsel = labelspkg.parse(label_selector) if label_selector else None
         fsel = fieldspkg.parse(field_selector) if field_selector else None
 
+        fmatch = (_compile_field_pred(info, fsel)
+                  if fsel is not None else None)
+
         def pred(o: Any) -> bool:
             if lsel is not None and not lsel.matches(o.metadata.labels):
                 return False
-            if fsel is not None and not fsel.matches(info.fields_fn(o)):
-                return False
+            if fsel is not None:
+                if fmatch is not None:
+                    return fmatch(o)
+                if not fsel.matches(info.fields_fn(o)):
+                    return False
             return True
 
         use_pred = pred if (lsel is not None or fsel is not None) else None
@@ -851,23 +890,32 @@ class Registry:
             # object of the SAME store can't alias (the memo is
             # per-Registry precisely because two stores can mint equal
             # rvs for different objects).
-            memo = self._fields_memo.setdefault(resource, {})
+            fmatch = (_compile_field_pred(info, fsel)
+                      if fsel is not None else None)
+            fields_of = None
+            if fsel is not None and fmatch is None:
+                # memo'd dict path only when the selector didn't compile
+                # to attribute reads (the common selectors all compile)
+                memo = self._fields_memo.setdefault(resource, {})
 
-            def fields_of(o: Any) -> Dict[str, str]:
-                key = (id(o), o.metadata.resource_version)
-                f = memo.get(key)
-                if f is None:
-                    if len(memo) > 16:
-                        memo.clear()
-                    f = info.fields_fn(o)
-                    memo[key] = f
-                return f
+                def fields_of(o: Any) -> Dict[str, str]:
+                    key = (id(o), o.metadata.resource_version)
+                    f = memo.get(key)
+                    if f is None:
+                        if len(memo) > 16:
+                            memo.clear()
+                        f = info.fields_fn(o)
+                        memo[key] = f
+                    return f
 
             def pred(o: Any) -> bool:
                 if lsel is not None and not lsel.matches(o.metadata.labels):
                     return False
-                if fsel is not None and not fsel.matches(fields_of(o)):
-                    return False
+                if fsel is not None:
+                    if fmatch is not None:
+                        return fmatch(o)
+                    if not fsel.matches(fields_of(o)):
+                        return False
                 return True
         if not self.info(resource).namespaced:
             namespace = ""  # cluster-scoped (same rule as list)

@@ -534,12 +534,17 @@ class ApiServer:
                 # forward — serving an aged-out rev forever would
                 # livelock that resource's list->watch->410 recovery
                 # loop (clients re-list, get the same stale rev, 410
-                # again). Rebuilding re-embeds the current rev.
-                floor_fn = getattr(self.registry.store, "watch_floor",
-                                   None)
-                floor = floor_fn() if floor_fn is not None else 0
-                if (seg_ver is not None and cached is not None
-                        and cached[0] == seg_ver and cached[1] >= floor):
+                # again). Rebuilding re-embeds the current rev. The
+                # floor read (a store-lock acquisition) only runs to
+                # validate an actual hit.
+                hit = (seg_ver is not None and cached is not None
+                       and cached[0] == seg_ver)
+                if hit:
+                    floor_fn = getattr(self.registry.store, "watch_floor",
+                                       None)
+                    hit = (floor_fn is None
+                           or cached[1] >= floor_fn())
+                if hit:
                     body = cached[2]
                 else:
                     body = self.scheme.encode_list_bytes(info.kind, items,
@@ -1240,10 +1245,13 @@ class ApiServer:
         # keeps its keep-alive — conflict-heavy CAS traffic must not
         # pay a reconnect per retry).
         if (h.command not in ("GET", "HEAD")
-                and not getattr(h, "_body_consumed", False)
-                and (h.headers.get("Content-Length")
-                     or h.headers.get("Transfer-Encoding"))):
-            h.close_connection = True
+                and not getattr(h, "_body_consumed", False)):
+            try:
+                pending = int(h.headers.get("Content-Length") or 0) > 0
+            except ValueError:
+                pending = True  # unparseable: can't trust the framing
+            if pending or h.headers.get("Transfer-Encoding"):
+                h.close_connection = True
         try:
             self._send_json(h, err.code, err.status())
         except (BrokenPipeError, ConnectionResetError, OSError):

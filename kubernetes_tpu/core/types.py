@@ -63,7 +63,6 @@ def fast_replace(obj, **fields):
 _now_cache = (0, "")  # (unix second, formatted) — timestamps have 1s grain
 
 
-
 def expand_template_rows(template, names):
     """One template object -> rows with fresh per-row identity: name
     stamped, uid/resource_version/creation_timestamp cleared so the
@@ -1082,3 +1081,27 @@ def generic_resource_fields(obj: Any) -> Dict[str, str]:
     if meta is None:
         return {}
     return {"metadata.name": meta.name, "metadata.namespace": meta.namespace}
+
+
+# Per-key getters mirroring the dict builders above. Field selectors
+# whose terms all resolve here compile to direct attribute checks — the
+# watch fan-out and filtered LISTs otherwise build one throwaway field
+# map per object-version (the load-bearing selectors, the scheduler's
+# spec.nodeName= / != pair, pay it on every event of a 30k-pod tile).
+POD_FIELD_GETTERS: Dict[str, Any] = {
+    "metadata.name": lambda o: o.metadata.name,
+    "metadata.namespace": lambda o: o.metadata.namespace,
+    "spec.nodeName": lambda o: o.spec.node_name,
+    "status.phase": lambda o: o.status.phase,
+}
+
+NODE_FIELD_GETTERS: Dict[str, Any] = {
+    "metadata.name": lambda o: o.metadata.name,
+    "spec.unschedulable": lambda o: ("true" if o.spec.unschedulable
+                                     else "false"),
+}
+
+GENERIC_FIELD_GETTERS: Dict[str, Any] = {
+    "metadata.name": lambda o: o.metadata.name,
+    "metadata.namespace": lambda o: o.metadata.namespace,
+}

@@ -555,3 +555,36 @@ def test_list_snapshot_patched_in_place_on_modify():
     s.delete("/registry/pods/d/p9")
     items5, _ = s.list("/registry/pods/d/")
     assert len(items5) == 5
+
+
+def test_field_getters_mirror_dict_builders():
+    """The compiled field-selector fast path (registry._compile_field_pred)
+    reads attributes via *_FIELD_GETTERS; each getter must produce
+    exactly what the corresponding *_resource_fields dict builder puts
+    under the same key, over every key, or LIST/watch selector results
+    silently diverge between the compiled and dict paths."""
+    from kubernetes_tpu.core import types as api
+
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="ns-a"),
+        spec=api.PodSpec(node_name="n-7", containers=[
+            api.Container(name="c", image="img")]),
+        status=api.PodStatus(phase="Running"))
+    fields = api.pod_resource_fields(pod)
+    assert set(fields) == set(api.POD_FIELD_GETTERS)
+    for k, getter in api.POD_FIELD_GETTERS.items():
+        assert getter(pod) == fields[k], k
+
+    for unsched in (True, False):
+        node = api.Node(metadata=api.ObjectMeta(name="n"),
+                        spec=api.NodeSpec(unschedulable=unsched))
+        fields = api.node_resource_fields(node)
+        assert set(fields) == set(api.NODE_FIELD_GETTERS)
+        for k, getter in api.NODE_FIELD_GETTERS.items():
+            assert getter(node) == fields[k], k
+
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="ns-b"))
+    fields = api.generic_resource_fields(svc)
+    assert set(fields) == set(api.GENERIC_FIELD_GETTERS)
+    for k, getter in api.GENERIC_FIELD_GETTERS.items():
+        assert getter(svc) == fields[k], k

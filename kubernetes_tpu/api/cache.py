@@ -169,11 +169,16 @@ class Reflector:
         # selectors are immutable per reflector: parse once, not per event
         self._parsed_fields = None
         self._fields_fn = None
+        self._field_match = None
         if field_selector:
             from ..core import fields as fieldspkg
-            from .registry import Registry
+            from .registry import Registry, field_matcher
             self._parsed_fields = fieldspkg.parse(field_selector)
-            self._fields_fn = Registry.info(resource).fields_fn
+            info = Registry.info(resource)
+            self._fields_fn = info.fields_fn
+            # the shared matcher: compiled attribute reads for the
+            # common selectors, the dict path otherwise
+            self._field_match = field_matcher(info, self._parsed_fields)
         self._parsed_labels = (labelspkg.parse(label_selector)
                                if label_selector else None)
         self.store = store
@@ -190,8 +195,7 @@ class Reflector:
     # watch events are not field-filtered by the in-proc store (the reference
     # filters in the apiserver; filtering at both ends is harmless).
     def _matches(self, obj: Any) -> bool:
-        if self._parsed_fields is not None and \
-                not self._parsed_fields.matches(self._fields_fn(obj)):
+        if self._field_match is not None and not self._field_match(obj):
             return False
         if self._parsed_labels is not None and \
                 not self._parsed_labels.matches(obj.metadata.labels):

@@ -173,6 +173,19 @@ def _compile_field_pred(info: "ResourceInfo", fsel):
     return matches
 
 
+def field_matcher(info: "ResourceInfo", fsel, fields_of_factory=None):
+    """THE field-selector matcher: compiled attribute reads when every
+    term has a getter, else the dict path. fields_of_factory (optional)
+    supplies a memoized fields_of and is only invoked on the fallback,
+    so compiled callers never build the memo. One helper so list(),
+    watch(), and the reflector's client-side check cannot drift."""
+    m = _compile_field_pred(info, fsel)
+    if m is not None:
+        return m
+    fn = fields_of_factory() if fields_of_factory else info.fields_fn
+    return lambda o: fsel.matches(fn(o))
+
+
 def _register(info: ResourceInfo) -> None:
     RESOURCES[info.name] = info
 
@@ -650,17 +663,13 @@ class Registry:
         lsel = labelspkg.parse(label_selector) if label_selector else None
         fsel = fieldspkg.parse(field_selector) if field_selector else None
 
-        fmatch = (_compile_field_pred(info, fsel)
-                  if fsel is not None else None)
+        fmatch = field_matcher(info, fsel) if fsel is not None else None
 
         def pred(o: Any) -> bool:
             if lsel is not None and not lsel.matches(o.metadata.labels):
                 return False
-            if fsel is not None:
-                if fmatch is not None:
-                    return fmatch(o)
-                if not fsel.matches(info.fields_fn(o)):
-                    return False
+            if fmatch is not None and not fmatch(o):
+                return False
             return True
 
         use_pred = pred if (lsel is not None or fsel is not None) else None
@@ -890,12 +899,9 @@ class Registry:
             # object of the SAME store can't alias (the memo is
             # per-Registry precisely because two stores can mint equal
             # rvs for different objects).
-            fmatch = (_compile_field_pred(info, fsel)
-                      if fsel is not None else None)
-            fields_of = None
-            if fsel is not None and fmatch is None:
-                # memo'd dict path only when the selector didn't compile
-                # to attribute reads (the common selectors all compile)
+            def _memoized_fields_of():
+                # memo'd dict path, built only when the selector didn't
+                # compile (the common selectors all compile)
                 memo = self._fields_memo.setdefault(resource, {})
 
                 def fields_of(o: Any) -> Dict[str, str]:
@@ -907,15 +913,16 @@ class Registry:
                         f = info.fields_fn(o)
                         memo[key] = f
                     return f
+                return fields_of
+
+            fmatch = (field_matcher(info, fsel, _memoized_fields_of)
+                      if fsel is not None else None)
 
             def pred(o: Any) -> bool:
                 if lsel is not None and not lsel.matches(o.metadata.labels):
                     return False
-                if fsel is not None:
-                    if fmatch is not None:
-                        return fmatch(o)
-                    if not fsel.matches(fields_of(o)):
-                        return False
+                if fmatch is not None and not fmatch(o):
+                    return False
                 return True
         if not self.info(resource).namespaced:
             namespace = ""  # cluster-scoped (same rule as list)

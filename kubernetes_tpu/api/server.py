@@ -1247,7 +1247,9 @@ class ApiServer:
         if (h.command not in ("GET", "HEAD")
                 and not getattr(h, "_body_consumed", False)):
             try:
-                pending = int(h.headers.get("Content-Length") or 0) > 0
+                # nonzero (incl. negative) means framing can't be
+                # trusted; only an explicit 0 / absent header is safe
+                pending = int(h.headers.get("Content-Length") or 0) != 0
             except ValueError:
                 pending = True  # unparseable: can't trust the framing
             if pending or h.headers.get("Transfer-Encoding"):

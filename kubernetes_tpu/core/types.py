@@ -1102,6 +1102,12 @@ NODE_FIELD_GETTERS: Dict[str, Any] = {
 }
 
 GENERIC_FIELD_GETTERS: Dict[str, Any] = {
-    "metadata.name": lambda o: o.metadata.name,
-    "metadata.namespace": lambda o: o.metadata.namespace,
+    # mirror generic_resource_fields' metadata-is-None guard (it
+    # returns {}, whose missing keys read as "" through the dict
+    # path's .get default)
+    "metadata.name": lambda o: (
+        m.name if (m := getattr(o, "metadata", None)) is not None else ""),
+    "metadata.namespace": lambda o: (
+        m.namespace if (m := getattr(o, "metadata", None)) is not None
+        else ""),
 }

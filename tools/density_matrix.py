@@ -12,8 +12,14 @@ Gates are COUPLED to sample validity (kubemark/slo.py api_ok): a point
 whose server-side sample window is starved reports api_slo_ok null,
 never true.
 
-Usage: python tools/density_matrix.py [--quick] [--out DENSITY.json]
-  --quick skips the 150k-pod point (CI-sized run).
+Usage: python tools/density_matrix.py [--quick] [--cpu] [--out DENSITY.json]
+  --quick runs only the 3 and 30 pods/node tiers at 1000 nodes
+  (CI-sized run; skips the 50/100 tiers and the 150k-pod point).
+  --cpu pins the CPU platform before jax init (the conftest move —
+  JAX_PLATFORMS alone is overridden by the image's sitecustomize), so
+  the standing artifact stays comparable round-over-round instead of
+  silently moving to the tunneled chip when the flaky tunnel happens
+  to be healthy (and contending with the watcher's captures).
 """
 
 import argparse
@@ -34,25 +40,41 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=os.path.join(REPO, "DENSITY.json"))
     ap.add_argument("--quick", action="store_true",
-                    help="skip the 5000-node/150k-pod point")
+                    help="run only the 1000-node 3 and 30 pods/node "
+                         "tiers (skips 50/100 and the 150k-pod point)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU platform before jax init for "
+                         "round-over-round comparability")
     args = ap.parse_args()
 
-    from kubernetes_tpu.utils.platform import ensure_live_platform
-    platform, _probe = ensure_live_platform()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu-fallback"
+    else:
+        from kubernetes_tpu.utils.platform import ensure_live_platform
+        platform, _probe = ensure_live_platform()
 
     from kubernetes_tpu.kubemark.slo import run_density_slo
 
-    # (nodes, pods/node, timeout): the two reference tiers at 1000
-    # nodes, then v1.0-density x north-star scale
-    matrix = [(1000, 3, 600.0), (1000, 30, 900.0)]
+    # (nodes, pods/node, timeout, max_pods, node_cpu): ALL FOUR
+    # reference tiers at 1000 nodes (density.go:201-209 — 3, 30, then
+    # the beyond-v1.0-goals 50 and 100 tiers, hollow nodes sized per
+    # tier like the reference's clusters), then v1.0-density x
+    # north-star scale
+    matrix = [(1000, 3, 600.0, 40, "4"), (1000, 30, 900.0, 40, "4")]
     if not args.quick:
-        matrix.append((5000, 30, 2400.0))
+        matrix += [(1000, 50, 1200.0, 60, "8"),
+                   (1000, 100, 1800.0, 110, "16"),
+                   (5000, 30, 2400.0, 40, "4")]
 
     points = []
-    for n_nodes, ppn, timeout in matrix:
+    for n_nodes, ppn, timeout, max_pods, node_cpu in matrix:
         t0 = time.time()
         r = run_density_slo(n_nodes=n_nodes, n_pods=n_nodes * ppn,
-                            timeout_s=timeout)
+                            timeout_s=timeout,
+                            max_pods_per_node=max_pods,
+                            node_cpu=node_cpu)
         d = r.as_dict()
         d["wall_s"] = round(time.time() - t0, 1)
         points.append(d)

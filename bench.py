@@ -24,7 +24,10 @@ vs_baseline, e2e_elapsed_s, scheduled, nodes, pods,
 engine_only_pods_per_sec, platform, probe, pallas, slo; r04 adds tpu
 (opportunistic real-hardware evidence merged from tools/tpu_watch.py)
 and e2e_runs (value = best of two on a ±20%-noise shared host; both
-raw runs recorded).
+raw runs recorded); r05 adds multihost (the 4-process x 2-device DCN
+dryrun regenerated per round) and, when the headline ran on the real
+tpu backend at the north-star shape, folds its e2e/engine numbers
+into TPU_EVIDENCE_BEST.json under the shared chip lock.
 """
 
 import argparse

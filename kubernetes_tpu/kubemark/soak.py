@@ -252,12 +252,6 @@ def main() -> None:
     import argparse
     import json
 
-    # probe-or-fallback BEFORE any jax touch: a wedged tunnel must
-    # degrade the soak to the CPU platform, not kill it at import
-    # (the same ensure_live_platform every bench entry uses)
-    from ..utils.platform import ensure_live_platform
-    ensure_live_platform()
-
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--minutes", type=float, default=10.0)
     ap.add_argument("--nodes", type=int, default=200)
@@ -265,9 +259,24 @@ def main() -> None:
     ap.add_argument("--no-check", action="store_true")
     ap.add_argument("--out", default="",
                     help="write the result JSON to this file as well")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU platform before jax init "
+                         "(round-over-round comparable artifacts; "
+                         "JAX_PLATFORMS alone is overridden by the "
+                         "image's sitecustomize)")
     args = ap.parse_args()
+
+    if args.cpu:
+        from ..utils.platform import pin_cpu
+        platform = pin_cpu()
+    else:
+        # probe-or-fallback BEFORE any jax touch: a wedged tunnel must
+        # degrade the soak to the CPU platform, not kill it at import
+        # (the same ensure_live_platform every bench entry uses)
+        from ..utils.platform import ensure_live_platform
+        platform, _probe = ensure_live_platform()
     r = run_soak(args.minutes * 60.0, args.nodes, args.pods_per_cycle)
-    doc = {"metric": "soak", "nodes": args.nodes,
+    doc = {"metric": "soak", "platform": platform, "nodes": args.nodes,
            "pods_per_cycle": args.pods_per_cycle, **r.as_dict()}
     try:
         r.check()

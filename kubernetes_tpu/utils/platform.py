@@ -19,6 +19,18 @@ _CHECKED_ENV = "KTPU_PLATFORM_CHECKED"
 _DIAG_ENV = "KTPU_PROBE_DIAG"
 
 
+def pin_cpu() -> str:
+    """Pin the CPU platform BEFORE jax backend init and return the
+    platform label for the artifact. JAX_PLATFORMS alone is not enough
+    on this image — sitecustomize registers the axon TPU plugin and
+    pins jax_platforms past the env var — so every cpu-pinned entry
+    point (tests/conftest.py, tools/density_matrix.py --cpu,
+    kubemark/soak.py --cpu) must make this exact config move."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu-pinned"
+
+
 def probe_default_platform(timeout: float = 180.0) -> bool:
     """True iff a tiny dispatch completes on the default platform in a
     clean subprocess within the timeout."""

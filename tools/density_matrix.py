@@ -48,9 +48,8 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.cpu:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        platform = "cpu-fallback"
+        from kubernetes_tpu.utils.platform import pin_cpu
+        platform = pin_cpu()
     else:
         from kubernetes_tpu.utils.platform import ensure_live_platform
         platform, _probe = ensure_live_platform()

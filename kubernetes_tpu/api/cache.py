@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -190,6 +191,8 @@ class Reflector:
         self._watcher: Optional[watchpkg.Watcher] = None
         self._known: Dict[str, Any] = {}
         self.last_sync_rev = 0
+        self.resync_period = resync_period
+        self._last_resync = 0.0
 
     # The server-side field selector also filters here client-side because
     # watch events are not field-filtered by the in-proc store (the reference
@@ -241,8 +244,21 @@ class Reflector:
                               label_selector=self.label_selector,
                               field_selector=self.field_selector)
         self._watcher = w
+        self._last_resync = time.monotonic()
         while not self._stop.is_set():
             ev = w.next(timeout=1.0)
+            if (self.resync_period > 0 and self.on_update is not None
+                    and time.monotonic() - self._last_resync
+                    >= self.resync_period):
+                # periodic resync: replay the known set through
+                # on_update so LEVEL-driven controllers make progress
+                # whose triggering condition produced no event on their
+                # watched resource (the reference's informer resync —
+                # DeltaFIFO Sync deltas; framework/controller.go
+                # NewInformer resyncPeriod)
+                self._last_resync = time.monotonic()
+                for obj in list(prev.values()):
+                    self.on_update(obj, obj)
             if ev is None:
                 if w.stopped:
                     return  # watch died; outer loop re-lists
@@ -310,12 +326,13 @@ class Informer:
 
     def __init__(self, client, resource: str, namespace: str = "",
                  label_selector: str = "", field_selector: str = "",
-                 on_add=None, on_update=None, on_delete=None):
+                 on_add=None, on_update=None, on_delete=None,
+                 resync_period: float = 0.0):
         self.cache = ObjectCache()
         self.reflector = Reflector(
             client, resource, namespace, label_selector, field_selector,
             on_add=on_add, on_update=on_update, on_delete=on_delete,
-            store=self.cache)
+            store=self.cache, resync_period=resync_period)
 
     def start(self) -> "Informer":
         self.reflector.start()

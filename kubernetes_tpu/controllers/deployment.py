@@ -35,11 +35,17 @@ class DeploymentController:
     def __init__(self, client, workers: int = 5):
         self.client = client
         self.workers = QueueWorkers(self._sync, workers, name="deployment")
+        # resync re-drives every deployment periodically: rollout
+        # progress can hinge on POD readiness, which produces no event
+        # on the deployments (or even RC) watch — edge-triggering alone
+        # deadlocks mid-rollout (the reference runs this controller on
+        # a 30s full resync for the same reason)
         self.deploy_informer = Informer(
             client, "deployments",
             on_add=self._enqueue,
             on_update=lambda old, new: self._enqueue(new),
-            on_delete=self._enqueue)
+            on_delete=self._enqueue,
+            resync_period=5.0)
         self.rc_informer = Informer(
             client, "replicationcontrollers",
             on_add=self._enqueue_rc_deployment,
@@ -152,8 +158,13 @@ class DeploymentController:
             # deployment scaled down: the new RC tracks spec directly
             # (reconcileNewRC's scale-down branch)
             self._scale(new_rc, d.spec.replicas)
-        available = (sum(rc.status.replicas for rc in old_rcs)
-                     + new_rc.status.replicas)
+        # availability means READY pods, not active pod count — scaling
+        # old RCs down against status.replicas would count the new RC's
+        # still-unready surge pods as available and let a rollout with
+        # maxUnavailable=0 delete every ready old pod before a single
+        # new one passes readiness (reconcileOldRCs scales by
+        # GetAvailablePodsForRCs, deployment/deployment.go)
+        available = self._ready_pod_count([new_rc] + list(old_rcs))
         can_remove = available - min_available
         for rc in sorted(old_rcs, key=lambda r: (r.metadata.creation_timestamp,
                                                  r.metadata.name)):
@@ -164,6 +175,31 @@ class DeploymentController:
             shrink = min(rc.spec.replicas, can_remove)
             self._scale(rc, rc.spec.replicas - shrink)
             can_remove -= shrink
+
+    def _ready_pod_count(self, rcs) -> int:
+        """Ready pods across the RCs' selectors (the reference's
+        GetAvailablePodsForRCs, minus minReadySeconds which v1.1's
+        Deployment does not surface)."""
+        from .framework import is_pod_ready
+        counted = set()
+        total = 0
+        by_ns: dict = {}
+        for rc in rcs:
+            ns = rc.metadata.namespace
+            if ns not in by_ns:
+                try:
+                    by_ns[ns], _ = self.client.list("pods", ns)
+                except Exception:
+                    by_ns[ns] = []
+            sel = selector_from_set(rc.spec.selector)
+            for pod in by_ns[ns]:
+                key = (ns, pod.metadata.name)
+                if key in counted:
+                    continue
+                if sel.matches(pod.metadata.labels) and is_pod_ready(pod):
+                    counted.add(key)
+                    total += 1
+        return total
 
     def _create_new_rc(self, d: api.Deployment
                        ) -> Optional[api.ReplicationController]:

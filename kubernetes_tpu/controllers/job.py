@@ -17,7 +17,8 @@ from typing import List
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
 from ..core.labels import selector_from_set
-from .framework import ControllerExpectations, QueueWorkers
+from .framework import (ControllerExpectations, QueueWorkers,
+                        active_pods_sort_key)
 
 
 class JobController:
@@ -118,6 +119,13 @@ class JobController:
                     for t in threads:
                         t.join()
                 elif diff < 0:
+                    # delete-preference order (controller.ActivePods:
+                    # unscheduled < scheduled, Pending < Running,
+                    # not-ready < ready) so scale-down discards pods
+                    # that have done the least work — the same sort the
+                    # RC manager applies (manageJob sorts by ActivePods
+                    # before deleting, job/controller.go)
+                    active = sorted(active, key=active_pods_sort_key)
                     self.expectations.expect_deletions(key, -diff)
                     for pod in active[:(-diff)]:
                         self._delete_pod(job, key, pod)

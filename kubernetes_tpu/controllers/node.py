@@ -22,6 +22,7 @@ from dataclasses import replace
 from typing import Dict, Optional, Set
 
 from ..core import types as api
+from ..core.errors import NotFound
 from ..utils.clock import Clock, RealClock
 from ..utils.ratelimit import TokenBucketRateLimiter
 
@@ -105,9 +106,13 @@ class NodeController:
             prior.probe_timestamp = now
             prior.last_heartbeat = heartbeat
 
-        if (status == "True"
+        if (status != "Unknown"
                 and now - prior.probe_timestamp > self.monitor_grace_period):
-            # heartbeat went stale: the node agent is gone
+            # heartbeat went stale: the node agent is gone. Any last
+            # reported status goes Unknown — a kubelet that reported
+            # Ready=False and then died must not leave its stale
+            # diagnosis standing (monitorNodeStatus marks every
+            # stale-heartbeat node Unknown, nodecontroller.go)
             status = "Unknown"
             prior.ready_transition_timestamp = now
             prior.status = status
@@ -147,23 +152,37 @@ class NodeController:
         re-queued by the next monitor tick, so pods bound to it later are
         evicted too — the reference's RateLimitedTimedQueue keeps
         processing a node until it goes Ready."""
+        failed: set = set()   # per-drain: skip, retry next drain
         while True:
             with self._lock:
-                if not self._eviction_queue:
+                pending = self._eviction_queue - failed
+                if not pending:
                     return
-                name = min(self._eviction_queue)  # deterministic order
+                name = min(pending)  # deterministic order
             if not self.eviction_limiter.try_accept():
                 return
-            self._evict_pods(name)
+            if not self._evict_pods(name):
+                # keep the entry (a node DELETED from the API is only
+                # ever queued once, so a transient failure must not
+                # discard its eviction forever — the reference's
+                # RateLimitedTimedQueue keeps entries until their work
+                # succeeds) but move PAST it this drain: one
+                # persistently failing node must not head-of-line
+                # block every other node's eviction
+                failed.add(name)
+                continue
             with self._lock:
                 self._eviction_queue.discard(name)
 
-    def _evict_pods(self, node_name: str) -> None:
+    def _evict_pods(self, node_name: str) -> bool:
+        """True when the node's pods were listed and every delete was
+        accepted (NotFound counts as done); False requeues the node."""
         try:
             pods, _ = self.client.list(
                 "pods", field_selector=f"spec.nodeName={node_name}")
         except Exception:
-            return
+            return False
+        ok = True
         for pod in pods:
             try:
                 self.client.delete("pods", pod.metadata.name,
@@ -173,8 +192,11 @@ class NodeController:
                         pod, "Normal", "NodeControllerEviction",
                         "Marking for deletion Pod %s from Node %s",
                         pod.metadata.name, node_name)
+            except NotFound:
+                continue  # someone else deleted it: done is done
             except Exception:
-                pass
+                ok = False  # retried when the node drains again
+        return ok
 
     # -- pod CIDR allocation ----------------------------------------------
 

@@ -74,10 +74,15 @@ class ImageManager:
             present = image in self._present
             if present:
                 self._present[image] = time.time()
-        if policy == "Never" and not present:
-            raise ImageNeverPullError(
-                f"container {container.name}: image {image!r} is not "
-                f"present with pull policy of Never")
+        if policy == "Never":
+            # never pulls, whether or not the image is present (the
+            # reference's shouldPullImage is unconditionally false for
+            # PullNever, image_puller.go); absent is the start error
+            if not present:
+                raise ImageNeverPullError(
+                    f"container {container.name}: image {image!r} is not "
+                    f"present with pull policy of Never")
+            return
         if policy == "IfNotPresent" and present:
             return
         if self._puller_takes_pod:

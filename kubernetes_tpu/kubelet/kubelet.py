@@ -123,6 +123,23 @@ class _PodWorker:
                 pass  # next update or periodic sync re-drives
 
 
+def _container_spec_hash(c) -> int:
+    """Restart-relevant spec identity for one container (the
+    dockertools HashContainer role): image, command/args, ports, env
+    names/values, volume mounts, and EFFECTIVE privilege (flat field or
+    nested SecurityContext — both surfaces are honored at create, so
+    both must trigger the restart). Probes/lifecycle are excluded
+    (workers re-read them live)."""
+    from .securitycontext import effective_privileged
+    return hash((c.image, tuple(c.command), tuple(c.args),
+                 tuple((p.name, p.host_port, p.container_port, p.protocol)
+                       for p in c.ports),
+                 tuple((e.name, e.value) for e in c.env),
+                 tuple((m.name, m.mount_path, m.read_only)
+                       for m in c.volume_mounts),
+                 effective_privileged(c)))
+
+
 class Kubelet:
     def __init__(self, client, node_name: str,
                  runtime: Optional[Runtime] = None,
@@ -157,11 +174,18 @@ class Kubelet:
         self.pleg = GenericPLEG(self.runtime)
         self.prober_manager = ProberManager(
             prober or Prober(), on_liveness_failure=self._liveness_failed,
-            on_readiness_change=self._readiness_changed)
+            on_readiness_change=self._readiness_changed,
+            runtime_view=self._runtime_container)
         self.status_manager = StatusManager(client)
         self._workers: Dict[str, _PodWorker] = {}
         self._pods: Dict[str, api.Pod] = {}  # uid -> latest spec
         self._backoff: Dict[str, float] = {}  # uid/name -> not-before
+        # container spec hash at last successful start — the
+        # dockertools container-hash role (manager.go computes a spec
+        # hash per container and kills/restarts on divergence); a
+        # kubelet restart adopts running containers at their current
+        # spec rather than restarting the node's workload
+        self._container_hash: Dict[str, int] = {}
         self._start_times: Dict[str, str] = {}  # uid -> first-seen time
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -283,6 +307,9 @@ class Kubelet:
             for key in [k for k in self._backoff
                         if k.startswith(f"{uid}/")]:
                 del self._backoff[key]
+            for key in [k for k in self._container_hash
+                        if k.startswith(f"{uid}/")]:
+                del self._container_hash[key]
         if worker:
             worker.stop()
         self.prober_manager.remove_pod(uid)
@@ -443,11 +470,53 @@ class Kubelet:
             if not _gated_setup("network", _network):
                 return
         self._reconcile_bandwidth(pod)
+        # containers running but no longer in the spec are killed (the
+        # reference's SyncPod kills everything not in the desired set,
+        # manager.go; PreStop is unknowable here — the old spec is
+        # gone — matching the divergence note in _run_pre_stop)
+        spec_names = {c.name for c in pod.spec.containers}
+        for name, rc in list(by_name.items()):
+            if name not in spec_names and \
+                    rc.state == ContainerState.RUNNING:
+                try:
+                    self.runtime.kill_container(uid, name)
+                except Exception:
+                    pass
+                self._container_hash.pop(f"{uid}/{name}", None)
+                if self.recorder:
+                    self.recorder.eventf(
+                        pod, "Normal", "Killing",
+                        f"Killing container {name} (removed from spec)")
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
+            chash = _container_spec_hash(container)
+            hkey = f"{uid}/{container.name}"
             if rc is not None and rc.state == ContainerState.RUNNING:
-                continue
-            if rc is not None and not self._should_restart(
+                stored = self._container_hash.get(hkey)
+                if stored is None:
+                    # kubelet restart: adopt at current spec
+                    self._container_hash[hkey] = chash
+                    continue
+                if stored == chash:
+                    continue
+                # spec changed under a running container: kill (with
+                # PreStop, like every intentional kill) and fall
+                # through to the restart below (manager.go container
+                # hash divergence)
+                self._run_pre_stop(pod, container.name)
+                try:
+                    self.runtime.kill_container(uid, container.name)
+                except Exception:
+                    continue  # retried next sync
+                self._container_hash.pop(hkey, None)
+                if self.recorder:
+                    self.recorder.eventf(
+                        pod, "Normal", "Killing",
+                        f"Killing container {container.name} "
+                        f"(spec changed)")
+            if rc is not None and rc.state == ContainerState.RUNNING:
+                pass  # killed above; restart this sync
+            elif rc is not None and not self._should_restart(
                     pod.spec.restart_policy, rc.exit_code):
                 continue
             key = f"{uid}/{container.name}"
@@ -460,6 +529,7 @@ class Kubelet:
                     self.image_manager.ensure_image_exists(pod, container)
                 self.runtime.start_container(
                     pod, self._container_with_env(pod, container))
+                self._container_hash[key] = chash
                 if (container.lifecycle is not None
                         and container.lifecycle.post_start is not None):
                     # a failed PostStart kills the container and fails
@@ -725,6 +795,15 @@ class Kubelet:
             return exit_code != 0
         return True  # Always
 
+    def _runtime_container(self, uid: str, name: str):
+        """Prober view: the CURRENT incarnation of one container (state,
+        start time, restart count) — worker.go doProbe's container
+        lookup."""
+        rp = self._runtime_pod(uid)
+        if rp is None:
+            return None
+        return next((c for c in rp.containers if c.name == name), None)
+
     def _runtime_pod(self, uid: str) -> Optional[RuntimePod]:
         for rp in self.runtime.get_pods():
             if rp.uid == uid:
@@ -878,7 +957,14 @@ class Kubelet:
                     self._worker_for(pod).update(pod)
             if now - last_housekeeping >= HOUSEKEEPING_PERIOD:
                 last_housekeeping = now
-                self._housekeeping()
+                try:
+                    self._housekeeping()
+                except Exception:
+                    # one transient runtime error must not kill the
+                    # kubelet's only sync/housekeeping thread (the
+                    # reference wraps syncLoop work in HandleCrash)
+                    logger.warning("housekeeping pass failed; retrying "
+                                   "next period", exc_info=True)
 
     def _housekeeping(self) -> None:
         """Kill runtime pods whose API object is gone, tear down their

@@ -8,12 +8,15 @@ and pushes PodLifecycleEvents into the channel the sync loop selects on
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 from .container import ContainerState, Runtime
+
+logger = logging.getLogger(__name__)
 
 RELIST_PERIOD = 1.0  # generic.go relistPeriod (1s in the reference too)
 
@@ -75,7 +78,15 @@ class GenericPLEG:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self.relist()
+            try:
+                self.relist()
+            except Exception:
+                # a transient runtime error (daemon restart, CLI
+                # hiccup) must not kill the only event source for the
+                # kubelet's life — the reference's relist runs under
+                # wait.Until and survives errors
+                logger.debug("pleg relist failed; retrying",
+                             exc_info=True)
             self._stop.wait(self.relist_period)
 
     def start(self) -> "GenericPLEG":

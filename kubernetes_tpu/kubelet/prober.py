@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 import urllib.request
 from typing import Callable, Dict, Optional, Tuple
 
 from ..core import types as api
+from .container import ContainerState
 
 SUCCESS = "success"
 FAILURE = "failure"
@@ -92,6 +94,7 @@ class _Worker:
         self._stop = threading.Event()
         self._successes = 0
         self._failures = 0
+        self._seen_restarts: Optional[int] = None
         self.thread = threading.Thread(target=self._run, daemon=True,
                                        name=f"probe-{probe_type}-"
                                             f"{container.name}")
@@ -106,6 +109,32 @@ class _Worker:
                 return
 
     def _probe_once(self) -> None:
+        rv = self.manager.runtime_view
+        if rv is not None:
+            rc = rv(self.pod.metadata.uid, self.container.name)
+            if rc is None or rc.state != ContainerState.RUNNING:
+                return  # nothing running to probe (worker.go doProbe)
+            if rc.restart_count != self._seen_restarts:
+                # a restarted container gets a clean slate AND a fresh
+                # initial delay keyed off ITS start time — the delay is
+                # per container incarnation, not per worker lifetime.
+                # Readiness also resets to NOT ready: the previous
+                # incarnation's pass must not route traffic to a fresh
+                # container that has never been probed (worker.go sets
+                # the result to Failure on restart); liveness keeps its
+                # counters-only reset — a synthetic failure result here
+                # would kill the brand-new container
+                first = self._seen_restarts is None
+                self._seen_restarts = rc.restart_count
+                self._successes = self._failures = 0
+                if self.probe_type == self.manager.READINESS and not first:
+                    self.manager._report(
+                        self.pod, self.container, self.probe_type, False,
+                        "container restarted; awaiting readiness probe")
+            if (self.probe.initial_delay_seconds and rc.started_at
+                    and time.time() - rc.started_at
+                    < self.probe.initial_delay_seconds):
+                return
         # always probe the manager's LATEST view of the pod — the object
         # captured at add time has no pod IP yet (worker.go re-reads the
         # status through the status manager for the same reason)
@@ -144,8 +173,15 @@ class ProberManager:
 
     def __init__(self, prober: Optional[Prober] = None,
                  on_liveness_failure: Optional[Callable] = None,
-                 on_readiness_change: Optional[Callable] = None):
+                 on_readiness_change: Optional[Callable] = None,
+                 runtime_view: Optional[Callable] = None):
         self.prober = prober or Prober()
+        # runtime_view(pod_uid, container_name) -> RuntimeContainer|None:
+        # lets workers key the initial delay off the CURRENT container's
+        # start time, reset counters across restarts, and skip
+        # non-running containers (worker.go doProbe); probes proceed
+        # unconditionally when no view is wired (standalone use)
+        self.runtime_view = runtime_view
         # (pod_uid, container, type) -> (ok, message)
         self.results: Dict[Tuple[str, str, str], Tuple[bool, str]] = {}
         self.on_liveness_failure = on_liveness_failure

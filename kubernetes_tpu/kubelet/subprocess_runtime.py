@@ -166,8 +166,13 @@ class SubprocessRuntime(Runtime):
     def start_container(self, pod: api.Pod, container: api.Container
                         ) -> RuntimeContainer:
         uid = pod.metadata.uid
-        cmd = (list(container.command) + list(container.args)) \
-            if container.command else self.default_command
+        # args apply whether or not command overrides the entrypoint:
+        # the default command plays the image-entrypoint role here, so
+        # an args-only spec runs default_command + args (dockertools
+        # passes Entrypoint/Cmd independently; an args-only container
+        # must not silently run the bare pause loop)
+        cmd = (list(container.command) or list(self.default_command)) \
+            + list(container.args)
         env = {**os.environ,
                **{e.name: e.value for e in container.env}}
         with self._lock:

@@ -210,3 +210,66 @@ class TestKubeletSync:
         assert wait_until(lambda: ready_cond() == "False")
         ready["ok"] = True
         assert wait_until(lambda: ready_cond() == "True", timeout=15)
+
+
+class TestSpecDrift:
+    """syncPod must make running containers MATCH the spec — divergent
+    containers restart at the new spec and removed containers are
+    killed (the reference's dockertools container hash, manager.go
+    HashContainer/SyncPod; kubelet.go:1597)."""
+
+    def test_image_change_restarts_running_container(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "web", "u-web")
+        assert wait_until(
+            lambda: runtime.running_containers(created.metadata.uid))
+        live = client.get("pods", "web", "default")
+        live.spec.containers[0].image = "img:v2"
+        client.update("pods", live, "default")
+        # the container restarts onto the new image
+        from kubernetes_tpu.kubelet.container import ContainerState
+
+        def new_image_running():
+            for rp in runtime.get_pods():
+                if rp.uid != created.metadata.uid:
+                    continue
+                return any(c.name == "c" and c.image == "img:v2"
+                           and c.state == ContainerState.RUNNING
+                           for c in rp.containers)
+            return False
+        assert wait_until(new_image_running, timeout=15)
+
+    def test_container_removed_from_spec_is_killed(self, kubelet_env):
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "web", "u-web", containers=[
+            api.Container(name="a", image="img"),
+            api.Container(name="b", image="img")])
+        assert wait_until(lambda: sorted(
+            runtime.running_containers(created.metadata.uid)) == ["a", "b"])
+        live = client.get("pods", "web", "default")
+        live.spec.containers = [c for c in live.spec.containers
+                                if c.name == "a"]
+        client.update("pods", live, "default")
+        assert wait_until(lambda: runtime.running_containers(
+            created.metadata.uid) == ["a"], timeout=15)
+
+
+def test_image_pull_policy_never_present_does_not_pull():
+    """PullNever never invokes the puller, present or not — the
+    reference's shouldPullImage is unconditionally false for PullNever
+    (image_puller.go); absent is a start error, present is a no-op."""
+    from kubernetes_tpu.kubelet.images import ImageManager, \
+        ImageNeverPullError
+
+    pulls = []
+    mgr = ImageManager(puller=pulls.append)
+    pod = mkpod("p", "u1")
+    cont = api.Container(name="c", image="present-img",
+                        image_pull_policy="Never")
+    with pytest.raises(ImageNeverPullError):
+        mgr.ensure_image_exists(pod, cont)
+    assert pulls == []
+    mgr.mark_present("present-img") if hasattr(mgr, "mark_present") else \
+        mgr._present.update({"present-img": 1.0})
+    mgr.ensure_image_exists(pod, cont)
+    assert pulls == []

@@ -190,6 +190,29 @@ class TestDeploymentController:
         registry, client = cluster
         rc_manager = ReplicationManager(client).run()
         ctrl = DeploymentController(client).run()
+        # hollow-kubelet stand-in: the rolling updater scales old RCs
+        # down against READY pods (reconcileOldRCs), so something must
+        # confirm readiness or the rollout (correctly) stalls forever
+        import threading as _threading
+        stop_ready = _threading.Event()
+
+        def _readiness_pump():
+            from dataclasses import replace as _rep
+            while not stop_ready.is_set():
+                for p in pods_of(client, label=("app", "web")):
+                    if not any(c.type == "Ready" and c.status == "True"
+                               for c in p.status.conditions):
+                        try:
+                            client.update_status("pods", _rep(
+                                p, status=_rep(
+                                    p.status, phase="Running",
+                                    conditions=[api.PodCondition(
+                                        type="Ready", status="True")])),
+                                "default")
+                        except Exception:
+                            pass
+                stop_ready.wait(0.1)
+        _threading.Thread(target=_readiness_pump, daemon=True).start()
         try:
             d = api.Deployment(
                 metadata=api.ObjectMeta(name="web", namespace="default"),
@@ -219,6 +242,7 @@ class TestDeploymentController:
                         and live[0].status.replicas == 2)
             assert wait_until(rolled, timeout=30)
         finally:
+            stop_ready.set()
             ctrl.stop()
             rc_manager.stop()
 

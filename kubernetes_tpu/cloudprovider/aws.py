@@ -208,10 +208,16 @@ class AwsLoadBalancers(LoadBalancers):
     :384-440)."""
 
     def __init__(self, client: _QueryClient, instances: AwsInstances,
-                 vpc_id: str = "vpc-default"):
+                 vpc_id: str = "vpc-default", zone: str = ""):
         self._c = client
         self._i = instances
         self.vpc_id = vpc_id
+        # the AZ the cluster's instances live in (aws.go derives the
+        # ELB's zones from the instances; this single-zone provider
+        # carries it as config) — an ELB enabled only in a hardcoded
+        # {region}a would leave instances in any other zone
+        # OutOfService with no backends
+        self.zone = zone or f"{client.region}a"
 
     def _describe(self, name: str) -> Optional[ET.Element]:
         try:
@@ -298,6 +304,27 @@ class AwsLoadBalancers(LoadBalancers):
             except AwsError as e:
                 if "InvalidPermission.Duplicate" not in str(e):
                     raise
+        # reconcile DOWN too: a port removed from the service must not
+        # leave its world-open ingress on the group forever
+        # (aws.go ensureSecurityGroupIngress removes as well as adds)
+        try:
+            root = self._c.call("ec2", "DescribeSecurityGroups", {
+                "GroupId": [sg_id]})
+            for perm in root.findall(".//ipPermissions/item"):
+                from_p = perm.findtext("fromPort")
+                if from_p is None or int(from_p) in ports:
+                    continue
+                self._c.call("ec2", "RevokeSecurityGroupIngress", {
+                    "GroupId": sg_id, "IpPermissions": {"item": [
+                        {"IpProtocol": perm.findtext("ipProtocol")
+                         or "tcp",
+                         "FromPort": int(from_p),
+                         "ToPort": int(perm.findtext("toPort")
+                                       or from_p),
+                         "IpRanges": {"item": [
+                             {"CidrIp": "0.0.0.0/0"}]}}]}})
+        except AwsError:
+            pass  # stale-rule cleanup is best-effort; adds already landed
         return sg_id
 
     def ensure(self, name: str, region: str, ports: List[int],
@@ -340,7 +367,7 @@ class AwsLoadBalancers(LoadBalancers):
         created = self._c.call("elb", "CreateLoadBalancer", {
             "LoadBalancerName": name,
             "Listeners": {"member": listeners},
-            "AvailabilityZones": {"member": [f"{self._c.region}a"]},
+            "AvailabilityZones": {"member": [self.zone]},
             "SecurityGroups": {"member": [sg_id]}})
         dns = created.findtext(".//DNSName") or ""
         ids = self._i.instance_ids(hosts)
@@ -464,7 +491,8 @@ class AwsProvider(CloudProvider, Zones):
         self.zone = zone or region + "a"
         self._instances = AwsInstances(self._client)
         self._load_balancers = AwsLoadBalancers(self._client,
-                                                self._instances, vpc_id)
+                                                self._instances, vpc_id,
+                                                zone=self.zone)
         self._routes = AwsRoutes(self._client, self._instances,
                                  route_table_id)
 

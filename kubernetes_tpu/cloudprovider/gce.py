@@ -28,6 +28,7 @@ Surface parity with gce.go:
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -99,14 +100,19 @@ class _GceClient:
 
     # ---- async operations (gce.go:305-352) ----
 
-    def wait_op(self, op: Optional[dict], max_polls: int = 100) -> None:
+    def wait_op(self, op: Optional[dict], max_polls: int = 100,
+                poll_interval: float = 0.5) -> None:
         """Poll a returned Operation to DONE, surfacing its error
-        (gce.go waitForOp + opIsDone/getErrorFromOp)."""
+        (gce.go waitForOp + opIsDone/getErrorFromOp). Sleeps between
+        polls like the reference — back-to-back GETs would exhaust
+        max_polls in under a second for an operation that takes a few
+        seconds to land, spuriously failing the mutation AND hammering
+        the API ~100 times."""
         if op is None:
             return
         name = op.get("name", "")
         scope = op.get("zone") or op.get("region")
-        for _ in range(max_polls):
+        for i in range(max_polls):
             if op and op.get("status") == "DONE":
                 err = (op.get("error") or {}).get("errors")
                 if err:
@@ -118,6 +124,8 @@ class _GceClient:
                 path = f"/{kind}/{seg}/operations/{name}"
             else:
                 path = f"/global/operations/{name}"
+            if i:
+                time.sleep(poll_interval)
             op = self.request("GET", path) or {}
         raise GceError(f"operation {name}: did not reach DONE")
 

@@ -167,9 +167,16 @@ class OpenStackLoadBalancers(LoadBalancers):
     """neutron LBaaS v1 (ref: openstack.go:633-907): one pool per LB,
     one member per host, a vip fronting the pool."""
 
-    def __init__(self, session: _Session, subnet_id: str = ""):
+    def __init__(self, session: _Session, subnet_id: str = "",
+                 instances: "Optional[OpenStackInstances]" = None):
         self._s = session
         self.subnet_id = subnet_id
+        # nova view for host-name <-> member-IP translation: members
+        # take IPs (getAddressByName before members.Create,
+        # openstack.go EnsureTCPLoadBalancer) while the service
+        # controller speaks node names — get() must answer in the
+        # controller's vocabulary or its host diff never converges
+        self._instances = instances or OpenStackInstances(session)
 
     def _vip_by_name(self, name: str) -> Optional[dict]:
         data = self._s.request(
@@ -188,8 +195,9 @@ class OpenStackLoadBalancers(LoadBalancers):
         if pool is not None:
             data = self._s.request(
                 "GET", "network", f"/lb/members?pool_id={pool['id']}")
-            hosts = sorted(m.get("address", "")
-                           for m in (data or {}).get("members", []))
+            hosts = self._names_of(
+                [m.get("address", "")
+                 for m in (data or {}).get("members", [])])
         return LoadBalancer(name=name, region=region,
                             external_ip=vip.get("address", ""),
                             ports=ports, hosts=hosts)
@@ -223,14 +231,46 @@ class OpenStackLoadBalancers(LoadBalancers):
                      "lb_method": "ROUND_ROBIN"}})["pool"]
         for host in hosts:
             self._s.request("POST", "network", "/lb/members", {
-                "member": {"pool_id": pool["id"], "address": host,
+                "member": {"pool_id": pool["id"],
+                           "address": self._address_by_name(host),
                            "protocol_port": ports[0]}})
         vip = self._s.request("POST", "network", "/lb/vips", {
             "vip": {"name": name, "pool_id": pool["id"],
                     "protocol": "TCP", "protocol_port": ports[0],
                     "subnet_id": self.subnet_id}})["vip"]
         return LoadBalancer(name=name, region=region,
-                            external_ip=vip.get("address", ""))
+                            external_ip=vip.get("address", ""),
+                            ports=list(ports), hosts=sorted(hosts))
+
+    def _address_by_name(self, host: str) -> str:
+        """Members take IP addresses, not node names: resolve each host
+        through nova like the reference's getAddressByName
+        (openstack.go EnsureTCPLoadBalancer resolves every host before
+        members.Create). A host that is already an IP passes through."""
+        import re as _re
+        if _re.fullmatch(r"\d+\.\d+\.\d+\.\d+", host):
+            return host
+        addrs = self._instances.node_addresses(host)
+        if not addrs:
+            raise OpenStackError(f"no address found for host {host!r}")
+        return addrs[0]
+
+    def _names_of(self, addrs: List[str]) -> List[str]:
+        """Reverse-translate member IPs to node names for the
+        controller-facing host list; unknown IPs pass through."""
+        ip_to_name = {}
+        try:
+            for srv in self._instances._servers():
+                srv_name = srv.get("name", "")
+                if srv.get("accessIPv4"):
+                    ip_to_name.setdefault(srv["accessIPv4"], srv_name)
+                for _pool, a in (srv.get("addresses") or {}).items():
+                    for rec in a:
+                        if rec.get("addr"):
+                            ip_to_name.setdefault(rec["addr"], srv_name)
+        except OpenStackError:
+            pass
+        return sorted(ip_to_name.get(a, a) for a in addrs)
 
     def _pool_for(self, name: str) -> Optional[dict]:
         data = self._s.request(
@@ -259,13 +299,13 @@ class OpenStackLoadBalancers(LoadBalancers):
         if not port:
             raise OpenStackError(
                 f"load balancer {name!r} has no resolvable port")
-        for host in hosts:
-            if host not in have:
-                self._s.request("POST", "network", "/lb/members", {
-                    "member": {"pool_id": pool["id"], "address": host,
-                               "protocol_port": port}})
+        want = {self._address_by_name(h) for h in hosts}
+        for addr in sorted(want - set(have)):
+            self._s.request("POST", "network", "/lb/members", {
+                "member": {"pool_id": pool["id"], "address": addr,
+                           "protocol_port": port}})
         for addr, member in have.items():
-            if addr not in hosts:
+            if addr not in want:
                 self._s.request("DELETE", "network",
                                 f"/lb/members/{member['id']}")
 
@@ -300,8 +340,8 @@ class OpenStackProvider(CloudProvider, Zones):
         self.region = region
         self.availability_zone = availability_zone
         self._instances = OpenStackInstances(self._session)
-        self._load_balancers = OpenStackLoadBalancers(self._session,
-                                                      subnet_id)
+        self._load_balancers = OpenStackLoadBalancers(
+            self._session, subnet_id, instances=self._instances)
 
     def instances(self) -> Optional[Instances]:
         return self._instances

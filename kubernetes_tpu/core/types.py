@@ -169,10 +169,19 @@ class SecretVolumeSource:
 
 
 @dataclass
+class DownwardAPIVolumeFile:
+    """(ref: pkg/api/types.go:620 — a file at `path` carrying the pod
+    field fieldRef selects; only annotations, labels, name, and
+    namespace are supported)"""
+    path: str = ""
+    field_ref: Optional["ObjectFieldSelector"] = None
+
+
+@dataclass
 class DownwardAPIVolumeSource:
-    """(ref: pkg/api/types.go DownwardAPIVolumeSource; the plugin writes
-    the standard metadata field set)"""
-    items: List[str] = field(default_factory=list)
+    """(ref: pkg/api/types.go:613 DownwardAPIVolumeSource; an empty
+    items list projects the standard metadata field set)"""
+    items: List[DownwardAPIVolumeFile] = field(default_factory=list)
 
 
 @dataclass

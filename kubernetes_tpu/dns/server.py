@@ -170,6 +170,23 @@ class ClusterDNS:
                 data, sock = self.request
                 reply = dns.handle_packet(data)
                 if reply is not None:
+                    if len(reply) > 512:
+                        # RFC 1035 4.2.1: UDP messages cap at 512
+                        # bytes — truncate to the empty-answer header
+                        # with TC set so the resolver retries over the
+                        # TCP listener this server already runs (a
+                        # headless service with ~30 endpoints exceeds
+                        # the cap)
+                        head = bytearray(reply[:12])
+                        head[2] |= 0x02          # TC bit
+                        head[6:8] = b"\x00\x00"  # ANCOUNT = 0
+                        # keep header + question section only: scan to
+                        # the end of QNAME then 4 fixed bytes
+                        i = 12
+                        while i < len(reply) and reply[i] != 0:
+                            i += 1 + reply[i]
+                        i += 1 + 4
+                        reply = bytes(head) + reply[12:i]
                     sock.sendto(reply, self.client_address)
 
         class _TCPHandler(socketserver.BaseRequestHandler):

@@ -490,12 +490,12 @@ class Kubelet:
         for container in pod.spec.containers:
             rc = by_name.get(container.name)
             chash = _container_spec_hash(container)
-            hkey = f"{uid}/{container.name}"
+            key = f"{uid}/{container.name}"  # hash AND backoff key
             if rc is not None and rc.state == ContainerState.RUNNING:
-                stored = self._container_hash.get(hkey)
+                stored = self._container_hash.get(key)
                 if stored is None:
                     # kubelet restart: adopt at current spec
-                    self._container_hash[hkey] = chash
+                    self._container_hash[key] = chash
                     continue
                 if stored == chash:
                     continue
@@ -508,7 +508,7 @@ class Kubelet:
                     self.runtime.kill_container(uid, container.name)
                 except Exception:
                     continue  # retried next sync
-                self._container_hash.pop(hkey, None)
+                self._container_hash.pop(key, None)
                 if self.recorder:
                     self.recorder.eventf(
                         pod, "Normal", "Killing",
@@ -519,7 +519,6 @@ class Kubelet:
             elif rc is not None and not self._should_restart(
                     pod.spec.restart_policy, rc.exit_code):
                 continue
-            key = f"{uid}/{container.name}"
             if self._backoff.get(key, 0) > now:
                 continue
             try:

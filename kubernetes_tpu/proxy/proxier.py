@@ -83,6 +83,19 @@ class IPTablesProxier:
 
         ipt.ensure_chain(TABLE_NAT, KUBE_SERVICES_CHAIN)
         ipt.ensure_chain(TABLE_NAT, KUBE_NODEPORTS_CHAIN)
+        # root jumps: without these the synthesized chain graph is
+        # unreachable — the reference installs PREROUTING/OUTPUT ->
+        # KUBE-SERVICES in iptablesInit and the dst-type LOCAL ->
+        # KUBE-NODEPORTS jump at the end of KUBE-SERVICES
+        # (proxier.go:57-60, syncProxyRules)
+        ipt.ensure_rule(TABLE_NAT, "PREROUTING",
+                        "-m", "comment", "--comment",
+                        "kubernetes service portals",
+                        "-j", KUBE_SERVICES_CHAIN)
+        ipt.ensure_rule(TABLE_NAT, "OUTPUT",
+                        "-m", "comment", "--comment",
+                        "kubernetes service portals",
+                        "-j", KUBE_SERVICES_CHAIN)
         ipt.flush_chain(TABLE_NAT, KUBE_SERVICES_CHAIN)
         ipt.flush_chain(TABLE_NAT, KUBE_NODEPORTS_CHAIN)
 
@@ -120,12 +133,33 @@ class IPTablesProxier:
 
                 targets = self._endpoint_targets(eps, port)
                 n = len(targets)
-                for i, target in enumerate(targets):
-                    sep_chain = endpoint_chain(key[0], key[1], port_name,
-                                               target)
+                affinity = svc.spec.session_affinity == "ClientIP"
+                sep_chains = [endpoint_chain(key[0], key[1], port_name, t)
+                              for t in targets]
+                # SEP chains must exist before any -j references them
+                for sep_chain in sep_chains:
                     wanted_chains.add(sep_chain)
                     ipt.ensure_chain(TABLE_NAT, sep_chain)
                     ipt.flush_chain(TABLE_NAT, sep_chain)
+                if affinity:
+                    # ClientIP stickiness: a client recently served by
+                    # an endpoint re-enters its SEP chain directly
+                    # (-m recent rcheck before the probability split;
+                    # the SEP chain stamps --set) — proxier.go writes
+                    # these alongside the random-split rules
+                    for sep_chain in sep_chains:
+                        ipt.ensure_rule(
+                            TABLE_NAT, svc_chain,
+                            "-m", "recent", "--name", sep_chain,
+                            "--rcheck", "--seconds", "10800", "--reap",
+                            "-j", sep_chain)
+                for i, target in enumerate(targets):
+                    sep_chain = sep_chains[i]
+                    if affinity:
+                        ipt.ensure_rule(
+                            TABLE_NAT, sep_chain,
+                            "-m", "recent", "--name", sep_chain,
+                            "--set")
                     ipt.ensure_rule(
                         TABLE_NAT, sep_chain,
                         "-m", port.protocol.lower(), "-p",
@@ -149,6 +183,15 @@ class IPTablesProxier:
                         TABLE_NAT, svc_chain,
                         "-j", "REJECT", "--reject-with",
                         "icmp-port-unreachable")
+
+        # the nodeports jump goes LAST in KUBE-SERVICES: only traffic
+        # addressed to a local address falls through to nodeport
+        # matching (proxier.go "--dst-type LOCAL -j KUBE-NODEPORTS")
+        ipt.ensure_rule(TABLE_NAT, KUBE_SERVICES_CHAIN,
+                        "-m", "comment", "--comment",
+                        "kubernetes service nodeports",
+                        "-m", "addrtype", "--dst-type", "LOCAL",
+                        "-j", KUBE_NODEPORTS_CHAIN)
 
         # GC chains for services that no longer exist
         for chain in ipt.list_chains(TABLE_NAT):

@@ -24,7 +24,9 @@ from .config import EndpointsConfig, ServiceConfig
 class RoundRobinLoadBalancer:
     """(ref: roundrobin.go LoadBalancerRR)"""
 
-    def __init__(self, affinity_ttl: float = 180.0):
+    def __init__(self, affinity_ttl: float = 180.0 * 60.0):
+        # 180 MINUTES: the reference's ttlMinutes=180 default
+        # (roundrobin.go NewLoadBalancerRR) — three hours, not 180s
         self._endpoints: Dict[Tuple[str, str, str], List[str]] = {}
         self._index: Dict[Tuple[str, str, str], int] = {}
         # (service, client_ip) -> (endpoint, stamp) when session affinity
@@ -102,7 +104,15 @@ class _PortProxy:
             try:
                 conn, addr = self.sock.accept()
             except OSError:
-                return
+                if self._stop.is_set():
+                    return  # closed by stop(): the loop is done
+                # transient accept failure (ECONNABORTED, EMFILE under
+                # load): the listener is still bound — exiting here
+                # would wedge the service port forever while the proxy
+                # stays registered (proxysocket.go ProxyLoop continues
+                # on non-closed errors)
+                time.sleep(0.1)
+                continue
             threading.Thread(target=self._handle, args=(conn, addr[0]),
                              daemon=True).start()
 

@@ -182,22 +182,48 @@ class SecretPlugin(VolumePlugin):
 
 
 class _DownwardAPIBuilder(_DirBuilder):
-    def __init__(self, path: str, pod: api.Pod):
+    def __init__(self, path: str, pod: api.Pod, items=None):
         super().__init__(path)
         self.pod = pod
+        self.items = items or []
+
+    def _field_value(self, field_path: str) -> str:
+        meta = self.pod.metadata
+        if field_path == "metadata.name":
+            return meta.name
+        if field_path == "metadata.namespace":
+            return meta.namespace
+        if field_path == "metadata.labels":
+            return json.dumps(meta.labels)
+        if field_path == "metadata.annotations":
+            return json.dumps(meta.annotations)
+        raise ValueError(
+            f"downward API: unsupported field {field_path!r} (only "
+            "annotations, labels, name and namespace are supported — "
+            "pkg/api/types.go:623)")
 
     def set_up(self) -> None:
         super().set_up()
-        meta = {
-            "metadata.name": self.pod.metadata.name,
-            "metadata.namespace": self.pod.metadata.namespace,
-            "metadata.labels": json.dumps(self.pod.metadata.labels),
-            "metadata.annotations": json.dumps(
-                self.pod.metadata.annotations),
-        }
-        for key, value in meta.items():
+        if self.items:
+            # spec'd projection: one file per item at its relative path
+            # (DownwardAPIVolumeFile, types.go:620-625)
+            for item in self.items:
+                rel = (item.path or "").lstrip("/")
+                if not rel or ".." in rel.split("/"):
+                    raise ValueError(
+                        f"downward API: invalid path {item.path!r}")
+                value = self._field_value(
+                    item.field_ref.field_path if item.field_ref else "")
+                dst = os.path.join(self.path, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                with open(dst, "w") as f:
+                    f.write(value)
+            return
+        # no items: the standard metadata field set
+        for key in ("metadata.name", "metadata.namespace",
+                    "metadata.labels", "metadata.annotations"):
             with open(os.path.join(self.path, key), "w") as f:
-                f.write(value)
+                f.write(self._field_value(key))
 
 
 class DownwardAPIPlugin(VolumePlugin):
@@ -208,8 +234,10 @@ class DownwardAPIPlugin(VolumePlugin):
         return getattr(volume, "downward_api", None) is not None
 
     def new_builder(self, volume: api.Volume, pod: api.Pod) -> Builder:
-        return _DownwardAPIBuilder(self.host.pod_volume_dir(
-            pod.metadata.uid, self.name, volume.name), pod)
+        return _DownwardAPIBuilder(
+            self.host.pod_volume_dir(pod.metadata.uid, self.name,
+                                     volume.name),
+            pod, items=volume.downward_api.items)
 
     def new_cleaner(self, volume_name: str, pod_uid: str) -> Cleaner:
         return _DirBuilder(self.host.pod_volume_dir(

@@ -300,3 +300,37 @@ class TestSubprocessRuntimeResolvConf:
         assert f"RESOLV={path}" in log
         rt.kill_pod("u1")
         assert not path.exists()  # cleaned up with the pod
+
+
+def test_udp_truncation_tc_bit_and_tcp_fallback(dns_env):
+    """RFC 1035 4.2.1: a UDP answer over 512 bytes truncates to the
+    question with TC set; the full answer set rides the TCP listener
+    (the resolver's standard retry path)."""
+    client, dns = dns_env
+    client.create("endpoints", api.Endpoints(
+        metadata=api.ObjectMeta(name="big", namespace="default"),
+        subsets=[api.EndpointSubset(
+            addresses=[api.EndpointAddress(ip=f"10.244.{i // 250}.{i % 250 + 1}")
+                       for i in range(40)],
+            ports=[api.EndpointPort(name="p", port=7000)])]), "default")
+    client.create("services", api.Service(
+        metadata=api.ObjectMeta(name="big", namespace="default"),
+        spec=api.ServiceSpec(cluster_ip="None", ports=[
+            api.ServicePort(name="p", port=7000, protocol="TCP")])),
+        "default")
+    name = "big.default.svc.cluster.local"
+    assert wait_until(lambda: tcp_query(dns.port, name, 1)[1])
+
+    # raw UDP: reply fits 512 with TC set and zero answers
+    q = build_query(0x7777, name, 1)
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(5.0)
+        s.sendto(q, ("127.0.0.1", dns.port))
+        data, _ = s.recvfrom(4096)
+    assert len(data) <= 512
+    flags = struct.unpack("!H", data[2:4])[0]
+    assert flags & 0x0200, "TC bit not set on truncated UDP reply"
+    assert struct.unpack("!H", data[6:8])[0] == 0  # ANCOUNT
+    # the TCP path carries all 40 answers
+    rcode, answers = tcp_query(dns.port, name, 1)
+    assert rcode == 0 and len(answers) == 40

@@ -254,11 +254,13 @@ def test_lb_get_populates_ports_and_hosts(cloud):
     lbs.ensure("stable-lb", "RegionOne", [8080], ["192.168.0.4"])
     got = lbs.get("stable-lb", "RegionOne")
     assert got.ports == [8080]
-    assert got.hosts == ["192.168.0.4"]
-    # ensure() on an existing LB returns the FRESH host set
+    # hosts come back in the controller's vocabulary: the member IP
+    # reverse-resolves to the node that owns it
+    assert got.hosts == ["node-a"]
+    # ensure() on an existing LB returns the FRESH host set (names)
     again = lbs.ensure("stable-lb", "RegionOne", [8080],
                        ["192.168.0.4", "192.168.0.5"])
-    assert again.hosts == ["192.168.0.4", "192.168.0.5"]
+    assert again.hosts == ["node-a", "node-b"]
 
 
 def test_region_matched_endpoint_selection(cloud):
@@ -292,3 +294,25 @@ def test_post_404_raises_instead_of_crashing(cloud):
     s = p._session
     with pytest.raises(OpenStackError):
         s.request("POST", "network", "/lb/nonexistent", {"x": 1})
+
+
+def test_lbaas_members_resolve_node_names(cloud):
+    """The service controller passes node NAMES; members must be
+    created with nova-resolved IPs (getAddressByName before
+    members.Create, openstack.go EnsureTCPLoadBalancer) while get()
+    answers back in node names so the controller's host diff
+    converges instead of re-ensuring forever."""
+    p = _provider(cloud)
+    lbs = p.load_balancers()
+    lb = lbs.ensure("svc-names", "RegionOne", [80], ["node-a", "node-b"])
+    member_addrs = sorted(m["address"] for m in cloud.members.values())
+    assert member_addrs == ["10.0.0.4", "192.168.0.5"]  # IPs, not names
+    assert lb.hosts == ["node-a", "node-b"]  # controller vocabulary
+
+    got = lbs.get("svc-names", "RegionOne")
+    assert got is not None and got.hosts == ["node-a", "node-b"]
+
+    # diffing by name converges: same hosts -> no member churn
+    before = set(cloud.members)
+    lbs.update_hosts("svc-names", "RegionOne", ["node-a", "node-b"])
+    assert set(cloud.members) == before

@@ -89,7 +89,9 @@ class TestIPTablesProxier:
         ipt = FakeIPTables()
         p = IPTablesProxier(ipt)
         p.on_service_update([svc("hl", "None")])
-        assert ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN) == []
+        # only the always-present nodeports fall-through jump remains
+        rules = ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        assert [r for r in rules if "KUBE-NODEPORTS" not in r] == []
 
     def test_watch_driven_sync(self):
         registry = Registry()
@@ -538,3 +540,42 @@ def test_userspace_udp_nodeport_listener():
     finally:
         p.stop()
         backend.close()
+
+
+class TestIPTablesRootJumpsAndAffinity:
+    def test_root_jumps_installed(self):
+        """The chain graph must be REACHABLE: PREROUTING/OUTPUT jump to
+        KUBE-SERVICES and KUBE-SERVICES falls through to KUBE-NODEPORTS
+        for local addresses (proxier.go iptablesInit + syncProxyRules)."""
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        p.on_service_update([svc("web", "10.0.0.10")])
+        for chain in ("PREROUTING", "OUTPUT"):
+            assert any("KUBE-SERVICES" in r
+                       for r in ipt.list_rules(TABLE_NAT, chain)), chain
+        assert any("KUBE-NODEPORTS" in r and "--dst-type" in r
+                   for r in ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN))
+
+    def test_clientip_affinity_recent_rules(self):
+        """sessionAffinity: ClientIP emits -m recent rcheck rules ahead
+        of the probability split and --set stamps in the SEP chains."""
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        s = svc("web", "10.0.0.10")
+        s.spec.session_affinity = "ClientIP"
+        p.on_service_update([s])
+        p.on_endpoints_update([eps("web", [("10.1.0.5", 8080),
+                                           ("10.1.0.6", 8080)])])
+        sc = [c for c in ipt.list_chains(TABLE_NAT)
+              if c.startswith("KUBE-SVC-")][0]
+        svc_rules = ipt.list_rules(TABLE_NAT, sc)
+        rcheck = [r for r in svc_rules if "--rcheck" in r]
+        assert len(rcheck) == 2 and all("10800" in r for r in rcheck)
+        # rcheck rules precede the probability split
+        first_split = next(i for i, r in enumerate(svc_rules)
+                           if "statistic" in r)
+        assert all(svc_rules.index(r) < first_split for r in rcheck)
+        for c in ipt.list_chains(TABLE_NAT):
+            if c.startswith("KUBE-SEP-"):
+                assert any("--set" in r
+                           for r in ipt.list_rules(TABLE_NAT, c))

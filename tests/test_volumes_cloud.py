@@ -251,3 +251,36 @@ class TestNewVolumePlugins:
                     repository="/tmp/nowhere", revision=bad))])
             with pytest.raises(BadRequest):
                 mgr.set_up_pod_volumes(pod)
+
+
+def test_downward_api_items_projection(host):
+    """DownwardAPIVolumeFile items select WHICH fields land and at what
+    relative paths (types.go:620-625); unsupported fieldRefs and path
+    traversal fail."""
+    vh, *_ = host
+    mgr = new_default_plugin_mgr(vh)
+    pod = mkpod(volumes=[api.Volume(
+        name="meta", downward_api=api.DownwardAPIVolumeSource(items=[
+            api.DownwardAPIVolumeFile(
+                path="labels", field_ref=api.ObjectFieldSelector(
+                    field_path="metadata.labels")),
+            api.DownwardAPIVolumeFile(
+                path="sub/podname", field_ref=api.ObjectFieldSelector(
+                    field_path="metadata.name"))]))])
+    paths = mgr.set_up_pod_volumes(pod)
+    import json as _json
+    with open(os.path.join(paths["meta"], "labels")) as f:
+        assert "web" in f.read()
+    with open(os.path.join(paths["meta"], "sub/podname")) as f:
+        assert f.read() == pod.metadata.name
+    assert not os.path.exists(
+        os.path.join(paths["meta"], "metadata.namespace"))
+
+    import pytest as _pytest
+    bad = mkpod(volumes=[api.Volume(
+        name="meta2", downward_api=api.DownwardAPIVolumeSource(items=[
+            api.DownwardAPIVolumeFile(
+                path="../esc", field_ref=api.ObjectFieldSelector(
+                    field_path="metadata.name"))]))])
+    with _pytest.raises(Exception):
+        mgr.set_up_pod_volumes(bad)

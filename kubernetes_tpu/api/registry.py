@@ -723,8 +723,12 @@ class Registry:
             if not obj.metadata.resource_version:
                 # Unconditional update requires the object to exist
                 # (PUT never creates in the reference's generic store).
-                self.store.get(key)
-                result = self.store.set(key, obj, ttl=info.ttl)
+                # One atomic read-modify-write: a get-then-set pair
+                # would let a concurrent DELETE land between the two
+                # lock acquisitions and the set would RESURRECT the
+                # deleted object as a fresh ADDED event
+                result = self.store.guaranteed_update(
+                    key, lambda cur: obj, ttl=info.ttl)
             else:
                 result = self.store.update(key, obj)
         except Exception:
@@ -746,8 +750,22 @@ class Registry:
         ns = self._namespace_for(info, obj, namespace)
         key = self.key(resource, ns, obj.metadata.name)
         new_status = obj.status
+        expect_rv = obj.metadata.resource_version
 
         def apply(cur: Any) -> Any:
+            # optimistic concurrency like every reference status write
+            # (statusStrategy through the generic etcd update,
+            # etcd.go:270-316): a writer carrying a stale rv must 409
+            # and re-read, not silently resurrect what it saw before —
+            # e.g. a delayed kubelet heartbeat overwriting the node
+            # controller's Ready=Unknown with pre-outage conditions.
+            # rv-less writes stay unconditional (the in-proc callers'
+            # documented contract).
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise Conflict(
+                    f"status update on {key} failed: object was "
+                    f"modified (have {expect_rv}, current "
+                    f"{cur.metadata.resource_version})")
             return replace(cur, status=new_status)
 
         return self.store.guaranteed_update(key, apply)
@@ -766,7 +784,15 @@ class Registry:
         for obj in objs:
             ns = self._namespace_for(info, obj, namespace)
 
-            def set_status(cur, rv="", s=obj.status):
+            def set_status(cur, rv="", s=obj.status,
+                           expect=obj.metadata.resource_version):
+                if expect and cur.metadata.resource_version != expect:
+                    # same optimistic-concurrency contract as the
+                    # single update_status above
+                    raise Conflict(
+                        f"status update failed: object was modified "
+                        f"(have {expect}, current "
+                        f"{cur.metadata.resource_version})")
                 if rv:
                     return api.fast_replace(
                         cur, status=s, metadata=api.fast_replace(

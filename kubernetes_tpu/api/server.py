@@ -508,51 +508,45 @@ class ApiServer:
                       and resource != "componentstatuses" else None)
                 seg_ver = (wv(Registry.prefix(resource)) if wv is not None
                            else None)
+                # two cache tiers: per-object fragments (serde.wire_json
+                # — a 5k-node LIST was ~1.9s of reflective encode before
+                # them) and the WHOLE response body keyed by (list args)
+                # and validated by segment write version: repeated LISTs
+                # between writes reduce to a socket write WITHOUT
+                # touching the store — checked BEFORE registry.list, so
+                # a hit skips the per-object selector scan entirely (5k
+                # kubelets polling nodeName-filtered pod LISTs would
+                # otherwise pay an O(pods) filter pass per poll only to
+                # throw the result away). A hit must also still be
+                # WATCHABLE: the cached bytes embed the resourceVersion
+                # the list was built at, and a write-quiet resource's
+                # segment version never moves while busier segments
+                # roll the shared watch window forward — serving an
+                # aged-out rev forever would livelock that resource's
+                # list->watch->410 recovery loop. TTL'd resources
+                # (events) expire passively — no write bumps the
+                # version, so their bytes never cache (wv None above).
+                ck = (resource, namespace,
+                      query.get("labelSelector", ""),
+                      query.get("fieldSelector", ""))
+                cached = (self._list_bytes_cache.get(ck)
+                          if seg_ver is not None else None)
+                if cached is not None and cached[0] == seg_ver:
+                    floor_fn = getattr(self.registry.store, "watch_floor",
+                                       None)
+                    if floor_fn is None or cached[1] >= floor_fn():
+                        return self._send_raw(h, 200, cached[2],
+                                              "application/json")
                 items, rev = self.registry.list(
                     resource, namespace,
                     query.get("labelSelector", ""),
                     query.get("fieldSelector", ""))
-                # two cache tiers: per-object fragments (serde.wire_json
-                # — a 5k-node LIST was ~1.9s of reflective encode before
-                # them) and, below, the WHOLE response body keyed by
-                # (list args, revision): repeated LISTs between writes
-                # reduce to a socket write. On a contended 1-core box
-                # the assembly pass alone (fragment joins, ~10-25ms at
-                # 5k nodes) multiplied by GIL queuing into
-                # p99-gate-breaking seconds (DENSITY.json 5000x30).
-                # TTL'd resources (events) expire passively — no write
-                # bumps the segment version, so their bytes never cache
-                # (wv None above)
-                ck = (resource, namespace,
-                      query.get("labelSelector", ""),
-                      query.get("fieldSelector", ""))
-                cached = self._list_bytes_cache.get(ck)
-                # a hit must also still be WATCHABLE: the cached bytes
-                # embed the resourceVersion the list was built at, and a
-                # write-quiet resource's segment version never moves
-                # while busier segments roll the shared watch window
-                # forward — serving an aged-out rev forever would
-                # livelock that resource's list->watch->410 recovery
-                # loop (clients re-list, get the same stale rev, 410
-                # again). Rebuilding re-embeds the current rev. The
-                # floor read (a store-lock acquisition) only runs to
-                # validate an actual hit.
-                hit = (seg_ver is not None and cached is not None
-                       and cached[0] == seg_ver)
-                if hit:
-                    floor_fn = getattr(self.registry.store, "watch_floor",
-                                       None)
-                    hit = (floor_fn is None
-                           or cached[1] >= floor_fn())
-                if hit:
-                    body = cached[2]
-                else:
-                    body = self.scheme.encode_list_bytes(info.kind, items,
-                                                         str(rev))
-                    if seg_ver is not None:
-                        if len(self._list_bytes_cache) >= 32:
-                            self._list_bytes_cache.clear()
-                        self._list_bytes_cache[ck] = (seg_ver, rev, body)
+                body = self.scheme.encode_list_bytes(info.kind, items,
+                                                     str(rev))
+                if seg_ver is not None:
+                    if len(self._list_bytes_cache) >= 32:
+                        self._list_bytes_cache.clear()
+                    self._list_bytes_cache[ck] = (seg_ver, rev, body)
                 return self._send_raw(h, 200, body, "application/json")
             obj = self.registry.get(resource, name, namespace)
             return self._send_json(h, 200, self.scheme.encode_dict(obj))
@@ -1120,6 +1114,19 @@ class ApiServer:
             return self._serve_watch_websocket(h, watcher)
         self._stream_watch_events(h, watcher, self.scheme.encode_dict)
 
+    @staticmethod
+    def _encode_watch_object(encode, ev):
+        """ERROR events carry an ApiError, not a registered API type —
+        they serialize as their Status dict (the reference's watch wire
+        sends a Status object; api/client._HttpWatcher decodes exactly
+        that via from_status). Letting encode() raise here would write
+        a second HTTP response into the half-open chunked body and
+        desync the connection."""
+        from ..core.errors import ApiError
+        if isinstance(ev.object, ApiError):
+            return ev.object.status()
+        return encode(ev.object)
+
     def _stream_watch_events(self, h, watcher, encode) -> None:
         """Chunked JSON event stream shared by the typed watch and the
         third-party watch (encode: object -> wire dict)."""
@@ -1143,7 +1150,7 @@ class ApiServer:
                     continue
                 line = json.dumps({
                     "type": ev.type,
-                    "object": encode(ev.object),
+                    "object": self._encode_watch_object(encode, ev),
                 }).encode() + b"\n"
                 write_chunk(line)
             h.wfile.write(b"0\r\n\r\n")
@@ -1204,7 +1211,7 @@ class ApiServer:
                     continue
                 line = json.dumps({
                     "type": ev.type,
-                    "object": encode(ev.object),
+                    "object": self._encode_watch_object(encode, ev),
                 }).encode()
                 wsstream.write_frame(write, line, wsstream.TEXT)
             wsstream.write_frame(write, b"", wsstream.CLOSE)

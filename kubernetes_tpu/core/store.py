@@ -416,12 +416,16 @@ class Store:
             return obj
 
     def guaranteed_update(self, key: str, fn: Callable[[Any], Any],
-                          retries: int = 10) -> Any:
+                          retries: int = 10,
+                          ttl: Optional[float] = None) -> Any:
         """Read-modify-write loop with CAS semantics
         (ref: etcd_helper.go:449). `fn` receives the current object and
         returns the new one (never mutate the input). In-process the lock
         makes one pass sufficient, but the retry structure is kept so `fn`
-        may be called outside the lock in future remote-store backends."""
+        may be called outside the lock in future remote-store backends.
+        ttl, when given, REFRESHES the entry's expiry (the rv-less PUT
+        path for TTL'd resources extends the deadline on every write,
+        matching the old get+set behavior)."""
         for _ in range(retries):
             with self._lock:
                 self._gc_expired()
@@ -434,6 +438,10 @@ class Store:
                     continue  # concurrent write between read and write
                 rev = self._bump()
                 new_obj = _with_rv(new_obj, rev)
+                if ttl is not None:
+                    expiry = time.time() + ttl
+                    heapq.heappush(self._expiry_heap, (expiry, key))
+                    self._ttl_segs.add(self._seg(key))
                 self._data[key] = (new_obj, rev, expiry)
                 self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
                 return new_obj

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional
 
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
-from ..core.errors import ApiError, NotFound
+from ..core.errors import ApiError, Conflict, NotFound
 from ..core.quantity import parse_quantity
 
 
@@ -206,8 +206,21 @@ class HollowFleet:
 
     def _status_one(self, pod: api.Pod, updated: api.Pod) -> None:
         try:
-            self.client.update_status(
-                "pods", updated, pod.metadata.namespace)
+            try:
+                self.client.update_status(
+                    "pods", updated, pod.metadata.namespace)
+            except Conflict:
+                # stale rv (a writer landed between our bind event and
+                # this confirm): re-read and re-stamp like the real
+                # kubelet's status manager — retrying the ORIGINAL
+                # object would 409 forever (the store rev only
+                # advances)
+                fresh = self.client.get("pods", pod.metadata.name,
+                                        pod.metadata.namespace)
+                self.client.update_status(
+                    "pods", api.fast_replace(
+                        fresh, status=updated.status),
+                    pod.metadata.namespace)
         except NotFound:
             self._on_pod_delete(pod)
         except Exception:

@@ -165,11 +165,28 @@ class BatchScheduler:
         if self._commit_thread:
             self._commit_thread.join(timeout=30)
 
+    def drain_commits(self, timeout: float = 30.0) -> None:
+        """Block until every queued tile has been committed AND assumed
+        (a barrier Event rides the queue behind the pending tiles). The
+        full-encode path snapshots the modeler's merged lister — tiles
+        still queued here are bound-but-unassumed, and scheduling
+        against that snapshot would see their capacity as free."""
+        barrier = threading.Event()
+        try:
+            self._commit_q.put(barrier, timeout=timeout)
+        except queue.Full:
+            return  # committer wedged; the caller's snapshot is stale
+                    # either way and the epoch guard catches it
+        barrier.wait(timeout=timeout)
+
     def _commit_loop(self) -> None:
         while True:
             item = self._commit_q.get()
             if item is None:
                 return
+            if isinstance(item, threading.Event):
+                item.set()  # drain barrier: everything before it landed
+                continue
             try:
                 # No tile-wide modeler lock here: the merged lister
                 # dedupes scheduled-vs-assumed by key, so bind→assume
@@ -279,8 +296,12 @@ class BatchScheduler:
                 return True
 
         # full-encode path: strictly ordered after any in-flight tile
-        # (the encoder below reads the modeler's merged lister)
+        # AND every queued commit (the encoder below reads the modeler's
+        # merged lister; assume_pods runs on the committer thread, so
+        # tiles still in _commit_q are bound-but-unassumed phantom
+        # capacity until the queue drains)
         self._finalize_prev()
+        self.drain_commits()
         try:
             chunk = self._chunk_for(c, len(pods))
             # the full node cache (not just ready nodes) resolves

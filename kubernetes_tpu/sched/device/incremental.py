@@ -171,6 +171,9 @@ class IncrementalEncoder:
         self.node_labels: List[Dict[str, str]] = [
             {} for _ in range(self.n_cap)]
         self._free_slots: List[int] = []
+        self._next_slot = 0   # high-water mark: len(node_slot) stops
+                              # being the next-free index once slots
+                              # are ever reclaimed
         self.valid = np.zeros(self.n_cap, bool)
         self.cpu_cap = np.zeros(self.n_cap, np.int64)
         self.mem_cap = np.zeros(self.n_cap, np.int64)
@@ -360,7 +363,8 @@ class IncrementalEncoder:
 
     def on_node_delete(self, node: api.Node) -> None:
         with self._lock:
-            slot = self.node_slot.get(node.metadata.name)
+            name = node.metadata.name
+            slot = self.node_slot.pop(name, None)
             if slot is None:
                 return
             self.state_epoch += 1
@@ -371,6 +375,46 @@ class IncrementalEncoder:
             # cached nodes keep their labels — they arrive as updates,
             # not deletes, and still resolve domains)
             self.node_labels[slot] = {}
+            self.node_names[slot] = ""
+            # RECLAIM the slot: node-name churn (autoscalers, recycled
+            # hollow fleets) must not grow the device node axis — and
+            # every scan's [n_cap] width — without bound. The dead
+            # node's pods detach to the off-table bucket (their later
+            # deletes resolve slot None and skip slot arrays) and the
+            # slot's accumulated state zeroes so a future occupant
+            # starts clean; the epoch bump above invalidates any
+            # in-flight carry chained on the old layout.
+            for key in self.node_pods.pop(slot, []):
+                rec = self.pods.get(key)
+                if rec is None:
+                    continue
+                rec.slot = None
+                self.unknown_node_pods.setdefault(rec.node,
+                                                  set()).add(key)
+            for g in self.groups.values():
+                moved = int(g.row[slot])
+                if moved:
+                    g.offgrid[name] = g.offgrid.get(name, 0) + moved
+                    g.row[slot] = 0
+            self.pod_count[slot] = 0
+            self.cpu_used[slot] = 0
+            self.mem_used[slot] = 0
+            self.nz_cpu[slot] = 0
+            self.nz_mem[slot] = 0
+            self.port_bits[slot] = 0
+            self.disk_any[slot] = 0
+            self.disk_rw[slot] = 0
+            self.cpu_cap[slot] = 0
+            self.mem_cap[slot] = 0
+            self.pod_cap[slot] = 0
+            # misfit flags too: a reused slot must not inherit the dead
+            # node's phantom-oversubscribed state (the fit gate requires
+            # not_exceeded — an empty successor would be unschedulable
+            # forever)
+            self.exceed_cpu[slot] = False
+            self.exceed_mem[slot] = False
+            self._free_slots.append(slot)
+            self._tie_dirty = True
 
     # ================================================== pod bookkeeping
 
@@ -655,9 +699,10 @@ class IncrementalEncoder:
         if self._free_slots:
             slot = self._free_slots.pop()
         else:
-            if len(self.node_slot) >= self.n_cap:
+            if self._next_slot >= self.n_cap:
                 self._grow_nodes()
-            slot = len(self.node_slot)
+            slot = self._next_slot
+            self._next_slot += 1
         self.node_slot[name] = slot
         self.node_names[slot] = name
         self._tie_dirty = True
@@ -1092,4 +1137,22 @@ class IncrementalEncoder:
             self.on_node_add(node)
         for pod in factory.scheduled_cache.list():
             self.on_pod_add(pod)
+        # reconcile the snapshot against the NOW-live cache: a pod
+        # whose DELETED event raced between the list() above and its
+        # bootstrap on_pod_add re-entered the ledger with no future
+        # event to remove it (the rv-idempotency check dedupes
+        # add/update overlap; it cannot undo an add that post-dates
+        # the delete) — phantom capacity for the process lifetime
+        with self._lock:
+            # the live set is read under the SAME lock the chained
+            # handlers serialize on: computed outside it, a pod whose
+            # ADDED event landed between the list() and the lock would
+            # be misread as stale and evicted
+            live = {f"{p.metadata.namespace}/{p.metadata.name}"
+                    for p in factory.scheduled_cache.list()}
+            stale = [k for k in self.pods if k not in live]
+        for key in stale:
+            ns, _, name = key.partition("/")
+            self.on_pod_delete(api.Pod(metadata=api.ObjectMeta(
+                name=name, namespace=ns)))
         return self

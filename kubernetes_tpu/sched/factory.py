@@ -15,6 +15,7 @@ Reference: plugin/pkg/scheduler/factory/factory.go:47-452 —
 
 from __future__ import annotations
 
+import time
 import threading
 from typing import Callable, List, Optional
 
@@ -36,7 +37,12 @@ DEFAULT_BIND_PODS_BURST = 100  # ref: server.go:70
 
 
 def node_condition_predicate(node: api.Node) -> bool:
-    """(ref: factory.go:241 getNodeConditionPredicate)"""
+    """(ref: factory.go:241 getNodeConditionPredicate; the
+    spec.unschedulable check stands in for createNodeLW's server-side
+    field selector — the informer is deliberately UNfiltered here, see
+    ConfigFactory)"""
+    if node.spec.unschedulable:
+        return False
     for cond in node.status.conditions:
         if cond.type == api.NODE_READY and cond.status != api.CONDITION_TRUE:
             return False
@@ -179,9 +185,18 @@ class ConfigFactory:
             on_add=self._scheduled_added, on_delete=self._forget)
         self.scheduled_pod_lister = StoreToPodLister(self.scheduled_cache)
 
-        # nodes (ref: createNodeLW :281 — spec.unschedulable=false)
-        self.node_informer = Informer(client, "nodes",
-                                      field_selector="spec.unschedulable=false")
+        # nodes: UNfiltered, unlike createNodeLW's
+        # spec.unschedulable=false selector (:281) — the reference pairs
+        # that filtered watch with a NodeInfo that hits the live nodes
+        # API (factory.go CreateFromKeys: f.Client.Nodes()), so
+        # ServiceAffinity/anti-affinity still resolve CORDONED nodes'
+        # labels. One unfiltered cache lands the same observable
+        # semantics: candidate lists apply node_condition_predicate
+        # (which now covers unschedulable), while get() — the NodeInfo
+        # role — resolves any cached node, so pods on cordoned nodes
+        # keep occupying their topology domains instead of silently
+        # vanishing from affinity math
+        self.node_informer = Informer(client, "nodes")
         self.node_lister = ReadyNodeLister(self.node_informer.cache)
 
         # services + RCs (ref: createServiceLW/createControllerLW :288-295)
@@ -195,6 +210,11 @@ class ConfigFactory:
                                      self.scheduled_pod_lister)
         self.pod_lister = self.modeler  # the merged view the algorithm sees
         self.backoff = Backoff(1.0, 60.0)  # ref: factory.go podBackoff
+        # shared delayed-requeue machinery (see _requeue_worker)
+        self._requeue_heap: list = []
+        self._requeue_cond = threading.Condition()
+        self._requeue_thread: Optional[threading.Thread] = None
+        self._requeue_seq = 0
         self.rate_limiter = TokenBucketRateLimiter(bind_qps, bind_burst) \
             if rate_limit else None
         self._started = False
@@ -353,25 +373,51 @@ class ConfigFactory:
         return self._create({}, [], [], algorithm=algorithm,
                             on_assume=algorithm.assume)
 
+    def _requeue_worker(self) -> None:
+        """ONE thread drains the time-ordered requeue heap — a
+        goroutine-per-pod translation of makeDefaultErrorFunc would
+        spawn an OS thread per failed pod and, on a cluster-full 30k-pod
+        tile, exhaust the process thread limit (after which the silent
+        Thread.start() failures strand pods Pending forever)."""
+        import heapq
+        while True:
+            with self._requeue_cond:
+                while not self._requeue_heap:
+                    self._requeue_cond.wait()
+                due, _seq, pod = self._requeue_heap[0]
+                delay = due - time.monotonic()
+                if delay > 0:
+                    self._requeue_cond.wait(delay)
+                    continue
+                heapq.heappop(self._requeue_heap)
+            self.backoff.gc()
+            try:
+                fresh = self.client.get("pods", pod.metadata.name,
+                                        pod.metadata.namespace)
+            except Exception:
+                continue
+            if not fresh.spec.node_name:
+                self.pod_queue.add(fresh)
+
     def make_default_error_func(self) -> Callable:
         """(ref: factory.go:297 makeDefaultErrorFunc — backoff + requeue)"""
+        import heapq
+
         def error_func(pod: api.Pod, err: Exception) -> None:
             # ref requeues with backoff for ALL errors — including
             # ErrNoNodesAvailable, which it only logs differently; the pod
             # was consumed from the FIFO, so skipping the requeue would
             # strand it Pending forever
             key = meta_namespace_key(pod)
-
-            def requeue():
-                self.backoff.wait(key)
-                self.backoff.gc()
-                try:
-                    fresh = self.client.get("pods", pod.metadata.name,
-                                            pod.metadata.namespace)
-                except Exception:
-                    return
-                if not fresh.spec.node_name:
-                    self.pod_queue.add(fresh)
-
-            threading.Thread(target=requeue, daemon=True).start()
+            due = time.monotonic() + self.backoff.get(key)
+            with self._requeue_cond:
+                if self._requeue_thread is None:
+                    self._requeue_thread = threading.Thread(
+                        target=self._requeue_worker, daemon=True,
+                        name="sched-requeue")
+                    self._requeue_thread.start()
+                self._requeue_seq += 1
+                heapq.heappush(self._requeue_heap,
+                               (due, self._requeue_seq, pod))
+                self._requeue_cond.notify()
         return error_func

@@ -239,3 +239,49 @@ def test_engine_affinity_colocates_batch():
     zones = {"node-a1": "a", "node-a2": "a", "node-b1": "b"}
     assert None not in got
     assert len({zones[g] for g in got}) == 1
+
+
+def test_cordoned_node_still_resolves_topology_domain():
+    """A cordoned node (spec.unschedulable=true) leaves the CANDIDATE
+    list but must keep resolving its labels for affinity domains — the
+    reference pairs its filtered node watch with a NodeInfo that hits
+    the live nodes API, so peer pods on cordoned nodes keep occupying
+    their domains (factory.go CreateFromKeys NodeInfo)."""
+    import time as _time
+
+    from kubernetes_tpu.api.client import InProcClient
+    from kubernetes_tpu.api.registry import Registry
+    from kubernetes_tpu.core.quantity import parse_quantity
+    from kubernetes_tpu.sched.factory import ConfigFactory
+
+    def wait_until(cond, timeout=10.0):
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if cond():
+                return True
+            _time.sleep(0.05)
+        return cond()
+
+    registry = Registry()
+    client = InProcClient(registry)
+    for name, zone, unsched in (("n-a1", "a", True), ("n-a2", "a", False),
+                                ("n-b1", "b", False)):
+        registry.create("nodes", api.Node(
+            metadata=api.ObjectMeta(name=name, labels={"zone": zone}),
+            spec=api.NodeSpec(unschedulable=unsched),
+            status=api.NodeStatus(
+                capacity={"cpu": parse_quantity("4"),
+                          "memory": parse_quantity("8Gi"),
+                          "pods": parse_quantity("40")},
+                conditions=[api.NodeCondition(type="Ready",
+                                              status="True")])))
+    f = ConfigFactory(client, rate_limit=False).start()
+    try:
+        assert wait_until(lambda: len(f.node_informer.cache.list()) == 3)
+        # candidates exclude the cordoned node; NodeInfo still sees it
+        assert sorted(n.metadata.name for n in f.node_lister.list()) == \
+            ["n-a2", "n-b1"]
+        assert f.node_lister.get("n-a1") is not None
+        assert f.node_lister.get("n-a1").metadata.labels["zone"] == "a"
+    finally:
+        f.stop()

@@ -413,3 +413,43 @@ class TestIncrementalNarrowing:
         eng = BatchEngine()
         got, _ = eng.run(enc2)
         assert enc2.node_names[int(got[0])].startswith("n")
+
+
+def test_node_slot_reclaim_under_name_churn():
+    """Node-name churn must not grow the device node axis without bound:
+    deleted nodes free their slot, a reused slot starts CLEAN (the dead
+    node's accumulated pod state zeroes; its pods detach to the
+    off-table bucket), and parity with the full encoder holds after
+    the churn."""
+    inc = IncrementalEncoder(node_capacity=8)
+    gen0 = [mk_node(f"old-{i}", cpu=2000) for i in range(4)]
+    pods0 = [mk_pod(f"e-{j}", node=f"old-{j % 4}", cpu=500, rv=str(j))
+             for j in range(8)]
+    feed(inc, gen0, pods0)
+    cap_before = inc.n_cap
+    slots_before = dict(inc.node_slot)
+
+    # recycle the fleet under fresh names, several generations deep
+    for gen in range(1, 4):
+        for i in range(4):
+            inc.on_node_delete(mk_node(f"{'old' if gen == 1 else 'g%d' % (gen-1)}-{i}"))
+        for i in range(4):
+            inc.on_node_add(mk_node(f"g{gen}-{i}", cpu=2000))
+    assert inc.n_cap == cap_before, "node axis grew under pure churn"
+    assert len(inc.node_slot) == 4
+    # reused slots carry no ghost state from their previous occupants
+    for name, slot in inc.node_slot.items():
+        assert inc.pod_count[slot] == 0, name
+        assert inc.cpu_used[slot] == 0, name
+    # the old pods detached to off-table bookkeeping; deleting them now
+    # must not touch the new occupants
+    for j in range(8):
+        inc.on_pod_delete(mk_pod(f"e-{j}", node=f"old-{j % 4}",
+                                 cpu=500, rv=str(j)))
+    # end-to-end parity after churn: schedule fresh pods on the new fleet
+    nodes = [mk_node(f"g3-{i}", cpu=2000) for i in range(4)]
+    pending = [mk_pod(f"p-{k}", cpu=400) for k in range(6)]
+    hosts_inc, hosts_full = schedule_both(inc, nodes, [], [], pending)
+    assert hosts_inc == hosts_full
+    assert all(h is not None for h in hosts_inc)
+    del slots_before

@@ -351,6 +351,10 @@ class SecurityContextDeny(Interface):
         pod: api.Pod = attributes.object
         if pod.spec.host_network:
             raise Forbidden("pod.spec.hostNetwork is forbidden")
+        if pod.spec.host_pid:
+            raise Forbidden("pod.spec.hostPID is forbidden")
+        if pod.spec.host_ipc:
+            raise Forbidden("pod.spec.hostIPC is forbidden")
         from ..kubelet.securitycontext import effective_privileged
         for c in pod.spec.containers:
             sc = getattr(c, "security_context", None)
@@ -402,11 +406,15 @@ class DenyExecOnPrivileged(Interface):
             return  # missing pod fails later with a clean 404
         # any other lookup failure propagates: a security admission
         # plugin must fail CLOSED, not open
-        if pod.spec.host_network or any(
-                getattr(c, "privileged", False)
-                for c in pod.spec.containers):
+        from ..kubelet.securitycontext import effective_privileged
+        if (pod.spec.host_network or pod.spec.host_pid or pod.spec.host_ipc
+                or any(effective_privileged(c)
+                       for c in pod.spec.containers)):
+            # ref: plugin/pkg/admission/exec/admission.go:93-97 — the
+            # deny-escalating-exec plugin blocks hostPID and hostIPC
+            # pods alongside privileged and host-network ones
             raise Forbidden(
-                f"cannot exec into privileged/host-network pod "
+                f"cannot exec into privileged/host-namespace pod "
                 f"{attributes.name!r}")
 
 

@@ -173,8 +173,14 @@ class Reflector:
         self._field_match = None
         if field_selector:
             from ..core import fields as fieldspkg
-            from .registry import Registry, field_matcher
-            self._parsed_fields = fieldspkg.parse(field_selector)
+            from .registry import (Registry, convert_field_selector,
+                                   field_matcher)
+            # same field-label conversion the server applies (legacy
+            # aliases like spec.host rewrite; without it the client-side
+            # re-check below would filter on the unconverted key and
+            # drop every event the server-side selector admits)
+            self._parsed_fields = convert_field_selector(
+                resource, fieldspkg.parse(field_selector))
             info = Registry.info(resource)
             self._fields_fn = info.fields_fn
             # the shared matcher: compiled attribute reads for the

@@ -142,6 +142,7 @@ RESOURCES: Dict[str, ResourceInfo] = {}
 _FIELD_GETTER_MAPS = {
     api.pod_resource_fields: api.POD_FIELD_GETTERS,
     api.node_resource_fields: api.NODE_FIELD_GETTERS,
+    api.event_resource_fields: api.EVENT_FIELD_GETTERS,
     api.generic_resource_fields: api.GENERIC_FIELD_GETTERS,
 }
 
@@ -186,6 +187,64 @@ def field_matcher(info: "ResourceInfo", fsel, fields_of_factory=None):
     return lambda o: fsel.matches(fn(o))
 
 
+# Per-kind field-label conversion (ref: pkg/api/v1/conversion.go:53-178
+# AddFieldLabelConversionFunc — the apiserver rewrites legacy labels,
+# e.g. the pre-v1 `spec.host` -> `spec.nodeName`, and rejects labels
+# the kind does not support with "field label not supported" before
+# the selector reaches storage). Each entry is (aliases, supported);
+# kinds without an entry keep the permissive pass-through the generic
+# metadata fields provide. The supported sets mirror the reference's
+# switch arms exactly — including labels the conversion accepts but
+# the selectable-fields set never populates (a pod selector on
+# `metadata.labels` converts fine and then matches nothing, both
+# there and here).
+_FIELD_LABEL_CONVERSIONS: Dict[str, Tuple[Dict[str, str], frozenset]] = {
+    "pods": ({"spec.host": "spec.nodeName"},
+             frozenset({"metadata.name", "metadata.namespace",
+                        "metadata.labels", "metadata.annotations",
+                        "status.phase", "status.podIP", "spec.nodeName"})),
+    "nodes": ({}, frozenset({"metadata.name", "spec.unschedulable"})),
+    "replicationcontrollers": ({}, frozenset({"metadata.name",
+                                              "status.replicas"})),
+    # events: the reference's switch arm plus the ObjectMeta pair its
+    # selectable set (event/strategy.go getAttrs ObjectMetaFieldsSet)
+    # exposes — rejecting metadata.name would dead-end a selector the
+    # storage layer can serve
+    "events": ({}, frozenset({
+        "metadata.name", "metadata.namespace",
+        "involvedObject.kind", "involvedObject.namespace",
+        "involvedObject.name", "involvedObject.uid",
+        "involvedObject.apiVersion", "involvedObject.resourceVersion",
+        "involvedObject.fieldPath", "reason", "source", "type"})),
+    "namespaces": ({}, frozenset({"status.phase"})),
+    "secrets": ({}, frozenset({"type"})),
+    "serviceaccounts": ({}, frozenset({"metadata.name"})),
+    "endpoints": ({}, frozenset({"metadata.name"})),
+}
+
+
+def convert_field_selector(resource: str,
+                           fsel: fieldspkg.FieldSelector
+                           ) -> fieldspkg.FieldSelector:
+    """Apply the kind's field-label conversion to a parsed selector:
+    legacy labels rewrite, unsupported labels raise BadRequest (the
+    reference's conversion error surfaces as a 400 from the selector
+    query parsing, pkg/apiserver/resthandler.go)."""
+    conv = _FIELD_LABEL_CONVERSIONS.get(resource)
+    if conv is None:
+        return fsel
+    aliases, supported = conv
+    terms = []
+    changed = False
+    for k, v, neg in fsel.terms:
+        nk = aliases.get(k, k)
+        if nk not in supported:
+            raise BadRequest(f"field label not supported: {k}")
+        changed = changed or nk != k
+        terms.append((nk, v, neg))
+    return fieldspkg.FieldSelector(tuple(terms)) if changed else fsel
+
+
 def _register(info: ResourceInfo) -> None:
     RESOURCES[info.name] = info
 
@@ -200,6 +259,7 @@ _register(ResourceInfo("endpoints", "Endpoints", api.Endpoints, True,
 _register(ResourceInfo("replicationcontrollers", "ReplicationController",
                        api.ReplicationController, True))
 _register(ResourceInfo("events", "Event", api.Event, True,
+                       api.event_resource_fields,
                        ttl=DEFAULT_EVENT_TTL, has_status=False))
 _register(ResourceInfo("namespaces", "Namespace", api.Namespace, False))
 _register(ResourceInfo("secrets", "Secret", api.Secret, True, has_status=False))
@@ -662,6 +722,8 @@ class Registry:
             namespace = ""  # cluster-scoped: a defaulted ns must not filter
         lsel = labelspkg.parse(label_selector) if label_selector else None
         fsel = fieldspkg.parse(field_selector) if field_selector else None
+        if fsel is not None:
+            fsel = convert_field_selector(resource, fsel)
 
         fmatch = field_matcher(info, fsel) if fsel is not None else None
 
@@ -916,6 +978,8 @@ class Registry:
             info = self.info(resource)
             lsel = labelspkg.parse(label_selector) if label_selector else None
             fsel = fieldspkg.parse(field_selector) if field_selector else None
+            if fsel is not None:
+                fsel = convert_field_selector(resource, fsel)
             # The store fans one event out to every filtered watcher
             # while holding its write lock; without sharing, N watchers
             # rebuild the same field map N times per event (2N for

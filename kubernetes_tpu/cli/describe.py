@@ -159,10 +159,15 @@ def _append_events(out: List[str], events) -> None:
 
 
 def _events_for(client, namespace: str, kind: str, name: str):
-    return [e for e in client.list("events", namespace)[0]
-            if e.involved_object.name == name
-            and (not e.involved_object.kind
-                 or e.involved_object.kind == kind)]
+    """Related events via a server-side involvedObject field selector
+    (ref: pkg/client/unversioned/events.go GetFieldSelector/Search —
+    kubectl describe filters events on the server, not by walking the
+    whole namespace client-side). Events recorded without a kind on
+    their reference still surface, as before."""
+    evs = client.list("events", namespace,
+                      field_selector=f"involvedObject.name={name}")[0]
+    return [e for e in evs
+            if not e.involved_object.kind or e.involved_object.kind == kind]
 
 
 def describe(client, scheme, resource: str, name: str, namespace: str) -> str:

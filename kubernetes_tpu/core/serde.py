@@ -20,13 +20,26 @@ T = TypeVar("T")
 
 _hints_cache: Dict[type, Dict[str, Any]] = {}
 
+# Deprecated wire-key aliases, per dataclass: alias wire key -> python
+# field name. The one the reference carries in v1 is
+# `serviceAccount` <-> `serviceAccountName` (pkg/api/v1/types.go
+# PodSpec.DeprecatedServiceAccount). On decode the alias fills the
+# field only when the canonical key is absent or empty
+# (pkg/api/v1/defaults.go copies DeprecatedServiceAccount into
+# ServiceAccountName when the latter is unset); on encode the alias is
+# emitted alongside the canonical key whenever the value is non-empty
+# (conversion.go convert_api_PodSpec_To_v1_PodSpec mirrors the value
+# into both). Populated by core.types at import.
+WIRE_ALIASES: Dict[type, Dict[str, str]] = {}
+
 
 def _camel(name: str) -> str:
     parts = name.split("_")
     out = parts[0] + "".join(p[:1].upper() + p[1:] for p in parts[1:])
     # Wire names like hostIP / podIP / clusterIP / externalID / podCIDR.
     for suf, rep in (("Ip", "IP"), ("Id", "ID"), ("Cidr", "CIDR"), ("Uid", "UID"),
-                     ("Url", "URL"), ("Tcp", "TCP"), ("Udp", "UDP")):
+                     ("Url", "URL"), ("Tcp", "TCP"), ("Udp", "UDP"),
+                     ("Pid", "PID"), ("Ipc", "IPC")):
         if out.endswith(suf):
             out = out[: -len(suf)] + rep
     return out
@@ -79,6 +92,12 @@ def to_wire(obj: Any) -> Any:
             if w is None or w == {} or w == []:
                 continue
             out[_camel(f.name)] = w
+        aliases = WIRE_ALIASES.get(type(obj))
+        if aliases:
+            for alias, fname in aliases.items():
+                v = getattr(obj, fname)
+                if v:
+                    out[alias] = to_wire(v)
         return out
     if isinstance(obj, dict):
         return {k: to_wire(v) for k, v in obj.items()}
@@ -121,6 +140,11 @@ def _from_wire(tp: Any, data: Any) -> Any:
             if fname is None:
                 continue
             kwargs[fname] = _from_wire(hints[fname], wv)
+        aliases = WIRE_ALIASES.get(tp)
+        if aliases and isinstance(data, dict):
+            for alias, fname in aliases.items():
+                if alias in data and not kwargs.get(fname):
+                    kwargs[fname] = _from_wire(hints[fname], data[alias])
         return tp(**kwargs)
     if tp is float and isinstance(data, int):
         return float(data)

@@ -489,6 +489,13 @@ class PodSpec:
     service_account_name: str = ""
     node_name: str = ""
     host_network: bool = False
+    # host PID/IPC namespace sharing (ref: pkg/api/types.go
+    # PodSecurityContext.HostPID/HostIPC, surfaced at the top level of
+    # the v1 wire form by pkg/api/v1/conversion.go
+    # convert_api_PodSpec_To_v1_PodSpec for v1.0.0 compatibility; the
+    # runtime maps them to pid/ipc modes, dockertools/manager.go:1994)
+    host_pid: bool = False
+    host_ipc: bool = False
     # ref: pkg/api/types.go PodSpec.ImagePullSecrets — resolved by the
     # kubelet into a docker keyring (kubelet/credentialprovider.py)
     image_pull_secrets: List[LocalObjectReference] = field(
@@ -1068,6 +1075,15 @@ class PersistentVolumeClaim:
 
 # ---------------------------------------------------------------- helpers
 
+# Deprecated v1 wire alias: `serviceAccount` mirrors
+# `serviceAccountName` on encode and fills it on decode when the
+# canonical key is empty (pkg/api/v1/types.go
+# PodSpec.DeprecatedServiceAccount, defaults.go, conversion.go).
+from . import serde as _serde  # noqa: E402  (needs PodSpec defined)
+
+_serde.WIRE_ALIASES[PodSpec] = {"serviceAccount": "service_account_name"}
+
+
 def pod_resource_fields(pod: Pod) -> Dict[str, str]:
     """Flat field map for field selectors (ref: pkg/registry/pod PodToSelectableFields)."""
     return {
@@ -1082,6 +1098,29 @@ def node_resource_fields(node: Node) -> Dict[str, str]:
     return {
         "metadata.name": node.metadata.name,
         "spec.unschedulable": "true" if node.spec.unschedulable else "false",
+    }
+
+
+def event_resource_fields(ev: Event) -> Dict[str, str]:
+    """Selectable fields for events (ref: pkg/registry/event/strategy.go
+    getAttrs:88-99 — involvedObject.* plus reason/source/type, merged
+    with the ObjectMeta set). kubectl describe's related-events lookup
+    and the reference client's Events.Search filter on these
+    server-side (pkg/client/unversioned/events.go GetFieldSelector)."""
+    o = ev.involved_object
+    return {
+        "metadata.name": ev.metadata.name,
+        "metadata.namespace": ev.metadata.namespace,
+        "involvedObject.kind": o.kind,
+        "involvedObject.namespace": o.namespace,
+        "involvedObject.name": o.name,
+        "involvedObject.uid": o.uid,
+        "involvedObject.apiVersion": o.api_version,
+        "involvedObject.resourceVersion": o.resource_version,
+        "involvedObject.fieldPath": o.field_path,
+        "reason": ev.reason,
+        "source": ev.source.component,
+        "type": ev.type,
     }
 
 
@@ -1102,6 +1141,22 @@ POD_FIELD_GETTERS: Dict[str, Any] = {
     "metadata.namespace": lambda o: o.metadata.namespace,
     "spec.nodeName": lambda o: o.spec.node_name,
     "status.phase": lambda o: o.status.phase,
+}
+
+EVENT_FIELD_GETTERS: Dict[str, Any] = {
+    "metadata.name": lambda o: o.metadata.name,
+    "metadata.namespace": lambda o: o.metadata.namespace,
+    "involvedObject.kind": lambda o: o.involved_object.kind,
+    "involvedObject.namespace": lambda o: o.involved_object.namespace,
+    "involvedObject.name": lambda o: o.involved_object.name,
+    "involvedObject.uid": lambda o: o.involved_object.uid,
+    "involvedObject.apiVersion": lambda o: o.involved_object.api_version,
+    "involvedObject.resourceVersion":
+        lambda o: o.involved_object.resource_version,
+    "involvedObject.fieldPath": lambda o: o.involved_object.field_path,
+    "reason": lambda o: o.reason,
+    "source": lambda o: o.source.component,
+    "type": lambda o: o.type,
 }
 
 NODE_FIELD_GETTERS: Dict[str, Any] = {

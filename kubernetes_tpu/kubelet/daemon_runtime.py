@@ -188,6 +188,15 @@ class DaemonRuntime(Runtime):
                                       apply_to_host_config)
         apply_to_container_config(container, body)
         apply_to_host_config(container, body["HostConfig"])
+        # pod-level namespace sharing -> engine modes (ref:
+        # dockertools/manager.go getPidMode/getIpcMode:1994-2008 and
+        # the hostNetwork NetworkMode=host wiring in runContainer)
+        if pod.spec.host_network:
+            body["HostConfig"]["NetworkMode"] = "host"
+        if pod.spec.host_pid:
+            body["HostConfig"]["PidMode"] = "host"
+        if pod.spec.host_ipc:
+            body["HostConfig"]["IpcMode"] = "host"
         created = self._do(
             "POST", f"/containers/create?name={urllib.parse.quote(cname)}",
             body=body)

@@ -76,6 +76,88 @@ def test_registry_field_and_label_selectors():
     assert [p.metadata.name for p in web] == ["a"]
 
 
+def test_field_label_conversion_alias_and_rejection():
+    """Per-kind field-label conversion (ref: pkg/api/v1/conversion.go
+    AddFieldLabelConversionFunc): the pre-v1 `spec.host` label rewrites
+    to `spec.nodeName`, and labels a kind does not support are rejected
+    with a 400 instead of silently matching nothing."""
+    from kubernetes_tpu.core.errors import BadRequest
+    r = Registry()
+    r.create("pods", mk_pod("a"))
+    r.create("pods", mk_pod("b", node="n1"))
+    on_n1, _ = r.list("pods", field_selector="spec.host=n1")
+    assert [p.metadata.name for p in on_n1] == ["b"]
+    off_n1, _ = r.list("pods", field_selector="spec.host!=n1")
+    assert [p.metadata.name for p in off_n1] == ["a"]
+    with pytest.raises(BadRequest):
+        r.list("pods", field_selector="spec.bogus=x")
+    with pytest.raises(BadRequest):
+        r.list("nodes", field_selector="status.phase=Ready")
+    with pytest.raises(BadRequest):
+        r.watch("pods", field_selector="spec.bogus=x")
+    # the watch path applies the same alias rewrite
+    w = r.watch("pods", field_selector="spec.host=n2")
+    try:
+        r.bind(api.Binding(
+            metadata=api.ObjectMeta(name="a", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n2")))
+        ev = w.next(timeout=2.0)
+        assert ev is not None and ev.object.metadata.name == "a"
+    finally:
+        w.stop()
+    # kinds without a registered conversion stay permissive
+    r.list("services", field_selector="anything=goes")
+
+
+def test_event_field_selectors():
+    """Events select on involvedObject.* / reason / source / type
+    server-side (ref: pkg/registry/event/strategy.go getAttrs,
+    pkg/client/unversioned/events.go GetFieldSelector)."""
+    from kubernetes_tpu.core.errors import BadRequest
+    r = Registry()
+    for i, (obj, reason) in enumerate(
+            [("p1", "Started"), ("p1", "Killing"), ("p2", "Started")]):
+        r.create("events", api.Event(
+            metadata=api.ObjectMeta(name=f"e{i}", namespace="default"),
+            involved_object=api.ObjectReference(
+                kind="Pod", namespace="default", name=obj, uid=f"u-{obj}"),
+            reason=reason, type="Normal",
+            source=api.EventSource(component="kubelet")))
+    p1, _ = r.list("events", field_selector="involvedObject.name=p1")
+    assert sorted(e.metadata.name for e in p1) == ["e0", "e1"]
+    started, _ = r.list(
+        "events",
+        field_selector="involvedObject.name=p1,reason=Started")
+    assert [e.metadata.name for e in started] == ["e0"]
+    by_src, _ = r.list("events", field_selector="source=kubelet")
+    assert len(by_src) == 3
+    by_name, _ = r.list("events", field_selector="metadata.name=e2")
+    assert [e.metadata.name for e in by_name] == ["e2"]
+    with pytest.raises(BadRequest):
+        r.list("events", field_selector="message=x")
+
+
+def test_reflector_converts_legacy_field_labels():
+    """The reflector's client-side re-check must filter on the SAME
+    converted labels the server matched, or a legacy-alias selector
+    lists fine and then drops every watch event client-side."""
+    r = Registry()
+    client = InProcClient(r)
+    r.create("pods", mk_pod("a"))
+    fifo = FIFO()
+    refl = Reflector(client, "pods", field_selector="spec.host=n1",
+                     store=fifo)
+    refl.start()
+    try:
+        r.bind(api.Binding(
+            metadata=api.ObjectMeta(name="a", namespace="default"),
+            target=api.ObjectReference(kind="Node", name="n1")))
+        got = fifo.pop(timeout=5)
+        assert got is not None and got.spec.node_name == "n1"
+    finally:
+        refl.stop()
+
+
 def test_registry_binding_subresource():
     r = Registry()
     r.create("pods", mk_pod("p1"))

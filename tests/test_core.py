@@ -132,6 +132,40 @@ def test_pod_round_trip():
     assert back == pod
 
 
+def test_service_account_wire_alias():
+    """The deprecated `serviceAccount` key mirrors `serviceAccountName`
+    on encode and fills it on decode when the canonical key is empty
+    (ref: pkg/api/v1/types.go DeprecatedServiceAccount, defaults.go,
+    conversion.go convert_api_PodSpec_To_v1_PodSpec)."""
+    from kubernetes_tpu.core import serde
+    spec = api.PodSpec(service_account_name="sa-1")
+    w = serde.to_wire(spec)
+    assert w["serviceAccountName"] == "sa-1"
+    assert w["serviceAccount"] == "sa-1"
+    # legacy-only input fills the canonical field
+    back = serde.from_wire(api.PodSpec, {"serviceAccount": "legacy"})
+    assert back.service_account_name == "legacy"
+    # the canonical key wins when both are present
+    both = serde.from_wire(api.PodSpec, {"serviceAccount": "old",
+                                         "serviceAccountName": "new"})
+    assert both.service_account_name == "new"
+    # empty spec emits neither
+    assert "serviceAccount" not in serde.to_wire(api.PodSpec())
+
+
+def test_host_namespace_wire_keys():
+    """hostPID/hostIPC ride the v1 wire with their ALL-CAPS suffixes
+    (ref: pkg/api/v1/types.go `json:"hostPID"` / `json:"hostIPC"`)."""
+    from kubernetes_tpu.core import serde
+    w = serde.to_wire(api.PodSpec(host_network=True, host_pid=True,
+                                  host_ipc=True))
+    assert w.get("hostPID") is True
+    assert w.get("hostIPC") is True
+    assert w.get("hostNetwork") is True
+    back = serde.from_wire(api.PodSpec, {"hostPID": True, "hostIPC": True})
+    assert back.host_pid and back.host_ipc and not back.host_network
+
+
 def test_node_round_trip():
     node = api.Node(
         metadata=api.ObjectMeta(name="n1", labels={"zone": "us-a"}),
@@ -588,3 +622,15 @@ def test_field_getters_mirror_dict_builders():
     assert set(fields) == set(api.GENERIC_FIELD_GETTERS)
     for k, getter in api.GENERIC_FIELD_GETTERS.items():
         assert getter(svc) == fields[k], k
+
+    ev = api.Event(
+        metadata=api.ObjectMeta(name="e", namespace="ns-c"),
+        involved_object=api.ObjectReference(
+            kind="Pod", namespace="ns-c", name="p", uid="u-1",
+            api_version="v1", resource_version="42", field_path="spec"),
+        reason="Started", type="Normal",
+        source=api.EventSource(component="kubelet", host="n-1"))
+    fields = api.event_resource_fields(ev)
+    assert set(fields) == set(api.EVENT_FIELD_GETTERS)
+    for k, getter in api.EVENT_FIELD_GETTERS.items():
+        assert getter(ev) == fields[k], k

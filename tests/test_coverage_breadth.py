@@ -78,6 +78,26 @@ class TestExecAdmission:
             registry.admission("CONNECT", "pods/exec", None,
                                "default", "hostnet")
 
+    def test_host_pid_ipc_and_nested_privileged_denied(self):
+        # ref: plugin/pkg/admission/exec/admission.go:93-97 — hostPID and
+        # hostIPC pods deny exec; the privileged check must resolve the
+        # NESTED security context too (one predicate with the runtime)
+        registry = wired_registry("DenyExecOnPrivileged")
+        hostpid = mkpod("hostpid")
+        hostpid.spec.host_pid = True
+        registry.create("pods", hostpid)
+        hostipc = mkpod("hostipc")
+        hostipc.spec.host_ipc = True
+        registry.create("pods", hostipc)
+        nested = mkpod("nestedpriv")
+        nested.spec.containers[0].security_context = api.SecurityContext(
+            privileged=True)
+        registry.create("pods", nested)
+        for name in ("hostpid", "hostipc", "nestedpriv"):
+            with pytest.raises(Forbidden):
+                registry.admission("CONNECT", "pods/exec", None,
+                                   "default", name)
+
 
 class TestInitialResources:
     def test_fills_absent_requests_from_observations(self):

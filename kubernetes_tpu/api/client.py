@@ -66,6 +66,15 @@ class Client:
     def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
         raise NotImplementedError
 
+    def get_scale(self, resource: str, name: str,
+                  namespace: str = "") -> Any:
+        """GET .../{name}/scale (ref: client/unversioned Scales getter)."""
+        raise NotImplementedError
+
+    def update_scale(self, resource: str, name: str, scale: Any,
+                     namespace: str = "") -> Any:
+        raise NotImplementedError
+
     def update_status_batch(self, resource: str, objs: List[Any],
                             namespace: str = "") -> List[Any]:
         # Default: sequential (the reference wire protocol has no status
@@ -149,6 +158,12 @@ class InProcClient(Client):
 
     def update_status_batch(self, resource, objs, namespace=""):
         return self.registry.update_status_batch(resource, objs, namespace)
+
+    def get_scale(self, resource, name, namespace=""):
+        return self.registry.get_scale(resource, name, namespace)
+
+    def update_scale(self, resource, name, scale, namespace=""):
+        return self.registry.update_scale(resource, name, scale, namespace)
 
     def delete(self, resource, name, namespace=""):
         return self.registry.delete(resource, name, namespace)
@@ -410,6 +425,16 @@ class HttpClient(Client):
         ns = namespace or obj.metadata.namespace
         return self._decode(self._do(
             "PUT", self._url(resource, ns, obj.metadata.name, "status"), obj))
+
+    def get_scale(self, resource, name, namespace=""):
+        ns = namespace or "default"
+        return self._decode(self._do(
+            "GET", self._url(resource, ns, name, "scale")))
+
+    def update_scale(self, resource, name, scale, namespace=""):
+        ns = namespace or "default"
+        return self._decode(self._do(
+            "PUT", self._url(resource, ns, name, "scale"), scale))
 
     def delete(self, resource, name, namespace=""):
         ns = namespace or "default"

@@ -21,6 +21,7 @@ from dataclasses import dataclass, replace, field as dc_field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import fields as fieldspkg
+from ..core import intstr
 from ..core import labels as labelspkg
 from ..core import types as api
 from ..core.errors import (BadRequest, Conflict, Invalid,
@@ -123,6 +124,39 @@ def validate_pod(pod: api.Pod) -> None:
 
 def validate_node(node: api.Node) -> None:
     validate_object_meta(node.metadata, False)
+
+
+def validate_deployment(d: api.Deployment) -> None:
+    """ref: pkg/apis/extensions/validation/validation.go
+    ValidateRollingUpdateDeployment:258-268 — both bounds must be
+    positive ints or percent strings, and maxUnavailable cannot be 0
+    when maxSurge is 0 (the rollout could never make progress)."""
+    validate_object_meta(d.metadata, True)
+    # explicit JSON nulls decode to None (serde); the reference treats
+    # a nil strategy/rollingUpdate as defaults (extensions defaults.go)
+    spec = d.spec or api.DeploymentSpec()
+    strategy = spec.strategy or api.DeploymentStrategy()
+    if strategy.type != "RollingUpdate":
+        return
+    ru = strategy.rolling_update or api.RollingUpdateDeployment()
+    vals = {}
+    for fld, v in (("maxUnavailable", ru.max_unavailable),
+                   ("maxSurge", ru.max_surge)):
+        try:
+            vals[fld] = intstr.resolve_int_or_percent(v, 100)
+        except (ValueError, TypeError):
+            raise Invalid(
+                f"spec.strategy.rollingUpdate.{fld}: not an int or percent")
+        if vals[fld] < 0:
+            raise Invalid(
+                f"spec.strategy.rollingUpdate.{fld}: must be non-negative")
+    if isinstance(ru.max_unavailable, str) \
+            and vals["maxUnavailable"] > 100:
+        raise Invalid("spec.strategy.rollingUpdate.maxUnavailable: "
+                      "cannot be more than 100%")
+    if vals["maxUnavailable"] == 0 and vals["maxSurge"] == 0:
+        raise Invalid("spec.strategy.rollingUpdate.maxUnavailable: "
+                      "cannot be 0 when maxSurge is 0 as well")
 
 
 @dataclass
@@ -349,7 +383,8 @@ def decode_third_party(data: dict) -> api.ThirdPartyResourceData:
         data={k: v for k, v in data.items()
               if k not in ("kind", "apiVersion", "metadata")})
 _register(ResourceInfo("jobs", "Job", api.Job, True))
-_register(ResourceInfo("deployments", "Deployment", api.Deployment, True))
+_register(ResourceInfo("deployments", "Deployment", api.Deployment, True,
+                       validate=validate_deployment))
 _register(ResourceInfo("daemonsets", "DaemonSet", api.DaemonSet, True))
 _register(ResourceInfo("horizontalpodautoscalers", "HorizontalPodAutoscaler",
                        api.HorizontalPodAutoscaler, True))
@@ -802,6 +837,55 @@ class Registry:
         for port in svc_to_release:
             self.port_allocator.release(port)
         return result
+
+    # Resources serving the scale subresource and how a Scale projects
+    # onto them (ref: registry/experimental/controller/etcd/etcd.go
+    # ScaleREST for replicationcontrollers, registry/deployment/etcd
+    # for deployments).
+    SCALABLE = ("replicationcontrollers", "deployments")
+
+    @staticmethod
+    def _project_scale(obj: Any) -> api.Scale:
+        """RC/Deployment -> its Scale projection (shared by GET and the
+        post-update read-back so the two cannot drift)."""
+        return api.Scale(
+            metadata=api.ObjectMeta(
+                name=obj.metadata.name, namespace=obj.metadata.namespace,
+                resource_version=obj.metadata.resource_version,
+                creation_timestamp=obj.metadata.creation_timestamp),
+            spec=api.ScaleSpec(replicas=obj.spec.replicas),
+            status=api.ScaleStatus(replicas=obj.status.replicas,
+                                   selector=dict(obj.spec.selector)))
+
+    def get_scale(self, resource: str, name: str,
+                  namespace: str = "") -> api.Scale:
+        if resource not in self.SCALABLE:
+            raise NotFound(f"{resource} has no scale subresource")
+        return self._project_scale(self.get(resource, name, namespace))
+
+    def update_scale(self, resource: str, name: str, scale: api.Scale,
+                     namespace: str = "") -> api.Scale:
+        """PUT .../{name}/scale: move ONLY spec.replicas, optimistic on
+        the Scale's resourceVersion when it carries one (the reference's
+        ScaleREST.Update runs the generic GuaranteedUpdate)."""
+        if resource not in self.SCALABLE:
+            raise NotFound(f"{resource} has no scale subresource")
+        ns = namespace or "default"
+        key = self.key(resource, ns, name)
+        want = scale.spec.replicas
+        if want < 0:
+            raise Invalid("spec.replicas: must be non-negative")
+        expect_rv = scale.metadata.resource_version
+
+        def apply(cur: Any) -> Any:
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise Conflict(
+                    f"scale update on {key} failed: object was modified "
+                    f"(have {expect_rv}, current "
+                    f"{cur.metadata.resource_version})")
+            return replace(cur, spec=replace(cur.spec, replicas=want))
+
+        return self._project_scale(self.store.guaranteed_update(key, apply))
 
     def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
         """Status subresource: replace only .status, keep spec/meta

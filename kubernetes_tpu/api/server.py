@@ -486,6 +486,10 @@ class ApiServer:
             if resource == "pods" and sub == "exec" and \
                     self._wants_websocket(h):
                 return self._serve_exec_ws(h, namespace, name, query)
+            if sub == "scale":
+                scale = self.registry.get_scale(resource, name, namespace)
+                return self._send_json(h, 200,
+                                       self.scheme.encode_dict(scale))
             if watching and not name:
                 return self._serve_watch(h, resource, namespace, query)
             if not name:
@@ -587,6 +591,9 @@ class ApiServer:
             obj = self.scheme.decode_dict(body)
             if sub == "status":
                 updated = self.registry.update_status(resource, obj, namespace)
+            elif sub == "scale":
+                updated = self.registry.update_scale(resource, name, obj,
+                                                     namespace)
             elif sub == "finalize" and resource == "namespaces":
                 updated = self.registry.finalize_namespace(obj)
             elif sub:

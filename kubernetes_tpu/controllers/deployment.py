@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
+from ..core.intstr import resolve_int_or_percent
 from ..core.labels import selector_from_set
 from ..core.serde import to_wire
 from .framework import QueueWorkers
@@ -129,7 +130,7 @@ class DeploymentController:
             new_rc = self._create_new_rc(d)
             if new_rc is None:
                 return
-        if d.spec.strategy.type == "Recreate":
+        if (d.spec.strategy or api.DeploymentStrategy()).type == "Recreate":
             for rc in old_rcs:
                 if rc.spec.replicas != 0:
                     self._scale(rc, 0)
@@ -144,11 +145,15 @@ class DeploymentController:
                         new_rc: api.ReplicationController,
                         old_rcs: List[api.ReplicationController]) -> None:
         """(reconcileNewRC/reconcileOldRCs: surge and unavailable bounds)"""
-        ru = d.spec.strategy.rolling_update
+        strategy = d.spec.strategy or api.DeploymentStrategy()
+        ru = strategy.rolling_update or api.RollingUpdateDeployment()
+        max_surge = resolve_int_or_percent(ru.max_surge, d.spec.replicas)
+        max_unavailable = resolve_int_or_percent(ru.max_unavailable,
+                                                 d.spec.replicas)
         old_total = sum(rc.spec.replicas for rc in old_rcs)
         total = new_rc.spec.replicas + old_total
-        max_total = d.spec.replicas + ru.max_surge
-        min_available = d.spec.replicas - ru.max_unavailable
+        max_total = d.spec.replicas + max_surge
+        min_available = d.spec.replicas - max_unavailable
 
         if new_rc.spec.replicas < d.spec.replicas and total < max_total:
             grow = min(d.spec.replicas - new_rc.spec.replicas,

@@ -51,18 +51,28 @@ class HorizontalController:
                 continue
         return scaled
 
+    # scaleRef kind -> registry resource (horizontal.go scales through
+    # the extensions Scale subresource, never the full object)
+    _SCALE_KINDS = {"ReplicationController": "replicationcontrollers",
+                    "Deployment": "deployments"}
+
     def _reconcile(self, hpa: api.HorizontalPodAutoscaler) -> bool:
         ref = hpa.spec.scale_ref
         ns = ref.namespace or hpa.metadata.namespace
-        if ref.kind != "ReplicationController":
+        resource = self._SCALE_KINDS.get(ref.kind)
+        if resource is None:
             return False
-        rc = self.client.get("replicationcontrollers", ref.name, ns)
-        current = rc.spec.replicas
+        # read and write through the scale subresource, the reference's
+        # contract (horizontal.go reconcileAutoscaler: scales.Get ->
+        # compute -> scales.Update; the selector for the metrics query
+        # comes from scale.status.selector)
+        scale = self.client.get_scale(resource, ref.name, ns)
+        current = scale.spec.replicas
         target = hpa.spec.cpu_utilization_target_percentage
         utilization = None
         desired = current
         if target and current > 0:
-            utilization = self.metrics(ns, rc.spec.selector)
+            utilization = self.metrics(ns, scale.status.selector)
             if utilization is not None:
                 ratio = utilization / target
                 # inside the tolerance band nothing moves (horizontal.go)
@@ -72,11 +82,9 @@ class HorizontalController:
                       min(hpa.spec.max_replicas, desired))
         did_scale = desired != current
         if did_scale:
-            fresh = self.client.get("replicationcontrollers", ref.name, ns)
-            self.client.update(
-                "replicationcontrollers",
-                replace(fresh, spec=replace(fresh.spec, replicas=desired)),
-                ns)
+            self.client.update_scale(
+                resource, ref.name,
+                replace(scale, spec=api.ScaleSpec(replicas=desired)), ns)
         self._update_status(hpa, current, desired, utilization, did_scale)
         return did_scale
 

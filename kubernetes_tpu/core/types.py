@@ -827,9 +827,36 @@ class Job:
 
 
 @dataclass
+class ScaleSpec:
+    replicas: int = 0
+
+
+@dataclass
+class ScaleStatus:
+    replicas: int = 0
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Scale:
+    """The scale subresource (ref: pkg/apis/extensions/types.go:38-63
+    Scale/ScaleSpec/ScaleStatus) — a scaling request detached from the
+    scaled object's full schema, served at .../{name}/scale for
+    replicationcontrollers (registry/experimental/controller/etcd) and
+    deployments (registry/deployment/etcd); the HPA writes through it."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ScaleSpec = field(default_factory=ScaleSpec)
+    status: ScaleStatus = field(default_factory=ScaleStatus)
+
+
+@dataclass
 class RollingUpdateDeployment:
-    max_unavailable: int = 1
-    max_surge: int = 1
+    # IntOrString: an absolute count or a "25%"-style percentage of
+    # spec.replicas (ref: pkg/apis/extensions/types.go:267,279
+    # intstr.IntOrString; resolved by controllers/deployment.py
+    # resolve_int_or_percent with the reference's ceil rounding)
+    max_unavailable: Any = 1
+    max_surge: Any = 1
 
 
 @dataclass

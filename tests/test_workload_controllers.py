@@ -166,6 +166,73 @@ class TestDeploymentController:
         finally:
             ctrl.stop()
 
+    def test_percent_bounds_resolve_with_ceil(self, cluster):
+        """maxSurge/maxUnavailable accept IntOrString percentages
+        (ref: pkg/apis/extensions/types.go:267,279; pkg/util/util.go
+        GetValueFromPercent ceils: 25% of 3 replicas -> 1)."""
+        registry, client = cluster
+        ctrl = DeploymentController(client).run()
+        try:
+            d = api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(
+                    replicas=3, selector={"app": "web"},
+                    template=template({"app": "web"}),
+                    strategy=api.DeploymentStrategy(
+                        rolling_update=api.RollingUpdateDeployment(
+                            max_surge="25%", max_unavailable="25%"))))
+            client.create("deployments", d, "default")
+
+            def new_rc():
+                rcs, _ = client.list("replicationcontrollers", "default")
+                return rcs[0] if rcs else None
+            assert wait_until(lambda: new_rc() is not None
+                              and new_rc().spec.replicas == 3)
+        finally:
+            ctrl.stop()
+
+    def test_rolling_update_validation(self, cluster):
+        """ref: validation.go ValidateRollingUpdateDeployment — both
+        bounds zero rejected, maxUnavailable over 100% rejected,
+        non-numeric strings rejected."""
+        from kubernetes_tpu.core.errors import Invalid
+        registry, client = cluster
+
+        def mk(surge, unavail):
+            return api.Deployment(
+                metadata=api.ObjectMeta(name="d", namespace="default"),
+                spec=api.DeploymentSpec(
+                    replicas=2, selector={"app": "d"},
+                    template=template({"app": "d"}),
+                    strategy=api.DeploymentStrategy(
+                        rolling_update=api.RollingUpdateDeployment(
+                            max_surge=surge, max_unavailable=unavail))))
+        for surge, unavail in ((0, 0), ("0%", 0), (1, "150%"),
+                               ("abc", 1), (-1, 1)):
+            with pytest.raises(Invalid):
+                registry.create("deployments", mk(surge, unavail))
+        registry.create("deployments", mk("100%", "0%"))
+
+    def test_null_strategy_decodes_and_validates(self, cluster):
+        """An explicit JSON null strategy/rollingUpdate decodes to None
+        (serde); validation must treat it as defaults, not crash."""
+        from kubernetes_tpu.core.scheme import default_scheme
+        registry, client = cluster
+        wire = {"kind": "Deployment", "apiVersion": "extensions/v1beta1",
+                "metadata": {"name": "nullstrat", "namespace": "default"},
+                "spec": {"replicas": 1, "selector": {"app": "x"},
+                         "template": {
+                             "metadata": {"labels": {"app": "x"}},
+                             "spec": {"containers": [
+                                 {"name": "c", "image": "img"}]}},
+                         "strategy": {"type": "RollingUpdate",
+                                      "rollingUpdate": None}}}
+        d = default_scheme.decode_dict(wire)
+        registry.create("deployments", d)
+        wire["metadata"]["name"] = "nullstrat2"
+        wire["spec"]["strategy"] = None
+        registry.create("deployments", default_scheme.decode_dict(wire))
+
     def test_namespace_cascade_covers_extensions(self, cluster):
         registry, client = cluster
         from kubernetes_tpu.controllers import NamespaceController
@@ -282,6 +349,86 @@ class TestHorizontalController:
                             "default").status
         assert status.desired_replicas == 5
         assert status.last_scale_time
+
+
+    def test_scales_deployment_through_scale_subresource(self, cluster):
+        """ref: horizontal.go reconcileAutoscaler — the HPA reads and
+        writes the extensions Scale subresource, for Deployments too."""
+        registry, client = cluster
+        client.create("deployments", api.Deployment(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.DeploymentSpec(replicas=2, selector={"app": "web"},
+                                    template=template({"app": "web"}))),
+            "default")
+        hpa = api.HorizontalPodAutoscaler(
+            metadata=api.ObjectMeta(name="web-hpa", namespace="default"),
+            spec=api.HorizontalPodAutoscalerSpec(
+                scale_ref=api.SubresourceReference(
+                    kind="Deployment", name="web", namespace="default"),
+                min_replicas=1, max_replicas=5,
+                cpu_utilization_target_percentage=90))
+        client.create("horizontalpodautoscalers", hpa, "default")
+        ctrl = HorizontalController(client, lambda ns, sel: 180.0)
+        assert ctrl.reconcile_once() == 1
+        assert client.get("deployments", "web",
+                          "default").spec.replicas == 4
+
+
+class TestScaleSubresource:
+    def test_get_and_update_scale(self, cluster):
+        """ref: registry/experimental/controller/etcd ScaleREST — GET
+        projects the RC onto a Scale; PUT moves only spec.replicas."""
+        registry, client = cluster
+        rc = client.create(
+            "replicationcontrollers", api.ReplicationController(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ReplicationControllerSpec(
+                    replicas=2, selector={"app": "web"},
+                    template=template({"app": "web"}))), "default")
+        scale = client.get_scale("replicationcontrollers", "web", "default")
+        assert scale.spec.replicas == 2
+        assert scale.status.selector == {"app": "web"}
+        assert scale.metadata.resource_version == rc.metadata.resource_version
+        from dataclasses import replace
+        out = client.update_scale(
+            "replicationcontrollers", "web",
+            replace(scale, spec=api.ScaleSpec(replicas=4)), "default")
+        assert out.spec.replicas == 4
+        fresh = client.get("replicationcontrollers", "web", "default")
+        assert fresh.spec.replicas == 4
+        assert fresh.spec.template is not None  # only replicas moved
+        # stale resourceVersion conflicts (optimistic concurrency)
+        from kubernetes_tpu.core.errors import Conflict, NotFound
+        with pytest.raises(Conflict):
+            client.update_scale(
+                "replicationcontrollers", "web",
+                replace(scale, spec=api.ScaleSpec(replicas=9)), "default")
+        with pytest.raises(NotFound):
+            client.get_scale("pods", "web", "default")
+
+    def test_scale_over_http(self, cluster):
+        from kubernetes_tpu.api.client import HttpClient
+        from kubernetes_tpu.api.server import ApiServer
+        registry, client = cluster
+        client.create("deployments", api.Deployment(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.DeploymentSpec(replicas=3, selector={"app": "web"},
+                                    template=template({"app": "web"}))),
+            "default")
+        srv = ApiServer(registry).start()
+        try:
+            hc = HttpClient(srv.url)
+            scale = hc.get_scale("deployments", "web", "default")
+            assert scale.spec.replicas == 3
+            from dataclasses import replace
+            out = hc.update_scale(
+                "deployments", "web",
+                replace(scale, spec=api.ScaleSpec(replicas=1)), "default")
+            assert out.spec.replicas == 1
+            assert hc.get("deployments", "web",
+                          "default").spec.replicas == 1
+        finally:
+            srv.stop()
 
 
 class TestServiceAccountControllers:

@@ -81,7 +81,9 @@ class Client:
         # batching; the in-proc client overrides with one store pass).
         return [self.update_status(resource, o, namespace) for o in objs]
 
-    def delete(self, resource: str, name: str, namespace: str = "") -> Any:
+    def delete(self, resource: str, name: str, namespace: str = "",
+               grace_period_seconds: Optional[int] = None,
+               uid: Optional[str] = None) -> Any:
         raise NotImplementedError
 
     def watch(self, resource: str, namespace: str = "",
@@ -165,8 +167,11 @@ class InProcClient(Client):
     def update_scale(self, resource, name, scale, namespace=""):
         return self.registry.update_scale(resource, name, scale, namespace)
 
-    def delete(self, resource, name, namespace=""):
-        return self.registry.delete(resource, name, namespace)
+    def delete(self, resource, name, namespace="",
+               grace_period_seconds=None, uid=None):
+        return self.registry.delete(
+            resource, name, namespace,
+            grace_period_seconds=grace_period_seconds, uid=uid)
 
     def watch(self, resource, namespace="", since_rev=None,
               label_selector="", field_selector=""):
@@ -436,9 +441,16 @@ class HttpClient(Client):
         return self._decode(self._do(
             "PUT", self._url(resource, ns, name, "scale"), scale))
 
-    def delete(self, resource, name, namespace=""):
+    def delete(self, resource, name, namespace="",
+               grace_period_seconds=None, uid=None):
         ns = namespace or "default"
-        return self._decode(self._do("DELETE", self._url(resource, ns, name)))
+        body = None
+        if grace_period_seconds is not None or uid:
+            body = api.DeleteOptions(
+                grace_period_seconds=grace_period_seconds,
+                preconditions=api.Preconditions(uid=uid) if uid else None)
+        return self._decode(self._do(
+            "DELETE", self._url(resource, ns, name), body))
 
     def _ws_connect(self, path: str):
         """Upgrade a websocket to the apiserver carrying this client's

@@ -959,7 +959,9 @@ class Registry:
         ns = (namespace or "default") if info.namespaced else ""
         return self.store.guaranteed_update(self.key(resource, ns, name), fn)
 
-    def delete(self, resource: str, name: str, namespace: str = "") -> Any:
+    def delete(self, resource: str, name: str, namespace: str = "",
+               grace_period_seconds: Optional[int] = None,
+               uid: Optional[str] = None) -> Any:
         if resource == "componentstatuses":
             raise MethodNotSupported("componentstatuses is read-only")
         info = self.info(resource)
@@ -968,8 +970,32 @@ class Registry:
             self.admission("DELETE", resource, None, ns, name)
         if resource == "namespaces":
             return self._delete_namespace(name)
+        if resource == "pods":
+            graceful = self._pod_graceful_delete(ns, name,
+                                                 grace_period_seconds, uid)
+            if graceful is not None:
+                return graceful
+        key = self.key(resource, ns, name)
         try:
-            deleted = self.store.delete(self.key(resource, ns, name))
+            if uid:
+                # Preconditions.UID (ref: pkg/api/types.go, honored by
+                # rest/delete.go BeforeDelete): CAS on the rv observed
+                # WITH the matching uid, so a same-name replacement
+                # created between the check and the delete survives
+                while True:
+                    cur = self.store.get(key)
+                    if cur.metadata.uid != uid:
+                        raise Conflict(
+                            f"uid precondition failed: have "
+                            f"{uid}, current {cur.metadata.uid}")
+                    try:
+                        deleted = self.store.delete(
+                            key, expect_rv=cur.metadata.resource_version)
+                        break
+                    except Conflict:
+                        continue  # rv moved: re-read and re-check uid
+            else:
+                deleted = self.store.delete(key)
         except NotFound:
             raise NotFound(kind=resource, name=name)
         if resource == "services":
@@ -988,6 +1014,63 @@ class Registry:
                 except NotFound:
                     pass
         return deleted
+
+    def _pod_graceful_delete(self, ns: str, name: str,
+                             grace: Optional[int],
+                             uid: Optional[str] = None
+                             ) -> Optional[api.Pod]:
+        """Two-phase pod deletion (ref: pkg/api/rest/delete.go
+        BeforeDelete + pkg/registry/pod/strategy.go CheckGracefulDelete):
+        a running, scheduled pod with a grace period is MARKED
+        (deletionTimestamp = now+grace, deletionGracePeriodSeconds) and
+        stays in the store for the kubelet to drain and confirm with a
+        grace-0 delete; unscheduled or already-terminal pods — and
+        grace 0 — fall through to the immediate path (returns None).
+        Repeated deletes may only SHORTEN the grace period.
+
+        Divergence from the reference: an absent grace defaults to the
+        pod's own spec.terminationGracePeriodSeconds OR immediate —
+        not the reference's unconditional 30s (DIVERGENCES #20)."""
+        key = self.key("pods", ns, name)
+        try:
+            pod = self.store.get(key)
+        except NotFound:
+            raise NotFound(kind="pods", name=name)
+        if grace is None:
+            grace = pod.spec.termination_grace_period_seconds or 0
+        if grace < 0:
+            raise Invalid("gracePeriodSeconds: must be non-negative")
+        if (grace == 0 or not pod.spec.node_name
+                or pod.status.phase in (api.POD_SUCCEEDED, api.POD_FAILED)):
+            return None
+
+        expires = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                time.gmtime(int(time.time()) + grace))
+
+        class _AlreadyTerminating(Exception):
+            def __init__(self, current):
+                self.current = current
+
+        def apply(cur: Any) -> Any:
+            # the only-shorten check runs on the CURRENT object inside
+            # the CAS closure — a racing longer-grace delete must not
+            # re-lengthen a period another caller already shortened
+            # (the pre-read outside the closure can be stale)
+            if uid and cur.metadata.uid != uid:
+                raise Conflict(f"uid precondition failed: have {uid}, "
+                               f"current {cur.metadata.uid}")
+            existing = cur.metadata.deletion_grace_period_seconds
+            if (cur.metadata.deletion_timestamp is not None
+                    and existing is not None and grace >= existing):
+                raise _AlreadyTerminating(cur)  # no-op: don't re-stamp
+            return replace(cur, metadata=replace(
+                cur.metadata, deletion_timestamp=expires,
+                deletion_grace_period_seconds=grace))
+
+        try:
+            return self.store.guaranteed_update(key, apply)
+        except _AlreadyTerminating as e:
+            return e.current
 
     # --------------------------------------------- namespace lifecycle
 

@@ -35,6 +35,7 @@ from ..auth.authorize import AuthorizerAttributes
 from ..core.errors import (ApiError, BadGateway, BadRequest, Forbidden,
                            MethodNotSupported, NotFound, ServiceUnavailable,
                            TooManyRequests, Unauthorized)
+from ..core import types as api_types
 from ..core.scheme import Scheme, default_scheme
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .registry import RESOURCES, Registry
@@ -611,7 +612,31 @@ class ApiServer:
                 info = Registry.info(resource)
                 return self._send_json(h, 200, self.scheme.encode_list(
                     info.kind, deleted))
-            obj = self.registry.delete(resource, name, namespace)
+            # DeleteOptions ride the DELETE body (kind DeleteOptions,
+            # gracePeriodSeconds; pkg/apiserver/resthandler.go Delete);
+            # a query param is accepted for curl ergonomics
+            grace = None
+            uid = None
+            if query.get("gracePeriodSeconds", "") != "":
+                try:
+                    grace = int(query["gracePeriodSeconds"])
+                except ValueError:
+                    raise BadRequest("gracePeriodSeconds: not an integer")
+            if int(h.headers.get("Content-Length") or 0) > 0:
+                body = self._read_body(h)
+                if isinstance(body, dict) and body:
+                    opts = self.scheme.decode_dict(
+                        body, expect=api_types.DeleteOptions) \
+                        if body.get("kind") == "DeleteOptions" else None
+                    if opts is not None:
+                        if opts.grace_period_seconds is not None:
+                            grace = opts.grace_period_seconds
+                        if opts.preconditions is not None \
+                                and opts.preconditions.uid:
+                            uid = opts.preconditions.uid
+            obj = self.registry.delete(resource, name, namespace,
+                                       grace_period_seconds=grace,
+                                       uid=uid)
             return self._send_json(h, 200, self.scheme.encode_dict(obj))
 
         raise MethodNotSupported(f"method {method} not supported")

@@ -185,8 +185,14 @@ class NodeController:
         ok = True
         for pod in pods:
             try:
+                # grace 0: the node's kubelet is gone, so nobody would
+                # ever confirm a graceful mark — a graced pod would sit
+                # Terminating forever (the reference's eviction relies
+                # on the kubelet; with the node dead, force is the only
+                # terminal option)
                 self.client.delete("pods", pod.metadata.name,
-                                   pod.metadata.namespace)
+                                   pod.metadata.namespace,
+                                   grace_period_seconds=0)
                 if self.recorder:
                     self.recorder.eventf(
                         pod, "Normal", "NodeControllerEviction",

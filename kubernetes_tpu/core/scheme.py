@@ -121,6 +121,7 @@ def new_scheme() -> Scheme:
     s.register("ComponentStatus", api.ComponentStatus)
     # extensions/v1beta1 group (master.go:1049-1091)
     s.register("Scale", api.Scale)
+    s.register("DeleteOptions", api.DeleteOptions)
     s.register("Job", api.Job)
     s.register("Deployment", api.Deployment)
     s.register("DaemonSet", api.DaemonSet)

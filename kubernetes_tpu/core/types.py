@@ -98,6 +98,10 @@ class ObjectMeta:
     resource_version: str = ""
     creation_timestamp: str = ""
     deletion_timestamp: Optional[str] = None
+    # seconds the object is granted to terminate gracefully, stamped by
+    # the graceful-delete path together with deletionTimestamp (ref:
+    # pkg/api/types.go ObjectMeta.DeletionGracePeriodSeconds)
+    deletion_grace_period_seconds: Optional[int] = None
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     generation: int = 0
@@ -692,6 +696,24 @@ class ReplicationController:
 class Binding:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     target: ObjectReference = field(default_factory=ObjectReference)
+
+
+@dataclass
+class Preconditions:
+    """Delete preconditions (ref: pkg/api/types.go Preconditions) —
+    the delete aborts with Conflict unless the target carries this
+    uid. The kubelet's graceful-deletion confirm uses it so a pod
+    recreated under the same name mid-drain is never collateral."""
+    uid: str = ""
+
+
+@dataclass
+class DeleteOptions:
+    """DELETE request options (ref: pkg/api/types.go DeleteOptions) —
+    gracePeriodSeconds rides the DELETE body; None means "use the
+    pod's own spec.terminationGracePeriodSeconds"."""
+    grace_period_seconds: Optional[int] = None
+    preconditions: Optional[Preconditions] = None
 
 
 # ---------------------------------------------------------------- events

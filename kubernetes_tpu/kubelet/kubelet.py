@@ -265,6 +265,14 @@ class Kubelet:
         """(kubelet.go:2394 HandlePodAdditions)"""
         if is_mirror_pod(pod):
             return  # the apiserver reflection of a static pod: never run
+        if (pod.metadata.deletion_timestamp is not None
+                and not is_static_pod(pod)):
+            # a relist (kubelet restart, watch 410 recovery) re-surfaces
+            # a mid-termination pod as an ADD: resume the drain instead
+            # of restarting its containers (the reference's syncPod
+            # checks DeletionTimestamp before running anything)
+            self.handle_pod_deletion(pod, confirm_api_delete=True)
+            return
         with self._lock:
             self._pods[pod.metadata.uid] = pod
         self.prober_manager.add_pod(pod)
@@ -273,13 +281,26 @@ class Kubelet:
     def handle_pod_update(self, old: api.Pod, pod: api.Pod) -> None:
         if is_mirror_pod(pod):
             return
+        if (pod.metadata.deletion_timestamp is not None
+                and (old is None
+                     or old.metadata.deletion_timestamp is None)
+                and not is_static_pod(pod)):
+            # graceful deletion observed: the apiserver marked the pod
+            # (registry._pod_graceful_delete) instead of dropping it;
+            # the kubelet drains (PreStop hooks + kill) and CONFIRMS
+            # with a grace-0 delete once teardown completes (ref:
+            # kubelet.go syncLoop deletion handling + the status
+            # manager's terminated-pod api delete)
+            self.handle_pod_deletion(pod, confirm_api_delete=True)
+            return
         with self._lock:
             self._pods[pod.metadata.uid] = pod
         # refresh the probers' pod view (pod IP, new probes on spec change)
         self.prober_manager.add_pod(pod)
         self._worker_for(pod).update(pod)
 
-    def handle_pod_deletion(self, pod: api.Pod) -> None:
+    def handle_pod_deletion(self, pod: api.Pod,
+                            confirm_api_delete: bool = False) -> None:
         if is_mirror_pod(pod):
             # deleting the reflection never kills the static pod — but
             # un-note it so the next resync recreates it (out-of-band
@@ -322,11 +343,13 @@ class Kubelet:
         # kill the containers out from under a running PreStop hook.
         with self._lock:
             self._tearing_down.add(uid)
-        threading.Thread(target=self._tear_down_pod, args=(pod,),
+        threading.Thread(target=self._tear_down_pod,
+                         args=(pod, confirm_api_delete),
                          daemon=True,
                          name=f"pod-teardown-{uid[:8]}").start()
 
-    def _tear_down_pod(self, pod: api.Pod) -> None:
+    def _tear_down_pod(self, pod: api.Pod,
+                       confirm_api_delete: bool = False) -> None:
         """PreStop hooks → network teardown → kill → volumes, in the
         deletion order the reference keeps; failures stay tracked for
         housekeeping retries."""
@@ -336,6 +359,21 @@ class Kubelet:
         finally:
             with self._lock:
                 self._tearing_down.discard(uid)
+        if confirm_api_delete:
+            # graceful deletion's second half: containers are down, so
+            # confirm with a grace-0 delete that actually removes the
+            # marked pod from storage (the reference's terminated-pod
+            # api delete; rest/delete.go admits grace 0 immediately)
+            try:
+                # uid precondition: a same-name pod recreated while the
+                # PreStop drain ran must never be collateral (the
+                # reference confirms with Preconditions.UID too)
+                self.client.delete("pods", pod.metadata.name,
+                                   pod.metadata.namespace,
+                                   grace_period_seconds=0,
+                                   uid=pod.metadata.uid)
+            except Exception:
+                pass  # already gone, or the next sync re-observes
 
     def _tear_down_pod_inner(self, pod: api.Pod) -> None:
         uid = pod.metadata.uid

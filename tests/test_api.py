@@ -158,6 +158,80 @@ def test_reflector_converts_legacy_field_labels():
         refl.stop()
 
 
+def test_graceful_pod_deletion():
+    """Two-phase pod deletion (ref: pkg/api/rest/delete.go BeforeDelete,
+    pkg/registry/pod/strategy.go CheckGracefulDelete): a scheduled pod
+    with a grace period is marked, not removed; grace 0 removes;
+    repeated deletes only shorten; unscheduled pods delete at once."""
+    r = Registry()
+    pod = mk_pod("graceful", node="n1")
+    pod.spec.termination_grace_period_seconds = 30
+    r.create("pods", pod)
+    marked = r.delete("pods", "graceful")
+    assert marked.metadata.deletion_timestamp is not None
+    assert marked.metadata.deletion_grace_period_seconds == 30
+    still = r.get("pods", "graceful")  # NOT removed from storage
+    assert still.metadata.deletion_timestamp is not None
+    # watchers saw MODIFIED (the kubelet's trigger), not DELETED
+    # a longer/equal grace is a no-op; a shorter one shortens
+    again = r.delete("pods", "graceful", grace_period_seconds=60)
+    assert again.metadata.deletion_grace_period_seconds == 30
+    shorter = r.delete("pods", "graceful", grace_period_seconds=5)
+    assert shorter.metadata.deletion_grace_period_seconds == 5
+    # grace 0 force-deletes
+    r.delete("pods", "graceful", grace_period_seconds=0)
+    with pytest.raises(NotFound):
+        r.get("pods", "graceful")
+    # unscheduled pods skip the dance even with a spec grace
+    p2 = mk_pod("unsched")
+    p2.spec.termination_grace_period_seconds = 30
+    r.create("pods", p2)
+    r.delete("pods", "unsched")
+    with pytest.raises(NotFound):
+        r.get("pods", "unsched")
+    # pods without a spec grace delete immediately (DIVERGENCES #20)
+    r.create("pods", mk_pod("bare", node="n1"))
+    r.delete("pods", "bare")
+    with pytest.raises(NotFound):
+        r.get("pods", "bare")
+
+
+def test_delete_uid_precondition():
+    """Preconditions.UID (ref: pkg/api/types.go Preconditions): a delete
+    carrying the OLD pod's uid must not touch a same-name replacement —
+    the race the kubelet's graceful-deletion confirm would otherwise
+    lose against a recreate."""
+    from kubernetes_tpu.core.errors import Conflict as ConflictErr
+    r = Registry()
+    first = r.create("pods", mk_pod("p", node="n1"))
+    r.delete("pods", "p", grace_period_seconds=0)
+    replacement = r.create("pods", mk_pod("p"))
+    assert replacement.metadata.uid != first.metadata.uid
+    with pytest.raises(ConflictErr):
+        r.delete("pods", "p", grace_period_seconds=0,
+                 uid=first.metadata.uid)
+    assert r.get("pods", "p").metadata.uid == replacement.metadata.uid
+    r.delete("pods", "p", grace_period_seconds=0,
+             uid=replacement.metadata.uid)
+    with pytest.raises(NotFound):
+        r.get("pods", "p")
+
+
+def test_graceful_deletion_over_http(server):
+    """DeleteOptions ride the DELETE body; the query param shortcut
+    works too."""
+    c = HttpClient(server.url)
+    pod = mk_pod("g1", node="n1")
+    pod.spec.termination_grace_period_seconds = 30
+    c.create("pods", pod)
+    marked = c.delete("pods", "g1")  # no options -> spec grace
+    assert marked.metadata.deletion_grace_period_seconds == 30
+    gone = c.delete("pods", "g1", grace_period_seconds=0)
+    assert gone.metadata.deletion_timestamp is not None
+    with pytest.raises(NotFound):
+        c.get("pods", "g1")
+
+
 def test_registry_binding_subresource():
     r = Registry()
     r.create("pods", mk_pod("p1"))

@@ -158,6 +158,42 @@ class TestKubeletHooks:
         finally:
             kubelet.stop()
 
+    def test_graceful_deletion_drains_then_confirms(self):
+        """Two-phase deletion end-to-end: DELETE marks the pod (it stays
+        in storage), the kubelet observes the deletionTimestamp, runs
+        PreStop, kills the containers, and CONFIRMS with a grace-0
+        delete that actually removes the pod (ref: rest/delete.go
+        BeforeDelete + the kubelet's terminated-pod api delete)."""
+        from kubernetes_tpu.core.errors import NotFound
+        registry = Registry()
+        client = InProcClient(registry)
+        rt = RecordingExecRuntime()
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            pod = mkpod([api.Container(
+                name="c", image="i",
+                lifecycle=api.Lifecycle(pre_stop=api.Handler(
+                    exec=api.ExecAction(command=["drain"]))))])
+            pod.spec.termination_grace_period_seconds = 30
+            client.create("pods", pod)
+            assert wait_until(lambda: rt.running_containers("u-lc"))
+            marked = client.delete("pods", "p", "default")
+            # first phase: marked, not removed
+            assert marked.metadata.deletion_timestamp is not None
+            # the kubelet drains and force-deletes: the pod disappears
+            # from storage WITHOUT any further client call from here
+            def gone():
+                try:
+                    registry.get("pods", "p", "default")
+                    return False
+                except NotFound:
+                    return True
+            assert wait_until(gone)
+            assert ("u-lc", "c", ["drain"]) in rt.execs
+            assert rt.running_containers("u-lc") == []
+        finally:
+            kubelet.stop()
+
     def test_pre_stop_runs_on_liveness_kill(self):
         client = InProcClient(Registry())
         rt = RecordingExecRuntime()

@@ -12,6 +12,7 @@ import dataclasses
 import json
 import random
 import typing
+import zlib
 from typing import get_args, get_origin
 
 import pytest
@@ -85,7 +86,9 @@ def _rand_instance(cls, rng: random.Random, depth: int = 0):
     "resource", sorted(r for r in RESOURCES))
 def test_fuzzed_round_trip(resource):
     cls = RESOURCES[resource].cls
-    rng = random.Random(hash(resource) & 0xFFFF)
+    # stable per-kind seed: str hash is salted per process, which would
+    # make failures unreproducible across runs
+    rng = random.Random(zlib.crc32(resource.encode()) & 0xFFFF)
     for trial in range(8):
         obj = _rand_instance(cls, rng)
         wire = default_scheme.encode_dict(obj)

@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     rm.add_argument("-f", "--filename", default="")
     rm.add_argument("-l", "--selector", default="")
     rm.add_argument("--all", action="store_true")
+    # ref: pkg/kubectl/cmd/delete.go:98 — negative means "unset"
+    # (pods then terminate with their own spec grace period)
+    rm.add_argument("--grace-period", type=int, default=-1)
 
     sc = sub.add_parser("scale", help="set a new size for a controller")
     sc.add_argument("args", nargs="+")
@@ -375,22 +378,27 @@ class Kubectl:
                     f"{resource}/{updated.metadata.name} configured\n")
 
     def delete(self, ns, args, filename="", selector="",
-               delete_all=False) -> None:
+               delete_all=False, grace_period=-1) -> None:
+        # negative = unset (delete.go: "Ignored if negative")
+        grace = grace_period if grace_period >= 0 else None
         if filename:
             for obj in load_manifest(filename, self.scheme):
                 resource = resource_for_object(obj, self.scheme)
                 self.client.delete(resource, obj.metadata.name,
-                                   obj.metadata.namespace or ns)
+                                   obj.metadata.namespace or ns,
+                                   grace_period_seconds=grace)
                 self.out.write(f"{resource}/{obj.metadata.name} deleted\n")
             return
         for resource, name in parse_resource_args(args):
             if name is not None:
-                self.client.delete(resource, name, ns)
+                self.client.delete(resource, name, ns,
+                                   grace_period_seconds=grace)
                 self.out.write(f"{resource}/{name} deleted\n")
             elif selector or delete_all:
                 items, _ = self.client.list(resource, ns, selector)
                 for obj in items:
-                    self.client.delete(resource, obj.metadata.name, ns)
+                    self.client.delete(resource, obj.metadata.name, ns,
+                                       grace_period_seconds=grace)
                     self.out.write(
                         f"{resource}/{obj.metadata.name} deleted\n")
             else:
@@ -1179,7 +1187,7 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
             k.apply(ns, ns_args.filename)
         elif ns_args.command == "delete":
             k.delete(ns, ns_args.args, ns_args.filename, ns_args.selector,
-                     ns_args.all)
+                     ns_args.all, ns_args.grace_period)
         elif ns_args.command == "scale":
             k.scale(ns, ns_args.args, ns_args.replicas,
                     ns_args.current_replicas)

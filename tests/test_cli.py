@@ -157,6 +157,25 @@ class TestCreateApplyDelete:
         assert code == 0 and "pods/a deleted" in out
         assert len(client.list("pods", "default")[0]) == 1
 
+    def test_delete_grace_period_flag(self, cluster):
+        """--grace-period (delete.go:98): a positive value runs the
+        graceful two-phase; 0 forces; negative (default) uses the
+        pod's own spec grace."""
+        _, client = cluster
+        pod = mkpod("g", {"app": "g"})
+        pod.spec.termination_grace_period_seconds = 30
+        client.create("pods", pod, "default")
+        code, out, _ = run_cli(client, "delete", "pods", "g",
+                               "--grace-period", "10")
+        assert code == 0 and "pods/g deleted" in out
+        marked = client.get("pods", "g", "default")
+        assert marked.metadata.deletion_grace_period_seconds == 10
+        code, _, _ = run_cli(client, "delete", "pods", "g",
+                             "--grace-period", "0")
+        assert code == 0
+        assert all(p.metadata.name != "g"
+                   for p in client.list("pods", "default")[0])
+
 
 class TestMutations:
     def rc(self, client, replicas=2):

@@ -355,12 +355,15 @@ class CliRuntime(Runtime):
         KillPod; SyncPod's whole-pod restart on liveness failure)."""
         self.kill_pod(pod_uid, remove=False)
 
-    def kill_pod(self, pod_uid: str, remove: bool = True) -> None:
+    def kill_pod(self, pod_uid: str, remove: bool = True,
+                 grace_seconds: Optional[float] = None) -> None:
         """Stop the unit; with remove=True also drop the unit file and
         prepared-pod data (the Runtime contract here folds the GC's
         removal in, like daemon_runtime.kill_pod). remove=False keeps
         the corpse for logs/status and touches the service file so the
-        min-age GC defers (rkt.go:991-999)."""
+        min-age GC defers (rkt.go:991-999). grace_seconds is accepted
+        for the Runtime contract; the unit manager's stop is already
+        systemd-style graceful with its own timeout."""
         unit = unit_name_for(pod_uid)
         if not self.units.has_unit(unit):
             return

@@ -66,7 +66,12 @@ class Runtime:
     def kill_container(self, pod_uid: str, name: str) -> None:
         raise NotImplementedError
 
-    def kill_pod(self, pod_uid: str) -> None:
+    def kill_pod(self, pod_uid: str,
+                 grace_seconds: Optional[float] = None) -> None:
+        """grace_seconds bounds the TERM->KILL window per the pod's own
+        grace period (ref: dockertools KillContainer receives the
+        DeleteOptions/spec grace); None means the runtime's default.
+        Runtimes without a graded stop may ignore it."""
         raise NotImplementedError
 
     def get_container_logs(self, pod_uid: str, name: str,
@@ -143,7 +148,8 @@ class FakeRuntime(Runtime):
         # killed containers report 128+SIGKILL like docker (137)
         self._transition(pod_uid, name, exit_code=137)
 
-    def kill_pod(self, pod_uid: str) -> None:
+    def kill_pod(self, pod_uid: str,
+                 grace_seconds: Optional[float] = None) -> None:
         with self._lock:
             self._pods.pop(pod_uid, None)
 

@@ -75,9 +75,10 @@ class DaemonRuntime(Runtime):
     # ------------------------------------------------------------ wire
 
     def _do(self, method: str, path: str, body: Optional[dict] = None,
-            raw: bool = False, headers: Optional[dict] = None):
+            raw: bool = False, headers: Optional[dict] = None,
+            timeout: Optional[float] = None):
         conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+                                          timeout=timeout or self.timeout)
         try:
             payload = json.dumps(body).encode() if body is not None else None
             hdrs = {"Content-Type": "application/json"} if payload else {}
@@ -210,12 +211,25 @@ class DaemonRuntime(Runtime):
         for c in self._find(pod_uid, name, running_only=True):
             self._do("POST", f"/containers/{c['Id']}/kill")
 
-    def kill_pod(self, pod_uid: str) -> None:
+    def kill_pod(self, pod_uid: str,
+                 grace_seconds: Optional[float] = None) -> None:
         """Kill every container, then remove the records (ref:
-        manager.go KillPod + the GC's container removal)."""
+        manager.go KillPod + the GC's container removal). With a grace
+        period the engine's graded stop runs (docker-remote
+        /containers/{id}/stop?t= — TERM, wait t, KILL) instead of the
+        immediate kill."""
         for c in self._find(pod_uid):
             if c.get("State") == "running":
-                self._do("POST", f"/containers/{c['Id']}/kill")
+                if grace_seconds is not None:
+                    # the stop call blocks up to t server-side: give
+                    # this one request a timeout of grace+slack so a
+                    # TERM-ignoring workload can't outlive the client
+                    # timeout and kill the teardown thread mid-loop
+                    self._do("POST", f"/containers/{c['Id']}/stop"
+                                     f"?t={int(grace_seconds)}",
+                             timeout=grace_seconds + 15.0)
+                else:
+                    self._do("POST", f"/containers/{c['Id']}/kill")
             self._do("DELETE", f"/containers/{c['Id']}")
 
     def get_container_logs(self, pod_uid: str, name: str,

@@ -401,7 +401,14 @@ class Kubelet:
                 with self._lock:
                     self._networked.pop(uid, None)
                     self._pod_ips.pop(uid, None)
-        self.runtime.kill_pod(uid)
+        # the pod's own grace bounds the runtime's TERM->KILL window
+        # (dockertools KillContainer receives the DeleteOptions grace;
+        # a marked pod carries the server-stamped period, otherwise the
+        # spec's)
+        grace = (pod.metadata.deletion_grace_period_seconds
+                 if pod.metadata.deletion_grace_period_seconds is not None
+                 else pod.spec.termination_grace_period_seconds)
+        self.runtime.kill_pod(uid, grace_seconds=grace)
         if self.volume_mgr is not None and uid in self._mounted:
             try:
                 self.volume_mgr.tear_down_pod_volumes(pod)
@@ -444,7 +451,9 @@ class Kubelet:
                 except Exception:
                     logging.exception("pre-stop %s/%s", uid,
                                       container.name)
-            self.runtime.kill_pod(uid)
+            self.runtime.kill_pod(
+                uid,
+                grace_seconds=pod.spec.termination_grace_period_seconds)
             self.status_manager.set_pod_status(pod, api.PodStatus(
                 phase=api.POD_FAILED, reason="DeadlineExceeded",
                 message="Pod was active on the node longer than "

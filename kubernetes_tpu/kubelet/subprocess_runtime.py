@@ -249,12 +249,22 @@ class SubprocessRuntime(Runtime):
             return
         self._kill(proc)
 
-    def kill_pod(self, pod_uid: str) -> None:
+    def kill_pod(self, pod_uid: str,
+                 grace_seconds: Optional[float] = None) -> None:
         with self._lock:
             procs = [p for (uid, _), p in self._procs.items()
                      if uid == pod_uid]
+        # the grace is a POD-wide bound (dockertools KillPod): TERM
+        # every container first, then share one deadline across the
+        # waits — serial per-container waits would both multiply the
+        # bound and starve later containers of their TERM window
         for proc in procs:
-            self._kill(proc)
+            self._signal_term(proc)
+        deadline = time.monotonic() + (grace_seconds
+                                       if grace_seconds is not None
+                                       else self.termination_grace)
+        for proc in procs:
+            self._await_or_force(proc, deadline)
         with self._lock:
             for key in [k for k in self._procs if k[0] == pod_uid]:
                 del self._procs[key]
@@ -389,19 +399,19 @@ class SubprocessRuntime(Runtime):
 
     # ------------------------------------------------------------ helpers
 
-    def _kill(self, proc: _Proc) -> None:
-        """Graceful-then-forced, the docker-stop semantics the kubelet
-        relies on (dockertools KillContainer: SIGTERM, grace period,
-        SIGKILL): a well-behaved init — the pause program included —
-        exits 0 instead of recording rc=-9 on every teardown."""
-        popen = proc.popen
-        if popen.poll() is None:
+    def _signal_term(self, proc: _Proc) -> None:
+        if proc.popen.poll() is None:
             try:  # the whole session, not just the leader
-                os.killpg(popen.pid, signal.SIGTERM)
+                os.killpg(proc.popen.pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
+
+    def _await_or_force(self, proc: _Proc, deadline: float) -> None:
+        popen = proc.popen
+        if popen.poll() is None:
             try:
-                popen.wait(timeout=self.termination_grace)
+                popen.wait(timeout=max(0.0,
+                                       deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 try:
                     os.killpg(popen.pid, signal.SIGKILL)
@@ -412,6 +422,20 @@ class SubprocessRuntime(Runtime):
                 except subprocess.TimeoutExpired:
                     pass
         self._mark_exited(proc)
+
+    def _kill(self, proc: _Proc,
+              grace_seconds: Optional[float] = None) -> None:
+        """Graceful-then-forced, the docker-stop semantics the kubelet
+        relies on (dockertools KillContainer: SIGTERM, grace period,
+        SIGKILL): a well-behaved init — the pause program included —
+        exits 0 instead of recording rc=-9 on every teardown.
+        grace_seconds (the pod's own grace) overrides the default
+        TERM->KILL window."""
+        self._signal_term(proc)
+        self._await_or_force(
+            proc, time.monotonic() + (grace_seconds
+                                      if grace_seconds is not None
+                                      else self.termination_grace))
 
     def _mark_exited(self, proc: _Proc) -> None:
         rc = proc.popen.poll()

@@ -194,6 +194,32 @@ class TestKubeletHooks:
         finally:
             kubelet.stop()
 
+    def test_pod_grace_reaches_runtime_kill(self):
+        """The runtime's TERM->KILL window is bounded by the pod's own
+        grace (dockertools KillContainer receives the DeleteOptions
+        grace) — the server-stamped deletionGracePeriodSeconds wins
+        over the spec value."""
+        seen = []
+
+        class GraceRecordingRuntime(FakeRuntime):
+            def kill_pod(self, pod_uid, grace_seconds=None):
+                seen.append(grace_seconds)
+                super().kill_pod(pod_uid, grace_seconds=grace_seconds)
+
+        client = InProcClient(Registry())
+        rt = GraceRecordingRuntime()
+        kubelet = Kubelet(client, "n1", runtime=rt).run()
+        try:
+            pod = mkpod([api.Container(name="c", image="i")])
+            pod.spec.termination_grace_period_seconds = 30
+            client.create("pods", pod)
+            assert wait_until(lambda: rt.running_containers("u-lc"))
+            client.delete("pods", "p", "default",
+                          grace_period_seconds=7)
+            assert wait_until(lambda: 7 in seen)
+        finally:
+            kubelet.stop()
+
     def test_pre_stop_runs_on_liveness_kill(self):
         client = InProcClient(Registry())
         rt = RecordingExecRuntime()

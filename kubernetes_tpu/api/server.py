@@ -1138,13 +1138,27 @@ class ApiServer:
     def _serve_watch(self, h, resource: str, namespace: str, query: dict) -> None:
         rv = query.get("resourceVersion")
         since_rev = int(rv) if rv not in (None, "") else None
+        # bounded watch (ref: the WatchServer's request timeout,
+        # api_installer.go TimeoutSeconds): the stream ends cleanly
+        # after N seconds and the client re-lists/re-watches — the
+        # reflector's normal recovery path. Parsed BEFORE the watcher
+        # registers so a malformed value can't leak an unstopped
+        # watcher into the store.
+        deadline = None
+        if query.get("timeoutSeconds", "") != "":
+            try:
+                deadline = time.monotonic() + float(query["timeoutSeconds"])
+            except ValueError:
+                raise BadRequest("timeoutSeconds: not a number")
         watcher = self.registry.watch(resource, namespace, since_rev,
                                       query.get("labelSelector", ""),
                                       query.get("fieldSelector", ""))
         self.metrics.inc("apiserver_watch_count", {"resource": resource})
         if self._wants_websocket(h):
-            return self._serve_watch_websocket(h, watcher)
-        self._stream_watch_events(h, watcher, self.scheme.encode_dict)
+            return self._serve_watch_websocket(h, watcher,
+                                               deadline=deadline)
+        self._stream_watch_events(h, watcher, self.scheme.encode_dict,
+                                  deadline=deadline)
 
     @staticmethod
     def _encode_watch_object(encode, ev):
@@ -1159,7 +1173,22 @@ class ApiServer:
             return ev.object.status()
         return encode(ev.object)
 
-    def _stream_watch_events(self, h, watcher, encode) -> None:
+    @staticmethod
+    def _watch_tick(watcher, deadline):
+        """One bounded watcher.next: (event, expired). The deadline caps
+        the wait so an expired watch ends within a heartbeat."""
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None, True
+            ev = watcher.next(timeout=min(WATCH_HEARTBEAT_SECONDS,
+                                          remaining))
+        else:
+            ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
+        return ev, (deadline is not None
+                    and time.monotonic() >= deadline and ev is None)
+
+    def _stream_watch_events(self, h, watcher, encode, deadline=None) -> None:
         """Chunked JSON event stream shared by the typed watch and the
         third-party watch (encode: object -> wire dict)."""
         try:
@@ -1174,7 +1203,9 @@ class ApiServer:
                 h.wfile.flush()
 
             while True:
-                ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
+                ev, expired = self._watch_tick(watcher, deadline)
+                if expired:
+                    break
                 if ev is None:
                     if watcher.stopped:
                         break
@@ -1191,7 +1222,8 @@ class ApiServer:
         finally:
             watcher.stop()
 
-    def _serve_watch_websocket(self, h, watcher, encode=None) -> None:
+    def _serve_watch_websocket(self, h, watcher, encode=None,
+                               deadline=None) -> None:
         """Watch over a websocket (ref: watch.go:89 HandleWS; wire events
         are the same JSON objects, one per text frame). Framing and
         handshake come from utils/wsstream (the pkg/util/wsstream role);
@@ -1235,7 +1267,9 @@ class ApiServer:
                              daemon=True).start()
 
             while True:
-                ev = watcher.next(timeout=WATCH_HEARTBEAT_SECONDS)
+                ev, expired = self._watch_tick(watcher, deadline)
+                if expired:
+                    break
                 if ev is None:
                     if watcher.stopped:
                         break

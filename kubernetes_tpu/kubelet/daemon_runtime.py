@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
+import time
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
@@ -218,16 +220,23 @@ class DaemonRuntime(Runtime):
         period the engine's graded stop runs (docker-remote
         /containers/{id}/stop?t= — TERM, wait t, KILL) instead of the
         immediate kill."""
+        # the grace is a POD-wide bound: each serial stop gets only the
+        # REMAINING window (a per-container t would multiply the bound
+        # by the container count for TERM-ignoring workloads)
+        deadline = (time.monotonic() + grace_seconds
+                    if grace_seconds is not None else None)
         for c in self._find(pod_uid):
             if c.get("State") == "running":
-                if grace_seconds is not None:
+                remaining = (max(0, math.ceil(deadline - time.monotonic()))
+                             if deadline is not None else None)
+                if remaining:
                     # the stop call blocks up to t server-side: give
-                    # this one request a timeout of grace+slack so a
+                    # this one request a timeout of t+slack so a
                     # TERM-ignoring workload can't outlive the client
                     # timeout and kill the teardown thread mid-loop
                     self._do("POST", f"/containers/{c['Id']}/stop"
-                                     f"?t={int(grace_seconds)}",
-                             timeout=grace_seconds + 15.0)
+                                     f"?t={remaining}",
+                             timeout=remaining + 15.0)
                 else:
                     self._do("POST", f"/containers/{c['Id']}/kill")
             self._do("DELETE", f"/containers/{c['Id']}")

@@ -353,6 +353,20 @@ def test_http_watch_with_resource_version(server):
     w.stop()
 
 
+def test_http_watch_timeout_seconds(server):
+    """?timeoutSeconds= bounds the watch stream (the WatchServer's
+    request timeout): the chunked body ends cleanly and the client can
+    re-list/re-watch."""
+    import urllib.request
+    t0 = time.time()
+    resp = urllib.request.urlopen(
+        server.url + "/api/v1/pods?watch=true&timeoutSeconds=1",
+        timeout=10)
+    body = resp.read()  # returns only because the server ended the stream
+    assert time.time() - t0 < 8
+    assert b'"type"' not in body  # no events; just a clean end
+
+
 def test_http_healthz_and_metrics(server):
     import urllib.request
     assert urllib.request.urlopen(server.url + "/healthz").read() == b"ok"

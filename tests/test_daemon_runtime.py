@@ -30,6 +30,7 @@ class MockDaemon:
         self.execs = {}        # exec id -> {Cmd, ExitCode, Output}
         self.calls = []
         self.logs = {}         # container id -> text
+        self.stops = []        # (container id, t) graded stops
         self.pulls = []        # (image, X-Registry-Auth header)
         self.protected = {}    # registry -> (user, password) required
         self._n = 0
@@ -142,6 +143,17 @@ class MockDaemon:
                     c["State"] = "exited"
                     c["ExitCode"] = 137
                     return self._send(204)
+                if path.endswith("/stop"):
+                    # docker-remote graded stop: TERM, wait up to t, KILL
+                    cid = path.split("/")[2]
+                    c = daemon.containers.get(cid)
+                    if c is None:
+                        return self._send(404, {"message": "no such id"})
+                    q = parse_qs(parsed.query)
+                    daemon.stops.append((cid, int(q.get("t", ["10"])[0])))
+                    c["State"] = "exited"
+                    c["ExitCode"] = 0  # clean TERM exit
+                    return self._send(204)
                 if path.endswith("/exec") and path.startswith("/containers/"):
                     body = self._body()
                     with daemon._lock:
@@ -208,6 +220,25 @@ def test_name_convention_roundtrip():
                       "attempt": 3}
     assert parse_container_name("/random-container") is None
     assert parse_container_name("k8s_a_b_c_d_notanint") is None
+
+
+def test_kill_pod_with_grace_uses_graded_stop(daemon):
+    """A pod grace maps to the engine's graded /stop?t= (dockertools
+    KillContainer via docker StopContainer); without one the immediate
+    /kill fires, and t never exceeds the pod-wide grace."""
+    rt = DaemonRuntime(daemon.url)
+    pod = mk_pod()
+    rc = rt.start_container(pod, pod.spec.containers[0])
+    rt.kill_pod("uid-dp", grace_seconds=7)
+    assert daemon.stops and daemon.stops[0] == (rc.id, 7)
+    assert all(t <= 7 for _cid, t in daemon.stops)
+    # grace 0 (force) falls back to the immediate kill
+    rc2 = rt.start_container(pod, pod.spec.containers[0])
+    daemon.stops.clear()
+    rt.kill_pod("uid-dp", grace_seconds=0)
+    assert not daemon.stops
+    assert any(p == ("POST", f"/containers/{rc2.id}/kill")
+               for p in daemon.calls)
 
 
 def test_start_list_kill_through_daemon(daemon):

@@ -23,6 +23,7 @@ matching the reference's watch/json wire format (pkg/watch/json).
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 import time
@@ -690,6 +691,7 @@ class ApiServer:
         if method == "GET":
             if query.get("watch") in ("true", "1") and not name:
                 rv = query.get("resourceVersion")
+                deadline = self._watch_deadline(query)
                 watcher = self.registry.third_party_watch(
                     group, plural, namespace,
                     int(rv) if rv not in (None, "") else None,
@@ -697,8 +699,10 @@ class ApiServer:
                 self.metrics.inc("apiserver_watch_count",
                                  {"resource": f"{group}/{plural}"})
                 if self._wants_websocket(h):
-                    return self._serve_watch_websocket(h, watcher, encode)
-                return self._stream_watch_events(h, watcher, encode)
+                    return self._serve_watch_websocket(h, watcher, encode,
+                                                       deadline=deadline)
+                return self._stream_watch_events(h, watcher, encode,
+                                                 deadline=deadline)
             if not name:
                 items, rev = self.registry.third_party_list(
                     group, plural, namespace, checked=True)
@@ -1138,18 +1142,7 @@ class ApiServer:
     def _serve_watch(self, h, resource: str, namespace: str, query: dict) -> None:
         rv = query.get("resourceVersion")
         since_rev = int(rv) if rv not in (None, "") else None
-        # bounded watch (ref: the WatchServer's request timeout,
-        # api_installer.go TimeoutSeconds): the stream ends cleanly
-        # after N seconds and the client re-lists/re-watches — the
-        # reflector's normal recovery path. Parsed BEFORE the watcher
-        # registers so a malformed value can't leak an unstopped
-        # watcher into the store.
-        deadline = None
-        if query.get("timeoutSeconds", "") != "":
-            try:
-                deadline = time.monotonic() + float(query["timeoutSeconds"])
-            except ValueError:
-                raise BadRequest("timeoutSeconds: not a number")
+        deadline = self._watch_deadline(query)
         watcher = self.registry.watch(resource, namespace, since_rev,
                                       query.get("labelSelector", ""),
                                       query.get("fieldSelector", ""))
@@ -1172,6 +1165,26 @@ class ApiServer:
         if isinstance(ev.object, ApiError):
             return ev.object.status()
         return encode(ev.object)
+
+    @staticmethod
+    def _watch_deadline(query: dict):
+        """?timeoutSeconds= -> absolute monotonic deadline or None
+        (ref: the WatchServer's request timeout, api_installer.go
+        TimeoutSeconds): the stream ends cleanly after N seconds and
+        the client re-lists/re-watches — the reflector's normal
+        recovery path. Parsed BEFORE the watcher registers so a
+        malformed value can't leak an unstopped watcher into the
+        store; nan/inf reject rather than silently unbounding."""
+        raw = query.get("timeoutSeconds", "")
+        if raw == "":
+            return None
+        try:
+            timeout = float(raw)
+        except ValueError:
+            raise BadRequest("timeoutSeconds: not a number")
+        if not math.isfinite(timeout):
+            raise BadRequest("timeoutSeconds: not a finite number")
+        return time.monotonic() + timeout
 
     @staticmethod
     def _watch_tick(watcher, deadline):

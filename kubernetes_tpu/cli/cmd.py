@@ -71,6 +71,10 @@ def build_parser() -> argparse.ArgumentParser:
     # ref: pkg/kubectl/cmd/delete.go:98 — negative means "unset"
     # (pods then terminate with their own spec grace period)
     rm.add_argument("--grace-period", type=int, default=-1)
+    # ref: delete.go:97 — cascade reaps managed pods first (stop.go
+    # ReaperFor); --cascade=false deletes only the object itself
+    rm.add_argument("--cascade", default=True,
+                    type=lambda v: v.lower() not in ("false", "0", "no"))
 
     sc = sub.add_parser("scale", help="set a new size for a controller")
     sc.add_argument("args", nargs="+")
@@ -378,29 +382,30 @@ class Kubectl:
                     f"{resource}/{updated.metadata.name} configured\n")
 
     def delete(self, ns, args, filename="", selector="",
-               delete_all=False, grace_period=-1) -> None:
+               delete_all=False, grace_period=-1, cascade=True) -> None:
         # negative = unset (delete.go: "Ignored if negative")
         grace = grace_period if grace_period >= 0 else None
+
+        def _one(resource, name, target_ns):
+            if cascade and resource in self.REAPABLE:
+                self._reap(resource, name, target_ns, grace)
+            else:
+                self.client.delete(resource, name, target_ns,
+                                   grace_period_seconds=grace)
+            self.out.write(f"{resource}/{name} deleted\n")
+
         if filename:
             for obj in load_manifest(filename, self.scheme):
-                resource = resource_for_object(obj, self.scheme)
-                self.client.delete(resource, obj.metadata.name,
-                                   obj.metadata.namespace or ns,
-                                   grace_period_seconds=grace)
-                self.out.write(f"{resource}/{obj.metadata.name} deleted\n")
+                _one(resource_for_object(obj, self.scheme),
+                     obj.metadata.name, obj.metadata.namespace or ns)
             return
         for resource, name in parse_resource_args(args):
             if name is not None:
-                self.client.delete(resource, name, ns,
-                                   grace_period_seconds=grace)
-                self.out.write(f"{resource}/{name} deleted\n")
+                _one(resource, name, ns)
             elif selector or delete_all:
                 items, _ = self.client.list(resource, ns, selector)
                 for obj in items:
-                    self.client.delete(resource, obj.metadata.name, ns,
-                                       grace_period_seconds=grace)
-                    self.out.write(
-                        f"{resource}/{obj.metadata.name} deleted\n")
+                    _one(resource, obj.metadata.name, ns)
             else:
                 raise ApiError(
                     "resource name, --selector, or --all is required")
@@ -715,10 +720,84 @@ class Kubectl:
         self.client.update(resource, obj, ns)
         self.out.write(f"{resource}/{name} patched\n")
 
+    # kinds with a reaper (ref: pkg/kubectl/stop.go ReaperFor) — the
+    # cascade path drains their managed pods before the final delete
+    REAPABLE = ("replicationcontrollers", "jobs", "daemonsets")
+
+    def _reap(self, resource: str, name: str, target_ns: str,
+              grace=None) -> None:
+        """Drain a controller's pods, then delete it (ref:
+        pkg/kubectl/stop.go): RCs scale to 0 and wait on
+        status.replicas; Jobs scale parallelism to 0, wait on
+        status.active, then delete their (dead) pods; DaemonSets
+        retarget to an unmatchable node selector and wait for the
+        controller to kill every daemon pod."""
+        deadline = time.time() + 30
+        if resource == "replicationcontrollers":
+            rc = self.client.get(resource, name, target_ns)
+            # never mutate a cached object: stored objects are frozen
+            self.client.update(
+                resource,
+                replace(rc, spec=replace(rc.spec, replicas=0)),
+                target_ns)
+            # wait for the manager to observe the scale-down before
+            # deleting (stop.go's reaper does exactly this) — delete
+            # racing the controller's informer would orphan the pods
+            while time.time() < deadline:
+                live = self.client.get(resource, name, target_ns)
+                if live.status.replicas == 0:
+                    break
+                time.sleep(0.1)
+        elif resource == "jobs":
+            job = self.client.get(resource, name, target_ns)
+            self.client.update(
+                resource,
+                replace(job, spec=replace(job.spec, parallelism=0)),
+                target_ns)
+            while time.time() < deadline:
+                if self.client.get(resource, name,
+                                   target_ns).status.active == 0:
+                    break
+                time.sleep(0.1)
+            # only dead pods remain; remove them (JobReaper.Stop)
+            sel = ",".join(f"{k}={v}"
+                           for k, v in sorted(job.spec.selector.items()))
+            if sel:
+                pods, _ = self.client.list("pods", target_ns, sel)
+                for p in pods:
+                    try:
+                        self.client.delete("pods", p.metadata.name,
+                                           target_ns,
+                                           grace_period_seconds=grace)
+                    except ApiError:
+                        pass
+        elif resource == "daemonsets":
+            import uuid as _uuid
+            ds = self.client.get(resource, name, target_ns)
+            tpl = ds.spec.template
+            # an unmatchable selector: the controller deletes every
+            # daemon pod (DaemonSetReaper.Stop's random-label move)
+            unmatchable = {str(_uuid.uuid4()): str(_uuid.uuid4())}
+            self.client.update(
+                resource,
+                replace(ds, spec=replace(
+                    ds.spec,
+                    template=replace(tpl, spec=replace(
+                        tpl.spec, node_selector=unmatchable)))),
+                target_ns)
+            while time.time() < deadline:
+                st = self.client.get(resource, name, target_ns).status
+                if st.current_number_scheduled + st.number_misscheduled \
+                        == 0:
+                    break
+                time.sleep(0.1)
+        self.client.delete(resource, name, target_ns,
+                           grace_period_seconds=grace)
+
     def stop(self, ns, args, filename="") -> None:
-        """kubectl stop: graceful shutdown — controllers scale to 0
-        before deletion so their pods terminate first (ref:
-        pkg/kubectl/stop.go ReplicationControllerReaper)."""
+        """kubectl stop: graceful shutdown — controllers drain before
+        deletion so their pods terminate first (ref: pkg/kubectl/stop.go
+        ReaperFor)."""
         targets = []
         if filename:
             for obj in load_manifest(filename, self.scheme):
@@ -730,25 +809,8 @@ class Kubectl:
                 if name is None:
                     raise ApiError("stop requires TYPE NAME")
                 targets.append((resource, name, ns))
-        import time as _time
         for resource, name, target_ns in targets:
-            if resource == "replicationcontrollers":
-                rc = self.client.get(resource, name, target_ns)
-                # never mutate a cached object: stored objects are frozen
-                self.client.update(
-                    resource,
-                    replace(rc, spec=replace(rc.spec, replicas=0)),
-                    target_ns)
-                # wait for the manager to observe the scale-down before
-                # deleting (stop.go's reaper does exactly this) — delete
-                # racing the controller's informer would orphan the pods
-                deadline = _time.time() + 30
-                while _time.time() < deadline:
-                    live = self.client.get(resource, name, target_ns)
-                    if live.status.replicas == 0:
-                        break
-                    _time.sleep(0.1)
-            self.client.delete(resource, name, target_ns)
+            self._reap(resource, name, target_ns)
             self.out.write(f"{resource}/{name} stopped\n")
 
     def edit(self, ns, args) -> int:
@@ -1187,7 +1249,7 @@ def main(argv: Optional[List[str]] = None, client=None, out=None,
             k.apply(ns, ns_args.filename)
         elif ns_args.command == "delete":
             k.delete(ns, ns_args.args, ns_args.filename, ns_args.selector,
-                     ns_args.all, ns_args.grace_period)
+                     ns_args.all, ns_args.grace_period, ns_args.cascade)
         elif ns_args.command == "scale":
             k.scale(ns, ns_args.args, ns_args.replicas,
                     ns_args.current_replicas)

@@ -18,13 +18,21 @@ from ..core.labels import selector_from_set
 from .framework import ControllerExpectations, QueueWorkers, filter_active_pods
 
 
-def node_should_run_daemon_pod(node: api.Node) -> bool:
+def node_should_run_daemon_pod(node: api.Node,
+                               ds: "api.DaemonSet | None" = None) -> bool:
     """Schedulable + Ready (the scheduler's node filter applied here
-    because daemon pods never pass through it)."""
+    because daemon pods never pass through it) + the template's
+    nodeSelector against the node's labels (ref:
+    pkg/controller/daemon/controller.go:534-535 — also what makes the
+    DaemonSetReaper's unmatchable-selector drain work)."""
     if node.spec.unschedulable:
         return False
     for cond in node.status.conditions:
         if cond.type == api.NODE_READY and cond.status != api.CONDITION_TRUE:
+            return False
+    if ds is not None:
+        sel = ds.spec.template.spec.node_selector
+        if sel and not selector_from_set(sel).matches(node.metadata.labels):
             return False
     return True
 
@@ -102,7 +110,7 @@ class DaemonSetController:
 
         nodes = self.node_informer.cache.list()
         eligible = {n.metadata.name for n in nodes
-                    if node_should_run_daemon_pod(n)}
+                    if node_should_run_daemon_pod(n, ds)}
 
         to_create: List[str] = []
         to_delete: List[api.Pod] = []

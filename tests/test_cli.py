@@ -467,6 +467,66 @@ class TestV11CommandParity:
         with pytest.raises(NF):
             client.get("replicationcontrollers", "rc1", "default")
 
+    def test_delete_rc_cascades_by_default(self, cluster):
+        """kubectl delete rc reaps (scale to 0, wait, delete) unless
+        --cascade=false (ref: delete.go:97,140 ReapResult)."""
+        registry, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc1", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"app": "w"})), "default")
+        seen = []
+        w = client.watch("replicationcontrollers", "default")
+        code, out, _ = run_cli(client, "delete", "rc", "rc1")
+        assert code == 0 and "deleted" in out
+        while True:
+            ev = w.next(timeout=1)
+            if ev is None:
+                break
+            seen.append((ev.type, ev.object.spec.replicas))
+        w.stop()
+        assert ("MODIFIED", 0) in seen  # the reaper's scale-to-0 write
+        assert seen[-1][0] == "DELETED"
+
+    def test_delete_rc_no_cascade_skips_reap(self, cluster):
+        registry, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc1", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"app": "w"})), "default")
+        seen = []
+        w = client.watch("replicationcontrollers", "default")
+        code, _, _ = run_cli(client, "delete", "rc", "rc1",
+                             "--cascade", "false")
+        assert code == 0
+        while True:
+            ev = w.next(timeout=1)
+            if ev is None:
+                break
+            seen.append((ev.type, ev.object.spec.replicas))
+        w.stop()
+        # straight delete: no scale-to-0 write ever lands
+        assert all(t != "MODIFIED" for t, _r in seen)
+        assert seen[-1] == ("DELETED", 3)
+
+    def test_delete_job_reaps_pods(self, cluster):
+        """JobReaper.Stop: parallelism to 0, dead pods removed, then
+        the job itself."""
+        registry, client = cluster
+        client.create("jobs", api.Job(
+            metadata=api.ObjectMeta(name="j1", namespace="default"),
+            spec=api.JobSpec(parallelism=2, completions=2,
+                             selector={"job": "j1"})), "default")
+        client.create("pods", mkpod("j1-a", {"job": "j1"},
+                                    phase="Succeeded"), "default")
+        code, out, _ = run_cli(client, "delete", "jobs", "j1")
+        assert code == 0 and "jobs/j1 deleted" in out
+        from kubernetes_tpu.core.errors import NotFound as NF
+        with pytest.raises(NF):
+            client.get("jobs", "j1", "default")
+        assert all(p.metadata.labels.get("job") != "j1"
+                   for p in client.list("pods", "default")[0])
+
     def test_edit_roundtrip(self, cluster, tmp_path, monkeypatch):
         _, client = cluster
         client.create("pods", mkpod("web"), "default")

@@ -120,6 +120,43 @@ class TestDaemonSetController:
         finally:
             ctrl.stop()
 
+    def test_template_node_selector_gates_eligibility(self, cluster):
+        """ref: pkg/controller/daemon/controller.go:534-535 — the
+        template's nodeSelector filters eligible nodes; retargeting to
+        an unmatchable selector drains every daemon pod (the
+        DaemonSetReaper's cascade-delete mechanism)."""
+        from dataclasses import replace
+        registry, client = cluster
+        ssd = ready_node("ssd-node")
+        ssd.metadata.labels["disk"] = "ssd"
+        client.create("nodes", ssd)
+        client.create("nodes", ready_node("hdd-node"))
+        ctrl = DaemonSetController(client).run()
+        try:
+            tpl = template({"ds": "agent"})
+            tpl.spec.node_selector = {"disk": "ssd"}
+            client.create("daemonsets", api.DaemonSet(
+                metadata=api.ObjectMeta(name="agent", namespace="default"),
+                spec=api.DaemonSetSpec(selector={"ds": "agent"},
+                                       template=tpl)), "default")
+            assert wait_until(lambda: {p.spec.node_name
+                                       for p in pods_of(client)}
+                              == {"ssd-node"})
+            # retarget to an unmatchable selector: every pod drains
+            fresh = client.get("daemonsets", "agent", "default")
+            dead_tpl = replace(fresh.spec.template, spec=replace(
+                fresh.spec.template.spec,
+                node_selector={"no-such-label": "x"}))
+            client.update("daemonsets", replace(
+                fresh, spec=replace(fresh.spec, template=dead_tpl)),
+                "default")
+            assert wait_until(lambda: not pods_of(client))
+            assert wait_until(lambda: client.get(
+                "daemonsets", "agent",
+                "default").status.current_number_scheduled == 0)
+        finally:
+            ctrl.stop()
+
 
 class TestDeploymentController:
     def test_rollout_creates_hashed_rc_and_scales(self, cluster):

@@ -1182,8 +1182,9 @@ class ApiServer:
             timeout = float(raw)
         except ValueError:
             raise BadRequest("timeoutSeconds: not a number")
-        if not math.isfinite(timeout):
-            raise BadRequest("timeoutSeconds: not a finite number")
+        if not math.isfinite(timeout) or timeout < 0:
+            raise BadRequest(
+                "timeoutSeconds: must be a non-negative finite number")
         return time.monotonic() + timeout
 
     @staticmethod

@@ -29,6 +29,17 @@ VERSION = "v1.1.0-tpu"  # capability parity line (pkg/version/base.go)
 LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
 
 
+def _parse_bool(v: str) -> bool:
+    """strconv.ParseBool's accepted spellings; anything else errors
+    (argparse surfaces the ValueError as a usage error)."""
+    low = v.lower()
+    if low in ("1", "t", "true"):
+        return True
+    if low in ("0", "f", "false"):
+        return False
+    raise ValueError(f"invalid boolean value {v!r}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kubectl",
@@ -72,9 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
     # (pods then terminate with their own spec grace period)
     rm.add_argument("--grace-period", type=int, default=-1)
     # ref: delete.go:97 — cascade reaps managed pods first (stop.go
-    # ReaperFor); --cascade=false deletes only the object itself
-    rm.add_argument("--cascade", default=True,
-                    type=lambda v: v.lower() not in ("false", "0", "no"))
+    # ReaperFor); --cascade=false deletes only the object itself.
+    # Strict bool parse like strconv.ParseBool: a typo must error, not
+    # silently cascade.
+    rm.add_argument("--cascade", default=True, type=_parse_bool)
 
     sc = sub.add_parser("scale", help="set a new size for a controller")
     sc.add_argument("args", nargs="+")
@@ -731,8 +743,47 @@ class Kubectl:
         status.replicas; Jobs scale parallelism to 0, wait on
         status.active, then delete their (dead) pods; DaemonSets
         retarget to an unmatchable node selector and wait for the
-        controller to kill every daemon pod."""
+        controller to kill every daemon pod. A drain that never
+        completes raises instead of deleting (the reference reapers
+        return the wait error) — deleting anyway would orphan the
+        pods silently. A target that vanishes mid-drain counts as
+        reaped (a concurrent delete won the race)."""
         deadline = time.time() + 30
+
+        def _drained(check) -> bool:
+            """Poll until check(current) or deadline; NotFound = gone =
+            drained."""
+            while time.time() < deadline:
+                try:
+                    if check(self.client.get(resource, name, target_ns)):
+                        return True
+                except NotFound:
+                    return True
+                time.sleep(0.1)
+            try:
+                return check(self.client.get(resource, name, target_ns))
+            except NotFound:
+                return True
+
+        try:
+            drained = self._reap_drain(resource, name, target_ns,
+                                       grace, _drained)
+        except NotFound:
+            return  # already gone: a concurrent deleter won the race
+        if not drained:
+            raise ApiError(
+                f"timed out waiting for {resource}/{name} to drain; "
+                f"not deleting (pods would be orphaned — use "
+                f"--cascade=false to delete the object anyway)")
+        try:
+            self.client.delete(resource, name, target_ns,
+                               grace_period_seconds=grace)
+        except NotFound:
+            pass  # a concurrent deleter finished first: outcome reached
+
+    def _reap_drain(self, resource, name, target_ns, grace,
+                    _drained) -> bool:
+        drained = True
         if resource == "replicationcontrollers":
             rc = self.client.get(resource, name, target_ns)
             # never mutate a cached object: stored objects are frozen
@@ -743,26 +794,18 @@ class Kubectl:
             # wait for the manager to observe the scale-down before
             # deleting (stop.go's reaper does exactly this) — delete
             # racing the controller's informer would orphan the pods
-            while time.time() < deadline:
-                live = self.client.get(resource, name, target_ns)
-                if live.status.replicas == 0:
-                    break
-                time.sleep(0.1)
+            drained = _drained(lambda live: live.status.replicas == 0)
         elif resource == "jobs":
             job = self.client.get(resource, name, target_ns)
             self.client.update(
                 resource,
                 replace(job, spec=replace(job.spec, parallelism=0)),
                 target_ns)
-            while time.time() < deadline:
-                if self.client.get(resource, name,
-                                   target_ns).status.active == 0:
-                    break
-                time.sleep(0.1)
+            drained = _drained(lambda live: live.status.active == 0)
             # only dead pods remain; remove them (JobReaper.Stop)
             sel = ",".join(f"{k}={v}"
                            for k, v in sorted(job.spec.selector.items()))
-            if sel:
+            if drained and sel:
                 pods, _ = self.client.list("pods", target_ns, sel)
                 for p in pods:
                     try:
@@ -785,14 +828,10 @@ class Kubectl:
                     template=replace(tpl, spec=replace(
                         tpl.spec, node_selector=unmatchable)))),
                 target_ns)
-            while time.time() < deadline:
-                st = self.client.get(resource, name, target_ns).status
-                if st.current_number_scheduled + st.number_misscheduled \
-                        == 0:
-                    break
-                time.sleep(0.1)
-        self.client.delete(resource, name, target_ns,
-                           grace_period_seconds=grace)
+            drained = _drained(
+                lambda live: live.status.current_number_scheduled
+                + live.status.number_misscheduled == 0)
+        return drained
 
     def stop(self, ns, args, filename="") -> None:
         """kubectl stop: graceful shutdown — controllers drain before

@@ -66,6 +66,14 @@ class Client:
     def update_status(self, resource: str, obj: Any, namespace: str = "") -> Any:
         raise NotImplementedError
 
+    def patch(self, resource: str, name: str, patch_body: Any,
+              namespace: str = "",
+              patch_type: str = "application/strategic-merge-patch+json"
+              ) -> Any:
+        """Server-side PATCH with the reference's three content types
+        (ref: client/unversioned request.go Patch)."""
+        raise NotImplementedError
+
     def get_scale(self, resource: str, name: str,
                   namespace: str = "") -> Any:
         """GET .../{name}/scale (ref: client/unversioned Scales getter)."""
@@ -160,6 +168,11 @@ class InProcClient(Client):
 
     def update_status_batch(self, resource, objs, namespace=""):
         return self.registry.update_status_batch(resource, objs, namespace)
+
+    def patch(self, resource, name, patch_body, namespace="",
+              patch_type="application/strategic-merge-patch+json"):
+        return self.registry.patch(resource, name, patch_body, namespace,
+                                   patch_type=patch_type)
 
     def get_scale(self, resource, name, namespace=""):
         return self.registry.get_scale(resource, name, namespace)
@@ -351,13 +364,14 @@ class HttpClient(Client):
         return url
 
     def _do(self, method: str, url: str, body: Any = None,
-            stream: bool = False, raw_body: Optional[bytes] = None):
+            stream: bool = False, raw_body: Optional[bytes] = None,
+            content_type: str = "application/json"):
         data = raw_body
         headers = {"Accept": "application/json", **self.headers}
         if body is not None:
             data = self.scheme.encode(body).encode()
         if data is not None:
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = content_type
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
         try:
@@ -430,6 +444,14 @@ class HttpClient(Client):
         ns = namespace or obj.metadata.namespace
         return self._decode(self._do(
             "PUT", self._url(resource, ns, obj.metadata.name, "status"), obj))
+
+    def patch(self, resource, name, patch_body, namespace="",
+              patch_type="application/strategic-merge-patch+json"):
+        ns = namespace or "default"
+        raw = json.dumps(patch_body).encode()
+        return self._decode(self._do(
+            "PATCH", self._url(resource, ns, name), raw_body=raw,
+            content_type=patch_type))
 
     def get_scale(self, resource, name, namespace=""):
         ns = namespace or "default"

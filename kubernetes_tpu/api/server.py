@@ -604,6 +604,25 @@ class ApiServer:
                 updated = self.registry.update(resource, obj, namespace)
             return self._send_json(h, 200, self.scheme.encode_dict(updated))
 
+        if method == "PATCH":
+            if not name:
+                raise MethodNotSupported("PATCH requires a resource name")
+            if sub:
+                raise MethodNotSupported(
+                    "PATCH on subresources is not supported")
+            # the patch TYPE rides the Content-Type (ref:
+            # pkg/api/types.go:2065 PatchType); absent defaults to
+            # strategic like kubectl's own patches
+            ctype = (h.headers.get("Content-Type", "")
+                     .split(";")[0].strip().lower()
+                     or Registry.PATCH_STRATEGIC)
+            if ctype == "application/json":
+                ctype = Registry.PATCH_STRATEGIC
+            body = self._read_body(h)
+            patched = self.registry.patch(resource, name, body, namespace,
+                                          patch_type=ctype)
+            return self._send_json(h, 200, self.scheme.encode_dict(patched))
+
         if method == "DELETE":
             if not name:
                 deleted = self.registry.delete_collection(

@@ -246,19 +246,6 @@ def _find_kv_split(args: List[str]):
     return targets, args[kv_start:]
 
 
-def _apply_null_deletes(patch, merged) -> None:
-    """Strategic-merge patch semantics: an explicit null in the patch
-    DELETES the key (patch.go); merge_maps (built for 3-way apply,
-    where deletion is original-vs-modified) assigns the None through,
-    so the patch verb strips those keys afterwards. List entries are
-    replaced wholesale by merge keys and need no null handling here."""
-    for key, val in patch.items():
-        if val is None:
-            merged.pop(key, None)
-        elif isinstance(val, dict) and isinstance(merged.get(key), dict):
-            _apply_null_deletes(val, merged[key])
-
-
 class Kubectl:
     def __init__(self, client, out=None, err=None,
                  scheme=default_scheme):
@@ -709,12 +696,11 @@ class Kubectl:
 
     def patch(self, ns, args, patch_json) -> None:
         """kubectl patch: strategic-merge a JSON fragment onto the live
-        object (ref: cmd/patch.go; patch semantics from
-        pkg/util/strategicpatch — map-lists merge by key, null
-        deletes)."""
+        object SERVER-SIDE (ref: cmd/patch.go — the CLI sends the raw
+        patch with the strategic content type and the apiserver's patch
+        handler does the merge + optimistic-concurrency retry)."""
         import json as jsonlib
 
-        from ..utils.strategicpatch import merge_maps
         resource, name = parse_resource_args(args)[0]
         try:
             patch = jsonlib.loads(patch_json)
@@ -722,14 +708,7 @@ class Kubectl:
             raise ApiError(f"invalid patch: {e}")
         if not isinstance(patch, dict):
             raise ApiError("patch must be a JSON object")
-        live = self.client.get(resource, name, ns)
-        merged = merge_maps({}, patch, self.scheme.encode_dict(live))
-        _apply_null_deletes(patch, merged)
-        obj = self.scheme.decode_dict(merged)
-        # keep the live concurrency token: a conflicting writer between
-        # our read and write must surface as 409
-        obj.metadata.resource_version = live.metadata.resource_version
-        self.client.update(resource, obj, ns)
+        self.client.patch(resource, name, patch, ns)
         self.out.write(f"{resource}/{name} patched\n")
 
     # kinds with a reaper (ref: pkg/kubectl/stop.go ReaperFor) — the

@@ -2,6 +2,7 @@
 streaming, reflector/FIFO/informer (ref test style: pkg/apiserver tests with
 in-process servers, pkg/client/cache/reflector_test.go)."""
 
+import json
 import threading
 import time
 
@@ -351,6 +352,106 @@ def test_http_watch_with_resource_version(server):
     ev = w.next(timeout=5)
     assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "late"
     w.stop()
+
+
+def test_patch_three_content_types(server):
+    """Server-side PATCH (ref: resthandler.go patchResource +
+    pkg/api/types.go:2065 PatchType): strategic merges map-lists by
+    key with null-deletes, merge-patch replaces lists wholesale,
+    json-patch evaluates RFC 6902 ops — all over the wire."""
+    import urllib.request
+
+    def patch(name, body, ctype):
+        req = urllib.request.Request(
+            server.url + f"/api/v1/namespaces/default/pods/{name}",
+            data=json.dumps(body).encode(), method="PATCH",
+            headers={"Content-Type": ctype})
+        return json.loads(urllib.request.urlopen(req, timeout=5).read())
+
+    c = HttpClient(server.url)
+    pod = mk_pod("p1", labels={"app": "web", "tier": "x"})
+    pod.spec.containers = [api.Container(name="c1", image="img:v1"),
+                           api.Container(name="c2", image="other")]
+    c.create("pods", pod)
+
+    # strategic: containers merge by name, null deletes the label
+    out = patch("p1", {"metadata": {"labels": {"tier": None,
+                                               "env": "prod"}},
+                       "spec": {"containers": [
+                           {"name": "c1", "image": "img:v2"}]}},
+                "application/strategic-merge-patch+json")
+    assert out["metadata"]["labels"] == {"app": "web", "env": "prod"}
+    imgs = {ct["name"]: ct["image"] for ct in out["spec"]["containers"]}
+    assert imgs == {"c1": "img:v2", "c2": "other"}  # c2 survived
+
+    # merge-patch: the containers list REPLACES wholesale (RFC 7386)
+    out = patch("p1", {"spec": {"containers": [
+        {"name": "only", "image": "solo"}]}},
+        "application/merge-patch+json")
+    assert [ct["name"] for ct in out["spec"]["containers"]] == ["only"]
+
+    # json-patch: test + replace ops; a failing test rejects
+    out = patch("p1", [
+        {"op": "test", "path": "/metadata/labels/app", "value": "web"},
+        {"op": "replace", "path": "/spec/containers/0/image",
+         "value": "img:v3"},
+        {"op": "remove", "path": "/metadata/labels/env"},
+    ], "application/json-patch+json")
+    assert out["spec"]["containers"][0]["image"] == "img:v3"
+    assert "env" not in out["metadata"]["labels"]
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        patch("p1", [{"op": "test", "path": "/metadata/labels/app",
+                      "value": "nope"}], "application/json-patch+json")
+    assert e.value.code == 400
+    # concurrency: the patched object's rv moved every write
+    live = c.get("pods", "p1")
+    assert live.spec.containers[0].image == "img:v3"
+
+
+def test_patch_directives_and_bad_pointers(server):
+    """patch.go's $patch directives and RFC 6901's strict array
+    tokens: a keyed element with $patch: delete removes its
+    counterpart; negative / missing-path pointers reject with 400."""
+    import urllib.error
+    import urllib.request
+
+    def patch(body, ctype, expect_error=False):
+        req = urllib.request.Request(
+            server.url + "/api/v1/namespaces/default/pods/pd",
+            data=json.dumps(body).encode(), method="PATCH",
+            headers={"Content-Type": ctype})
+        try:
+            return json.loads(urllib.request.urlopen(req,
+                                                     timeout=5).read())
+        except urllib.error.HTTPError as e:
+            assert expect_error, e.read()
+            return e.code
+
+    c = HttpClient(server.url)
+    pod = mk_pod("pd")
+    pod.spec.containers = [api.Container(name="c1", image="a"),
+                           api.Container(name="c2", image="b")]
+    c.create("pods", pod)
+    out = patch({"spec": {"containers": [
+        {"name": "c1", "$patch": "delete"}]}},
+        "application/strategic-merge-patch+json")
+    assert [ct["name"] for ct in out["spec"]["containers"]] == ["c2"]
+    assert all("$patch" not in ct for ct in out["spec"]["containers"])
+    # $patch: replace on a map replaces instead of merging
+    out = patch({"metadata": {"labels": {"$patch": "replace",
+                                         "only": "this"}}},
+                "application/strategic-merge-patch+json")
+    assert out["metadata"]["labels"] == {"only": "this"}
+    # RFC 6901 violations reject
+    assert patch([{"op": "replace", "path": "/spec/containers/-1",
+                   "value": {}}], "application/json-patch+json",
+                 expect_error=True) == 400
+    assert patch([{"op": "add", "value": {}}],
+                 "application/json-patch+json", expect_error=True) == 400
+    assert patch([{"op": "replace", "path": "/metadata/name/x",
+                   "value": 1}], "application/json-patch+json",
+                 expect_error=True) == 400
 
 
 def test_http_watch_timeout_seconds(server):

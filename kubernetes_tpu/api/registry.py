@@ -922,8 +922,15 @@ class Registry:
                         TypeError) as e:
                     raise BadRequest(f"json patch failed: {e}")
             elif patch_type == self.PATCH_MERGE:
+                if not isinstance(patch_body, dict):
+                    raise BadRequest("merge-patch body must be an object")
                 merged = json_merge_patch(wire, patch_body)
             elif patch_type == self.PATCH_STRATEGIC:
+                if not isinstance(patch_body, dict):
+                    raise BadRequest(
+                        "strategic-merge-patch body must be an object "
+                        "(json-patch op arrays need the "
+                        "application/json-patch+json content type)")
                 merged = strategic_patch(wire, patch_body)
             else:
                 raise BadRequest(

@@ -157,9 +157,9 @@ class ApiServer:
                 server.handle(self, "DELETE")
 
             def do_PATCH(self):
-                # served for the any-method proxy relay
-                # (pkg/apiserver/proxy.go:52 has no verb filter);
-                # non-proxy PATCH paths answer MethodNotSupported
+                # resource PATCH (three patch content types,
+                # resthandler.go patchResource) and the any-method
+                # proxy relay (pkg/apiserver/proxy.go:52)
                 server.handle(self, "PATCH")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
@@ -1344,7 +1344,7 @@ class ApiServer:
 
     def _send_error(self, h, err: ApiError) -> None:
         # an error can fire before a body-bearing request's body was
-        # read (e.g. PATCH to a non-proxy path -> MethodNotSupported);
+        # read (e.g. PATCH to a subresource -> MethodNotSupported);
         # leftover body bytes would desync HTTP/1.1 keep-alive framing —
         # the next request on the connection parses mid-body. Close
         # unless a body reader ran to completion (a 409 AFTER the read

@@ -49,7 +49,8 @@ class ControllerManager:
         ]
         if metrics_source is not None:
             self.controllers.append(
-                HorizontalController(client, metrics_source))
+                HorizontalController(client, metrics_source,
+                                     recorder=recorder))
         if cloud is not None:
             self.controllers.append(ServiceController(client, cloud))
             self.controllers.append(RouteController(

@@ -27,9 +27,10 @@ MetricsSource = Callable[[str, Dict[str, str]], Optional[float]]
 
 class HorizontalController:
     def __init__(self, client, metrics: MetricsSource,
-                 sync_period: float = SYNC_PERIOD):
+                 sync_period: float = SYNC_PERIOD, recorder=None):
         self.client = client
         self.metrics = metrics
+        self.recorder = recorder
         self.sync_period = sync_period
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -82,9 +83,22 @@ class HorizontalController:
                       min(hpa.spec.max_replicas, desired))
         did_scale = desired != current
         if did_scale:
-            self.client.update_scale(
-                resource, ref.name,
-                replace(scale, spec=api.ScaleSpec(replicas=desired)), ns)
+            try:
+                self.client.update_scale(
+                    resource, ref.name,
+                    replace(scale, spec=api.ScaleSpec(replicas=desired)),
+                    ns)
+            except Exception as e:
+                # ref: horizontal.go:145 — a failed rescale records and
+                # propagates (the reconcile loop isolates per HPA)
+                if self.recorder:
+                    self.recorder.eventf(
+                        hpa, "Warning", "FailedRescale",
+                        "New size: %d; error: %s", desired, e)
+                raise
+            if self.recorder:
+                self.recorder.eventf(hpa, "Normal", "SuccessfulRescale",
+                                     "New size: %d", desired)
         self._update_status(hpa, current, desired, utilization, did_scale)
         return did_scale
 

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import math
 import threading
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -215,6 +217,16 @@ class IncrementalEncoder:
         self.node_pods: Dict[int, List[str]] = {}
         self.unknown_node_pods: Dict[str, Set[str]] = {}
         self.groups: Dict[object, _Group] = {}
+        # delete tombstones, keyed (ns/name, uid) like the modeler's
+        # (modeler.py _forgotten): a DELETED event that lands BEFORE the
+        # committer's assume for the same pod must win, or the assume
+        # re-adds a ledger record no future event will ever remove —
+        # phantom capacity and an entry leaked for the process lifetime
+        # (the 5k-node soak caught ~1-in-54k churned pods doing exactly
+        # this under heavy GIL contention). uid-scoped so a recreated
+        # same-name pod assumes normally.
+        self._del_tombstones: Dict[Tuple[str, str], float] = {}
+        self._del_order: deque = deque()
 
         # ---- device-carry bookkeeping (the pipelined scheduler chains
         # tile k+1's scan off tile k's on-device final state; that's
@@ -238,17 +250,46 @@ class IncrementalEncoder:
         with self._lock:
             self._pod_upsert(new)
 
+    _DEL_TOMBSTONE_TTL = 30.0  # the modeler's ASSUMED_POD_TTL window
+
     def on_pod_delete(self, pod: api.Pod) -> None:
         with self._lock:
             key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            now = time.monotonic()
+            tkey = (key, pod.metadata.uid)
+            self._del_tombstones[tkey] = now
+            self._del_order.append((now, tkey))
+            ttl = self._DEL_TOMBSTONE_TTL
+            order = self._del_order
+            while order and now - order[0][0] > ttl:
+                ts, k = order.popleft()
+                if self._del_tombstones.get(k) == ts:
+                    del self._del_tombstones[k]
             rec = self.pods.pop(key, None)
             if rec is not None:
                 self._remove_record(key, rec)
 
+    def _deleted_recently(self, key: str, uid: str) -> bool:
+        """Caller holds the lock. True while the pod's DELETED event is
+        within the tombstone window — an assume arriving now lost the
+        race and must not resurrect the ledger entry."""
+        ts = self._del_tombstones.get((key, uid))
+        return (ts is not None
+                and time.monotonic() - ts <= self._DEL_TOMBSTONE_TTL)
+
     def assume(self, pod: api.Pod) -> None:
         """Count a just-bound pod before the watch confirms it (the
-        modeler.AssumePod moment, modeler.go:113)."""
-        self.on_pod_add(pod)
+        modeler.AssumePod moment, modeler.go:113). A pod whose DELETED
+        event already landed is NOT resurrected (same rule as the
+        modeler's forget tombstones)."""
+        with self._lock:
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            if self._deleted_recently(key, pod.metadata.uid):
+                # a device carry may have counted this pod: re-encode
+                # from host truth rather than chaining
+                self.state_epoch += 1
+                return
+            self._pod_upsert(pod)
 
     def assume_assigned(self, enc: EncodeResult, pods: List[api.Pod],
                         assigned: np.ndarray) -> None:
@@ -303,6 +344,16 @@ class IncrementalEncoder:
                 pod = pods[j]
                 meta = pod.metadata
                 key = f"{meta.namespace}/{meta.name}"
+                if self._del_tombstones and \
+                        self._deleted_recently(key, meta.uid):
+                    # the pod was bound, confirmed AND deleted before
+                    # this finalize ran — re-adding it would leak a
+                    # ledger record no future event removes. The device
+                    # carry counted the pod, the host (correctly) does
+                    # not: break the chain so the next tile re-encodes
+                    # from host truth.
+                    self.state_epoch += 1
+                    continue
                 if (not fast_ok or ports_any_l[j] or disks_any_l[j]
                         or key in ledger
                         or pod.status.phase in (api.POD_SUCCEEDED,

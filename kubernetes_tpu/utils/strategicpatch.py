@@ -129,20 +129,45 @@ def strategic_patch(current: Dict, patch: Dict) -> Dict:
             # directive markers strip either way
             out[key] = strategic_patch(
                 cval if isinstance(cval, dict) else {}, pval)
+        elif isinstance(pval, list) and _is_map_list(pval):
+            # merge against [] when the live key is absent/non-list so
+            # $patch markers strip either way
+            out[key] = _merge_lists_two_way(
+                key, pval, cval if isinstance(cval, list) else [])
         elif isinstance(pval, list) and isinstance(cval, list) \
-                and (_is_map_list(pval) or _is_map_list(cval)):
+                and _is_map_list(cval):
             out[key] = _merge_lists_two_way(key, pval, cval)
         else:
             out[key] = pval
     return out
 
 
+def _strip_directives(el: Any) -> Any:
+    if isinstance(el, dict):
+        return {k: v for k, v in el.items() if k != _DIRECTIVE}
+    return el
+
+
 def _merge_lists_two_way(field: str, patch_list: List,
                          current: List) -> List:
+    # a standalone {"$patch": "replace"} element (patch.go's
+    # replace-list directive): the remaining elements ARE the new list
+    if any(isinstance(el, dict) and el.get(_DIRECTIVE) == "replace"
+           for el in patch_list):
+        out = []
+        for el in patch_list:
+            if isinstance(el, dict) and el.get(_DIRECTIVE) == "replace":
+                if len(el) == 1:
+                    continue  # the standalone marker itself
+                out.append(_strip_directives(el))
+            else:
+                out.append(_strip_directives(el))
+        return out
     mk = _merge_key_for(field, patch_list, current)
     if mk is None or any(not isinstance(el, dict) or mk not in el
                          for el in patch_list):
-        return list(patch_list)  # unkeyed patch elements: replace
+        # unkeyed patch elements: replace (markers never persist)
+        return [_strip_directives(el) for el in patch_list]
     deletes = {el[mk] for el in patch_list
                if el.get(_DIRECTIVE) == "delete"}
     patch_by = {el[mk]: el for el in patch_list
@@ -236,6 +261,9 @@ def apply_json_patch(doc: Any, ops: List[Dict]) -> Any:
                 doc = val
             elif isinstance(parent, list):
                 i = len(parent) if tok == "-" else _list_index(tok)
+                if i > len(parent):  # RFC 6902: > length is an error
+                    raise ValueError(
+                        f"add: index {i} beyond array length")
                 parent.insert(i, val)
             else:
                 parent[tok] = val
@@ -270,6 +298,9 @@ def apply_json_patch(doc: Any, ops: List[Dict]) -> Any:
                 doc = val
             elif isinstance(parent, list):
                 i = len(parent) if tok == "-" else _list_index(tok)
+                if i > len(parent):  # RFC 6902: > length is an error
+                    raise ValueError(
+                        f"{kind}: index {i} beyond array length")
                 parent.insert(i, val)
             else:
                 parent[tok] = val

@@ -452,6 +452,26 @@ def test_patch_directives_and_bad_pointers(server):
     assert patch([{"op": "replace", "path": "/metadata/name/x",
                    "value": 1}], "application/json-patch+json",
                  expect_error=True) == 400
+    # ops array under the strategic content type -> 400, not 500
+    assert patch([{"op": "add", "path": "/x", "value": 1}],
+                 "application/strategic-merge-patch+json",
+                 expect_error=True) == 400
+    # add beyond the array length -> 400 (RFC 6902)
+    assert patch([{"op": "add", "path": "/spec/containers/99",
+                   "value": {}}], "application/json-patch+json",
+                 expect_error=True) == 400
+    # $patch: delete against an ABSENT list never persists the marker
+    out = patch({"spec": {"volumes": [
+        {"name": "ghost", "$patch": "delete"}]}},
+        "application/strategic-merge-patch+json")
+    assert "volumes" not in out.get("spec", {}) \
+        or all("$patch" not in v for v in out["spec"]["volumes"])
+    # the standalone replace-list directive replaces wholesale
+    out = patch({"spec": {"containers": [
+        {"$patch": "replace"}, {"name": "solo", "image": "z"}]}},
+        "application/strategic-merge-patch+json")
+    assert [ct["name"] for ct in out["spec"]["containers"]] == ["solo"]
+    assert all("$patch" not in ct for ct in out["spec"]["containers"])
 
 
 def test_http_watch_timeout_seconds(server):

@@ -453,3 +453,40 @@ def test_node_slot_reclaim_under_name_churn():
     assert hosts_inc == hosts_full
     assert all(h is not None for h in hosts_inc)
     del slots_before
+
+
+def test_delete_racing_ahead_of_assume_does_not_leak_ledger():
+    """The 5k soak's leak: a pod bound, confirmed AND deleted before the
+    committer's assume runs — the DELETED event pops nothing (no record
+    yet) and the late assume used to re-add a ledger record no future
+    event would ever remove. The delete tombstone must win (the
+    modeler's forget-tombstone rule applied to the device ledger), for
+    both the vectorized assume_assigned path and the per-pod assume."""
+    from kubernetes_tpu.sched.device.engine import BatchEngine
+
+    inc = IncrementalEncoder()
+    feed(inc, [mk_node("n-0"), mk_node("n-1")], [])
+    victim = mk_pod("victim", cpu=100, phase="Pending", rv="5")
+    victim.metadata.uid = "u-victim"
+    enc = inc.encode_tile([victim], [], [])
+    assigned, _ = BatchEngine().run_chunked(enc, 64)
+
+    # the DELETED event lands FIRST (confirm reflector raced ahead)
+    inc.on_pod_delete(victim)
+    before_epoch = inc.state_epoch
+    inc.assume_assigned(enc, [victim], assigned)
+    assert "default/victim" not in inc.pods, "ledger entry resurrected"
+    assert inc.state_epoch > before_epoch, \
+        "carry chain must break: the device counted the deleted pod"
+
+    # per-pod assume path obeys the same tombstone (same uid)
+    late = mk_pod("victim", node="n-0", rv="6")
+    late.metadata.uid = "u-victim"
+    inc.assume(late)
+    assert "default/victim" not in inc.pods
+
+    # a RECREATED same-name pod (new uid) assumes normally
+    reborn = mk_pod("victim", node="n-0", rv="7")
+    reborn.metadata.uid = "u-reborn"
+    inc.assume(reborn)
+    assert "default/victim" in inc.pods

@@ -388,6 +388,34 @@ class TestHorizontalController:
         assert status.last_scale_time
 
 
+    def test_rescale_records_events(self, cluster):
+        """ref: horizontal.go:148 — a scale records SuccessfulRescale
+        with the new size."""
+        from kubernetes_tpu.api.record import FakeRecorder
+        registry, client = cluster
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=2, selector={"app": "web"},
+                template=template({"app": "web"}))), "default")
+        client.create("horizontalpodautoscalers",
+                      api.HorizontalPodAutoscaler(
+                          metadata=api.ObjectMeta(name="h",
+                                                  namespace="default"),
+                          spec=api.HorizontalPodAutoscalerSpec(
+                              scale_ref=api.SubresourceReference(
+                                  kind="ReplicationController",
+                                  name="web", namespace="default"),
+                              min_replicas=1, max_replicas=5,
+                              cpu_utilization_target_percentage=90)),
+                      "default")
+        rec = FakeRecorder()
+        ctrl = HorizontalController(client, lambda ns, sel: 180.0,
+                                    recorder=rec)
+        assert ctrl.reconcile_once() == 1
+        assert any(e.startswith("Normal SuccessfulRescale New size: 4")
+                   for e in rec.events), rec.events
+
     def test_scales_deployment_through_scale_subresource(self, cluster):
         """ref: horizontal.go reconcileAutoscaler — the HPA reads and
         writes the extensions Scale subresource, for Deployments too."""

@@ -25,6 +25,7 @@ from dataclasses import replace
 from typing import Dict, List, Optional
 
 from ..api.cache import Informer, meta_namespace_key
+from ..api.client import confirm_pod_deletion
 from ..core import types as api
 from ..core.errors import NotFound
 from ..core.quantity import Quantity, parse_quantity
@@ -255,10 +256,25 @@ class HollowKubelet:
         self.status_manager.set_pod_status(pod, status)
 
     def _on_pod_add(self, pod: api.Pod) -> None:
+        if pod.metadata.deletion_timestamp is not None:
+            self._confirm_deletion(pod)
+            return
         self._sync_pod(pod)
 
     def _on_pod_update(self, old: api.Pod, pod: api.Pod) -> None:
+        if pod.metadata.deletion_timestamp is not None:
+            self._confirm_deletion(pod)
+            return
         self._sync_pod(pod)
+
+    def _confirm_deletion(self, pod: api.Pod) -> None:
+        """Graceful deletion's node half, hollow style: no real
+        containers to drain, so kill the fake pod and confirm with the
+        grace-0 uid-guarded delete immediately (the real kubelet's
+        handle_pod_update drain, minus the PreStop wait)."""
+        self.runtime.kill_pod(pod)
+        self.status_manager.forget(pod)
+        confirm_pod_deletion(self.client, pod)
 
     def _on_pod_delete(self, pod: api.Pod) -> None:
         self.runtime.kill_pod(pod)

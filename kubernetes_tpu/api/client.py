@@ -604,3 +604,41 @@ class HttpClient(Client):
             return resp.read()
         finally:
             resp.close()
+
+
+def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 5,
+                         backoff_s: float = 0.5) -> None:
+    """The grace-0, uid-guarded delete that completes a graceful pod
+    deletion from the node side (real kubelet, hollow kubelet, fleet).
+    NotFound/Conflict are terminal — the pod is gone, or a same-name
+    replacement took the name; transient API errors retry off-thread
+    with backoff, because a marked pod emits no further watch events
+    and a dropped confirm would leave it Terminating forever."""
+    import time as _time
+
+    from ..core.errors import Conflict, NotFound
+
+    def attempt() -> bool:
+        try:
+            client.delete("pods", pod.metadata.name,
+                          pod.metadata.namespace,
+                          grace_period_seconds=0, uid=pod.metadata.uid)
+        except (NotFound, Conflict):
+            pass  # outcome reached (gone or replaced)
+        except Exception:
+            return False
+        return True
+
+    if attempt():
+        return
+
+    def retry_loop():
+        delay = backoff_s
+        for _ in range(attempts - 1):
+            _time.sleep(delay)
+            if attempt():
+                return
+            delay = min(delay * 2, 5.0)
+
+    threading.Thread(target=retry_loop, daemon=True,
+                     name=f"confirm-del-{pod.metadata.name}").start()

@@ -361,19 +361,15 @@ class Kubelet:
                 self._tearing_down.discard(uid)
         if confirm_api_delete:
             # graceful deletion's second half: containers are down, so
-            # confirm with a grace-0 delete that actually removes the
-            # marked pod from storage (the reference's terminated-pod
-            # api delete; rest/delete.go admits grace 0 immediately)
-            try:
-                # uid precondition: a same-name pod recreated while the
-                # PreStop drain ran must never be collateral (the
-                # reference confirms with Preconditions.UID too)
-                self.client.delete("pods", pod.metadata.name,
-                                   pod.metadata.namespace,
-                                   grace_period_seconds=0,
-                                   uid=pod.metadata.uid)
-            except Exception:
-                pass  # already gone, or the next sync re-observes
+            # confirm with a grace-0, uid-guarded delete that actually
+            # removes the marked pod from storage (the reference's
+            # terminated-pod api delete; the uid precondition keeps a
+            # same-name pod recreated during the PreStop drain from
+            # being collateral). Transient API errors retry off-thread
+            # — a marked pod emits no further watch events to re-drive
+            # a dropped confirm.
+            from ..api.client import confirm_pod_deletion
+            confirm_pod_deletion(self.client, pod)
 
     def _tear_down_pod_inner(self, pod: api.Pod) -> None:
         uid = pod.metadata.uid

@@ -23,6 +23,7 @@ import threading
 from dataclasses import replace
 from typing import Dict, List, Optional
 
+from ..agents.hollow_node import confirm_pod_deletion
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
 from ..core.errors import ApiError, Conflict, NotFound
@@ -128,6 +129,15 @@ class HollowFleet:
     def _on_pod(self, pod: api.Pod) -> None:
         node = pod.spec.node_name
         if not node or not node.startswith(self.name_prefix):
+            return
+        if pod.metadata.deletion_timestamp is not None:
+            # graceful deletion's node half (hollow: nothing to drain):
+            # confirm with the grace-0 uid-guarded delete so marked
+            # pods terminate instead of sitting Terminating forever
+            # (transient failures retry off-thread — no further watch
+            # event will re-drive a marked pod)
+            self._on_pod_delete(pod)
+            confirm_pod_deletion(self.client, pod)
             return
         if pod.status.phase in ("Running", "Succeeded", "Failed"):
             return

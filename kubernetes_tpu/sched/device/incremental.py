@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ...core import types as api
+from ..modeler import ASSUMED_POD_TTL
 from ..predicates import get_resource_request
 from ..priorities import get_nonzero_requests
 from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
@@ -250,7 +251,9 @@ class IncrementalEncoder:
         with self._lock:
             self._pod_upsert(new)
 
-    _DEL_TOMBSTONE_TTL = 30.0  # the modeler's ASSUMED_POD_TTL window
+    # the SAME window as the modeler's forget tombstones — the two
+    # solve one race at two ledgers and must not drift apart
+    _DEL_TOMBSTONE_TTL = ASSUMED_POD_TTL
 
     def on_pod_delete(self, pod: api.Pod) -> None:
         with self._lock:

@@ -154,15 +154,12 @@ def _merge_lists_two_way(field: str, patch_list: List,
     # replace-list directive): the remaining elements ARE the new list
     if any(isinstance(el, dict) and el.get(_DIRECTIVE) == "replace"
            for el in patch_list):
-        out = []
-        for el in patch_list:
-            if isinstance(el, dict) and el.get(_DIRECTIVE) == "replace":
-                if len(el) == 1:
-                    continue  # the standalone marker itself
-                out.append(_strip_directives(el))
-            else:
-                out.append(_strip_directives(el))
-        return out
+        # the remaining (marker-stripped) elements ARE the new list;
+        # the standalone {"$patch": "replace"} element itself drops
+        return [_strip_directives(el) for el in patch_list
+                if not (isinstance(el, dict)
+                        and el.get(_DIRECTIVE) == "replace"
+                        and len(el) == 1)]
     mk = _merge_key_for(field, patch_list, current)
     if mk is None or any(not isinstance(el, dict) or mk not in el
                          for el in patch_list):

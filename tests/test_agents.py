@@ -148,6 +148,37 @@ class TestHollowNode:
         finally:
             kubelet.stop()
 
+    def test_graceful_deletion_confirmed(self, cluster):
+        """The hollow node plays the real kubelet's graceful-deletion
+        half: a marked pod (deletionTimestamp) is killed and confirmed
+        with a grace-0 uid-guarded delete, so it terminates instead of
+        sitting Terminating forever."""
+        from kubernetes_tpu.core.errors import NotFound as NF
+        registry, client = cluster
+        runtime = FakeRuntime()
+        kubelet = HollowKubelet(client, "hn-0", runtime=runtime,
+                                heartbeat_interval=5).run()
+        try:
+            pod = pending_pod("g1")
+            pod.spec.node_name = "hn-0"
+            pod.spec.termination_grace_period_seconds = 30
+            client.create("pods", pod)
+            assert wait_until(
+                lambda: client.get("pods", "g1").status.phase == "Running")
+            marked = client.delete("pods", "g1")  # two-phase mark
+            assert marked.metadata.deletion_timestamp is not None
+
+            def gone():
+                try:
+                    client.get("pods", "g1")
+                    return False
+                except NF:
+                    return True
+            assert wait_until(gone)
+            assert runtime.running_pods() == []
+        finally:
+            kubelet.stop()
+
     def test_other_nodes_pods_ignored(self, cluster):
         _, client = cluster
         kubelet = HollowKubelet(client, "hn-0", heartbeat_interval=5).run()

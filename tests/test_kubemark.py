@@ -35,6 +35,39 @@ def test_fleet_registers_and_heartbeats():
         fleet.stop()
 
 
+def test_fleet_confirms_graceful_deletion():
+    """The fleet plays the kubelet's graceful-deletion half for its
+    hollow nodes: a marked pod gets the grace-0 uid-guarded confirm."""
+    from kubernetes_tpu.core import types as api
+    from kubernetes_tpu.core.errors import NotFound as NF
+    registry = Registry()
+    client = InProcClient(registry)
+    fleet = HollowFleet(client, 2, heartbeat_interval=5).run()
+    try:
+        assert wait_until(lambda: len(registry.list("nodes")[0]) == 2)
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="g1", namespace="default"),
+            spec=api.PodSpec(node_name="hollow-00000",
+                             termination_grace_period_seconds=30,
+                             containers=[api.Container(name="c",
+                                                       image="i")]))
+        client.create("pods", pod)
+        assert wait_until(
+            lambda: client.get("pods", "g1").status.phase == "Running")
+        marked = client.delete("pods", "g1")
+        assert marked.metadata.deletion_timestamp is not None
+
+        def gone():
+            try:
+                client.get("pods", "g1")
+                return False
+            except NF:
+                return True
+        assert wait_until(gone)
+    finally:
+        fleet.stop()
+
+
 def test_fleet_reregisters_deleted_node():
     registry = Registry()
     client = InProcClient(registry)

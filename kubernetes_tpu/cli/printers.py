@@ -58,7 +58,13 @@ def _node_row(n: api.Node) -> List[str]:
 def _svc_row(s: api.Service) -> List[str]:
     ports = ",".join(f"{p.port}/{p.protocol}" for p in s.spec.ports) or "<none>"
     selector = ",".join(f"{k}={v}" for k, v in sorted(s.spec.selector.items())) or "<none>"
-    return [s.metadata.name, s.spec.cluster_ip or "<none>", ports, selector,
+    # EXTERNAL-IP: the LB ingress joined with explicit externalIPs
+    # (ref: resource_printer.go getServiceExternalIP shows both for
+    # LoadBalancer services)
+    external = ",".join(list(s.status.load_balancer_ingress)
+                        + list(s.spec.external_ips)) or "<none>"
+    return [s.metadata.name, s.spec.cluster_ip or "<none>", external,
+            ports, selector,
             translate_timestamp(s.metadata.creation_timestamp)]
 
 
@@ -100,8 +106,8 @@ def _ns_row(ns: api.Namespace) -> List[str]:
 COLUMNS: Dict[str, Any] = {
     "Pod": (["NAME", "READY", "STATUS", "RESTARTS", "AGE"], _pod_row),
     "Node": (["NAME", "LABELS", "STATUS", "AGE"], _node_row),
-    "Service": (["NAME", "CLUSTER_IP", "PORT(S)", "SELECTOR", "AGE"],
-                _svc_row),
+    "Service": (["NAME", "CLUSTER_IP", "EXTERNAL_IP", "PORT(S)",
+                 "SELECTOR", "AGE"], _svc_row),
     "ReplicationController": (
         ["CONTROLLER", "CONTAINER(S)", "IMAGE(S)", "SELECTOR", "REPLICAS",
          "AGE"], _rc_row),

@@ -37,7 +37,8 @@ def _camel(name: str) -> str:
     parts = name.split("_")
     out = parts[0] + "".join(p[:1].upper() + p[1:] for p in parts[1:])
     # Wire names like hostIP / podIP / clusterIP / externalID / podCIDR.
-    for suf, rep in (("Ip", "IP"), ("Id", "ID"), ("Cidr", "CIDR"), ("Uid", "UID"),
+    for suf, rep in (("Ip", "IP"), ("Ips", "IPs"), ("Id", "ID"),
+                     ("Cidr", "CIDR"), ("Uid", "UID"),
                      ("Url", "URL"), ("Tcp", "TCP"), ("Udp", "UDP"),
                      ("Pid", "PID"), ("Ipc", "IPC")):
         if out.endswith(suf):

@@ -626,6 +626,10 @@ class ServiceSpec:
     cluster_ip: str = ""
     type: str = "ClusterIP"
     session_affinity: str = "None"
+    # addresses outside the service range that also route to the
+    # endpoints (ref: pkg/api/v1/types.go:1585 ExternalIPs; the wire
+    # accepts the deprecatedPublicIPs alias — serde WIRE_ALIASES)
+    external_ips: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -1131,6 +1135,10 @@ class PersistentVolumeClaim:
 from . import serde as _serde  # noqa: E402  (needs PodSpec defined)
 
 _serde.WIRE_ALIASES[PodSpec] = {"serviceAccount": "service_account_name"}
+# `deprecatedPublicIPs` is externalIPs' pre-v1.1 spelling (ref:
+# pkg/api/v1/types.go:1587) — accepted on decode when the canonical key
+# is empty, mirrored on encode like the reference's conversion
+_serde.WIRE_ALIASES[ServiceSpec] = {"deprecatedPublicIPs": "external_ips"}
 
 
 def pod_resource_fields(pod: Pod) -> Dict[str, str]:

@@ -121,6 +121,18 @@ class IPTablesProxier:
                     port.protocol.lower(),
                     "-d", f"{cluster_ip}/32", "--dport", str(port.port),
                     "-j", svc_chain)
+                # externalIPs route like a second cluster IP (ref:
+                # proxier.go:237,327 — one DNAT entry per external IP
+                # into the same service chain)
+                for ext_ip in (svc.spec.external_ips or []):
+                    ipt.ensure_rule(
+                        TABLE_NAT, KUBE_SERVICES_CHAIN,
+                        "-m", "comment", "--comment",
+                        f"{key[0]}/{key[1]}:{port_name} external IP",
+                        "-m", port.protocol.lower(), "-p",
+                        port.protocol.lower(),
+                        "-d", f"{ext_ip}/32", "--dport", str(port.port),
+                        "-j", svc_chain)
                 if port.node_port:
                     ipt.ensure_rule(
                         TABLE_NAT, KUBE_NODEPORTS_CHAIN,

@@ -295,7 +295,15 @@ class _UdpPortProxy:
 
 class UserspaceProxier:
     """(ref: userspace/proxier.go Proxier — OnServiceUpdate opens/closes
-    port proxies; localhost ports stand in for the service portal IPs)"""
+    port proxies; localhost ports stand in for the service portal IPs).
+
+    Virtual addresses (cluster IP, spec.externalIPs) are not
+    materialized in this mode — the reference's userspace proxier
+    programs iptables portals for them (openPortal over the service +
+    public IPs); here the iptables MODE (proxy/proxier.py) carries
+    that role, including the per-externalIP DNAT entries, while this
+    mode's local stand-in ports cover the functional TCP/UDP relay
+    semantics (affinity, conntrack, node ports)."""
 
     def __init__(self, client=None,
                  balancer: Optional[RoundRobinLoadBalancer] = None,

@@ -349,6 +349,34 @@ class TestUdpProxy:
         assert any("udp" in r and "10.244.0.2:5353" in r for r in dnats)
 
 
+class TestExternalIPs:
+    def test_external_ips_route_like_a_second_cluster_ip(self):
+        """ref: proxier.go:237,327 — each externalIP gets its own DNAT
+        entry into the same service chain; the deprecatedPublicIPs wire
+        alias fills the field."""
+        from kubernetes_tpu.core.serde import from_wire
+        ipt = FakeIPTables()
+        p = IPTablesProxier(ipt)
+        s = svc("web", "10.0.0.10", port_name="http")
+        s.spec.external_ips = ["192.0.2.7"]
+        p.on_service_update([s])
+        p.on_endpoints_update([eps("web", ["10.244.0.2"], port=8080,
+                                   port_name="http")])
+        chain = service_chain("default", "web", "http")
+        jumps = ipt.list_rules(TABLE_NAT, KUBE_SERVICES_CHAIN)
+        assert any("10.0.0.10/32" in r and chain in r for r in jumps)
+        assert any("192.0.2.7/32" in r and chain in r for r in jumps)
+        # wire alias: pre-v1.1 clients send deprecatedPublicIPs
+        spec = from_wire(api.ServiceSpec,
+                         {"deprecatedPublicIPs": ["198.51.100.3"]})
+        assert spec.external_ips == ["198.51.100.3"]
+        # canonical key wins when both are present
+        both = from_wire(api.ServiceSpec,
+                         {"externalIPs": ["1.1.1.1"],
+                          "deprecatedPublicIPs": ["2.2.2.2"]})
+        assert both.external_ips == ["1.1.1.1"]
+
+
 class TestUdpConntrackSemantics:
     def test_one_way_flow_never_expires_mid_stream(self):
         """Client->backend traffic must refresh the conntrack TTL

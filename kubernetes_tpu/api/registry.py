@@ -126,6 +126,24 @@ def validate_node(node: api.Node) -> None:
     validate_object_meta(node.metadata, False)
 
 
+def validate_service(svc: api.Service) -> None:
+    """ref: pkg/api/validation ValidateService — the address-bearing
+    spec fields must parse as IPs before a controller hands them to a
+    cloud API (an invalid string would otherwise surface as an opaque
+    provider error instead of a 422 at admission time)."""
+    import socket
+    validate_object_meta(svc.metadata, True)
+    for label, ip in ([("spec.loadBalancerIP", svc.spec.load_balancer_ip)]
+                      + [("spec.externalIPs", x)
+                         for x in svc.spec.external_ips]):
+        if not ip:
+            continue
+        try:
+            socket.inet_aton(ip)
+        except OSError:
+            raise Invalid(f"{label}: {ip!r} is not a valid IP address")
+
+
 def validate_deployment(d: api.Deployment) -> None:
     """ref: pkg/apis/extensions/validation/validation.go
     ValidateRollingUpdateDeployment:258-268 — both bounds must be
@@ -287,7 +305,8 @@ _register(ResourceInfo("pods", "Pod", api.Pod, True, api.pod_resource_fields,
                        validate_pod))
 _register(ResourceInfo("nodes", "Node", api.Node, False,
                        api.node_resource_fields, validate_node))
-_register(ResourceInfo("services", "Service", api.Service, True))
+_register(ResourceInfo("services", "Service", api.Service, True,
+                       validate=validate_service))
 _register(ResourceInfo("endpoints", "Endpoints", api.Endpoints, True,
                        has_status=False))
 _register(ResourceInfo("replicationcontrollers", "ReplicationController",

@@ -328,10 +328,17 @@ class AwsLoadBalancers(LoadBalancers):
         return sg_id
 
     def ensure(self, name: str, region: str, ports: List[int],
-               hosts: List[str]) -> LoadBalancer:
+               hosts: List[str],
+               load_balancer_ip: str = "") -> LoadBalancer:
         """(aws.go:1627 — region guard, security group, one listener
         per port, register instances; idempotent re-ensure converges
-        the host set)"""
+        the host set. A requested load_balancer_ip is REJECTED: classic
+        ELBs allocate their own address, and the reference errors on a
+        requested publicIP rather than silently ignoring it.)"""
+        if load_balancer_ip:
+            raise AwsError(
+                "requested loadBalancerIP is not supported by "
+                "classic ELBs")  # aws.go EnsureTCPLoadBalancer publicIP guard
         if region != self._c.region:
             raise AwsError(
                 f"requested load balancer region {region!r} does not "

@@ -56,7 +56,12 @@ class LoadBalancers:
         raise NotImplementedError
 
     def ensure(self, name: str, region: str, ports: List[int],
-               hosts: List[str]) -> LoadBalancer:
+               hosts: List[str],
+               load_balancer_ip: str = "") -> LoadBalancer:
+        """load_balancer_ip: the service's requested address (ref:
+        EnsureTCPLoadBalancer's externalIP parameter) — honored by
+        providers that support address reservation, best-effort
+        elsewhere."""
         raise NotImplementedError
 
     def update_hosts(self, name: str, region: str,
@@ -157,14 +162,16 @@ class FakeCloudProvider(CloudProvider, Instances, LoadBalancers, Zones,
             return list(self.balancers.values())
 
     def ensure(self, name: str, region: str, ports: List[int],
-               hosts: List[str]) -> LoadBalancer:
+               hosts: List[str],
+               load_balancer_ip: str = "") -> LoadBalancer:
         self.calls.append(f"ensure-lb:{name}")
         with self._lock:
             lb = self.balancers.get((name, region))
             if lb is None:
                 self._ip_counter += 1
                 lb = LoadBalancer(name=name, region=region,
-                                  external_ip=f"35.0.0.{self._ip_counter}")
+                                  external_ip=(load_balancer_ip
+                                               or f"35.0.0.{self._ip_counter}"))
                 self.balancers[(name, region)] = lb
             lb.ports = list(ports)
             lb.hosts = list(hosts)

@@ -241,10 +241,13 @@ class GceLoadBalancers(LoadBalancers):
                 for r in data.get("items", [])]
 
     def ensure(self, name: str, region: str, ports: List[int],
-               hosts: List[str]) -> LoadBalancer:
+               hosts: List[str],
+               load_balancer_ip: str = "") -> LoadBalancer:
         """(gce.go:380 EnsureTCPLoadBalancer — target pool of instance
         URLs, forwarding rule over the pool's port range, firewall
-        allowing the service ports; each mutation is an async op)"""
+        allowing the service ports; each mutation is an async op.
+        load_balancer_ip rides the forwarding rule's IPAddress, the
+        requested-address seat gce.go passes through)"""
         existing = self.get(name, region)
         if existing is not None:
             if sorted(existing.ports) != sorted(ports):
@@ -269,6 +272,8 @@ class GceLoadBalancers(LoadBalancers):
         self._c.wait_op(self._c.request(
             "POST", f"/regions/{self._c.region}/forwardingRules", {
                 "name": name, "IPProtocol": "TCP",
+                **({"IPAddress": load_balancer_ip}
+                   if load_balancer_ip else {}),
                 "portRange": port_range,
                 "description": json.dumps(
                     {"ports": sorted(ports)}),

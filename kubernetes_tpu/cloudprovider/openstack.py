@@ -213,9 +213,11 @@ class OpenStackLoadBalancers(LoadBalancers):
         return [self._lb_of(v, "") for v in (data or {}).get("vips", [])]
 
     def ensure(self, name: str, region: str, ports: List[int],
-               hosts: List[str]) -> LoadBalancer:
+               hosts: List[str],
+               load_balancer_ip: str = "") -> LoadBalancer:
         """(ref: EnsureTCPLoadBalancer :653 — create pool, add a member
-        per host, create the vip; LBaaS v1 takes ONE port per vip, the
+        per host, create the vip with the requested address when given;
+        LBaaS v1 takes ONE port per vip, the
         reference rejects multi-port services :659)"""
         if len(ports) != 1:
             raise OpenStackError(
@@ -237,6 +239,8 @@ class OpenStackLoadBalancers(LoadBalancers):
         vip = self._s.request("POST", "network", "/lb/vips", {
             "vip": {"name": name, "pool_id": pool["id"],
                     "protocol": "TCP", "protocol_port": ports[0],
+                    **({"address": load_balancer_ip}
+                       if load_balancer_ip else {}),
                     "subnet_id": self.subnet_id}})["vip"]
         return LoadBalancer(name=name, region=region,
                             external_ip=vip.get("address", ""),

@@ -34,9 +34,10 @@ def _is_owned_lb_name(name: str) -> bool:
 
 class ServiceController:
     def __init__(self, client, cloud: CloudProvider,
-                 sync_period: float = SYNC_PERIOD):
+                 sync_period: float = SYNC_PERIOD, recorder=None):
         self.client = client
         self.cloud = cloud
+        self.recorder = recorder
         self.sync_period = sync_period
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -70,14 +71,37 @@ class ServiceController:
                         pass
                 continue
             wanted.add(lb_name)
-            lb = balancers.get(lb_name, region)
-            # order-insensitive: providers report ports sorted (ELB
-            # listeners and GCE rules have no spec order to preserve)
-            ports = sorted(p.port for p in svc.spec.ports)
-            if lb is None or sorted(lb.ports) != ports \
-                    or lb.hosts != hosts:
-                lb = balancers.ensure(lb_name, region, ports, hosts)
-                actions += 1
+            # one broken service (bad loadBalancerIP, provider error)
+            # must not kill reconciliation for every other service —
+            # the reference's controller records the error per service
+            # and keeps going (servicecontroller.go processDelta)
+            try:
+                lb = balancers.get(lb_name, region)
+                # order-insensitive: providers report ports sorted (ELB
+                # listeners and GCE rules have no spec order to
+                # preserve)
+                ports = sorted(p.port for p in svc.spec.ports)
+                want_ip = svc.spec.load_balancer_ip
+                if lb is not None and want_ip \
+                        and lb.external_ip != want_ip:
+                    # the requested address is honored at creation only
+                    # (forwarding rules/vips are address-immutable):
+                    # recreate, like gce.go's forwardingRuleNeedsUpdate
+                    # IPAddress check -> delete + recreate path
+                    balancers.delete(lb_name, region)
+                    lb = None
+                if lb is None or sorted(lb.ports) != ports \
+                        or lb.hosts != hosts:
+                    lb = balancers.ensure(
+                        lb_name, region, ports, hosts,
+                        load_balancer_ip=want_ip)
+                    actions += 1
+            except Exception as e:
+                if self.recorder:
+                    self.recorder.eventf(
+                        svc, "Warning", "CreatingLoadBalancerFailed",
+                        "Error creating load balancer: %s", e)
+                continue
             ingress = [lb.external_ip]
             if svc.status.load_balancer_ingress != ingress:
                 try:
@@ -103,7 +127,10 @@ class ServiceController:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self.sync_once()
+            try:
+                self.sync_once()
+            except Exception:
+                pass  # transient provider failure: next period retries
             self._stop.wait(self.sync_period)
 
     def run(self) -> "ServiceController":
@@ -192,7 +219,10 @@ class RouteController:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self.sync_once()
+            try:
+                self.sync_once()
+            except Exception:
+                pass  # transient provider failure: next period retries
             self._stop.wait(self.sync_period)
 
     def run(self) -> "RouteController":

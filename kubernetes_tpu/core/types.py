@@ -630,6 +630,10 @@ class ServiceSpec:
     # endpoints (ref: pkg/api/v1/types.go:1585 ExternalIPs; the wire
     # accepts the deprecatedPublicIPs alias — serde WIRE_ALIASES)
     external_ips: List[str] = field(default_factory=list)
+    # requested address for a type=LoadBalancer service (ref:
+    # pkg/api/v1/types.go:1606 — honored by providers that support
+    # address reservation, best-effort elsewhere)
+    load_balancer_ip: str = ""
 
 
 @dataclass

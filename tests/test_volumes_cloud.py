@@ -155,6 +155,27 @@ class TestCloudControllers:
         ctrl.sync_once()
         assert cloud.balancers == {}
 
+    def test_requested_load_balancer_ip_honored(self):
+        """spec.loadBalancerIP (types.go:1606) rides through the
+        controller into the provider's ensure; providers that support
+        reservation grant it."""
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="pin", namespace="default"),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 load_balancer_ip="203.0.113.9",
+                                 selector={"app": "pin"},
+                                 ports=[api.ServicePort(name="http",
+                                                        port=80)])),
+            "default")
+        ctrl = ServiceController(client, cloud)
+        assert ctrl.sync_once() >= 1
+        fresh = client.get("services", "pin", "default")
+        assert fresh.status.load_balancer_ingress == ["203.0.113.9"]
+
     def test_route_controller(self):
         from kubernetes_tpu.cloudprovider import Route
         registry = Registry()

@@ -133,14 +133,19 @@ def validate_service(svc: api.Service) -> None:
     provider error instead of a 422 at admission time)."""
     import socket
     validate_object_meta(svc.metadata, True)
-    for label, ip in ([("spec.loadBalancerIP", svc.spec.load_balancer_ip)]
+    # explicit JSON nulls decode to None (serde): treat as defaults
+    spec = svc.spec or api.ServiceSpec()
+    for label, ip in ([("spec.loadBalancerIP",
+                        spec.load_balancer_ip or "")]
                       + [("spec.externalIPs", x)
-                         for x in svc.spec.external_ips]):
+                         for x in (spec.external_ips or [])]):
         if not ip:
             continue
         try:
-            socket.inet_aton(ip)
-        except OSError:
+            # inet_pton: strict dotted-quad like the reference's
+            # net.ParseIP (inet_aton admits "127.1"-style shorthand)
+            socket.inet_pton(socket.AF_INET, ip)
+        except (OSError, TypeError):
             raise Invalid(f"{label}: {ip!r} is not a valid IP address")
 
 
@@ -644,7 +649,10 @@ class Registry:
         """Assign cluster IP + node ports (ref: pkg/registry/service
         rest.go Create: headless "None" skips IP; explicit requests are
         honored or rejected; NodePort/LoadBalancer types get node ports)."""
-        spec = obj.spec
+        # an explicit JSON-null spec decodes to None: normalize to
+        # defaults so the allocator (and every later reader of the
+        # STORED object) sees a real ServiceSpec
+        spec = obj.spec or api.ServiceSpec()
         allocated_ip = ""
         if spec.cluster_ip != "None":
             if spec.cluster_ip:

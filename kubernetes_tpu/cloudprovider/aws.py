@@ -207,6 +207,11 @@ class AwsLoadBalancers(LoadBalancers):
     """ELB classic (ref: aws.go:1627-1965 + the awsSdkELB calls
     :384-440)."""
 
+    # classic ELBs allocate their own DNS address; the controller must
+    # not tear anything down chasing a requested IP (aws.go rejects a
+    # requested publicIP up front)
+    supports_load_balancer_ip = False
+
     def __init__(self, client: _QueryClient, instances: AwsInstances,
                  vpc_id: str = "vpc-default", zone: str = ""):
         self._c = client

@@ -55,6 +55,11 @@ class LoadBalancers:
         """All balancers this provider manages (for orphan GC)."""
         raise NotImplementedError
 
+    # whether ensure() can honor a requested address at all — AWS
+    # classic ELBs cannot (aws.go rejects a requested publicIP); the
+    # controller consults this BEFORE tearing anything down
+    supports_load_balancer_ip: bool = True
+
     def ensure(self, name: str, region: str, ports: List[int],
                hosts: List[str],
                load_balancer_ip: str = "") -> LoadBalancer:

@@ -236,12 +236,24 @@ class OpenStackLoadBalancers(LoadBalancers):
                 "member": {"pool_id": pool["id"],
                            "address": self._address_by_name(host),
                            "protocol_port": ports[0]}})
-        vip = self._s.request("POST", "network", "/lb/vips", {
-            "vip": {"name": name, "pool_id": pool["id"],
-                    "protocol": "TCP", "protocol_port": ports[0],
-                    **({"address": load_balancer_ip}
-                       if load_balancer_ip else {}),
-                    "subnet_id": self.subnet_id}})["vip"]
+        try:
+            vip = self._s.request("POST", "network", "/lb/vips", {
+                "vip": {"name": name, "pool_id": pool["id"],
+                        "protocol": "TCP", "protocol_port": ports[0],
+                        **({"address": load_balancer_ip}
+                           if load_balancer_ip else {}),
+                        "subnet_id": self.subnet_id}})["vip"]
+        except Exception:
+            # existence is vip-keyed (get() looks the vip up): a failed
+            # vip create must not strand the pool+members just made, or
+            # every controller retry leaks another orphan pool into
+            # neutron
+            try:
+                self._s.request("DELETE", "network",
+                                f"/lb/pools/{pool['id']}")
+            except OpenStackError:
+                pass
+            raise
         return LoadBalancer(name=name, region=region,
                             external_ip=vip.get("address", ""),
                             ports=list(ports), hosts=sorted(hosts))

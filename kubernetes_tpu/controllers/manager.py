@@ -52,7 +52,8 @@ class ControllerManager:
                 HorizontalController(client, metrics_source,
                                      recorder=recorder))
         if cloud is not None:
-            self.controllers.append(ServiceController(client, cloud))
+            self.controllers.append(ServiceController(client, cloud,
+                                                      recorder=recorder))
             self.controllers.append(RouteController(
                 client, cloud, cluster_cidr=cluster_cidr))
 

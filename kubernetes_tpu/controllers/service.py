@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import replace
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..cloudprovider import CloudProvider, Route
 from ..core import types as api
@@ -41,6 +41,9 @@ class ServiceController:
         self.sync_period = sync_period
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # lb name -> the requested address already attempted once (the
+        # recreate-on-mismatch path fires a single time per value)
+        self._ip_attempts: Dict[str, str] = {}
 
     def sync_once(self) -> int:
         balancers = self.cloud.load_balancers()
@@ -82,12 +85,28 @@ class ServiceController:
                 # preserve)
                 ports = sorted(p.port for p in svc.spec.ports)
                 want_ip = svc.spec.load_balancer_ip
-                if lb is not None and want_ip \
-                        and lb.external_ip != want_ip:
+                if want_ip and not getattr(
+                        balancers, "supports_load_balancer_ip", True):
+                    # capability check BEFORE any mutation (aws.go
+                    # rejects a requested publicIP up front): never
+                    # tear down a working LB chasing an address the
+                    # provider cannot grant
+                    if self.recorder:
+                        self.recorder.eventf(
+                            svc, "Warning", "LoadBalancerIPUnsupported",
+                            "provider cannot honor loadBalancerIP %s; "
+                            "keeping the provider-assigned address",
+                            want_ip)
+                    want_ip = ""
+                if (lb is not None and want_ip
+                        and lb.external_ip != want_ip
+                        and self._ip_attempts.get(lb_name) != want_ip):
                     # the requested address is honored at creation only
                     # (forwarding rules/vips are address-immutable):
-                    # recreate, like gce.go's forwardingRuleNeedsUpdate
-                    # IPAddress check -> delete + recreate path
+                    # recreate ONCE per requested value, like gce.go's
+                    # forwardingRuleNeedsUpdate IPAddress check — a
+                    # provider that grants a different address anyway
+                    # must not trigger delete/recreate churn every sync
                     balancers.delete(lb_name, region)
                     lb = None
                 if lb is None or sorted(lb.ports) != ports \
@@ -96,6 +115,13 @@ class ServiceController:
                         lb_name, region, ports, hosts,
                         load_balancer_ip=want_ip)
                     actions += 1
+                if want_ip:
+                    self._ip_attempts[lb_name] = want_ip
+                    if lb.external_ip != want_ip and self.recorder:
+                        self.recorder.eventf(
+                            svc, "Warning", "LoadBalancerIPNotGranted",
+                            "requested %s, provider granted %s",
+                            want_ip, lb.external_ip)
             except Exception as e:
                 if self.recorder:
                     self.recorder.eventf(

@@ -500,22 +500,24 @@ def test_userspace_nodeport_listener():
                     break
                 got += piece
         assert got == b"np:hello"
-        # removing the node port closes the listener
+        # removing the node port closes the listener. Assert on the
+        # proxier's own bookkeeping, not connection-refused: under a
+        # loaded box another process can re-claim the freed port inside
+        # the polling window and accept the probe connection, flaking a
+        # refusal-based check.
         p.on_service_update([api.Service(
             metadata=api.ObjectMeta(name="svc", namespace="default"),
             spec=api.ServiceSpec(ports=[
                 api.ServicePort(name="http", port=80)]))])
         import time as _time
         deadline = _time.time() + 5
-        refused = False
-        while _time.time() < deadline and not refused:
-            try:
-                _socket.create_connection(("127.0.0.1", node_port),
-                                          timeout=1).close()
-                _time.sleep(0.05)
-            except OSError:
-                refused = True
-        assert refused
+        while _time.time() < deadline and p._node_proxies:
+            _time.sleep(0.05)
+        # pop+close are coupled in on_service_update (the proxy object
+        # leaves the map only via its close path), so the bookkeeping
+        # assertion suffices — an OS-level refusal check would race
+        # with foreign processes re-claiming the freed port
+        assert not p._node_proxies  # the node-port listener released
     finally:
         p.stop()
         backend.close()

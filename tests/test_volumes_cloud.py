@@ -176,6 +176,68 @@ class TestCloudControllers:
         fresh = client.get("services", "pin", "default")
         assert fresh.status.load_balancer_ingress == ["203.0.113.9"]
 
+    def test_lb_ip_capability_gate_never_tears_down(self):
+        """A provider that cannot honor loadBalancerIP (AWS classic
+        ELB shape) keeps its working LB: the capability check runs
+        BEFORE any delete, and a warning event records the refusal."""
+        from kubernetes_tpu.api.record import FakeRecorder
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        cloud.load_balancers().supports_load_balancer_ip = False
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="keep", namespace="default"),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 selector={"app": "keep"},
+                                 ports=[api.ServicePort(name="h",
+                                                        port=80)])),
+            "default")
+        rec = FakeRecorder()
+        ctrl = ServiceController(client, cloud, recorder=rec)
+        ctrl.sync_once()
+        lb_before = list(cloud.balancers.values())[0]
+        # now the user requests an address the provider can't grant
+        from dataclasses import replace as _rep
+        fresh = client.get("services", "keep", "default")
+        client.update("services", _rep(fresh, spec=_rep(
+            fresh.spec, load_balancer_ip="203.0.113.9")), "default")
+        ctrl.sync_once()
+        # the working LB survives, a warning records the refusal
+        assert list(cloud.balancers.values())[0] is lb_before
+        assert any("LoadBalancerIPUnsupported" in e for e in rec.events)
+
+    def test_lb_ip_recreate_fires_once(self):
+        """A requested address is attempted once — a provider granting
+        a different one must not trigger delete/recreate churn."""
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="churn", namespace="default"),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 selector={"app": "churn"},
+                                 ports=[api.ServicePort(name="h",
+                                                        port=80)])),
+            "default")
+        ctrl = ServiceController(client, cloud)
+        ctrl.sync_once()  # ephemeral address assigned
+        from dataclasses import replace as _rep
+        fresh = client.get("services", "churn", "default")
+        client.update("services", _rep(fresh, spec=_rep(
+            fresh.spec, load_balancer_ip="203.0.113.7")), "default")
+        ctrl.sync_once()  # one recreate, address granted by the fake
+        assert client.get("services", "churn",
+                          "default").status.load_balancer_ingress \
+            == ["203.0.113.7"]
+        deletes_after_grant = [c for c in cloud.calls
+                               if c.startswith("delete-lb")]
+        ctrl.sync_once()
+        ctrl.sync_once()
+        assert [c for c in cloud.calls if c.startswith("delete-lb")] \
+            == deletes_after_grant  # no further churn
+
     def test_route_controller(self):
         from kubernetes_tpu.cloudprovider import Route
         registry = Registry()

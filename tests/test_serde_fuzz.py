@@ -99,6 +99,28 @@ def test_fuzzed_round_trip(resource):
             f"wire={json.dumps(wire2, indent=1)[:2000]}")
 
 
+def test_fuzzed_list_bytes_match_dict_encoding():
+    """The byte-assembled LIST fast path (encode_list_bytes, built from
+    cached per-object fragments) must stay byte-identical to the
+    reflective encode_list under fuzzed objects — a divergence would
+    serve different wire bytes depending on cache temperature."""
+    for resource in ("pods", "nodes", "services", "events"):
+        cls = RESOURCES[resource].cls
+        kind = RESOURCES[resource].kind
+        rng = random.Random(zlib.crc32(resource.encode()) & 0xFFF)
+        items = [_rand_instance(cls, rng) for _ in range(4)]
+        for m in items:  # a resourceVersion makes the fragments cacheable
+            if getattr(m, "metadata", None) is not None:
+                m.metadata.resource_version = str(rng.randrange(1, 9999))
+        # cold pass: fragments computed
+        fast = default_scheme.encode_list_bytes(kind, items, "7")
+        slow = json.dumps(default_scheme.encode_list(kind, items, "7"))
+        assert fast == slow.encode(), resource  # BYTE identity, the pin
+        # warm pass: the cached-fragment branch must serve the same bytes
+        assert default_scheme.encode_list_bytes(kind, items, "7") \
+            == fast, resource
+
+
 def test_fuzzed_round_trip_request_kinds():
     """Kinds that ride requests rather than the registry map."""
     from kubernetes_tpu.core.serde import from_wire, to_wire

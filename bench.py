@@ -221,6 +221,10 @@ def main():
     ap.add_argument("--pods", type=int, default=30000)
     ap.add_argument("--probe-attempts", type=int, default=2)
     ap.add_argument("--skip-slo", action="store_true")
+    ap.add_argument("--store-ab", action="store_true",
+                    help="run one extra e2e pass with watch fan-out "
+                         "held under the store's ledger lock (the "
+                         "pre-two-phase commit path) and report both")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -271,6 +275,22 @@ def main():
     if args.verbose:
         print(f"# e2e {r.scheduled}/{r.n_pods} in {r.elapsed_s:.2f}s",
               file=sys.stderr)
+    store_ab = None
+    if args.store_ab:
+        # control arm: same shape, fan-out back under the ledger lock —
+        # the measured delta IS the two-phase commit split
+        ctl = run_scheduling_benchmark(args.nodes, args.pods, "batch",
+                                       store_publish_inline=True)
+        store_ab = {
+            "publish_offlock_pods_per_sec": round(r.pods_per_sec, 1),
+            "publish_inline_pods_per_sec": round(ctl.pods_per_sec, 1),
+            "publish_inline_elapsed_s": round(ctl.elapsed_s, 2),
+            "speedup": (round(r.pods_per_sec / ctl.pods_per_sec, 3)
+                        if ctl.pods_per_sec else None)}
+        if args.verbose:
+            print(f"# store A/B inline {ctl.pods_per_sec:.0f} vs "
+                  f"off-lock {r.pods_per_sec:.0f} pods/s",
+                  file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -376,6 +396,7 @@ def main():
         "probe": probe,
         "pallas": pallas,
         "slo": slo,
+        "store_ab": store_ab,
         "multihost": multihost,
         "tpu": _tpu_section()}))
 

@@ -131,7 +131,7 @@ def validate_service(svc: api.Service) -> None:
     spec fields must parse as IPs before a controller hands them to a
     cloud API (an invalid string would otherwise surface as an opaque
     provider error instead of a 422 at admission time)."""
-    import socket
+    import ipaddress
     validate_object_meta(svc.metadata, True)
     # explicit JSON nulls decode to None (serde): treat as defaults
     spec = svc.spec or api.ServiceSpec()
@@ -142,10 +142,11 @@ def validate_service(svc: api.Service) -> None:
         if not ip:
             continue
         try:
-            # inet_pton: strict dotted-quad like the reference's
-            # net.ParseIP (inet_aton admits "127.1"-style shorthand)
-            socket.inet_pton(socket.AF_INET, ip)
-        except (OSError, TypeError):
+            # ip_address: strict v4 dotted-quad OR v6, like the
+            # reference's net.ParseIP (inet_aton-style "127.1"
+            # shorthand is rejected; an IPv6 externalIP is accepted)
+            ipaddress.ip_address(ip)
+        except (ValueError, TypeError):
             raise Invalid(f"{label}: {ip!r} is not a valid IP address")
 
 
@@ -958,7 +959,12 @@ class Registry:
                         "strategic-merge-patch body must be an object "
                         "(json-patch op arrays need the "
                         "application/json-patch+json content type)")
-                merged = strategic_patch(wire, patch_body)
+                try:
+                    merged = strategic_patch(wire, patch_body)
+                except ValueError as e:
+                    # unknown $patch directive (patch.go's "Unknown
+                    # patch type" surfaces as a 400)
+                    raise BadRequest(f"strategic merge patch failed: {e}")
             else:
                 raise BadRequest(
                     f"unsupported patch content type {patch_type!r}")
@@ -1243,15 +1249,17 @@ class Registry:
             fsel = fieldspkg.parse(field_selector) if field_selector else None
             if fsel is not None:
                 fsel = convert_field_selector(resource, fsel)
-            # The store fans one event out to every filtered watcher
-            # while holding its write lock; without sharing, N watchers
-            # rebuild the same field map N times per event (2N for
-            # MODIFIED: new + prev). Memo key (id, resourceVersion) is
-            # collision-safe within this registry — its rv strings are
-            # unique per committed write, so an id reused by a later
-            # object of the SAME store can't alias (the memo is
-            # per-Registry precisely because two stores can mint equal
-            # rvs for different objects).
+            # The store's publisher fans one event out to every
+            # filtered watcher in a single serialized pass (under its
+            # publish lock — off the ledger lock since the two-phase
+            # commit split, so this memo stays single-threaded);
+            # without sharing, N watchers rebuild the same field map N
+            # times per event (2N for MODIFIED: new + prev). Memo key
+            # (id, resourceVersion) is collision-safe within this
+            # registry — its rv strings are unique per committed write,
+            # so an id reused by a later object of the SAME store can't
+            # alias (the memo is per-Registry precisely because two
+            # stores can mint equal rvs for different objects).
             def _memoized_fields_of():
                 # memo'd dict path, built only when the selector didn't
                 # compile (the common selectors all compile)

@@ -137,6 +137,15 @@ class ServiceController:
                         svc.metadata.namespace)
                 except Exception:
                     pass
+        # prune one-shot recreate suppressions for balancers outside the
+        # wanted set: a deleted-then-recreated service mints a new uid
+        # (new lb name), but a same-name recreate under a provider that
+        # reuses uids — or a service flapping LoadBalancer<->ClusterIP —
+        # must get its one recreate attempt back instead of inheriting
+        # the dead entry forever (the map also stops leaking an entry
+        # per deleted service)
+        for name in [n for n in self._ip_attempts if n not in wanted]:
+            del self._ip_attempts[name]
         # tear down balancers whose service is gone or downgraded — via
         # the interface's list(), and ONLY balancers carrying this
         # controller's naming convention: LBs we never created (operators,

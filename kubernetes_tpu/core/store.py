@@ -12,16 +12,36 @@ Reference mapping:
     HTTP layer maps this to 410 Gone, prompting a client re-list).
 
 Being in-process (etcd is an external process in the reference), storage and
-watch cache collapse into one component guarded by one lock. Concurrency
-contract: stored objects are logically FROZEN — readers get the stored object
-without copying (list/watch fan-out to thousands of agents must not deep-copy
-per reader); writers hand ownership of the written object to the store and
-must not mutate it afterwards. Updates build new objects (dataclasses.replace
-or codec round-trip), never mutate in place. This is the same contract Go
-client caches impose informally.
+watch cache collapse into one component. Concurrency contract: stored objects
+are logically FROZEN — readers get the stored object without copying
+(list/watch fan-out to thousands of agents must not deep-copy per reader);
+writers hand ownership of the written object to the store and must not mutate
+it afterwards. Updates build new objects (dataclasses.replace or codec
+round-trip), never mutate in place. This is the same contract Go client
+caches impose informally.
 
 A single global revision counter doubles as resourceVersion (stringified),
 exactly like etcd's modifiedIndex in the reference.
+
+Every commit runs in three phases (the decomposition the 5k-node profile
+demanded — roughly half of each ledger-lock hold was watch fan-out, and
+three committers serialize on this lock at full load):
+
+  stage   — object construction and conflict checks; as much as the verb's
+            semantics allow runs before the lock (the registry's
+            _prepare_create does the heavy cloning outside it entirely)
+  ledger  — revision bump + _data/_seg_keys/history/list-cache mutation;
+            the ONLY phase that holds self._lock
+  publish — predicate mapping (_filtered_event) + Watcher.send/send_many,
+            run by the ordered publisher AFTER the ledger lock is released
+
+The publisher is a FIFO of committed batches fed under the ledger lock (so
+queue order IS revision order) and drained under a dedicated _pub_lock by
+whichever committer gets there first: watchers observe events in strict
+revision order no matter which thread fans them out. A watcher registering
+mid-flight replays the history window only up to the last PUBLISHED
+revision and carries a per-watcher floor for live delivery, so the
+replay->live handoff has no duplicates and no gaps (see watch()).
 """
 
 from __future__ import annotations
@@ -45,7 +65,9 @@ def _with_rv(obj: Any, rev: int) -> Any:
 
 
 class Store:
-    def __init__(self, window: int = 100_000):
+    def __init__(self, window: int = 100_000, publish_inline: bool = False):
+        # the LEDGER lock: guards _rev/_data/_seg_keys/_history/list
+        # caches — and nothing else. Watch fan-out runs outside it.
         self._lock = threading.RLock()
         self._rev = 0
         # key -> (object, mod_rev, expiry_ts|None); insertion-ordered so list
@@ -54,9 +76,25 @@ class Store:
         # sliding watch window: deque of (rev, event_type, key, obj, prev_obj)
         self._history: deque = deque(maxlen=window)
         self._oldest_rev = 0  # smallest rev still replayable + its predecessor
-        # (prefix, server-side predicate | None, watcher)
+        # (prefix, server-side predicate | None, watcher, floor): floor
+        # is the registration-time delivery cutoff — the publisher skips
+        # events with rev <= floor (they were replayed from history, or
+        # predate a from-now watch). Guarded by _pub_lock, NOT the
+        # ledger lock: only the publish phase touches watchers.
         self._watchers: List[Tuple[str, Optional[Callable[[Any], bool]],
-                                   "watchpkg.Watcher"]] = []
+                                   "watchpkg.Watcher", int]] = []
+        # publish pipeline: batches of (rev, key, event, prev) appended
+        # under the ledger lock (FIFO order = revision order) and fanned
+        # out under _pub_lock after the ledger lock is released
+        self._pub_queue: deque = deque()
+        self._pub_lock = threading.Lock()
+        # highest revision whose events have been handed to watchers;
+        # watch() replays history only up to here (the rest arrives live)
+        self._published_rev = 0
+        # A/B switch (bench.py --store-ab): publish while still holding
+        # the ledger lock — the pre-split serialization, kept so the
+        # two-phase win stays measurable end-to-end
+        self._publish_inline = publish_inline
         # min-heap of (expiry, key) for TTL'd entries only, so GC cost is
         # O(expired) per write instead of a full-store scan (only events
         # carry TTLs; pods/nodes must not pay for them)
@@ -99,8 +137,11 @@ class Store:
 
     @property
     def current_revision(self) -> int:
-        with self._lock:
-            return self._rev
+        # lock-free: a single int read is atomic under the GIL, and any
+        # torn ordering a caller could observe is indistinguishable from
+        # sampling a moment earlier — revision reads must not queue
+        # behind a committer's ledger window
+        return self._rev
 
     def _bump(self) -> int:
         self._rev += 1
@@ -161,17 +202,21 @@ class Store:
 
     def write_version(self, prefix: str) -> int:
         """Writes ever committed under the prefix's resource segment —
-        the validity token for cached LIST response bytes."""
-        with self._lock:
-            return self._seg_writes.get(self._seg(prefix), 0)
+        the validity token for cached LIST response bytes. Lock-free:
+        one GIL-atomic dict read, so the apiserver's byte-cache hit
+        path (the DENSITY GET-/nodes whale) never queues behind a
+        committer. A racing write can only make the read conservative
+        (the caller rebuilds a response it could have reused)."""
+        return self._seg_writes.get(self._seg(prefix), 0)
 
     def watch_floor(self) -> int:
         """Smallest resourceVersion a watch can still start from without
         410 Expired. Cached LIST bytes embedding an older rev must be
         rebuilt, or a write-quiet resource's list->watch loop livelocks
-        once busier segments roll the shared history window past it."""
-        with self._lock:
-            return self._oldest_rev
+        once busier segments roll the shared history window past it.
+        Lock-free for the same reason as write_version: the int only
+        grows, so a stale read is again the conservative direction."""
+        return self._oldest_rev
 
     def _record(self, rev: int, etype: str, key: str, obj: Any,
                 prev: Any) -> watchpkg.Event:
@@ -208,9 +253,18 @@ class Store:
             return watchpkg.Event(watchpkg.DELETED, ev.object)
         return None
 
-    def _fanout(self, items: List[Tuple[str, watchpkg.Event, Any]]) -> None:
-        """Deliver committed events to watchers — one send per watcher
-        when the batch has more than one event — and sweep the dead.
+    def _fanout(self, items: List[Tuple[int, str, watchpkg.Event, Any]]
+                ) -> None:
+        """Publish phase: deliver one committed batch to watchers — one
+        send per watcher when the batch has more than one event — and
+        sweep the dead. Runs under _pub_lock (never the ledger lock):
+        the publisher is the only reader/writer of _watchers, and
+        serializing on _pub_lock is what keeps delivery in revision
+        order across committer threads.
+
+        Per-watcher floors: an event with rev <= floor was already
+        replayed to that watcher from history at registration time (or
+        predates a from-now watch) and must not be delivered again.
 
         For multi-event batches, items is the OUTER loop: every
         watcher's predicate sees one object back-to-back, so the
@@ -221,12 +275,12 @@ class Store:
             return
         dead = []
         if len(items) == 1:
-            key, ev, prev = items[0]
-            for i, (prefix, pred, w) in enumerate(self._watchers):
+            rev, key, ev, prev = items[0]
+            for i, (prefix, pred, w, floor) in enumerate(self._watchers):
                 if w.stopped:
                     dead.append(i)
                     continue
-                if not key.startswith(prefix):
+                if rev <= floor or not key.startswith(prefix):
                     continue
                 mapped = (ev if pred is None
                           else self._filtered_event(ev, prev, pred))
@@ -238,7 +292,7 @@ class Store:
         else:
             watchers = self._watchers
             per_w: List[Optional[list]] = [None] * len(watchers)
-            for i, (_prefix, _pred, w) in enumerate(watchers):
+            for i, (_prefix, _pred, w, _floor) in enumerate(watchers):
                 if w.stopped:
                     dead.append(i)
                 else:
@@ -249,15 +303,18 @@ class Store:
             # testing every watcher's prefix against every key — the
             # per-(event x watcher) startswith was ~a third of fan-out
             # at 30k-pod tiles
-            seg0 = self._seg(items[0][0])
-            if all(k.startswith(seg0) for k, _e, _p in items):
-                active = [(i, prefix, pred) for i, (prefix, pred, _w)
+            seg0 = self._seg(items[0][1])
+            if all(k.startswith(seg0) for _r, k, _e, _p in items):
+                active = [(i, prefix, pred, floor)
+                          for i, (prefix, pred, _w, floor)
                           in enumerate(watchers)
                           if per_w[i] is not None
                           and (prefix.startswith(seg0)
                                or seg0.startswith(prefix))]
-                for key, ev, prev in items:
-                    for i, prefix, pred in active:
+                for rev, key, ev, prev in items:
+                    for i, prefix, pred, floor in active:
+                        if rev <= floor:
+                            continue
                         if len(prefix) > len(seg0) \
                                 and not key.startswith(prefix):
                             continue
@@ -268,10 +325,11 @@ class Store:
                             if mapped is not None:
                                 per_w[i].append(mapped)
             else:
-                for key, ev, prev in items:
-                    for i, (prefix, pred, _w) in enumerate(watchers):
+                for rev, key, ev, prev in items:
+                    for i, (prefix, pred, _w, floor) in enumerate(watchers):
                         evs = per_w[i]
-                        if evs is None or not key.startswith(prefix):
+                        if evs is None or rev <= floor \
+                                or not key.startswith(prefix):
                             continue
                         if pred is None:
                             evs.append(ev)
@@ -279,12 +337,12 @@ class Store:
                             mapped = self._filtered_event(ev, prev, pred)
                             if mapped is not None:
                                 evs.append(mapped)
-            for i, (_prefix, _pred, w) in enumerate(watchers):
+            for i, (_prefix, _pred, w, _floor) in enumerate(watchers):
                 evs = per_w[i]
                 if not evs:
                     continue
                 ok = (w.send(evs[0]) if len(evs) == 1
-                      else w.send_many(evs))
+                      else w.send_many(evs, owned=True))
                 if not ok:
                     w.stop()
                     dead.append(i)
@@ -293,8 +351,42 @@ class Store:
         for i in sorted(dead, reverse=True):
             del self._watchers[i]
 
-    def _emit(self, rev: int, etype: str, key: str, obj: Any, prev: Any) -> None:
-        self._fanout([(key, self._record(rev, etype, key, obj, prev), prev)])
+    def _stage_publish(self, items: List[Tuple[int, str, watchpkg.Event,
+                                               Any]]) -> None:
+        """Hand one committed batch to the publisher (caller holds the
+        ledger lock, so queue order is revision order) — the caller MUST
+        call _drain_publish() after releasing the lock."""
+        if items:
+            self._pub_queue.append(items)
+
+    def _emit(self, rev: int, etype: str, key: str, obj: Any,
+              prev: Any) -> None:
+        """Ledger bookkeeping + publisher handoff for one write (caller
+        holds the ledger lock and drains after releasing it)."""
+        self._stage_publish(
+            [(rev, key, self._record(rev, etype, key, obj, prev), prev)])
+
+    def _drain_publish(self) -> None:
+        """Publish every queued batch, in order, outside the ledger
+        lock. The non-blocking acquire hands a busy publisher the work
+        instead of parking this committer behind another thread's
+        fan-out; the outer re-check after release closes the
+        enqueue-after-empty window (a batch queued while the previous
+        drainer was exiting is picked up here, never stranded)."""
+        q = self._pub_queue
+        while q:
+            if not self._pub_lock.acquire(blocking=False):
+                return  # the live publisher drains our batch in order
+            try:
+                while True:
+                    try:
+                        items = q.popleft()
+                    except IndexError:
+                        break
+                    self._fanout(items)
+                    self._published_rev = items[-1][0]
+            finally:
+                self._pub_lock.release()
 
     def _gc_expired(self, now: Optional[float] = None) -> None:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
@@ -313,21 +405,30 @@ class Store:
     # ------------------------------------------------------------ writes
 
     def create(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
-        with self._lock:
-            self._gc_expired()
-            if key in self._data:
-                raise AlreadyExists(kind=key.split("/")[2] if key.count("/") >= 2 else "",
-                                    name=key.rsplit("/", 1)[-1])
-            rev = self._bump()
-            obj = _with_rv(obj, rev)
-            expiry = time.time() + ttl if ttl else None
-            self._data[key] = (obj, rev, expiry)
-            self._index_add(key)
-            if expiry is not None:
-                heapq.heappush(self._expiry_heap, (expiry, key))
-                self._ttl_segs.add(self._seg(key))
-            self._emit(rev, watchpkg.ADDED, key, obj, None)
-            return obj
+        # every write verb shares this shape: ledger phase under the
+        # lock, then the publish drain in the finally — which also
+        # flushes expiry events _gc_expired queued even when the verb
+        # itself raises before committing anything
+        try:
+            with self._lock:
+                self._gc_expired()
+                if key in self._data:
+                    raise AlreadyExists(kind=key.split("/")[2] if key.count("/") >= 2 else "",
+                                        name=key.rsplit("/", 1)[-1])
+                rev = self._bump()
+                obj = _with_rv(obj, rev)
+                expiry = time.time() + ttl if ttl else None
+                self._data[key] = (obj, rev, expiry)
+                self._index_add(key)
+                if expiry is not None:
+                    heapq.heappush(self._expiry_heap, (expiry, key))
+                    self._ttl_segs.add(self._seg(key))
+                self._emit(rev, watchpkg.ADDED, key, obj, None)
+                if self._publish_inline:
+                    self._drain_publish()
+                return obj
+        finally:
+            self._drain_publish()
 
     def create_batch(self, entries: List[Tuple[str, Any, Optional[float]]],
                      owned_meta: bool = False) -> List[Any]:
@@ -345,75 +446,91 @@ class Store:
         the revision is then stamped in place instead of through two
         clone passes per object, which is most of what the create storm
         used to do under the store lock (PROFILE_e2e.md)."""
-        with self._lock:
-            self._gc_expired()
-            now = time.time()
-            seen = set()
-            for key, _obj, _ttl in entries:
-                if key in self._data or key in seen:
-                    raise AlreadyExists(
-                        kind=key.split("/")[2] if key.count("/") >= 2 else "",
-                        name=key.rsplit("/", 1)[-1])
-                seen.add(key)
-            out = []
-            batch_events: List[Tuple[str, watchpkg.Event, Any]] = []
-            for key, obj, ttl in entries:
-                rev = self._bump()
-                if owned_meta:
-                    obj.metadata.resource_version = str(rev)
-                else:
-                    obj = _with_rv(obj, rev)
-                expiry = now + ttl if ttl else None
-                self._data[key] = (obj, rev, expiry)
-                self._index_add(key)
-                if expiry is not None:
-                    heapq.heappush(self._expiry_heap, (expiry, key))
-                    self._ttl_segs.add(self._seg(key))
-                batch_events.append(
-                    (key, self._record(rev, watchpkg.ADDED, key, obj, None),
-                     None))
-                out.append(obj)
-            self._fanout(batch_events)
-            return out
+        try:
+            with self._lock:
+                self._gc_expired()
+                now = time.time()
+                seen = set()
+                for key, _obj, _ttl in entries:
+                    if key in self._data or key in seen:
+                        raise AlreadyExists(
+                            kind=key.split("/")[2] if key.count("/") >= 2 else "",
+                            name=key.rsplit("/", 1)[-1])
+                    seen.add(key)
+                out = []
+                batch_events: List[Tuple[int, str, watchpkg.Event, Any]] = []
+                for key, obj, ttl in entries:
+                    rev = self._bump()
+                    if owned_meta:
+                        obj.metadata.resource_version = str(rev)
+                    else:
+                        obj = _with_rv(obj, rev)
+                    expiry = now + ttl if ttl else None
+                    self._data[key] = (obj, rev, expiry)
+                    self._index_add(key)
+                    if expiry is not None:
+                        heapq.heappush(self._expiry_heap, (expiry, key))
+                        self._ttl_segs.add(self._seg(key))
+                    batch_events.append(
+                        (rev, key,
+                         self._record(rev, watchpkg.ADDED, key, obj, None),
+                         None))
+                    out.append(obj)
+                self._stage_publish(batch_events)
+                if self._publish_inline:
+                    self._drain_publish()
+                return out
+        finally:
+            self._drain_publish()
 
     def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         """Unconditional write (ref: etcd_helper Set)."""
-        with self._lock:
-            self._gc_expired()
-            rev = self._bump()
-            obj = _with_rv(obj, rev)
-            expiry = time.time() + ttl if ttl else None
-            prev = self._data.get(key)
-            self._data[key] = (obj, rev, expiry)
-            if prev is None:
-                self._index_add(key)
-            if expiry is not None:
-                heapq.heappush(self._expiry_heap, (expiry, key))
-                self._ttl_segs.add(self._seg(key))
-            etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
-            self._emit(rev, etype, key, obj, prev[0] if prev else None)
-            return obj
+        try:
+            with self._lock:
+                self._gc_expired()
+                rev = self._bump()
+                obj = _with_rv(obj, rev)
+                expiry = time.time() + ttl if ttl else None
+                prev = self._data.get(key)
+                self._data[key] = (obj, rev, expiry)
+                if prev is None:
+                    self._index_add(key)
+                if expiry is not None:
+                    heapq.heappush(self._expiry_heap, (expiry, key))
+                    self._ttl_segs.add(self._seg(key))
+                etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
+                self._emit(rev, etype, key, obj, prev[0] if prev else None)
+                if self._publish_inline:
+                    self._drain_publish()
+                return obj
+        finally:
+            self._drain_publish()
 
     def update(self, key: str, obj: Any) -> Any:
         """Conditional write: obj.metadata.resource_version must match the
         stored revision (the optimistic-concurrency check every PUT gets,
         ref: pkg/registry/generic/etcd/etcd.go:270-316)."""
-        with self._lock:
-            self._gc_expired()
-            entry = self._data.get(key)
-            if entry is None:
-                raise NotFound(name=key)
-            stored, mod_rev, expiry = entry
-            rv = obj.metadata.resource_version
-            if rv and int(rv) != mod_rev:
-                raise Conflict(
-                    f"operation on {key} failed: object was modified "
-                    f"(have {rv}, current {mod_rev})")
-            rev = self._bump()
-            obj = _with_rv(obj, rev)
-            self._data[key] = (obj, rev, expiry)
-            self._emit(rev, watchpkg.MODIFIED, key, obj, stored)
-            return obj
+        try:
+            with self._lock:
+                self._gc_expired()
+                entry = self._data.get(key)
+                if entry is None:
+                    raise NotFound(name=key)
+                stored, mod_rev, expiry = entry
+                rv = obj.metadata.resource_version
+                if rv and int(rv) != mod_rev:
+                    raise Conflict(
+                        f"operation on {key} failed: object was modified "
+                        f"(have {rv}, current {mod_rev})")
+                rev = self._bump()
+                obj = _with_rv(obj, rev)
+                self._data[key] = (obj, rev, expiry)
+                self._emit(rev, watchpkg.MODIFIED, key, obj, stored)
+                if self._publish_inline:
+                    self._drain_publish()
+                return obj
+        finally:
+            self._drain_publish()
 
     def guaranteed_update(self, key: str, fn: Callable[[Any], Any],
                           retries: int = 10,
@@ -426,41 +543,51 @@ class Store:
         ttl, when given, REFRESHES the entry's expiry (the rv-less PUT
         path for TTL'd resources extends the deadline on every write,
         matching the old get+set behavior)."""
-        for _ in range(retries):
+        try:
+            for _ in range(retries):
+                with self._lock:
+                    self._gc_expired()
+                    entry = self._data.get(key)
+                    if entry is None:
+                        raise NotFound(name=key)
+                    stored, mod_rev, expiry = entry
+                    new_obj = fn(stored)
+                    if self._data.get(key, (None, -1, None))[1] != mod_rev:
+                        continue  # concurrent write between read and write
+                    rev = self._bump()
+                    new_obj = _with_rv(new_obj, rev)
+                    if ttl is not None:
+                        expiry = time.time() + ttl
+                        heapq.heappush(self._expiry_heap, (expiry, key))
+                        self._ttl_segs.add(self._seg(key))
+                    self._data[key] = (new_obj, rev, expiry)
+                    self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
+                    if self._publish_inline:
+                        self._drain_publish()
+                    return new_obj
+            raise Conflict(f"guaranteed_update on {key}: too many retries")
+        finally:
+            self._drain_publish()
+
+    def delete(self, key: str, expect_rv: Optional[str] = None) -> Any:
+        try:
             with self._lock:
                 self._gc_expired()
                 entry = self._data.get(key)
                 if entry is None:
                     raise NotFound(name=key)
-                stored, mod_rev, expiry = entry
-                new_obj = fn(stored)
-                if self._data.get(key, (None, -1, None))[1] != mod_rev:
-                    continue  # concurrent write between read and write
+                stored, mod_rev, _ = entry
+                if expect_rv and int(expect_rv) != mod_rev:
+                    raise Conflict(f"delete {key}: revision mismatch")
+                del self._data[key]
+                self._index_del(key)
                 rev = self._bump()
-                new_obj = _with_rv(new_obj, rev)
-                if ttl is not None:
-                    expiry = time.time() + ttl
-                    heapq.heappush(self._expiry_heap, (expiry, key))
-                    self._ttl_segs.add(self._seg(key))
-                self._data[key] = (new_obj, rev, expiry)
-                self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
-                return new_obj
-        raise Conflict(f"guaranteed_update on {key}: too many retries")
-
-    def delete(self, key: str, expect_rv: Optional[str] = None) -> Any:
-        with self._lock:
-            self._gc_expired()
-            entry = self._data.get(key)
-            if entry is None:
-                raise NotFound(name=key)
-            stored, mod_rev, _ = entry
-            if expect_rv and int(expect_rv) != mod_rev:
-                raise Conflict(f"delete {key}: revision mismatch")
-            del self._data[key]
-            self._index_del(key)
-            rev = self._bump()
-            self._emit(rev, watchpkg.DELETED, key, stored, stored)
-            return stored
+                self._emit(rev, watchpkg.DELETED, key, stored, stored)
+                if self._publish_inline:
+                    self._drain_publish()
+                return stored
+        finally:
+            self._drain_publish()
 
     def batch(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]) -> List[Any]:
         """Apply many guaranteed-updates under ONE lock acquisition with one
@@ -475,74 +602,87 @@ class Store:
         per drain this loop IS the host-side commit cost
         (PROFILE_e2e.md's bind/status whales)."""
         out = []
-        with self._lock:
-            self._gc_expired()
-            # Two-phase: run every update function first, then commit.  A
-            # mid-batch failure therefore commits nothing (all-or-nothing),
-            # so the scheduler always knows whether a tile of bindings is
-            # durable.
-            # Revisions are pre-assigned during staging (we hold the
-            # lock, so rev0+1..rev0+n are ours): an update fn marked
-            # `wants_rv` receives the final resourceVersion and builds
-            # the stamped object in ONE construction pass instead of
-            # fn's clone + a second _with_rv clone — the 30k-binding
-            # tile pays 4 object clones per pod otherwise.
-            rev = self._rev
-            staged = []
-            stage = staged.append
-            data_get = self._data.get
-            for key, fn in ops:
-                entry = data_get(key)
-                if entry is None:
-                    raise NotFound(name=key)
-                stored, _mod_rev, expiry = entry
-                rev += 1
-                if getattr(fn, "wants_rv", False):
-                    new_obj = fn(stored, str(rev))
-                else:
-                    new_obj = _with_rv(fn(stored), rev)
-                stage((key, new_obj, stored, expiry, rev))
-            batch_events: List[Tuple[str, watchpkg.Event, Any]] = []
-            ev_append = batch_events.append
-            out_append = out.append
-            data = self._data
-            hist = self._history
-            hist_append = hist.append
-            hist_max = hist.maxlen
-            segs = set()
-            modified = watchpkg.MODIFIED
-            event = watchpkg.Event
-            for key, new_obj, stored, expiry, rev in staged:
-                data[key] = (new_obj, rev, expiry)
-                segs.add(self._seg(key))
-                if len(hist) == hist_max:
-                    self._oldest_rev = hist[0][0]
-                hist_append((rev, modified, key, new_obj, stored))
-                ev_append((key, event(modified, new_obj), stored))
-                out_append(new_obj)
-            if staged:
-                self._rev = staged[-1][4]
-                for seg in segs:
-                    self._seg_writes[seg] = \
-                        self._seg_writes.get(seg, 0) + 1
-                if self._list_cache:
-                    # all batch events are MODIFIED: patch snapshots in
-                    # place (key set and sort order unchanged)
-                    for key, new_obj, _stored, _exp, _rev in staged:
-                        self._patch_lists(key, new_obj)
-            # one send per watcher for the whole tile, not per object
-            # (the fan-out was ~half the measured binding commit cost)
-            self._fanout(batch_events)
+        try:
+            with self._lock:
+                self._gc_expired()
+                # Two-phase: run every update function first, then commit.
+                # A mid-batch failure therefore commits nothing
+                # (all-or-nothing), so the scheduler always knows whether
+                # a tile of bindings is durable.
+                # Revisions are pre-assigned during staging (we hold the
+                # lock, so rev0+1..rev0+n are ours): an update fn marked
+                # `wants_rv` receives the final resourceVersion and builds
+                # the stamped object in ONE construction pass instead of
+                # fn's clone + a second _with_rv clone — the 30k-binding
+                # tile pays 4 object clones per pod otherwise.
+                rev = self._rev
+                staged = []
+                stage = staged.append
+                data_get = self._data.get
+                for key, fn in ops:
+                    entry = data_get(key)
+                    if entry is None:
+                        raise NotFound(name=key)
+                    stored, _mod_rev, expiry = entry
+                    rev += 1
+                    if getattr(fn, "wants_rv", False):
+                        new_obj = fn(stored, str(rev))
+                    else:
+                        new_obj = _with_rv(fn(stored), rev)
+                    stage((key, new_obj, stored, expiry, rev))
+                batch_events: List[Tuple[int, str, watchpkg.Event,
+                                         Any]] = []
+                ev_append = batch_events.append
+                out_append = out.append
+                data = self._data
+                hist = self._history
+                hist_append = hist.append
+                hist_max = hist.maxlen
+                segs = set()
+                modified = watchpkg.MODIFIED
+                event = watchpkg.Event
+                for key, new_obj, stored, expiry, rev in staged:
+                    data[key] = (new_obj, rev, expiry)
+                    segs.add(self._seg(key))
+                    if len(hist) == hist_max:
+                        self._oldest_rev = hist[0][0]
+                    hist_append((rev, modified, key, new_obj, stored))
+                    ev_append((rev, key, event(modified, new_obj), stored))
+                    out_append(new_obj)
+                if staged:
+                    self._rev = staged[-1][4]
+                    for seg in segs:
+                        self._seg_writes[seg] = \
+                            self._seg_writes.get(seg, 0) + 1
+                    if self._list_cache:
+                        # all batch events are MODIFIED: patch snapshots
+                        # in place (key set and sort order unchanged)
+                        for key, new_obj, _stored, _exp, _rev in staged:
+                            self._patch_lists(key, new_obj)
+                # one send per watcher for the whole tile, not per
+                # object — and the whole fan-out runs AFTER this lock
+                # releases (the fan-out was ~half the measured in-lock
+                # binding commit cost)
+                self._stage_publish(batch_events)
+                if self._publish_inline:
+                    self._drain_publish()
+        finally:
+            self._drain_publish()
         return out
 
     # ------------------------------------------------------------- reads
 
     def get(self, key: str) -> Any:
-        with self._lock:
-            entry = self._data.get(key)
-            if entry is None or self._expired(entry, time.time()):
-                raise NotFound(name=key)
-            return entry[0]
+        # Lock-free point read: _data maps keys to IMMUTABLE tuples that
+        # writers swap atomically under the GIL, so a dict .get observes
+        # either the pre- or post-commit entry — both valid snapshots —
+        # and never a torn one. GETs therefore no longer queue behind a
+        # committer's ledger window (the DENSITY.json GET-/nodes p99
+        # whale was reads parked on this lock during the create storm).
+        entry = self._data.get(key)
+        if entry is None or self._expired(entry, time.time()):
+            raise NotFound(name=key)
+        return entry[0]
 
     def list(self, prefix: str,
              predicate: Optional[Callable[[Any], bool]] = None
@@ -630,33 +770,67 @@ class Store:
         non-matching events out of the watcher queue entirely). Events
         are mapped through the reference's filtered-watch transition
         semantics — see _filtered_event.
+
+        Mid-flight registration (commits in their publish phase): under
+        _pub_lock the publisher is quiescent and _published_rev frozen.
+        History is replayed only up to _published_rev; anything already
+        committed to the ledger but not yet fanned out is delivered by
+        the publisher, because this watcher registers (with floor =
+        max(since_rev, _published_rev)) before _pub_lock is released.
+        Exactly-once across the replay->live handoff, in revision order.
         """
-        with self._lock:
-            replay = []
-            if since_rev is not None:
-                if since_rev < self._oldest_rev:
-                    raise Expired(
-                        f"resourceVersion {since_rev} is too old "
-                        f"(oldest available {self._oldest_rev})")
-                for rev, etype, key, obj, prev in self._history:
-                    if rev <= since_rev or not key.startswith(prefix):
-                        continue
-                    ev = watchpkg.Event(etype, obj)
-                    if predicate is not None:
-                        ev = self._filtered_event(ev, prev, predicate)
-                        if ev is None:
+        try:
+            return self._watch_register(prefix, since_rev, capacity,
+                                        predicate)
+        finally:
+            # batches committed while registration held _pub_lock
+            # skipped their drain (non-blocking acquire): flush them
+            # even when registration raises Expired
+            self._drain_publish()
+
+    def _watch_register(self, prefix: str, since_rev: Optional[int],
+                        capacity: int,
+                        predicate: Optional[Callable[[Any], bool]]
+                        ) -> watchpkg.Watcher:
+        with self._pub_lock:
+            with self._lock:
+                replay = []
+                if since_rev is None:
+                    # "from now": everything already committed — even if
+                    # its publish is still queued — predates this watch
+                    floor = self._rev
+                else:
+                    if since_rev < self._oldest_rev:
+                        raise Expired(
+                            f"resourceVersion {since_rev} is too old "
+                            f"(oldest available {self._oldest_rev})")
+                    published = self._published_rev
+                    floor = max(since_rev, published)
+                    for rev, etype, key, obj, prev in self._history:
+                        if rev <= since_rev or rev > published \
+                                or not key.startswith(prefix):
                             continue
-                    replay.append(ev)
-            # Size the queue to hold the whole replay: a blocking send here
-            # would deadlock the store (no consumer can run until we return).
+                        ev = watchpkg.Event(etype, obj)
+                        if predicate is not None:
+                            ev = self._filtered_event(ev, prev, predicate)
+                            if ev is None:
+                                continue
+                        replay.append(ev)
+            # Size the queue to hold the whole replay: a blocking send
+            # here would deadlock the store (no consumer can run until
+            # we return). One send_many = one queue slot for the whole
+            # replay (send_many admits an oversized batch into an empty
+            # watcher).
             w = watchpkg.Watcher(max(capacity, len(replay) + 16))
-            for ev in replay:
-                w.send(ev)
-            self._watchers.append((prefix, predicate, w))
-            return w
+            if replay:
+                w.send_many(replay, owned=True)
+            self._watchers.append((prefix, predicate, w, floor))
+        return w
 
     def watcher_count(self) -> int:
-        with self._lock:
-            self._watchers = [(p, f, w) for p, f, w in self._watchers
-                              if not w.stopped]
-            return len(self._watchers)
+        with self._pub_lock:
+            self._watchers = [e for e in self._watchers
+                              if not e[2].stopped]
+            n = len(self._watchers)
+        self._drain_publish()  # flush batches parked while we held the lock
+        return n

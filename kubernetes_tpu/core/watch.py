@@ -60,13 +60,17 @@ class Watcher:
             self._cond.notify()
         return True
 
-    def send_many(self, events: List[Event]) -> bool:
+    def send_many(self, events: List[Event], owned: bool = False) -> bool:
         """Enqueue a batch as ONE queue slot — the store's tile-commit
         fan-out (30k bindings = a handful of puts per watcher instead of
         30k lock/notify cycles each). Consumers unwrap transparently.
         A single batch larger than capacity is admitted into an EMPTY
         watcher (it isn't lagging — the commit is just big); a watcher
-        already holding events gets the strict bound."""
+        already holding events gets the strict bound.
+
+        owned=True: the caller hands the list over and never touches it
+        again (the store's publisher builds one fresh list per watcher
+        per batch) — skip the defensive copy."""
         if not events:
             return True
         if self._stopped.is_set():
@@ -76,7 +80,7 @@ class Watcher:
             if self._count + n > self.capacity and self._count > 0:
                 return False
             self._count += n
-            self._dq.append(list(events))
+            self._dq.append(events if owned else list(events))
             self._cond.notify()
         return True
 

@@ -282,15 +282,19 @@ class Kubelet:
         if is_mirror_pod(pod):
             return
         if (pod.metadata.deletion_timestamp is not None
-                and (old is None
-                     or old.metadata.deletion_timestamp is None)
                 and not is_static_pod(pod)):
             # graceful deletion observed: the apiserver marked the pod
             # (registry._pod_graceful_delete) instead of dropping it;
             # the kubelet drains (PreStop hooks + kill) and CONFIRMS
             # with a grace-0 delete once teardown completes (ref:
             # kubelet.go syncLoop deletion handling + the status
-            # manager's terminated-pod api delete)
+            # manager's terminated-pod api delete). ANY update of a
+            # marked pod is terminating — not just the None->set
+            # transition: a second delete (shorter grace re-stamp) or a
+            # PUT/PATCH to a terminating pod used to fall through to
+            # the normal path, re-add the pod to _pods, and the worker
+            # restarted its containers mid-drain (ADVICE.md medium);
+            # handle_pod_deletion dedupes the re-entrant teardown.
             self.handle_pod_deletion(pod, confirm_api_delete=True)
             return
         with self._lock:
@@ -341,7 +345,13 @@ class Kubelet:
         # scopes kills to per-pod workers the same way. The uid is
         # marked mid-teardown so housekeeping's orphan sweep doesn't
         # kill the containers out from under a running PreStop hook.
+        # Re-entrant deletes (every MODIFIED on a marked pod routes
+        # here) dedupe on that same marker: a second teardown thread
+        # would re-run PreStop hooks against dying containers and its
+        # stale-bail could strand the API confirm.
         with self._lock:
+            if uid in self._tearing_down:
+                return  # a teardown is already draining this pod
             self._tearing_down.add(uid)
         threading.Thread(target=self._tear_down_pod,
                          args=(pod, confirm_api_delete),
@@ -354,12 +364,13 @@ class Kubelet:
         deletion order the reference keeps; failures stay tracked for
         housekeeping retries."""
         uid = pod.metadata.uid
+        completed = False
         try:
-            self._tear_down_pod_inner(pod)
+            completed = self._tear_down_pod_inner(pod)
         finally:
             with self._lock:
                 self._tearing_down.discard(uid)
-        if confirm_api_delete:
+        if confirm_api_delete and completed:
             # graceful deletion's second half: containers are down, so
             # confirm with a grace-0, uid-guarded delete that actually
             # removes the marked pod from storage (the reference's
@@ -371,7 +382,10 @@ class Kubelet:
             from ..api.client import confirm_pod_deletion
             confirm_pod_deletion(self.client, pod)
 
-    def _tear_down_pod_inner(self, pod: api.Pod) -> None:
+    def _tear_down_pod_inner(self, pod: api.Pod) -> bool:
+        """-> True when the pod was actually torn down; False on the
+        stale bail (the caller must then NOT confirm the API delete —
+        deleting the object out from under a live re-incarnation)."""
         uid = pod.metadata.uid
         for container in pod.spec.containers:
             try:
@@ -383,7 +397,7 @@ class Kubelet:
                 # re-added during the hooks (a static pod's manifest
                 # restored): this teardown is stale — killing now would
                 # destroy the NEW incarnation
-                return
+                return False
         if self.network_plugin is not None and uid in self._networked:
             # teardown before the pod is killed (exec.go: teardown
             # before the infra container dies); a failed teardown stays
@@ -413,12 +427,20 @@ class Kubelet:
             else:
                 with self._lock:
                     self._mounted.discard(uid)
+        return True
 
     # ----------------------------------------------------------- syncPod
 
     def sync_pod(self, pod: api.Pod) -> None:
         """(kubelet.go:1597 syncPod, against the runtime's view)"""
         uid = pod.metadata.uid
+        if (pod.metadata.deletion_timestamp is not None
+                and not is_static_pod(pod)):
+            # terminating: the teardown path owns this pod (the
+            # reference's syncPod checks DeletionTimestamp before
+            # running anything) — a worker update racing the drain must
+            # never restart containers a teardown is killing
+            return
         if is_static_pod(pod):
             # keep the apiserver reflection alive so the static pod is
             # visible (and carries status) cluster-wide; the periodic

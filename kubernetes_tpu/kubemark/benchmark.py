@@ -91,10 +91,16 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                              max_pods_per_node: int = 32,
                              wait_running: bool = False,
                              timeout_s: float = 300.0,
-                             registry: Optional[Registry] = None
+                             registry: Optional[Registry] = None,
+                             store_publish_inline: bool = False
                              ) -> BenchmarkResult:
     """Stand up master + fleet + scheduler, blast pods from 30 writers,
-    measure time until every pod is bound (and optionally Running)."""
+    measure time until every pod is bound (and optionally Running).
+
+    store_publish_inline: build the registry over a store that fans
+    watch events out while still holding its ledger lock — the
+    pre-split commit serialization, kept as the control arm of
+    bench.py's --store-ab."""
     # GIL slice: r2 measured 1ms best (the scheduler thread parked
     # behind 30 writers at every dispatch); after r4's contention fixes
     # (thread-local uids, in-place rv stamping, informer-riding
@@ -102,6 +108,9 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     # threads — and tightens the run-to-run spread (A/B in PROFILE_e2e.md)
     import sys
     sys.setswitchinterval(0.005)
+    if registry is None and store_publish_inline:
+        from ..core.store import Store
+        registry = Registry(store=Store(publish_inline=True))
     registry = registry or Registry()
     client = InProcClient(registry)
     # heartbeats quiesce during the measured window: the reference's
@@ -243,9 +252,14 @@ def main() -> None:
     ap.add_argument("--pods", type=int, default=1000)
     ap.add_argument("--mode", choices=["batch", "serial"], default="batch")
     ap.add_argument("--wait-running", action="store_true")
+    ap.add_argument("--store-publish-inline", action="store_true",
+                    help="control arm: fan watch events out under the "
+                         "store's ledger lock (pre-split behavior)")
     args = ap.parse_args()
-    r = run_scheduling_benchmark(args.nodes, args.pods, args.mode,
-                                 wait_running=args.wait_running)
+    r = run_scheduling_benchmark(
+        args.nodes, args.pods, args.mode,
+        wait_running=args.wait_running,
+        store_publish_inline=args.store_publish_inline)
     print(json.dumps({
         "metric": f"e2e_scheduling_throughput_{r.mode}",
         "nodes": r.n_nodes, "pods": r.n_pods, "scheduled": r.scheduled,

@@ -188,9 +188,12 @@ class HollowFleet:
             # confirm them Running in ONE batched store pass instead of
             # per-pod writes fighting the GIL (per-object semantics are
             # unchanged; see registry.update_status_batch)
-            # 1024 bounds the store-lock window (an 8k-pod status tile
+            # 1024 bounds the ledger-lock window (an 8k-pod status tile
             # held the lock long enough to push concurrent API reads
-            # over the latency SLO; see sched/batch.py commit_chunk)
+            # over the latency SLO). The two-phase store split halved
+            # the per-tile lock hold, but the 5000x30000 A/B kept 1024
+            # ahead of 2048 on the 1-core box — see sched/batch.py
+            # commit_chunk for the numbers.
             batch = [pod]
             while len(batch) < 1024:
                 try:

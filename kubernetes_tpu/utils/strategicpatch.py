@@ -112,10 +112,20 @@ def strategic_patch(current: Dict, patch: Dict) -> Dict:
     nulls delete, maps recurse, patchMergeKey lists merge by element,
     other lists replace wholesale. The patch.go directives are
     honored: a map carrying `"$patch": "replace"` replaces instead of
-    merging, and a keyed list element carrying `"$patch": "delete"`
-    removes its counterpart; directive markers never persist."""
-    if patch.get(_DIRECTIVE) == "replace":
+    merging, a map carrying `"$patch": "delete"` empties it (the
+    reference's mergeMap returns an empty map), a keyed list element
+    carrying `"$patch": "delete"` removes its counterpart, and any
+    OTHER directive value raises ValueError (mergeMap's "Unknown patch
+    type" error — the apiserver surfaces it as a 400); directive
+    markers never persist."""
+    directive = patch.get(_DIRECTIVE)
+    if directive == "replace":
         return {k: v for k, v in patch.items() if k != _DIRECTIVE}
+    if directive == "delete":
+        return {}
+    if directive is not None:
+        raise ValueError(
+            f"unknown patch type: {directive!r} in map {patch!r}")
     out = dict(current)
     for key, pval in patch.items():
         if key == _DIRECTIVE:

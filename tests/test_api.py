@@ -701,6 +701,27 @@ def test_websocket_watch():
         srv.stop()
 
 
+def test_service_ip_fields_accept_ipv6():
+    """validate_service parses address fields like the reference's
+    net.ParseIP: IPv4 dotted-quad or IPv6, nothing else (inet_aton
+    shorthand like "127.1" stays rejected)."""
+    from kubernetes_tpu.api.registry import validate_service
+
+    def svc(lb_ip="", ext=None):
+        return api.Service(
+            metadata=api.ObjectMeta(name="s", namespace="default"),
+            spec=api.ServiceSpec(load_balancer_ip=lb_ip,
+                                 external_ips=ext or []))
+
+    validate_service(svc(lb_ip="2001:db8::1"))
+    validate_service(svc(ext=["192.0.2.7", "2001:db8::2"]))
+    for bad in ("127.1", "not-an-ip", "2001:db8::zz"):
+        with pytest.raises(Invalid):
+            validate_service(svc(lb_ip=bad))
+        with pytest.raises(Invalid):
+            validate_service(svc(ext=[bad]))
+
+
 # ------------------------------------------------------- batched create
 
 def test_registry_create_batch_matches_create():

@@ -591,6 +591,183 @@ def test_list_snapshot_patched_in_place_on_modify():
     assert len(items5) == 5
 
 
+# ------------------------------------- two-phase commit publish ordering
+
+def test_midflight_watcher_live_only_handoff():
+    """Commits whose publish is still queued when a watcher registers
+    must reach it exactly once via the LIVE path: replay stops at the
+    published revision, the per-watcher floor covers the rest."""
+    s = Store()
+    # park the publisher: ledger commits land, fan-out stays queued
+    # (committers skip a busy publisher instead of blocking on it)
+    assert s._pub_lock.acquire(timeout=1)
+    for i in range(3):
+        s.create(pod_key("default", f"q{i}"), make_pod(f"q{i}"))
+    assert s.current_revision == 3 and s._published_rev == 0
+    holder = {}
+    th = threading.Thread(
+        target=lambda: holder.update(w=s.watch("/registry/pods/",
+                                               since_rev=0)))
+    th.start()          # registration parks behind the held publish lock
+    time.sleep(0.05)
+    s._pub_lock.release()
+    th.join(timeout=5)
+    w = holder["w"]
+    evs = [w.next(timeout=1) for _ in range(3)]
+    assert [int(e.object.metadata.resource_version) for e in evs] == \
+        [1, 2, 3]
+    assert all(e.type == watchpkg.ADDED for e in evs)
+    assert w.next(timeout=0.1) is None      # exactly once — no replays
+    w.stop()
+
+
+def test_midflight_watcher_replay_plus_live_handoff():
+    """Replay (published prefix) and live (still-queued suffix) hand
+    off without duplication or gaps, in revision order."""
+    s = Store()
+    s.create(pod_key("default", "r0"), make_pod("r0"))   # published
+    assert s._published_rev == 1
+    assert s._pub_lock.acquire(timeout=1)
+    s.create(pod_key("default", "r1"), make_pod("r1"))   # queued
+    s.create(pod_key("default", "r2"), make_pod("r2"))   # queued
+    holder = {}
+    th = threading.Thread(
+        target=lambda: holder.update(w=s.watch("/registry/pods/",
+                                               since_rev=0)))
+    th.start()
+    time.sleep(0.05)
+    s._pub_lock.release()
+    th.join(timeout=5)
+    w = holder["w"]
+    evs = [w.next(timeout=1) for _ in range(3)]
+    assert [e.object.metadata.name for e in evs] == ["r0", "r1", "r2"]
+    assert [int(e.object.metadata.resource_version) for e in evs] == \
+        [1, 2, 3]
+    assert w.next(timeout=0.1) is None
+    w.stop()
+
+
+def test_concurrent_committers_publish_in_revision_order():
+    """The three-committer shape (create storm + CAS batches) against
+    watchers registering mid-flight: every watcher sees every event
+    under the prefix exactly once, in strictly increasing revision
+    order, whether it arrived via replay or live fan-out."""
+    from dataclasses import replace
+
+    s = Store()
+    base = [s.create(pod_key("default", f"seed-{i}"), make_pod(f"seed-{i}"))
+            for i in range(8)]
+    start_rev = s.current_revision
+    n_writers, per_writer, n_cas = 4, 100, 100
+    stop_reg = threading.Event()
+    watchers = [s.watch("/registry/pods/", since_rev=0)]
+
+    def creator(wid):
+        for lo in range(0, per_writer, 5):
+            s.create_batch([
+                (pod_key("default", f"w{wid}-{lo + j}"),
+                 make_pod(f"w{wid}-{lo + j}"), None)
+                for j in range(5)])
+            time.sleep(0.001)   # leave registration windows in the storm
+
+    def cas_batcher():
+        def bump(p):
+            return replace(p, metadata=replace(
+                p.metadata, generation=p.metadata.generation + 1))
+        for _ in range(n_cas // 4):
+            s.batch([(pod_key("default", f"seed-{i}"), bump)
+                     for i in range(4)])
+            time.sleep(0.001)
+
+    def registrar():
+        while not stop_reg.is_set() and len(watchers) < 16:
+            watchers.append(s.watch("/registry/pods/", since_rev=0))
+            time.sleep(0)   # yield: interleave with the committers
+
+    threads = ([threading.Thread(target=creator, args=(wid,))
+                for wid in range(n_writers)]
+               + [threading.Thread(target=cas_batcher),
+                  threading.Thread(target=registrar)])
+    for t in threads:
+        t.start()
+    for t in threads[:-1]:
+        t.join()
+    stop_reg.set()
+    threads[-1].join()
+
+    total = start_rev + n_writers * per_writer + n_cas
+    assert s.current_revision == total
+    assert len(watchers) >= 3   # some genuinely registered mid-flight
+    for w in watchers:
+        revs = []
+        while len(revs) < total:
+            ev = w.next(timeout=5)
+            assert ev is not None, \
+                f"watcher starved at {len(revs)}/{total}"
+            revs.append(int(ev.object.metadata.resource_version))
+        # every commit exactly once, in strict revision order, and
+        # nothing extra after the last one
+        assert revs == list(range(1, total + 1))
+        assert w.next(timeout=0.05) is None
+        w.stop()
+
+
+def test_from_now_watcher_sees_contiguous_suffix_under_storm():
+    """since_rev=None during a commit storm: whatever the watcher sees
+    is a dup-free, gap-free suffix of the committed revisions."""
+    s = Store()
+    stop = threading.Event()
+
+    def churner():
+        i = 0
+        while not stop.is_set():
+            s.create(pod_key("default", f"n{i}"), make_pod(f"n{i}"))
+            i += 1
+
+    th = threading.Thread(target=churner)
+    th.start()
+    time.sleep(0.01)
+    w = s.watch("/registry/pods/")          # from now, mid-storm
+    time.sleep(0.05)
+    stop.set()
+    th.join()
+    final = s.current_revision
+    revs = []
+    while True:
+        ev = w.next(timeout=0.2)
+        if ev is None:
+            break
+        revs.append(int(ev.object.metadata.resource_version))
+    w.stop()
+    assert revs == list(range(revs[0], revs[-1] + 1)) if revs else True
+    if revs:
+        assert revs[-1] == final            # nothing dropped at the tail
+
+
+def test_filtered_watch_transitions_survive_offlock_publish():
+    """The ADDED/DELETED transition mapping (filtered watch) is applied
+    by the publisher, off the ledger lock — semantics unchanged from
+    the in-lock fan-out, including through a CAS batch."""
+    from dataclasses import replace
+
+    s = Store()
+    for i in range(4):
+        s.create(pod_key("default", f"f{i}"), make_pod(f"f{i}"))
+    unassigned = s.watch("/registry/pods/",
+                         predicate=lambda p: not p.spec.node_name)
+
+    def bind(p):
+        return replace(p, spec=replace(p.spec, node_name="n1"))
+
+    s.batch([(pod_key("default", f"f{i}"), bind) for i in range(4)])
+    evs = [unassigned.next(timeout=1) for _ in range(4)]
+    # all four left the selector in one batch: DELETED, current object
+    assert all(e.type == watchpkg.DELETED for e in evs)
+    assert all(e.object.spec.node_name == "n1" for e in evs)
+    assert unassigned.next(timeout=0.1) is None
+    unassigned.stop()
+
+
 def test_field_getters_mirror_dict_builders():
     """The compiled field-selector fast path (registry._compile_field_pred)
     reads attributes via *_FIELD_GETTERS; each getter must produce

@@ -171,6 +171,45 @@ class TestKubeletSync:
         assert wait_until(
             lambda: runtime.running_containers("u-gone") == [], timeout=10)
 
+    def test_terminating_pod_update_never_resurrects(self, kubelet_env):
+        """Any event on a pod with deletionTimestamp set is terminating
+        (the reference's syncPod checks DeletionTimestamp): a re-stamp
+        (second delete with shorter grace) or PUT to a marked pod must
+        not re-add it to the worker set or restart its containers, and
+        re-entrant teardowns dedupe on _tearing_down."""
+        import dataclasses
+        registry, client, runtime, kubelet = kubelet_env
+        created = bound_pod(client, "doomed", "u-doom")
+        assert wait_until(
+            lambda: runtime.running_containers("u-doom"))
+
+        def marked(base, grace):
+            return dataclasses.replace(base, metadata=dataclasses.replace(
+                base.metadata, deletion_timestamp="2099-01-01T00:00:00Z",
+                deletion_grace_period_seconds=grace))
+
+        kubelet.handle_pod_update(created, marked(created, 30))
+        assert wait_until(
+            lambda: runtime.running_containers("u-doom") == [])
+        # a second delete re-stamps a shorter grace: MODIFIED on an
+        # already-marked pod — must not resurrect
+        kubelet.handle_pod_update(marked(created, 30), marked(created, 5))
+        # a racing worker sync on the marked pod must not start anything
+        kubelet.sync_pod(marked(created, 5))
+        time.sleep(0.2)
+        assert runtime.running_containers("u-doom") == []
+        assert "u-doom" not in kubelet._pods
+
+    def test_sync_pod_skips_terminating(self, kubelet_env):
+        """sync_pod bails before any setup/start for a marked pod."""
+        import dataclasses
+        registry, client, runtime, kubelet = kubelet_env
+        pod = mkpod("ghost", "u-ghost")
+        pod = dataclasses.replace(pod, metadata=dataclasses.replace(
+            pod.metadata, deletion_timestamp="2099-01-01T00:00:00Z"))
+        kubelet.sync_pod(pod)
+        assert runtime.running_containers("u-ghost") == []
+
     def test_liveness_failure_restarts(self, kubelet_env):
         registry, client, runtime, kubelet = kubelet_env
         health = {"ok": True}

@@ -80,6 +80,51 @@ class TestThreeWayMerge:
         assert labels == {"mine": "2", "server": "s"}
 
 
+class TestPatchDirectives:
+    """patch.go's mergeMap directive arms: $patch: replace merges
+    nothing, $patch: delete EMPTIES the map (the reference returns an
+    empty map), anything else is an 'Unknown patch type' error the
+    apiserver maps to 400."""
+
+    def test_map_level_delete_directive_empties_the_map(self):
+        from kubernetes_tpu.utils.strategicpatch import strategic_patch
+        out = strategic_patch(
+            {"metadata": {"annotations": {"a": "1", "b": "2"},
+                          "labels": {"keep": "y"}}},
+            {"metadata": {"annotations": {"$patch": "delete"}}})
+        assert out["metadata"]["annotations"] == {}
+        assert out["metadata"]["labels"] == {"keep": "y"}  # untouched
+
+    def test_unknown_map_directive_raises(self):
+        from kubernetes_tpu.utils.strategicpatch import strategic_patch
+        with pytest.raises(ValueError, match="unknown patch type"):
+            strategic_patch({"a": 1}, {"$patch": "merge"})
+        with pytest.raises(ValueError, match="unknown patch type"):
+            strategic_patch(
+                {"spec": {"containers": [{"name": "c", "image": "a"}]}},
+                {"spec": {"containers": [
+                    {"name": "c", "$patch": "nuke"}]}})
+
+    def test_registry_patch_maps_unknown_directive_to_bad_request(self):
+        from kubernetes_tpu.core.errors import BadRequest
+        registry = Registry()
+        client = InProcClient(registry)
+        client.create("namespaces", api.Namespace(
+            metadata=api.ObjectMeta(name="default")))
+        client.create("pods", api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c",
+                                                       image="img")])))
+        with pytest.raises(BadRequest):
+            registry.patch("pods", "p",
+                           {"metadata": {"$patch": "bogus"}}, "default")
+        # map-level delete lands through the full PATCH verb too
+        out = registry.patch(
+            "pods", "p",
+            {"metadata": {"labels": {"$patch": "delete"}}}, "default")
+        assert out.metadata.labels == {}
+
+
 class TestKubectlApply:
     @pytest.fixture()
     def cluster(self):

@@ -238,6 +238,45 @@ class TestCloudControllers:
         assert [c for c in cloud.calls if c.startswith("delete-lb")] \
             == deletes_after_grant  # no further churn
 
+    def test_ip_attempt_suppression_pruned_with_the_service(self):
+        """_ip_attempts entries for balancers outside the wanted set are
+        dropped during sync: a recreated service (same lb name) gets its
+        one recreate attempt back instead of inheriting the dead
+        suppression, and the map doesn't grow per deleted service."""
+        registry = Registry()
+        client = InProcClient(registry)
+        cloud = FakeCloudProvider()
+        client.create("nodes", api.Node(metadata=api.ObjectMeta(name="n1")))
+        svc = client.create("services", api.Service(
+            metadata=api.ObjectMeta(name="phoenix", namespace="default"),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 load_balancer_ip="203.0.113.5",
+                                 selector={"app": "p"},
+                                 ports=[api.ServicePort(name="h",
+                                                        port=80)])),
+            "default")
+        ctrl = ServiceController(client, cloud)
+        ctrl.sync_once()
+        assert ctrl._ip_attempts  # one-shot suppression recorded
+        client.delete("services", "phoenix", "default")
+        ctrl.sync_once()          # LB torn down AND attempts pruned
+        assert ctrl._ip_attempts == {}
+        # recreate with the SAME uid-derived lb name (uid pinned): the
+        # requested-address recreate path must get to fire again
+        recreated = api.Service(
+            metadata=api.ObjectMeta(name="phoenix", namespace="default",
+                                    uid=svc.metadata.uid),
+            spec=api.ServiceSpec(type="LoadBalancer",
+                                 load_balancer_ip="203.0.113.6",
+                                 selector={"app": "p"},
+                                 ports=[api.ServicePort(name="h",
+                                                        port=80)]))
+        client.create("services", recreated, "default")
+        ctrl.sync_once()
+        assert client.get("services", "phoenix",
+                          "default").status.load_balancer_ingress \
+            == ["203.0.113.6"]
+
     def test_route_controller(self):
         from kubernetes_tpu.cloudprovider import Route
         registry = Registry()

@@ -32,6 +32,19 @@ WAIT_LEAVES = {"wait", "acquire", "_wait_for_tstate_lock", "select",
                "poll", "recv", "accept", "read", "sleep", "epoll",
                "_recv_into", "readinto"}
 
+# Store commit phase split (the two-phase commit decomposition): a
+# sample inside the publish frames is watch fan-out running OFF the
+# ledger lock; a sample inside a commit verb WITHOUT a publish frame is
+# the in-lock ledger window (stage+ledger). The per-role ratio is the
+# direct readout of how much of each committer's store time still
+# holds the lock.
+STORE_PUBLISH_FRAMES = {"store.py:_drain_publish", "store.py:_fanout",
+                        "store.py:_filtered_event"}
+STORE_COMMIT_FRAMES = {"store.py:create", "store.py:create_batch",
+                       "store.py:set", "store.py:update",
+                       "store.py:guaranteed_update", "store.py:delete",
+                       "store.py:batch"}
+
 
 def thread_group(name: str) -> str:
     """Collapse per-instance thread names into roles so 30 writers (or
@@ -115,6 +128,7 @@ def main():
     incl = collections.Counter()       # (group, func) -> count
     by_thread = collections.Counter()  # group -> count
     run_by_thread = collections.Counter()
+    phase = collections.Counter()      # (group, "ledger"|"publish") -> count
     for _ts, snap in window:
         for name, lf, stack in snap:
             g = thread_group(name)
@@ -122,7 +136,12 @@ def main():
             leaf[(g, lf)] += 1
             if lf.rsplit(":", 2)[-2] not in WAIT_LEAVES:
                 run_by_thread[g] += 1
-            for fn in set(stack):
+            frames = set(stack)
+            if frames & STORE_PUBLISH_FRAMES:
+                phase[(g, "publish")] += 1
+            elif frames & STORE_COMMIT_FRAMES:
+                phase[(g, "ledger")] += 1
+            for fn in frames:
                 incl[(g, fn)] += 1
 
     total = sum(by_thread.values())
@@ -168,6 +187,23 @@ leaves.
         for g, c in by_thread.most_common(18):
             f.write(f"| {g} | {c} | {run_by_thread[g]} | "
                     f"{100 * run_by_thread[g] / max(1, n_ticks):.1f}% |\n")
+        f.write("""
+## Store commit: in-lock (ledger) vs publish
+
+Samples inside a store commit verb split by phase — `ledger` frames
+hold the store's ledger lock (stage + mutation), `publish` frames are
+the watch fan-out the two-phase commit moved OFF that lock. The
+in-lock share is what the three committers still serialize on.
+
+| role | ledger (in-lock) | publish (off-lock) | in-lock share |
+|---|---|---|---|
+""")
+        roles = sorted({g for g, _p in phase})
+        for g in roles:
+            led, pub = phase[(g, "ledger")], phase[(g, "publish")]
+            tot = led + pub
+            f.write(f"| {g} | {led} | {pub} | "
+                    f"{100 * led / max(1, tot):.0f}% |\n")
         f.write(f"""
 ## Top leaf lines
 

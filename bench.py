@@ -225,6 +225,15 @@ def main():
                     help="run one extra e2e pass with watch fan-out "
                          "held under the store's ledger lock (the "
                          "pre-two-phase commit path) and report both")
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="also record one e2e pass under the seeded "
+                         "chaos injector (chaos.ChaosClient, "
+                         "--chaos-rate faults on every verb) — the "
+                         "throughput-under-fault-load arm; the "
+                         "headline number stays fault-free")
+    ap.add_argument("--chaos-rate", type=float, default=0.01,
+                    help="per-verb injected fault probability for the "
+                         "--chaos-seed arm (default 0.01)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -291,6 +300,26 @@ def main():
             print(f"# store A/B inline {ctl.pods_per_sec:.0f} vs "
                   f"off-lock {r.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
+    chaos = None
+    if args.chaos_seed is not None:
+        # the fault-load arm: same shape, every component client wrapped
+        # in the seeded injector — records how much throughput survives
+        # a faulty control plane (and that the run converges at all)
+        cr = run_scheduling_benchmark(args.nodes, args.pods, "batch",
+                                      chaos_seed=args.chaos_seed,
+                                      chaos_error_rate=args.chaos_rate)
+        chaos = {
+            "seed": args.chaos_seed,
+            "error_rate": args.chaos_rate,
+            "pods_per_sec": round(cr.pods_per_sec, 1),
+            "elapsed_s": round(cr.elapsed_s, 2),
+            "scheduled": cr.scheduled,
+            "vs_fault_free": (round(cr.pods_per_sec / r.pods_per_sec, 3)
+                              if r.pods_per_sec else None)}
+        if args.verbose:
+            print(f"# chaos[seed={args.chaos_seed} "
+                  f"rate={args.chaos_rate}] {cr.pods_per_sec:.0f} pods/s "
+                  f"({cr.scheduled}/{cr.n_pods})", file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -397,6 +426,7 @@ def main():
         "pallas": pallas,
         "slo": slo,
         "store_ab": store_ab,
+        "chaos": chaos,
         "multihost": multihost,
         "tpu": _tpu_section()}))
 

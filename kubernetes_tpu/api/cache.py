@@ -151,9 +151,21 @@ class FIFO:
             return len(self._items)
 
 
+#: Reflector re-list backoff: starts at the old fixed 50ms, doubles to
+#: the cap with full jitter. 16 controllers x N informers against a
+#: restarting apiserver settle at ~0.2 attempts/s per informer instead
+#: of hammering it at 20/s each (the thundering-herd relist storm).
+RELIST_BACKOFF_INITIAL = 0.05
+RELIST_BACKOFF_MAX = 5.0
+#: a list+watch session that survived this long was healthy — its
+#: eventual death reconnects fast instead of inheriting stale backoff
+HEALTHY_SESSION_S = 1.0
+
+
 class Reflector:
     """List+watch a resource into a target (ObjectCache, FIFO, or handler
-    triple). Crash-only: any watch error falls back to re-list."""
+    triple). Crash-only: any watch error falls back to re-list, under
+    capped jittered exponential backoff."""
 
     def __init__(self, client, resource: str, namespace: str = "",
                  label_selector: str = "", field_selector: str = "",
@@ -161,7 +173,9 @@ class Reflector:
                  on_update: Optional[Callable[[Any, Any], None]] = None,
                  on_delete: Optional[Callable[[Any], None]] = None,
                  store: Optional[Any] = None,
-                 resync_period: float = 0.0):
+                 resync_period: float = 0.0,
+                 backoff_initial: float = RELIST_BACKOFF_INITIAL,
+                 backoff_max: float = RELIST_BACKOFF_MAX):
         self.client = client
         self.resource = resource
         self.namespace = namespace
@@ -199,6 +213,11 @@ class Reflector:
         self.last_sync_rev = 0
         self.resync_period = resync_period
         self._last_resync = 0.0
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        #: observability for the fault tier: how many times the run
+        #: loop recovered from a failed list/watch session
+        self.reconnects = 0
 
     # The server-side field selector also filters here client-side because
     # watch events are not field-filtered by the in-proc store (the reference
@@ -267,7 +286,14 @@ class Reflector:
                     self.on_update(obj, obj)
             if ev is None:
                 if w.stopped:
-                    return  # watch died; outer loop re-lists
+                    if getattr(w, "failed", False):
+                        # mid-stream disconnect (HTTP watcher marks it;
+                        # the ERROR event may have been shed by a full
+                        # queue) — surface it so the run loop logs the
+                        # reconnect and backs off
+                        raise ApiError(
+                            f"watch stream for {self.resource} failed")
+                    return  # clean stop; outer loop re-lists at once
                 continue
             if ev.type == watchpkg.ERROR:
                 raise ev.object if isinstance(ev.object, ApiError) \
@@ -302,16 +328,33 @@ class Reflector:
         self._list_and_watch()
 
     def _run(self) -> None:
+        import random
+        rng = random.Random()
+        delay = self.backoff_initial
         while not self._stop.is_set():
+            started = time.monotonic()
             try:
                 self._list_and_watch()
+                delay = self.backoff_initial  # clean stop: healthy server
             except Expired:
-                continue  # too-old resourceVersion: immediate re-list
+                # too-old resourceVersion: the server is healthy and
+                # asking for a re-list — immediate, no backoff
+                delay = self.backoff_initial
+                continue
             except Exception as e:
                 if self._stop.is_set():
                     return
-                logger.debug("reflector %s: %r; re-listing", self.resource, e)
-                self._stop.wait(0.05)
+                if time.monotonic() - started >= HEALTHY_SESSION_S:
+                    # the session was established and lived — this is a
+                    # fresh failure, not a continuing outage
+                    delay = self.backoff_initial
+                self.reconnects += 1
+                logger.info("reflector %s: %r; re-list in <=%.2fs",
+                            self.resource, e, delay)
+                # full jitter: N informers re-listing against a
+                # restarting apiserver spread out instead of herding
+                self._stop.wait(delay * rng.random())
+                delay = min(delay * 2.0, self.backoff_max)
 
     def start(self) -> "Reflector":
         self._thread = threading.Thread(

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import socket
 import threading
 import urllib.parse
@@ -24,6 +25,9 @@ from ..core.errors import (ApiError, BadGateway, BadRequest, NotFound,
 from ..core.scheme import Scheme, default_scheme
 from ..core.watch import Event, Watcher
 from .registry import Registry
+from .retry import RetryPolicy
+
+logger = logging.getLogger("kubernetes_tpu.client")
 
 
 class Client:
@@ -293,10 +297,15 @@ class _HttpWatcher(Watcher):
         self._conn = conn
         self._resp = resp
         self._scheme = scheme
+        #: True when the stream died mid-flight (not a clean server end
+        #: or a deliberate stop()) — Reflector logs the reconnect and
+        #: backs off instead of treating it as a clean stop
+        self.failed = False
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
     def _pump(self):
+        err: Optional[Exception] = None
         try:
             for raw in self._resp:
                 line = raw.strip()
@@ -308,9 +317,16 @@ class _HttpWatcher(Watcher):
                     self.send(Event("ERROR", from_status(obj)))
                     break
                 self.send(Event(data["type"], self._scheme.decode_dict(obj)))
-        except Exception:
-            pass
+        except Exception as e:
+            # a deliberate stop() shuts the socket down under the
+            # reader — that is a clean stop, not a stream failure
+            if not self.stopped:
+                err = e
         finally:
+            if err is not None:
+                self.failed = True
+                self.send(Event("ERROR", ApiError(
+                    f"watch stream disconnected: {err!r}")))
             self.stop()
 
     def stop(self):
@@ -330,16 +346,22 @@ class HttpClient(Client):
     def __init__(self, base_url: str, scheme: Scheme = default_scheme,
                  timeout: float = 30.0,
                  headers: Optional[dict] = None,
-                 ssl_context=None):
+                 ssl_context=None,
+                 retry: Optional[RetryPolicy] = None):
         """headers: sent with every request (Authorization etc. — the
         kubeconfig credential role). ssl_context: for https servers —
         CA trust plus an optional client certificate
-        (ssl.SSLContext.load_cert_chain), the x509 credential role."""
+        (ssl.SSLContext.load_cert_chain), the x509 credential role.
+        retry: the resilience policy (api.retry.RetryPolicy) — None
+        picks the default (idempotency-aware retries + breaker); pass
+        RetryPolicy.disabled() for raw single-shot requests."""
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme
         self.timeout = timeout
         self.headers = dict(headers or {})
         self.ssl_context = ssl_context
+        self.retry = retry if retry is not None else RetryPolicy()
+        self._breaker = self.retry.make_breaker()
 
     # ------------------------------------------------------------ plumbing
 
@@ -365,7 +387,39 @@ class HttpClient(Client):
 
     def _do(self, method: str, url: str, body: Any = None,
             stream: bool = False, raw_body: Optional[bytes] = None,
-            content_type: str = "application/json"):
+            content_type: str = "application/json",
+            idempotent: Optional[bool] = None):
+        """One REST request under the retry policy. idempotent: None
+        defaults to method == GET; verb methods pass True when the
+        request carries its own replay guard (uid precondition, CAS
+        resourceVersion). Streams bypass retry — their consumers
+        (Reflector, log followers) own reconnection."""
+        if stream:
+            return self._do_once(method, url, body, stream, raw_body,
+                                 content_type)
+        if idempotent is None:
+            idempotent = method in ("GET", "HEAD")
+        return self.retry.call(
+            lambda: self._do_once(method, url, body, False, raw_body,
+                                  content_type),
+            idempotent=idempotent, breaker=self._breaker,
+            probe=self._probe_healthz)
+
+    def _probe_healthz(self) -> bool:
+        """The breaker's recovery probe: one cheap unretried GET."""
+        try:
+            resp = urllib.request.urlopen(
+                self.base_url + "/healthz", timeout=2.0,
+                context=self.ssl_context)
+            ok = resp.status == 200
+            resp.close()
+            return ok
+        except Exception:
+            return False
+
+    def _do_once(self, method: str, url: str, body: Any = None,
+                 stream: bool = False, raw_body: Optional[bytes] = None,
+                 content_type: str = "application/json"):
         data = raw_body
         headers = {"Accept": "application/json", **self.headers}
         if body is not None:
@@ -379,11 +433,20 @@ class HttpClient(Client):
                 req, timeout=None if stream else self.timeout,
                 context=self.ssl_context)
         except urllib.error.HTTPError as e:
+            retry_after = e.headers.get("Retry-After") if e.headers \
+                else None
             try:
                 status = json.loads(e.read().decode())
             except Exception:
-                raise ApiError(f"HTTP {e.code} from {url}")
-            raise from_status(status)
+                err = ApiError(f"HTTP {e.code} from {url}")
+            else:
+                err = from_status(status)
+            if retry_after:
+                try:
+                    err.retry_after = float(retry_after)
+                except ValueError:
+                    pass
+            raise err
         if stream:
             return resp
         payload = resp.read().decode()
@@ -435,15 +498,25 @@ class HttpClient(Client):
         rev = int(data["metadata"].get("resourceVersion") or 0)
         return items, rev
 
+    @staticmethod
+    def _has_rv(obj) -> bool:
+        """A PUT carrying a resourceVersion is CAS — replaying it after
+        an ambiguous connection loss surfaces as Conflict, never as a
+        silent double-commit, so it is safe to retry."""
+        meta = getattr(obj, "metadata", None)
+        return bool(getattr(meta, "resource_version", ""))
+
     def update(self, resource, obj, namespace=""):
         ns = namespace or obj.metadata.namespace
         return self._decode(self._do(
-            "PUT", self._url(resource, ns, obj.metadata.name), obj))
+            "PUT", self._url(resource, ns, obj.metadata.name), obj,
+            idempotent=self._has_rv(obj)))
 
     def update_status(self, resource, obj, namespace=""):
         ns = namespace or obj.metadata.namespace
         return self._decode(self._do(
-            "PUT", self._url(resource, ns, obj.metadata.name, "status"), obj))
+            "PUT", self._url(resource, ns, obj.metadata.name, "status"), obj,
+            idempotent=self._has_rv(obj)))
 
     def patch(self, resource, name, patch_body, namespace="",
               patch_type="application/strategic-merge-patch+json"):
@@ -461,7 +534,8 @@ class HttpClient(Client):
     def update_scale(self, resource, name, scale, namespace=""):
         ns = namespace or "default"
         return self._decode(self._do(
-            "PUT", self._url(resource, ns, name, "scale"), scale))
+            "PUT", self._url(resource, ns, name, "scale"), scale,
+            idempotent=self._has_rv(scale)))
 
     def delete(self, resource, name, namespace="",
                grace_period_seconds=None, uid=None):
@@ -471,8 +545,12 @@ class HttpClient(Client):
             body = api.DeleteOptions(
                 grace_period_seconds=grace_period_seconds,
                 preconditions=api.Preconditions(uid=uid) if uid else None)
+        # uid precondition makes a replay unambiguous: the same object
+        # deletes once, a replacement answers Conflict, a completed
+        # delete answers NotFound — all terminal signals for callers
         return self._decode(self._do(
-            "DELETE", self._url(resource, ns, name), body))
+            "DELETE", self._url(resource, ns, name), body,
+            idempotent=bool(uid)))
 
     def _ws_connect(self, path: str):
         """Upgrade a websocket to the apiserver carrying this client's
@@ -561,7 +639,8 @@ class HttpClient(Client):
     def finalize_namespace(self, obj):
         return self._decode(self._do(
             "PUT", self._url("namespaces", "", obj.metadata.name,
-                             "finalize"), obj))
+                             "finalize"), obj,
+            idempotent=self._has_rv(obj)))
 
     def bind_batch(self, bindings, namespace=""):
         """POST a JSON array to the bindings resource: one batched store
@@ -606,14 +685,18 @@ class HttpClient(Client):
             resp.close()
 
 
-def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 5,
+def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 8,
                          backoff_s: float = 0.5) -> None:
     """The grace-0, uid-guarded delete that completes a graceful pod
     deletion from the node side (real kubelet, hollow kubelet, fleet).
     NotFound/Conflict are terminal — the pod is gone, or a same-name
     replacement took the name; transient API errors retry off-thread
-    with backoff, because a marked pod emits no further watch events
-    and a dropped confirm would leave it Terminating forever."""
+    with jittered backoff, because a marked pod emits no further watch
+    events and a dropped confirm would leave it Terminating forever.
+    Exhaustion is loud: the pod will sit Terminating until something
+    else (a fleet/kubelet restart's re-list) re-drives it, so the
+    operator must hear about it."""
+    import random as _random
     import time as _time
 
     from ..core.errors import Conflict, NotFound
@@ -635,10 +718,17 @@ def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 5,
     def retry_loop():
         delay = backoff_s
         for _ in range(attempts - 1):
-            _time.sleep(delay)
+            # jittered: a fleet confirming thousands of pods against a
+            # restarting apiserver must not replay them in one wave
+            _time.sleep(delay * (0.5 + _random.random()))
             if attempt():
                 return
             delay = min(delay * 2, 5.0)
+        logger.warning(
+            "confirm_pod_deletion: giving up on %s/%s after %d "
+            "attempts; pod stays Terminating until a re-list re-drives "
+            "the confirm", pod.metadata.namespace, pod.metadata.name,
+            attempts)
 
     threading.Thread(target=retry_loop, daemon=True,
                      name=f"confirm-del-{pod.metadata.name}").start()

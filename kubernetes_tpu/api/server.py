@@ -92,7 +92,8 @@ class ApiServer:
                  authenticator=None, authorizer=None, request_log=None,
                  tls_cert_file: str = "", tls_key_file: str = "",
                  tls_client_ca_file: str = "",
-                 runtime_config: Optional[dict] = None):
+                 runtime_config: Optional[dict] = None,
+                 shed_retry_after: float = 1.0):
         """tls_cert_file/tls_key_file: serve HTTPS (the reference's
         --tls-cert-file/--tls-private-key-file secure port).
         tls_client_ca_file: additionally request client certificates
@@ -125,6 +126,9 @@ class ApiServer:
         # ref: --max-requests-inflight (cmd/kube-apiserver/app/server.go),
         # MaxInFlightLimit pkg/apiserver/handlers.go:76
         self._inflight = threading.BoundedSemaphore(max_in_flight)
+        # the backpressure hint shed 429s carry (Retry-After seconds);
+        # the retrying client treats it as a backoff floor
+        self.shed_retry_after = shed_retry_after
         # (resource, ns, selectors) -> (segment write version, response
         # bytes): whole-LIST responses reused verbatim between writes
         # to that resource (the watch cache's LIST half at the byte
@@ -199,6 +203,13 @@ class ApiServer:
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: Optional[threading.Thread] = None
+        # live watch streams, so stop() can end them: a stopped server
+        # must behave like a killed one — shutting only the accept loop
+        # would leave established watch handler threads streaming from
+        # the in-process registry forever, and clients would never
+        # notice the "crash" (the fault tier restarts servers in-proc)
+        self._live_watchers: set = set()
+        self._watchers_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -215,6 +226,11 @@ class ApiServer:
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        with self._watchers_lock:
+            live = list(self._live_watchers)
+            self._live_watchers.clear()
+        for w in live:
+            w.stop()  # handler threads write their final chunk and exit
         self.httpd.server_close()
 
     # ------------------------------------------------------------- dispatch
@@ -242,9 +258,21 @@ class ApiServer:
                         or "/watch/" in path or path.endswith("/watch")
                         or path.endswith("/portforward")
                         or path.endswith("/attach")
-                        or path.endswith("/exec"))
+                        or path.endswith("/exec")
+                        # health stays shed-exempt: it is the retrying
+                        # client's breaker probe and the LB liveness
+                        # check — a saturated server must still answer
+                        # "alive" or every breaker stays open
+                        or path in ("/healthz", "/healthz/ping"))
         if not long_running and not self._inflight.acquire(blocking=False):
-            self._send_error(h, TooManyRequests("too many requests in flight"))
+            # sheds-per-resource: the saturation signal dashboards and
+            # the chaos/scale gates read (ref: apiserver
+            # dropped_requests metric, pkg/apiserver/handlers.go:83)
+            self.metrics.inc("apiserver_dropped_requests",
+                             {"resource": _authz_target(path)[0] or "none"})
+            err = TooManyRequests("too many requests in flight")
+            err.retry_after = self.shed_retry_after
+            self._send_error(h, err)
             return
         try:
             # handler chain order per master.go:702,710:
@@ -1221,9 +1249,18 @@ class ApiServer:
         return ev, (deadline is not None
                     and time.monotonic() >= deadline and ev is None)
 
+    def _track_watcher(self, watcher) -> None:
+        with self._watchers_lock:
+            self._live_watchers.add(watcher)
+
+    def _untrack_watcher(self, watcher) -> None:
+        with self._watchers_lock:
+            self._live_watchers.discard(watcher)
+
     def _stream_watch_events(self, h, watcher, encode, deadline=None) -> None:
         """Chunked JSON event stream shared by the typed watch and the
         third-party watch (encode: object -> wire dict)."""
+        self._track_watcher(watcher)
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -1253,6 +1290,7 @@ class ApiServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            self._untrack_watcher(watcher)
             watcher.stop()
 
     def _serve_watch_websocket(self, h, watcher, encode=None,
@@ -1266,6 +1304,7 @@ class ApiServer:
 
         if encode is None:
             encode = self.scheme.encode_dict
+        self._track_watcher(watcher)
         try:
             if not wsstream.server_handshake(h):
                 return
@@ -1317,6 +1356,7 @@ class ApiServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            self._untrack_watcher(watcher)
             watcher.stop()
             h.close_connection = True
 
@@ -1338,9 +1378,10 @@ class ApiServer:
         except json.JSONDecodeError as e:
             raise BadRequest(f"invalid JSON body: {e}")
 
-    def _send_json(self, h, code: int, payload: dict) -> None:
+    def _send_json(self, h, code: int, payload: dict,
+                   extra_headers: Optional[dict] = None) -> None:
         self._send_raw(h, code, json.dumps(payload).encode(),
-                       "application/json")
+                       "application/json", extra_headers=extra_headers)
 
     def _send_error(self, h, err: ApiError) -> None:
         # an error can fire before a body-bearing request's body was
@@ -1360,15 +1401,25 @@ class ApiServer:
                 pending = True  # unparseable: can't trust the framing
             if pending or h.headers.get("Transfer-Encoding"):
                 h.close_connection = True
+        extra = None
+        retry_after = getattr(err, "retry_after", None)
+        if retry_after:
+            # fractional values allowed (DIVERGENCES.md: RFC 7231 says
+            # integer delta-seconds; sub-second shed windows would all
+            # round to the same wave otherwise)
+            extra = {"Retry-After": f"{retry_after:g}"}
         try:
-            self._send_json(h, err.code, err.status())
+            self._send_json(h, err.code, err.status(), extra_headers=extra)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
 
     @staticmethod
-    def _send_raw(h, code: int, payload: bytes, ctype: str) -> None:
+    def _send_raw(h, code: int, payload: bytes, ctype: str,
+                  extra_headers: Optional[dict] = None) -> None:
         h.send_response(code)
         h.send_header("Content-Type", ctype)
         h.send_header("Content-Length", str(len(payload)))
+        for k, v in (extra_headers or {}).items():
+            h.send_header(k, v)
         h.end_headers()
         h.wfile.write(payload)

@@ -1,0 +1,11 @@
+"""Deterministic chaos injection for the API plane.
+
+`ChaosClient` wraps any `api.client.Client` with seeded, per-verb fault
+streams (error rates, injected latency, 429/503 bursts, watch-stream
+cuts) — the machinery the chaos soak and the fault-load perf arm run
+on. See `injector.py` for the determinism contract.
+"""
+
+from .injector import VERBS, ChaosClient, ChaosWatcher, FaultPlan
+
+__all__ = ["ChaosClient", "ChaosWatcher", "FaultPlan", "VERBS"]

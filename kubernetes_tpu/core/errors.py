@@ -11,6 +11,10 @@ from __future__ import annotations
 class ApiError(Exception):
     code = 500
     reason = "InternalError"
+    #: seconds the server asked the client to wait before retrying
+    #: (header-borne — a 429's Retry-After; None when the server sent
+    #: none). Consumed by api.retry.RetryPolicy.
+    retry_after = None
 
     def __init__(self, message: str = "", kind: str = "", name: str = ""):
         self.kind = kind

@@ -92,7 +92,9 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                              wait_running: bool = False,
                              timeout_s: float = 300.0,
                              registry: Optional[Registry] = None,
-                             store_publish_inline: bool = False
+                             store_publish_inline: bool = False,
+                             chaos_seed: Optional[int] = None,
+                             chaos_error_rate: float = 0.01
                              ) -> BenchmarkResult:
     """Stand up master + fleet + scheduler, blast pods from 30 writers,
     measure time until every pod is bound (and optionally Running).
@@ -100,7 +102,13 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     store_publish_inline: build the registry over a store that fans
     watch events out while still holding its ledger lock — the
     pre-split commit serialization, kept as the control arm of
-    bench.py's --store-ab."""
+    bench.py's --store-ab.
+
+    chaos_seed: wrap every component's client in the seeded chaos
+    injector (chaos.ChaosClient at chaos_error_rate on all verbs) so
+    the perf number is recorded UNDER fault load — the bench.py
+    --chaos-seed arm. None (the default) leaves the hot path
+    untouched."""
     # GIL slice: r2 measured 1ms best (the scheduler thread parked
     # behind 30 writers at every dispatch); after r4's contention fixes
     # (thread-local uids, in-place rv stamping, informer-riding
@@ -113,6 +121,10 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
         registry = Registry(store=Store(publish_inline=True))
     registry = registry or Registry()
     client = InProcClient(registry)
+    if chaos_seed is not None:
+        from ..chaos import ChaosClient, FaultPlan
+        client = ChaosClient(client, FaultPlan(seed=chaos_seed,
+                                               error_rate=chaos_error_rate))
     # heartbeats quiesce during the measured window: the reference's
     # BenchmarkScheduling fixture has NO kubelets (nodes are API
     # objects, scheduler_test.go:329) — the fleet is here to confirm
@@ -200,9 +212,20 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                         ids.append(i)
                 if not ids:
                     return
-                client.create_from_template(
-                    "pods", template,
-                    [f"bench-pod-{i:06d}" for i in ids], "default")
+                names = [f"bench-pod-{i:06d}" for i in ids]
+                while True:
+                    try:
+                        client.create_from_template(
+                            "pods", template, names, "default")
+                        break
+                    except Exception:
+                        # only injected faults are retried (a fault
+                        # fires before the call reaches the registry,
+                        # so the claimed chunk is never half-created);
+                        # real errors keep crashing the writer
+                        if chaos_seed is None or time.time() > deadline:
+                            raise
+                        time.sleep(0.01)
 
         writers = [threading.Thread(target=writer, daemon=True)
                    for _ in range(WRITER_THREADS)]

@@ -79,10 +79,20 @@ class HollowFleet:
 
     def register_all(self) -> None:
         for i in range(self.n_nodes):
-            try:
-                self.client.create("nodes", self._node_object(i))
-            except ApiError:
-                pass  # already registered from a prior life
+            for attempt in range(5):
+                try:
+                    self.client.create("nodes", self._node_object(i))
+                    break
+                except ApiError:
+                    break  # already registered from a prior life
+                except Exception:
+                    # transient (connection loss, injected fault): the
+                    # heartbeat's NotFound path would heal this, but a
+                    # long heartbeat interval must not leave the node
+                    # unregistered for minutes — retry here first
+                    self._stop.wait(0.05 * (attempt + 1))
+                    if self._stop.is_set():
+                        return
 
     def _heartbeat_one(self, i: int) -> None:
         name = self._names[i]

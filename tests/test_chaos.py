@@ -1,0 +1,394 @@
+"""The chaos-injection subsystem and the fault-tolerance acceptance
+gates: seeded determinism, watch cuts, the chaos soak (RC + batch
+scheduler + hollow fleet over HTTP through injected faults), and
+bounded informer re-list backoff through an apiserver kill/restart.
+
+Reference: the reference grows this into test/e2e/chaosmonkey; the
+crash-only invariants asserted here are test_faults.py's (SURVEY §5),
+now held under CONTINUOUS fault injection rather than one clean kill."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.cache import Informer, Reflector
+from kubernetes_tpu.api.client import Client, HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.chaos import VERBS, ChaosClient, FaultPlan
+from kubernetes_tpu.controllers.replication import ReplicationManager
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.kubemark.fleet import HollowFleet
+from kubernetes_tpu.sched.batch import BatchScheduler
+from kubernetes_tpu.sched.factory import ConfigFactory
+
+
+def wait_until(cond, timeout=60.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def mkpod(name, labels=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity("100m"),
+                          "memory": parse_quantity("64Mi")}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+# ------------------------------------------------------------ determinism
+
+@pytest.mark.chaos
+class TestDeterminism:
+    def _drive(self, seed):
+        """A fixed single-threaded call script; returns the trace."""
+        plan = FaultPlan(seed=seed, error_rate=0.3)
+        chaos = ChaosClient(InProcClient(Registry()), plan)
+        outcomes = []
+        for i in range(40):
+            try:
+                chaos.create("pods", mkpod(f"d-{i:02d}"))
+                outcomes.append("ok")
+            except Exception as e:
+                outcomes.append(type(e).__name__)
+            try:
+                chaos.list("pods", "default")
+                outcomes.append("ok")
+            except Exception as e:
+                outcomes.append(type(e).__name__)
+        return plan, chaos.trace(), outcomes
+
+    def test_same_seed_bit_identical_runs(self):
+        plan, trace_a, out_a = self._drive(seed=1234)
+        _, trace_b, out_b = self._drive(seed=1234)
+        assert trace_a == trace_b
+        assert out_a == out_b  # outcomes, not just decisions
+        # and the live trace IS the pure schedule replay
+        for verb in ("create", "list"):
+            assert trace_a[verb] == plan.schedule(verb, len(trace_a[verb]))
+
+    def test_different_seeds_differ(self):
+        _, trace_a, _ = self._drive(seed=1)
+        _, trace_b, _ = self._drive(seed=2)
+        assert trace_a != trace_b
+
+    def test_schedule_independent_of_cross_verb_interleaving(self):
+        """Verb streams are independent: interleaving create/get calls
+        across threads cannot shift either verb's decisions."""
+        plan = FaultPlan(seed=7, error_rate=0.5)
+        chaos = ChaosClient(InProcClient(Registry()), plan)
+        registry_pod = mkpod("x")
+
+        def hammer(verb):
+            for _ in range(50):
+                try:
+                    if verb == "create":
+                        chaos.create("pods", registry_pod)
+                    else:
+                        chaos.get("pods", "x", "default")
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=hammer, args=(v,))
+                   for v in ("create", "get")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        trace = chaos.trace()
+        assert trace["create"] == plan.schedule("create", 50)
+        assert trace["get"] == plan.schedule("get", 50)
+
+    def test_draw_always_consumes_four(self):
+        """A decision is a function of its index alone — faulting and
+        clean calls must consume identical RNG amounts."""
+        plan_hot = FaultPlan(seed=9, error_rate=1.0)
+        plan_cold = FaultPlan(seed=9, error_rate=0.0)
+        # same seed, different rates: the N-th draw's underlying rolls
+        # line up, so the hot plan's schedule is rate-independent in
+        # POSITION (both consume 4 per call)
+        rng_hot, rng_cold = plan_hot.stream("get"), plan_cold.stream("get")
+        for _ in range(20):
+            plan_hot.draw(rng_hot, 1.0)
+            plan_cold.draw(rng_cold, 0.0)
+        assert rng_hot.random() == rng_cold.random()
+
+
+# ------------------------------------------------------------ watch cuts
+
+@pytest.mark.chaos
+class TestWatchCuts:
+    def test_watch_cut_after_n_events(self):
+        plan = FaultPlan(seed=0, watch_cut_after=3)
+        registry = Registry()
+        chaos = ChaosClient(InProcClient(registry), plan)
+        w = chaos.watch("pods", "default")
+        for i in range(5):
+            chaos.create("pods", mkpod(f"c-{i}"))
+        seen = []
+        for ev in w:
+            seen.append(ev.type)
+        # 3 delivered events, then the injected disconnect
+        assert seen[:3] == ["ADDED"] * 3
+        assert "ERROR" in seen
+        assert w.failed
+
+    def test_forced_cut_and_informer_recovery(self):
+        registry = Registry()
+        chaos = ChaosClient(InProcClient(registry), FaultPlan(seed=0))
+        seen = {}
+        informer = Informer(chaos, "pods",
+                            on_add=lambda p: seen.setdefault(
+                                p.metadata.name, True)).start()
+        try:
+            assert wait_until(lambda: informer.has_synced)
+            chaos.create("pods", mkpod("before"))
+            assert wait_until(lambda: "before" in seen)
+            assert chaos.cut_watches() >= 1
+            # the reflector logs the reconnect and re-lists; new
+            # objects keep flowing
+            chaos.create("pods", mkpod("after"))
+            assert wait_until(lambda: "after" in seen)
+            assert informer.reflector.reconnects >= 1
+        finally:
+            informer.stop()
+
+
+# ----------------------------------------------------------- chaos soak
+
+def run_chaos_soak(seed, replicas=16, n_nodes=6, fault_rate=0.05,
+                   timeout=150.0):
+    """The soak body: RC + batch scheduler + hollow fleet, all over
+    HttpClient wrapped in one seeded injector; one forced watch cut
+    mid-run. Returns (converged, rebinds, pods, trace, plan)."""
+    registry = Registry()
+    srv = ApiServer(registry, port=0).start()
+    plan = FaultPlan(seed=seed, error_rate=fault_rate)
+    chaos = ChaosClient(HttpClient(srv.url), plan)
+
+    # invariant tracker rides the registry directly (no chaos, no HTTP):
+    # every binding observed exactly once, never re-pointed
+    bound_to, rebinds = {}, []
+    lock = threading.Lock()
+    tracker_w = InProcClient(registry).watch("pods", "default")
+
+    def track():
+        for ev in tracker_w:
+            pod = ev.object
+            if ev.type == "DELETED" or not pod.spec.node_name:
+                continue
+            with lock:
+                prev = bound_to.get(pod.metadata.uid)
+                if prev is not None and prev != pod.spec.node_name:
+                    rebinds.append((pod.metadata.name, prev,
+                                    pod.spec.node_name))
+                bound_to[pod.metadata.uid] = pod.spec.node_name
+
+    threading.Thread(target=track, daemon=True).start()
+
+    fleet = HollowFleet(chaos, n_nodes, heartbeat_interval=1.0).run()
+    factory = ConfigFactory(chaos, rate_limit=False).start()
+    sched = BatchScheduler(factory.create_batch()).run()
+    rc_mgr = ReplicationManager(chaos).run()
+    try:
+        wait_until(lambda: len(factory.node_lister.list()) == n_nodes,
+                   timeout=60)
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="soak", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=replicas, selector={"app": "soak"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"app": "soak"}),
+                    spec=mkpod("t", labels={"app": "soak"}).spec)))
+        # RC creation itself rides the chaos client (retry until it
+        # lands — an injected fault fires before the POST is sent)
+        deadline = time.time() + 30
+        while True:
+            try:
+                chaos.create("replicationcontrollers", rc)
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+        def converged():
+            pods, _ = registry.list("pods", "default",
+                                    label_selector="app=soak")
+            live = [p for p in pods if p.metadata.deletion_timestamp
+                    is None]
+            return (len(live) == replicas
+                    and all(p.spec.node_name for p in live)
+                    and all(p.status.phase == "Running" for p in live))
+
+        # let some progress happen, then force the watch cut — every
+        # component's streams drop at once (the apiserver-restart wire)
+        wait_until(lambda: len(bound_to) >= max(2, replicas // 4),
+                   timeout=timeout / 2)
+        chaos.cut_watches()
+        ok = wait_until(converged, timeout=timeout)
+        pods, _ = registry.list("pods", "default",
+                                label_selector="app=soak")
+        return ok, list(rebinds), pods, chaos.trace(), plan
+    finally:
+        rc_mgr.stop()
+        sched.stop()
+        factory.stop()
+        fleet.stop()
+        tracker_w.stop()
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_soak_converges_with_single_bindings():
+    """Acceptance: seeded 5% faults on all verbs + one forced watch
+    cut; the RC reaches desired replicas, every scheduled pod holds
+    exactly one binding, and the run's fault schedule is exactly the
+    seed's pure replay (reproducibility)."""
+    ok, rebinds, pods, trace, plan = run_chaos_soak(seed=42)
+    assert ok, (f"did not converge: "
+                f"{[(p.metadata.name, p.spec.node_name, p.status.phase) for p in pods]}")
+    assert rebinds == [], rebinds  # CAS bind guarantee: never re-pointed
+    # the live trace is a prefix realization of the deterministic
+    # schedule — a second invocation with seed 42 draws the same
+    # decisions at every index (see the slow two-invocation gate)
+    for verb in VERBS:
+        assert trace[verb] == plan.schedule(verb, len(trace[verb])), verb
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_reproducible_across_invocations():
+    """The long gate: TWO full soak invocations, same seed — both
+    converge with zero duplicate bindings and draw the same fault
+    schedule (bit-identical decisions at every common index)."""
+    results = [run_chaos_soak(seed=4242) for _ in range(2)]
+    for ok, rebinds, pods, _, _ in results:
+        assert ok
+        assert rebinds == []
+    (_, _, _, trace_a, _), (_, _, _, trace_b, _) = results
+    for verb in VERBS:
+        n = min(len(trace_a[verb]), len(trace_b[verb]))
+        assert trace_a[verb][:n] == trace_b[verb][:n], verb
+
+
+# ---------------------------------------- outage backoff + restart gates
+
+class _CountingClient(Client):
+    """list/watch counter around any Client (attempt-rate probe)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.list_calls = 0
+        self.watch_calls = 0
+        self._lock = threading.Lock()
+
+    def list(self, *a, **kw):
+        with self._lock:
+            self.list_calls += 1
+        return self.inner.list(*a, **kw)
+
+    def watch(self, *a, **kw):
+        with self._lock:
+            self.watch_calls += 1
+        return self.inner.watch(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+@pytest.mark.chaos
+def test_reflector_backoff_bounds_relist_rate_during_outage():
+    """A dead endpoint: the reflector must back off, not hammer at the
+    old fixed 50ms (20 attempts/s)."""
+
+    class Down(Client):
+        def __init__(self):
+            self.list_calls = 0
+
+        def list(self, *a, **kw):
+            self.list_calls += 1
+            raise ConnectionError("apiserver down")
+
+    down = Down()
+    refl = Reflector(down, "pods")
+    refl.start()
+    try:
+        time.sleep(2.0)
+        # fixed 50ms would make ~40 attempts; capped jittered backoff
+        # (50ms doubling to 5s, full jitter) stays an order lower
+        assert 1 <= down.list_calls <= 20, down.list_calls
+    finally:
+        refl.stop()
+
+
+@pytest.mark.chaos
+def test_apiserver_restart_informers_reconnect_with_backoff():
+    """Acceptance: kill the apiserver under live HttpClient informers,
+    restart it on the same port — every informer reconnects (bounded
+    re-list attempts during the outage, no reflector thread dies) and
+    resumes delivering events."""
+    registry = Registry()
+    srv = ApiServer(registry, port=0).start()
+    port = srv.port
+    clients = [_CountingClient(HttpClient(f"http://127.0.0.1:{port}"))
+               for _ in range(3)]
+    seen = {}
+    lock = threading.Lock()
+
+    def on_add(resource):
+        def _h(obj):
+            with lock:
+                seen[(resource, obj.metadata.name)] = True
+        return _h
+
+    informers = [Informer(c, res, on_add=on_add(res)).start()
+                 for c, res in zip(clients, ("pods", "nodes", "services"))]
+    try:
+        assert wait_until(lambda: all(i.has_synced for i in informers))
+        InProcClient(registry).create("pods", mkpod("pre"))
+        assert wait_until(lambda: ("pods", "pre") in seen)
+
+        # the outage
+        srv.stop()
+        counts_at_kill = [c.list_calls for c in clients]
+        outage_s = 2.0
+        time.sleep(outage_s)
+        attempts = [c.list_calls - base
+                    for c, base in zip(clients, counts_at_kill)]
+        # bounded: not 20/s per informer (= 40 per informer here); the
+        # jittered doubling backoff keeps each informer to a handful
+        for n in attempts:
+            assert n <= 20, attempts
+
+        # fresh apiserver, same port, fresh (empty) registry — the
+        # components' crash-only re-list absorbs the state loss
+        registry2 = Registry()
+        srv2 = ApiServer(registry2, host="127.0.0.1", port=port).start()
+        try:
+            InProcClient(registry2).create("pods", mkpod("post"))
+            InProcClient(registry2).create(
+                "nodes", api.Node(metadata=api.ObjectMeta(name="post-n")))
+            assert wait_until(lambda: ("pods", "post") in seen,
+                              timeout=30), seen
+            assert wait_until(lambda: ("nodes", "post-n") in seen,
+                              timeout=30), seen
+            # no reflector thread died across the outage
+            for inf in informers:
+                assert inf.reflector._thread.is_alive()
+                assert inf.reflector.reconnects >= 1
+        finally:
+            srv2.stop()
+    finally:
+        for inf in informers:
+            inf.stop()

@@ -1,0 +1,311 @@
+"""The client resilience layer: the (verb x error-class) retry matrix,
+Retry-After honoring, the circuit breaker, and the server's
+backpressure headers/counters.
+
+Reference behaviors: client-go's rest.Request retry-on-429 and
+util/flowcontrol backoff, MaxInFlightLimit's 429 shed
+(pkg/apiserver/handlers.go:76) — see DIVERGENCES.md for where this
+policy is deliberately simpler."""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.client import HttpClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.retry import CircuitBreaker, RetryPolicy
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import (BadRequest, Conflict, NotFound,
+                                        ServiceUnavailable,
+                                        TooManyRequests, Unauthorized)
+
+
+def fast_policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("initial_backoff", 0.001)
+    kw.setdefault("max_backoff", 0.01)
+    kw.setdefault("deadline", 5.0)
+    kw.setdefault("breaker_threshold", 0)
+    return RetryPolicy(**kw)
+
+
+def failing(times, exc_factory, then=lambda: "ok"):
+    """fn that raises exc_factory() for the first `times` calls."""
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) <= times:
+            raise exc_factory()
+        return then()
+
+    fn.calls = calls
+    return fn
+
+
+# ------------------------------------------------------- the retry matrix
+
+# (error factory, idempotent, expect_retry): the policy contract —
+# 429/503 retry for EVERY verb (the server answered without committing),
+# connection-class loss retries ONLY idempotent requests, every other
+# API error raises straight through.
+MATRIX = [
+    (lambda: ConnectionError("refused"), True, True),
+    (lambda: ConnectionError("refused"), False, False),  # bare POST
+    (lambda: TimeoutError("timed out"), True, True),
+    (lambda: TimeoutError("timed out"), False, False),
+    (lambda: urllib.error.URLError("unreachable"), True, True),
+    (lambda: urllib.error.URLError("unreachable"), False, False),
+    (lambda: TooManyRequests("shed"), True, True),
+    (lambda: TooManyRequests("shed"), False, True),      # POST retries 429
+    (lambda: ServiceUnavailable("no backend"), True, True),
+    (lambda: ServiceUnavailable("no backend"), False, True),
+    (lambda: NotFound("gone"), True, False),
+    (lambda: Conflict("cas"), True, False),
+    (lambda: BadRequest("bad"), False, False),
+    (lambda: Unauthorized("denied"), True, False),
+]
+
+
+@pytest.mark.parametrize("exc_factory,idempotent,expect_retry", MATRIX)
+def test_retry_matrix(exc_factory, idempotent, expect_retry):
+    policy = fast_policy(sleep=lambda s: None)
+    fn = failing(1, exc_factory)
+    if expect_retry:
+        assert policy.call(fn, idempotent=idempotent) == "ok"
+        assert len(fn.calls) == 2
+    else:
+        with pytest.raises(type(exc_factory())):
+            policy.call(fn, idempotent=idempotent)
+        assert len(fn.calls) == 1  # exactly one attempt — never replayed
+
+
+def test_retries_exhaust_and_reraise():
+    policy = fast_policy(max_attempts=3, sleep=lambda s: None)
+    fn = failing(99, lambda: ConnectionError("down"))
+    with pytest.raises(ConnectionError):
+        policy.call(fn, idempotent=True)
+    assert len(fn.calls) == 3
+
+
+def test_retry_after_is_a_backoff_floor():
+    sleeps = []
+    policy = fast_policy(sleep=sleeps.append)
+
+    def shed():
+        e = TooManyRequests("shed")
+        e.retry_after = 0.25
+        return e
+
+    assert policy.call(failing(1, shed), idempotent=False) == "ok"
+    assert len(sleeps) == 1
+    assert sleeps[0] >= 0.25  # jittered backoff would be ~1ms here
+
+
+def test_deadline_budget_stops_retrying():
+    clock = [0.0]
+    policy = fast_policy(max_attempts=10, initial_backoff=1.0,
+                         max_backoff=1.0, deadline=2.5,
+                         sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+                         clock=lambda: clock[0])
+    fn = failing(99, lambda: ServiceUnavailable("down"))
+    with pytest.raises(ServiceUnavailable):
+        policy.call(fn, idempotent=True)
+    # well under max_attempts: the deadline cut it off
+    assert len(fn.calls) <= 3
+
+
+# ----------------------------------------------------------- the breaker
+
+def test_breaker_opens_fast_fails_and_probe_recovers():
+    clock = [0.0]
+    br = CircuitBreaker(threshold=3, probe_interval=1.0,
+                        clock=lambda: clock[0])
+    for _ in range(3):
+        br.record_failure()
+    assert br.open
+    probes = []
+
+    def probe_down():
+        probes.append(1)
+        return False
+
+    # first allow() probes (and fails); the next within the interval
+    # fast-fails WITHOUT probing
+    assert not br.allow(probe_down)
+    assert not br.allow(probe_down)
+    assert len(probes) == 1
+    # interval elapses, server healthy: probe closes the breaker
+    clock[0] += 1.5
+    assert br.allow(lambda: True)
+    assert not br.open
+
+
+def test_breaker_fast_fail_is_typed_service_unavailable():
+    policy = fast_policy(breaker_threshold=2, sleep=lambda s: None)
+    br = policy.make_breaker()
+    fn = failing(99, lambda: ConnectionError("down"))
+    # non-idempotent so each call makes exactly one attempt
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            policy.call(fn, idempotent=False, breaker=br,
+                        probe=lambda: False)
+    with pytest.raises(ServiceUnavailable) as ei:
+        policy.call(fn, idempotent=False, breaker=br, probe=lambda: False)
+    assert "circuit breaker" in str(ei.value)
+    assert len(fn.calls) == 2  # the third call never touched the socket
+
+
+def test_any_http_response_resets_the_breaker():
+    policy = fast_policy(breaker_threshold=2, sleep=lambda s: None)
+    br = policy.make_breaker()
+    with pytest.raises(ConnectionError):
+        policy.call(failing(99, lambda: ConnectionError("x")),
+                    idempotent=False, breaker=br)
+    # a NotFound is a live server: consecutive-failure count resets
+    with pytest.raises(NotFound):
+        policy.call(failing(99, lambda: NotFound("gone")),
+                    idempotent=False, breaker=br)
+    with pytest.raises(ConnectionError):
+        policy.call(failing(99, lambda: ConnectionError("x")),
+                    idempotent=False, breaker=br)
+    assert not br.open
+
+
+# ------------------------------------------- HttpClient verb idempotency
+
+def mk_pod(name, rv=""):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                resource_version=rv, uid="u-1"),
+        spec=api.PodSpec(containers=[api.Container(name="c")]),
+        status=api.PodStatus(phase="Pending"))
+
+
+class _Flaky:
+    """Patch target for HttpClient._do_once: fail once, then succeed."""
+
+    def __init__(self, result=None):
+        self.calls = 0
+        self.result = result if result is not None else {}
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.calls == 1:
+            raise ConnectionError("chaos")
+        return self.result
+
+
+@pytest.mark.parametrize("invoke,expect_retry", [
+    (lambda c: c.get("pods", "p", "default"), True),
+    (lambda c: c.list("pods", "default"), True),
+    (lambda c: c.create("pods", mk_pod("p")), False),
+    (lambda c: c.bind(api.Binding(
+        metadata=api.ObjectMeta(name="p", namespace="default"),
+        target=api.ObjectReference(kind="Node", name="n"))), False),
+    (lambda c: c.update("pods", mk_pod("p", rv="7")), True),
+    (lambda c: c.update("pods", mk_pod("p")), False),      # no CAS guard
+    (lambda c: c.update_status("pods", mk_pod("p", rv="7")), True),
+    (lambda c: c.delete("pods", "p", "default", uid="u-1"), True),
+    (lambda c: c.delete("pods", "p", "default"), False),   # no uid guard
+    (lambda c: c.patch("pods", "p", {"metadata": {}}), False),
+])
+def test_httpclient_verb_idempotency(monkeypatch, invoke, expect_retry):
+    c = HttpClient("http://127.0.0.1:1",
+                   retry=fast_policy(sleep=lambda s: None))
+    flaky = _Flaky(result={"kind": "Pod", "metadata": {"name": "p"},
+                           "items": [], "apiVersion": "v1"})
+    monkeypatch.setattr(c, "_do_once", flaky)
+    if expect_retry:
+        invoke(c)  # first attempt's ConnectionError was absorbed
+        assert flaky.calls == 2
+    else:
+        with pytest.raises(ConnectionError):
+            invoke(c)
+        assert flaky.calls == 1
+
+
+# ----------------------------------------- server-side backpressure wire
+
+def _saturated_server(**kw):
+    """An ApiServer whose one in-flight slot is held by the test."""
+    srv = ApiServer(Registry(), port=0, max_in_flight=1, **kw).start()
+    assert srv._inflight.acquire(blocking=False)
+    return srv
+
+
+def test_shed_429_carries_retry_after_and_counts_per_resource():
+    srv = _saturated_server(shed_retry_after=0.25)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/api/v1/pods", timeout=5)
+        assert ei.value.code == 429
+        assert ei.value.headers.get("Retry-After") == "0.25"
+        assert srv.metrics.counter("apiserver_dropped_requests",
+                                   {"resource": "pods"}) == 1
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + "/api/v1/nodes", timeout=5)
+        assert srv.metrics.counter("apiserver_dropped_requests",
+                                   {"resource": "nodes"}) == 1
+    finally:
+        srv._inflight.release()
+        srv.stop()
+
+
+def test_client_honors_server_retry_after():
+    srv = _saturated_server(shed_retry_after=0.2)
+    sleeps = []
+    try:
+        c = HttpClient(srv.url, retry=fast_policy(max_attempts=2,
+                                                  sleep=sleeps.append))
+        with pytest.raises(TooManyRequests) as ei:
+            c.get("pods", "p", "default")
+        assert ei.value.retry_after == 0.2
+        # one retry happened, and it waited at least the server's floor
+        assert len(sleeps) == 1 and sleeps[0] >= 0.2
+    finally:
+        srv._inflight.release()
+        srv.stop()
+
+
+def test_healthz_stays_shed_exempt_for_the_breaker_probe():
+    # the breaker's recovery path GETs /healthz; it must answer even
+    # when the in-flight limit sheds everything else
+    srv = _saturated_server()
+    try:
+        resp = urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+        assert resp.status == 200 and resp.read() == b"ok"
+    finally:
+        srv._inflight.release()
+        srv.stop()
+
+
+def test_end_to_end_recovery_through_shed_window():
+    """A saturated server sheds a GET with 429; once the slot frees,
+    the retrying client's next attempt succeeds — no caller-visible
+    error for a transient shed."""
+    registry = Registry()
+    srv = ApiServer(registry, port=0, max_in_flight=1,
+                    shed_retry_after=0.05).start()
+    try:
+        plain = HttpClient(srv.url, retry=RetryPolicy.disabled())
+        plain.create("pods", mk_pod("p"), "default")
+        # the create's handler thread releases its slot AFTER the
+        # response reaches the client — poll rather than race it
+        deadline = time.time() + 5.0
+        while not srv._inflight.acquire(blocking=False):
+            assert time.time() < deadline, "in-flight slot never freed"
+            time.sleep(0.01)
+        release_timer = threading.Timer(0.15, srv._inflight.release)
+        release_timer.start()
+        c = HttpClient(srv.url, retry=RetryPolicy(
+            max_attempts=6, initial_backoff=0.05, max_backoff=0.1))
+        pod = c.get("pods", "p", "default")
+        assert pod.metadata.name == "p"
+        release_timer.join()
+    finally:
+        srv.stop()

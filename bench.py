@@ -27,7 +27,10 @@ and e2e_runs (value = best of two on a ±20%-noise shared host; both
 raw runs recorded); r05 adds multihost (the 4-process x 2-device DCN
 dryrun regenerated per round) and, when the headline ran on the real
 tpu backend at the north-star shape, folds its e2e/engine numbers
-into TPU_EVIDENCE_BEST.json under the shared chip lock.
+into TPU_EVIDENCE_BEST.json under the shared chip lock; r06 adds
+node_chaos (the --node-kill-fraction recovery arm: kill/convergence
+times, evictions, rebinds, the zero-dead-bindings gate), null unless
+requested.
 """
 
 import argparse
@@ -234,6 +237,17 @@ def main():
     ap.add_argument("--chaos-rate", type=float, default=0.01,
                     help="per-verb injected fault probability for the "
                          "--chaos-seed arm (default 0.01)")
+    ap.add_argument("--node-kill-fraction", type=float, default=0.0,
+                    help="also run the node-kill soak: this fraction "
+                         "of a 1k-node hollow fleet is hard-killed "
+                         "mid-run under 5%% API faults and the run "
+                         "gates on convergence off the dead nodes "
+                         "(kubemark/node_chaos.py); records the "
+                         "node_chaos section")
+    ap.add_argument("--node-kill-seed", type=int, default=0,
+                    help="seed for the node-kill arm's NodeFaultPlan "
+                         "and API-fault schedule (same seed -> "
+                         "identical kill set)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -320,6 +334,39 @@ def main():
             print(f"# chaos[seed={args.chaos_seed} "
                   f"rate={args.chaos_rate}] {cr.pods_per_sec:.0f} pods/s "
                   f"({cr.scheduled}/{cr.n_pods})", file=sys.stderr)
+    node_chaos = None
+    if args.node_kill_fraction > 0:
+        # the node-failure arm: full stack (fleet + batch scheduler +
+        # RC + NodeController) with a seeded mid-run node kill; the
+        # recorded numbers are the recovery story — kill time,
+        # convergence time, evictions issued, rebind count — plus the
+        # zero-dead-bindings gate the soak test enforces
+        from kubernetes_tpu.kubemark.node_chaos import run_node_kill_soak
+        nk = run_node_kill_soak(
+            n_nodes=1000, replicas=600,
+            kill_fraction=args.node_kill_fraction,
+            seed=args.node_kill_seed, fault_rate=0.05, timeout=420,
+            heartbeat_interval=2.0, monitor_period=0.3,
+            monitor_grace_period=6.0, pod_eviction_timeout=0.5)
+        node_chaos = {
+            "seed": args.node_kill_seed,
+            "kill_fraction": args.node_kill_fraction,
+            "n_nodes": nk.n_nodes,
+            "replicas": nk.replicas,
+            "converged": nk.converged,
+            "killed": len(nk.killed),
+            "kill_at_s": nk.kill_at_s,
+            "convergence_s": nk.converge_s,
+            "evictions": nk.evictions,
+            "rebinds": nk.rebinds,
+            "dead_bound_at_quiesce": nk.dead_bound,
+            "schedule_replayed": nk.schedule_replayed}
+        if args.verbose:
+            print(f"# node_chaos[seed={args.node_kill_seed} "
+                  f"kill={args.node_kill_fraction}] converged="
+                  f"{nk.converged} in {nk.converge_s:.1f}s "
+                  f"({nk.evictions} evictions, {nk.rebinds} rebinds)",
+                  file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -427,6 +474,7 @@ def main():
         "slo": slo,
         "store_ab": store_ab,
         "chaos": chaos,
+        "node_chaos": node_chaos,
         "multihost": multihost,
         "tpu": _tpu_section()}))
 

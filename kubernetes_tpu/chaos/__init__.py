@@ -1,11 +1,17 @@
-"""Deterministic chaos injection for the API plane.
+"""Deterministic chaos injection for the API plane and the node fleet.
 
 `ChaosClient` wraps any `api.client.Client` with seeded, per-verb fault
 streams (error rates, injected latency, 429/503 bursts, watch-stream
 cuts) — the machinery the chaos soak and the fault-load perf arm run
 on. See `injector.py` for the determinism contract.
+
+`NodeFaultPlan`/`NodeChaos` extend the same fixed-draw determinism to
+NODE faults — seeded kill / heartbeat-freeze / flap schedules driving a
+`kubemark.fleet.HollowFleet` (see `nodes.py`).
 """
 
 from .injector import VERBS, ChaosClient, ChaosWatcher, FaultPlan
+from .nodes import NodeChaos, NodeFaultPlan
 
-__all__ = ["ChaosClient", "ChaosWatcher", "FaultPlan", "VERBS"]
+__all__ = ["ChaosClient", "ChaosWatcher", "FaultPlan", "NodeChaos",
+           "NodeFaultPlan", "VERBS"]

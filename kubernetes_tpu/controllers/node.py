@@ -13,23 +13,40 @@ pods evicted too (:378-382).
 Defaults mirror the reference flags (controllermanager.go):
 --node-monitor-period=5s, --node-monitor-grace-period=40s,
 --pod-eviction-timeout=5m, --deleting-pods-qps=0.1 burst 10.
+
+Forward-ported beyond the v1.1 reference (DIVERGENCES.md):
+
+- Partition safety valve: when more than `unhealthy_threshold` of the
+  fleet is NotReady/Unknown simultaneously, the likeliest explanation
+  is a MASTER-side partition (the controller can't reach anything, or
+  the apiserver lost the kubelets), not half the datacenter dying at
+  once — so evictions HALT (queue freezes, drain stops) and resume
+  only when the unhealthy fraction drops back under the threshold.
+  This is the later reference's --unhealthy-zone-threshold=0.55
+  collapsed to one zone.
+- Flap damping: a node bouncing Ready<->NotReady inside the damping
+  window (a sick kubelet, a lossy link) is never queued for eviction
+  while flapping — without it, each bounce queues/cancels the node and
+  a drain racing a flap evicts pods off a node that is Ready again.
+- Evictions delete pods with a uid precondition, so a racing
+  same-name replacement pod is never killed by a stale drain.
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import replace
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from ..core import types as api
-from ..core.errors import NotFound
+from ..core.errors import Conflict, NotFound
 from ..utils.clock import Clock, RealClock
 from ..utils.ratelimit import TokenBucketRateLimiter
 
 
 class _NodeHealth:
     __slots__ = ("probe_timestamp", "ready_transition_timestamp", "status",
-                 "last_heartbeat")
+                 "last_heartbeat", "transitions")
 
     def __init__(self, probe: float, transition: float, status: str,
                  heartbeat: Optional[str] = None):
@@ -37,6 +54,9 @@ class _NodeHealth:
         self.ready_transition_timestamp = transition
         self.status = status
         self.last_heartbeat = heartbeat
+        # recent Ready-status transition times (flap detection); pruned
+        # to the damping window on every observation
+        self.transitions: List[float] = []
 
 
 class NodeController:
@@ -46,11 +66,27 @@ class NodeController:
                  eviction_qps: float = 0.1, eviction_burst: int = 10,
                  clock: Optional[Clock] = None, recorder=None,
                  allocate_node_cidrs: bool = False,
-                 cluster_cidr: str = ""):
+                 cluster_cidr: str = "",
+                 unhealthy_threshold: float = 0.55,
+                 partition_min_cluster: int = 3,
+                 flap_threshold: int = 3,
+                 flap_window: Optional[float] = None):
         """allocate_node_cidrs + cluster_cidr: assign each node a /24
         pod CIDR from the cluster range (nodecontroller.go:62,137
         --allocate-node-cidrs; the route controller consumes
-        node.spec.pod_cidr)."""
+        node.spec.pod_cidr).
+
+        unhealthy_threshold: when MORE than this fraction of the fleet
+        is NotReady/Unknown at once, suspect a master-side partition
+        and halt all evictions until the fraction recovers. Only
+        applies once the fleet has at least partition_min_cluster
+        observed nodes (a 1-node cluster losing its node is not a
+        partition signal).
+
+        flap_threshold / flap_window: a node with >= flap_threshold
+        Ready-status transitions inside flap_window seconds is
+        'flapping' and is not queued for eviction until it settles
+        (window defaults to the monitor grace period)."""
         if allocate_node_cidrs:
             if not cluster_cidr:
                 raise ValueError(
@@ -70,6 +106,11 @@ class NodeController:
         self.recorder = recorder
         self.eviction_limiter = TokenBucketRateLimiter(
             eviction_qps, eviction_burst, self.clock)
+        self.unhealthy_threshold = unhealthy_threshold
+        self.partition_min_cluster = partition_min_cluster
+        self.flap_threshold = flap_threshold
+        self.flap_window = (flap_window if flap_window is not None
+                            else monitor_grace_period)
         # node name -> health bookkeeping (nodeStatusMap :95)
         self._health: Dict[str, _NodeHealth] = {}
         self._known_nodes: Set[str] = set()
@@ -77,6 +118,12 @@ class NodeController:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # observability: the chaos soak / bench read these
+        self.evictions_halted = False      # partition valve engaged
+        self.evictions_total = 0           # pods deleted by eviction
+        self.eviction_drains_total = 0     # nodes fully drained
+        self.partition_halts_total = 0     # valve engage count
+        self.flap_damped_total = 0         # evictions deferred by damping
 
     # -- status monitoring ------------------------------------------------
 
@@ -102,6 +149,7 @@ class NodeController:
         if status != prior.status:
             prior.ready_transition_timestamp = now
             prior.status = status
+            prior.transitions.append(now)
         if heartbeat != prior.last_heartbeat:
             prior.probe_timestamp = now
             prior.last_heartbeat = heartbeat
@@ -116,6 +164,7 @@ class NodeController:
             status = "Unknown"
             prior.ready_transition_timestamp = now
             prior.status = status
+            prior.transitions.append(now)
             self._mark_unknown(node)
             if self.recorder:
                 self.recorder.eventf(node, "Normal", "NodeNotReady",
@@ -136,6 +185,15 @@ class NodeController:
                                                        conditions=conds)))
         except Exception:
             pass  # retried next tick (nodeStatusUpdateRetry)
+
+    def _is_flapping(self, health: _NodeHealth, now: float) -> bool:
+        """>= flap_threshold Ready-status transitions inside the damping
+        window: the node is bouncing, not dead — deferring its eviction
+        beats the queue/cancel churn (and the drain-races-a-recovery
+        eviction) each bounce would cause."""
+        cutoff = now - self.flap_window
+        health.transitions = [t for t in health.transitions if t >= cutoff]
+        return len(health.transitions) >= self.flap_threshold
 
     # -- eviction ---------------------------------------------------------
 
@@ -161,6 +219,11 @@ class NodeController:
                 name = min(pending)  # deterministic order
             if not self.eviction_limiter.try_accept():
                 return
+            if self.evictions_halted:
+                # the partition valve can engage between drains (the
+                # monitor tick runs on the same thread, but tests and
+                # embedders may drive drains directly)
+                return
             if not self._evict_pods(name):
                 # keep the entry (a node DELETED from the API is only
                 # ever queued once, so a transient failure must not
@@ -171,6 +234,7 @@ class NodeController:
                 # block every other node's eviction
                 failed.add(name)
                 continue
+            self.eviction_drains_total += 1
             with self._lock:
                 self._eviction_queue.discard(name)
 
@@ -189,10 +253,15 @@ class NodeController:
                 # ever confirm a graceful mark — a graced pod would sit
                 # Terminating forever (the reference's eviction relies
                 # on the kubelet; with the node dead, force is the only
-                # terminal option)
+                # terminal option). uid precondition: this drain kills
+                # exactly the pod it LISTED — a same-name replacement
+                # created in between (RC recreate racing a stale drain)
+                # must survive.
                 self.client.delete("pods", pod.metadata.name,
                                    pod.metadata.namespace,
-                                   grace_period_seconds=0)
+                                   grace_period_seconds=0,
+                                   uid=pod.metadata.uid or None)
+                self.evictions_total += 1
                 if self.recorder:
                     self.recorder.eventf(
                         pod, "Normal", "NodeControllerEviction",
@@ -200,6 +269,9 @@ class NodeController:
                         pod.metadata.name, node_name)
             except NotFound:
                 continue  # someone else deleted it: done is done
+            except Conflict:
+                continue  # uid moved: a replacement took the name —
+                          # the pod this drain observed is gone
             except Exception:
                 ok = False  # retried when the node drains again
         return ok
@@ -260,15 +332,36 @@ class NodeController:
             self._health.pop(gone, None)
         self._known_nodes = names
 
-        for node in nodes:
-            status = self._observe(node)
+        observed = [(node, self._observe(node)) for node in nodes]
+
+        # -- partition safety valve -----------------------------------
+        # the whole fleet going NotReady/Unknown at once looks like a
+        # master-side partition, not mass hardware death: halt all
+        # evictions (queueing AND draining) until the unhealthy
+        # fraction drops back under the threshold
+        unhealthy = sum(1 for _, status in observed if status != "True")
+        if (len(observed) >= self.partition_min_cluster
+                and unhealthy > self.unhealthy_threshold * len(observed)):
+            if not self.evictions_halted:
+                self.evictions_halted = True
+                self.partition_halts_total += 1
+        elif self.evictions_halted:
+            self.evictions_halted = False
+
+        for node, status in observed:
             health = self._health[node.metadata.name]
             if status == "True":
                 self._cancel_eviction(node.metadata.name)
             elif (now - health.ready_transition_timestamp
                   > self.pod_eviction_timeout):
+                if self.evictions_halted:
+                    continue
+                if self._is_flapping(health, now):
+                    self.flap_damped_total += 1
+                    continue
                 self._queue_eviction(node.metadata.name)
-        self._drain_eviction_queue()
+        if not self.evictions_halted:
+            self._drain_eviction_queue()
 
     def _loop(self) -> None:
         while not self._stop.is_set():

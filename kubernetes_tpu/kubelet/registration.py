@@ -11,6 +11,7 @@ capacity/port providers.
 
 from __future__ import annotations
 
+import random
 import threading
 from dataclasses import replace
 from typing import Callable, Dict, List, Optional
@@ -87,25 +88,45 @@ class NodeRegistration:
         except Exception:
             self.heartbeat_once()  # already registered: refresh status
 
-    def heartbeat_once(self) -> None:
+    def heartbeat_once(self) -> bool:
+        """One status sync; True when the apiserver accepted it."""
         try:
             node = self.client.get("nodes", self.node_name)
             self.client.update_status(
                 "nodes", replace(node, status=self._status()))
+            return True
         except NotFound:
             try:
                 self.client.create("nodes", self._node_object())
             except Exception:
                 pass
+            return True  # re-registration is its own success path
         except Exception:
-            pass  # apiserver hiccup: next tick retries
+            return False  # apiserver hiccup: caller retries with backoff
 
     def _loop(self) -> None:
+        # full jitter around the period (uniform over [0.5, 1.5) of the
+        # nominal interval): a 5k-node fleet whose kubelets all sleep
+        # exactly `heartbeat_interval` heartbeats in lockstep waves —
+        # every wave invalidates every cached node encoding at once and
+        # the controller's grace window sees synchronized staleness.
+        rng = random.Random()
         while not self._stop.is_set():
-            self._stop.wait(self.heartbeat_interval)
+            self._stop.wait(self.heartbeat_interval * rng.uniform(0.5, 1.5))
             if self._stop.is_set():
                 return
-            self.heartbeat_once()
+            # a failed sync retries on a short backoff instead of
+            # leaving the heartbeat stale for a whole period (which at
+            # long intervals walks straight into the controller's
+            # grace window and an Unknown marking)
+            backoff = min(0.2, self.heartbeat_interval / 4)
+            attempt = 0
+            while not self.heartbeat_once():
+                attempt += 1
+                if attempt >= 5 or self._stop.is_set():
+                    break
+                self._stop.wait(min(backoff * (2 ** (attempt - 1)),
+                                    self.heartbeat_interval))
 
     def run(self) -> "NodeRegistration":
         self.register()

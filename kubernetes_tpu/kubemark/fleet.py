@@ -19,9 +19,10 @@ the start-kubemark.sh role).
 from __future__ import annotations
 
 import queue
+import random
 import threading
 from dataclasses import replace
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Set
 
 from ..agents.hollow_node import confirm_pod_deletion
 from ..api.cache import Informer, meta_namespace_key
@@ -46,6 +47,13 @@ class HollowFleet:
         self.labels_for = labels_for or (lambda i: {})
         self._names = [f"{name_prefix}{i:05d}" for i in range(n_nodes)]
         self._running: Dict[str, str] = {}  # pod key -> node
+        # chaos surfaces (chaos.nodes.NodeChaos drives these):
+        # dead      — the host died: no heartbeats, no pod confirms
+        # frozen    — heartbeats suppressed (partition sim); kubelet alive
+        # not_ready — heartbeats continue but report Ready=False (flap sim)
+        self._dead: Set[str] = set()
+        self._frozen: Set[str] = set()
+        self._not_ready: Set[str] = set()
         self._lock = threading.Lock()
         self._status_q: "queue.Queue[Optional[api.Pod]]" = queue.Queue()
         # (ts, shared Ready conditions, shared running state) — see
@@ -59,16 +67,20 @@ class HollowFleet:
 
     def _node_object(self, i: int) -> api.Node:
         ts = api.now_rfc3339()
+        name = self._names[i]
+        with self._lock:
+            ready = "False" if name in self._not_ready else "True"
         return api.Node(
-            metadata=api.ObjectMeta(name=self._names[i],
+            metadata=api.ObjectMeta(name=name,
                                     labels=self.labels_for(i)),
             status=api.NodeStatus(
                 capacity={"cpu": parse_quantity(self.cpu),
                           "memory": parse_quantity(self.memory),
                           "pods": parse_quantity(str(self.max_pods))},
                 conditions=[
-                    api.NodeCondition(type="Ready", status="True",
-                                      reason="KubeletReady",
+                    api.NodeCondition(type="Ready", status=ready,
+                                      reason=("KubeletReady" if ready == "True"
+                                              else "KubeletNotReady"),
                                       last_heartbeat_time=ts),
                     api.NodeCondition(type="OutOfDisk", status="False",
                                       reason="KubeletHasSufficientDisk",
@@ -94,21 +106,34 @@ class HollowFleet:
                     if self._stop.is_set():
                         return
 
-    def _heartbeat_one(self, i: int) -> None:
+    def _heartbeat_one(self, i: int, retries: int = 2) -> None:
         name = self._names[i]
-        try:
-            node = self.client.get("nodes", name)
-            fresh = self._node_object(i)
-            self.client.update_status("nodes", replace(
-                node, status=replace(node.status,
-                                     conditions=fresh.status.conditions)))
-        except NotFound:
+        with self._lock:
+            if name in self._dead or name in self._frozen:
+                return  # a dead/partitioned kubelet posts nothing
+        for attempt in range(retries + 1):
             try:
-                self.client.create("nodes", self._node_object(i))
-            except ApiError:
-                pass
-        except Exception:
-            pass  # crash-only: next tick retries
+                node = self.client.get("nodes", name)
+                fresh = self._node_object(i)
+                self.client.update_status("nodes", replace(
+                    node, status=replace(node.status,
+                                         conditions=fresh.status.conditions)))
+                return
+            except NotFound:
+                try:
+                    self.client.create("nodes", self._node_object(i))
+                except ApiError:
+                    pass
+                return
+            except Exception:
+                # transient (injected fault, connection loss): retry
+                # with a short backoff instead of leaving the heartbeat
+                # stale a whole period — at 5k nodes and 5% faults,
+                # period-long gaps push healthy nodes over the
+                # controller's grace window
+                if attempt >= retries or self._stop.is_set():
+                    return
+                self._stop.wait(0.05 * (attempt + 1))
 
     def _heartbeat_loop(self) -> None:
         # staggered: real kubelets beat independently, not in one
@@ -117,12 +142,15 @@ class HollowFleet:
         # in the same instant, turning the next LIST into a full
         # re-encode spike (1.9s at 5k nodes, over the 1s API SLO). Beat
         # one shard per tick so each node still beats once per
-        # heartbeat_interval.
+        # heartbeat_interval; each tick draws full jitter (uniform over
+        # [0.5, 1.5) of the nominal tick) so shards decohere over time
+        # instead of 5k nodes settling into one phase-locked wave.
         shards = 10
         tick = self.heartbeat_interval / shards
         shard = 0
+        rng = random.Random()
         while not self._stop.is_set():
-            self._stop.wait(tick)
+            self._stop.wait(tick * rng.uniform(0.5, 1.5))
             if self._stop.is_set():
                 return
             self._heartbeat_shard(shard, shards)
@@ -134,12 +162,65 @@ class HollowFleet:
                 return
             self._heartbeat_one(i)
 
+    # ----------------------------------------------------- chaos surface
+
+    def node_names(self) -> List[str]:
+        return list(self._names)
+
+    def kill_nodes(self, names: Iterable[str]) -> List[str]:
+        """Hard-kill these hollow hosts: heartbeats stop, bound pods are
+        never confirmed Running again, deletion marks are never acked.
+        The Node API objects stay behind with stale heartbeats — exactly
+        the wire a dead machine leaves."""
+        names = [n for n in names if n in set(self._names)]
+        with self._lock:
+            self._dead.update(names)
+        return names
+
+    def dead_nodes(self) -> Set[str]:
+        with self._lock:
+            return set(self._dead)
+
+    def live_nodes(self) -> List[str]:
+        with self._lock:
+            return [n for n in self._names if n not in self._dead]
+
+    def freeze_heartbeats(self, names: Iterable[str]) -> None:
+        """Suppress heartbeats (master-side partition sim): the kubelet
+        is alive — pods still confirm — but its status updates never
+        arrive, so the controller sees the heartbeat go stale."""
+        with self._lock:
+            self._frozen.update(names)
+
+    def thaw_heartbeats(self, names: Optional[Iterable[str]] = None) -> None:
+        with self._lock:
+            if names is None:
+                self._frozen.clear()
+            else:
+                self._frozen.difference_update(names)
+
+    def set_not_ready(self, names: Iterable[str], not_ready: bool) -> None:
+        """Flap surface: keep heartbeating but report Ready=False (a
+        sick-but-alive kubelet). Toggling this is how NodeChaos bounces
+        a node Ready<->NotReady inside the controller's grace window."""
+        with self._lock:
+            if not_ready:
+                self._not_ready.update(names)
+            else:
+                self._not_ready.difference_update(names)
+
     # ----------------------------------------------------------- pod side
 
     def _on_pod(self, pod: api.Pod) -> None:
         node = pod.spec.node_name
         if not node or not node.startswith(self.name_prefix):
             return
+        with self._lock:
+            if node in self._dead:
+                # a dead kubelet neither confirms Running nor acks
+                # deletion marks — the pod object just sits there until
+                # the NodeController evicts it
+                return
         if pod.metadata.deletion_timestamp is not None:
             # graceful deletion's node half (hollow: nothing to drain):
             # confirm with the grace-0 uid-guarded delete so marked
@@ -214,6 +295,14 @@ class HollowFleet:
                     self._status_q.put(None)  # re-arm shutdown sentinel
                     break
                 batch.append(nxt)
+            with self._lock:
+                if self._dead:
+                    # nodes killed after their pods were queued: the
+                    # dead kubelet must not confirm them
+                    batch = [p for p in batch
+                             if p.spec.node_name not in self._dead]
+            if not batch:
+                continue
             ts = api.now_rfc3339()
             updated = [api.fast_replace(p,
                                         status=self._running_status(p, ts))

@@ -34,10 +34,12 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from ..core import types as api
+from ..core.errors import NotFound
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .device import BatchEngine, ClusterSnapshot
 from .device.incremental import IncrementalEncoder, NeedsFullEncode
 from .generic import FitError
+from .predicates import node_schedulable
 
 
 @dataclass
@@ -489,6 +491,50 @@ class BatchScheduler:
             except Exception:
                 logger.exception("error-routing pod failed")
 
+    def _target_alive(self, host: str) -> bool:
+        """Is the bind target still a live node RIGHT NOW, per the node
+        informer cache? The scan decided with encode-time knowledge; a
+        node can go NotReady/Unknown, get cordoned, or vanish between
+        scan and commit — binding to it anyway starts the bind -> evict
+        -> recreate -> rebind-to-the-corpse loop the NodeController
+        then has to fight."""
+        cache = getattr(self.config.factory.node_lister, "cache", None)
+        if cache is None:
+            return True
+        node = cache.get_by_key(host)
+        return node is not None and node_schedulable(node)
+
+    def _requeue(self, pod: api.Pod, host: str, reason: str) -> None:
+        """Immediate requeue, no error backoff: the pod did nothing
+        wrong — its target died (or a racing write collided) between
+        scan and commit. The FIFO re-add re-schedules it against the
+        post-death mask on the very next tile."""
+        f = self.config.factory
+        if f.recorder is not None:
+            f.recorder.eventf(pod, "Normal", "SchedulingRequeued",
+                              f"node {host} {reason}; pod requeued")
+        self.config.metrics.inc("batch_commit_requeues_total")
+        f.pod_queue.add(pod)
+
+    def _bind_failed(self, pod: api.Pod, host: str, err: Exception) -> None:
+        """A per-pod CAS bind was rejected. Re-read the pod: still
+        unbound -> requeue it NOW for a fresh placement instead of
+        paying the error path's 1s->60s backoff; already bound (a
+        racing scheduler won) or deleted -> done is done; the re-read
+        itself failing -> the classic error path (backoff + requeue)."""
+        f = self.config.factory
+        try:
+            fresh = f.client.get("pods", pod.metadata.name,
+                                 pod.metadata.namespace)
+        except NotFound:
+            return
+        except Exception:
+            self._error(pod, err)
+            return
+        if fresh.spec.node_name:
+            return
+        self._requeue(fresh, host, f"rejected the bind ({err})")
+
     def _commit(self, scheduled: List[Tuple[api.Pod, str]],
                 inc_assumed: bool) -> None:
         """Bind a tile (batched CAS, per-pod fallback), record events,
@@ -499,6 +545,21 @@ class BatchScheduler:
         it in locked_action for snapshot ordering."""
         c = self.config
         f = c.factory
+        # commit-time health gate: a target that went NotReady/Unknown,
+        # cordoned, or deleted since the scan gets its pods requeued
+        # rather than bound to a corpse (the incremental assume is
+        # corrected by the watch echo once the pod binds elsewhere)
+        live: List[Tuple[api.Pod, str]] = []
+        for pod, host in scheduled:
+            if self._target_alive(host):
+                live.append((pod, host))
+            else:
+                try:
+                    self._requeue(pod, host, "went unschedulable")
+                except Exception:
+                    logger.exception("requeue of %s failed",
+                                     pod.metadata.name)
+        scheduled = live
         # columnar commit: (ns, name, host) rows, no Binding carrier
         # objects on the hot path (client.bind_batch_hosts expands them
         # only for wire transports)
@@ -540,7 +601,7 @@ class BatchScheduler:
                             f.recorder.eventf(pod, "Normal",
                                               "FailedScheduling",
                                               f"Binding rejected: {e}")
-                        self._error(pod, e)
+                        self._bind_failed(pod, host, e)
         c.metrics.observe("binding_latency_microseconds",
                           (time.monotonic() - bind_start) * 1e6)
         to_assume = []

@@ -64,6 +64,8 @@ def ensure_x64() -> None:
 
 class NodeConst(NamedTuple):
     valid: jax.Array       # bool[N]
+    sched_ok: jax.Array    # bool[N] — node_schedulable at encode time;
+                           #   dead nodes stay in the table but masked
     cpu_cap: jax.Array     # i64[N]
     mem_cap: jax.Array     # i64[N]
     pod_cap: jax.Array     # i32[N]
@@ -175,7 +177,8 @@ def _mask_and_score(node: NodeConst, weights: Tuple[int, int, int],
         ((state.disk_any & pod.qany[None, :])
          | (state.disk_rw & pod.qrw[None, :])) != 0, axis=1)
 
-    mask = (node.valid & pod.valid & res_ok & ~port_conflict & sel_ok
+    mask = (node.valid & node.sched_ok & pod.valid & res_ok
+            & ~port_conflict & sel_ok
             & host_ok & ~disk_conflict & node.static_mask)
 
     if has_aff:
@@ -448,6 +451,7 @@ def _gather_lanes(node: NodeConst, state: State, tidx: jax.Array,
     XLA removes the dead bindings."""
     g = NodeConst(
         valid=node.valid[tidx] & lane_valid,
+        sched_ok=node.sched_ok[tidx],
         cpu_cap=node.cpu_cap[tidx], mem_cap=node.mem_cap[tidx],
         pod_cap=node.pod_cap[tidx], labels=node.labels[tidx],
         tie_rank=node.tie_rank[tidx],
@@ -611,7 +615,8 @@ def _make_spec_run(weights: Tuple[int, int, int],
 def _node_shardings(mesh: Mesh, axis: str):
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
-    node = NodeConst(valid=s(axis), cpu_cap=s(axis), mem_cap=s(axis),
+    node = NodeConst(valid=s(axis), sched_ok=s(axis),
+                     cpu_cap=s(axis), mem_cap=s(axis),
                      pod_cap=s(axis), labels=s(axis, None), tie_rank=s(axis),
                      exceed_cpu=s(axis), exceed_mem=s(axis), offgrid_max=s(),
                      aff_dom=s(None, axis), zone_id=s(axis),
@@ -749,7 +754,8 @@ class BatchEngine:
         enc = self._ensure_safe_dtypes(enc)
         nt, st, pb = enc.node_tab, enc.init_state, enc.pod_batch
         node = NodeConst(
-            valid=nt.valid, cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,
+            valid=nt.valid, sched_ok=nt.sched_ok,
+            cpu_cap=nt.cpu_cap, mem_cap=nt.mem_cap,
             pod_cap=nt.pod_cap, labels=nt.label_words, tie_rank=nt.tie_rank,
             exceed_cpu=nt.exceed_cpu, exceed_mem=nt.exceed_mem,
             offgrid_max=enc.offgrid_max, aff_dom=nt.aff_dom,

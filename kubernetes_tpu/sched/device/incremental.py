@@ -45,7 +45,7 @@ import numpy as np
 
 from ...core import types as api
 from ..modeler import ASSUMED_POD_TTL
-from ..predicates import get_resource_request
+from ..predicates import get_resource_request, node_schedulable
 from ..priorities import get_nonzero_requests
 from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
                      _disk_keys, _matching_services, _pod_spread_selectors,
@@ -177,7 +177,17 @@ class IncrementalEncoder:
         self._next_slot = 0   # high-water mark: len(node_slot) stops
                               # being the next-free index once slots
                               # are ever reclaimed
+        # valid: slot is occupied by a known node; sched_ok: that node is
+        # a live binding target (predicates.node_schedulable — Ready, not
+        # Unknown, not cordoned). The engine masks on valid & sched_ok,
+        # so a NotReady node keeps its slot (its pods keep counting into
+        # spread rows and topology domains, the serial node_by_name view)
+        # but never receives a binding. A condition flip arrives as a
+        # node update -> _node_upsert bumps state_epoch, which retires
+        # the node from any in-flight device carry (the batch scheduler
+        # refuses to chain across an epoch change and re-encodes).
         self.valid = np.zeros(self.n_cap, bool)
+        self.sched_ok = np.zeros(self.n_cap, bool)
         self.cpu_cap = np.zeros(self.n_cap, np.int64)
         self.mem_cap = np.zeros(self.n_cap, np.int64)
         self.pod_cap = np.zeros(self.n_cap, np.int32)
@@ -423,6 +433,7 @@ class IncrementalEncoder:
                 return
             self.state_epoch += 1
             self.valid[slot] = False
+            self.sched_ok[slot] = False
             # a DELETED node left the informer cache: the serial path's
             # node_by_name can no longer resolve it, so peers bound to
             # it must stop occupying topology domains (NotReady-but-
@@ -708,8 +719,8 @@ class IncrementalEncoder:
                 self.label_words = _grow(self.label_words, 1,
                                          self.labels_dict.words)
             _set_bit(self.label_words[slot], bit)
-        from ..factory import node_condition_predicate
-        self.valid[slot] = node_condition_predicate(node)
+        self.valid[slot] = True
+        self.sched_ok[slot] = node_schedulable(node)
         if self._policy is not None:
             # same math as tables.py's policy tier (predicates.go:292 /
             # priorities.go:148), one node at a time
@@ -804,7 +815,8 @@ class IncrementalEncoder:
         # to 5120 lanes (2% waste), not 8192 (64%) — every scan step pays
         # for the full node axis width
         new_cap = self.n_cap * 2 if self.n_cap < 1024 else self.n_cap + 1024
-        for attr in ("valid", "cpu_cap", "mem_cap", "pod_cap", "tie_rank",
+        for attr in ("valid", "sched_ok", "cpu_cap", "mem_cap", "pod_cap",
+                     "tie_rank",
                      "cpu_used", "mem_used", "nz_cpu", "nz_mem", "pod_count",
                      "exceed_cpu", "exceed_mem", "static_score"):
             setattr(self, attr, _grow(getattr(self, attr), 0, new_cap))
@@ -871,7 +883,8 @@ class IncrementalEncoder:
             row = aff_dom[tid]
             doms = dom_ids[tid]
             for slot, name in enumerate(self.node_names):
-                if not name or not self.valid[slot]:
+                if not name or not self.valid[slot] \
+                        or not self.sched_ok[slot]:
                     continue
                 value = self.node_labels[slot].get(topo_key)
                 if value is None:
@@ -1119,6 +1132,7 @@ class IncrementalEncoder:
 
             nt = NodeArrays(
                 valid=self.valid.copy(),
+                sched_ok=self.sched_ok.copy(),
                 cpu_cap=res(self.cpu_cap),
                 mem_cap=res(self.mem_cap, mem_scale),
                 pod_cap=self.pod_cap.copy(),

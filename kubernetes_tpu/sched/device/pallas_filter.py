@@ -196,8 +196,11 @@ def filter_masks(enc) -> np.ndarray:
     mask half. Caller must have checked supports(enc)."""
     nt, st, pb = enc.node_tab, enc.init_state, enc.pod_batch
     interpret = jax.default_backend() not in ("tpu",)
+    # sched_ok folds into the kernel's valid lane mask: the two are
+    # AND-ed identically in the XLA mask, so the kernel needs no new
+    # input column to match it bit-for-bit
     out = _filter_call(
-        (jnp.asarray(nt.valid), jnp.asarray(nt.cpu_cap),
+        (jnp.asarray(nt.valid & nt.sched_ok), jnp.asarray(nt.cpu_cap),
          jnp.asarray(nt.mem_cap), jnp.asarray(nt.pod_cap),
          jnp.asarray(nt.exceed_cpu), jnp.asarray(nt.exceed_mem),
          jnp.asarray(nt.static_mask), jnp.asarray(nt.label_words)),

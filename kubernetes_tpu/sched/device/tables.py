@@ -33,7 +33,7 @@ import numpy as np
 from ...core import labels as labelspkg
 from ...core import types as api
 from ..predicates import (filter_non_running_pods, get_resource_request,
-                          term_namespaces)
+                          node_schedulable, term_namespaces)
 from ..priorities import get_nonzero_requests
 
 WORD = 32
@@ -112,7 +112,13 @@ class ClusterSnapshot:
 
 @dataclass
 class NodeArrays:
-    valid: np.ndarray       # bool[N]
+    valid: np.ndarray       # bool[N] — real (unpadded) table row
+    sched_ok: np.ndarray    # bool[N] — node_schedulable at encode time
+                            #   (Ready, not Unknown, not cordoned); the
+                            #   engine masks on valid & sched_ok, so a
+                            #   dead node stays IN the table (its pods
+                            #   keep their spread counts and topology
+                            #   domains) but never receives a binding
     cpu_cap: np.ndarray     # i64[N] (milli)
     mem_cap: np.ndarray     # i64[N] (bytes)
     pod_cap: np.ndarray     # i32[N]
@@ -408,6 +414,7 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
     # ------------------------------------------------------ node table
     nt = NodeArrays(
         valid=np.zeros(n_pad, bool),
+        sched_ok=np.zeros(n_pad, bool),
         cpu_cap=np.zeros(n_pad, np.int64),
         mem_cap=np.zeros(n_pad, np.int64),
         pod_cap=np.zeros(n_pad, np.int32),
@@ -422,6 +429,7 @@ def encode_snapshot(snap: ClusterSnapshot, node_pad_to: int = 1,
         static_score=np.zeros(n_pad, np.int64))
     for i, n in enumerate(nodes):
         nt.valid[i] = True
+        nt.sched_ok[i] = node_schedulable(n)
         cap = n.status.capacity
         nt.cpu_cap[i] = cap["cpu"].milli if "cpu" in cap else 0
         nt.mem_cap[i] = cap["memory"].value if "memory" in cap else 0

@@ -30,6 +30,7 @@ from .api import Policy
 from .extender import HTTPExtender
 from .generic import GenericScheduler
 from .modeler import SimpleModeler
+from .predicates import node_schedulable
 from .scheduler import Scheduler, SchedulerConfig
 
 DEFAULT_BIND_PODS_QPS = 50.0   # ref: plugin/cmd/kube-scheduler/app/server.go:69
@@ -40,16 +41,10 @@ def node_condition_predicate(node: api.Node) -> bool:
     """(ref: factory.go:241 getNodeConditionPredicate; the
     spec.unschedulable check stands in for createNodeLW's server-side
     field selector — the informer is deliberately UNfiltered here, see
-    ConfigFactory)"""
-    if node.spec.unschedulable:
-        return False
-    for cond in node.status.conditions:
-        if cond.type == api.NODE_READY and cond.status != api.CONDITION_TRUE:
-            return False
-        if cond.type == api.NODE_OUT_OF_DISK and \
-                cond.status != api.CONDITION_FALSE:
-            return False
-    return True
+    ConfigFactory). Delegates to predicates.node_schedulable so the
+    candidate filter, the serial NodeSchedulable predicate and the
+    device encoders' sched_ok mask cannot drift."""
+    return node_schedulable(node)
 
 
 class ReadyNodeLister:
@@ -124,7 +119,10 @@ def _translate_policy(policy):
         # a stricter engine than its serial counterpart
         required = _ENGINE_CORE_PREDICATES | {"PodFitsHostPorts",
                                               "InterPodAffinity"}
-        if not required <= named or named - required:
+        # NodeSchedulable is enforced unconditionally by the engine's
+        # sched_ok mask (and by the serial path's candidate filter), so
+        # a policy may name it but never has to
+        if not required <= named or named - (required | {"NodeSchedulable"}):
             return None  # dropped core predicate / unknown name
     weights = [1, 1, 1]
     if policy.priorities:

@@ -132,6 +132,8 @@ register_fit_predicate("NoDiskConflict",
 register_fit_predicate("MatchNodeSelector",
                        lambda args: preds.pod_selector_matches)
 register_fit_predicate("HostName", lambda args: preds.pod_fits_host)
+register_fit_predicate("NodeSchedulable",
+                       lambda args: preds.pod_fits_node_schedulable)
 
 
 def _inter_pod_affinity_factory(args: PluginFactoryArgs) -> Callable:
@@ -169,10 +171,16 @@ DEFAULT_PROVIDER = "DefaultProvider"
 # default predicate set (the reference has no inter-pod affinity at v1.1;
 # the batch engine enforces it unconditionally for pods that carry
 # spec.affinity, so the serial fallback must too — path-independent
-# bindings). Pods without affinity specs are unaffected.
+# bindings). Pods without affinity specs are unaffected. NodeSchedulable
+# joins too: the reference leans on the filtered node watch alone, but a
+# node that dies between the informer's candidate filter and the
+# predicate walk (or a static node lister that never filtered) must not
+# receive bindings — the device engine enforces the same via its
+# sched_ok mask column, so the serial provider must match.
 register_algorithm_provider(
     DEFAULT_PROVIDER,
     {"PodFitsHostPorts", "PodFitsResources", "NoDiskConflict",
-     "MatchNodeSelector", "HostName", "InterPodAffinity"},
+     "MatchNodeSelector", "HostName", "InterPodAffinity",
+     "NodeSchedulable"},
     {"LeastRequestedPriority", "BalancedResourceAllocation",
      "SelectorSpreadPriority"})

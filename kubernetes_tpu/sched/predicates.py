@@ -33,6 +33,40 @@ PredicateResult = Tuple[bool, Optional[str]]
 POD_EXCEEDS_FREE_CPU = "PodExceedsFreeCPU"
 POD_EXCEEDS_FREE_MEMORY = "PodExceedsFreeMemory"
 POD_EXCEEDS_MAX_POD_NUMBER = "PodExceedsMaxPodNumber"
+NODE_NOT_SCHEDULABLE = "NodeNotSchedulable"
+
+
+def node_schedulable(node: api.Node) -> bool:
+    """Is the node a live binding target? (ref: factory.go:241
+    getNodeConditionPredicate + createNodeLW's spec.unschedulable field
+    selector, :281-285.)
+
+    False when spec.unschedulable is set, when the Ready condition is
+    not True (False OR Unknown — a stale-heartbeat node the
+    NodeController marked Unknown is dead to the scheduler), or when
+    OutOfDisk is reported anything but False. The single source of
+    node-schedulability truth: the serial oracle's predicate, the
+    factory's candidate filter and the device encoders' mask column all
+    call this."""
+    if node.spec.unschedulable:
+        return False
+    for cond in node.status.conditions:
+        if cond.type == api.NODE_READY and cond.status != api.CONDITION_TRUE:
+            return False
+        if cond.type == api.NODE_OUT_OF_DISK and \
+                cond.status != api.CONDITION_FALSE:
+            return False
+    return True
+
+
+def pod_fits_node_schedulable(pod: api.Pod, existing_pods: Sequence[api.Pod],
+                              node: api.Node) -> PredicateResult:
+    """Node-schedulability as a fit predicate, so a node list that was
+    NOT pre-filtered (static listers, mid-tile condition flips) still
+    never produces a bind to a NotReady/Unknown/cordoned node."""
+    if node_schedulable(node):
+        return True, None
+    return False, NODE_NOT_SCHEDULABLE
 
 
 def get_resource_request(pod: api.Pod) -> Tuple[int, int]:

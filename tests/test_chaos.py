@@ -16,7 +16,8 @@ from kubernetes_tpu.api.cache import Informer, Reflector
 from kubernetes_tpu.api.client import Client, HttpClient, InProcClient
 from kubernetes_tpu.api.registry import Registry
 from kubernetes_tpu.api.server import ApiServer
-from kubernetes_tpu.chaos import VERBS, ChaosClient, FaultPlan
+from kubernetes_tpu.chaos import (VERBS, ChaosClient, FaultPlan,
+                                  NodeFaultPlan)
 from kubernetes_tpu.controllers.replication import ReplicationManager
 from kubernetes_tpu.core import types as api
 from kubernetes_tpu.core.quantity import parse_quantity
@@ -280,6 +281,87 @@ def test_chaos_soak_reproducible_across_invocations():
     for verb in VERBS:
         n = min(len(trace_a[verb]), len(trace_b[verb]))
         assert trace_a[verb][:n] == trace_b[verb][:n], verb
+
+
+# ------------------------------------------------------- node-kill chaos
+
+@pytest.mark.chaos
+class TestNodeFaultPlanDeterminism:
+    NAMES = [f"hollow-{i:05d}" for i in range(50)]
+
+    def test_same_seed_same_victims(self):
+        a = NodeFaultPlan(seed=11, kill_fraction=0.2)
+        b = NodeFaultPlan(seed=11, kill_fraction=0.2)
+        assert a.kill_set(self.NAMES) == b.kill_set(self.NAMES)
+        assert a.schedule(self.NAMES) == b.schedule(self.NAMES)
+
+    def test_selection_independent_of_name_order(self):
+        plan = NodeFaultPlan(seed=11, kill_fraction=0.2)
+        shuffled = list(reversed(self.NAMES))
+        assert plan.kill_set(self.NAMES) == plan.kill_set(shuffled)
+
+    def test_streams_independent(self):
+        """kill/freeze/flap draw from independent streams: turning one
+        fault class on cannot shift another's victims."""
+        kill_only = NodeFaultPlan(seed=5, kill_fraction=0.1)
+        both = NodeFaultPlan(seed=5, kill_fraction=0.1,
+                             freeze_fraction=0.5)
+        assert kill_only.kill_set(self.NAMES) == both.kill_set(self.NAMES)
+
+    def test_different_seeds_differ(self):
+        a = NodeFaultPlan(seed=1, kill_fraction=0.2)
+        b = NodeFaultPlan(seed=2, kill_fraction=0.2)
+        assert a.kill_set(self.NAMES) != b.kill_set(self.NAMES)
+
+
+@pytest.mark.chaos
+def test_node_kill_soak_converges_off_dead_nodes():
+    """Acceptance (fast shape): 5% API faults on every verb, 10% of the
+    hollow fleet hard-killed mid-run — the stack converges with every
+    replica Running on a live node, zero pods still bound to a dead
+    node, and the applied kill set equal to the seed's pure replay."""
+    from kubernetes_tpu.kubemark.node_chaos import run_node_kill_soak
+    r = run_node_kill_soak(n_nodes=40, replicas=30, kill_fraction=0.10,
+                           seed=1205, fault_rate=0.05, timeout=120)
+    assert r.converged, r.as_dict()
+    assert r.dead_bound == 0
+    assert r.killed and len(r.killed) == 4
+    assert r.schedule_replayed
+    assert r.evictions >= 1   # the controller, not pod GC, cleared them
+    assert r.rebinds >= 1     # replacements were re-placed post-kill
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_node_kill_soak_1k_nodes():
+    """The fleet-scale gate: 1000 hollow nodes, 10% killed mid-run
+    under 5% API faults — converges with zero bindings to dead nodes
+    and the seeded kill schedule replays identically."""
+    from kubernetes_tpu.kubemark.node_chaos import run_node_kill_soak
+    r = run_node_kill_soak(n_nodes=1000, replicas=600,
+                           kill_fraction=0.10, seed=77, fault_rate=0.05,
+                           timeout=420, heartbeat_interval=2.0,
+                           monitor_period=0.3, monitor_grace_period=6.0,
+                           pod_eviction_timeout=0.5)
+    assert r.converged, r.as_dict()
+    assert r.dead_bound == 0
+    assert len(r.killed) == 100
+    assert r.schedule_replayed
+    assert r.evictions >= 1
+
+
+@pytest.mark.chaos
+def test_partition_gate_halts_and_resumes_evictions():
+    """Acceptance: freezing >55% of heartbeats at once engages the
+    NodeController's partition valve (zero evictions while halted);
+    thawing recovers the fleet and disengages it."""
+    from kubernetes_tpu.kubemark.node_chaos import run_partition_gate
+    out = run_partition_gate(n_nodes=20, freeze_fraction=0.6, seed=3)
+    assert out["halted"], out
+    assert out["evictions_while_halted"] == 0
+    assert out["resumed"], out
+    assert out["halts"] >= 1
+    assert len(out["frozen"]) == 12
 
 
 # ---------------------------------------- outage backoff + restart gates

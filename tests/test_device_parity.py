@@ -34,6 +34,7 @@ DEFAULT_PREDICATES = {
     "NoDiskConflict": preds.no_disk_conflict,
     "MatchNodeSelector": preds.pod_selector_matches,
     "HostName": preds.pod_fits_host,
+    "NodeSchedulable": preds.pod_fits_node_schedulable,
 }
 
 
@@ -190,6 +191,46 @@ def test_engine_matches_oracle_tight_capacity():
     # all pods race for few slots: exercises sequential-commit semantics
     snap = rand_cluster(99, n_nodes=3, n_existing=5, n_pending=30)
     assert schedule_batch(snap) == oracle_schedule(snap)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_engine_never_binds_unschedulable_nodes(seed):
+    """ISSUE-5 acceptance, device half: nodes marked Unknown/NotReady or
+    cordoned at encode time NEVER receive a binding (the sched_ok mask
+    column), and the engine stays bit-identical with the serial oracle
+    over a snapshot that still CONTAINS those nodes — their pods keep
+    feeding spread counts, matching the oracle's unfiltered pod view."""
+    snap = rand_cluster(seed, n_nodes=10, n_existing=12, n_pending=30)
+    rng = random.Random(1000 + seed)
+    dead = set()
+    for node in snap.nodes:
+        r = rng.random()
+        if r < 0.25:
+            node.status.conditions = [api.NodeCondition(
+                type="Ready", status=rng.choice(["Unknown", "False"]))]
+            dead.add(node.metadata.name)
+        elif r < 0.35:
+            node.spec.unschedulable = True
+            dead.add(node.metadata.name)
+        else:
+            node.status.conditions = [api.NodeCondition(
+                type="Ready", status="True")]
+    if not dead:  # the draw left everyone alive: kill one outright
+        snap.nodes[0].status.conditions = [api.NodeCondition(
+            type="Ready", status="Unknown")]
+        dead.add(snap.nodes[0].metadata.name)
+    got = schedule_batch(snap)
+    want = oracle_schedule(snap)
+    assert got == want
+    assert all(h not in dead for h in got if h is not None)
+    # dead capacity is real capacity lost: with every node dead, nothing
+    # schedules
+    for node in snap.nodes:
+        node.status.conditions = [api.NodeCondition(
+            type="Ready", status="Unknown")]
+    all_dead = schedule_batch(snap)
+    assert all_dead == [None] * len(snap.pending_pods)
+    assert all_dead == oracle_schedule(snap)
 
 
 def test_engine_empty_and_trivial():

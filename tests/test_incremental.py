@@ -171,6 +171,32 @@ def test_node_readiness_and_capacity_update():
     assert inc.exceed_cpu[inc.node_slot["n-00"]]
 
 
+def test_cordon_flip_retires_and_restores_node():
+    """spec.unschedulable rides the sched_ok mask column: a cordon
+    update retires the slot from scheduling (and bumps state_epoch so a
+    device carry can't keep using the stale mask); uncordoning restores
+    it."""
+    inc = IncrementalEncoder()
+    inc.on_node_add(mk_node("n-00"))
+    inc.on_node_add(mk_node("n-01"))
+    engine = BatchEngine()
+    cordoned = mk_node("n-00")
+    cordoned.spec.unschedulable = True
+    epoch_before = inc.state_epoch
+    inc.on_node_update(mk_node("n-00"), cordoned)
+    assert inc.state_epoch > epoch_before  # carry invalidated
+    enc = inc.encode_tile([mk_pod("p-0", phase="Pending")], [], [])
+    a, _ = engine.run_chunked(enc, 64)
+    assert enc.node_names[int(a[0])] == "n-01"
+    # uncordon: n-00 schedulable again (and wins the tie-break,
+    # name-descending pick -> highest tie_rank among max-score nodes)
+    inc.on_node_update(cordoned, mk_node("n-00"))
+    enc2 = inc.encode_tile([mk_pod("p-1", phase="Pending")], [], [])
+    a2, _ = engine.run_chunked(enc2, 64)
+    assert bool(enc2.node_tab.sched_ok[inc.node_slot["n-00"]])
+    assert int(a2[0]) >= 0
+
+
 def test_assume_then_watch_echo_dedup():
     inc = IncrementalEncoder()
     inc.on_node_add(mk_node("n-00"))

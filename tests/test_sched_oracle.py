@@ -155,6 +155,56 @@ class TestHostAndSelector:
         assert require(cpod(), [], cnode(labels={"zone": "a"}))[0]
         assert not require(cpod(), [], cnode(labels={}))[0]
 
+    def test_node_schedulable(self):
+        """Ready/Unknown condition + spec.unschedulable (ISSUE 5): the
+        serial oracle must refuse dead and cordoned nodes even when the
+        candidate list was never pre-filtered."""
+        ready = cnode(conditions=[
+            api.NodeCondition(type="Ready", status="True")])
+        not_ready = cnode(conditions=[
+            api.NodeCondition(type="Ready", status="False")])
+        unknown = cnode(conditions=[
+            api.NodeCondition(type="Ready", status="Unknown")])
+        out_of_disk = cnode(conditions=[
+            api.NodeCondition(type="Ready", status="True"),
+            api.NodeCondition(type="OutOfDisk", status="True")])
+        cordoned = cnode(conditions=[
+            api.NodeCondition(type="Ready", status="True")])
+        cordoned.spec.unschedulable = True
+        assert preds.pod_fits_node_schedulable(cpod(), [], ready)[0]
+        # a condition-less node (fresh registration) is schedulable —
+        # matches getNodeConditionPredicate's per-condition walk
+        assert preds.pod_fits_node_schedulable(cpod(), [], cnode())[0]
+        for bad in (not_ready, unknown, out_of_disk, cordoned):
+            fit, reason = preds.pod_fits_node_schedulable(cpod(), [], bad)
+            assert not fit
+            assert reason == preds.NODE_NOT_SCHEDULABLE
+
+    def test_scheduler_never_binds_to_unschedulable_node(self):
+        """Serial-oracle half of the ISSUE-5 acceptance: with the
+        default provider's predicate set, a NotReady/Unknown/cordoned
+        node never receives a bind even when it is strictly the most
+        attractive candidate."""
+        from kubernetes_tpu.sched import plugins
+        live = cnode("n-live", cpu="1", mem="1Gi", conditions=[
+            api.NodeCondition(type="Ready", status="True")])
+        dead = cnode("n-dead", cpu="64", mem="512Gi", conditions=[
+            api.NodeCondition(type="Ready", status="Unknown")])
+        cordoned = cnode("n-cordoned", cpu="64", mem="512Gi", conditions=[
+            api.NodeCondition(type="Ready", status="True")])
+        cordoned.spec.unschedulable = True
+        keys, _ = plugins.get_algorithm_provider(plugins.DEFAULT_PROVIDER)
+        assert "NodeSchedulable" in keys
+        predicates = plugins.get_fit_predicates(
+            keys, plugins.PluginFactoryArgs(
+                pod_lister=FakePodLister([]),
+                node_lister=FakeNodeLister([live, dead, cordoned])))
+        gs = GenericScheduler(predicates, [], FakePodLister([]))
+        for _ in range(3):
+            host = gs.schedule(cpod(cpu="100m", mem="64Mi"),
+                               FakeNodeLister([live, dead, cordoned]))
+            assert host == "n-live"
+
 
 def vol_gce(pd, ro=False):
     return api.Volume(name=pd, gce_persistent_disk=

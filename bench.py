@@ -30,6 +30,10 @@ tpu backend at the north-star shape, folds its e2e/engine numbers
 into TPU_EVIDENCE_BEST.json under the shared chip lock; r06 adds
 node_chaos (the --node-kill-fraction recovery arm: kill/convergence
 times, evictions, rebinds, the zero-dead-bindings gate), null unless
+requested; r07 adds durability (the --wal-dir fsync-policy A/B +
+recovery replay, and the --crash-seed process-crash soak: recovery
+wall-clock, replayed records/s, leader transitions, the
+zero-duplicate-bindings / one-holder-per-term gates), null unless
 requested.
 """
 
@@ -248,6 +252,28 @@ def main():
                     help="seed for the node-kill arm's NodeFaultPlan "
                          "and API-fault schedule (same seed -> "
                          "identical kill set)")
+    ap.add_argument("--wal-dir", default=None,
+                    help="run the WAL durability arm: a create storm "
+                         "against a WAL-backed store under each fsync "
+                         "policy (always vs batch) plus a recovery "
+                         "replay, recorded as durability.wal "
+                         "(kubemark/crash_soak.run_wal_bench). The "
+                         "directory is used as scratch; pass a path "
+                         "on the filesystem whose fsync cost you "
+                         "want measured")
+    ap.add_argument("--wal-records", type=int, default=5000,
+                    help="record count for the --wal-dir arm")
+    ap.add_argument("--crash-seed", type=int, default=None,
+                    help="run the process-crash soak: WAL-backed "
+                         "store, redundant schedulers + controller-"
+                         "managers under lease election, 5%% API "
+                         "faults, seeded apiserver/scheduler/"
+                         "controller-manager kills "
+                         "(kubemark/crash_soak.py); records "
+                         "durability.crash — recovery wall-clock and "
+                         "replayed records, leader transitions, and "
+                         "the zero-duplicate-bindings / one-holder-"
+                         "per-term gates")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -367,6 +393,46 @@ def main():
                   f"{nk.converged} in {nk.converge_s:.1f}s "
                   f"({nk.evictions} evictions, {nk.rebinds} rebinds)",
                   file=sys.stderr)
+    durability = None
+    if args.wal_dir is not None or args.crash_seed is not None:
+        # the durability/HA arm (ISSUE 7): the WAL fsync-policy A/B +
+        # recovery replay, and/or the seeded process-crash soak — the
+        # exact invariants tests/test_chaos.py's crash gates enforce,
+        # recorded so the artifact carries the numbers (recovery
+        # wall-clock, replayed records/s, leader transitions)
+        from kubernetes_tpu.kubemark.crash_soak import (run_crash_soak,
+                                                        run_wal_bench)
+        durability = {}
+        if args.wal_dir is not None:
+            durability["wal"] = run_wal_bench(n_records=args.wal_records,
+                                              wal_dir=args.wal_dir)
+            if args.verbose:
+                w = durability["wal"]
+                print(f"# wal always={w['always']['records_per_sec']}/s "
+                      f"batch={w['batch']['records_per_sec']}/s "
+                      f"recovery={w['recovery']['wall_s']}s",
+                      file=sys.stderr)
+        if args.crash_seed is not None:
+            cs = run_crash_soak(n_nodes=6, replicas=24,
+                                seed=args.crash_seed, fault_rate=0.05,
+                                timeout=180)
+            durability["crash"] = {
+                "seed": args.crash_seed,
+                "converged": cs.converged,
+                "convergence_s": cs.converge_s,
+                "killed": cs.killed,
+                "schedule_replayed": cs.schedule_replayed,
+                "recovery": cs.recovery,
+                "duplicate_bindings": len(cs.duplicate_bindings),
+                "term_violations": len(cs.term_violations),
+                "terms": cs.terms,
+                "counters": cs.counters}
+            if args.verbose:
+                print(f"# crash[seed={args.crash_seed}] converged="
+                      f"{cs.converged} in {cs.converge_s:.1f}s "
+                      f"(dupes={len(cs.duplicate_bindings)} "
+                      f"term_violations={len(cs.term_violations)})",
+                      file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -475,6 +541,7 @@ def main():
         "store_ab": store_ab,
         "chaos": chaos,
         "node_chaos": node_chaos,
+        "durability": durability,
         "multihost": multihost,
         "tpu": _tpu_section()}))
 

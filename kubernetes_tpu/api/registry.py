@@ -422,6 +422,15 @@ _register(ResourceInfo("thirdpartyresources", "ThirdPartyResource",
 # own; ref: pkg/registry/pod/etcd BindingREST).
 _register(ResourceInfo("bindings", "Binding", api.Binding, True,
                        has_status=False))
+# coordination/leases: the CAS-renewed leader-election record
+# (utils/leaderelection.py). Forward-ported from the reference's master
+# election seam into the typed Lease the later reference grew; served
+# flat under api/v1 rather than a coordination.k8s.io group (the server
+# mounts one registry — DIVERGENCES.md #25). Every PUT carries the
+# elector's observed resourceVersion, so acquisition races resolve to
+# exactly one winner per fencing term at the store's CAS.
+_register(ResourceInfo("leases", "Lease", api.Lease, True,
+                       has_status=False))
 
 
 class Registry:

@@ -1,4 +1,5 @@
-"""Deterministic chaos injection for the API plane and the node fleet.
+"""Deterministic chaos injection for the API plane, the node fleet,
+and the control-plane processes themselves.
 
 `ChaosClient` wraps any `api.client.Client` with seeded, per-verb fault
 streams (error rates, injected latency, 429/503 bursts, watch-stream
@@ -8,10 +9,18 @@ on. See `injector.py` for the determinism contract.
 `NodeFaultPlan`/`NodeChaos` extend the same fixed-draw determinism to
 NODE faults — seeded kill / heartbeat-freeze / flap schedules driving a
 `kubemark.fleet.HollowFleet` (see `nodes.py`).
+
+`CrashPlan`/`CrashChaos` extend it to PROCESS death: seeded kill points
+(in bound-pod progress, not wall time) for the apiserver, the active
+scheduler, and the active controller-manager — the durability/HA gates
+ride these (see `crash.py` and `kubemark/crash_soak.py`).
 """
 
+from .crash import TARGETS as CRASH_TARGETS
+from .crash import CrashChaos, CrashPlan
 from .injector import VERBS, ChaosClient, ChaosWatcher, FaultPlan
 from .nodes import NodeChaos, NodeFaultPlan
 
-__all__ = ["ChaosClient", "ChaosWatcher", "FaultPlan", "NodeChaos",
-           "NodeFaultPlan", "VERBS"]
+__all__ = ["ChaosClient", "ChaosWatcher", "CrashChaos", "CrashPlan",
+           "CRASH_TARGETS", "FaultPlan", "NodeChaos", "NodeFaultPlan",
+           "VERBS"]

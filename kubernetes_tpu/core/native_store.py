@@ -26,6 +26,7 @@ can fall back to the pure-Python Store (native_available() probes).
 from __future__ import annotations
 
 import ctypes
+import json as _json
 import os
 import struct
 import subprocess
@@ -127,6 +128,25 @@ def _load_library() -> ctypes.CDLL:
         lib.kv_wait.restype = ctypes.c_uint64
         lib.kv_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                 ctypes.c_double]
+        # WAL recovery surface (kvstore.cc kv_restore/kv_restore_seal/
+        # kv_replay); absent only in a stale prebuilt library, in which
+        # case recover() refuses rather than replaying wrong
+        try:
+            lib.kv_restore.restype = ctypes.c_int64
+            lib.kv_restore.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint64, ctypes.c_double]
+            lib.kv_restore_seal.restype = None
+            lib.kv_restore_seal.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint64]
+            lib.kv_replay.restype = ctypes.c_int64
+            lib.kv_replay.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                      ctypes.c_uint8, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_uint64, ctypes.c_double]
+            lib.has_recovery = True
+        except AttributeError:
+            lib.has_recovery = False
         _lib = lib
         return lib
 
@@ -205,6 +225,60 @@ class NativeStore:
     @property
     def current_revision(self) -> int:
         return int(self._lib.kv_current_rev(self._h))
+
+    # ----------------------------------------------------- durability
+
+    @classmethod
+    def recover(cls, wal_dir: str, window: int = 100_000,
+                scheme: Scheme = default_scheme) -> "NativeStore":
+        """Rebuild a NativeStore from a WAL directory (core/wal.py
+        layout, as written by the Python Store's ledger hook): snapshot
+        entries restore with their original mod_revs and absolute
+        expiries (kv_restore, no history), the revision counter seals
+        at the snapshot point (kv_restore_seal — revisions at or below
+        it are not replayable, the watch-window contract), and the
+        record tail replays at its exact revisions (kv_replay). Same
+        recovered-prefix contract as Store.recover: same revision,
+        same live object set, expired keys never resurrected. This is
+        also the migration path from the in-proc ledger onto the
+        native engine: capture with one backend, recover into the
+        other."""
+        import time as _time
+
+        from ..utils.metrics import global_metrics
+        from .wal import WalError, read_wal
+
+        t0 = _time.monotonic()
+        lib = _load_library()
+        if not getattr(lib, "has_recovery", False):
+            raise WalError("native library predates the recovery ABI; "
+                           "rebuild kvstore.cc")
+        snap, records = read_wal(wal_dir)
+        st = cls(window=window, scheme=scheme)
+        etype_code = {v: k for k, v in _EVENT_TYPES.items()}
+        if snap is not None:
+            for key, mod_rev, expiry, wire in snap["entries"]:
+                raw = _json.dumps(wire).encode()
+                lib.kv_restore(st._h, key.encode(), raw, len(raw),
+                               int(mod_rev), float(expiry or 0))
+            lib.kv_restore_seal(st._h, int(snap["rev"]))
+        for rev, etype, key, expiry, wire in records:
+            raw = _json.dumps(wire).encode()
+            obj_rev = int((wire.get("metadata") or {})
+                          .get("resourceVersion") or rev)
+            if lib.kv_replay(st._h, rev, etype_code[etype], key.encode(),
+                             raw, len(raw), obj_rev,
+                             float(expiry or 0)) != rev:
+                raise WalError(f"replay of revision {rev} rejected "
+                               f"(engine at {st.current_revision})")
+        global_metrics.inc("wal_recoveries_total")
+        st.recovery_stats = {
+            "snapshot_rev": snap["rev"] if snap is not None else 0,
+            "replayed_records": len(records),
+            "recovered_revision": st.current_revision,
+            "seconds": round(_time.monotonic() - t0, 6),
+        }
+        return st
 
     def create(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         raw = self._encode(obj)

@@ -109,6 +109,7 @@ def new_scheme() -> Scheme:
     s.register("Endpoints", api.Endpoints)
     s.register("ReplicationController", api.ReplicationController)
     s.register("Binding", api.Binding)
+    s.register("Lease", api.Lease)
     s.register("Event", api.Event)
     s.register("Namespace", api.Namespace)
     s.register("Secret", api.Secret)

@@ -65,7 +65,11 @@ def _with_rv(obj: Any, rev: int) -> Any:
 
 
 class Store:
-    def __init__(self, window: int = 100_000, publish_inline: bool = False):
+    def __init__(self, window: int = 100_000, publish_inline: bool = False,
+                 wal_dir: Optional[str] = None,
+                 fsync_policy: str = "batch",
+                 wal_segment_records: int = 10_000,
+                 wal_snapshot_records: int = 50_000):
         # the LEDGER lock: guards _rev/_data/_seg_keys/_history/list
         # caches — and nothing else. Watch fan-out runs outside it.
         self._lock = threading.RLock()
@@ -132,6 +136,26 @@ class Store:
         # embedded resourceVersion stays sound because no events exist
         # for this segment between the two revisions)
         self._seg_writes: Dict[str, int] = {}
+        # durability (opt-in): a segmented, checksummed write-ahead log
+        # hooked at the ledger stage — one record per committed
+        # revision, appended under the ledger lock so append order IS
+        # revision order (core/wal.py; recovery is Store.recover).
+        # wal_dir=None keeps every hot path byte-identical to before.
+        self._wal = None
+        self._wal_scheme = None
+        if wal_dir is not None:
+            import os
+            from .wal import WalError, WalWriter, _segments, _snapshots
+            if os.path.isdir(wal_dir) and (_segments(wal_dir)
+                                           or _snapshots(wal_dir)):
+                raise WalError(
+                    f"{wal_dir} already holds a WAL — a fresh Store "
+                    f"would fork its history; use Store.recover()")
+            self._wal = WalWriter(wal_dir, fsync_policy=fsync_policy,
+                                  segment_records=wal_segment_records,
+                                  snapshot_records=wal_snapshot_records)
+            from .scheme import default_scheme
+            self._wal_scheme = default_scheme
 
     # ------------------------------------------------------------- helpers
 
@@ -230,7 +254,46 @@ class Store:
         if len(self._history) == self._history.maxlen:
             self._oldest_rev = self._history[0][0]
         self._history.append((rev, etype, key, obj, prev))
+        if self._wal is not None:
+            self._wal_append(rev, etype, key, obj)
         return watchpkg.Event(etype, obj)
+
+    def _wal_append(self, rev: int, etype: str, key: str, obj: Any) -> None:
+        """Buffer one ledger record (caller holds the ledger lock).
+        The entry's absolute expiry rides along so recovery restores
+        TTL deadlines instead of resurrecting expired keys; for a
+        DELETED record the entry is already gone and expiry is moot."""
+        entry = self._data.get(key)
+        self._wal.append(rev, etype, key,
+                         entry[2] if entry is not None else None,
+                         self._wal_scheme.encode_dict(obj))
+
+    def _wal_sync(self) -> None:
+        """Flush buffered WAL records for the commit that just ran
+        (caller still holds the ledger lock — append order stays
+        revision order) and compact when the snapshot interval is due.
+        The snapshot runs under the lock too: commits stall for its
+        duration, which is the price of a consistent cut."""
+        w = self._wal
+        if w is None:
+            return
+        w.commit()
+        if w.should_snapshot:
+            w.write_snapshot(self._snapshot_state())
+
+    def _snapshot_state(self) -> dict:
+        """Full store state for a WAL snapshot (caller holds the ledger
+        lock): the live entries plus the bookkeeping recovery must
+        rebuild bit-identically — per-segment write counters (the LIST
+        byte-cache validity tokens) and the TTL'd-segment set."""
+        enc = self._wal_scheme.encode_dict
+        return {
+            "rev": self._rev,
+            "entries": [[k, mod_rev, expiry, enc(obj)]
+                        for k, (obj, mod_rev, expiry) in self._data.items()],
+            "seg_writes": dict(self._seg_writes),
+            "ttl_segs": sorted(self._ttl_segs),
+        }
 
     @staticmethod
     def _filtered_event(ev: watchpkg.Event, prev: Any,
@@ -424,6 +487,7 @@ class Store:
                     heapq.heappush(self._expiry_heap, (expiry, key))
                     self._ttl_segs.add(self._seg(key))
                 self._emit(rev, watchpkg.ADDED, key, obj, None)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
                 return obj
@@ -477,6 +541,7 @@ class Store:
                          None))
                     out.append(obj)
                 self._stage_publish(batch_events)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
                 return out
@@ -500,6 +565,7 @@ class Store:
                     self._ttl_segs.add(self._seg(key))
                 etype = watchpkg.MODIFIED if prev else watchpkg.ADDED
                 self._emit(rev, etype, key, obj, prev[0] if prev else None)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
                 return obj
@@ -526,6 +592,7 @@ class Store:
                 obj = _with_rv(obj, rev)
                 self._data[key] = (obj, rev, expiry)
                 self._emit(rev, watchpkg.MODIFIED, key, obj, stored)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
                 return obj
@@ -562,6 +629,7 @@ class Store:
                         self._ttl_segs.add(self._seg(key))
                     self._data[key] = (new_obj, rev, expiry)
                     self._emit(rev, watchpkg.MODIFIED, key, new_obj, stored)
+                    self._wal_sync()
                     if self._publish_inline:
                         self._drain_publish()
                     return new_obj
@@ -583,6 +651,7 @@ class Store:
                 self._index_del(key)
                 rev = self._bump()
                 self._emit(rev, watchpkg.DELETED, key, stored, stored)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
                 return stored
@@ -638,12 +707,18 @@ class Store:
                 hist = self._history
                 hist_append = hist.append
                 hist_max = hist.maxlen
-                segs = set()
+                seg_of = self._seg
+                seg_writes = self._seg_writes
+                seg_writes_get = seg_writes.get
                 modified = watchpkg.MODIFIED
                 event = watchpkg.Event
                 for key, new_obj, stored, expiry, rev in staged:
                     data[key] = (new_obj, rev, expiry)
-                    segs.add(self._seg(key))
+                    # per-RECORD write token (not per batch): WAL replay
+                    # rebuilds these counters one record at a time, and
+                    # the recovered token must equal the live one
+                    seg = seg_of(key)
+                    seg_writes[seg] = seg_writes_get(seg, 0) + 1
                     if len(hist) == hist_max:
                         self._oldest_rev = hist[0][0]
                     hist_append((rev, modified, key, new_obj, stored))
@@ -651,19 +726,24 @@ class Store:
                     out_append(new_obj)
                 if staged:
                     self._rev = staged[-1][4]
-                    for seg in segs:
-                        self._seg_writes[seg] = \
-                            self._seg_writes.get(seg, 0) + 1
                     if self._list_cache:
                         # all batch events are MODIFIED: patch snapshots
                         # in place (key set and sort order unchanged)
                         for key, new_obj, _stored, _exp, _rev in staged:
                             self._patch_lists(key, new_obj)
+                    if self._wal is not None:
+                        # outside the hot loop: the common case has no
+                        # WAL, and with one the encode pass batches
+                        enc = self._wal_scheme.encode_dict
+                        for key, new_obj, _stored, expiry, rev in staged:
+                            self._wal.append(rev, modified, key, expiry,
+                                             enc(new_obj))
                 # one send per watcher for the whole tile, not per
                 # object — and the whole fan-out runs AFTER this lock
                 # releases (the fan-out was ~half the measured in-lock
                 # binding commit cost)
                 self._stage_publish(batch_events)
+                self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
         finally:
@@ -680,9 +760,28 @@ class Store:
         # committer's ledger window (the DENSITY.json GET-/nodes p99
         # whale was reads parked on this lock during the create storm).
         entry = self._data.get(key)
-        if entry is None or self._expired(entry, time.time()):
+        if entry is None:
+            raise NotFound(name=key)
+        if self._expired(entry, time.time()):
+            # first-class expiry: the key's death is COMMITTED to the
+            # ledger (revision, DELETED event, WAL record) the moment a
+            # reader observes it, not deferred to the next write — so
+            # revision history, watch streams, and recovery agree on
+            # when it died. Only actually-expired reads pay the lock.
+            self._reap_expired()
             raise NotFound(name=key)
         return entry[0]
+
+    def _reap_expired(self) -> None:
+        """Commit pending TTL expiries from a read path: ledger phase
+        under the lock, publish drain after release, WAL flush — the
+        same shape as every write verb."""
+        try:
+            with self._lock:
+                self._gc_expired()
+                self._wal_sync()
+        finally:
+            self._drain_publish()
 
     def list(self, prefix: str,
              predicate: Optional[Callable[[Any], bool]] = None
@@ -692,7 +791,15 @@ class Store:
         ref: pkg/client/cache/reflector.go:225). Selector-free lists of
         resource-or-deeper prefixes serve from the snapshot cache; a
         hit is consistent at the CURRENT revision because any write
-        under the prefix would have invalidated it (_record)."""
+        under the prefix would have invalidated it (_record).
+
+        Pending TTL expiries are committed first (first-class expiry:
+        ledger, watch streams, and WAL record a key's death when a
+        reader observes it, not at the next unrelated write); the
+        lock-free heap peek keeps the no-TTL hot path unchanged."""
+        heap = self._expiry_heap
+        if heap and heap[0][0] <= time.time():
+            self._reap_expired()
         with self._lock:
             cacheable = (predicate is None and prefix.count("/") >= 3
                          and self._seg(prefix) not in self._ttl_segs)
@@ -834,3 +941,94 @@ class Store:
             n = len(self._watchers)
         self._drain_publish()  # flush batches parked while we held the lock
         return n
+
+    # -------------------------------------------------------- durability
+
+    def wal_close(self) -> None:
+        """Flush and close the WAL (clean shutdown). A crashed process
+        never calls this — recovery handles the torn tail."""
+        if self._wal is not None:
+            with self._lock:
+                self._wal.close()
+
+    @classmethod
+    def recover(cls, wal_dir: str, window: int = 100_000,
+                publish_inline: bool = False,
+                fsync_policy: str = "batch",
+                wal_segment_records: int = 10_000,
+                wal_snapshot_records: int = 50_000) -> "Store":
+        """Rebuild a Store from its WAL directory: newest snapshot,
+        then the record tail, applied in strict revision order — the
+        pre-crash ledger prefix, bit-identically: same revision
+        counter, same live entries (insertion order preserved through
+        the snapshot), same history tail, same per-segment write
+        tokens and key index. Expired keys are not resurrected: every
+        record carries its absolute expiry, and expiries the old
+        process committed are first-class DELETED records. A torn
+        final record is truncated, not fatal (core/wal.py).
+
+        The returned store has the WAL re-attached and keeps
+        journaling; `recovery_stats` records what the replay did.
+        """
+        import time as _time
+        from ..utils.metrics import global_metrics
+        from .scheme import default_scheme
+        from .wal import WalWriter, read_wal
+
+        t0 = _time.monotonic()
+        snap, records = read_wal(wal_dir)
+        st = cls(window=window, publish_inline=publish_inline)
+        decode = default_scheme.decode_dict
+        if snap is not None:
+            st._rev = snap["rev"]
+            # revisions at or below the snapshot are no longer
+            # replayable from history (same meaning as a rolled window)
+            st._oldest_rev = snap["rev"]
+            st._seg_writes = {k: int(v)
+                              for k, v in snap["seg_writes"].items()}
+            st._ttl_segs = set(snap["ttl_segs"])
+            for key, mod_rev, expiry, wire in snap["entries"]:
+                obj = decode(wire)
+                st._data[key] = (obj, int(mod_rev), expiry)
+                st._index_add(key)
+                if expiry is not None:
+                    heapq.heappush(st._expiry_heap, (expiry, key))
+        hist = st._history
+        for rev, etype, key, expiry, wire in records:
+            obj = decode(wire)
+            prev_entry = st._data.get(key)
+            if etype == watchpkg.DELETED:
+                # the record's object IS the pre-delete stored object;
+                # the live _record path emits (obj=stored, prev=stored)
+                if prev_entry is not None:
+                    del st._data[key]
+                    st._index_del(key)
+                prev = obj
+            else:
+                st._data[key] = (obj, rev, expiry)
+                st._index_add(key)
+                if expiry is not None:
+                    heapq.heappush(st._expiry_heap, (expiry, key))
+                    st._ttl_segs.add(st._seg(key))
+                prev = prev_entry[0] if prev_entry is not None else None
+            seg = st._seg(key)
+            st._seg_writes[seg] = st._seg_writes.get(seg, 0) + 1
+            if len(hist) == hist.maxlen:
+                st._oldest_rev = hist[0][0]
+            hist.append((rev, etype, key, obj, prev))
+            st._rev = rev
+        st._published_rev = st._rev  # nothing is pending fan-out
+        w = WalWriter(wal_dir, fsync_policy=fsync_policy,
+                      segment_records=wal_segment_records,
+                      snapshot_records=wal_snapshot_records)
+        w._since_snapshot = len(records)  # resume the compaction cadence
+        st._wal = w
+        st._wal_scheme = default_scheme
+        global_metrics.inc("wal_recoveries_total")
+        st.recovery_stats = {
+            "snapshot_rev": snap["rev"] if snap is not None else 0,
+            "replayed_records": len(records),
+            "recovered_revision": st._rev,
+            "seconds": round(_time.monotonic() - t0, 6),
+        }
+        return st

@@ -706,6 +706,31 @@ class Binding:
     target: ObjectReference = field(default_factory=ObjectReference)
 
 
+# ----------------------------------------------------------------- leases
+
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io Lease spec, forward-ported from the later
+    reference (the v1.1 reference elects its master through a raw etcd
+    CAS seam; the typed Lease is what that seam became). The *Time
+    fields are wall-clock and informational — election liveness runs
+    on each elector's LOCAL monotonic clock (utils/leaderelection.py),
+    so a wall-clock jump can neither drop nor extend leadership."""
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: str = ""
+    renew_time: str = ""
+    #: fencing term: increments on every holder CHANGE, never on a
+    #: renewal — at most one holder exists per term (CAS-enforced)
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 @dataclass
 class Preconditions:
     """Delete preconditions (ref: pkg/api/types.go Preconditions) —

@@ -71,6 +71,7 @@ struct Store {
   uint64_t rev = 0;
   uint64_t oldest_rev = 0;  // history no longer replays revs <= this... see emit
   size_t window;
+  double next_expiry = 0;   // soonest pending TTL deadline; 0 = none
   std::map<std::string, Entry> data;  // ordered: list output is sorted
   std::deque<Event> history;
 
@@ -92,13 +93,27 @@ struct Store {
     return e.expiry != 0 && e.expiry <= now;
   }
 
-  // Lazy TTL GC, mirroring core/store.py _gc_expired: expired entries are
-  // deleted and emit DELETED carrying the stale object.
+  void note_expiry(double expiry) {
+    if (expiry != 0 && (next_expiry == 0 || expiry < next_expiry))
+      next_expiry = expiry;
+  }
+
+  // TTL GC, mirroring core/store.py _gc_expired: expired entries are
+  // deleted and emit DELETED carrying the stale object. Runs on reads
+  // too (first-class expiry); the next_expiry guard keeps the no-due
+  // common case O(1) instead of a full-map scan per call.
   void gc(double now) {
+    if (next_expiry == 0 || next_expiry > now) return;
     std::vector<std::string> dead;
+    double nxt = 0;
     for (auto& [k, e] : data) {
-      if (expired(e, now)) dead.push_back(k);
+      if (expired(e, now)) {
+        dead.push_back(k);
+      } else if (e.expiry != 0 && (nxt == 0 || e.expiry < nxt)) {
+        nxt = e.expiry;
+      }
     }
+    next_expiry = nxt;
     for (auto& k : dead) {
       Entry e = data[k];
       data.erase(k);
@@ -171,6 +186,7 @@ int64_t kv_create(void* h, const char* key, const uint8_t* val,
   uint64_t rev = s->bump();
   Entry e{std::string(reinterpret_cast<const char*>(val), val_len), rev,
           ttl_seconds > 0 ? now + ttl_seconds : 0};
+  s->note_expiry(e.expiry);
   s->data[k] = e;
   s->emit(rev, EventType::Added, k, rev, e.value);
   return static_cast<int64_t>(rev);
@@ -187,6 +203,7 @@ int64_t kv_set(void* h, const char* key, const uint8_t* val,
   uint64_t rev = s->bump();
   Entry e{std::string(reinterpret_cast<const char*>(val), val_len), rev,
           ttl_seconds > 0 ? now + ttl_seconds : 0};
+  s->note_expiry(e.expiry);
   s->data[k] = e;
   s->emit(rev, existed ? EventType::Modified : EventType::Added, k, rev,
           e.value);
@@ -231,10 +248,14 @@ int64_t kv_get(void* h, const char* key, uint8_t* buf, int64_t buflen,
                uint64_t* mod_rev) {
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
+  // first-class TTL expiry (mirrors core/store.py get/list): a read
+  // past a due deadline COMMITS the deletion to the ledger rather than
+  // skipping passively, so history and recovery agree on when the key
+  // died; the next_expiry guard keeps the no-due case O(1).
+  s->gc(now_seconds());
   std::string k(key);
   auto it = s->data.find(k);
-  if (it == s->data.end() || s->expired(it->second, now_seconds()))
-    return ERR_NOT_FOUND;
+  if (it == s->data.end()) return ERR_NOT_FOUND;
   const std::string& v = it->second.value;
   *mod_rev = it->second.mod_rev;
   if (static_cast<int64_t>(v.size()) > buflen) return ERR_TOO_SMALL;
@@ -247,6 +268,7 @@ int64_t kv_list(void* h, const char* prefix, uint8_t* buf, int64_t buflen) {
   Store* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
   double now = now_seconds();
+  s->gc(now);  // first-class expiry, same contract as kv_get
   std::string p(prefix);
   Writer w(buf, buflen);
   w.put<uint64_t>(s->rev);
@@ -320,6 +342,7 @@ int64_t kv_create_batch(void* h, uint64_t n, const char** keys,
     Entry e{std::string(reinterpret_cast<const char*>(vals[i]),
                         val_lens[i]),
             rev, ttls[i] > 0 ? now + ttls[i] : 0};
+    s->note_expiry(e.expiry);
     s->data[k] = e;
     s->emit(rev, EventType::Added, k, rev, e.value);
   }
@@ -357,6 +380,61 @@ int64_t kv_events(void* h, uint64_t since_rev, const char* prefix,
   }
   if (!w.fits()) return -(w.size() + SIZE_HINT_BASE);
   return w.size();
+}
+
+// ---------------------------------------------------------- recovery
+// WAL recovery entry points (core/wal.py + NativeStore.recover): the
+// Python side reads the snapshot + record tail and replays it here.
+
+// Insert one snapshot entry with its original mod_rev and absolute
+// expiry, emitting NO history event (snapshot state predates the
+// replayable window). Advances the revision counter monotonically.
+int64_t kv_restore(void* h, const char* key, const uint8_t* val,
+                   uint64_t val_len, uint64_t mod_rev, double expiry) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  Entry e{std::string(reinterpret_cast<const char*>(val), val_len),
+          mod_rev, expiry};
+  s->note_expiry(expiry);
+  s->data[std::string(key)] = e;
+  if (mod_rev > s->rev) s->rev = mod_rev;
+  return static_cast<int64_t>(mod_rev);
+}
+
+// Seal the snapshot restore point: revisions <= rev are not
+// replayable from history (the watch-window meaning of oldest_rev).
+void kv_restore_seal(void* h, uint64_t rev) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (rev > s->rev) s->rev = rev;
+  s->oldest_rev = rev;
+}
+
+// Replay one ledger record at EXACTLY the given revision (the WAL
+// tail). Unlike the write verbs, no gc runs and no revision is
+// assigned here — the record's revision is authoritative, so replay
+// reproduces the pre-crash ledger prefix bit-identically. obj_rev is
+// the resourceVersion the delivered event stamps (pre-delete mod_rev
+// for DELETED records).
+int64_t kv_replay(void* h, uint64_t rev, uint8_t type, const char* key,
+                  const uint8_t* val, uint64_t val_len, uint64_t obj_rev,
+                  double expiry) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (rev <= s->rev) return ERR_CONFLICT;
+  s->rev = rev;
+  std::string k(key);
+  std::string v(reinterpret_cast<const char*>(val), val_len);
+  if (type == static_cast<uint8_t>(EventType::Deleted)) {
+    s->data.erase(k);
+    s->emit(rev, EventType::Deleted, k, obj_rev, v);
+  } else {
+    Entry e{v, rev, expiry};
+    s->note_expiry(expiry);
+    s->data[k] = e;
+    s->emit(rev, static_cast<EventType>(type), k, rev, v);
+  }
+  return static_cast<int64_t>(rev);
 }
 
 // Block until the store revision exceeds since_rev (or timeout).

@@ -103,13 +103,31 @@ class BatchSchedulerConfig:
 
 
 class BatchScheduler:
-    """Tile-at-a-time scheduler over the device engine."""
+    """Tile-at-a-time scheduler over the device engine.
 
-    def __init__(self, config: BatchSchedulerConfig):
+    HA: pass `elector` (utils/leaderelection.LeaderElector) and the
+    scheduler becomes a CANDIDATE — the scan loop idles until the
+    elector wins the lease, and every leadership session starts from a
+    fresh device state (see _on_started_leading). N replicas can run
+    against one apiserver; the bind CAS guarantees a pod binds once no
+    matter how leadership moved mid-tile.
+    """
+
+    def __init__(self, config: BatchSchedulerConfig, elector=None):
         self.config = config
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inc: Optional[IncrementalEncoder] = None
+        # leadership gate: the scan loop only drains the FIFO while
+        # set. Electorless schedulers lead unconditionally.
+        self._leading = threading.Event()
+        self._killed = False
+        self.elector = elector
+        if elector is None:
+            self._leading.set()
+        else:
+            elector.on_started_leading = self._on_started_leading
+            elector.on_stopped_leading = self._on_stopped_leading
         # the dispatched-but-unfinalized tile (device pipeline depth 1):
         # scheduler-thread only
         self._prev: Optional[_Inflight] = None
@@ -145,9 +163,51 @@ class BatchScheduler:
         self._commit_thread = threading.Thread(
             target=self._commit_loop, name="batch-binder", daemon=True)
         self._commit_thread.start()
+        if self.elector is not None:
+            self.elector.run()
         return self
 
+    # ------------------------------------------------------- leadership
+
+    def _on_started_leading(self, term: int) -> None:
+        """Failover rebuild: drop every pre-leadership carry — the
+        in-flight tile and the incremental device ledger — and
+        bootstrap a fresh encoder from the informer caches (a fresh
+        re-list of bound pods and nodes) on the next tile. The pending
+        FIFO needs no rebuild: the unassigned reflector has been
+        feeding it all along, and a pod the old leader managed to bind
+        mid-failover leaves via its filtered-watch DELETE (or, at
+        worst, the bind CAS rejects the duplicate and _bind_failed
+        re-reads it)."""
+        self._prev = None
+        old = self._inc
+        self._inc = None
+        if old is not None:
+            old.detach()
+        self._leading.set()
+
+    def _on_stopped_leading(self) -> None:
+        self._leading.clear()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
+
+    def kill(self) -> None:
+        """Simulated process death (chaos/crash.py): scheduling halts
+        NOW, queued-but-uncommitted tiles are dropped (a dead binder
+        binds nothing), and the lease is NOT released — the standby
+        waits out the expiry and takes over under a new fencing term,
+        re-scheduling whatever this process left unbound."""
+        self._killed = True
+        self._leading.clear()
+        if self.elector is not None:
+            self.elector.kill()
+        self._stop.set()
+
     def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()  # demotes + releases the lease
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=30)
@@ -189,6 +249,8 @@ class BatchScheduler:
             if isinstance(item, threading.Event):
                 item.set()  # drain barrier: everything before it landed
                 continue
+            if self._killed:
+                continue  # a dead binder binds nothing (kill())
             try:
                 # No tile-wide modeler lock here: the merged lister
                 # dedupes scheduled-vs-assumed by key, so bind→assume
@@ -213,6 +275,13 @@ class BatchScheduler:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            if not self._leading.is_set():
+                # standby / demoted: land any in-flight tile (its binds
+                # are CAS-protected — the new leader's duplicates lose
+                # cleanly on one side) and stop draining the FIFO
+                self._finalize_prev()
+                self._stop.wait(0.02)
+                continue
             try:
                 busy = self.schedule_tile()
             except Exception:
@@ -224,7 +293,8 @@ class BatchScheduler:
                 # idle: land the in-flight tile before parking
                 self._finalize_prev()
                 self._stop.wait(0.01)
-        self._finalize_prev()
+        if not self._killed:
+            self._finalize_prev()
 
     def _drain_tile(self, timeout: float = 0.5) -> List[api.Pod]:
         f = self.config.factory
@@ -448,7 +518,11 @@ class BatchScheduler:
         c.metrics.observe("scheduling_algorithm_latency_microseconds",
                           (time.monotonic() - fl.t_start) * 1e6)
         try:
-            self._inc.assume_assigned(enc, fl.pods, idx)
+            # self._inc can be None mid-failover (_on_started_leading
+            # discards it); the tile still binds — the fresh encoder's
+            # bootstrap re-list covers its capacity
+            if self._inc is not None:
+                self._inc.assume_assigned(enc, fl.pods, idx)
         except Exception:
             # the slow path inside assume_assigned is the robust one;
             # anything escaping means the ledger may be torn for this

@@ -1177,12 +1177,21 @@ class IncrementalEncoder:
 
     # ================================================== wiring helpers
 
+    def detach(self) -> None:
+        """Stop consuming informer events. The chained handlers attach()
+        installed cannot be unhooked (closures over closures), so they
+        stay in the chain as gated no-ops; a scheduler failing over
+        builds a FRESH encoder from a fresh snapshot rather than
+        trusting this one's carry (sched/batch.py _on_started_leading)."""
+        self._detached = True
+
     def attach(self, factory) -> "IncrementalEncoder":
         """Chain onto the factory's scheduled-pod reflector and node
         informer, then bootstrap from their caches. Events that land
         between attach and bootstrap are absorbed by the ledger's
         resourceVersion idempotency check."""
         sref = factory.scheduled_reflector
+        self._detached = False
 
         def chain(first, second):
             if first is None:
@@ -1192,15 +1201,25 @@ class IncrementalEncoder:
                 second(*a)
             return chained
 
-        sref.on_add = chain(sref.on_add, self.on_pod_add)
-        sref.on_update = chain(sref.on_update,
-                               lambda old, new: self.on_pod_update(old, new))
-        sref.on_delete = chain(sref.on_delete, self.on_pod_delete)
+        def gate(fn):
+            # detach() turns this encoder's share of the chain into a
+            # no-op without disturbing other subscribers
+            def gated(*a):
+                if not self._detached:
+                    fn(*a)
+            return gated
+
+        sref.on_add = chain(sref.on_add, gate(self.on_pod_add))
+        sref.on_update = chain(
+            sref.on_update,
+            gate(lambda old, new: self.on_pod_update(old, new)))
+        sref.on_delete = chain(sref.on_delete, gate(self.on_pod_delete))
         nref = factory.node_informer.reflector
-        nref.on_add = chain(nref.on_add, self.on_node_add)
-        nref.on_update = chain(nref.on_update,
-                               lambda old, new: self.on_node_update(old, new))
-        nref.on_delete = chain(nref.on_delete, self.on_node_delete)
+        nref.on_add = chain(nref.on_add, gate(self.on_node_add))
+        nref.on_update = chain(
+            nref.on_update,
+            gate(lambda old, new: self.on_node_update(old, new)))
+        nref.on_delete = chain(nref.on_delete, gate(self.on_node_delete))
         for node in factory.node_informer.cache.list():
             self.on_node_add(node)
         for pod in factory.scheduled_cache.list():

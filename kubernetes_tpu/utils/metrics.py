@@ -107,6 +107,13 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, {}).get(_key(labels), 0.0)
 
+    def counter_sum(self, name: str) -> float:
+        """Total across every label set of one counter — what a gate
+        asserts when it cares that the thing happened, not which label
+        it happened under (the crash soak's durability counters)."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
     def summary(self, name: str,
                 labels: Optional[Dict[str, str]] = None) -> Optional[_Summary]:
         with self._lock:
@@ -137,3 +144,15 @@ class MetricsRegistry:
 
 #: shared default registry (each binary may still make its own)
 global_metrics = MetricsRegistry()
+
+#: Durability / HA counters: wal_* incremented by core/wal.py and the
+#: store recovery paths, leader/lease ones by utils/leaderelection.py.
+#: The crash-soak gates (tests/test_chaos.py) assert these move; the
+#: names are pinned here so dashboards and gates cannot drift.
+DURABILITY_COUNTERS = (
+    "wal_records_total",        # ledger records appended to the WAL
+    "wal_snapshots_total",      # snapshot compactions written
+    "wal_recoveries_total",     # Store/NativeStore.recover completions
+    "leader_transitions_total", # elector acquisitions (label: name)
+    "lease_renew_failures_total",  # failed renew attempts (label: name)
+)

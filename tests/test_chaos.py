@@ -474,3 +474,103 @@ def test_apiserver_restart_informers_reconnect_with_backoff():
     finally:
         for inf in informers:
             inf.stop()
+
+
+# -------------------------------------------- process-crash chaos (ISSUE 7)
+
+@pytest.mark.chaos
+@pytest.mark.durability
+class TestCrashPlanDeterminism:
+    """Same fixed-draw contract as FaultPlan/NodeFaultPlan: each
+    target's kill point is ONE draw from its own (seed, target)
+    stream, so schedules are bit-reproducible and per-target
+    independent."""
+
+    def test_same_seed_same_schedule(self):
+        from kubernetes_tpu.chaos import CrashPlan
+        a, b = CrashPlan(seed=9), CrashPlan(seed=9)
+        assert a.schedule(100) == b.schedule(100)
+        assert a.order(100) == b.order(100)
+
+    def test_different_seeds_differ(self):
+        from kubernetes_tpu.chaos import CrashPlan
+        assert CrashPlan(seed=1).schedule(100) != \
+            CrashPlan(seed=2).schedule(100)
+
+    def test_streams_independent_of_target_set(self):
+        """Dropping a target cannot shift another target's kill point
+        (independent streams, one draw each)."""
+        from kubernetes_tpu.chaos import CrashPlan
+        full = CrashPlan(seed=7)
+        solo = CrashPlan(seed=7, targets=("scheduler",))
+        assert full.schedule(200)["scheduler"] == \
+            solo.schedule(200)["scheduler"]
+
+    def test_kill_points_interrupt_the_run(self):
+        """Clamped inside (0, total): every kill observably fires
+        mid-workload, never before the first or after the last bind."""
+        from kubernetes_tpu.chaos import CrashPlan
+        for seed in range(20):
+            for t, p in CrashPlan(seed=seed).schedule(10).items():
+                assert 1 <= p <= 9, (seed, t, p)
+
+
+@pytest.mark.chaos
+@pytest.mark.durability
+def test_crash_soak_survives_control_plane_kills():
+    """The ISSUE-7 acceptance gate (fast shape): WAL-backed store,
+    redundant schedulers + controller-managers under lease election,
+    5% API faults, and a seeded CrashPlan killing the apiserver
+    mid-commit-storm, the active scheduler mid-batch, and the active
+    controller-manager. Gates: the recovered store equals the
+    pre-crash ledger prefix (same revision, same live object set — so
+    no resurrected expired keys), the fleet converges past a
+    post-kill scale-up only the standbys could have served, zero
+    duplicate bindings, at most one lease holder per fencing term,
+    the applied kill schedule is the plan's pure replay, and every
+    durability counter moved."""
+    from kubernetes_tpu.kubemark.crash_soak import run_crash_soak
+    r = run_crash_soak(n_nodes=6, replicas=24, seed=0,
+                       fault_rate=0.05, timeout=150)
+    assert r.converged, r.as_dict()
+    # apiserver kill: recovery is the pre-crash ledger prefix
+    assert r.recovery, "apiserver kill never fired"
+    assert r.recovery["revision_match"], r.recovery
+    assert r.recovery["live_set_match"], r.recovery
+    assert r.recovery["replayed_records"] >= 1
+    # scheduler/manager kills: standbys took over, exactly-once binds
+    assert r.duplicate_bindings == []
+    assert r.term_violations == []
+    assert set(r.killed) == {"apiserver", "scheduler",
+                             "controller-manager"}
+    assert r.schedule_replayed, (r.killed, r.schedule)
+    # each singleton's lease advanced past the killed leader's term
+    assert r.terms["batch-scheduler"] >= 2
+    assert r.terms["controller-manager"] >= 2
+    # the durability counters the soak is instrumented to gate on
+    assert r.counters["wal_records_total"] >= 1
+    assert r.counters["wal_recoveries_total"] >= 1
+    assert r.counters["leader_transitions_total"] >= 4  # 2 initial + 2 failover
+    assert r.counters["lease_renew_failures_total"] >= 1
+
+
+@pytest.mark.chaos
+@pytest.mark.durability
+@pytest.mark.slow
+def test_crash_soak_reproducible_across_invocations():
+    """The long gate: TWO full crash-soak invocations with the same
+    seed both converge with zero duplicate bindings / term violations
+    and apply bit-identical kill schedules."""
+    from kubernetes_tpu.kubemark.crash_soak import run_crash_soak
+    results = [run_crash_soak(n_nodes=6, replicas=24, seed=1337,
+                              fault_rate=0.05, timeout=150)
+               for _ in range(2)]
+    for r in results:
+        assert r.converged, r.as_dict()
+        assert r.duplicate_bindings == []
+        assert r.term_violations == []
+        assert r.schedule_replayed
+        assert r.recovery["revision_match"], r.recovery
+        assert r.recovery["live_set_match"], r.recovery
+    a, b = results
+    assert a.killed == b.killed == a.schedule

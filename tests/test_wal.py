@@ -1,0 +1,315 @@
+"""The write-ahead log + snapshot recovery subsystem (core/wal.py,
+Store.recover, NativeStore.recover) and the first-class TTL-expiry
+ledger contract.
+
+The acceptance bar (ISSUE 7): recovery rebuilds the pre-crash ledger
+prefix bit-identically — same revision counter, same live object set
+and per-entry mod revisions, same history tail, same per-segment write
+tokens — with a torn final record truncated (not fatal), snapshot+tail
+replay equal to pure replay, and expired keys never resurrected."""
+
+import os
+import time
+from dataclasses import replace
+
+import pytest
+
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.errors import NotFound
+from kubernetes_tpu.core.store import Store
+from kubernetes_tpu.core.wal import WalCorrupt, WalError, read_wal
+
+
+def mkpod(name, ns="default"):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns))
+
+
+def pod_key(name, ns="default"):
+    return f"/registry/pods/{ns}/{name}"
+
+
+def drive_mixed_workload(s: Store, n: int = 25) -> None:
+    """Every verb class: creates, a set, CAS updates, a delete, a
+    batch tile, and a TTL'd entry."""
+    for i in range(n):
+        s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+    s.set(pod_key("p0"), mkpod("p0"))
+    s.update(pod_key("p1"),
+             replace(s.get(pod_key("p1")),
+                     metadata=replace(s.get(pod_key("p1")).metadata,
+                                      labels={"u": "1"})))
+    s.guaranteed_update(
+        pod_key("p2"),
+        lambda p: replace(p, spec=replace(p.spec, node_name="n9")))
+    s.delete(pod_key("p3"))
+    s.batch([(pod_key(f"p{i}"),
+              lambda p: replace(p, spec=replace(p.spec, node_name="n1")))
+             for i in range(4, 9)])
+    s.create("/registry/events/default/e-live",
+             api.Event(metadata=api.ObjectMeta(name="e-live",
+                                               namespace="default")),
+             ttl=3600.0)
+
+
+def assert_stores_equal(a: Store, b: Store,
+                        exact_expiry: bool = True) -> None:
+    assert a.current_revision == b.current_revision
+    assert list(a._data.keys()) == list(b._data.keys())
+    for k in a._data:
+        oa, ra, ea = a._data[k]
+        ob, rb, eb = b._data[k]
+        assert ra == rb, k
+        if exact_expiry:
+            assert ea == eb, k
+        else:
+            # two INDEPENDENTLY driven stores stamp absolute expiries
+            # milliseconds apart; same-WAL recoveries compare exact
+            assert (ea is None) == (eb is None), k
+            if ea is not None:
+                assert abs(ea - eb) < 1.0, k
+        assert oa == ob, k
+    assert a._seg_writes == b._seg_writes
+    assert a._ttl_segs == b._ttl_segs
+    assert {s: list(ks) for s, ks in a._seg_keys.items() if ks} == \
+        {s: list(ks) for s, ks in b._seg_keys.items() if ks}
+
+
+@pytest.mark.durability
+class TestWalRecovery:
+    def test_recover_bit_identical_prefix(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        drive_mixed_workload(s)
+        s.wal_close()
+        r = Store.recover(d)
+        assert_stores_equal(s, r)
+        # the replayed history tail is the live one, tuple for tuple
+        assert [(t[0], t[1], t[2], t[3]) for t in s._history] == \
+            [(t[0], t[1], t[2], t[3]) for t in r._history]
+        assert r.recovery_stats["recovered_revision"] == \
+            s.current_revision
+        # and the recovered store keeps journaling: a post-recovery
+        # write survives a SECOND recovery
+        r.create(pod_key("post"), mkpod("post"))
+        r.wal_close()
+        r2 = Store.recover(d)
+        assert r2.current_revision == r.current_revision
+        assert pod_key("post") in r2._data
+
+    def test_recovered_store_serves_watch_from_tail(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        for i in range(10):
+            s.create(pod_key(f"w{i}"), mkpod(f"w{i}"))
+        mid_rev = s.current_revision
+        for i in range(10, 15):
+            s.create(pod_key(f"w{i}"), mkpod(f"w{i}"))
+        s.wal_close()
+        r = Store.recover(d)
+        w = r.watch("/registry/pods/", since_rev=mid_rev)
+        names = [ev.object.metadata.name
+                 for ev in iter(lambda: w.next(timeout=0.5), None)]
+        assert names == [f"w{i}" for i in range(10, 15)]
+        w.stop()
+
+    def test_snapshot_plus_tail_equals_pure_replay(self, tmp_path):
+        compact = str(tmp_path / "compact")
+        pure = str(tmp_path / "pure")
+        a = Store(wal_dir=compact, wal_snapshot_records=10,
+                  wal_segment_records=4)
+        b = Store(wal_dir=pure, wal_snapshot_records=10**9)
+        for s in (a, b):
+            drive_mixed_workload(s)
+        a.wal_close()
+        b.wal_close()
+        # the compacting WAL actually compacted (snapshot + fewer segs)
+        assert any(f.startswith("snap-") for f in os.listdir(compact))
+        ra, rb = Store.recover(compact), Store.recover(pure)
+        assert ra.recovery_stats["snapshot_rev"] > 0
+        assert rb.recovery_stats["snapshot_rev"] == 0
+        assert_stores_equal(ra, rb, exact_expiry=False)
+        # and each recovery is exact against ITS OWN pre-crash store
+        assert_stores_equal(a, ra)
+        assert_stores_equal(b, rb)
+
+    def test_torn_final_record_truncated_not_fatal(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        for i in range(8):
+            s.create(pod_key(f"t{i}"), mkpod(f"t{i}"))
+        s.wal_close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".seg"))
+        # a torn append: half a frame of garbage at the tail
+        with open(os.path.join(d, segs[-1]), "ab") as f:
+            f.write(b"\x40\x00\x00\x00\x99\x99\x99\x99torn")
+        r = Store.recover(d)
+        assert r.current_revision == 8
+        # ...and the reader repaired the file: a second recovery is
+        # clean too
+        assert Store.recover(d).current_revision == 8
+
+    def test_truncated_final_record_drops_only_the_tail(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        for i in range(8):
+            s.create(pod_key(f"t{i}"), mkpod(f"t{i}"))
+        s.wal_close()
+        seg = sorted(f for f in os.listdir(d) if f.endswith(".seg"))[-1]
+        path = os.path.join(d, seg)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 5)
+        r = Store.recover(d)
+        assert r.current_revision == 7  # the torn record 8 is gone
+        assert pod_key("t6") in r._data
+        assert pod_key("t7") not in r._data
+
+    def test_corruption_mid_chain_raises(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d, wal_segment_records=3)
+        for i in range(10):
+            s.create(pod_key(f"c{i}"), mkpod(f"c{i}"))
+        s.wal_close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".seg"))
+        assert len(segs) >= 3
+        # flip a payload byte in the FIRST segment: replay past it
+        # would tear revision contiguity, so this must be fatal
+        path = os.path.join(d, segs[0])
+        blob = bytearray(open(path, "rb").read())
+        blob[12] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(WalCorrupt):
+            read_wal(d)
+
+    def test_fresh_store_refuses_existing_wal_dir(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        s.create(pod_key("x"), mkpod("x"))
+        s.wal_close()
+        with pytest.raises(WalError):
+            Store(wal_dir=d)  # would fork history; must use recover()
+
+    def test_expired_keys_are_not_resurrected(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        s.create("/registry/events/default/e1",
+                 api.Event(metadata=api.ObjectMeta(name="e1",
+                                                   namespace="default")),
+                 ttl=0.05)
+        s.create(pod_key("alive"), mkpod("alive"))
+        time.sleep(0.08)
+        # crash BEFORE anything observed the expiry: the record carries
+        # its absolute deadline, so the recovered entry is already dead
+        s.wal_close()
+        r = Store.recover(d)
+        with pytest.raises(NotFound):
+            r.get("/registry/events/default/e1")
+        assert [o.metadata.name
+                for o in r.list("/registry/events/default/")[0]] == []
+        assert r.get(pod_key("alive")).metadata.name == "alive"
+
+    def test_observed_expiry_replays_as_deletion(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        s.create("/registry/events/default/e1",
+                 api.Event(metadata=api.ObjectMeta(name="e1",
+                                                   namespace="default")),
+                 ttl=0.05)
+        time.sleep(0.08)
+        with pytest.raises(NotFound):
+            s.get("/registry/events/default/e1")  # commits the expiry
+        rev_after_expiry = s.current_revision
+        s.wal_close()
+        r = Store.recover(d)
+        # the expiry's DELETED record replayed: same revision, entry
+        # gone from _data entirely (not merely unreadable)
+        assert r.current_revision == rev_after_expiry
+        assert "/registry/events/default/e1" not in r._data
+
+    def test_fsync_policy_validated(self, tmp_path):
+        with pytest.raises(WalError):
+            Store(wal_dir=str(tmp_path / "w"), fsync_policy="yolo")
+
+
+@pytest.mark.durability
+class TestFirstClassExpiry:
+    """TTL expiry is a LEDGER event at observation time: revision
+    history, watch streams, and the WAL agree on when a key died
+    (previously expiry was passive at read time — satellite 1)."""
+
+    def test_get_commits_expiry_as_deleted_event(self):
+        s = Store()
+        s.create("/registry/events/default/e1",
+                 api.Event(metadata=api.ObjectMeta(name="e1",
+                                                   namespace="default")),
+                 ttl=0.05)
+        rev = s.current_revision
+        w = s.watch("/registry/events/", since_rev=rev)
+        time.sleep(0.08)
+        with pytest.raises(NotFound):
+            s.get("/registry/events/default/e1")
+        assert s.current_revision == rev + 1  # the death got a revision
+        ev = w.next(timeout=1)
+        assert ev is not None and ev.type == "DELETED"
+        assert ev.object.metadata.name == "e1"
+        w.stop()
+
+    def test_list_commits_expiry_as_deleted_event(self):
+        s = Store()
+        s.create("/registry/events/default/e1",
+                 api.Event(metadata=api.ObjectMeta(name="e1",
+                                                   namespace="default")),
+                 ttl=0.05)
+        rev = s.current_revision
+        time.sleep(0.08)
+        items, list_rev = s.list("/registry/events/default/")
+        assert items == []
+        assert list_rev == rev + 1  # the LIST itself committed the death
+        assert "/registry/events/default/e1" not in s._data
+
+
+@pytest.mark.durability
+class TestNativeRecovery:
+    def _native(self):
+        from kubernetes_tpu.core.native_store import (NativeStore,
+                                                      native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        return NativeStore
+
+    def test_native_recover_matches_python_recover(self, tmp_path):
+        NativeStore = self._native()
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d, wal_snapshot_records=12,
+                  wal_segment_records=5)
+        drive_mixed_workload(s)
+        s.wal_close()
+        py = Store.recover(d)
+        nat = NativeStore.recover(d)
+        assert nat.current_revision == py.current_revision
+        py_items, py_rev = py.list("/registry/pods/")
+        nat_items, nat_rev = nat.list("/registry/pods/")
+        assert nat_rev == py_rev
+        assert [(o.metadata.name, o.metadata.resource_version)
+                for o in nat_items] == \
+            [(o.metadata.name, o.metadata.resource_version)
+             for o in py_items]
+        # CAS still works against recovered revisions
+        p = nat.get(pod_key("p9"))
+        out = nat.update(pod_key("p9"), replace(
+            p, spec=replace(p.spec, node_name="n2")))
+        assert int(out.metadata.resource_version) == \
+            py.current_revision + 1
+
+    def test_native_first_class_expiry(self):
+        NativeStore = self._native()
+        s = NativeStore()
+        s.create("/registry/events/default/e1",
+                 api.Event(metadata=api.ObjectMeta(name="e1",
+                                                   namespace="default")),
+                 ttl=0.05)
+        rev = s.current_revision
+        time.sleep(0.08)
+        with pytest.raises(NotFound):
+            s.get("/registry/events/default/e1")
+        # the read committed the expiry to the native ledger
+        assert s.current_revision == rev + 1

@@ -34,7 +34,10 @@ requested; r07 adds durability (the --wal-dir fsync-policy A/B +
 recovery replay, and the --crash-seed process-crash soak: recovery
 wall-clock, replayed records/s, leader transitions, the
 zero-duplicate-bindings / one-holder-per-term gates), null unless
-requested.
+requested; r08 adds workload (the --workload-seed trace-replay soak:
+a compressed day of diurnal/burst/jobwave/rollout/churn traffic under
+5% API faults + a 10% node-kill plan, recording per-phase bind
+throughput and every SLO verdict), null unless requested.
 """
 
 import argparse
@@ -274,6 +277,21 @@ def main():
                          "replayed records, leader transitions, and "
                          "the zero-duplicate-bindings / one-holder-"
                          "per-term gates")
+    ap.add_argument("--workload-seed", type=int, default=None,
+                    help="run the trace-replay workload soak: a "
+                         "seeded, time-compressed day of heterogeneous "
+                         "traffic (diurnal HPA demand, flash crowds, "
+                         "Job waves, rollout steps, Service churn) "
+                         "under 5%% API faults + a 10%% node-kill "
+                         "plan (kubemark/workload_soak.py); records "
+                         "the workload section — per-phase bind "
+                         "throughput and every SLO verdict")
+    ap.add_argument("--workload-trace", choices=("fast", "day"),
+                    default="fast",
+                    help="trace shape for the --workload-seed arm: "
+                         "'fast' = 12 ticks on a small fleet (the "
+                         "tier-1 gate's shape), 'day' = 48 ticks on "
+                         "a 1k-node fleet (the slow gate's shape)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -433,6 +451,38 @@ def main():
                       f"(dupes={len(cs.duplicate_bindings)} "
                       f"term_violations={len(cs.term_violations)})",
                       file=sys.stderr)
+    workload = None
+    if args.workload_seed is not None:
+        # the trace-replay arm (ISSUE 8): the exact invariants
+        # tests/test_workload.py's soak gate enforces, recorded so the
+        # artifact carries per-phase bind throughput + SLO verdicts
+        from kubernetes_tpu.chaos import WorkloadPlan
+        from kubernetes_tpu.kubemark.workload_soak import run_workload_soak
+        if args.workload_trace == "day":
+            wp = WorkloadPlan(seed=args.workload_seed, ticks=48,
+                              diurnal_period=48, diurnal_base=120,
+                              diurnal_amp=80, burst_min=40,
+                              burst_max=120)
+            wr = run_workload_soak(
+                n_nodes=1000, plan=wp, tick_wall_s=0.5,
+                fault_rate=0.05, node_kill_fraction=0.10,
+                timeout=900.0, heartbeat_interval=3.0,
+                monitor_period=0.5, monitor_grace_period=8.0,
+                pod_eviction_timeout=0.5, bind_p99_limit_s=8.0)
+        else:
+            wp = WorkloadPlan(seed=args.workload_seed, ticks=12)
+            wr = run_workload_soak(
+                n_nodes=12, plan=wp, tick_wall_s=0.4, fault_rate=0.05,
+                node_kill_fraction=0.10, timeout=120.0)
+        workload = {"trace": args.workload_trace, **wr.as_dict()}
+        workload.pop("hpa_track", None)
+        if args.verbose:
+            print(f"# workload[seed={args.workload_seed} "
+                  f"trace={args.workload_trace}] slo_ok={wr.slo_ok} "
+                  f"bind_p99={wr.bind_p99_s}s "
+                  f"lag={wr.hpa_max_lag_ticks} ticks "
+                  f"phases={[p['binds'] for p in wr.phases]}",
+                  file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -542,6 +592,7 @@ def main():
         "chaos": chaos,
         "node_chaos": node_chaos,
         "durability": durability,
+        "workload": workload,
         "multihost": multihost,
         "tpu": _tpu_section()}))
 

@@ -14,13 +14,22 @@ NODE faults — seeded kill / heartbeat-freeze / flap schedules driving a
 (in bound-pod progress, not wall time) for the apiserver, the active
 scheduler, and the active controller-manager — the durability/HA gates
 ride these (see `crash.py` and `kubemark/crash_soak.py`).
+
+`WorkloadPlan`/`WorkloadChaos` extend it to the WORKLOAD itself: a
+seeded, time-compressed replay of heterogeneous arrival traces
+(diurnal HPA-driven demand, Poisson flash crowds, batch Job waves,
+rollout steps, Service churn) — the trace-replay scenario suite rides
+these (see `workload.py` and `kubemark/workload_soak.py`).
 """
 
 from .crash import TARGETS as CRASH_TARGETS
 from .crash import CrashChaos, CrashPlan
 from .injector import VERBS, ChaosClient, ChaosWatcher, FaultPlan
 from .nodes import NodeChaos, NodeFaultPlan
+from .workload import GENERATORS as WORKLOAD_GENERATORS
+from .workload import WorkloadChaos, WorkloadEvent, WorkloadPlan
 
 __all__ = ["ChaosClient", "ChaosWatcher", "CrashChaos", "CrashPlan",
            "CRASH_TARGETS", "FaultPlan", "NodeChaos", "NodeFaultPlan",
-           "VERBS"]
+           "VERBS", "WORKLOAD_GENERATORS", "WorkloadChaos",
+           "WorkloadEvent", "WorkloadPlan"]

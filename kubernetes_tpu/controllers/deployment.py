@@ -169,8 +169,19 @@ class DeploymentController:
         # maxUnavailable=0 delete every ready old pod before a single
         # new one passes readiness (reconcileOldRCs scales by
         # GetAvailablePodsForRCs, deployment/deployment.go)
-        available = self._ready_pod_count([new_rc] + list(old_rcs))
-        can_remove = available - min_available
+        # both counts share ONE pod snapshot: a deletion landing
+        # between two separate LISTs would inflate the removal budget
+        snapshot: dict = {}
+        available = self._ready_pod_count([new_rc] + list(old_rcs),
+                                          snapshot)
+        # deletions already scheduled but not yet executed (a prior
+        # sync shrank an old RC whose manager hasn't killed the pod
+        # yet) still read as available — budget them as spent, or two
+        # back-to-back syncs double-delete past the maxUnavailable
+        # floor (the availability-gate test catches this race)
+        pending_deletes = max(0, self._ready_pod_count(old_rcs, snapshot)
+                              - old_total)
+        can_remove = available - pending_deletes - min_available
         for rc in sorted(old_rcs, key=lambda r: (r.metadata.creation_timestamp,
                                                  r.metadata.name)):
             if can_remove <= 0:
@@ -181,14 +192,18 @@ class DeploymentController:
             self._scale(rc, rc.spec.replicas - shrink)
             can_remove -= shrink
 
-    def _ready_pod_count(self, rcs) -> int:
+    def _ready_pod_count(self, rcs, by_ns: Optional[dict] = None) -> int:
         """Ready pods across the RCs' selectors (the reference's
         GetAvailablePodsForRCs, minus minReadySeconds which v1.1's
-        Deployment does not surface)."""
+        Deployment does not surface). TERMINATING pods are excluded: a
+        pod whose deletion has started still reports Ready until its
+        kubelet tears it down, and counting it would let the rollout
+        scale old RCs below the maxUnavailable floor (the trace
+        replay's availability gate caught exactly this)."""
         from .framework import is_pod_ready
         counted = set()
         total = 0
-        by_ns: dict = {}
+        by_ns = {} if by_ns is None else by_ns
         for rc in rcs:
             ns = rc.metadata.namespace
             if ns not in by_ns:
@@ -201,7 +216,9 @@ class DeploymentController:
                 key = (ns, pod.metadata.name)
                 if key in counted:
                     continue
-                if sel.matches(pod.metadata.labels) and is_pod_ready(pod):
+                if (pod.metadata.deletion_timestamp is None
+                        and sel.matches(pod.metadata.labels)
+                        and is_pod_ready(pod)):
                     counted.add(key)
                     total += 1
         return total
@@ -252,14 +269,24 @@ class DeploymentController:
                     pass
         total = (new_rc.status.replicas
                  + sum(rc.status.replicas for rc in old_rcs))
+        # surfaced for the rollout availability gate (the trace replay
+        # asserts the rolling-update invariant off these fields):
+        # available counts READY pods, unavailable the gap to the
+        # larger of desired and present totals
+        available = self._ready_pod_count([new_rc] + list(old_rcs))
+        unavailable = max(0, max(d.spec.replicas, total) - available)
         if (d.status.replicas == total
-                and d.status.updated_replicas == new_rc.status.replicas):
+                and d.status.updated_replicas == new_rc.status.replicas
+                and d.status.available_replicas == available
+                and d.status.unavailable_replicas == unavailable):
             return
         try:
             self.client.update_status("deployments", replace(
                 d, status=api.DeploymentStatus(
                     replicas=total,
                     updated_replicas=new_rc.status.replicas,
+                    available_replicas=available,
+                    unavailable_replicas=unavailable,
                     observed_generation=d.metadata.generation)),
                 d.metadata.namespace)
         except Exception:

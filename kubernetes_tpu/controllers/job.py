@@ -6,32 +6,62 @@ succeeded/failed pods by phase, run up to `parallelism` active pods until
 leftover active pods. Defaulting follows the reference's api defaults:
 parallelism nil -> 1; completions nil -> "any single success completes"
 (treated as 1 for the done-check but parallelism still bounds actives).
+
+Failure backoff: replacements for FAILED pods are requeued under a
+capped, jittered exponential backoff (escalating while the failure
+count keeps growing) instead of recreated on every sync — a
+crash-looping Job wave in the trace replay would otherwise turn the
+controller into a create-storm against the apiserver. The later
+reference grows this as the Job BackoffLimit/failure backoff
+(job_controller.go); v1.1 recreates immediately. Blocked requeues are
+counted by `job_backoff_requeues_total`.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 from dataclasses import replace
-from typing import List
+from typing import Dict, List, Optional, Tuple
 
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
 from ..core.labels import selector_from_set
+from ..utils.clock import Clock, RealClock
+from ..utils.metrics import global_metrics
 from .framework import (ControllerExpectations, QueueWorkers,
                         active_pods_sort_key)
 
 
 class JobController:
-    def __init__(self, client, workers: int = 5, recorder=None):
+    def __init__(self, client, workers: int = 5, recorder=None,
+                 failure_backoff_initial: float = 0.1,
+                 failure_backoff_cap: float = 10.0,
+                 clock: Optional[Clock] = None):
         self.client = client
         self.recorder = recorder
+        self.failure_backoff_initial = failure_backoff_initial
+        self.failure_backoff_cap = failure_backoff_cap
+        self.clock = clock or RealClock()
+        # key -> (failed count last seen, current delay, not-before)
+        self._backoff: Dict[str, Tuple[int, float, float]] = {}
+        # keys with a wakeup timer already armed (at most one per key,
+        # or a crash-looping wave would breed timers on every sync)
+        self._backoff_armed: set = set()
+        self._backoff_lock = threading.Lock()
         self.expectations = ControllerExpectations()
         self.workers = QueueWorkers(self._sync, workers, name="job-controller")
+        # resync re-drives every job periodically: the controller is
+        # otherwise edge-triggered, and a failed status write after the
+        # last pod went terminal would leave the job un-Completed
+        # forever (no further pod event arrives to re-drive the sync —
+        # the trace replay under 5% API faults shook this out)
         self.job_informer = Informer(
             client, "jobs",
             on_add=self._enqueue,
             on_update=lambda old, new: self._enqueue(new),
-            on_delete=self._enqueue)
+            on_delete=self._enqueue,
+            resync_period=5.0)
         self.pod_informer = Informer(
             client, "pods",
             on_add=self._pod_event(adds=True),
@@ -80,6 +110,8 @@ class JobController:
         job = self.job_informer.cache.get_by_key(key)
         if job is None:
             self.expectations.delete(key)
+            with self._backoff_lock:
+                self._backoff.pop(key, None)
             return
         pods = self._job_pods(job)
         active = [p for p in pods
@@ -109,6 +141,8 @@ class JobController:
                              if completions is not None else parallelism)
                 want_active = min(parallelism, remaining)
                 diff = want_active - len(active)
+                if diff > 0 and self._failure_backoff_active(key, failed):
+                    diff = 0  # cooling down; the timer re-drives us
                 if diff > 0:
                     self.expectations.expect_creations(key, diff)
                     threads = [threading.Thread(
@@ -132,6 +166,45 @@ class JobController:
                     active = active[(-diff):]
 
         self._update_status(job, len(active), succeeded, failed, done)
+
+    def _failure_backoff_active(self, key: str, failed: int) -> bool:
+        """True while replacements for failed pods must wait. Escalates
+        (doubles, capped) each time the failure count grows; a job with
+        no failed pods pays nothing. Blocked syncs arm a timer so the
+        key re-drives itself when the window expires."""
+        now = self.clock.monotonic()
+        with self._backoff_lock:
+            if failed <= 0:
+                self._backoff.pop(key, None)
+                return False
+            seen, delay, not_before = self._backoff.get(
+                key, (0, 0.0, 0.0))
+            if failed > seen:
+                delay = (self.failure_backoff_initial if delay <= 0
+                         else min(delay * 2, self.failure_backoff_cap))
+                # full jitter on the top quarter: a wave of jobs
+                # failing together must not retry in one synchronized
+                # spike (the retry-policy lesson, api/retry.py)
+                not_before = now + delay * (0.75 + random.random() * 0.25)
+                self._backoff[key] = (failed, delay, not_before)
+            remaining = not_before - now
+            if remaining <= 0:
+                return False
+            if key in self._backoff_armed:
+                return True  # the armed timer will re-drive this key
+            self._backoff_armed.add(key)
+        global_metrics.inc("job_backoff_requeues_total",
+                           {"job": key})
+
+        def fire():
+            with self._backoff_lock:
+                self._backoff_armed.discard(key)
+            self.workers.enqueue(key)
+
+        timer = threading.Timer(remaining, fire)
+        timer.daemon = True
+        timer.start()
+        return True
 
     def _create_pod(self, job: api.Job, key: str) -> None:
         tpl = job.spec.template

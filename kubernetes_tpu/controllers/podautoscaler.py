@@ -7,17 +7,26 @@ band, clamped to [min, max]; scale the referenced RC. The reference reads
 utilization from heapster; here the metrics source is injectable
 (fn(namespace, selector_labels) -> average utilization percent or None),
 with the same semantics: no metrics -> no scaling.
-"""
+
+Downscale stabilization (the later reference's
+--horizontal-pod-autoscaler-downscale-stabilization, backported for the
+trace-replay soak): with a window of N seconds, the effective desired
+count is the MAX recommendation over the last N seconds — upscales act
+immediately, downscales only once every recommendation in the window
+agrees. A diurnal replay's metric dips then stop flapping replica
+counts (tests/test_workload_controllers.py pins flap vs genuine
+ramp-down)."""
 
 from __future__ import annotations
 
 import math
 import threading
 from dataclasses import replace
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core import types as api
 from ..core.errors import ApiError, NotFound
+from ..utils.clock import Clock, RealClock
 
 SYNC_PERIOD = 30.0        # horizontal.go default --horizontal-pod-autoscaler-sync-period
 TOLERANCE = 0.1           # horizontal.go tolerance
@@ -27,11 +36,17 @@ MetricsSource = Callable[[str, Dict[str, str]], Optional[float]]
 
 class HorizontalController:
     def __init__(self, client, metrics: MetricsSource,
-                 sync_period: float = SYNC_PERIOD, recorder=None):
+                 sync_period: float = SYNC_PERIOD, recorder=None,
+                 downscale_stabilization: float = 0.0,
+                 clock: Optional[Clock] = None):
         self.client = client
         self.metrics = metrics
         self.recorder = recorder
         self.sync_period = sync_period
+        self.downscale_stabilization = downscale_stabilization
+        self.clock = clock or RealClock()
+        # per-HPA (ns/name) recommendation history inside the window
+        self._recommendations: Dict[str, List[Tuple[float, int]]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -81,6 +96,7 @@ class HorizontalController:
                     desired = int(math.ceil(current * ratio))
         desired = max(hpa.spec.min_replicas,
                       min(hpa.spec.max_replicas, desired))
+        desired = self._stabilized(hpa, desired)
         did_scale = desired != current
         if did_scale:
             try:
@@ -101,6 +117,22 @@ class HorizontalController:
                                      "New size: %d", desired)
         self._update_status(hpa, current, desired, utilization, did_scale)
         return did_scale
+
+    def _stabilized(self, hpa: api.HorizontalPodAutoscaler,
+                    desired: int) -> int:
+        """Damped desired count: the max recommendation over the
+        stabilization window. A single-dip recommendation can never
+        shrink the fleet; a ramp-down that outlives the window can."""
+        if self.downscale_stabilization <= 0:
+            return desired
+        key = f"{hpa.metadata.namespace}/{hpa.metadata.name}"
+        now = self.clock.monotonic()
+        floor = now - self.downscale_stabilization
+        window = [(ts, d) for ts, d in self._recommendations.get(key, [])
+                  if ts >= floor]
+        window.append((now, desired))
+        self._recommendations[key] = window
+        return max(d for _, d in window)
 
     def _update_status(self, hpa, current, desired, utilization,
                        did_scale) -> None:

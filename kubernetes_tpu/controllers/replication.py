@@ -30,11 +30,20 @@ class ReplicationManager:
         self.recorder = recorder
         self.expectations = ControllerExpectations()
         self.workers = QueueWorkers(self._sync, workers, name="rc-manager")
+        # resync re-drives every RC periodically: "next sync retries"
+        # in _update_status is a lie without it — a status write that
+        # failed after the last pod event (e.g. under injected API
+        # faults) would otherwise leave status.replicas stale forever,
+        # wedging any controller layered on RC status (the Deployment
+        # rollout waits on old-RC status reaching 0; the trace replay
+        # shook this out). The reference runs the RC manager on a full
+        # resync for the same reason.
         self.rc_informer = Informer(
             client, "replicationcontrollers",
             on_add=self._enqueue_rc,
             on_update=lambda old, new: self._enqueue_rc(new),
-            on_delete=self._delete_rc)
+            on_delete=self._delete_rc,
+            resync_period=5.0)
         self.pod_informer = Informer(
             client, "pods",
             on_add=self._add_pod, on_update=self._update_pod,

@@ -934,6 +934,12 @@ class DeploymentSpec:
 class DeploymentStatus:
     replicas: int = 0
     updated_replicas: int = 0
+    # availability means READY pods (deployment/deployment.go
+    # GetAvailablePodsForRCs); unavailable counts the gap to the larger
+    # of spec.replicas and the current total — during a surge the extra
+    # unready pods are unavailable too
+    available_replicas: int = 0
+    unavailable_replicas: int = 0
     observed_generation: int = 0
 
 

@@ -27,7 +27,7 @@ from typing import Dict, Iterable, List, Optional, Set
 from ..agents.hollow_node import confirm_pod_deletion
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
-from ..core.errors import ApiError, Conflict, NotFound
+from ..core.errors import AlreadyExists, Conflict, NotFound
 from ..core.quantity import parse_quantity
 
 
@@ -95,7 +95,7 @@ class HollowFleet:
                 try:
                     self.client.create("nodes", self._node_object(i))
                     break
-                except ApiError:
+                except AlreadyExists:
                     break  # already registered from a prior life
                 except Exception:
                     # transient (connection loss, injected fault): the
@@ -120,9 +120,16 @@ class HollowFleet:
                                          conditions=fresh.status.conditions)))
                 return
             except NotFound:
+                # re-register a node the apiserver lost (or whose
+                # registration never landed). ANY failure here must be
+                # swallowed — an exception raised inside this handler
+                # would escape the outer try and kill the fleet's one
+                # heartbeat thread (a transient create fault at 1k
+                # nodes under injected chaos did exactly that); the
+                # next beat retries the heal
                 try:
                     self.client.create("nodes", self._node_object(i))
-                except ApiError:
+                except Exception:
                     pass
                 return
             except Exception:

@@ -1,0 +1,598 @@
+"""Trace-replay workload soak: a day of production under chaos, in
+minutes, SLO-gated.
+
+Stands up the full control plane over HTTP — registry + apiserver,
+hollow fleet, batch scheduler, replication manager, deployment / job /
+daemonset controllers, HPA, node-lifecycle controller — with every
+component client behind the seeded API-fault injector, then replays a
+`chaos.WorkloadPlan` trace tick by tick:
+
+  diurnal   per-tick demand drives the HPA's metrics source; the HPA
+            chases the sinusoid up and down through the Deployment's
+            scale subresource (downscale damping keeps dips from
+            flapping the fleet)
+  burst     flash crowds of bare pods; their create->bind latency is
+            the burst-window SLO population
+  jobwave   batch Jobs created mid-replay; a hollow "executor" marks
+            their Running pods Succeeded (or Failed for the drawn
+            crash-looping waves, exercising the Job failure backoff)
+  rollout   Deployment image bumps (hash rollout under the
+            maxUnavailable invariant) and DaemonSet retargeting
+  churn     Service create/delete against a fixed pool
+
+Optionally a seeded `NodeFaultPlan` hard-kills a fraction of the fleet
+at `kill_tick` — the replay then proves the whole recovery chain under
+live heterogeneous load.
+
+SLO gates (the ISSUE-8 acceptance bar), read server-side where the
+server is the authority (api latency summaries; registry state for
+bindings):
+
+  - burst bind p99 under `bind_p99_limit_s`
+  - HPA convergence: tracking error vs the pure demand curve never
+    stays out of tolerance longer than `hpa_max_lag_ticks` ticks
+  - zero pods bound to dead nodes at quiesce, zero duplicate bindings
+  - every non-failing Job Complete; the final Service set equal to the
+    plan's pure fold
+  - the applied event trace byte-identical to `plan.schedule()` (and
+    the node-kill victim set to its plan) — same seed, same day
+
+Determinism note: the replay clock is the COMPRESSED TICK axis
+(`tick_wall_s` wall seconds per virtual tick), and the contract covers
+WHAT happens at each tick, not wall timing. Final-state equality
+between two same-seed invocations is asserted over `state_summary()` —
+the canonical deterministic projection (service set, completed-job
+set, DaemonSet coverage, crowd-pod bind totals, dead-node set, HPA
+band membership). The HPA's 10% tolerance band admits more than one
+integer fixed point, so raw replica counts are compared as
+band-membership, not bit-equality (see DIVERGENCES.md).
+
+Shared verbatim by the pytest gates (tests/test_workload.py) and the
+bench arm (bench.py --workload-seed), so the artifact records exactly
+the invariants the tests enforce.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api.client import HttpClient, InProcClient
+from ..api.registry import Registry
+from ..api.server import ApiServer
+from ..chaos import (ChaosClient, FaultPlan, NodeChaos, NodeFaultPlan,
+                     WorkloadChaos, WorkloadPlan)
+from ..controllers.daemon import DaemonSetController
+from ..controllers.deployment import DeploymentController
+from ..controllers.job import JobController
+from ..controllers.node import NodeController
+from ..controllers.podautoscaler import HorizontalController
+from ..controllers.replication import ReplicationManager
+from ..core import types as api
+from ..core.quantity import parse_quantity
+from ..sched.batch import BatchScheduler
+from ..sched.factory import ConfigFactory
+from ..utils.metrics import MetricsRegistry, global_metrics
+from .fleet import HollowFleet
+
+#: demand units one replica serves at exactly the HPA target — the
+#: pure demand->replicas mapping the convergence gate compares against
+UNITS_PER_REPLICA = 4
+HPA_TARGET_PCT = 50
+HPA_MAX_REPLICAS = 60
+
+LATENCY_METRIC = "apiserver_request_latencies_microseconds"
+
+
+def ideal_replicas(demand: int) -> int:
+    """The unique HPA equilibrium for a demand level (pure)."""
+    return max(1, min(HPA_MAX_REPLICAS, int(math.ceil(
+        demand * 100.0 / (UNITS_PER_REPLICA * HPA_TARGET_PCT)))))
+
+
+def hpa_in_band(demand: int, replicas: int) -> bool:
+    """The HPA's own no-move region (its 10% utilization tolerance,
+    plus sampling slack): the convergence gate must judge the
+    controller by ITS fixed-point criterion — ceil rounding means more
+    than one replica count can satisfy the band for one demand level,
+    and all of them are converged (see module docstring). A fleet
+    pegged at the min/max clamp while demand is beyond it is converged
+    too — the controller has nothing left to move."""
+    ideal = ideal_replicas(demand)
+    if ideal >= HPA_MAX_REPLICAS and replicas >= HPA_MAX_REPLICAS:
+        return True
+    if ideal <= 1 and replicas <= 1:
+        return True
+    ratio = demand * 100.0 / (
+        UNITS_PER_REPLICA * max(1, replicas)) / HPA_TARGET_PCT
+    return abs(ratio - 1.0) <= 0.12
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+@dataclass
+class WorkloadSoakResult:
+    converged: bool
+    n_nodes: int
+    seed: int
+    ticks: int
+    #: applied workload trace == plan.schedule(), per generator
+    schedule_replayed: bool = False
+    #: applied node-kill victims == NodeFaultPlan replay
+    node_schedule_replayed: bool = True
+    events_applied: int = 0
+    events_expected: int = 0
+    killed: List[str] = field(default_factory=list)
+    # ---- burst bind SLO (create -> spec.nodeName observed)
+    bind_p50_s: float = 0.0
+    bind_p99_s: float = 0.0
+    bind_samples: int = 0
+    bind_p99_limit_s: float = 3.0
+    # ---- HPA convergence vs the pure demand curve
+    hpa_max_lag_ticks: int = 0
+    hpa_lag_limit_ticks: int = 0
+    hpa_in_band_final: bool = False
+    hpa_track: List[Tuple[int, int, int, int]] = field(
+        default_factory=list)  # (tick, demand, ideal, actual)
+    # ---- correctness gates
+    duplicate_bindings: int = 0
+    dead_bound: int = 0
+    jobs_expected: int = 0
+    jobs_completed: int = 0
+    backoff_requeues: float = 0.0
+    failing_waves: int = 0
+    services_ok: bool = False
+    services_final: List[str] = field(default_factory=list)
+    # ---- per-phase bind throughput (replay split into thirds)
+    phases: List[Dict] = field(default_factory=list)
+    # ---- server-side API latency over the whole replay
+    api_p99_ms: float = 0.0
+    api_calls: int = 0
+    detail: str = ""
+
+    @property
+    def bind_p99_ok(self) -> Optional[bool]:
+        if self.bind_samples == 0:
+            return None  # the plan drew no bursts: nothing to gate
+        return self.bind_p99_s < self.bind_p99_limit_s
+
+    @property
+    def hpa_ok(self) -> bool:
+        return (self.hpa_max_lag_ticks <= self.hpa_lag_limit_ticks
+                and self.hpa_in_band_final)
+
+    @property
+    def slo_ok(self) -> bool:
+        """Every gate at once — what the soak test asserts and the
+        bench artifact records."""
+        return bool(self.converged and self.schedule_replayed
+                    and self.node_schedule_replayed
+                    and self.bind_p99_ok is not False
+                    and self.hpa_ok
+                    and self.duplicate_bindings == 0
+                    and self.dead_bound == 0
+                    and self.jobs_completed >= self.jobs_expected
+                    and self.services_ok)
+
+    def state_summary(self) -> Dict:
+        """The canonical deterministic projection of post-replay state
+        — what two same-seed invocations are compared on (see module
+        docstring for why HPA replicas are band-membership)."""
+        return {
+            "services": list(self.services_final),
+            "jobs_completed": self.jobs_completed,
+            "jobs_expected": self.jobs_expected,
+            "crowd_bound": self.bind_samples,
+            "killed": list(self.killed),
+            "hpa_in_band_final": self.hpa_in_band_final,
+            "converged": self.converged,
+        }
+
+    def as_dict(self) -> Dict:
+        d = asdict(self)
+        d["bind_p99_ok"] = self.bind_p99_ok
+        d["hpa_ok"] = self.hpa_ok
+        d["slo_ok"] = self.slo_ok
+        d["hpa_track"] = [list(t) for t in self.hpa_track]
+        return d
+
+
+def run_workload_soak(n_nodes: int = 12, seed: int = 0,
+                      plan: Optional[WorkloadPlan] = None,
+                      tick_wall_s: float = 0.4,
+                      fault_rate: float = 0.05,
+                      node_kill_fraction: float = 0.0,
+                      kill_tick: Optional[int] = None,
+                      bind_p99_limit_s: float = 3.0,
+                      hpa_damping_ticks: int = 2,
+                      hpa_lag_limit_ticks: Optional[int] = None,
+                      timeout: float = 180.0,
+                      heartbeat_interval: float = 0.5,
+                      monitor_period: float = 0.1,
+                      monitor_grace_period: float = 1.5,
+                      pod_eviction_timeout: float = 0.3,
+                      registry: Optional[Registry] = None
+                      ) -> WorkloadSoakResult:
+    """One seeded trace replay; see the module docstring for the
+    scenario. Timing knobs default to soak-compressed values."""
+    plan = plan or WorkloadPlan(seed=seed)
+    seed = plan.seed
+    fault_plan = FaultPlan(seed=seed, error_rate=fault_rate)
+    node_plan = NodeFaultPlan(seed=seed, kill_fraction=node_kill_fraction)
+    kill_tick = (plan.ticks // 2 if kill_tick is None else kill_tick)
+    # damping intentionally holds downscales for hpa_damping_ticks; the
+    # +6 absorbs fault-delayed reconciles without unbounding the gate
+    hpa_lag_limit = (hpa_damping_ticks + 6 if hpa_lag_limit_ticks is None
+                     else hpa_lag_limit_ticks)
+
+    metrics = MetricsRegistry()
+    registry = registry or Registry()
+    server = ApiServer(registry, port=0, metrics=metrics).start()
+    chaos = ChaosClient(HttpClient(server.url), fault_plan)
+    inproc = InProcClient(registry)
+
+    result = WorkloadSoakResult(
+        converged=False, n_nodes=n_nodes, seed=seed, ticks=plan.ticks,
+        bind_p99_limit_s=bind_p99_limit_s,
+        hpa_lag_limit_ticks=hpa_lag_limit)
+    sched_pure = plan.schedule()
+    result.events_expected = sum(len(v) for v in sched_pure.values())
+    backoff_base = global_metrics.counter_sum("job_backoff_requeues_total")
+
+    # ---- the fleet, zoned for DaemonSet retargeting
+    fleet = HollowFleet(
+        chaos, n_nodes, heartbeat_interval=heartbeat_interval,
+        labels_for=lambda i: {"zone": f"z{i % plan.n_zones}"}).run()
+    factory = ConfigFactory(chaos, rate_limit=False).start()
+    sched = BatchScheduler(factory.create_batch()).run()
+    rc_mgr = ReplicationManager(chaos).run()
+    deploy_ctl = DeploymentController(chaos).run()
+    job_ctl = JobController(chaos, failure_backoff_initial=0.2,
+                            failure_backoff_cap=2.0).run()
+    ds_ctl = DaemonSetController(chaos).run()
+    node_ctl = NodeController(
+        chaos, monitor_period=monitor_period,
+        monitor_grace_period=monitor_grace_period,
+        pod_eviction_timeout=pod_eviction_timeout,
+        eviction_qps=1000.0, eviction_burst=1000).run()
+
+    wl = WorkloadChaos(chaos, plan)
+    node_chaos = NodeChaos(fleet, node_plan)
+
+    # ---- HPA rides the shared demand signal: utilization is demand
+    # over serving capacity, so the equilibrium is exactly
+    # ideal_replicas(demand) and the convergence gate is pure
+    def metrics_source(ns, selector):
+        try:
+            d = registry.get("deployments", plan.deployment, "default")
+        except Exception:
+            return None
+        cur = max(1, d.spec.replicas)
+        return 100.0 * wl.demand / (UNITS_PER_REPLICA * cur)
+
+    hpa_ctl = HorizontalController(
+        chaos, metrics_source, sync_period=max(0.05, tick_wall_s / 3.0),
+        downscale_stabilization=hpa_damping_ticks * tick_wall_s).run()
+
+    # ---- trackers ride the live registry directly (no chaos, no HTTP)
+    lock = threading.Lock()
+    bound_to: Dict[str, str] = {}            # pod uid -> node
+    duplicates: List[Tuple[str, str, str]] = []
+    crowd_created: Dict[str, float] = {}
+    crowd_bound: Dict[str, float] = {}
+    bind_stamps: List[float] = []            # all binds, for phases
+    stop_threads = threading.Event()
+
+    wl.on_crowd = lambda names: crowd_created.update(
+        {n: time.monotonic() for n in names})
+
+    def tracker():
+        # one registry sweep: duplicate-binding ledger + crowd bind
+        # stamps (server-side truth — spec.nodeName in the store)
+        while not stop_threads.is_set():
+            try:
+                pods, _ = registry.list("pods", "default")
+            except Exception:
+                time.sleep(0.03)
+                continue
+            now = time.monotonic()
+            with lock:
+                for p in pods:
+                    node = p.spec.node_name
+                    if not node:
+                        continue
+                    prev = bound_to.get(p.metadata.uid)
+                    if prev is not None and prev != node:
+                        duplicates.append((p.metadata.uid, prev, node))
+                    if prev is None:
+                        bind_stamps.append(now)
+                    bound_to[p.metadata.uid] = node
+                    name = p.metadata.name
+                    if (name.startswith("crowd-")
+                            and name not in crowd_bound):
+                        crowd_bound[name] = now
+            time.sleep(0.03)
+
+    def executor():
+        # the hollow workload side: Running job pods exit — cleanly for
+        # normal waves, crashing for the drawn failing waves
+        from dataclasses import replace
+        while not stop_threads.is_set():
+            try:
+                pods, _ = registry.list("pods", "default")
+            except Exception:
+                time.sleep(0.05)
+                continue
+            for p in pods:
+                wave = p.metadata.labels.get("wave")
+                if not wave or p.status.phase != "Running":
+                    continue
+                _, failing = wl.jobs.get(wave, (0, False))
+                phase = "Failed" if failing else "Succeeded"
+                try:
+                    inproc.update_status("pods", replace(
+                        p, status=replace(p.status, phase=phase)),
+                        "default")
+                except Exception:
+                    pass  # conflict/NotFound: next sweep retries
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=tracker, daemon=True,
+                                name="workload-tracker"),
+               threading.Thread(target=executor, daemon=True,
+                                name="workload-executor")]
+    for t in threads:
+        t.start()
+
+    def wait_until(cond, deadline):
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return cond()
+
+    def retry_api(fn, deadline):
+        while True:
+            try:
+                return fn()
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    try:
+        deadline = time.time() + timeout
+        if not wait_until(
+                lambda: len(factory.node_lister.list()) >= n_nodes,
+                deadline):
+            result.detail = "fleet never registered"
+            return result
+
+        # warm the engine's compile cache at the run's shapes while the
+        # scheduler is still idle (a live scheduler has warm caches; an
+        # XLA compile inside the replay would bill seconds of compiler
+        # time to the first burst's bind-latency SLO — the
+        # kubemark/slo.py lesson)
+        from .benchmark import _warmup_batch
+        _warmup_batch(sched, factory)
+
+        # ---- bootstrap the standing workload (retried through faults)
+        base_replicas = ideal_replicas(plan.diurnal_base)
+        tiny = api.PodSpec(containers=[api.Container(
+            name="c", image="img:v1",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity("10m"),
+                          "memory": parse_quantity("16Mi")}))])
+        retry_api(lambda: chaos.create("deployments", api.Deployment(
+            metadata=api.ObjectMeta(name=plan.deployment,
+                                    namespace="default"),
+            spec=api.DeploymentSpec(
+                replicas=base_replicas,
+                selector={"app": plan.deployment},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(
+                        labels={"app": plan.deployment}),
+                    spec=tiny))), "default"), deadline)
+        retry_api(lambda: chaos.create(
+            "horizontalpodautoscalers", api.HorizontalPodAutoscaler(
+                metadata=api.ObjectMeta(name=f"{plan.deployment}-hpa",
+                                        namespace="default"),
+                spec=api.HorizontalPodAutoscalerSpec(
+                    scale_ref=api.SubresourceReference(
+                        kind="Deployment", name=plan.deployment,
+                        namespace="default"),
+                    min_replicas=1, max_replicas=HPA_MAX_REPLICAS,
+                    cpu_utilization_target_percentage=HPA_TARGET_PCT)),
+            "default"), deadline)
+        retry_api(lambda: chaos.create("daemonsets", api.DaemonSet(
+            metadata=api.ObjectMeta(name=plan.daemonset,
+                                    namespace="default"),
+            spec=api.DaemonSetSpec(
+                selector={"ds": plan.daemonset},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(
+                        labels={"ds": plan.daemonset}),
+                    spec=tiny))), "default"), deadline)
+
+        def deployment_ready():
+            try:
+                d = registry.get("deployments", plan.deployment,
+                                 "default")
+            except Exception:
+                return False
+            return (d.status.available_replicas >= base_replicas
+                    and d.status.unavailable_replicas == 0)
+
+        if not wait_until(deployment_ready, deadline):
+            result.detail = "bootstrap deployment never became available"
+            return result
+
+        # ---- the replay: one compressed tick at a time
+        t_start = time.monotonic()
+        dead: set = set()
+        hpa_bad_run = 0
+        for tick in range(plan.ticks):
+            wl.apply_tick(tick, deadline)
+            if node_kill_fraction > 0 and tick == kill_tick:
+                result.killed = node_chaos.kill()
+                dead = set(result.killed)
+                result.node_schedule_replayed = (
+                    result.killed
+                    == node_plan.schedule(fleet.node_names())["kill"])
+            time.sleep(tick_wall_s)
+            # HPA tracking sample, against the pure curve
+            try:
+                d = registry.get("deployments", plan.deployment,
+                                 "default")
+                actual = d.spec.replicas
+            except Exception:
+                actual = -1
+            ideal = ideal_replicas(wl.demand)
+            result.hpa_track.append((tick, wl.demand, ideal, actual))
+            in_band = actual > 0 and hpa_in_band(wl.demand, actual)
+            # damping holds downscales for hpa_damping_ticks by design:
+            # only count ticks beyond the window as lag
+            hpa_bad_run = 0 if in_band else hpa_bad_run + 1
+            lag = max(0, hpa_bad_run - hpa_damping_ticks)
+            result.hpa_max_lag_ticks = max(result.hpa_max_lag_ticks, lag)
+        t_end = time.monotonic()
+
+        # ---- quiesce: every workload class settled on live nodes
+        expected_services = plan.expected_services()
+        result.jobs_expected = sum(
+            1 for _n, (_c, failing) in wl.jobs.items() if not failing)
+        result.failing_waves = sum(
+            1 for _n, (_c, failing) in wl.jobs.items() if failing)
+
+        def completed_jobs():
+            try:
+                jobs, _ = registry.list("jobs", "default")
+            except Exception:
+                return -1
+            return sum(1 for j in jobs
+                       if any(c.type == "Complete" and c.status == "True"
+                              for c in j.status.conditions))
+
+        def services_now():
+            try:
+                svcs, _ = registry.list("services", "default")
+            except Exception:
+                return None
+            return sorted(s.metadata.name for s in svcs
+                          if s.metadata.deletion_timestamp is None)
+
+        def crowd_settled():
+            # every crowd pod observed bound (the flash crowd was
+            # served); pods later evicted off killed nodes still count
+            # — they were served before the node died
+            with lock:
+                return len(crowd_bound) >= len(wl.crowd_pods)
+
+        def hpa_settled():
+            try:
+                d = registry.get("deployments", plan.deployment,
+                                 "default")
+            except Exception:
+                return False
+            return (hpa_in_band(wl.demand, d.spec.replicas)
+                    and d.status.unavailable_replicas == 0)
+
+        def dead_bound_count():
+            try:
+                pods, _ = registry.list("pods", "default")
+            except Exception:
+                return -1
+            return sum(1 for p in pods if p.spec.node_name in dead)
+
+        def quiesced():
+            return (crowd_settled()
+                    and completed_jobs() >= result.jobs_expected
+                    and services_now() == expected_services
+                    and hpa_settled()
+                    and dead_bound_count() == 0)
+
+        ok = wait_until(quiesced, deadline)
+        result.converged = ok
+        result.services_final = services_now() or []
+        result.services_ok = result.services_final == expected_services
+        result.jobs_completed = max(0, completed_jobs())
+        result.dead_bound = max(0, dead_bound_count())
+        d_final = registry.get("deployments", plan.deployment, "default")
+        result.hpa_in_band_final = hpa_in_band(wl.demand,
+                                               d_final.spec.replicas)
+        with lock:
+            result.duplicate_bindings = len(duplicates)
+            latencies = sorted(crowd_bound[n] - crowd_created[n]
+                               for n in crowd_bound if n in crowd_created)
+            stamps = list(bind_stamps)
+        result.bind_samples = len(latencies)
+        result.bind_p50_s = round(_percentile(latencies, 0.50), 4)
+        result.bind_p99_s = round(_percentile(latencies, 0.99), 4)
+
+        # ---- the applied trace vs the pure replay
+        trace = wl.trace()
+        result.events_applied = sum(len(v) for v in trace.values())
+        result.schedule_replayed = trace == sched_pure
+        result.backoff_requeues = round(
+            global_metrics.counter_sum("job_backoff_requeues_total")
+            - backoff_base, 1)
+
+        # ---- per-phase bind throughput (replay thirds)
+        span = max(1e-6, t_end - t_start)
+        for i in range(3):
+            lo = t_start + span * i / 3.0
+            hi = t_start + span * (i + 1) / 3.0
+            n = sum(1 for s in stamps if lo <= s < hi)
+            result.phases.append({
+                "phase": i,
+                "binds": n,
+                "binds_per_sec": round(n / (span / 3.0), 1)})
+
+        # ---- server-side API latency over the replay window
+        merged: List[float] = []
+        calls = 0
+        for labels, samples in metrics.summary_samples(
+                LATENCY_METRIC).items():
+            if dict(labels).get("resource", "").endswith(":batch"):
+                continue
+            merged.extend(samples)
+            calls += len(samples)
+        merged.sort()
+        result.api_p99_ms = round(_percentile(merged, 0.99) / 1e3, 2)
+        result.api_calls = calls
+
+        if not ok:
+            result.detail = (
+                f"crowd {len(crowd_bound)}/{len(wl.crowd_pods)} bound, "
+                f"jobs {result.jobs_completed}/{result.jobs_expected} "
+                f"complete, services={result.services_final} "
+                f"(want {expected_services}), "
+                f"dead_bound={result.dead_bound}, "
+                f"hpa actual={d_final.spec.replicas} "
+                f"ideal={ideal_replicas(wl.demand)} "
+                f"status(replicas={d_final.status.replicas} "
+                f"avail={d_final.status.available_replicas} "
+                f"unavail={d_final.status.unavailable_replicas} "
+                f"updated={d_final.status.updated_replicas})")
+        return result
+    finally:
+        stop_threads.set()
+        node_chaos.stop()
+        hpa_ctl.stop()
+        node_ctl.stop()
+        ds_ctl.stop()
+        job_ctl.stop()
+        deploy_ctl.stop()
+        rc_mgr.stop()
+        sched.stop()
+        factory.stop()
+        fleet.stop()
+        server.stop()

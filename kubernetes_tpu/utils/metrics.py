@@ -156,3 +156,11 @@ DURABILITY_COUNTERS = (
     "leader_transitions_total", # elector acquisitions (label: name)
     "lease_renew_failures_total",  # failed renew attempts (label: name)
 )
+
+#: Workload-replay counters: incremented by the controllers the
+#: trace-replay soak shakes out; pinned here for the same no-drift
+#: reason as DURABILITY_COUNTERS (the workload gates assert these).
+WORKLOAD_COUNTERS = (
+    "job_backoff_requeues_total",  # Job syncs held back by failure
+                                   # backoff (label: job)
+)

@@ -351,6 +351,245 @@ class TestDeploymentController:
             rc_manager.stop()
 
 
+class TestDeploymentRolloutAvailability:
+    def test_rolling_update_never_below_max_unavailable(self, cluster):
+        """The rolling-update invariant under a replayed rollout step:
+        available (READY) pods never drop below spec.replicas -
+        maxUnavailable, and the deployment status surfaces
+        available/unavailable_replicas correctly throughout."""
+        import threading as _threading
+        from dataclasses import replace
+        registry, client = cluster
+        rc_manager = ReplicationManager(client).run()
+        ctrl = DeploymentController(client).run()
+        replicas, max_unavailable = 4, 1
+        stop = _threading.Event()
+        samples = []
+
+        def readiness_pump():
+            # hollow-kubelet stand-in with a readiness DELAY, so the
+            # rollout is gradual enough to observe its windows
+            pending_since = {}
+            while not stop.is_set():
+                for p in pods_of(client, label=("app", "web")):
+                    if any(c.type == "Ready" and c.status == "True"
+                           for c in p.status.conditions):
+                        continue
+                    first = pending_since.setdefault(
+                        p.metadata.name, time.time())
+                    if time.time() - first < 0.15:
+                        continue
+                    try:
+                        client.update_status("pods", replace(
+                            p, status=replace(
+                                p.status, phase="Running",
+                                conditions=[api.PodCondition(
+                                    type="Ready", status="True")])),
+                            "default")
+                    except Exception:
+                        pass
+                stop.wait(0.03)
+
+        def sampler():
+            # ground truth, sampled tight: ready non-terminating pods
+            while not stop.is_set():
+                ready = [
+                    p for p in pods_of(client, label=("app", "web"))
+                    if p.metadata.deletion_timestamp is None
+                    and any(c.type == "Ready" and c.status == "True"
+                            for c in p.status.conditions)]
+                samples.append(len(ready))
+                stop.wait(0.01)
+
+        _threading.Thread(target=readiness_pump, daemon=True).start()
+        try:
+            d = api.Deployment(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.DeploymentSpec(
+                    replicas=replicas, selector={"app": "web"},
+                    template=template({"app": "web"}),
+                    strategy=api.DeploymentStrategy(
+                        rolling_update=api.RollingUpdateDeployment(
+                            max_surge=1,
+                            max_unavailable=max_unavailable))))
+            client.create("deployments", d, "default")
+            assert wait_until(lambda: client.get(
+                "deployments", "web",
+                "default").status.available_replicas == replicas)
+
+            # the replayed rollout step: bump the template image
+            _threading.Thread(target=sampler, daemon=True).start()
+            fresh = client.get("deployments", "web", "default")
+            new_tpl = template({"app": "web"})
+            new_tpl.spec.containers[0].image = "img:v2"
+            client.update("deployments", replace(
+                fresh, spec=replace(fresh.spec, template=new_tpl)),
+                "default")
+
+            def rolled():
+                rcs, _ = client.list("replicationcontrollers", "default")
+                live = [rc for rc in rcs if rc.spec.replicas > 0]
+                return (len(live) == 1
+                        and live[0].spec.template.spec.containers[0]
+                        .image == "img:v2"
+                        and live[0].status.replicas == replicas)
+            assert wait_until(rolled, timeout=30)
+            assert wait_until(lambda: client.get(
+                "deployments", "web",
+                "default").status.available_replicas == replicas)
+            stop.set()
+
+            # the invariant: the rollout never dipped below
+            # replicas - maxUnavailable ready pods (sampler warmed up
+            # while the fleet was fully available, so min() is the
+            # rollout's floor)
+            assert samples and min(samples) >= replicas - max_unavailable, \
+                f"availability dipped to {min(samples)} (samples={samples[:50]}...)"
+            final = client.get("deployments", "web", "default").status
+            assert final.available_replicas == replicas
+            assert final.unavailable_replicas == 0
+        finally:
+            stop.set()
+            ctrl.stop()
+            rc_manager.stop()
+
+
+class TestJobFailureBackoff:
+    def test_failed_pods_requeue_with_backoff(self, cluster):
+        """A crash-looping job must not recreate replacements on every
+        sync: the first replacement waits out the initial backoff, and
+        job_backoff_requeues_total counts the deferrals."""
+        from kubernetes_tpu.utils.metrics import global_metrics
+        from dataclasses import replace
+        registry, client = cluster
+        base = global_metrics.counter_sum("job_backoff_requeues_total")
+        ctrl = JobController(client, failure_backoff_initial=0.5,
+                             failure_backoff_cap=2.0).run()
+        try:
+            client.create("jobs", api.Job(
+                metadata=api.ObjectMeta(name="crash", namespace="default"),
+                spec=api.JobSpec(parallelism=1, completions=1,
+                                 selector={"job": "crash"},
+                                 template=template({"job": "crash"}))),
+                "default")
+            assert wait_until(lambda: len(pods_of(client)) == 1)
+            victim = pods_of(client)[0]
+            client.update_status("pods", replace(
+                victim, status=api.PodStatus(phase="Failed")), "default")
+
+            def active_count():
+                return len([p for p in pods_of(client)
+                            if p.status.phase != "Failed"])
+
+            # inside the backoff window: no replacement yet
+            time.sleep(0.2)
+            assert active_count() == 0, \
+                "replacement created before the backoff expired"
+            # the window expires: the replacement arrives
+            assert wait_until(lambda: active_count() == 1, timeout=5)
+            assert global_metrics.counter_sum(
+                "job_backoff_requeues_total") > base
+        finally:
+            ctrl.stop()
+
+    def test_successful_jobs_pay_nothing(self, cluster):
+        """No failed pods -> no backoff: scale-up is immediate."""
+        registry, client = cluster
+        ctrl = JobController(client, failure_backoff_initial=5.0,
+                             failure_backoff_cap=5.0).run()
+        try:
+            client.create("jobs", api.Job(
+                metadata=api.ObjectMeta(name="ok", namespace="default"),
+                spec=api.JobSpec(parallelism=2, completions=2,
+                                 selector={"job": "ok"},
+                                 template=template({"job": "ok"}))),
+                "default")
+            # a 5s initial backoff would make this wait_until fail if
+            # clean jobs were charged for it
+            assert wait_until(lambda: len(pods_of(client)) == 2,
+                              timeout=3)
+        finally:
+            ctrl.stop()
+
+
+class TestHPADownscaleStabilization:
+    def _cluster_with_hpa(self, client, utilization):
+        client.create("replicationcontrollers", api.ReplicationController(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=2, selector={"app": "web"},
+                template=template({"app": "web"}))), "default")
+        client.create("horizontalpodautoscalers",
+                      api.HorizontalPodAutoscaler(
+                          metadata=api.ObjectMeta(name="web-hpa",
+                                                  namespace="default"),
+                          spec=api.HorizontalPodAutoscalerSpec(
+                              scale_ref=api.SubresourceReference(
+                                  kind="ReplicationController",
+                                  name="web", namespace="default"),
+                              min_replicas=1, max_replicas=10,
+                              cpu_utilization_target_percentage=100)),
+                      "default")
+
+    def _replicas(self, client):
+        return client.get("replicationcontrollers", "web",
+                          "default").spec.replicas
+
+    def test_metric_dip_does_not_flap(self, cluster):
+        """A one-reconcile dip inside the window must not shrink the
+        fleet; upscales stay immediate."""
+        from kubernetes_tpu.utils.clock import FakeClock
+        registry, client = cluster
+        clock = FakeClock()
+        utilization = {"value": 400.0}
+        self._cluster_with_hpa(client, utilization)
+        ctrl = HorizontalController(
+            client, lambda ns, sel: utilization["value"],
+            downscale_stabilization=60.0, clock=clock)
+        assert ctrl.reconcile_once() == 1   # 2 -> 8 (immediate upscale)
+        assert self._replicas(client) == 8
+        clock.step(5)
+        utilization["value"] = 25.0         # the flap dip
+        assert ctrl.reconcile_once() == 0   # damped: window max is 8
+        assert self._replicas(client) == 8
+        clock.step(5)
+        utilization["value"] = 100.0        # dip over, in tolerance
+        assert ctrl.reconcile_once() == 0
+        assert self._replicas(client) == 8
+
+    def test_sustained_rampdown_scales_after_window(self, cluster):
+        """Low metric held past the window IS a genuine ramp-down."""
+        from kubernetes_tpu.utils.clock import FakeClock
+        registry, client = cluster
+        clock = FakeClock()
+        utilization = {"value": 400.0}
+        self._cluster_with_hpa(client, utilization)
+        ctrl = HorizontalController(
+            client, lambda ns, sel: utilization["value"],
+            downscale_stabilization=60.0, clock=clock)
+        assert ctrl.reconcile_once() == 1
+        assert self._replicas(client) == 8
+        utilization["value"] = 25.0
+        for _ in range(5):                  # inside the window: held
+            clock.step(10)
+            ctrl.reconcile_once()
+            assert self._replicas(client) == 8
+        clock.step(15)                      # the 8-rec ages out (t>60)
+        assert ctrl.reconcile_once() == 1
+        assert self._replicas(client) == 2  # ceil(8 * 25/100)
+
+    def test_zero_window_keeps_legacy_behavior(self, cluster):
+        registry, client = cluster
+        utilization = {"value": 400.0}
+        self._cluster_with_hpa(client, utilization)
+        ctrl = HorizontalController(client,
+                                    lambda ns, sel: utilization["value"])
+        assert ctrl.reconcile_once() == 1
+        utilization["value"] = 25.0
+        assert ctrl.reconcile_once() == 1   # immediate downscale
+        assert self._replicas(client) == 2
+
+
 class TestHorizontalController:
     def test_scales_rc_by_utilization(self, cluster):
         registry, client = cluster

@@ -37,7 +37,10 @@ zero-duplicate-bindings / one-holder-per-term gates), null unless
 requested; r08 adds workload (the --workload-seed trace-replay soak:
 a compressed day of diurnal/burst/jobwave/rollout/churn traffic under
 5% API faults + a 10% node-kill plan, recording per-phase bind
-throughput and every SLO verdict), null unless requested.
+throughput and every SLO verdict), null unless requested; r09 adds
+lint (orchlint wall time over the tree and its verdict — recorded
+every round so the static-analysis pass stays inside its 5s tier-1
+budget as rules and tree both grow).
 """
 
 import argparse
@@ -294,6 +297,21 @@ def main():
                          "a 1k-node fleet (the slow gate's shape)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+
+    # orchlint wall time: the lint suite runs inside tier-1, so its
+    # cost is part of every build — record it each round and keep it
+    # under the 5s budget (it is ~1s at 155 files; a rule that regexes
+    # its way to 10s would silently tax every CI run otherwise)
+    from kubernetes_tpu.lint import run_lint
+    lint_report = run_lint()
+    lint_section = {
+        "ok": lint_report.ok,
+        "files": lint_report.files_scanned,
+        "known_sites": len(lint_report.violations),
+        "seconds": round(lint_report.seconds, 3),
+        "budget_s": 5.0,
+        "within_budget": lint_report.seconds < 5.0,
+    }
 
     from kubernetes_tpu.utils.platform import ensure_live_platform
     platform, probe = ensure_live_platform(attempts=args.probe_attempts)
@@ -594,6 +612,7 @@ def main():
         "durability": durability,
         "workload": workload,
         "multihost": multihost,
+        "lint": lint_section,
         "tpu": _tpu_section()}))
 
 

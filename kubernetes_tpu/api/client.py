@@ -686,7 +686,8 @@ class HttpClient(Client):
 
 
 def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 8,
-                         backoff_s: float = 0.5) -> None:
+                         backoff_s: float = 0.5,
+                         clock=None, rng=None) -> None:
     """The grace-0, uid-guarded delete that completes a graceful pod
     deletion from the node side (real kubelet, hollow kubelet, fleet).
     NotFound/Conflict are terminal — the pod is gone, or a same-name
@@ -695,11 +696,18 @@ def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 8,
     events and a dropped confirm would leave it Terminating forever.
     Exhaustion is loud: the pod will sit Terminating until something
     else (a fleet/kubelet restart's re-list) re-drives it, so the
-    operator must hear about it."""
+    operator must hear about it.
+
+    clock (utils/clock.Clock) and rng (random.Random) are injectable
+    for deterministic harnesses; the defaults are the real clock and
+    the process RNG."""
     import random as _random
-    import time as _time
 
     from ..core.errors import Conflict, NotFound
+    from ..utils.clock import REAL
+
+    clock = clock or REAL
+    rng = rng or _random
 
     def attempt() -> bool:
         try:
@@ -720,7 +728,7 @@ def confirm_pod_deletion(client: Client, pod: Any, attempts: int = 8,
         for _ in range(attempts - 1):
             # jittered: a fleet confirming thousands of pods against a
             # restarting apiserver must not replay them in one wave
-            _time.sleep(delay * (0.5 + _random.random()))
+            clock.sleep(delay * (0.5 + rng.random()))
             if attempt():
                 return
             delay = min(delay * 2, 5.0)

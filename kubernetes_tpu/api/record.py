@@ -28,6 +28,7 @@ from dataclasses import replace
 from typing import Callable, List, Optional, Tuple
 
 from ..core import types as api
+from ..core.errors import AlreadyExists
 from ..utils.clock import Clock, RealClock
 
 MAX_LRU_CACHE_ENTRIES = 4096  # events_cache.go:37
@@ -250,6 +251,8 @@ class EventBroadcaster:
                 if is_update and correlated.metadata.resource_version:
                     try:
                         written = sink.update(correlated)
+                    except AlreadyExists:
+                        raise  # let the outer replay guard settle it
                     except Exception:
                         # server copy expired (events have a TTL) or CAS
                         # conflict: fall back to create with a cleared
@@ -262,6 +265,12 @@ class EventBroadcaster:
                 else:
                     written = sink.create(correlated)
                 correlator.logger.update_state(written)
+                return
+            except AlreadyExists:
+                # event names are unique per occurrence, so the only
+                # way the name exists is that an earlier attempt's
+                # create committed and the response was lost — the
+                # event is recorded; replaying would duplicate it
                 return
             except Exception:
                 if attempt + 1 >= self.max_tries:

@@ -28,10 +28,10 @@ from __future__ import annotations
 
 import http.client
 import threading
-import time
 from typing import Callable, Optional
 
 from ..core.errors import ServiceUnavailable
+from ..utils.clock import REAL, Clock
 
 #: API status codes every verb may retry (see module docstring).
 RETRYABLE_CODES = (429, 503)
@@ -48,10 +48,12 @@ class CircuitBreaker:
     True and failures are not counted)."""
 
     def __init__(self, threshold: int = 5, probe_interval: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Optional[Clock] = None):
         self.threshold = threshold
         self.probe_interval = probe_interval
-        self.clock = clock
+        # all breaker timing is on Clock.monotonic(): probe pacing must
+        # not stretch or collapse under a wall-clock step
+        self.clock = clock or REAL
         self._lock = threading.Lock()
         self._failures = 0
         self._open = False
@@ -68,7 +70,8 @@ class CircuitBreaker:
             self._failures += 1
             if self._failures >= self.threshold and not self._open:
                 self._open = True
-                self._next_probe = self.clock()  # probe allowed at once
+                # probe allowed at once
+                self._next_probe = self.clock.monotonic()
 
     def record_success(self) -> None:
         with self._lock:
@@ -84,7 +87,7 @@ class CircuitBreaker:
         with self._lock:
             if not self._open:
                 return True
-            now = self.clock()
+            now = self.clock.monotonic()
             if now < self._next_probe:
                 return False
             self._next_probe = now + self.probe_interval
@@ -98,7 +101,14 @@ class RetryPolicy:
     """Jittered exponential backoff under a per-call deadline budget.
 
     seed: fix the jitter stream (chaos/determinism harnesses); None
-    draws from the process RNG. sleep/clock are injectable for tests.
+    draws from the process RNG.
+
+    clock: a utils/clock.Clock — deadline budgets and backoff pacing
+    run on its monotonic() axis, so a wall-clock step (NTP correction,
+    VM migration) can neither starve a call of its budget nor grant it
+    extra attempts, the same jump-immunity contract leader election
+    holds (tests/test_retry.py pins it with FakeClock.jump_wall).
+    sleep: overrides clock.sleep (tests that only count delays).
     """
 
     def __init__(self, max_attempts: int = 4,
@@ -107,7 +117,7 @@ class RetryPolicy:
                  breaker_threshold: int = 5,
                  breaker_probe_interval: float = 1.0,
                  seed=None, sleep: Optional[Callable] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Optional[Clock] = None):
         import random
         self.max_attempts = max(1, max_attempts)
         self.initial_backoff = initial_backoff
@@ -118,8 +128,8 @@ class RetryPolicy:
         self.breaker_probe_interval = breaker_probe_interval
         self._rng = random.Random(seed)
         self._rng_lock = threading.Lock()
-        self.sleep = sleep or time.sleep
-        self.clock = clock
+        self.clock = clock or REAL
+        self.sleep = sleep or self.clock.sleep
 
     @classmethod
     def disabled(cls) -> "RetryPolicy":
@@ -148,7 +158,7 @@ class RetryPolicy:
         status failures and a CONNECTION_ERRORS member for transport
         failures; anything else propagates unretried."""
         from ..core.errors import ApiError
-        deadline = (self.clock() + self.deadline
+        deadline = (self.clock.monotonic() + self.deadline
                     if self.deadline else None)
         attempt = 0
         while True:
@@ -169,7 +179,7 @@ class RetryPolicy:
                 delay = self._delay(attempt,
                                     getattr(e, "retry_after", None))
                 if deadline is not None \
-                        and self.clock() + delay > deadline:
+                        and self.clock.monotonic() + delay > deadline:
                     raise
                 self.sleep(delay)
             except CONNECTION_ERRORS:
@@ -179,7 +189,7 @@ class RetryPolicy:
                     raise
                 delay = self._delay(attempt, None)
                 if deadline is not None \
-                        and self.clock() + delay > deadline:
+                        and self.clock.monotonic() + delay > deadline:
                     raise
                 self.sleep(delay)
             else:

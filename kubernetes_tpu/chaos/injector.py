@@ -23,13 +23,13 @@ from __future__ import annotations
 
 import random
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..api.client import Client
 from ..core.errors import ServiceUnavailable, TooManyRequests
 from ..core.watch import Event, Watcher
+from ..utils.clock import REAL, Clock
 
 #: the injectable verb streams; batch/columnar variants draw from their
 #: base verb's stream so a workload's fault schedule doesn't depend on
@@ -141,9 +141,13 @@ class ChaosClient(Client):
     """Wrap any Client with the plan's fault streams. Thread-safe; all
     non-verb capabilities delegate untouched."""
 
-    def __init__(self, inner: Client, plan: FaultPlan):
+    def __init__(self, inner: Client, plan: FaultPlan,
+                 clock: Optional[Clock] = None):
         self.inner = inner
         self.plan = plan
+        # injected latency sleeps ride the clock so a FakeClock harness
+        # can compress a latency-heavy plan without wall time passing
+        self.clock = clock or REAL
         self._lock = threading.Lock()
         self._streams = {v: plan.stream(v) for v in VERBS}
         self._trace: Dict[str, List[Optional[str]]] = {v: [] for v in VERBS}
@@ -174,7 +178,7 @@ class ChaosClient(Client):
             fault, delay = self.plan.draw(rng, self.plan.rate_for(verb))
             self._trace[verb].append(fault)
         if delay > 0:
-            time.sleep(delay)
+            self.clock.sleep(delay)
         if fault == _FAULT_429:
             err = TooManyRequests("chaos: injected 429 burst")
             err.retry_after = self.plan.retry_after

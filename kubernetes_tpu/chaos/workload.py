@@ -45,6 +45,8 @@ import random
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.clock import REAL, Clock
+
 #: generator evaluation order inside one tick (ties in the merged
 #: stream break by this order, deterministically)
 GENERATORS = ("diurnal", "burst", "jobwave", "rollout", "churn")
@@ -247,10 +249,12 @@ class WorkloadChaos:
     source."""
 
     def __init__(self, client, plan: WorkloadPlan,
-                 namespace: str = "default"):
+                 namespace: str = "default",
+                 clock: Optional[Clock] = None):
         self.client = client
         self.plan = plan
         self.namespace = namespace
+        self.clock = clock or REAL
         self.demand = plan.diurnal_base  # pre-replay demand floor
         self._by_tick: Dict[int, List[WorkloadEvent]] = {}
         for ev in plan.events():
@@ -276,18 +280,18 @@ class WorkloadChaos:
     def apply_tick(self, tick: int, deadline: float) -> List[WorkloadEvent]:
         """Apply every event of one tick, in merged-stream order. Each
         apply retries through injected faults until it lands or the
-        deadline passes (an event that never lands leaves the trace
-        short, which the schedule-replay gate then correctly fails)."""
-        import time as _time
+        deadline (on this applier's clock.monotonic() axis) passes —
+        an event that never lands leaves the trace short, which the
+        schedule-replay gate then correctly fails."""
         applied = []
         for ev in self._by_tick.get(tick, ()):
             while True:
                 try:
                     self._apply(ev)
                 except Exception:
-                    if _time.time() > deadline:
+                    if self.clock.monotonic() > deadline:
                         return applied
-                    _time.sleep(0.02)
+                    self.clock.sleep(0.02)
                     continue
                 self._trace[ev.generator].append(ev)
                 applied.append(ev)

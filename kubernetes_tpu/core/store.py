@@ -49,11 +49,11 @@ from __future__ import annotations
 import fnmatch
 import heapq
 import threading
-import time
 from collections import OrderedDict, deque
 from dataclasses import replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..utils.clock import REAL, Clock
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
 from .types import fast_replace
@@ -69,7 +69,13 @@ class Store:
                  wal_dir: Optional[str] = None,
                  fsync_policy: str = "batch",
                  wal_segment_records: int = 10_000,
-                 wal_snapshot_records: int = 50_000):
+                 wal_snapshot_records: int = 50_000,
+                 clock: Optional[Clock] = None):
+        # TTL deadlines are wall-clock (they stamp API objects and ride
+        # the WAL as absolute expiries); the clock is injectable so
+        # expiry behavior is testable without sleeping and so the lint
+        # determinism rule has a sanctioned time source to point at
+        self._clock = clock or REAL
         # the LEDGER lock: guards _rev/_data/_seg_keys/_history/list
         # caches — and nothing else. Watch fan-out runs outside it.
         self._lock = threading.RLock()
@@ -455,7 +461,7 @@ class Store:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
         if not self._expiry_heap:
             return
-        now = time.time() if now is None else now
+        now = self._clock.now() if now is None else now
         while self._expiry_heap and self._expiry_heap[0][0] <= now:
             expiry, k = heapq.heappop(self._expiry_heap)
             entry = self._data.get(k)
@@ -480,7 +486,7 @@ class Store:
                                         name=key.rsplit("/", 1)[-1])
                 rev = self._bump()
                 obj = _with_rv(obj, rev)
-                expiry = time.time() + ttl if ttl else None
+                expiry = self._clock.now() + ttl if ttl else None
                 self._data[key] = (obj, rev, expiry)
                 self._index_add(key)
                 if expiry is not None:
@@ -513,7 +519,7 @@ class Store:
         try:
             with self._lock:
                 self._gc_expired()
-                now = time.time()
+                now = self._clock.now()
                 seen = set()
                 for key, _obj, _ttl in entries:
                     if key in self._data or key in seen:
@@ -555,7 +561,7 @@ class Store:
                 self._gc_expired()
                 rev = self._bump()
                 obj = _with_rv(obj, rev)
-                expiry = time.time() + ttl if ttl else None
+                expiry = self._clock.now() + ttl if ttl else None
                 prev = self._data.get(key)
                 self._data[key] = (obj, rev, expiry)
                 if prev is None:
@@ -624,7 +630,7 @@ class Store:
                     rev = self._bump()
                     new_obj = _with_rv(new_obj, rev)
                     if ttl is not None:
-                        expiry = time.time() + ttl
+                        expiry = self._clock.now() + ttl
                         heapq.heappush(self._expiry_heap, (expiry, key))
                         self._ttl_segs.add(self._seg(key))
                     self._data[key] = (new_obj, rev, expiry)
@@ -762,7 +768,7 @@ class Store:
         entry = self._data.get(key)
         if entry is None:
             raise NotFound(name=key)
-        if self._expired(entry, time.time()):
+        if self._expired(entry, self._clock.now()):
             # first-class expiry: the key's death is COMMITTED to the
             # ledger (revision, DELETED event, WAL record) the moment a
             # reader observes it, not deferred to the next write — so
@@ -798,7 +804,7 @@ class Store:
         reader observes it, not at the next unrelated write); the
         lock-free heap peek keeps the no-TTL hot path unchanged."""
         heap = self._expiry_heap
-        if heap and heap[0][0] <= time.time():
+        if heap and heap[0][0] <= self._clock.now():
             self._reap_expired()
         with self._lock:
             cacheable = (predicate is None and prefix.count("/") >= 3
@@ -808,7 +814,7 @@ class Store:
                 if cached is not None:
                     # copy: callers filter/mutate their result lists
                     return list(cached), self._rev
-            now = time.time()
+            now = self._clock.now()
             # iterate only the prefix's resource segment (the key
             # index): a nodes LIST must not walk 150k pod keys. The
             # index is sound only for resource-or-deeper /registry/

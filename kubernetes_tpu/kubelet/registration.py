@@ -33,8 +33,14 @@ class NodeRegistration:
                  heartbeat_interval: float = 10.0,
                  labels: Optional[Dict[str, str]] = None,
                  kubelet_version: str = "v1.1.0-tpu",
-                 runtime_version: str = "proc://1"):
+                 runtime_version: str = "proc://1",
+                 jitter_rng: Optional[random.Random] = None):
+        """jitter_rng: the heartbeat-phase RNG — pass a seeded
+        random.Random to make the beat schedule reproducible (the
+        deterministic-harness contract); None keeps the process RNG
+        (real kubelets should NOT share a phase)."""
         self.client = client
+        self._jitter_rng = jitter_rng
         self.node_name = node_name
         self.capacity = capacity
         self.allocatable = allocatable or capacity
@@ -110,7 +116,7 @@ class NodeRegistration:
         # exactly `heartbeat_interval` heartbeats in lockstep waves —
         # every wave invalidates every cached node encoding at once and
         # the controller's grace window sees synchronized staleness.
-        rng = random.Random()
+        rng = self._jitter_rng or random.Random()
         while not self._stop.is_set():
             self._stop.wait(self.heartbeat_interval * rng.uniform(0.5, 1.5))
             if self._stop.is_set():

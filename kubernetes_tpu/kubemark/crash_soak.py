@@ -46,8 +46,10 @@ from ..chaos import ChaosClient, CrashChaos, CrashPlan, FaultPlan
 from ..controllers.manager import ControllerManager
 from ..core import types as api
 from ..core.store import Store
+from ..core.errors import AlreadyExists
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
+from ..utils.clock import REAL, Clock
 from ..utils.leaderelection import LeaderElectionConfig, LeaderElector
 from ..utils.metrics import global_metrics
 from .benchmark import _bench_pod
@@ -101,7 +103,8 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                    renew_deadline: float = 1.0,
                    retry_period: float = 0.15,
                    heartbeat_interval: float = 1.0,
-                   post_kill_scale: Optional[int] = None
+                   post_kill_scale: Optional[int] = None,
+                   clock: Optional[Clock] = None
                    ) -> CrashSoakResult:
     """One seeded crash soak; see the module docstring for the
     scenario. Lease timings default to soak-compressed values (the
@@ -114,6 +117,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
     bind, so convergence structurally proves both failovers (and the
     lease takeovers advance each fencing term past the killed
     leader's)."""
+    clock = clock or REAL
     own_tmp = wal_dir is None
     wal_dir = wal_dir or tempfile.mkdtemp(prefix="kube-wal-")
     base = {name: global_metrics.counter_sum(name)
@@ -147,7 +151,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                                    label_selector="app=crash")
                 leases, _ = reg.list("leases", "kube-system")
             except Exception:
-                time.sleep(0.03)
+                clock.sleep(0.03)
                 continue
             with lock:
                 for p in pods:
@@ -163,7 +167,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                         term_holders.setdefault(
                             (l.metadata.name, l.spec.lease_transitions),
                             set()).add(l.spec.holder_identity)
-            time.sleep(0.03)
+            clock.sleep(0.03)
 
     tracker = threading.Thread(target=track, daemon=True,
                                name="crash-soak-tracker")
@@ -181,7 +185,8 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
             retry_period=retry_period)
 
     fleet = HollowFleet(chaos, n_nodes,
-                        heartbeat_interval=heartbeat_interval).run()
+                        heartbeat_interval=heartbeat_interval,
+                        jitter_seed=seed).run()
     factories = {k: ConfigFactory(chaos, rate_limit=False).start()
                  for k in ("a", "b")}
     scheds = {k: BatchScheduler(
@@ -194,10 +199,10 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
         for k in ("a", "b")}
 
     def wait_until(cond, deadline):
-        while time.time() < deadline:
+        while clock.monotonic() < deadline:
             if cond():
                 return True
-            time.sleep(0.05)
+            clock.sleep(0.05)
         return cond()
 
     def active(pair):
@@ -207,7 +212,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
         return None, None
 
     try:
-        deadline = time.time() + timeout
+        deadline = clock.monotonic() + timeout
         if not wait_until(
                 lambda: len(factories["a"].node_lister.list()) >= n_nodes,
                 deadline):
@@ -225,11 +230,13 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
             try:
                 chaos.create("replicationcontrollers", rc)
                 break
+            except AlreadyExists:
+                break  # a replayed create already committed the RC
             except Exception:
-                if time.time() > deadline:
+                if clock.monotonic() > deadline:
                     result.detail = "rc create never landed"
                     return result
-                time.sleep(0.05)
+                clock.sleep(0.05)
 
         # ---- apply the crash schedule as progress crosses each point
         for point, target in crash.pending():
@@ -244,10 +251,10 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                 store.wal_close()
                 pre_rev = store.current_revision
                 pre_live = {k: v[1] for k, v in store._data.items()
-                            if not store._expired(v, time.time())}
+                            if not store._expired(v, clock.now())}
                 recovered = Store.recover(wal_dir,
                                           fsync_policy=fsync_policy)
-                now = time.time()
+                now = clock.now()
                 rec_live = {k: v[1] for k, v in recovered._data.items()
                             if not recovered._expired(v, now)}
                 result.recovery = {
@@ -284,7 +291,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
         result.schedule_replayed = (
             result.killed == crash_plan.schedule(replicas)
             == result.schedule)
-        t_kill = time.time()
+        t_kill = clock.monotonic()
 
         # the failover-proof wave: these pods do not exist yet, so the
         # DEAD controller-manager cannot have created them nor the dead
@@ -302,10 +309,10 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                                    sc, "default")
                 break
             except Exception:
-                if time.time() > deadline:
+                if clock.monotonic() > deadline:
                     result.detail = "post-kill scale-up never landed"
                     return result
-                time.sleep(0.05)
+                clock.sleep(0.05)
 
         def converged():
             reg = ctx["registry"]
@@ -321,7 +328,7 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                     and all(p.status.phase == "Running" for p in live))
 
         ok = wait_until(converged, deadline)
-        result.converge_s = round(time.time() - t_kill, 3)
+        result.converge_s = round(clock.monotonic() - t_kill, 3)
         result.converged = ok
         with lock:
             result.duplicate_bindings = list(duplicates)

@@ -35,9 +35,14 @@ class HollowFleet:
     def __init__(self, client, n_nodes: int, name_prefix: str = "hollow-",
                  cpu: str = "4", memory: str = "32Gi", max_pods: int = 40,
                  heartbeat_interval: float = 10.0,
-                 labels_for=None):
-        """labels_for: optional fn(index) -> labels dict (zones etc.)."""
+                 labels_for=None, jitter_seed: Optional[int] = None):
+        """labels_for: optional fn(index) -> labels dict (zones etc.).
+        jitter_seed: seeds the heartbeat-phase RNG so a chaos/soak
+        harness's beat schedule is reproducible; None keeps the
+        process RNG (beats must decohere, not share a phase)."""
         self.client = client
+        self._jitter_rng = (random.Random(f"{jitter_seed}:heartbeat")
+                            if jitter_seed is not None else random.Random())
         self.n_nodes = n_nodes
         self.name_prefix = name_prefix
         self.cpu = cpu
@@ -129,6 +134,8 @@ class HollowFleet:
                 # next beat retries the heal
                 try:
                     self.client.create("nodes", self._node_object(i))
+                except AlreadyExists:
+                    pass  # the heal (or a replayed create) landed
                 except Exception:
                     pass
                 return
@@ -155,7 +162,7 @@ class HollowFleet:
         shards = 10
         tick = self.heartbeat_interval / shards
         shard = 0
-        rng = random.Random()
+        rng = self._jitter_rng
         while not self._stop.is_set():
             self._stop.wait(tick * rng.uniform(0.5, 1.5))
             if self._stop.is_set():

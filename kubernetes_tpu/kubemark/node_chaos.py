@@ -33,6 +33,8 @@ from ..chaos import ChaosClient, FaultPlan, NodeChaos, NodeFaultPlan
 from ..controllers.node import NodeController
 from ..controllers.replication import ReplicationManager
 from ..core import types as api
+from ..core.errors import AlreadyExists
+from ..utils.clock import REAL, Clock
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
 from .benchmark import _bench_pod
@@ -75,18 +77,21 @@ def run_node_kill_soak(n_nodes: int = 40, replicas: int = 30,
                        monitor_period: float = 0.1,
                        monitor_grace_period: float = 1.5,
                        pod_eviction_timeout: float = 0.3,
-                       registry: Optional[Registry] = None
+                       registry: Optional[Registry] = None,
+                       clock: Optional[Clock] = None
                        ) -> NodeKillResult:
     """One seeded node-kill soak; see the module docstring for the
     scenario. Timing knobs default to soak-compressed values (the
     production defaults would make recovery a 5+ minute wait)."""
+    clock = clock or REAL
     registry = registry or Registry()
     plan = FaultPlan(seed=seed, error_rate=fault_rate)
     client = ChaosClient(InProcClient(registry), plan)
     node_plan = NodeFaultPlan(seed=seed, kill_fraction=kill_fraction)
 
     fleet = HollowFleet(client, n_nodes,
-                        heartbeat_interval=heartbeat_interval).run()
+                        heartbeat_interval=heartbeat_interval,
+                        jitter_seed=seed).run()
     factory = ConfigFactory(client, rate_limit=False).start()
     sched = BatchScheduler(factory.create_batch()).run()
     rc_mgr = ReplicationManager(client).run()
@@ -113,14 +118,14 @@ def run_node_kill_soak(n_nodes: int = 40, replicas: int = 30,
     factory.scheduled_observers.append(count_rebind)
 
     def wait_until(cond, deadline):
-        while time.time() < deadline:
+        while clock.monotonic() < deadline:
             if cond():
                 return True
-            time.sleep(0.05)
+            clock.sleep(0.05)
         return cond()
 
     try:
-        deadline = time.time() + timeout
+        deadline = clock.monotonic() + timeout
         if not wait_until(
                 lambda: len(factory.node_lister.list()) >= n_nodes,
                 deadline):
@@ -134,16 +139,18 @@ def run_node_kill_soak(n_nodes: int = 40, replicas: int = 30,
                 template=api.PodTemplateSpec(
                     metadata=api.ObjectMeta(labels={"app": "nodekill"}),
                     spec=_bench_pod(0).spec)))
-        t0 = time.time()
+        t0 = clock.monotonic()
         while True:  # RC creation rides the fault injector too
             try:
                 client.create("replicationcontrollers", rc)
                 break
+            except AlreadyExists:
+                break  # a replayed create already committed the RC
             except Exception:
-                if time.time() > deadline:
+                if clock.monotonic() > deadline:
                     result.detail = "rc create never landed"
                     return result
-                time.sleep(0.05)
+                clock.sleep(0.05)
 
         def live_pods():
             pods, _ = registry.list("pods", "default",
@@ -159,10 +166,10 @@ def run_node_kill_soak(n_nodes: int = 40, replicas: int = 30,
             result.detail = "never reached half-bound before kill"
             return result
 
-        result.kill_at_s = round(time.time() - t0, 3)
+        result.kill_at_s = round(clock.monotonic() - t0, 3)
         post_kill["armed"] = True
         killed = chaos_nodes.kill()
-        t_kill = time.time()
+        t_kill = clock.monotonic()
         result.killed = killed
         result.schedule_replayed = (
             killed == node_plan.kill_set(fleet.node_names())
@@ -182,7 +189,7 @@ def run_node_kill_soak(n_nodes: int = 40, replicas: int = 30,
             return not any(p.spec.node_name in dead for p in all_pods)
 
         ok = wait_until(converged, deadline)
-        result.converge_s = round(time.time() - t_kill, 3)
+        result.converge_s = round(clock.monotonic() - t_kill, 3)
         result.converged = ok
         result.evictions = node_ctl.evictions_total
         result.partition_halts = node_ctl.partition_halts_total
@@ -212,16 +219,19 @@ def run_partition_gate(n_nodes: int = 20, freeze_fraction: float = 0.6,
                        heartbeat_interval: float = 0.3,
                        monitor_period: float = 0.1,
                        monitor_grace_period: float = 1.0,
-                       pod_eviction_timeout: float = 0.2) -> Dict:
+                       pod_eviction_timeout: float = 0.2,
+                       clock: Optional[Clock] = None) -> Dict:
     """The partition safety-valve acceptance: freeze the heartbeats of
     > unhealthy_threshold of the fleet at once -> the NodeController
     must HALT evictions (zero pods deleted while halted), then resume
     after the heartbeats thaw. Returns the observations the test (and
     anyone replaying the README workflow) asserts on."""
+    clock = clock or REAL
     registry = Registry()
     client = InProcClient(registry)
     fleet = HollowFleet(client, n_nodes,
-                        heartbeat_interval=heartbeat_interval).run()
+                        heartbeat_interval=heartbeat_interval,
+                        jitter_seed=seed).run()
     node_ctl = NodeController(
         client, monitor_period=monitor_period,
         monitor_grace_period=monitor_grace_period,
@@ -233,11 +243,11 @@ def run_partition_gate(n_nodes: int = 20, freeze_fraction: float = 0.6,
            "resumed": False, "halts": 0, "frozen": []}
 
     def wait_until(cond, t):
-        deadline = time.time() + t
-        while time.time() < deadline:
+        deadline = clock.monotonic() + t
+        while clock.monotonic() < deadline:
             if cond():
                 return True
-            time.sleep(0.05)
+            clock.sleep(0.05)
         return cond()
 
     try:
@@ -257,7 +267,7 @@ def run_partition_gate(n_nodes: int = 20, freeze_fraction: float = 0.6,
         out["halted"] = halted
         # hold the partition well past grace + eviction timeout: zero
         # evictions may be issued while the valve is engaged
-        time.sleep(3 * (monitor_grace_period + pod_eviction_timeout))
+        clock.sleep(3 * (monitor_grace_period + pod_eviction_timeout))
         out["evictions_while_halted"] = node_ctl.evictions_total
         chaos_nodes.thaw()
         out["resumed"] = wait_until(

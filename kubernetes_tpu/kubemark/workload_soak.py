@@ -75,6 +75,7 @@ from ..core import types as api
 from ..core.quantity import parse_quantity
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
+from ..utils.clock import REAL, Clock
 from ..utils.metrics import MetricsRegistry, global_metrics
 from .fleet import HollowFleet
 
@@ -219,10 +220,12 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                       monitor_period: float = 0.1,
                       monitor_grace_period: float = 1.5,
                       pod_eviction_timeout: float = 0.3,
-                      registry: Optional[Registry] = None
+                      registry: Optional[Registry] = None,
+                      clock: Optional[Clock] = None
                       ) -> WorkloadSoakResult:
     """One seeded trace replay; see the module docstring for the
     scenario. Timing knobs default to soak-compressed values."""
+    clock = clock or REAL
     plan = plan or WorkloadPlan(seed=seed)
     seed = plan.seed
     fault_plan = FaultPlan(seed=seed, error_rate=fault_rate)
@@ -250,7 +253,8 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
     # ---- the fleet, zoned for DaemonSet retargeting
     fleet = HollowFleet(
         chaos, n_nodes, heartbeat_interval=heartbeat_interval,
-        labels_for=lambda i: {"zone": f"z{i % plan.n_zones}"}).run()
+        labels_for=lambda i: {"zone": f"z{i % plan.n_zones}"},
+        jitter_seed=seed).run()
     factory = ConfigFactory(chaos, rate_limit=False).start()
     sched = BatchScheduler(factory.create_batch()).run()
     rc_mgr = ReplicationManager(chaos).run()
@@ -264,7 +268,7 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         pod_eviction_timeout=pod_eviction_timeout,
         eviction_qps=1000.0, eviction_burst=1000).run()
 
-    wl = WorkloadChaos(chaos, plan)
+    wl = WorkloadChaos(chaos, plan, clock=clock)
     node_chaos = NodeChaos(fleet, node_plan)
 
     # ---- HPA rides the shared demand signal: utilization is demand
@@ -353,10 +357,10 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         t.start()
 
     def wait_until(cond, deadline):
-        while time.time() < deadline:
+        while clock.monotonic() < deadline:
             if cond():
                 return True
-            time.sleep(0.05)
+            clock.sleep(0.05)
         return cond()
 
     def retry_api(fn, deadline):
@@ -364,12 +368,12 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
             try:
                 return fn()
             except Exception:
-                if time.time() > deadline:
+                if clock.monotonic() > deadline:
                     raise
-                time.sleep(0.05)
+                clock.sleep(0.05)
 
     try:
-        deadline = time.time() + timeout
+        deadline = clock.monotonic() + timeout
         if not wait_until(
                 lambda: len(factory.node_lister.list()) >= n_nodes,
                 deadline):

@@ -1,0 +1,669 @@
+"""orchlint: AST invariant lint for the orchestrator's own contracts.
+
+The reference tree leans on `go vet` and the race detector in CI; this
+port's equivalents are conventions — and conventions rot. Four invariant
+families are machine-checked here (stdlib `ast`, no dependencies), run
+as a tier-1 test so a violation fails the build:
+
+  determinism      inside `chaos/`, `kubemark/*_soak.py` and `sched/`,
+                   wall-clock reads (`time.time()`, `datetime.now()`)
+                   and unseeded process RNG (`random.random()`,
+                   `random.Random()`, `np.random.*`) are banned: one
+                   stray draw or wall read silently breaks the
+                   `trace() == schedule()` replay contract every chaos
+                   plan is built on. Time flows through
+                   `utils/clock.Clock`, randomness through per-
+                   `(seed, stream)` `random.Random` instances.
+  lock-discipline  in `core/store.py` / `core/wal.py`, code holding the
+                   ledger lock (`self._lock`) must not publish (watcher
+                   sends, `_drain_publish`/`_fanout`), sleep, do HTTP,
+                   or perform non-WAL blocking I/O — the two-phase
+                   stage/ledger/publish split is enforced lexically.
+                   Acquiring `_pub_lock` under the ledger lock is a
+                   statically-detected lock-order inversion (the
+                   sanctioned order is publish -> ledger, see
+                   `Store._watch_register`).
+  jax-hygiene      in `sched/device/`, host syncs (`.item()`,
+                   `float()`/`int()` casts, `np.asarray`) and Python
+                   branching on traced parameters are flagged inside
+                   jitted functions and `lax.scan` bodies — each one is
+                   a silent device->host round trip in the scan hot
+                   path.
+  api-idempotency  a retry loop around a bare POST (`create`/`bind`
+                   without an idempotency guard) outside `api/retry.py`
+                   is flagged: replaying an ambiguous POST duplicates
+                   objects; retries belong in `RetryPolicy`, which
+                   knows which verbs are safe.
+
+Pre-existing accepted sites live in `lint/baseline.toml` — explicit,
+counted, and with a reason each. A new violation is a hard error; so is
+baseline drift (a fixed violation whose allowance was not removed).
+
+Run: `python -m kubernetes_tpu.lint [--json]`; the tier-1 gate is
+tests/test_lint.py. The runtime complement (lock-order witness) is
+`lint/lockwitness.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .baseline import Baseline, load_baseline
+
+__all__ = [
+    "Violation", "LintReport", "run_lint", "lint_source", "lint_file",
+    "Baseline", "load_baseline", "RULES", "DEFAULT_BASELINE",
+]
+
+#: repo-relative path of the checked-in allowlist
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.toml")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One rule hit. `key()` is the baseline identity: it survives
+    line-number drift (edits above a site must not invalidate the
+    allowlist), so it is (file, rule, enclosing def, symbol) with an
+    occurrence COUNT carried by the baseline side."""
+
+    rule: str          # rule family, e.g. "determinism"
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    site: str          # dotted enclosing scope, e.g. "Store.create"
+    symbol: str        # machine tag, e.g. "time.time"
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.path, self.rule, self.site, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.site}: {self.message}")
+
+
+@dataclass
+class LintReport:
+    violations: List[Violation] = field(default_factory=list)
+    #: violations not covered by the baseline — hard errors
+    new: List[Violation] = field(default_factory=list)
+    #: baseline entries whose allowance exceeds what the tree still
+    #: contains — fixed violations that must be removed from the
+    #: baseline (drift is an error too, or the allowlist only grows)
+    stale: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+    seconds: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "seconds": round(self.seconds, 4),
+            "violations_total": len(self.violations),
+            "new": [v.__dict__ for v in self.new],
+            "stale_baseline": list(self.stale),
+        }
+
+
+# --------------------------------------------------------------- helpers
+
+def _import_table(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> fully-qualified module path, from this module's
+    imports — so `import time as _time; _time.time()` resolves to
+    `time.time` and a variable merely NAMED `random` does not."""
+    table: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                table[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                table[a.asname or a.name] = f"{node.module}.{a.name}"
+    # conventional scientific aliases resolve even without the import
+    # (fixture snippets in tests use them bare)
+    table.setdefault("np", "numpy")
+    table.setdefault("jnp", "jax.numpy")
+    return table
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _resolve(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Dotted name with its head rewritten through the import table."""
+    dotted = _dotted(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    full = imports.get(head)
+    if full is None:
+        return dotted
+    return f"{full}.{rest}" if rest else full
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains the dotted enclosing-scope name
+    (ClassDef/FunctionDef chain) so violations carry a stable site."""
+
+    def __init__(self, path: str, imports: Dict[str, str]):
+        self.path = path
+        self.imports = imports
+        self.scope: List[str] = []
+        self.out: List[Violation] = []
+
+    @property
+    def site(self) -> str:
+        return ".".join(self.scope) or "<module>"
+
+    def _push(self, name: str, node: ast.AST) -> None:
+        self.scope.append(name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._push(node.name, node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._push(node.name, node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._push(node.name, node)
+
+    def flag(self, rule: str, node: ast.AST, symbol: str,
+             message: str) -> None:
+        self.out.append(Violation(
+            rule=rule, path=self.path, line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0), site=self.site,
+            symbol=symbol, message=message))
+
+
+# ----------------------------------------------------- rule: determinism
+
+_WALL_CLOCK = {
+    "time.time": "wall-clock read",
+    "datetime.datetime.now": "wall-clock read",
+    "datetime.datetime.utcnow": "wall-clock read",
+    "datetime.datetime.today": "wall-clock read",
+    "datetime.date.today": "wall-clock read",
+}
+
+
+class _DeterminismVisitor(_ScopedVisitor):
+    RULE = "determinism"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _resolve(node.func, self.imports)
+        if name in _WALL_CLOCK:
+            self.flag(self.RULE, node, name,
+                      f"{name}() is a wall-clock read; seeded/replayed "
+                      f"code must take time from utils/clock.Clock "
+                      f"(monotonic() for deadlines, now() only for "
+                      f"API-object timestamps)")
+        elif name == "random.Random" and not node.args:
+            self.flag(self.RULE, node, "random.Random()",
+                      "unseeded random.Random() breaks trace()=="
+                      "schedule() replay; seed it from the plan's "
+                      "(seed, stream) contract")
+        elif name is not None and name.startswith("random.") \
+                and name != "random.Random":
+            self.flag(self.RULE, node, name,
+                      f"{name}() draws from the shared process RNG; "
+                      f"all randomness here must come from a per-"
+                      f"(seed, stream) random.Random instance")
+        elif name is not None and name.startswith("numpy.random.") \
+                and not (name == "numpy.random.default_rng"
+                         and node.args):
+            self.flag(self.RULE, node, name,
+                      f"{name}() uses numpy's global (or unseeded) "
+                      f"RNG; use numpy.random.default_rng(seed)")
+        self.generic_visit(node)
+
+
+def check_determinism(tree: ast.AST, path: str) -> List[Violation]:
+    v = _DeterminismVisitor(path, _import_table(tree))
+    v.visit(tree)
+    return v.out
+
+
+# -------------------------------------------------- rule: lock-discipline
+
+#: attribute names of the two store locks (on self)
+_LEDGER_LOCK = "self._lock"
+_PUB_LOCK = "self._pub_lock"
+
+#: blocking-I/O call heads banned under either lock (the WAL is the
+#: one sanctioned writer under the ledger lock: any `self._wal*`
+#: receiver or method is exempt)
+_BLOCKING_HEADS = ("urllib", "http", "requests", "socket")
+_BLOCKING_CALLS = {"open", "os.fsync", "os.replace", "os.unlink",
+                   "os.makedirs", "json.dump", "json.load",
+                   "time.sleep"}
+_WATCHER_METHODS = {"send", "send_many"}
+_PUBLISH_METHODS = {"self._drain_publish", "self._fanout"}
+
+
+class _LockDisciplineVisitor(_ScopedVisitor):
+    RULE = "lock-discipline"
+
+    def __init__(self, path: str, imports: Dict[str, str]):
+        super().__init__(path, imports)
+        self.held: List[str] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            name = _dotted(item.context_expr)
+            if name in (_LEDGER_LOCK, _PUB_LOCK):
+                if name == _PUB_LOCK and _LEDGER_LOCK in self.held:
+                    self.flag(self.RULE, node, "lock-order-inversion",
+                              "acquiring _pub_lock while holding the "
+                              "ledger lock inverts the sanctioned "
+                              "publish->ledger order "
+                              "(Store._watch_register) and can "
+                              "deadlock against it")
+                acquired.append(name)
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(acquired):]
+
+    def _is_wal_exempt(self, name: Optional[str]) -> bool:
+        return name is not None and (name.startswith("self._wal")
+                                     or ".__wal" in name)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            name = _dotted(node.func)
+            resolved = _resolve(node.func, self.imports)
+            ledger = _LEDGER_LOCK in self.held
+            if not self._is_wal_exempt(name):
+                method = (node.func.attr
+                          if isinstance(node.func, ast.Attribute)
+                          else None)
+                head = (resolved or "").partition(".")[0]
+                if ledger and name in _PUBLISH_METHODS:
+                    self.flag(self.RULE, node, "publish-under-ledger-lock",
+                              f"{name}() runs the publish phase while "
+                              f"the ledger lock is held; publish must "
+                              f"run after release (two-phase commit)")
+                elif ledger and method in _WATCHER_METHODS:
+                    self.flag(self.RULE, node,
+                              "watcher-callback-under-ledger-lock",
+                              f".{method}() is a watcher callback; "
+                              f"fan-out must not run under the ledger "
+                              f"lock")
+                elif head in _BLOCKING_HEADS:
+                    self.flag(self.RULE, node, "http-under-lock",
+                              f"{resolved}() does network I/O while "
+                              f"holding a store lock")
+                elif resolved in _BLOCKING_CALLS:
+                    self.flag(self.RULE, node, "blocking-io-under-lock",
+                              f"{resolved}() is blocking I/O under a "
+                              f"store lock; only the WAL may block "
+                              f"the ledger")
+                elif method == "sleep":
+                    self.flag(self.RULE, node, "blocking-io-under-lock",
+                              f"{name}() sleeps while holding a store "
+                              f"lock")
+        self.generic_visit(node)
+
+
+def check_lock_discipline(tree: ast.AST, path: str) -> List[Violation]:
+    v = _LockDisciplineVisitor(path, _import_table(tree))
+    v.visit(tree)
+    return v.out
+
+
+# ------------------------------------------------------ rule: jax-hygiene
+
+def _jit_decorated(node: ast.FunctionDef, imports: Dict[str, str]) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _resolve(target, imports)
+        if name in ("jax.jit", "jax.pmap"):
+            return True
+        if name in ("functools.partial", "partial") \
+                and isinstance(dec, ast.Call) and dec.args:
+            inner = _resolve(dec.args[0], imports)
+            if inner in ("jax.jit", "jax.pmap"):
+                return True
+    return False
+
+
+def _scan_body_names(tree: ast.AST, imports: Dict[str, str]) -> set:
+    """Names of locally-defined functions passed as the body of
+    jax.lax.scan / jax.lax.fori_loop / jax.lax.while_loop — traced
+    regions even without a @jit decorator."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _resolve(node.func, imports)
+            if name in ("jax.lax.scan", "jax.lax.fori_loop",
+                        "jax.lax.while_loop", "jax.lax.map"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        names.add(arg.id)
+    return names
+
+
+class _TracedRegionVisitor(_ScopedVisitor):
+    """Checks ONE traced function body (params are traced values)."""
+
+    RULE = "jax-hygiene"
+
+    def __init__(self, path: str, imports: Dict[str, str],
+                 scope: List[str], params: set):
+        super().__init__(path, imports)
+        self.scope = list(scope)
+        self.params = params
+
+    def _mentions_param(self, node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in self.params
+                   for n in ast.walk(node))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        resolved = _resolve(node.func, self.imports)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item":
+            self.flag(self.RULE, node, "host-sync-item",
+                      ".item() inside a traced region forces a "
+                      "device->host sync per call")
+        elif resolved in ("float", "int", "bool") and node.args \
+                and not isinstance(node.args[0], ast.Constant):
+            self.flag(self.RULE, node, f"host-sync-{resolved}",
+                      f"{resolved}() on a traced value concretizes it "
+                      f"on host; use jnp casts/astype")
+        elif resolved is not None and (resolved.startswith("numpy.")):
+            self.flag(self.RULE, node, resolved,
+                      f"{resolved}() inside a traced region pulls the "
+                      f"array to host; keep the hot path on device "
+                      f"(jnp equivalents)")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        if self._mentions_param(node.test):
+            self.flag(self.RULE, node, "python-branch-on-traced",
+                      "Python `if` on a traced value fails (or "
+                      "silently specializes) under jit; use jnp.where "
+                      "/ lax.cond")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._mentions_param(node.test):
+            self.flag(self.RULE, node, "python-branch-on-traced",
+                      "Python `while` on a traced value cannot trace; "
+                      "use lax.while_loop")
+        self.generic_visit(node)
+
+
+def check_jax_hygiene(tree: ast.AST, path: str) -> List[Violation]:
+    imports = _import_table(tree)
+    scan_bodies = _scan_body_names(tree, imports)
+    out: List[Violation] = []
+
+    def walk(node: ast.AST, scope: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced = (_jit_decorated(child, imports)
+                          or child.name in scan_bodies)
+                if traced:
+                    params = {a.arg for a in child.args.args
+                              + child.args.posonlyargs
+                              + child.args.kwonlyargs}
+                    params.discard("self")
+                    v = _TracedRegionVisitor(
+                        path, imports, scope + [child.name], params)
+                    for stmt in child.body:
+                        v.visit(stmt)
+                    out.extend(v.out)
+                else:
+                    walk(child, scope + [child.name])
+            elif isinstance(child, ast.ClassDef):
+                walk(child, scope + [child.name])
+            else:
+                walk(child, scope)
+
+    walk(tree, [])
+    return out
+
+
+# -------------------------------------------- rule: api-idempotency
+
+_POST_METHODS = {"create", "create_batch", "create_from_template",
+                 "bind", "bind_batch", "bind_batch_hosts"}
+
+
+#: exception types whose explicit handling makes a POST replay
+#: name-guarded: a re-sent create of the same name collapses on
+#: AlreadyExists/Conflict instead of committing a duplicate
+_REPLAY_GUARDS = {"AlreadyExists", "Conflict"}
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _IdempotencyVisitor(_ScopedVisitor):
+    """A loop that try/excepts a bare POST verb and swallows the error
+    is a client-side replay of a non-idempotent request: an ambiguous
+    connection loss (request committed, response lost) duplicates the
+    object. Retries belong in api/retry.py (which never replays a bare
+    POST on ambiguity) — or the handler must catch
+    AlreadyExists/Conflict, proving the create is name-guarded so a
+    replay collapses instead of duplicating.
+
+    A `for` loop whose POST arguments derive from the iteration
+    variable is iteration, not retry (each pass posts a DIFFERENT
+    object) and is not flagged."""
+
+    RULE = "api-idempotency"
+
+    def _post_calls_in(self, node: ast.AST):
+        """POST-verb calls under `node`, NOT descending into nested
+        Trys that carry their own replay guard (the guarded inner try
+        answers for its calls)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Try) \
+                    and any(self._guarded(h) for h in child.handlers):
+                continue
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr in _POST_METHODS:
+                receiver = _dotted(child.func.value) or ""
+                # server-side registry/store writes are in-process
+                # commits, not wire POSTs
+                if receiver.split(".")[-1] not in ("registry", "store"):
+                    yield child
+            yield from self._post_calls_in(child)
+
+    @staticmethod
+    def _per_iteration(loop: ast.AST, call: ast.Call) -> bool:
+        """True when the call's arguments depend on the loop targets —
+        directly, through in-loop assignments, or through nested loop
+        targets iterating over tainted values."""
+        if not isinstance(loop, ast.For):
+            return False
+        tainted = _names_in(loop.target)
+        for _ in range(8):  # taint to a fixpoint (chains are short)
+            grown = set(tainted)
+            for n in ast.walk(loop):
+                if isinstance(n, ast.Assign) \
+                        and _names_in(n.value) & grown:
+                    for t in n.targets:
+                        grown |= _names_in(t)
+                elif isinstance(n, ast.For) and n is not loop \
+                        and _names_in(n.iter) & grown:
+                    grown |= _names_in(n.target)
+            if grown == tainted:
+                break
+            tainted = grown
+        args = set()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            args |= _names_in(a)
+        return bool(args & tainted)
+
+    @staticmethod
+    def _guarded(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return False
+        types = (handler.type.elts
+                 if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        for t in types:
+            name = _dotted(t) or ""
+            if name.split(".")[-1] in _REPLAY_GUARDS:
+                return True
+        return False
+
+    def _loop(self, node) -> None:
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Try):
+                continue
+            if any(self._guarded(h) for h in child.handlers):
+                continue  # name-guarded: replay collapses
+            swallows = any(not any(isinstance(x, ast.Raise)
+                                   for x in ast.walk(h))
+                           for h in child.handlers)
+            if not swallows:
+                continue
+            for call in self._post_calls_in(child):
+                if self._per_iteration(node, call):
+                    continue
+                self.flag(self.RULE, call, "bare-post-retry-loop",
+                          f".{call.func.attr}() retried in a loop with "
+                          f"a swallowing except: an ambiguous failure "
+                          f"replays a non-idempotent POST (duplicate "
+                          f"objects); route it through RetryPolicy or "
+                          f"catch AlreadyExists/Conflict as the replay "
+                          f"guard")
+        self.generic_visit(node)
+
+    visit_For = _loop
+    visit_While = _loop
+
+
+def check_api_idempotency(tree: ast.AST, path: str) -> List[Violation]:
+    v = _IdempotencyVisitor(path, _import_table(tree))
+    v.visit(tree)
+    return v.out
+
+
+# ----------------------------------------------------------- the runner
+
+def _soak_file(name: str) -> bool:
+    return name.endswith("_soak.py")
+
+
+def _rule_applies(rule: str, path: str) -> bool:
+    """Scope map — paths are repo-relative posix."""
+    if rule == "determinism":
+        return (path.startswith("kubernetes_tpu/chaos/")
+                or path.startswith("kubernetes_tpu/sched/")
+                or (path.startswith("kubernetes_tpu/kubemark/")
+                    and _soak_file(path.rsplit("/", 1)[-1])))
+    if rule == "lock-discipline":
+        return path in ("kubernetes_tpu/core/store.py",
+                        "kubernetes_tpu/core/wal.py")
+    if rule == "jax-hygiene":
+        return path.startswith("kubernetes_tpu/sched/device/")
+    if rule == "api-idempotency":
+        return (path.startswith("kubernetes_tpu/")
+                and path != "kubernetes_tpu/api/retry.py")
+    raise ValueError(f"unknown rule {rule!r}")
+
+
+RULES = {
+    "determinism": check_determinism,
+    "lock-discipline": check_lock_discipline,
+    "jax-hygiene": check_jax_hygiene,
+    "api-idempotency": check_api_idempotency,
+}
+
+
+def lint_source(src: str, path: str,
+                rules: Optional[List[str]] = None) -> List[Violation]:
+    """Lint one module's source. `path` (repo-relative posix) selects
+    which rules apply; pass `rules` to force a specific set regardless
+    of path (the test fixtures do)."""
+    tree = ast.parse(src, filename=path)
+    out: List[Violation] = []
+    for rule, check in RULES.items():
+        if rules is not None:
+            if rule in rules:
+                out.extend(check(tree, path))
+        elif _rule_applies(rule, path):
+            out.extend(check(tree, path))
+    # a site inside nested loops/withs is reachable by more than one
+    # enclosing construct — it is still ONE violation
+    out = sorted(set(out), key=lambda v: (v.path, v.line, v.col, v.rule,
+                                          v.symbol))
+    return out
+
+
+def lint_file(abspath: str, relpath: str) -> List[Violation]:
+    with open(abspath, encoding="utf-8") as f:
+        return lint_source(f.read(), relpath)
+
+
+def _iter_py_files(root: str):
+    pkg = os.path.join(root, "kubernetes_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                abspath = os.path.join(dirpath, name)
+                rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+                yield abspath, rel
+
+
+def repo_root() -> str:
+    """The directory holding the kubernetes_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_lint(root: Optional[str] = None,
+             baseline_path: Optional[str] = None) -> LintReport:
+    """Lint the tree under `root` and reconcile against the baseline.
+
+    New violations (beyond the counted allowance) and stale baseline
+    entries (allowance exceeding what the tree still contains) both
+    fail — the allowlist can only shrink truthfully."""
+    import time as _time
+    t0 = _time.monotonic()
+    root = root or repo_root()
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None else DEFAULT_BASELINE)
+    report = LintReport()
+    for abspath, rel in _iter_py_files(root):
+        report.files_scanned += 1
+        try:
+            report.violations.extend(lint_file(abspath, rel))
+        except SyntaxError as e:
+            report.new.append(Violation(
+                rule="parse", path=rel, line=e.lineno or 0, col=0,
+                site="<module>", symbol="syntax-error", message=str(e)))
+    new, stale = baseline.reconcile(report.violations)
+    report.new.extend(new)
+    report.stale = stale
+    report.seconds = _time.monotonic() - t0
+    return report

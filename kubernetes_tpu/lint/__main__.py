@@ -1,0 +1,51 @@
+"""CLI: `python -m kubernetes_tpu.lint [--json] [--root DIR]
+[--baseline FILE]`.
+
+Exit status: 0 when the tree is clean against the baseline, 1 when
+there are new violations or stale baseline entries — the same verdict
+the tier-1 gate (tests/test_lint.py) enforces. `--json` prints one
+machine-readable report line (bench.py records the wall time from it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_BASELINE, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.lint",
+        description="orchlint: AST invariant lint (determinism, "
+                    "lock-discipline, jax-hygiene, api-idempotency)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON report line instead of text")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected from the "
+                         "installed package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: lint/baseline.toml)")
+    args = ap.parse_args(argv)
+
+    report = run_lint(root=args.root, baseline_path=args.baseline)
+    if args.json:
+        print(json.dumps(report.to_dict(), sort_keys=True))
+    else:
+        for v in report.new:
+            print(v.render())
+        for s in report.stale:
+            print(f"stale baseline: {s}")
+        print(f"orchlint: {report.files_scanned} files, "
+              f"{len(report.violations)} known site(s), "
+              f"{len(report.new)} new violation(s), "
+              f"{len(report.stale)} stale baseline entr(ies) "
+              f"in {report.seconds:.2f}s -> "
+              f"{'OK' if report.ok else 'FAIL'}")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""The lint baseline: explicit, counted allowances for accepted sites.
+
+`baseline.toml` is an array of `[[allow]]` tables; each names one
+(file, rule, site, symbol) violation identity, how many occurrences are
+accepted there, and WHY. The runner reconciles the tree against it both
+ways:
+
+  - more occurrences than allowed  -> new violations, hard error
+  - fewer occurrences than allowed -> baseline drift, also an error:
+    a fixed violation must take its allowance with it, or the
+    allowlist silently becomes a grant for future regressions.
+
+The parser is a deliberate TOML subset (this interpreter predates
+tomllib, and the lint suite takes no dependencies): `[[allow]]`
+headers, `key = "string" | integer` pairs, comments, blank lines.
+Anything else is a parse error — the baseline is machine-written
+prose, not a config language.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+Key = Tuple[str, str, str, str]  # (path, rule, site, symbol)
+
+
+class BaselineError(Exception):
+    pass
+
+
+@dataclass
+class Baseline:
+    #: violation identity -> accepted occurrence count
+    allow: Dict[Key, int] = field(default_factory=dict)
+    #: identity -> reason string (kept for reporting)
+    reasons: Dict[Key, str] = field(default_factory=dict)
+
+    def reconcile(self, violations) -> Tuple[list, List[str]]:
+        """-> (new violations beyond allowance, stale entry labels)."""
+        counts: Dict[Key, int] = {}
+        by_key: Dict[Key, list] = {}
+        for v in violations:
+            counts[v.key()] = counts.get(v.key(), 0) + 1
+            by_key.setdefault(v.key(), []).append(v)
+        new = []
+        for key, vs in sorted(by_key.items()):
+            allowed = self.allow.get(key, 0)
+            if len(vs) > allowed:
+                # the tail occurrences are the unallowed ones (sorted
+                # by line already) — deterministic either way, and the
+                # message names the full count
+                new.extend(vs[allowed:])
+        stale = []
+        for key, allowed in sorted(self.allow.items()):
+            actual = counts.get(key, 0)
+            if actual < allowed:
+                path, rule, site, symbol = key
+                stale.append(
+                    f"{path}: [{rule}] {site}: {symbol} — baseline "
+                    f"allows {allowed}, tree has {actual}; remove the "
+                    f"fixed allowance from lint/baseline.toml")
+        return new, stale
+
+
+_REQUIRED = ("file", "rule", "site", "symbol")
+
+
+def parse_baseline(text: str, origin: str = "<baseline>") -> Baseline:
+    bl = Baseline()
+    entry: Dict[str, object] = {}
+    entry_line = 0
+
+    def commit() -> None:
+        if not entry:
+            return
+        missing = [k for k in _REQUIRED if k not in entry]
+        if missing:
+            raise BaselineError(
+                f"{origin}:{entry_line}: [[allow]] entry missing "
+                f"{missing}")
+        key: Key = (str(entry["file"]), str(entry["rule"]),
+                    str(entry["site"]), str(entry["symbol"]))
+        if key in bl.allow:
+            raise BaselineError(
+                f"{origin}:{entry_line}: duplicate allowance for {key}")
+        count = entry.get("count", 1)
+        if not isinstance(count, int) or count < 1:
+            raise BaselineError(
+                f"{origin}:{entry_line}: count must be a positive "
+                f"integer, got {count!r}")
+        bl.allow[key] = count
+        bl.reasons[key] = str(entry.get("reason", ""))
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[allow]]":
+            commit()
+            entry = {}
+            entry_line = lineno
+            continue
+        if "=" in line and entry_line:
+            key, _, value = line.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if value.startswith('"'):
+                end = value.find('"', 1)
+                if end < 0:
+                    raise BaselineError(
+                        f"{origin}:{lineno}: unterminated string")
+                entry[key] = value[1:end]
+            else:
+                value = value.split("#", 1)[0].strip()
+                try:
+                    entry[key] = int(value)
+                except ValueError:
+                    raise BaselineError(
+                        f"{origin}:{lineno}: unsupported value "
+                        f"{value!r} (strings and integers only)")
+            continue
+        raise BaselineError(
+            f"{origin}:{lineno}: unsupported syntax {line!r} (this "
+            f"baseline is a TOML subset: [[allow]] tables of "
+            f"string/int pairs)")
+    commit()
+    return bl
+
+
+def load_baseline(path: str) -> Baseline:
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        return Baseline()
+    return parse_baseline(text, origin=path)

@@ -1,0 +1,171 @@
+"""Runtime lock-order witness — the dynamic half of the lock lint.
+
+The AST rule in this package enforces the stage/ledger/publish split
+LEXICALLY; what it cannot see is the cross-thread ACQUISITION ORDER.
+The reference gets that from Go's race detector + `go vet -copylocks`;
+this is the Python stand-in: wrap the locks under test in
+`WitnessedLock`s sharing one `LockWitness`, run the workload (the fast
+chaos soak does), and the witness records
+
+  - the pairwise order graph: an edge A->B means some thread acquired
+    B while holding A. Observing both A->B and B->A is a lock-order
+    INVERSION — two threads doing that concurrently is a deadlock
+    waiting for the right interleaving, even if this run got lucky.
+    (The sanctioned store order is publish -> ledger, pinned by
+    Store._watch_register; ledger -> publish would deadlock against
+    it.)
+  - per-lock hold times: the two-phase commit exists to keep the
+    ledger lock hold bounded (fan-out runs after release). A
+    hold-time budget turns "publish crept back under the ledger lock"
+    into a test failure instead of a p99 regression three PRs later.
+
+Reentrant acquisition (the ledger lock is an RLock) increments a
+per-thread depth — no new edges, no hold-clock restart — so RLock
+recursion never self-reports. `acquire(blocking=False)` that fails
+records nothing.
+
+Usage (what tests/test_chaos.py wires into the fast soak):
+
+    witness = LockWitness()
+    witness_store(store, witness)
+    ... drive the workload ...
+    witness.assert_clean(max_hold={"store.ledger": 0.5})
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LockWitness", "WitnessedLock", "witness_store"]
+
+
+class WitnessedLock:
+    """Wraps a Lock/RLock, reporting acquire/release to the witness.
+    Supports the full lock protocol the store uses: context manager,
+    acquire(blocking=, timeout=), release."""
+
+    def __init__(self, inner, name: str, witness: "LockWitness"):
+        self._inner = inner
+        self.name = name
+        self._witness = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness._acquired(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._witness._released(self.name)
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockWitness:
+    """Shared recorder: order graph, inversions, hold times."""
+
+    def __init__(self):
+        self._mu = threading.Lock()  # leaf lock: guards only bookkeeping
+        #: (held, acquired) -> "thread:held->acquired" of first sighting
+        self._edges: Dict[Tuple[str, str], str] = {}
+        #: observed inversions: ((a, b), first_sighting, second_sighting)
+        self.inversions: List[Tuple[Tuple[str, str], str, str]] = []
+        #: thread ident -> [(lock name, depth, t0)]
+        self._held: Dict[int, List[list]] = {}
+        #: lock name -> [acquisitions, max hold seconds]
+        self._stats: Dict[str, list] = {}
+
+    def wrap(self, lock, name: str) -> WitnessedLock:
+        return WitnessedLock(lock, name, self)
+
+    # ---------------------------------------------------------- recording
+
+    def _acquired(self, name: str) -> None:
+        ident = threading.get_ident()
+        tname = threading.current_thread().name
+        now = time.monotonic()
+        with self._mu:
+            held = self._held.setdefault(ident, [])
+            for entry in held:
+                if entry[0] == name:      # reentrant: depth only
+                    entry[1] += 1
+                    return
+            for prior, _depth, _t0 in held:
+                edge = (prior, name)
+                sighting = f"{tname}: {prior} -> {name}"
+                self._edges.setdefault(edge, sighting)
+                rev = self._edges.get((name, prior))
+                if rev is not None:
+                    self.inversions.append(((name, prior), rev,
+                                            sighting))
+            held.append([name, 1, now])
+            self._stats.setdefault(name, [0, 0.0])[0] += 1
+
+    def _released(self, name: str) -> None:
+        now = time.monotonic()
+        with self._mu:
+            held = self._held.get(threading.get_ident(), [])
+            for i, entry in enumerate(held):
+                if entry[0] != name:
+                    continue
+                entry[1] -= 1
+                if entry[1] == 0:
+                    hold = now - entry[2]
+                    stats = self._stats.setdefault(name, [0, 0.0])
+                    stats[1] = max(stats[1], hold)
+                    del held[i]
+                return
+            # released by a thread that did not acquire (legal for a
+            # bare Lock, unused by the store): nothing to unwind
+
+    # ---------------------------------------------------------- reporting
+
+    def report(self) -> dict:
+        with self._mu:
+            return {
+                "locks": {name: {"acquisitions": c,
+                                 "max_hold_s": round(h, 6)}
+                          for name, (c, h) in sorted(self._stats.items())},
+                "edges": sorted(f"{a} -> {b}" for a, b in self._edges),
+                "inversions": [
+                    {"pair": list(pair), "first": first, "second": second}
+                    for pair, first, second in self.inversions],
+            }
+
+    def assert_clean(self,
+                     max_hold: Optional[Dict[str, float]] = None) -> None:
+        """Raise AssertionError on any recorded inversion, or on a
+        lock whose max observed hold exceeded its budget."""
+        rep = self.report()
+        problems = [f"lock-order inversion {inv['pair']}: "
+                    f"{inv['first']} vs {inv['second']}"
+                    for inv in rep["inversions"]]
+        for name, budget in sorted((max_hold or {}).items()):
+            seen = rep["locks"].get(name, {}).get("max_hold_s", 0.0)
+            if seen > budget:
+                problems.append(
+                    f"{name}: max hold {seen:.4f}s exceeds the "
+                    f"{budget:.4f}s budget (publish creeping back "
+                    f"under the ledger lock?)")
+        if problems:
+            raise AssertionError(
+                "lock witness: " + "; ".join(problems)
+                + f" [report: {rep}]")
+
+
+def witness_store(store, witness: Optional[LockWitness] = None
+                  ) -> LockWitness:
+    """Swap a Store's ledger and publish locks for witnessed wrappers
+    (do this BEFORE the store serves traffic). Returns the witness.
+    Lock names: `store.ledger`, `store.publish`."""
+    witness = witness or LockWitness()
+    store._lock = witness.wrap(store._lock, "store.ledger")
+    store._pub_lock = witness.wrap(store._pub_lock, "store.publish")
+    return witness

@@ -38,6 +38,14 @@ class RealClock(Clock):
         time.sleep(seconds)
 
 
+#: the process-wide real clock. Stateless, so one shared instance is
+#: enough — components take `clock: Clock = REAL` and tests hand in a
+#: FakeClock. Direct `time.time()` in seeded/replayed code is a lint
+#: error (kubernetes_tpu/lint, "determinism" rule); this singleton is
+#: the sanctioned default.
+REAL = RealClock()
+
+
 class FakeClock(Clock):
     def __init__(self, start: float = 0.0):
         self._now = start          # the monotonic axis

@@ -22,6 +22,7 @@ from kubernetes_tpu.controllers.replication import ReplicationManager
 from kubernetes_tpu.core import types as api
 from kubernetes_tpu.core.quantity import parse_quantity
 from kubernetes_tpu.kubemark.fleet import HollowFleet
+from kubernetes_tpu.lint.lockwitness import witness_store
 from kubernetes_tpu.sched.batch import BatchScheduler
 from kubernetes_tpu.sched.factory import ConfigFactory
 
@@ -171,8 +172,15 @@ def run_chaos_soak(seed, replicas=16, n_nodes=6, fault_rate=0.05,
                    timeout=150.0):
     """The soak body: RC + batch scheduler + hollow fleet, all over
     HttpClient wrapped in one seeded injector; one forced watch cut
-    mid-run. Returns (converged, rebinds, pods, trace, plan)."""
+    mid-run. Returns (converged, rebinds, pods, trace, plan, witness).
+
+    The store's ledger/publish locks run under the lock-order witness
+    for the whole soak: every committer, watcher registration and
+    publish drain the fault storm provokes feeds the acquisition-order
+    graph, so the two-phase locking contract is checked by execution,
+    not just lexically (kubernetes_tpu/lint)."""
     registry = Registry()
+    witness = witness_store(registry.store)
     srv = ApiServer(registry, port=0).start()
     plan = FaultPlan(seed=seed, error_rate=fault_rate)
     chaos = ChaosClient(HttpClient(srv.url), plan)
@@ -240,7 +248,7 @@ def run_chaos_soak(seed, replicas=16, n_nodes=6, fault_rate=0.05,
         ok = wait_until(converged, timeout=timeout)
         pods, _ = registry.list("pods", "default",
                                 label_selector="app=soak")
-        return ok, list(rebinds), pods, chaos.trace(), plan
+        return ok, list(rebinds), pods, chaos.trace(), plan, witness
     finally:
         rc_mgr.stop()
         sched.stop()
@@ -256,7 +264,7 @@ def test_chaos_soak_converges_with_single_bindings():
     cut; the RC reaches desired replicas, every scheduled pod holds
     exactly one binding, and the run's fault schedule is exactly the
     seed's pure replay (reproducibility)."""
-    ok, rebinds, pods, trace, plan = run_chaos_soak(seed=42)
+    ok, rebinds, pods, trace, plan, witness = run_chaos_soak(seed=42)
     assert ok, (f"did not converge: "
                 f"{[(p.metadata.name, p.spec.node_name, p.status.phase) for p in pods]}")
     assert rebinds == [], rebinds  # CAS bind guarantee: never re-pointed
@@ -265,6 +273,15 @@ def test_chaos_soak_converges_with_single_bindings():
     # decisions at every index (see the slow two-invocation gate)
     for verb in VERBS:
         assert trace[verb] == plan.schedule(verb, len(trace[verb])), verb
+    # lock-witness gate: zero order inversions across every thread the
+    # storm ran, and the ledger lock never held through a publish-sized
+    # window (the budget is deliberately loose — GIL stalls on a loaded
+    # box are not regressions; fan-out creeping back under the ledger
+    # lock grows with the pod count and is)
+    witness.assert_clean(max_hold={"store.ledger": 1.0})
+    rep = witness.report()
+    assert rep["locks"]["store.ledger"]["acquisitions"] > 0
+    assert rep["locks"]["store.publish"]["acquisitions"] > 0
 
 
 @pytest.mark.chaos
@@ -274,10 +291,10 @@ def test_chaos_soak_reproducible_across_invocations():
     converge with zero duplicate bindings and draw the same fault
     schedule (bit-identical decisions at every common index)."""
     results = [run_chaos_soak(seed=4242) for _ in range(2)]
-    for ok, rebinds, pods, _, _ in results:
+    for ok, rebinds, pods, _, _, _ in results:
         assert ok
         assert rebinds == []
-    (_, _, _, trace_a, _), (_, _, _, trace_b, _) = results
+    (_, _, _, trace_a, _, _), (_, _, _, trace_b, _, _) = results
     for verb in VERBS:
         n = min(len(trace_a[verb]), len(trace_b[verb]))
         assert trace_a[verb][:n] == trace_b[verb][:n], verb

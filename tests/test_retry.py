@@ -19,6 +19,7 @@ from kubernetes_tpu.api.registry import Registry
 from kubernetes_tpu.api.retry import CircuitBreaker, RetryPolicy
 from kubernetes_tpu.api.server import ApiServer
 from kubernetes_tpu.core import types as api
+from kubernetes_tpu.utils.clock import FakeClock
 from kubernetes_tpu.core.errors import (BadRequest, Conflict, NotFound,
                                         ServiceUnavailable,
                                         TooManyRequests, Unauthorized)
@@ -107,11 +108,10 @@ def test_retry_after_is_a_backoff_floor():
 
 
 def test_deadline_budget_stops_retrying():
-    clock = [0.0]
+    fc = FakeClock()
     policy = fast_policy(max_attempts=10, initial_backoff=1.0,
                          max_backoff=1.0, deadline=2.5,
-                         sleep=lambda s: clock.__setitem__(0, clock[0] + s),
-                         clock=lambda: clock[0])
+                         sleep=fc.step, clock=fc)
     fn = failing(99, lambda: ServiceUnavailable("down"))
     with pytest.raises(ServiceUnavailable):
         policy.call(fn, idempotent=True)
@@ -119,12 +119,32 @@ def test_deadline_budget_stops_retrying():
     assert len(fn.calls) <= 3
 
 
+def test_deadline_budget_immune_to_wall_clock_jumps():
+    """The budget runs on the monotonic axis: a backwards NTP step
+    mid-call must not hand the retry loop extra attempts, and a
+    forward jump must not starve it (the bug class PR 7 fixed for
+    leases, here for every API call's retry budget)."""
+    for jump in (-3600.0, +3600.0):
+        fc = FakeClock()
+
+        def sleep_and_jump(s, fc=fc, jump=jump):
+            fc.step(s)
+            fc.jump_wall(jump)  # wall lurches under every backoff
+
+        policy = fast_policy(max_attempts=10, initial_backoff=1.0,
+                             max_backoff=1.0, deadline=2.5,
+                             sleep=sleep_and_jump, clock=fc)
+        fn = failing(99, lambda: ServiceUnavailable("down"))
+        with pytest.raises(ServiceUnavailable):
+            policy.call(fn, idempotent=True)
+        assert len(fn.calls) <= 3, f"wall jump {jump:+} changed the budget"
+
+
 # ----------------------------------------------------------- the breaker
 
 def test_breaker_opens_fast_fails_and_probe_recovers():
-    clock = [0.0]
-    br = CircuitBreaker(threshold=3, probe_interval=1.0,
-                        clock=lambda: clock[0])
+    fc = FakeClock()
+    br = CircuitBreaker(threshold=3, probe_interval=1.0, clock=fc)
     for _ in range(3):
         br.record_failure()
     assert br.open
@@ -140,7 +160,7 @@ def test_breaker_opens_fast_fails_and_probe_recovers():
     assert not br.allow(probe_down)
     assert len(probes) == 1
     # interval elapses, server healthy: probe closes the breaker
-    clock[0] += 1.5
+    fc.step(1.5)
     assert br.allow(lambda: True)
     assert not br.open
 

@@ -113,7 +113,7 @@ class TestWorkloadChaosApplier:
         client = InProcClient(Registry())
         _bootstrap(client, plan)
         wl = WorkloadChaos(client, plan)
-        deadline = time.time() + 30
+        deadline = time.monotonic() + 30
         for tick in range(plan.ticks):
             wl.apply_tick(tick, deadline)
         return plan, wl

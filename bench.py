@@ -40,7 +40,11 @@ a compressed day of diurnal/burst/jobwave/rollout/churn traffic under
 throughput and every SLO verdict), null unless requested; r09 adds
 lint (orchlint wall time over the tree and its verdict — recorded
 every round so the static-analysis pass stays inside its 5s tier-1
-budget as rules and tree both grow).
+budget as rules and tree both grow); r10 adds pipeline (the --txn-ab
+multi-key-transaction A/B: the headline arm commits each bind tile /
+status burst as ONE store.commit_txn revision window while the
+control arm restores the per-1024-op store.batch() chunk loops),
+null unless requested.
 """
 
 import argparse
@@ -238,6 +242,12 @@ def main():
                     help="run one extra e2e pass with watch fan-out "
                          "held under the store's ledger lock (the "
                          "pre-two-phase commit path) and report both")
+    ap.add_argument("--txn-ab", action="store_true",
+                    help="run one extra e2e pass with multi-key "
+                         "transactions disabled (per-1024-op "
+                         "store.batch() chunks, the pre-txn commit "
+                         "shape) and report both arms in the "
+                         "pipeline section")
     ap.add_argument("--chaos-seed", type=int, default=None,
                     help="also record one e2e pass under the seeded "
                          "chaos injector (chaos.ChaosClient, "
@@ -375,6 +385,26 @@ def main():
         if args.verbose:
             print(f"# store A/B inline {ctl.pods_per_sec:.0f} vs "
                   f"off-lock {r.pods_per_sec:.0f} pods/s",
+                  file=sys.stderr)
+    pipeline = None
+    if args.txn_ab:
+        # control arm: same shape, multi-key txns off — registry batch
+        # verbs fall back to per-1024-op store.batch() chunks and the
+        # fleet's status pump re-caps its drain at 1024; the measured
+        # delta IS the single-revision-window commit + scan/commit
+        # overlap (ISSUE 12)
+        tc = run_scheduling_benchmark(args.nodes, args.pods, "batch",
+                                      txn_commit=False)
+        pipeline = {
+            "txn_pods_per_sec": round(r.pods_per_sec, 1),
+            "txn_elapsed_s": round(r.elapsed_s, 2),
+            "chunked_pods_per_sec": round(tc.pods_per_sec, 1),
+            "chunked_elapsed_s": round(tc.elapsed_s, 2),
+            "speedup": (round(r.pods_per_sec / tc.pods_per_sec, 3)
+                        if tc.pods_per_sec else None)}
+        if args.verbose:
+            print(f"# txn A/B chunked {tc.pods_per_sec:.0f} vs "
+                  f"txn {r.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
     chaos = None
     if args.chaos_seed is not None:
@@ -607,6 +637,7 @@ def main():
         "pallas": pallas,
         "slo": slo,
         "store_ab": store_ab,
+        "pipeline": pipeline,
         "chaos": chaos,
         "node_chaos": node_chaos,
         "durability": durability,

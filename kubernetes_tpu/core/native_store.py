@@ -147,6 +147,24 @@ def _load_library() -> ctypes.CDLL:
             lib.has_recovery = True
         except AttributeError:
             lib.has_recovery = False
+        # txn-window replay (kv_replay_txn): a TXN frame recovers as
+        # one engine lock window, mirroring the atomic unit it was on
+        # disk. Absent in a stale prebuilt library — recover() then
+        # falls back to per-record kv_replay (bit-identical result).
+        try:
+            lib.kv_replay_txn.restype = ctypes.c_int64
+            lib.kv_replay_txn.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_double)]
+            lib.has_txn_replay = True
+        except AttributeError:
+            lib.has_txn_replay = False
         _lib = lib
         return lib
 
@@ -246,14 +264,14 @@ class NativeStore:
         import time as _time
 
         from ..utils.metrics import global_metrics
-        from .wal import WalError, read_wal
+        from .wal import WalError, read_wal_grouped
 
         t0 = _time.monotonic()
         lib = _load_library()
         if not getattr(lib, "has_recovery", False):
             raise WalError("native library predates the recovery ABI; "
                            "rebuild kvstore.cc")
-        snap, records = read_wal(wal_dir)
+        snap, groups = read_wal_grouped(wal_dir)
         st = cls(window=window, scheme=scheme)
         etype_code = {v: k for k, v in _EVENT_TYPES.items()}
         if snap is not None:
@@ -262,19 +280,53 @@ class NativeStore:
                 lib.kv_restore(st._h, key.encode(), raw, len(raw),
                                int(mod_rev), float(expiry or 0))
             lib.kv_restore_seal(st._h, int(snap["rev"]))
-        for rev, etype, key, expiry, wire in records:
-            raw = _json.dumps(wire).encode()
-            obj_rev = int((wire.get("metadata") or {})
-                          .get("resourceVersion") or rev)
-            if lib.kv_replay(st._h, rev, etype_code[etype], key.encode(),
-                             raw, len(raw), obj_rev,
-                             float(expiry or 0)) != rev:
-                raise WalError(f"replay of revision {rev} rejected "
-                               f"(engine at {st.current_revision})")
+        txn_ok = getattr(lib, "has_txn_replay", False)
+        n_records = 0
+        for group in groups:
+            n_records += len(group)
+            if len(group) > 1 and txn_ok:
+                # a TXN frame replays as ONE engine lock window — the
+                # same atomic unit it was on disk and at commit time
+                n = len(group)
+                prepared = []
+                for rev, etype, key, expiry, wire in group:
+                    raw = _json.dumps(wire).encode()
+                    obj_rev = int((wire.get("metadata") or {})
+                                  .get("resourceVersion") or rev)
+                    prepared.append((rev, etype_code[etype], key.encode(),
+                                     raw, obj_rev, float(expiry or 0)))
+                revs = (ctypes.c_uint64 * n)(*[p[0] for p in prepared])
+                types = (ctypes.c_uint8 * n)(*[p[1] for p in prepared])
+                keys = (ctypes.c_char_p * n)(*[p[2] for p in prepared])
+                vals = (ctypes.c_char_p * n)(*[p[3] for p in prepared])
+                val_lens = (ctypes.c_uint64 * n)(
+                    *[len(p[3]) for p in prepared])
+                obj_revs = (ctypes.c_uint64 * n)(
+                    *[p[4] for p in prepared])
+                expiries = (ctypes.c_double * n)(
+                    *[p[5] for p in prepared])
+                last = group[-1][0]
+                if lib.kv_replay_txn(st._h, n, revs, types, keys, vals,
+                                     val_lens, obj_revs,
+                                     expiries) != last:
+                    raise WalError(
+                        f"txn replay of revisions "
+                        f"{group[0][0]}..{last} rejected "
+                        f"(engine at {st.current_revision})")
+                continue
+            for rev, etype, key, expiry, wire in group:
+                raw = _json.dumps(wire).encode()
+                obj_rev = int((wire.get("metadata") or {})
+                              .get("resourceVersion") or rev)
+                if lib.kv_replay(st._h, rev, etype_code[etype],
+                                 key.encode(), raw, len(raw), obj_rev,
+                                 float(expiry or 0)) != rev:
+                    raise WalError(f"replay of revision {rev} rejected "
+                                   f"(engine at {st.current_revision})")
         global_metrics.inc("wal_recoveries_total")
         st.recovery_stats = {
             "snapshot_rev": snap["rev"] if snap is not None else 0,
-            "replayed_records": len(records),
+            "replayed_records": n_records,
             "recovered_revision": st.current_revision,
             "seconds": round(_time.monotonic() - t0, 6),
         }
@@ -418,6 +470,16 @@ class NativeStore:
                 out.append(stamped)
             return out
         raise Conflict("batch: too many retries")
+
+    def commit_txn(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
+                   ) -> List[Any]:
+        """Multi-key transaction: kv_batch already commits the whole op
+        list as ONE mutex window with consecutive revisions
+        (all-or-nothing CAS), so the engine-side txn verb IS batch.
+        WAL framing parity with Store.commit_txn lives in recover():
+        read_wal expands TXN frames to flat records and kv_replay_txn
+        replays each frame's window in one engine call."""
+        return self.batch(ops)
 
     # --------------------------------------------------------- reads
 

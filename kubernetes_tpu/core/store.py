@@ -756,6 +756,90 @@ class Store:
             self._drain_publish()
         return out
 
+    def commit_txn(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
+                   ) -> List[Any]:
+        """Multi-key ledger TRANSACTION: apply a whole bind/status tile
+        in ONE revision window — one ledger-lock acquisition covering
+        one pre-assigned _bump range, ONE WAL frame (a TXN record whose
+        per-frame CRC makes a torn tail truncate the whole txn
+        atomically in recover()), and one ordered publish batch through
+        _pub_queue, so _published_rev jumps the entire window at once
+        and a mid-txn watch() registration replays up to the previous
+        batch and takes this one live — exactly-once either way.
+
+        Same op interface and all-or-nothing NotFound/Conflict
+        semantics as batch(); the difference is the caller no longer
+        chunks (the per-1024-op batch() loops in the binder and the
+        status pump were paying a lock acquisition, a WAL commit and a
+        publish handoff per chunk — PROFILE_e2e.md round-6's 70%
+        in-lock binder). batch() is kept verbatim as the A/B control
+        arm (bench.py --txn-ab)."""
+        out = []
+        try:
+            with self._lock:
+                self._gc_expired()
+                # staging phase: identical to batch() — run every
+                # update fn first against pre-assigned revisions, so a
+                # mid-txn failure commits nothing
+                rev = self._rev
+                staged = []
+                stage = staged.append
+                data_get = self._data.get
+                for key, fn in ops:
+                    entry = data_get(key)
+                    if entry is None:
+                        raise NotFound(name=key)
+                    stored, _mod_rev, expiry = entry
+                    rev += 1
+                    if getattr(fn, "wants_rv", False):
+                        new_obj = fn(stored, str(rev))
+                    else:
+                        new_obj = _with_rv(fn(stored), rev)
+                    stage((key, new_obj, stored, expiry, rev))
+                batch_events: List[Tuple[int, str, watchpkg.Event,
+                                         Any]] = []
+                ev_append = batch_events.append
+                out_append = out.append
+                data = self._data
+                hist = self._history
+                hist_append = hist.append
+                hist_max = hist.maxlen
+                seg_of = self._seg
+                seg_writes = self._seg_writes
+                seg_writes_get = seg_writes.get
+                modified = watchpkg.MODIFIED
+                event = watchpkg.Event
+                for key, new_obj, stored, expiry, rev in staged:
+                    data[key] = (new_obj, rev, expiry)
+                    seg = seg_of(key)
+                    seg_writes[seg] = seg_writes_get(seg, 0) + 1
+                    if len(hist) == hist_max:
+                        self._oldest_rev = hist[0][0]
+                    hist_append((rev, modified, key, new_obj, stored))
+                    ev_append((rev, key, event(modified, new_obj), stored))
+                    out_append(new_obj)
+                if staged:
+                    self._rev = staged[-1][4]
+                    if self._list_cache:
+                        for key, new_obj, _stored, _exp, _rev in staged:
+                            self._patch_lists(key, new_obj)
+                    if self._wal is not None:
+                        # the one framing difference from batch(): the
+                        # whole window is ONE TXN frame — one CRC unit,
+                        # torn-tail truncation is all-or-nothing
+                        enc = self._wal_scheme.encode_dict
+                        self._wal.append_txn(
+                            [[rev, modified, key, expiry, enc(new_obj)]
+                             for key, new_obj, _stored, expiry, rev
+                             in staged])
+                self._stage_publish(batch_events)
+                self._wal_sync()
+                if self._publish_inline:
+                    self._drain_publish()
+        finally:
+            self._drain_publish()
+        return out
+
     # ------------------------------------------------------------- reads
 
     def get(self, key: str) -> Any:

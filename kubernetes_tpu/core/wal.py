@@ -22,6 +22,8 @@ On-disk layout (everything under one directory):
   wal-<first_rev:020d>.seg   frames: <u32 len><u32 crc32>payload, where
                              payload is the JSON array
                              [rev, etype, key, expiry|null, obj_wire]
+                             or, for a multi-key transaction, one frame
+                             [first_rev, "TXN", [records...]] (see TXN)
   snap-<rev:020d>.json       full store state at rev: entries
                              [[key, mod_rev, expiry|null, obj_wire]...]
                              plus the seg_writes / ttl_segs bookkeeping
@@ -55,6 +57,15 @@ _FRAME = struct.Struct("<II")          # payload length, crc32(payload)
 _SEG_FMT = "wal-%020d.seg"
 _SNAP_FMT = "snap-%020d.json"
 _BATCH_FSYNC_S = 0.05
+
+# Sentinel in the etype position marking a multi-record transaction
+# frame: payload [first_rev, "TXN", [[rev, etype, key, expiry,
+# obj_wire], ...]]. One frame is one CRC unit, so a crash mid-write
+# tears the WHOLE transaction and _read_segment truncates it
+# atomically — a partial txn is never replayable. read_wal expands the
+# frame back into flat records, so both recover() loops (Python and
+# the kvstore.cc kv_replay ABI) replay txn-bearing logs unchanged.
+TXN = "TXN"
 
 FSYNC_POLICIES = ("always", "batch")
 
@@ -95,6 +106,15 @@ def _snapshots(dirpath: str) -> List[Tuple[int, str]]:
 def encode_record(rev: int, etype: str, key: str,
                   expiry: Optional[float], obj_wire: Any) -> bytes:
     payload = json.dumps([rev, etype, key, expiry, obj_wire],
+                         separators=(",", ":")).encode()
+    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def encode_txn(records: List[list]) -> bytes:
+    """One frame for a whole multi-key transaction (see TXN above).
+    `records` are ordinary [rev, etype, key, expiry, obj_wire] lists
+    with consecutive revisions; the first one names the frame."""
+    payload = json.dumps([records[0][0], TXN, records],
                          separators=(",", ":")).encode()
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
 
@@ -143,8 +163,14 @@ def _read_segment(path: str, last: bool) -> Tuple[List[list], bool]:
     return records, torn
 
 
-def read_wal(dirpath: str) -> Tuple[Optional[Dict], List[list]]:
-    """-> (snapshot state | None, tail records strictly after it).
+def read_wal_grouped(dirpath: str
+                     ) -> Tuple[Optional[Dict], List[List[list]]]:
+    """-> (snapshot state | None, tail record GROUPS strictly after
+    it). Each group is the atomic unit one frame carried: a singleton
+    for a plain record, the whole window for a TXN frame. Recovery
+    backends that replay transactions as one engine window
+    (NativeStore via kv_replay_txn) key off the grouping; read_wal()
+    flattens it for callers that replay record-at-a-time.
 
     Picks the newest parseable snapshot, then replays every segment
     record with rev > snapshot rev, enforcing strict revision order.
@@ -162,24 +188,47 @@ def read_wal(dirpath: str) -> Tuple[Optional[Dict], List[list]]:
         except (OSError, ValueError):
             continue  # half-written snapshot: fall back to an older one
     floor = snap["rev"] if snap else 0
-    records: List[list] = []
+    groups: List[List[list]] = []
     segs = _segments(dirpath)
     last_rev = floor
     for i, (_first, path) in enumerate(segs):
         seg_records, torn = _read_segment(path, last=(i == len(segs) - 1))
         for rec in seg_records:
-            rev = rec[0]
-            if rev <= floor:
-                continue
-            if rev != last_rev + 1:
-                raise WalCorrupt(
-                    f"revision gap: have {last_rev}, next record {rev} "
-                    f"({os.path.basename(path)})")
-            records.append(rec)
-            last_rev = rev
+            if len(rec) > 1 and rec[1] == TXN:
+                # expand the txn frame; its CRC already guaranteed
+                # all-or-nothing, so only intra-frame contiguity with
+                # the declared first_rev is left to enforce.
+                first, flat = rec[0], rec[2]
+                for j, sub in enumerate(flat):
+                    if sub[0] != first + j:
+                        raise WalCorrupt(
+                            f"txn frame at {first} not contiguous: "
+                            f"record {j} has rev {sub[0]} "
+                            f"({os.path.basename(path)})")
+            else:
+                flat = (rec,)
+            group = []
+            for sub in flat:
+                rev = sub[0]
+                if rev <= floor:
+                    continue
+                if rev != last_rev + 1:
+                    raise WalCorrupt(
+                        f"revision gap: have {last_rev}, next record {rev} "
+                        f"({os.path.basename(path)})")
+                group.append(sub)
+                last_rev = rev
+            if group:
+                groups.append(group)
         if torn:
             break  # nothing after a torn tail is replayable
-    return snap, records
+    return snap, groups
+
+
+def read_wal(dirpath: str) -> Tuple[Optional[Dict], List[list]]:
+    """Flat view of read_wal_grouped: (snapshot | None, tail records)."""
+    snap, groups = read_wal_grouped(dirpath)
+    return snap, [rec for group in groups for rec in group]
 
 
 class WalWriter:
@@ -199,6 +248,7 @@ class WalWriter:
         self.segment_records = segment_records
         self.snapshot_records = snapshot_records
         self._buf: List[bytes] = []
+        self._buf_records = 0            # logical records (txn-expanded)
         self._buf_first_rev = 0
         self._f = None                   # current segment file object
         self._seg_count = 0              # records in the current segment
@@ -213,6 +263,18 @@ class WalWriter:
         if not self._buf:
             self._buf_first_rev = rev
         self._buf.append(encode_record(rev, etype, key, expiry, obj_wire))
+        self._buf_records += 1
+
+    def append_txn(self, records: List[list]) -> None:
+        """Buffer a whole multi-key transaction as ONE frame. The
+        records are [rev, etype, key, expiry, obj_wire] lists with
+        consecutive revisions (the store's commit_txn window)."""
+        if not records:
+            return
+        if not self._buf:
+            self._buf_first_rev = records[0][0]
+        self._buf.append(encode_txn(records))
+        self._buf_records += len(records)
 
     def commit(self) -> int:
         """Write every buffered frame in one os.write and flush; fsync
@@ -224,10 +286,11 @@ class WalWriter:
         if self._f is None:
             self._f = open(os.path.join(
                 self.dir, _SEG_FMT % self._buf_first_rev), "ab")
-        n = len(self._buf)
+        n = self._buf_records
         self._f.write(b"".join(self._buf))
         self._f.flush()
         self._buf.clear()
+        self._buf_records = 0
         self._seg_count += n
         self._since_snapshot += n
         now = time.monotonic()

@@ -94,7 +94,8 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                              registry: Optional[Registry] = None,
                              store_publish_inline: bool = False,
                              chaos_seed: Optional[int] = None,
-                             chaos_error_rate: float = 0.01
+                             chaos_error_rate: float = 0.01,
+                             txn_commit: bool = True
                              ) -> BenchmarkResult:
     """Stand up master + fleet + scheduler, blast pods from 30 writers,
     measure time until every pod is bound (and optionally Running).
@@ -103,6 +104,12 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     watch events out while still holding its ledger lock — the
     pre-split commit serialization, kept as the control arm of
     bench.py's --store-ab.
+
+    txn_commit: False restores the pre-txn commit shape — registry
+    batch verbs route store.batch() per 1024-op chunk and the fleet's
+    status pump caps its drain at 1024 — the control arm of bench.py's
+    --txn-ab. True (default) lands each tile/burst in one multi-key
+    transaction (one revision window, one WAL frame).
 
     chaos_seed: wrap every component's client in the seeded chaos
     injector (chaos.ChaosClient at chaos_error_rate on all verbs) so
@@ -116,9 +123,11 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     # threads — and tightens the run-to-run spread (A/B in PROFILE_e2e.md)
     import sys
     sys.setswitchinterval(0.005)
-    if registry is None and store_publish_inline:
+    if registry is None and (store_publish_inline or not txn_commit):
         from ..core.store import Store
-        registry = Registry(store=Store(publish_inline=True))
+        registry = Registry(
+            store=Store(publish_inline=store_publish_inline),
+            txn_commit=txn_commit)
     registry = registry or Registry()
     client = InProcClient(registry)
     if chaos_seed is not None:
@@ -132,10 +141,12 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     # ~500 status writes into every 6s of a ~5s window
     fleet = HollowFleet(client, n_nodes, cpu="4", memory="32Gi",
                         max_pods=max_pods_per_node,
-                        heartbeat_interval=600.0).run()
+                        heartbeat_interval=600.0,
+                        status_chunk=0 if txn_commit else 1024).run()
     factory = ConfigFactory(client, rate_limit=False).start()
     if mode == "batch":
-        sched = BatchScheduler(factory.create_batch()).run()
+        sched = BatchScheduler(factory.create_batch(
+            commit_chunk=0 if txn_commit else 1024)).run()
     elif mode == "serial":
         sched = Scheduler(factory.create()).run()
     else:
@@ -278,11 +289,15 @@ def main() -> None:
     ap.add_argument("--store-publish-inline", action="store_true",
                     help="control arm: fan watch events out under the "
                          "store's ledger lock (pre-split behavior)")
+    ap.add_argument("--no-txn", action="store_true",
+                    help="control arm: per-1024-op store.batch() chunks "
+                         "instead of one multi-key txn per tile/burst")
     args = ap.parse_args()
     r = run_scheduling_benchmark(
         args.nodes, args.pods, args.mode,
         wait_running=args.wait_running,
-        store_publish_inline=args.store_publish_inline)
+        store_publish_inline=args.store_publish_inline,
+        txn_commit=not args.no_txn)
     print(json.dumps({
         "metric": f"e2e_scheduling_throughput_{r.mode}",
         "nodes": r.n_nodes, "pods": r.n_pods, "scheduled": r.scheduled,

@@ -35,11 +35,16 @@ class HollowFleet:
     def __init__(self, client, n_nodes: int, name_prefix: str = "hollow-",
                  cpu: str = "4", memory: str = "32Gi", max_pods: int = 40,
                  heartbeat_interval: float = 10.0,
-                 labels_for=None, jitter_seed: Optional[int] = None):
+                 labels_for=None, jitter_seed: Optional[int] = None,
+                 status_chunk: int = 0):
         """labels_for: optional fn(index) -> labels dict (zones etc.).
         jitter_seed: seeds the heartbeat-phase RNG so a chaos/soak
         harness's beat schedule is reproducible; None keeps the
-        process RNG (beats must decohere, not share a phase)."""
+        process RNG (beats must decohere, not share a phase).
+        status_chunk: 0 drains each queued status burst into one
+        txn-routed update_status_batch (one revision window); a
+        positive value restores the old capped per-chunk loop —
+        bench.py --txn-ab uses 1024 as the control arm."""
         self.client = client
         self._jitter_rng = (random.Random(f"{jitter_seed}:heartbeat")
                             if jitter_seed is not None else random.Random())
@@ -49,6 +54,7 @@ class HollowFleet:
         self.memory = memory
         self.max_pods = max_pods
         self.heartbeat_interval = heartbeat_interval
+        self.status_chunk = status_chunk
         self.labels_for = labels_for or (lambda i: {})
         self._names = [f"{name_prefix}{i:05d}" for i in range(n_nodes)]
         self._running: Dict[str, str] = {}  # pod key -> node
@@ -293,14 +299,15 @@ class HollowFleet:
             # confirm them Running in ONE batched store pass instead of
             # per-pod writes fighting the GIL (per-object semantics are
             # unchanged; see registry.update_status_batch)
-            # 1024 bounds the ledger-lock window (an 8k-pod status tile
-            # held the lock long enough to push concurrent API reads
-            # over the latency SLO). The two-phase store split halved
-            # the per-tile lock hold, but the 5000x30000 A/B kept 1024
-            # ahead of 2048 on the 1-core box — see sched/batch.py
-            # commit_chunk for the numbers.
+            # With commit_txn routing the whole burst lands in one
+            # revision window under one ledger-lock acquisition, so the
+            # old 1024 cap (which bounded the per-chunk lock hold when
+            # each chunk was a separate store.batch) is off by default.
+            # A positive status_chunk restores the capped loop as the
+            # --txn-ab control arm — see sched/batch.py commit_chunk.
+            cap = self.status_chunk or float("inf")
             batch = [pod]
-            while len(batch) < 1024:
+            while len(batch) < cap:
                 try:
                     nxt = self._status_q.get_nowait()
                 except queue.Empty:

@@ -437,6 +437,40 @@ int64_t kv_replay(void* h, uint64_t rev, uint8_t type, const char* key,
   return static_cast<int64_t>(rev);
 }
 
+// Replay one TXN frame's whole window (core/wal.py TXN records) in
+// ONE lock window: the frame was one CRC unit on disk, so it recovers
+// as one atomic unit in the engine too — mirroring kv_batch's commit
+// shape. Revisions must be consecutive and start strictly after the
+// current revision; per-record semantics are exactly kv_replay's.
+// Returns the last replayed revision, or ERR_CONFLICT.
+int64_t kv_replay_txn(void* h, uint64_t n, const uint64_t* revs,
+                      const uint8_t* types, const char** keys,
+                      const uint8_t** vals, const uint64_t* val_lens,
+                      const uint64_t* obj_revs, const double* expiries) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (n == 0) return static_cast<int64_t>(s->rev);
+  if (revs[0] <= s->rev) return ERR_CONFLICT;
+  for (uint64_t i = 1; i < n; ++i)
+    if (revs[i] != revs[0] + i) return ERR_CONFLICT;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t rev = revs[i];
+    s->rev = rev;
+    std::string k(keys[i]);
+    std::string v(reinterpret_cast<const char*>(vals[i]), val_lens[i]);
+    if (types[i] == static_cast<uint8_t>(EventType::Deleted)) {
+      s->data.erase(k);
+      s->emit(rev, EventType::Deleted, k, obj_revs[i], v);
+    } else {
+      Entry e{v, rev, expiries[i]};
+      s->note_expiry(expiries[i]);
+      s->data[k] = e;
+      s->emit(rev, static_cast<EventType>(types[i]), k, rev, v);
+    }
+  }
+  return static_cast<int64_t>(s->rev);
+}
+
 // Block until the store revision exceeds since_rev (or timeout).
 // Returns the current revision. ctypes releases the GIL around this,
 // so watcher threads park in native code, not in Python polling loops.

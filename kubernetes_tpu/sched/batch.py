@@ -26,7 +26,7 @@ import logging
 import queue
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -55,6 +55,9 @@ class _Inflight:
     flags: Tuple[bool, bool]  # (has_aff, has_spread)
     t_start: float
     t_dev: float
+    # set once _finalize has handed the tile's bindings over (commit
+    # queued or committed) — the drain_commits barrier rides behind it
+    landed: threading.Event = field(default_factory=threading.Event)
 
 
 def _carry_compatible(enc, prev_state) -> bool:
@@ -83,10 +86,18 @@ class BatchSchedulerConfig:
     def __init__(self, factory, engine: Optional[BatchEngine] = None,
                  tile_size: int = 8192, min_pad: int = 64,
                  bulk_chunk: int = 1024, incremental: bool = True,
+                 commit_chunk: int = 0,
                  metrics: Optional[MetricsRegistry] = None):
         self.factory = factory
         self.engine = engine or BatchEngine()
         self.tile_size = tile_size
+        # bind-commit sub-batch size: 0 commits the whole tile as ONE
+        # multi-key store transaction (registry routes commit_txn — one
+        # ledger window, one WAL frame, one publish batch); a positive
+        # value restores the per-chunk store.batch() loops, kept as the
+        # A/B control arm (bench.py --txn-ab; 1024 was the pre-txn
+        # sweet spot on the 1-core box)
+        self.commit_chunk = commit_chunk
         # scan-chunk sizes: small drains compile/run the [min_pad] program,
         # bulk drains the [bulk_chunk] one — exactly two XLA programs per
         # node-table shape, regardless of tile size (engine.run_chunked)
@@ -131,6 +142,10 @@ class BatchScheduler:
         # the dispatched-but-unfinalized tile (device pipeline depth 1):
         # scheduler-thread only
         self._prev: Optional[_Inflight] = None
+        # the most recently handed-off unfinalized tile (scheduler-
+        # thread writes; FIFO means its landed event implies every
+        # earlier handoff landed too — see _ledger_current)
+        self._last_handed: Optional[_Inflight] = None
         # the commit pipeline (SURVEY.md section 7 hard part 2 + the
         # reference's scheduler->binder two-stage analogue,
         # scheduler.go:120-165): tile k's binding commit runs on this
@@ -180,6 +195,7 @@ class BatchScheduler:
         worst, the bind CAS rejects the duplicate and _bind_failed
         re-reads it)."""
         self._prev = None
+        self._last_handed = None
         old = self._inc
         self._inc = None
         if old is not None:
@@ -228,18 +244,37 @@ class BatchScheduler:
             self._commit_thread.join(timeout=30)
 
     def drain_commits(self, timeout: float = 30.0) -> None:
-        """Block until every queued tile has been committed AND assumed
-        (a barrier Event rides the queue behind the pending tiles). The
-        full-encode path snapshots the modeler's merged lister — tiles
-        still queued here are bound-but-unassumed, and scheduling
-        against that snapshot would see their capacity as free."""
+        """Block until every dispatched tile has been committed AND
+        assumed (a barrier Event rides the queue behind the pending
+        tiles). The full-encode path snapshots the modeler's merged
+        lister — tiles still queued here are bound-but-unassumed, and
+        scheduling against that snapshot would see their capacity as
+        free.
+
+        Under the deep pipeline the dispatched-but-unfinalized tile in
+        self._prev is NOT in the queue yet: its bindings only enqueue
+        when _finalize hands them over, so a barrier queued before that
+        handoff would fire with the tile still in flight. The barrier
+        therefore rides BEHIND it — on the scheduler thread by
+        finalizing it first, elsewhere by waiting for its landed event
+        (set after the handoff, so FIFO puts the barrier behind the
+        bindings)."""
+        deadline = time.monotonic() + timeout
+        fl = self._prev
+        if fl is not None:
+            if threading.current_thread() is self._thread:
+                self._finalize_prev()
+            else:
+                fl.landed.wait(timeout=max(0.0,
+                                           deadline - time.monotonic()))
         barrier = threading.Event()
         try:
-            self._commit_q.put(barrier, timeout=timeout)
+            self._commit_q.put(barrier, timeout=max(
+                0.001, deadline - time.monotonic()))
         except queue.Full:
             return  # committer wedged; the caller's snapshot is stale
                     # either way and the epoch guard catches it
-        barrier.wait(timeout=timeout)
+        barrier.wait(timeout=max(0.0, deadline - time.monotonic()))
 
     def _commit_loop(self) -> None:
         while True:
@@ -251,6 +286,23 @@ class BatchScheduler:
                 continue
             if self._killed:
                 continue  # a dead binder binds nothing (kill())
+            if isinstance(item, _Inflight):
+                # deep pipeline (scan/commit overlap): the scheduler
+                # thread handed over a dispatched-but-unfinalized tile —
+                # the blocking np.asarray happens HERE, double-buffered
+                # against the next tile's encode/execute on device.
+                # _finalize routes its own failures (asarray -> whole
+                # tile to error path, commit -> per-pod fallback).
+                try:
+                    self._finalize(item, on_committer=True)
+                except Exception as e:
+                    logger.exception("tile finalize failed")
+                    for pod in item.pods:
+                        try:
+                            self._error(pod, e)
+                        except Exception:
+                            pass
+                continue
             try:
                 # No tile-wide modeler lock here: the merged lister
                 # dedupes scheduled-vs-assumed by key, so bind→assume
@@ -446,6 +498,11 @@ class BatchScheduler:
         if self._prev is not None and (services or controllers
                                        or inc.groups):
             self._finalize_prev()
+        if self._prev is None and not self._ledger_current():
+            # about to dispatch from the encoder's init state (nothing
+            # to chain off): tiles handed to the committer but not yet
+            # assumed would read as free capacity — land them first
+            self.drain_commits()
         enc = inc.encode_tile(pods, services, controllers, pad_to=pad)
         c.metrics.observe("batch_snapshot_latency_microseconds",
                           (time.monotonic() - start) * 1e6)
@@ -465,9 +522,12 @@ class BatchScheduler:
                 chained = True
                 self._prev = None
             else:
-                # can't chain: land the previous tile, then re-encode so
-                # this tile's init state includes its assumes
+                # can't chain: land the previous tile (and any older
+                # handoffs still with the committer), then re-encode so
+                # this tile's init state includes every assume
                 self._finalize_prev()
+                if not self._ledger_current():
+                    self.drain_commits()
                 prev = None
                 enc = inc.encode_tile(pods, services, controllers,
                                       pad_to=pad)
@@ -481,9 +541,27 @@ class BatchScheduler:
                                state=state, epoch=enc.state_epoch,
                                flags=flags, t_start=start, t_dev=t_dev)
         if chained and prev is not None:
-            # overlap: tile k finalizes on the host while tile k+1 runs
-            self._finalize(prev)
+            # scan/commit overlap, committer-side double-buffer: hand
+            # tile k over UNFINALIZED — the blocking np.asarray (and the
+            # bind commit behind it) runs on the committer thread while
+            # tile k+1 executes on device and this thread encodes tile
+            # k+2. Sound for the same assume-before-bind reason as the
+            # commit queue itself; chaining means tile k+1's carry
+            # already contains tile k's placements, so the encoder
+            # ledger lagging behind the committer's assume_assigned is
+            # invisible to chained dispatches (non-chained ones drain
+            # via _ledger_current above). Bounded queue = backpressure.
+            self._commit_q.put(prev)
+            self._last_handed = prev
         return True
+
+    def _ledger_current(self) -> bool:
+        """Has every tile handed to the committer been assumed into the
+        incremental encoder's ledger? FIFO order: if the most recent
+        handoff landed (assume_assigned + commit handed over), every
+        earlier one did too."""
+        lh = self._last_handed
+        return lh is None or lh.landed.is_set()
 
     def _finalize_prev(self) -> None:
         fl = self._prev
@@ -491,45 +569,65 @@ class BatchScheduler:
         if fl is not None:
             self._finalize(fl)
 
-    def _finalize(self, fl: _Inflight) -> None:
+    def _finalize(self, fl: _Inflight, on_committer: bool = False) -> None:
         """Land a dispatched tile: block on its assignments, assume them
         into the persistent encoder state, hand bindings to the
-        committer, route no-fit pods to backoff."""
+        committer (or, on the committer thread itself, commit them
+        directly — enqueueing into its own bounded queue would
+        deadlock), route no-fit pods to backoff. The landed event fires
+        once the bindings are queued/committed, whatever path ran —
+        it's what drain_commits and _ledger_current key off."""
         c = self.config
         f = c.factory
         try:
-            assigned = np.asarray(fl.assigned)
-        except Exception as e:
-            self._fail_tile(fl.pods, e)
-            return
-        c.metrics.observe("batch_device_latency_microseconds",
-                          (time.monotonic() - fl.t_dev) * 1e6)
-        enc = fl.enc
-        idx = assigned[: enc.n_pods]
-        names = enc.node_names
-        scheduled: List[Tuple[api.Pod, str]] = []
-        unscheduled: List[api.Pod] = []
-        for j, pod in enumerate(fl.pods):
-            i = idx[j]
-            if i >= 0:
-                scheduled.append((pod, names[i]))
+            try:
+                assigned = np.asarray(fl.assigned)
+            except Exception as e:
+                self._fail_tile(fl.pods, e)
+                return
+            c.metrics.observe("batch_device_latency_microseconds",
+                              (time.monotonic() - fl.t_dev) * 1e6)
+            enc = fl.enc
+            idx = assigned[: enc.n_pods]
+            names = enc.node_names
+            scheduled: List[Tuple[api.Pod, str]] = []
+            unscheduled: List[api.Pod] = []
+            for j, pod in enumerate(fl.pods):
+                i = idx[j]
+                if i >= 0:
+                    scheduled.append((pod, names[i]))
+                else:
+                    unscheduled.append(pod)
+            c.metrics.observe("scheduling_algorithm_latency_microseconds",
+                              (time.monotonic() - fl.t_start) * 1e6)
+            try:
+                # self._inc can be None mid-failover (_on_started_leading
+                # discards it); the tile still binds — the fresh encoder's
+                # bootstrap re-list covers its capacity
+                if self._inc is not None:
+                    self._inc.assume_assigned(enc, fl.pods, idx)
+            except Exception:
+                # the slow path inside assume_assigned is the robust one;
+                # anything escaping means the ledger may be torn for this
+                # tile — scheduling continues (the watch echo reconciles),
+                # binds still commit
+                logger.exception("assume_assigned failed")
+            if on_committer:
+                try:
+                    self._commit(scheduled, inc_assumed=True)
+                except Exception as e:
+                    # same whole-tile error routing as _commit_loop's
+                    # list path: error_func re-reads, bound pods drop out
+                    logger.exception("tile commit failed")
+                    for pod, _host in scheduled:
+                        try:
+                            self._error(pod, e)
+                        except Exception:
+                            pass
             else:
-                unscheduled.append(pod)
-        c.metrics.observe("scheduling_algorithm_latency_microseconds",
-                          (time.monotonic() - fl.t_start) * 1e6)
-        try:
-            # self._inc can be None mid-failover (_on_started_leading
-            # discards it); the tile still binds — the fresh encoder's
-            # bootstrap re-list covers its capacity
-            if self._inc is not None:
-                self._inc.assume_assigned(enc, fl.pods, idx)
-        except Exception:
-            # the slow path inside assume_assigned is the robust one;
-            # anything escaping means the ledger may be torn for this
-            # tile — scheduling continues (the watch echo reconciles),
-            # binds still commit
-            logger.exception("assume_assigned failed")
-        self._commit_q.put(scheduled)
+                self._commit_q.put(scheduled)
+        finally:
+            fl.landed.set()
         self._route_unscheduled(unscheduled)
         c.metrics.observe("scheduler_e2e_scheduling_latency_microseconds",
                           (time.monotonic() - fl.t_start) * 1e6)
@@ -641,17 +739,18 @@ class BatchScheduler:
                 for p, h in scheduled]
         bind_start = time.monotonic()
         committed: List[bool] = [False] * len(rows)
-        # commit in bounded sub-batches: one 8k-pod store window holds
-        # the ledger lock long enough that concurrent LIST reads queue
-        # behind it (the 5k-density GET-nodes p99). Each sub-batch
-        # keeps all-or-nothing CAS semantics; the per-pod fallback
-        # scopes a conflict to its sub-batch. Since the two-phase store
-        # split the per-chunk LOCK hold halved (fan-out publishes after
-        # release), but the A/B at 5000x30000 kept 1024 ahead of 2048
-        # (~5.8k vs ~5.2k pods/s on the 1-core box): the GIL still
-        # serializes total work, and shorter ledger windows interleave
-        # the reflector/status consumers better.
-        commit_chunk = 1024
+        # whole-tile commit by default (commit_chunk=0): the registry
+        # routes one multi-key transaction per tile — one ledger-lock
+        # acquisition, one WAL frame, one publish fan-out — so the
+        # per-chunk lock/WAL/publish overheads that made 1024 the
+        # pre-txn sweet spot (the A/B that kept 1024 ahead of 2048 at
+        # 5000x30000: shorter ledger windows interleaved the
+        # reflector/status consumers better) are paid once, not
+        # ceil(tile/1024) times. A positive commit_chunk restores the
+        # bounded sub-batch loop as the --txn-ab control arm; either
+        # way each call keeps all-or-nothing CAS semantics and the
+        # per-pod fallback scopes a conflict to its sub-batch.
+        commit_chunk = c.commit_chunk or max(1, len(rows))
         for lo in range(0, len(rows), commit_chunk):
             part = rows[lo:lo + commit_chunk]
             try:

@@ -211,6 +211,58 @@ def test_batch_scheduler_on_sharded_mesh_end_to_end():
         factory.stop()
 
 
+def test_drain_commits_barrier_rides_behind_unfinalized_tile():
+    """Regression (ISSUE 12 satellite): under the deep pipeline a
+    dispatched tile can sit UNFINALIZED in self._prev — its bindings
+    are not in the commit queue yet. A drain_commits barrier enqueued
+    before that handoff would fire with the tile still in flight; the
+    barrier must instead wait for the tile's landed event (set after
+    the handoff) so FIFO puts it behind the bindings."""
+    import threading
+
+    from kubernetes_tpu.sched.batch import _Inflight
+
+    registry = Registry()
+    client = InProcClient(registry)
+    factory = ConfigFactory(client, rate_limit=False).start()
+    sched = BatchScheduler(factory.create_batch())
+    # start ONLY the committer: the scheduler thread stays unstarted so
+    # the test controls the handoff ordering deterministically
+    sched._commit_thread = threading.Thread(
+        target=sched._commit_loop, daemon=True)
+    sched._commit_thread.start()
+    order = []
+    sched._commit = lambda scheduled, inc_assumed: order.append("commit")
+    try:
+        fl = _Inflight(pods=[], enc=None, assigned=None, state=None,
+                       epoch=0, flags=(False, False), t_start=0.0,
+                       t_dev=0.0)
+        sched._prev = fl  # dispatched-but-unfinalized
+
+        drained = threading.Event()
+
+        def drain():
+            sched.drain_commits(timeout=10.0)
+            order.append("drained")
+            drained.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        # the barrier must NOT fire while the tile is unfinalized
+        assert not drained.wait(0.25)
+        assert order == []
+        # _finalize's handoff order: bindings enqueue, THEN landed fires
+        sched._commit_q.put([("pod", "host")])
+        fl.landed.set()
+        assert drained.wait(5.0)
+        # the barrier rode BEHIND the bindings: commit before drain
+        assert order == ["commit", "drained"]
+    finally:
+        sched._commit_q.put(None)
+        sched._commit_thread.join(timeout=5)
+        factory.stop()
+
+
 def test_modeler_forget_wins_over_late_assume():
     """A confirm-reflector forget that lands BEFORE the committer's
     assume must not leave the pod assumed (phantom capacity until the

@@ -275,10 +275,13 @@ def test_chaos_soak_converges_with_single_bindings():
         assert trace[verb] == plan.schedule(verb, len(trace[verb])), verb
     # lock-witness gate: zero order inversions across every thread the
     # storm ran, and the ledger lock never held through a publish-sized
-    # window (the budget is deliberately loose — GIL stalls on a loaded
+    # window (the budget stays loose enough that GIL stalls on a loaded
     # box are not regressions; fan-out creeping back under the ledger
-    # lock grows with the pod count and is)
-    witness.assert_clean(max_hold={"store.ledger": 1.0})
+    # lock grows with the pod count and is). Tightened from 1.0s once
+    # commit_txn collapsed the per-chunk batch loops into one window
+    # per tile/burst (ISSUE 12) — the soak's worst hold dropped with
+    # the re-acquisition churn.
+    witness.assert_clean(max_hold={"store.ledger": 0.5})
     rep = witness.report()
     assert rep["locks"]["store.ledger"]["acquisitions"] > 0
     assert rep["locks"]["store.publish"]["acquisitions"] > 0

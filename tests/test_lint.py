@@ -148,6 +148,12 @@ LOCK_BAD = [
                     with self._pub_lock:
                         pass
         """, "lock-order-inversion"),
+    ("publish_under_ledger_in_commit_txn", """
+        class Store:
+            def commit_txn(self, ops):
+                with self._lock:
+                    self._drain_publish()
+        """, "publish-under-ledger-lock"),
 ]
 
 LOCK_GOOD = [
@@ -157,6 +163,14 @@ LOCK_GOOD = [
                 with self._lock:
                     self._wal.append(1)
                     self._wal_sync()
+        """),
+    ("txn_wal_frame_is_sanctioned", """
+        class Store:
+            def commit_txn(self, ops):
+                with self._lock:
+                    self._wal.append_txn(records)
+                    self._wal_sync()
+                self._drain_publish()
         """),
     ("publish_after_release", """
         class Store:

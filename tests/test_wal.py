@@ -267,6 +267,185 @@ class TestFirstClassExpiry:
         assert "/registry/events/default/e1" not in s._data
 
 
+def _bind_to(node):
+    return lambda p: replace(p, spec=replace(p.spec, node_name=node))
+
+
+def drive_txn_workload(s: Store, n: int = 12) -> None:
+    """Singles interleaved with multi-key transactions: the WAL carries
+    both plain frames and TXN frames, in both orders."""
+    for i in range(n):
+        s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+    s.commit_txn([(pod_key(f"p{i}"), _bind_to("n1")) for i in range(5)])
+    s.set(pod_key("p5"), mkpod("p5"))
+    s.delete(pod_key("p6"))
+    s.commit_txn([(pod_key(f"p{i}"), _bind_to("n2"))
+                  for i in range(7, n)])
+    s.create(pod_key("tail"), mkpod("tail"))
+
+
+@pytest.mark.durability
+class TestTxnCommit:
+    """Store.commit_txn — one revision window, one WAL TXN frame, one
+    ordered publish batch (ISSUE 12 tentpole)."""
+
+    def test_single_revision_window_ordering(self):
+        s = Store()
+        for i in range(10):
+            s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+        rev0 = s.current_revision
+        w = s.watch("/registry/pods/", since_rev=rev0)
+        out = s.commit_txn([(pod_key(f"p{i}"), _bind_to("n1"))
+                            for i in range(10)])
+        # the whole window is one pre-assigned consecutive rev range
+        assert [int(o.metadata.resource_version) for o in out] == \
+            list(range(rev0 + 1, rev0 + 11))
+        assert s.current_revision == rev0 + 10
+        # the publish batch lands the window IN ORDER, exactly once
+        evs = list(iter(lambda: w.next(timeout=0.5), None))
+        assert [int(e.object.metadata.resource_version) for e in evs] == \
+            list(range(rev0 + 1, rev0 + 11))
+        assert all(e.type == "MODIFIED" for e in evs)
+        # _published_rev jumped the entire window at once
+        assert s._published_rev == s.current_revision
+        w.stop()
+
+    def test_txn_ledger_bit_identical_to_chunked_batch(self):
+        """The txn verb is an op-for-op semantic twin of batch(): two
+        stores driven with the same ops — one whole-window txn, one
+        per-chunk batch loop (the --txn-ab control arm) — end
+        bit-identical."""
+        a, b = Store(), Store()
+        for s in (a, b):
+            for i in range(9):
+                s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+        ops = [(pod_key(f"p{i}"), _bind_to("n1")) for i in range(9)]
+        a.commit_txn(ops)
+        for lo in range(0, 9, 3):  # chunked control arm
+            b.batch(ops[lo:lo + 3])
+        assert_stores_equal(a, b)
+
+    def test_txn_is_all_or_nothing(self):
+        s = Store()
+        s.create(pod_key("p0"), mkpod("p0"))
+        rev0 = s.current_revision
+        with pytest.raises(NotFound):
+            s.commit_txn([(pod_key("p0"), _bind_to("n1")),
+                          (pod_key("ghost"), _bind_to("n1"))])
+        # nothing committed: no revision burned, p0 untouched
+        assert s.current_revision == rev0
+        assert not s.get(pod_key("p0")).spec.node_name
+
+    def test_mid_txn_watch_registration_exactly_once(self):
+        """A watch registered at a since_rev INSIDE a committed txn
+        window replays the tail of that window and hands off to live
+        txn publishes with no duplicate and no gap."""
+        s = Store()
+        for i in range(10):
+            s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+        rev0 = s.current_revision
+        s.commit_txn([(pod_key(f"p{i}"), _bind_to("n1"))
+                      for i in range(10)])  # revs rev0+1 .. rev0+10
+        mid = rev0 + 4  # inside txn A's window
+        w = s.watch("/registry/pods/", since_rev=mid)
+        s.commit_txn([(pod_key(f"p{i}"), _bind_to("n2"))
+                      for i in range(10)])  # revs rev0+11 .. rev0+20
+        evs = list(iter(lambda: w.next(timeout=0.5), None))
+        # replayed tail of txn A (+5..+10) then live txn B — contiguous,
+        # exactly once
+        assert [int(e.object.metadata.resource_version) for e in evs] == \
+            list(range(mid + 1, rev0 + 21))
+        w.stop()
+
+    def test_concurrent_watch_registration_no_dup_no_gap(self):
+        """Watchers racing registration against a committer thread's
+        txn stream each observe a contiguous, duplicate-free suffix."""
+        import threading as _th
+        s = Store()
+        n_keys, n_txns = 25, 12
+        for i in range(n_keys):
+            s.create(pod_key(f"p{i}"), mkpod(f"p{i}"))
+        start_rev = s.current_revision
+        watchers = []
+
+        def committer():
+            for t in range(n_txns):
+                s.commit_txn([(pod_key(f"p{i}"), _bind_to(f"n{t}"))
+                              for i in range(n_keys)])
+
+        def register():
+            since = s.current_revision
+            watchers.append((since, s.watch("/registry/pods/",
+                                            since_rev=since)))
+
+        c = _th.Thread(target=committer)
+        c.start()
+        for _ in range(4):
+            register()
+            time.sleep(0.002)
+        c.join()
+        final = s.current_revision
+        assert final == start_rev + n_keys * n_txns
+        for since, w in watchers:
+            revs = [int(e.object.metadata.resource_version)
+                    for e in iter(lambda: w.next(timeout=0.5), None)]
+            # exactly the (since, final] suffix — no dup, no gap,
+            # whether each event arrived via replay or live publish
+            assert revs == list(range(since + 1, final + 1)), \
+                (since, revs[:5], revs[-5:] if revs else [])
+            w.stop()
+
+    def test_torn_final_txn_frame_truncates_atomically(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        for i in range(4):
+            s.create(pod_key(f"t{i}"), mkpod(f"t{i}"))
+        s.commit_txn([(pod_key(f"t{i}"), _bind_to("n1"))
+                      for i in range(4)])  # revs 5..8, ONE frame
+        s.wal_close()
+        seg = sorted(f for f in os.listdir(d) if f.endswith(".seg"))[-1]
+        path = os.path.join(d, seg)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 5)
+        r = Store.recover(d)
+        # the WHOLE txn is gone — not a prefix of it (a partial window
+        # would tear the all-or-nothing contract the committer observed)
+        assert r.current_revision == 4
+        assert all(not r.get(pod_key(f"t{i}")).spec.node_name
+                   for i in range(4))
+        # the reader repaired the tail: a second recovery is clean
+        assert Store.recover(d).current_revision == 4
+
+    def test_corrupt_txn_frame_mid_chain_raises(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d, wal_segment_records=4)
+        for i in range(4):
+            s.create(pod_key(f"c{i}"), mkpod(f"c{i}"))  # fills seg 1
+        s.commit_txn([(pod_key(f"c{i}"), _bind_to("n1"))
+                      for i in range(4)])  # seg 2 = one TXN frame
+        for i in range(4, 6):
+            s.create(pod_key(f"c{i}"), mkpod(f"c{i}"))  # seg 3
+        s.wal_close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".seg"))
+        assert len(segs) >= 3
+        path = os.path.join(d, segs[1])
+        blob = bytearray(open(path, "rb").read())
+        blob[12] ^= 0xFF  # payload byte inside the TXN frame
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(WalCorrupt):
+            read_wal(d)
+
+    def test_recover_mixed_txn_wal_bit_identical(self, tmp_path):
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        drive_txn_workload(s)
+        s.wal_close()
+        r = Store.recover(d)
+        assert_stores_equal(s, r)
+        assert [(t[0], t[1], t[2], t[3]) for t in s._history] == \
+            [(t[0], t[1], t[2], t[3]) for t in r._history]
+
+
 @pytest.mark.durability
 class TestNativeRecovery:
     def _native(self):
@@ -299,6 +478,28 @@ class TestNativeRecovery:
             p, spec=replace(p.spec, node_name="n2")))
         assert int(out.metadata.resource_version) == \
             py.current_revision + 1
+
+    def test_native_recover_parity_on_txn_wal(self, tmp_path):
+        """Mixed single/TXN WAL replays bit-identically through the
+        native kv_replay_txn path (one mutex window per frame) and the
+        Python recover."""
+        NativeStore = self._native()
+        d = str(tmp_path / "wal")
+        s = Store(wal_dir=d)
+        drive_txn_workload(s)
+        s.wal_close()
+        py = Store.recover(d)
+        nat = NativeStore.recover(d)
+        assert nat.current_revision == py.current_revision
+        assert nat.recovery_stats["replayed_records"] == \
+            py.recovery_stats["replayed_records"]
+        py_items, py_rev = py.list("/registry/pods/")
+        nat_items, nat_rev = nat.list("/registry/pods/")
+        assert nat_rev == py_rev
+        assert [(o.metadata.name, o.metadata.resource_version,
+                 o.spec.node_name) for o in nat_items] == \
+            [(o.metadata.name, o.metadata.resource_version,
+              o.spec.node_name) for o in py_items]
 
     def test_native_first_class_expiry(self):
         NativeStore = self._native()

@@ -43,7 +43,13 @@ STORE_PUBLISH_FRAMES = {"store.py:_drain_publish", "store.py:_fanout",
 STORE_COMMIT_FRAMES = {"store.py:create", "store.py:create_batch",
                        "store.py:set", "store.py:update",
                        "store.py:guaranteed_update", "store.py:delete",
-                       "store.py:batch"}
+                       "store.py:batch", "store.py:commit_txn"}
+
+# Device-execution frames: a tick with one thread inside these AND
+# another thread inside a ledger commit is the async bind pipeline
+# doing both halves of its job at once (tile N+1 encoding/scanning
+# while tile N's bindings commit) — the scan/commit overlap readout.
+DEVICE_FRAMES = {"engine.py:run_chunked", "incremental.py:encode_tile"}
 
 
 def thread_group(name: str) -> str:
@@ -129,7 +135,14 @@ def main():
     by_thread = collections.Counter()  # group -> count
     run_by_thread = collections.Counter()
     phase = collections.Counter()      # (group, "ledger"|"publish") -> count
+    overlap_ticks = 0                  # device scan ∥ ledger commit
+    device_ticks = 0
+    ledger_ticks = 0
+    hold_runs = collections.defaultdict(list)  # role -> [run lengths]
+    hold_cur = collections.Counter()           # role -> current run
     for _ts, snap in window:
+        tick_device = False
+        tick_ledger_roles = set()
         for name, lf, stack in snap:
             g = thread_group(name)
             by_thread[g] += 1
@@ -137,12 +150,30 @@ def main():
             if lf.rsplit(":", 2)[-2] not in WAIT_LEAVES:
                 run_by_thread[g] += 1
             frames = set(stack)
+            if frames & DEVICE_FRAMES:
+                tick_device = True
             if frames & STORE_PUBLISH_FRAMES:
                 phase[(g, "publish")] += 1
             elif frames & STORE_COMMIT_FRAMES:
                 phase[(g, "ledger")] += 1
+                tick_ledger_roles.add(g)
             for fn in frames:
                 incl[(g, fn)] += 1
+        if tick_device:
+            device_ticks += 1
+        if tick_ledger_roles:
+            ledger_ticks += 1
+        if tick_device and tick_ledger_roles:
+            overlap_ticks += 1
+        # ledger-hold run lengths: consecutive ticks a role stays inside
+        # the in-lock phase ~ one lock-hold window (0.002s resolution)
+        for g in list(hold_cur):
+            if g not in tick_ledger_roles:
+                hold_runs[g].append(hold_cur.pop(g))
+        for g in tick_ledger_roles:
+            hold_cur[g] += 1
+    for g, c in hold_cur.items():
+        hold_runs[g].append(c)
 
     total = sum(by_thread.values())
     wait = sum(c for (g, site), c in leaf.items()
@@ -204,6 +235,37 @@ in-lock share is what the three committers still serialize on.
             tot = led + pub
             f.write(f"| {g} | {led} | {pub} | "
                     f"{100 * led / max(1, tot):.0f}% |\n")
+        tick_s = r.elapsed_s / max(1, n_ticks)
+
+        def pctile(xs, p):
+            if not xs:
+                return 0.0
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(p * len(xs)))] * tick_s
+
+        f.write(f"""
+## Pipeline overlap (scan ∥ commit)
+
+A window tick is *overlapped* when one thread is inside a device
+frame ({", ".join(sorted(DEVICE_FRAMES))}) while another holds the
+ledger — the async bind pipeline executing tile N+1 on device while
+tile N's bindings commit. Ledger-hold percentiles are run lengths of
+consecutive in-lock ticks per committer (~one lock-hold window,
+{1000 * tick_s:.1f}ms resolution).
+
+- device-execution ticks: {device_ticks} ({100 * device_ticks / max(1, n_ticks):.1f}% of window)
+- ledger-commit ticks: {ledger_ticks} ({100 * ledger_ticks / max(1, n_ticks):.1f}% of window)
+- **overlapped ticks: {overlap_ticks}** ({100 * overlap_ticks / max(1, n_ticks):.1f}% of window, {100 * overlap_ticks / max(1, device_ticks):.1f}% of device time)
+
+| committer | holds | p50 hold | p99 hold | max hold |
+|---|---|---|---|---|
+""")
+        for g in sorted(hold_runs):
+            runs_g = hold_runs[g]
+            f.write(f"| {g} | {len(runs_g)} | "
+                    f"{1000 * pctile(runs_g, 0.50):.1f}ms | "
+                    f"{1000 * pctile(runs_g, 0.99):.1f}ms | "
+                    f"{1000 * max(runs_g) * tick_s:.1f}ms |\n")
         f.write(f"""
 ## Top leaf lines
 
@@ -220,7 +282,11 @@ in-lock share is what the three committers still serialize on.
     print(json.dumps({"pods_per_sec": round(r.pods_per_sec, 1),
                       "elapsed_s": round(r.elapsed_s, 2),
                       "scheduled": r.scheduled,
-                      "window_ticks": n_ticks, "out": args.out}))
+                      "window_ticks": n_ticks,
+                      "overlap_ticks": overlap_ticks,
+                      "device_ticks": device_ticks,
+                      "ledger_ticks": ledger_ticks,
+                      "out": args.out}))
 
 
 if __name__ == "__main__":

@@ -44,7 +44,12 @@ budget as rules and tree both grow); r10 adds pipeline (the --txn-ab
 multi-key-transaction A/B: the headline arm commits each bind tile /
 status burst as ONE store.commit_txn revision window while the
 control arm restores the per-1024-op store.batch() chunk loops),
-null unless requested.
+null unless requested; r11 adds obs (the --trace causal-tracing arm:
+one traced pass recording the pod-lifecycle stage decomposition —
+per-stage p50/p99 from pod_e2e_stage_seconds plus the
+stage-coverage-of-e2e-wall ratio, gated >=90% — and one tracing-off
+control pass gating the tracer's throughput cost at <5%), null
+unless requested.
 """
 
 import argparse
@@ -248,6 +253,16 @@ def main():
                          "store.batch() chunks, the pre-txn commit "
                          "shape) and report both arms in the "
                          "pipeline section")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the causal-tracing A/B arm: one e2e pass "
+                         "with a fresh seeded obs tracer (recording the "
+                         "per-stage latency decomposition and the "
+                         "stage-coverage ratio against that pass's e2e "
+                         "wall) and one pass with tracing disabled (the "
+                         "overhead control); records the obs section")
+    ap.add_argument("--trace-seed", type=int, default=0,
+                    help="seed for the --trace arm's tracer (span ids "
+                         "are a pure function of seed + counter)")
     ap.add_argument("--chaos-seed", type=int, default=None,
                     help="also record one e2e pass under the seeded "
                          "chaos injector (chaos.ChaosClient, "
@@ -406,6 +421,64 @@ def main():
             print(f"# txn A/B chunked {tc.pods_per_sec:.0f} vs "
                   f"txn {r.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
+    obs_section = None
+    if args.trace:
+        # the causal-tracing arm (ISSUE 13): a traced pass decomposes
+        # the run's wall-clock into the pinned lifecycle stages
+        # (create -> queue -> schedule -> device -> bind -> publish ->
+        # confirm); coverage is the staged seconds summed over the
+        # traced pass's e2e wall (>=90% or the decomposition is lying
+        # by omission), overhead is traced vs untraced throughput
+        # (<5% or the NOOP fast path regressed)
+        from kubernetes_tpu import obs as obspkg
+        from kubernetes_tpu.utils.metrics import (OBS_STAGE_SUMMARY,
+                                                  MetricsRegistry)
+        # best of two per arm, same as the headline runs above — a
+        # single-shot A/B can't gate at 5% on a ±20%-noise box
+        tron = mreg = None
+        n_spans = 0
+        for _ in range(2):
+            reg = MetricsRegistry()
+            obspkg.configure(seed=args.trace_seed, metrics=reg)
+            r = run_scheduling_benchmark(args.nodes, args.pods, "batch")
+            if tron is None or r.pods_per_sec > tron.pods_per_sec:
+                tron, mreg = r, reg
+                n_spans = len(obspkg.tracer().spans())
+        stages = {}
+        staged_sum = 0.0
+        for k, st in sorted(mreg.summary_stats(OBS_STAGE_SUMMARY).items()):
+            stage = dict(k).get("stage", "?")
+            staged_sum += st["sum"]
+            stages[stage] = {"count": int(st["count"]),
+                             "sum_s": round(st["sum"], 3),
+                             "p50_ms": round(st["p50"] * 1e3, 3),
+                             "p99_ms": round(st["p99"] * 1e3, 3)}
+        coverage = (staged_sum / tron.elapsed_s) if tron.elapsed_s else None
+        obspkg.configure(seed=args.trace_seed, enabled=False)
+        troff = max((run_scheduling_benchmark(args.nodes, args.pods,
+                                              "batch") for _ in range(2)),
+                    key=lambda x: x.pods_per_sec)
+        obspkg.configure(seed=args.trace_seed)  # back to the default
+        overhead = (1.0 - tron.pods_per_sec / troff.pods_per_sec
+                    if troff.pods_per_sec else None)
+        obs_section = {
+            "seed": args.trace_seed,
+            "traced_pods_per_sec": round(tron.pods_per_sec, 1),
+            "untraced_pods_per_sec": round(troff.pods_per_sec, 1),
+            "overhead_frac": (round(overhead, 4)
+                              if overhead is not None else None),
+            "overhead_ok": (overhead is not None and overhead < 0.05),
+            "spans": n_spans,
+            "stage_coverage_frac": (round(coverage, 3)
+                                    if coverage is not None else None),
+            "stage_coverage_ok": (coverage is not None
+                                  and coverage >= 0.90),
+            "stages": stages}
+        if args.verbose:
+            print(f"# obs traced {tron.pods_per_sec:.0f} vs untraced "
+                  f"{troff.pods_per_sec:.0f} pods/s "
+                  f"(overhead {overhead:.2%}, coverage {coverage:.2f}, "
+                  f"{n_spans} spans)", file=sys.stderr)
     chaos = None
     if args.chaos_seed is not None:
         # the fault-load arm: same shape, every component client wrapped
@@ -638,6 +711,7 @@ def main():
         "slo": slo,
         "store_ab": store_ab,
         "pipeline": pipeline,
+        "obs": obs_section,
         "chaos": chaos,
         "node_chaos": node_chaos,
         "durability": durability,

@@ -95,13 +95,24 @@ class FIFO:
         self._cond = threading.Condition()
         self._items: Dict[str, Any] = {}
         self._queue: deque = deque()
+        self._stamps: Dict[str, float] = {}
         self._closed = False
+        #: queue-wait of the most recently popped object (monotonic
+        #: seconds from first enqueue to pop) — the scheduler reads it
+        #: right after pop() to time the pipeline's "queue" stage; a
+        #: plain attribute is enough because the pending queue has one
+        #: consumer (matches the reference's single scheduling loop)
+        self.last_pop_wait = 0.0
 
     def add(self, obj: Any) -> None:
         key = meta_namespace_key(obj)
         with self._cond:
             if key not in self._items:
                 self._queue.append(key)
+                # first-enqueue stamp: coalesced updates keep the
+                # original arrival time (the pod has been waiting since
+                # it first showed up, not since its last update)
+                self._stamps.setdefault(key, time.monotonic())
             self._items[key] = obj
             self._cond.notify()
 
@@ -109,7 +120,9 @@ class FIFO:
 
     def delete(self, obj: Any) -> None:
         with self._cond:
-            self._items.pop(meta_namespace_key(obj), None)
+            key = meta_namespace_key(obj)
+            self._items.pop(key, None)
+            self._stamps.pop(key, None)
             # key stays in deque; pop skips dead keys (add() may re-queue the
             # same key later — pop's items-membership check dedupes)
 
@@ -119,6 +132,10 @@ class FIFO:
                 while self._queue:
                     key = self._queue.popleft()
                     if key in self._items:
+                        stamp = self._stamps.pop(key, None)
+                        self.last_pop_wait = (
+                            time.monotonic() - stamp
+                            if stamp is not None else 0.0)
                         return self._items.pop(key)
                 if self._closed:
                     return None

@@ -19,6 +19,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Iterator, List, Optional, Tuple
 
+from .. import obs
 from ..core import types as api
 from ..core.errors import (ApiError, BadGateway, BadRequest, NotFound,
                            from_status)
@@ -393,17 +394,51 @@ class HttpClient(Client):
         defaults to method == GET; verb methods pass True when the
         request carries its own replay guard (uid precondition, CAS
         resourceVersion). Streams bypass retry — their consumers
-        (Reflector, log followers) own reconnection."""
+        (Reflector, log followers) own reconnection.
+
+        Tracing: one root span per logical request; every retry
+        attempt is a sibling child span carrying its OWN traceparent
+        (fresh span id, shared trace id), so the server's spans show
+        which attempt committed and which were lost."""
+        tr = obs.tracer()
         if stream:
-            return self._do_once(method, url, body, stream, raw_body,
-                                 content_type)
+            ctx = obs.current()
+            return self._do_once(
+                method, url, body, stream, raw_body, content_type,
+                traceparent=obs.format_traceparent(ctx) if ctx else None)
         if idempotent is None:
             idempotent = method in ("GET", "HEAD")
-        return self.retry.call(
-            lambda: self._do_once(method, url, body, False, raw_body,
-                                  content_type),
-            idempotent=idempotent, breaker=self._breaker,
-            probe=self._probe_healthz)
+        if not tr.enabled:
+            return self.retry.call(
+                lambda: self._do_once(method, url, body, False, raw_body,
+                                      content_type),
+                idempotent=idempotent, breaker=self._breaker,
+                probe=self._probe_healthz)
+        root = tr.start_span(
+            f"http {method}", parent=obs.current(),
+            attrs={"path": urllib.parse.urlsplit(url).path})
+
+        def attempt():
+            span = tr.start_span(f"http {method} attempt", parent=root)
+            try:
+                resp = self._do_once(
+                    method, url, body, False, raw_body, content_type,
+                    traceparent=obs.format_traceparent(span))
+            except BaseException:
+                tr.end(span, status="error")
+                raise
+            tr.end(span)
+            return resp
+
+        try:
+            result = self.retry.call(
+                attempt, idempotent=idempotent, breaker=self._breaker,
+                probe=self._probe_healthz)
+        except BaseException:
+            tr.end(root, status="error")
+            raise
+        tr.end(root)
+        return result
 
     def _probe_healthz(self) -> bool:
         """The breaker's recovery probe: one cheap unretried GET."""
@@ -419,9 +454,12 @@ class HttpClient(Client):
 
     def _do_once(self, method: str, url: str, body: Any = None,
                  stream: bool = False, raw_body: Optional[bytes] = None,
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 traceparent: Optional[str] = None):
         data = raw_body
         headers = {"Accept": "application/json", **self.headers}
+        if traceparent:
+            headers["traceparent"] = traceparent
         if body is not None:
             data = self.scheme.encode(body).encode()
         if data is not None:
@@ -619,8 +657,11 @@ class HttpClient(Client):
         else:
             conn = http.client.HTTPConnection(split.hostname, split.port)
         path = split.path + ("?" + split.query if split.query else "")
-        conn.request("GET", path,
-                     headers={"Accept": "application/json", **self.headers})
+        watch_headers = {"Accept": "application/json", **self.headers}
+        ctx = obs.current()
+        if ctx is not None:
+            watch_headers["traceparent"] = obs.format_traceparent(ctx)
+        conn.request("GET", path, headers=watch_headers)
         resp = conn.getresponse()
         if resp.status != 200:
             body = resp.read().decode()

@@ -20,6 +20,7 @@ import uuid
 from dataclasses import dataclass, replace, field as dc_field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import obs
 from ..core import fields as fieldspkg
 from ..core import intstr
 from ..core import labels as labelspkg
@@ -554,6 +555,12 @@ class Registry:
                 for port in allocated_ports:
                     self.port_allocator.release(port)
                 raise
+        if resource == "pods":
+            # the "create" stage of the pod lifecycle model: the
+            # server-side store commit (utils/metrics.OBS_STAGES)
+            with obs.tracer().span("registry.create", stage="create"):
+                return self.store.create(self.key(resource, ns, name),
+                                         obj, ttl=info.ttl)
         return self.store.create(self.key(resource, ns, name), obj, ttl=info.ttl)
 
     def _prepare_create(self, info: "ResourceInfo", resource: str, obj: Any,
@@ -570,10 +577,24 @@ class Registry:
         if not name and meta.generate_name:
             # ref: pkg/api/rest names.SimpleNameGenerator (5 random chars)
             name = meta.generate_name + _name_suffix(5)
+        # create-time trace context rides the object as an annotation:
+        # through the store, the WAL, every watch replay/live delivery
+        # and every wire serialization — how the scheduler's informer
+        # links a tile back to the create that fed it (obs layer). A
+        # client-stamped annotation wins (cross-process creates where
+        # the caller owns the root span).
+        annotations = meta.annotations
+        ctx = obs.current()
+        if ctx is not None and obs.tracer().enabled \
+                and obs.TRACEPARENT_ANNOTATION not in annotations:
+            annotations = {**annotations,
+                           obs.TRACEPARENT_ANNOTATION:
+                           obs.format_traceparent(ctx)}
         meta = api.fast_replace(
             meta, name=name, namespace=ns,
             uid=meta.uid or _new_uid(),
             creation_timestamp=meta.creation_timestamp or api.now_rfc3339(),
+            annotations=annotations,
             resource_version="")
         obj = api.fast_replace(obj, metadata=meta)
         if resource == "namespaces" and not obj.spec.finalizers:
@@ -612,6 +633,10 @@ class Registry:
         # stamp the revision in place instead of re-cloning both per
         # object (the clone pair was most of the create storm's work
         # under the store lock, PROFILE_e2e.md)
+        if resource == "pods":
+            with obs.tracer().span("registry.create_batch", stage="create",
+                                   attrs={"pods": len(entries)}):
+                return self.store.create_batch(entries, owned_meta=True)
         return self.store.create_batch(entries, owned_meta=True)
 
     def create_from_template(self, resource: str, template: Any,
@@ -644,6 +669,16 @@ class Registry:
         ns = self._namespace_for(info, template, namespace)
         ts = api.now_rfc3339()
         tm = template.metadata
+        # same traceparent stamping as _prepare_create, once for the
+        # whole batch: the rows share the creating span's context (one
+        # logical create storm, one trace exemplar per template)
+        ctx = obs.current()
+        if ctx is not None and obs.tracer().enabled \
+                and obs.TRACEPARENT_ANNOTATION not in tm.annotations:
+            tm = api.fast_replace(
+                tm, annotations={**tm.annotations,
+                                 obs.TRACEPARENT_ANNOTATION:
+                                 obs.format_traceparent(ctx)})
         # template-wide validation once, against a representative row
         rep = api.fast_replace(
             template, metadata=api.fast_replace(
@@ -669,6 +704,11 @@ class Registry:
                       creation_timestamp=ts, resource_version="")
             entries.append((key_prefix + name, fr(template, metadata=meta),
                             info.ttl))
+        if resource == "pods":
+            with obs.tracer().span("registry.create_from_template",
+                                   stage="create",
+                                   attrs={"pods": len(entries)}):
+                return self.store.create_batch(entries, owned_meta=True)
         return self.store.create_batch(entries, owned_meta=True)
 
     def _service_allocate(self, obj: api.Service):

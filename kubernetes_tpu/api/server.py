@@ -31,6 +31,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 
+from .. import obs
 from ..auth.authenticate import authenticate_request
 from ..auth.authorize import AuthorizerAttributes
 from ..core.errors import (ApiError, BadGateway, BadRequest, Forbidden,
@@ -274,6 +275,24 @@ class ApiServer:
             err.retry_after = self.shed_retry_after
             self._send_error(h, err)
             return
+        # the SERVER span: extracted traceparent (or a fresh trace) for
+        # every routed request, installed as the current context so
+        # registry/store spans nest under it. A span exists per request
+        # ARRIVAL — an injected client-side fault never reaches here,
+        # and a bare POST is never replayed after ambiguous loss, which
+        # together are why "one server span per committed object" holds
+        # under chaos (tests/test_obs.py). Self-observation endpoints
+        # are excluded, as are breaker/LB health probes.
+        tracer = obs.tracer()
+        server_span = obs.NOOP
+        if tracer.enabled and path not in ("/healthz", "/healthz/ping",
+                                           "/metrics", "/debug/trace"):
+            res0 = _authz_target(path)[0]
+            server_span = tracer.start_span(
+                f"apiserver {method} {res0 or path}",
+                parent=obs.parse_traceparent(h.headers.get("traceparent")),
+                attrs={"verb": method, "resource": res0 or "none"})
+        span_status = "error"
         try:
             # handler chain order per master.go:702,710:
             # authenticate -> 401, authorize -> 403, then route.
@@ -317,14 +336,18 @@ class ApiServer:
                     name = user.name if user else "unknown"
                     raise Forbidden(f"user {name!r} cannot "
                                     f"{method} {resource or path}")
-            self._route(h, method, path, query)
+            with obs.use(server_span):
+                self._route(h, method, path, query)
+            span_status = "ok"
         except ApiError as e:
+            span_status = f"error:{e.code}"
             self._send_error(h, e)
         except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:  # crash-only server, but report the request
             self._send_error(h, ApiError(f"internal error: {e!r}"))
         finally:
+            tracer.end(server_span, status=span_status)
             if not long_running:
                 self._inflight.release()
             # per-verb AND per-resource service time, server-side — the
@@ -353,6 +376,18 @@ class ApiServer:
         if path == "/metrics":
             return self._send_raw(h, 200, self.metrics.render().encode(),
                                   "text/plain; version=0.0.4")
+        if path == "/debug/trace":
+            # the span buffer next to /metrics: ?format=perfetto
+            # (default) is trace-event JSON for ui.perfetto.dev /
+            # chrome://tracing; ?format=spans is the raw span dump
+            # tools/trace_report.py analyzes
+            t = obs.tracer()
+            if query.get("format") == "spans":
+                body = json.dumps([s.to_dict() for s in t.spans()])
+            else:
+                body = t.export_json()
+            return self._send_raw(h, 200, body.encode(),
+                                  "application/json")
         if path == "/swaggerapi":
             from .swagger import swagger_api
             return self._send_json(h, 200, swagger_api(self.url))

@@ -53,6 +53,7 @@ from collections import OrderedDict, deque
 from dataclasses import replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from .. import obs
 from ..utils.clock import REAL, Clock
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
@@ -516,6 +517,9 @@ class Store:
         the revision is then stamped in place instead of through two
         clone passes per object, which is most of what the create storm
         used to do under the store lock (PROFILE_e2e.md)."""
+        tr = obs.tracer()
+        t0 = tr.clock.monotonic() if tr.enabled else 0.0
+        t1 = None
         try:
             with self._lock:
                 self._gc_expired()
@@ -550,9 +554,18 @@ class Store:
                 self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
-                return out
+            if tr.enabled:
+                t1 = tr.clock.monotonic()
         finally:
             self._drain_publish()
+            if t1 is not None:
+                ctx = obs.current()
+                t2 = tr.clock.monotonic()
+                tr.record("store.create_batch.ledger", t0, t1, parent=ctx,
+                          attrs={"ops": len(out)})
+                tr.record("store.create_batch.publish", t1, t2, parent=ctx,
+                          stage="publish", attrs={"ops": len(out)})
+        return out
 
     def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         """Unconditional write (ref: etcd_helper Set)."""
@@ -677,6 +690,9 @@ class Store:
         per drain this loop IS the host-side commit cost
         (PROFILE_e2e.md's bind/status whales)."""
         out = []
+        tr = obs.tracer()
+        t0 = tr.clock.monotonic() if tr.enabled else 0.0
+        t1 = None
         try:
             with self._lock:
                 self._gc_expired()
@@ -752,8 +768,17 @@ class Store:
                 self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
+            if tr.enabled:
+                t1 = tr.clock.monotonic()
         finally:
             self._drain_publish()
+            if t1 is not None:
+                ctx = obs.current()
+                t2 = tr.clock.monotonic()
+                tr.record("store.batch.ledger", t0, t1, parent=ctx,
+                          attrs={"ops": len(out)})
+                tr.record("store.batch.publish", t1, t2, parent=ctx,
+                          stage="publish", attrs={"ops": len(out)})
         return out
 
     def commit_txn(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
@@ -775,6 +800,9 @@ class Store:
         in-lock binder). batch() is kept verbatim as the A/B control
         arm (bench.py --txn-ab)."""
         out = []
+        tr = obs.tracer()
+        t0 = tr.clock.monotonic() if tr.enabled else 0.0
+        t1 = None
         try:
             with self._lock:
                 self._gc_expired()
@@ -836,8 +864,20 @@ class Store:
                 self._wal_sync()
                 if self._publish_inline:
                     self._drain_publish()
+            if tr.enabled:
+                t1 = tr.clock.monotonic()
         finally:
             self._drain_publish()
+            if t1 is not None:
+                # span bookkeeping stays outside self._lock (the
+                # lock-witness lint); under publish_inline the fan-out
+                # ran inside the window, so the ledger span absorbs it
+                ctx = obs.current()
+                t2 = tr.clock.monotonic()
+                tr.record("store.txn.ledger", t0, t1, parent=ctx,
+                          attrs={"ops": len(out)})
+                tr.record("store.txn.publish", t1, t2, parent=ctx,
+                          stage="publish", attrs={"ops": len(out)})
         return out
 
     # ------------------------------------------------------------- reads

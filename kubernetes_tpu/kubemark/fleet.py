@@ -24,6 +24,7 @@ import threading
 from dataclasses import replace
 from typing import Dict, Iterable, List, Optional, Set
 
+from .. import obs
 from ..agents.hollow_node import confirm_pod_deletion
 from ..api.cache import Informer, meta_namespace_key
 from ..core import types as api
@@ -328,14 +329,33 @@ class HollowFleet:
             updated = [api.fast_replace(p,
                                         status=self._running_status(p, ts))
                        for p in batch]
-            if len(updated) > 1:
-                try:
-                    self.client.update_status_batch("pods", updated)
-                    continue
-                except Exception:
-                    pass  # degrade to singles: per-pod NotFound handling
-            for p, u in zip(batch, updated):
-                self._status_one(p, u)
+            tr = obs.tracer()
+            span = obs.NOOP
+            if tr.enabled:
+                # "confirm" stage, burst-granular (first pod's
+                # annotation context as exemplar parent): fleet status
+                # batch -> committed closes the pod's e2e decomposition
+                span = tr.start_span("fleet.confirm",
+                                     parent=obs.ctx_of(batch[0]),
+                                     stage="confirm",
+                                     attrs={"pods": len(batch)})
+            try:
+                with obs.use(span):
+                    batched = False
+                    if len(updated) > 1:
+                        try:
+                            self.client.update_status_batch("pods",
+                                                            updated)
+                            batched = True
+                        except Exception:
+                            # degrade to singles: per-pod NotFound
+                            # handling
+                            pass
+                    if not batched:
+                        for p, u in zip(batch, updated):
+                            self._status_one(p, u)
+            finally:
+                tr.end(span)
 
     def _status_one(self, pod: api.Pod, updated: api.Pod) -> None:
         try:

@@ -576,8 +576,13 @@ def _soak_file(name: str) -> bool:
 def _rule_applies(rule: str, path: str) -> bool:
     """Scope map — paths are repo-relative posix."""
     if rule == "determinism":
+        # obs/ is in the family: span IDs must stay a pure function of
+        # (seed, counter) and timestamps must ride the injectable Clock
+        # — wall-clock or process RNG there breaks the byte-identical
+        # same-seed trace-export contract
         return (path.startswith("kubernetes_tpu/chaos/")
                 or path.startswith("kubernetes_tpu/sched/")
+                or path.startswith("kubernetes_tpu/obs/")
                 or (path.startswith("kubernetes_tpu/kubemark/")
                     and _soak_file(path.rsplit("/", 1)[-1])))
     if rule == "lock-discipline":

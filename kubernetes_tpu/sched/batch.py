@@ -33,6 +33,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from .. import obs
 from ..core import types as api
 from ..core.errors import NotFound
 from ..utils.metrics import MetricsRegistry, global_metrics
@@ -158,6 +159,9 @@ class BatchScheduler:
         self._commit_q: "queue.Queue[Optional[list]]" = queue.Queue(
             maxsize=4)
         self._commit_thread: Optional[threading.Thread] = None
+        # longest FIFO wait among the pods of the last drained tile
+        # (scheduler-thread only) — the "queue" stage span reads it
+        self._last_drain_wait = 0.0
 
     def _incremental(self) -> Optional[IncrementalEncoder]:
         """Lazily attach the incremental encoder (the factory's informers
@@ -351,14 +355,24 @@ class BatchScheduler:
     def _drain_tile(self, timeout: float = 0.5) -> List[api.Pod]:
         f = self.config.factory
         pods: List[api.Pod] = []
+        # tile queue-wait = the longest per-pod FIFO wait in the drain
+        # (fifo.pop stamps last_pop_wait; getattr tolerates the fake
+        # queues tests substitute)
+        max_wait = 0.0
+        q_wait = lambda: getattr(f.pod_queue, "last_pop_wait", 0.0)
         pod = f.pod_queue.pop(timeout=timeout)
         if pod is None:
+            self._last_drain_wait = 0.0
             return pods
+        max_wait = q_wait()
         pods.append(pod)
         while len(pods) < self.config.tile_size:
             pod = f.pod_queue.pop(timeout=0)
             if pod is None:
                 break
+            w = q_wait()
+            if w > max_wait:
+                max_wait = w
             pods.append(pod)
         # Top-up while a tile is in flight: until the device reports the
         # previous assignments ready, dispatching this tile would only
@@ -378,7 +392,11 @@ class BatchScheduler:
                 # poll (a full-tile scan runs far longer than 20ms)
                 pod = f.pod_queue.pop(timeout=0.02)
                 if pod is not None:
+                    w = q_wait()
+                    if w > max_wait:
+                        max_wait = w
                     pods.append(pod)
+        self._last_drain_wait = max_wait
         return pods
 
     @staticmethod
@@ -408,6 +426,14 @@ class BatchScheduler:
             for _ in pods:
                 f.rate_limiter.accept()
         start = time.monotonic()
+        tr = obs.tracer()
+        if tr.enabled:
+            # "queue" stage, tile-granular: informer delivery -> this
+            # drain, per the FIFO's first-enqueue stamps; the first
+            # pod's annotation context is the exemplar parent
+            tr.record("sched.queue_wait", start - self._last_drain_wait,
+                      start, parent=obs.ctx_of(pods[0]), stage="queue",
+                      attrs={"pods": len(pods)})
 
         inc = self._incremental()
         if inc is not None:
@@ -445,8 +471,15 @@ class BatchScheduler:
                               (time.monotonic() - start) * 1e6)
             t_dev = time.monotonic()
             hosts, _enc = c.engine.schedule(snap, chunk=chunk)
+            t_done = time.monotonic()
             c.metrics.observe("batch_device_latency_microseconds",
-                              (time.monotonic() - t_dev) * 1e6)
+                              (t_done - t_dev) * 1e6)
+            if tr.enabled:
+                ctx0 = obs.ctx_of(pods[0])
+                tr.record("sched.encode", start, t_dev, parent=ctx0,
+                          stage="schedule", attrs={"pods": len(pods)})
+                tr.record("sched.device", t_dev, t_done, parent=ctx0,
+                          stage="device", attrs={"pods": len(pods)})
         except Exception as e:
             self._fail_tile(pods, e)
             return True
@@ -540,6 +573,15 @@ class BatchScheduler:
         self._prev = _Inflight(pods=pods, enc=enc, assigned=assigned,
                                state=state, epoch=enc.state_epoch,
                                flags=flags, t_start=start, t_dev=t_dev)
+        tr = obs.tracer()
+        if tr.enabled:
+            # "schedule" stage ends at device dispatch; the matching
+            # "device" span closes in _finalize when the assignments
+            # materialize (possibly on the committer thread)
+            tr.record("sched.encode", start, t_dev,
+                      parent=obs.ctx_of(pods[0]), stage="schedule",
+                      attrs={"pods": len(pods),
+                             "chained": str(chained).lower()})
         if chained and prev is not None:
             # scan/commit overlap, committer-side double-buffer: hand
             # tile k over UNFINALIZED — the blocking np.asarray (and the
@@ -585,8 +627,14 @@ class BatchScheduler:
             except Exception as e:
                 self._fail_tile(fl.pods, e)
                 return
+            t_done = time.monotonic()
             c.metrics.observe("batch_device_latency_microseconds",
-                              (time.monotonic() - fl.t_dev) * 1e6)
+                              (t_done - fl.t_dev) * 1e6)
+            tr = obs.tracer()
+            if tr.enabled:
+                tr.record("sched.device", fl.t_dev, t_done,
+                          parent=obs.ctx_of(fl.pods[0]), stage="device",
+                          attrs={"pods": len(fl.pods)})
             enc = fl.enc
             idx = assigned[: enc.n_pods]
             names = enc.node_names
@@ -739,6 +787,15 @@ class BatchScheduler:
                 for p, h in scheduled]
         bind_start = time.monotonic()
         committed: List[bool] = [False] * len(rows)
+        tr = obs.tracer()
+        bind_span = obs.NOOP
+        if tr.enabled and rows:
+            # "bind" stage, tile-granular; installed as current context
+            # so the client's http spans and the store's txn spans nest
+            # under it
+            bind_span = tr.start_span(
+                "sched.bind", parent=obs.ctx_of(scheduled[0][0]),
+                stage="bind", attrs={"pods": len(rows)}, start=bind_start)
         # whole-tile commit by default (commit_chunk=0): the registry
         # routes one multi-key transaction per tile — one ledger-lock
         # acquisition, one WAL frame, one publish fan-out — so the
@@ -751,30 +808,36 @@ class BatchScheduler:
         # way each call keeps all-or-nothing CAS semantics and the
         # per-pod fallback scopes a conflict to its sub-batch.
         commit_chunk = c.commit_chunk or max(1, len(rows))
-        for lo in range(0, len(rows), commit_chunk):
-            part = rows[lo:lo + commit_chunk]
-            try:
-                f.client.bind_batch_hosts(part)
-                committed[lo:lo + len(part)] = [True] * len(part)
-            except Exception:
-                # sub-batch failed (e.g. a pod got bound by another
-                # scheduler mid-flight): degrade to per-pod CAS so one
-                # conflict doesn't waste the rest
-                for i, (ns, name, host) in enumerate(part, start=lo):
+        try:
+            with obs.use(bind_span):
+                for lo in range(0, len(rows), commit_chunk):
+                    part = rows[lo:lo + commit_chunk]
                     try:
-                        f.client.bind(api.Binding(
-                            metadata=api.ObjectMeta(namespace=ns,
-                                                    name=name),
-                            target=api.ObjectReference(kind="Node",
-                                                       name=host)))
-                        committed[i] = True
-                    except Exception as e:
-                        pod = scheduled[i][0]
-                        if f.recorder is not None:
-                            f.recorder.eventf(pod, "Normal",
-                                              "FailedScheduling",
-                                              f"Binding rejected: {e}")
-                        self._bind_failed(pod, host, e)
+                        f.client.bind_batch_hosts(part)
+                        committed[lo:lo + len(part)] = [True] * len(part)
+                    except Exception:
+                        # sub-batch failed (e.g. a pod got bound by
+                        # another scheduler mid-flight): degrade to
+                        # per-pod CAS so one conflict doesn't waste the
+                        # rest
+                        for i, (ns, name, host) in enumerate(part,
+                                                             start=lo):
+                            try:
+                                f.client.bind(api.Binding(
+                                    metadata=api.ObjectMeta(namespace=ns,
+                                                            name=name),
+                                    target=api.ObjectReference(
+                                        kind="Node", name=host)))
+                                committed[i] = True
+                            except Exception as e:
+                                pod = scheduled[i][0]
+                                if f.recorder is not None:
+                                    f.recorder.eventf(
+                                        pod, "Normal", "FailedScheduling",
+                                        f"Binding rejected: {e}")
+                                self._bind_failed(pod, host, e)
+        finally:
+            tr.end(bind_span)
         c.metrics.observe("binding_latency_microseconds",
                           (time.monotonic() - bind_start) * 1e6)
         to_assume = []

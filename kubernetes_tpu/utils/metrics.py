@@ -87,8 +87,10 @@ class MetricsRegistry:
             return {k: list(s._samples)
                     for k, s in self._summaries.get(name, {}).items()}
 
-    def summary_stats(self, name: str) -> Dict[dict, dict]:
-        """-> {labels_dict_as_tuple: {count, sum, p50, p90, p99}} for
+    def summary_stats(self, name: str
+                      ) -> Dict[Tuple[Tuple[str, str], ...],
+                                Dict[str, float]]:
+        """-> {labels_key_tuple: {count, sum, p50, p90, p99}} for
         one summary metric — the server-side read the SLO suite gates
         on (the reference gates on apiserver metrics, not client
         probes: test/e2e/metrics_util.go:194-200)."""
@@ -155,6 +157,25 @@ DURABILITY_COUNTERS = (
     "wal_recoveries_total",     # Store/NativeStore.recover completions
     "leader_transitions_total", # elector acquisitions (label: name)
     "lease_renew_failures_total",  # failed renew attempts (label: name)
+)
+
+#: Pod-lifecycle stage model (the obs tracing layer): every span that
+#: carries a stage tag lands one observation in this summary, so
+#: render() exposes the spans-derived decomposition under ONE stable
+#: metric name — {stage=...} label values are pinned below (no-drift,
+#: like DURABILITY_COUNTERS; bench.py's obs section and the stage
+#: glossary in README both read these names).
+OBS_STAGE_SUMMARY = "pod_e2e_stage_seconds"
+
+#: where a pod's wall-clock goes, create -> kubelet confirm:
+OBS_STAGES = (
+    "create",    # apiserver/registry create commit (server-side)
+    "queue",     # pending FIFO wait: informer delivery -> tile drain
+    "schedule",  # tile snapshot/encode up to device dispatch
+    "device",    # device execute: dispatch -> assignments materialized
+    "bind",      # bind txn: CAS commit of a tile's bindings
+    "publish",   # store publish fan-out to watchers
+    "confirm",   # kubelet confirm: fleet status batch -> committed
 )
 
 #: Workload-replay counters: incremented by the controllers the

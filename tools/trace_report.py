@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Trace report: stage-latency decomposition + critical path from a
+span dump, with optional Chrome/Perfetto trace-event export.
+
+The input is either format the debug endpoint serves — the span dump
+of GET /debug/trace?format=spans (a JSON list of Span.to_dict dicts,
+also what a harness writes from obs.tracer().spans()) or the bare
+GET /debug/trace trace-event JSON, auto-detected — as a file path,
+`-` for stdin, or an http(s) URL to a live apiserver.
+
+Usage:
+  python tools/trace_report.py spans.json
+  python tools/trace_report.py http://127.0.0.1:8080/debug/trace?format=spans
+  python tools/trace_report.py spans.json --trace TRACE_ID   # one trace
+  python tools/trace_report.py spans.json --perfetto out.json
+    (open out.json in ui.perfetto.dev or chrome://tracing)
+
+stdlib-only by design: it must run anywhere the repo does, including
+the bare soak containers.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubernetes_tpu.obs.export import (critical_path, stage_totals,
+                                       to_trace_events)
+from kubernetes_tpu.utils.metrics import OBS_STAGES
+
+
+def _events_to_spans(events: list) -> list:
+    """Fold trace-event JSON (what bare GET /debug/trace serves) back
+    into span dicts — the "X" events carry the full span identity in
+    args, so both endpoint formats feed the same reports."""
+    spans = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        a = dict(e.get("args") or {})
+        start = e["ts"] / 1e6
+        steps = [[t / 1e6, m] for t, m in a.pop("steps", [])]
+        spans.append({
+            "name": e["name"],
+            "trace_id": a.pop("trace_id", ""),
+            "span_id": a.pop("span_id", ""),
+            "parent_id": a.pop("parent_id", None),
+            "status": a.pop("status", "ok"),
+            "stage": None if e.get("cat") in (None, "span") else e["cat"],
+            "start": start,
+            "end": start + e["dur"] / 1e6,
+            "attrs": a,
+            "steps": steps})
+    return spans
+
+
+def load_spans(source: str) -> list:
+    if source == "-":
+        data = json.load(sys.stdin)
+    elif source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            data = json.loads(resp.read().decode())
+    else:
+        with open(source) as fh:
+            data = json.load(fh)
+    if data and isinstance(data[0], dict) and "ph" in data[0]:
+        return _events_to_spans(data)
+    return data
+
+
+def _quantile(samples, q):
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def stage_table(spans: list) -> str:
+    """Per-stage count/total/p50/p99 over finished staged spans, in
+    pipeline order (the OBS_STAGES glossary), then any stray stages."""
+    by_stage = {}
+    for s in spans:
+        if s.get("stage") is None or s.get("end") is None:
+            continue
+        by_stage.setdefault(s["stage"], []).append(s["end"] - s["start"])
+    order = [st for st in OBS_STAGES if st in by_stage]
+    order += sorted(set(by_stage) - set(OBS_STAGES))
+    lines = [f"{'stage':<10} {'count':>7} {'total_s':>10} "
+             f"{'p50_ms':>9} {'p99_ms':>9}"]
+    for st in order:
+        d = by_stage[st]
+        lines.append(f"{st:<10} {len(d):>7} {sum(d):>10.3f} "
+                     f"{_quantile(d, 0.5) * 1e3:>9.2f} "
+                     f"{_quantile(d, 0.99) * 1e3:>9.2f}")
+    return "\n".join(lines)
+
+
+def path_report(spans: list, trace_id: str) -> str:
+    path = critical_path(spans, trace_id)
+    if not path:
+        return f"trace {trace_id}: no finished spans"
+    t0 = path[0]["start"]
+    lines = [f"critical path of trace {trace_id} "
+             f"({(path[-1]['end'] - t0) * 1e3:.2f}ms root to last):"]
+    for s in path:
+        lines.append(
+            f"  +{(s['start'] - t0) * 1e3:9.2f}ms "
+            f"{(s['end'] - s['start']) * 1e3:9.2f}ms "
+            f"[{s.get('stage') or '-':<8}] {s['name']} ({s['status']})")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="stage decomposition + critical path from a span dump")
+    ap.add_argument("source", help="span-dump file, '-' for stdin, or the "
+                                   "/debug/trace?format=spans URL")
+    ap.add_argument("--trace", metavar="TRACE_ID",
+                    help="report one trace's critical path (default: the "
+                         "trace whose root span ran longest)")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="also write Chrome/Perfetto trace-event JSON")
+    args = ap.parse_args()
+
+    spans = load_spans(args.source)
+    done = [s for s in spans if s.get("end") is not None]
+    print(f"{len(spans)} spans ({len(done)} finished), "
+          f"{len({s['trace_id'] for s in spans})} traces")
+    print()
+    print(stage_table(spans))
+
+    trace_id = args.trace
+    if trace_id is None and done:
+        # default: the slowest root span's trace — the whale a latency
+        # investigation opens with
+        roots = [s for s in done if not s["parent_id"]] or done
+        trace_id = max(roots,
+                       key=lambda s: s["end"] - s["start"])["trace_id"]
+    if trace_id:
+        print()
+        print(path_report(spans, trace_id))
+
+    if args.perfetto:
+        events = to_trace_events(spans)
+        with open(args.perfetto, "w") as fh:
+            json.dump(events, fh, sort_keys=True, separators=(",", ":"))
+        print(f"\nwrote {len(events)} trace events to {args.perfetto} "
+              f"(open in ui.perfetto.dev)")
+
+
+if __name__ == "__main__":
+    main()

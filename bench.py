@@ -320,6 +320,15 @@ def main():
                          "'fast' = 12 ticks on a small fleet (the "
                          "tier-1 gate's shape), 'day' = 48 ticks on "
                          "a 1k-node fleet (the slow gate's shape)")
+    ap.add_argument("--timeseries", action="store_true",
+                    help="run the metrics-plane arm: the fast workload "
+                         "soak with the deterministic FleetScraper + "
+                         "burn-rate evaluator on (recording the full "
+                         "time-series export and the alert timeline), "
+                         "plus a scraped-vs-unscraped e2e A/B (the "
+                         "scrape-overhead control); records the "
+                         "metricsplane section — feed the artifact to "
+                         "tools/obs_report.py")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -604,6 +613,67 @@ def main():
                   f"lag={wr.hpa_max_lag_ticks} ticks "
                   f"phases={[p['binds'] for p in wr.phases]}",
                   file=sys.stderr)
+    metricsplane = None
+    if args.timeseries:
+        # the metrics-plane arm (ISSUE 14): one fast trace replay with
+        # the scraper + burn-rate evaluator on — the artifact carries
+        # the full sorted-key series export (what tools/obs_report.py
+        # renders) and the alert timeline, gated the same way the soak
+        # test gates (crowd fast-burn must trip AND clear)
+        from kubernetes_tpu.chaos import WorkloadPlan as _WP
+        from kubernetes_tpu.kubemark.workload_soak import run_workload_soak
+        mp_seed = args.workload_seed if args.workload_seed is not None \
+            else 2
+        mw = run_workload_soak(
+            n_nodes=12, plan=_WP(seed=mp_seed, ticks=12),
+            tick_wall_s=0.4, fault_rate=0.05, node_kill_fraction=0.10,
+            timeout=120.0, scrape=True, keep_series=True)
+        crowd_trips = [a for a in mw.alerts
+                       if a["action"] == "TRIP"
+                       and a["slo"] == "crowd-bind-availability"]
+        # scrape-overhead control: best-of-two e2e passes with a
+        # FleetScraper polling the fleet registry flat-out vs the
+        # headline (unscraped) best — same gate shape as the --trace
+        # arm's overhead (<5% or render()/observe() regressed)
+        from kubernetes_tpu.obs.metricsplane import (FleetScraper,
+                                                     RegistryTarget)
+        from kubernetes_tpu.utils.metrics import global_metrics
+        sc = FleetScraper([RegistryTarget("fleet", global_metrics)],
+                          cadence_s=0.05)
+        sc.start()
+        try:
+            scraped = max(
+                (run_scheduling_benchmark(args.nodes, args.pods,
+                                          "batch") for _ in range(2)),
+                key=lambda x: x.pods_per_sec)
+        finally:
+            sc.stop()
+        base = max(runs, key=lambda x: x.pods_per_sec)
+        sc_overhead = (1.0 - scraped.pods_per_sec / base.pods_per_sec
+                       if base.pods_per_sec else None)
+        metricsplane = {
+            "seed": mp_seed,
+            "samples": mw.scrape_samples,
+            "counter_resets": mw.scrape_resets,
+            "scrape_errors": mw.scrape_errors,
+            "alerts": mw.alerts,
+            "alerts_ok": mw.alerts_ok,
+            "fast_burn_tripped": bool(crowd_trips),
+            "slo_ok": mw.slo_ok,
+            "series": mw.scrape_export,
+            "scraped_pods_per_sec": round(scraped.pods_per_sec, 1),
+            "unscraped_pods_per_sec": round(base.pods_per_sec, 1),
+            "overhead_frac": (round(sc_overhead, 4)
+                              if sc_overhead is not None else None),
+            "overhead_ok": (sc_overhead is not None
+                            and sc_overhead < 0.05)}
+        if args.verbose:
+            edges = [(a["sample"], a["action"]) for a in mw.alerts]
+            print(f"# metricsplane[seed={mp_seed}] "
+                  f"samples={mw.scrape_samples} alerts={edges} "
+                  f"scraped {scraped.pods_per_sec:.0f} vs "
+                  f"{base.pods_per_sec:.0f} pods/s",
+                  file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     pallas = _pallas_status(platform)
 
@@ -716,6 +786,7 @@ def main():
         "node_chaos": node_chaos,
         "durability": durability,
         "workload": workload,
+        "metricsplane": metricsplane,
         "multihost": multihost,
         "lint": lint_section,
         "tpu": _tpu_section()}))

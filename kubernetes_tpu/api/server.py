@@ -264,7 +264,13 @@ class ApiServer:
                         # client's breaker probe and the LB liveness
                         # check — a saturated server must still answer
                         # "alive" or every breaker stays open
-                        or path in ("/healthz", "/healthz/ping"))
+                        or path in ("/healthz", "/healthz/ping")
+                        # /metrics too: the fleet scraper must keep
+                        # reading THROUGH a 429/503 storm — the storm
+                        # is exactly what the series needs to show
+                        # (Prometheus' own scrape would also bypass an
+                        # ingress shedder on the metrics port)
+                        or path == "/metrics")
         if not long_running and not self._inflight.acquire(blocking=False):
             # sheds-per-resource: the saturation signal dashboards and
             # the chaos/scale gates read (ref: apiserver

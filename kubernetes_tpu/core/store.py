@@ -55,6 +55,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import obs
 from ..utils.clock import REAL, Clock
+from ..utils.metrics import (WATCH_LAG_HISTOGRAM, MetricsRegistry,
+                             global_metrics)
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
 from .types import fast_replace
@@ -71,7 +73,8 @@ class Store:
                  fsync_policy: str = "batch",
                  wal_segment_records: int = 10_000,
                  wal_snapshot_records: int = 50_000,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         # TTL deadlines are wall-clock (they stamp API objects and ride
         # the WAL as absolute expiries); the clock is injectable so
         # expiry behavior is testable without sleeping and so the lint
@@ -94,11 +97,15 @@ class Store:
         # ledger lock: only the publish phase touches watchers.
         self._watchers: List[Tuple[str, Optional[Callable[[Any], bool]],
                                    "watchpkg.Watcher", int]] = []
-        # publish pipeline: batches of (rev, key, event, prev) appended
-        # under the ledger lock (FIFO order = revision order) and fanned
-        # out under _pub_lock after the ledger lock is released
+        # publish pipeline: (enqueue_monotonic, batch) pairs — batches
+        # of (rev, key, event, prev) — appended under the ledger lock
+        # (FIFO order = revision order) and fanned out under _pub_lock
+        # after the ledger lock is released. The enqueue stamp feeds
+        # the watch publish->deliver lag histogram: how long a
+        # committed event sat queued before watcher fan-out began.
         self._pub_queue: deque = deque()
         self._pub_lock = threading.Lock()
+        self._metrics = metrics or global_metrics
         # highest revision whose events have been handed to watchers;
         # watch() replays history only up to here (the rest arrives live)
         self._published_rev = 0
@@ -427,7 +434,7 @@ class Store:
         ledger lock, so queue order is revision order) — the caller MUST
         call _drain_publish() after releasing the lock."""
         if items:
-            self._pub_queue.append(items)
+            self._pub_queue.append((self._clock.monotonic(), items))
 
     def _emit(self, rev: int, etype: str, key: str, obj: Any,
               prev: Any) -> None:
@@ -450,9 +457,15 @@ class Store:
             try:
                 while True:
                     try:
-                        items = q.popleft()
+                        t_enq, items = q.popleft()
                     except IndexError:
                         break
+                    # publish->deliver lag, observed OUTSIDE the ledger
+                    # lock (metrics take their own registry lock; the
+                    # histogram dual-lands via the pinned boundaries)
+                    self._metrics.observe(
+                        WATCH_LAG_HISTOGRAM,
+                        self._clock.monotonic() - t_enq)
                     self._fanout(items)
                     self._published_rev = items[-1][0]
             finally:

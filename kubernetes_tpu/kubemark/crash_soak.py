@@ -47,6 +47,8 @@ from ..controllers.manager import ControllerManager
 from ..core import types as api
 from ..core.store import Store
 from ..core.errors import AlreadyExists
+from ..obs import tracer as _obs_tracer
+from ..obs.flightrec import FlightRecorder
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
 from ..utils.clock import REAL, Clock
@@ -88,6 +90,8 @@ class CrashSoakResult:
     #: which replica (a/b) held each singleton at quiesce
     leaders_at_end: Dict[str, str] = field(default_factory=dict)
     converge_s: float = 0.0
+    #: flight-recorder bundles written (flight_dir runs): one per kill
+    flight_bundles: List[str] = field(default_factory=list)
     detail: str = ""
 
     def as_dict(self) -> Dict:
@@ -104,7 +108,8 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                    retry_period: float = 0.15,
                    heartbeat_interval: float = 1.0,
                    post_kill_scale: Optional[int] = None,
-                   clock: Optional[Clock] = None
+                   clock: Optional[Clock] = None,
+                   flight_dir: Optional[str] = None
                    ) -> CrashSoakResult:
     """One seeded crash soak; see the module docstring for the
     scenario. Lease timings default to soak-compressed values (the
@@ -133,6 +138,8 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
     result = CrashSoakResult(converged=False, n_nodes=n_nodes,
                              replicas=replicas,
                              schedule=crash_plan.schedule(replicas))
+    recorder = (FlightRecorder(flight_dir, clock=clock)
+                if flight_dir else None)
 
     # ---- invariant trackers ride the live registry directly (no
     # chaos, no HTTP) and re-point after the apiserver restart
@@ -286,8 +293,18 @@ def run_crash_soak(n_nodes: int = 6, replicas: int = 24, seed: int = 0,
                 _k, leader = active(managers)
                 leader.kill()
             crash.record(target, point)
+            if recorder is not None:
+                # chaos-kill post-mortem: the plan position + span
+                # buffer at the instant of the kill (the series tail
+                # comes from the workload soak's recorder — this soak
+                # has no scraper, and the recorder writes what exists)
+                recorder.dump(f"chaos-kill-{target}",
+                              tracer=_obs_tracer(), chaos=crash,
+                              extra={"point": point, "target": target})
 
         result.killed = crash.trace()
+        if recorder is not None:
+            result.flight_bundles = list(recorder.bundles)
         result.schedule_replayed = (
             result.killed == crash_plan.schedule(replicas)
             == result.schedule)

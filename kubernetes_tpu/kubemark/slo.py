@@ -32,9 +32,11 @@ from ..api.client import HttpClient, InProcClient
 from ..api.registry import Registry
 from ..api.server import ApiServer
 from ..core import types as api
+from ..obs.metricsplane import SLODef
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
-from ..utils.metrics import MetricsRegistry
+from ..utils.metrics import (APISERVER_LATENCY_SUMMARY, CROWD_COUNTERS,
+                             MetricsRegistry)
 from .benchmark import _bench_pod
 from .fleet import HollowFleet
 
@@ -43,7 +45,48 @@ STARTUP_P50_LIMIT_S = 5.0  # ref: metrics_util.go:224-225, density.go:203
 MIN_API_SAMPLES = 1000     # below this a percentile claim is void
 MIN_ENDPOINT_SAMPLES = 10  # endpoints with fewer samples aren't gated
 
-LATENCY_METRIC = "apiserver_request_latencies_microseconds"
+#: the metric-pinning lint contract: this module reads the spelling
+#: pinned in utils/metrics.py, never a local literal
+LATENCY_METRIC = APISERVER_LATENCY_SUMMARY
+
+# ---------------------------------------------------- burn-rate SLOs
+#
+# Continuous SLOs the burn-rate evaluator (obs/metricsplane.py) runs
+# over the fleet time-series, next to the end-of-run gates above.
+# Windows are in SAMPLES (the soak scrapes once per workload tick),
+# thresholds follow the SRE-workbook multi-window shape: TRIP needs
+# the fast AND slow window burning, CLEAR needs only the fast window
+# calm — so a flash crowd trips within one tick of landing and clears
+# within a bounded tick lag once binds drain.
+
+#: flash-crowd drain: of the crowd pods created, what fraction is
+#: bound? The crowd injection itself drives the error ratio to ~1 at
+#: the burst tick (pods cannot bind in the same tick they land), so
+#: this alert's trip/clear ticks ARE the crowd timeline — replayable,
+#: and gated by the workload soak.
+CROWD_BIND_SLO = SLODef(
+    name="crowd-bind-availability",
+    metric=CROWD_COUNTERS[0],        # crowd_pods_created_total
+    good_metric=CROWD_COUNTERS[1],   # crowd_pods_bound_total
+    kind="ratio",
+    objective=0.999,
+    fast_window=2, slow_window=8,
+    fast_burn=10.0, slow_burn=2.0)
+
+#: apiserver service time against the reference's 1s p99 limit, read
+#: from the merged fleet histogram: "good" = requests <= 1s (1e6 us
+#: is a pinned bucket bound, so the count is exact, no interpolation)
+API_LATENCY_SLO = SLODef(
+    name="api-latency-1s",
+    metric=APISERVER_LATENCY_SUMMARY,
+    kind="histogram_le",
+    threshold_le=1.0e6,              # us — ref metrics_util.go:41-47
+    objective=0.99,
+    fast_window=2, slow_window=8,
+    fast_burn=10.0, slow_burn=2.0)
+
+#: the pinned fleet SLO set the soaks evaluate every sample
+FLEET_SLOS = (CROWD_BIND_SLO, API_LATENCY_SLO)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:

@@ -54,6 +54,7 @@ the invariants the tests enforce.
 
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
@@ -73,11 +74,17 @@ from ..controllers.podautoscaler import HorizontalController
 from ..controllers.replication import ReplicationManager
 from ..core import types as api
 from ..core.quantity import parse_quantity
+from ..obs import tracer as _obs_tracer
+from ..obs.flightrec import FlightRecorder
+from ..obs.metricsplane import (BurnRateEvaluator, FleetScraper,
+                                HttpTarget, RegistryTarget)
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
 from ..utils.clock import REAL, Clock
-from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.metrics import (APISERVER_LATENCY_SUMMARY, CROWD_COUNTERS,
+                             MetricsRegistry, global_metrics)
 from .fleet import HollowFleet
+from .slo import CROWD_BIND_SLO, FLEET_SLOS
 
 #: demand units one replica serves at exactly the HPA target — the
 #: pure demand->replicas mapping the convergence gate compares against
@@ -85,7 +92,8 @@ UNITS_PER_REPLICA = 4
 HPA_TARGET_PCT = 50
 HPA_MAX_REPLICAS = 60
 
-LATENCY_METRIC = "apiserver_request_latencies_microseconds"
+#: pinned spelling (the metric-pinning lint contract)
+LATENCY_METRIC = APISERVER_LATENCY_SUMMARY
 
 
 def ideal_replicas(demand: int) -> int:
@@ -157,6 +165,19 @@ class WorkloadSoakResult:
     # ---- server-side API latency over the whole replay
     api_p99_ms: float = 0.0
     api_calls: int = 0
+    # ---- metrics plane (scrape=True): per-tick fleet samples + the
+    # burn-rate alert timeline (AlertEvent.to_dict list, in order)
+    scrape_samples: int = 0
+    scrape_resets: int = 0
+    scrape_errors: int = 0
+    alerts: List[Dict] = field(default_factory=list)
+    alert_clear_limit_ticks: int = 6
+    flight_bundles: List[str] = field(default_factory=list)
+    #: the full FleetScraper export (keep_series=True runs only) —
+    #: what bench.py --timeseries records and tools/obs_report.py
+    #: renders; popped from as_dict() so the workload section stays
+    #: verdict-sized
+    scrape_export: Optional[Dict] = None
     detail: str = ""
 
     @property
@@ -171,6 +192,33 @@ class WorkloadSoakResult:
                 and self.hpa_in_band_final)
 
     @property
+    def alerts_ok(self) -> Optional[bool]:
+        """The burn-rate alert gate (scrape=True runs only): every
+        flash crowd must TRIP the crowd fast-burn alert — the crowd's
+        pods cannot bind in the tick they land, so a crowd that does
+        NOT trip means the alert pipeline is broken — and every TRIP
+        must CLEAR within alert_clear_limit_ticks samples once binds
+        drain. None when the plane was off or no crowd was drawn."""
+        if self.scrape_samples == 0:
+            return None
+        crowd = [a for a in self.alerts
+                 if a["slo"] == CROWD_BIND_SLO.name]
+        if self.bind_samples == 0 and not crowd:
+            return None  # the plan drew no bursts: nothing to gate
+        trips = [a for a in crowd if a["action"] == "TRIP"]
+        if self.bind_samples > 0 and not trips:
+            return False
+        for i, a in enumerate(crowd):
+            if a["action"] != "TRIP":
+                continue
+            clear = next((b for b in crowd[i + 1:]
+                          if b["action"] == "CLEAR"), None)
+            if clear is None or (clear["sample"] - a["sample"]
+                                 > self.alert_clear_limit_ticks):
+                return False
+        return True
+
+    @property
     def slo_ok(self) -> bool:
         """Every gate at once — what the soak test asserts and the
         bench artifact records."""
@@ -178,6 +226,7 @@ class WorkloadSoakResult:
                     and self.node_schedule_replayed
                     and self.bind_p99_ok is not False
                     and self.hpa_ok
+                    and self.alerts_ok is not False
                     and self.duplicate_bindings == 0
                     and self.dead_bound == 0
                     and self.jobs_completed >= self.jobs_expected
@@ -186,7 +235,9 @@ class WorkloadSoakResult:
     def state_summary(self) -> Dict:
         """The canonical deterministic projection of post-replay state
         — what two same-seed invocations are compared on (see module
-        docstring for why HPA replicas are band-membership)."""
+        docstring for why HPA replicas are band-membership). The
+        alert timeline (sample index, SLO, edge) is part of it: trip
+        and clear ticks must replay."""
         return {
             "services": list(self.services_final),
             "jobs_completed": self.jobs_completed,
@@ -195,14 +246,18 @@ class WorkloadSoakResult:
             "killed": list(self.killed),
             "hpa_in_band_final": self.hpa_in_band_final,
             "converged": self.converged,
+            "alerts": [[a["sample"], a["slo"], a["action"]]
+                       for a in self.alerts],
         }
 
     def as_dict(self) -> Dict:
         d = asdict(self)
         d["bind_p99_ok"] = self.bind_p99_ok
         d["hpa_ok"] = self.hpa_ok
+        d["alerts_ok"] = self.alerts_ok
         d["slo_ok"] = self.slo_ok
         d["hpa_track"] = [list(t) for t in self.hpa_track]
+        d.pop("scrape_export", None)
         return d
 
 
@@ -221,10 +276,22 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                       monitor_grace_period: float = 1.5,
                       pod_eviction_timeout: float = 0.3,
                       registry: Optional[Registry] = None,
-                      clock: Optional[Clock] = None
+                      clock: Optional[Clock] = None,
+                      scrape: bool = False,
+                      alert_clear_limit_ticks: int = 6,
+                      keep_series: bool = False,
+                      flight_dir: Optional[str] = None
                       ) -> WorkloadSoakResult:
     """One seeded trace replay; see the module docstring for the
-    scenario. Timing knobs default to soak-compressed values."""
+    scenario. Timing knobs default to soak-compressed values.
+
+    scrape=True turns on the metrics plane: a FleetScraper pulls the
+    apiserver's /metrics over HTTP (through the shed-exempt path) and
+    the in-proc fleet registry once per tick, and a BurnRateEvaluator
+    runs the pinned FLEET_SLOS over the samples — the crowd fast-burn
+    alert timeline becomes a gate (alerts_ok). flight_dir additionally
+    arms a FlightRecorder: SLO trips and node-kill chaos dump
+    post-mortem bundles there."""
     clock = clock or REAL
     plan = plan or WorkloadPlan(seed=seed)
     seed = plan.seed
@@ -245,7 +312,29 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
     result = WorkloadSoakResult(
         converged=False, n_nodes=n_nodes, seed=seed, ticks=plan.ticks,
         bind_p99_limit_s=bind_p99_limit_s,
-        hpa_lag_limit_ticks=hpa_lag_limit)
+        hpa_lag_limit_ticks=hpa_lag_limit,
+        alert_clear_limit_ticks=alert_clear_limit_ticks)
+
+    # ---- metrics plane: scraper + burn-rate evaluator + recorder
+    recorder = (FlightRecorder(flight_dir, clock=clock)
+                if flight_dir else None)
+    tick_now = [0]  # current replay tick, for bundle metadata
+
+    def _on_trip(ev):
+        if recorder is not None:
+            recorder.dump(f"slo-{ev.slo}", scraper=scraper,
+                          tracer=_obs_tracer(),
+                          chaos={"tick": tick_now[0]},
+                          extra=ev.to_dict())
+
+    scraper = evaluator = None
+    if scrape:
+        scraper = FleetScraper(
+            [HttpTarget("apiserver", server.url + "/metrics"),
+             RegistryTarget("fleet", global_metrics)],
+            clock=clock, cadence_s=tick_wall_s, seed=seed)
+        evaluator = BurnRateEvaluator(list(FLEET_SLOS),
+                                      on_trip=_on_trip)
     sched_pure = plan.schedule()
     result.events_expected = sum(len(v) for v in sched_pure.values())
     backoff_base = global_metrics.counter_sum("job_backoff_requeues_total")
@@ -295,8 +384,15 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
     bind_stamps: List[float] = []            # all binds, for phases
     stop_threads = threading.Event()
 
-    wl.on_crowd = lambda names: crowd_created.update(
-        {n: time.monotonic() for n in names})
+    def _on_crowd(names):
+        # synchronous with apply_tick: the created counter moves in
+        # the SAME tick the crowd lands, so the burn-rate evaluator's
+        # sample at this tick deterministically sees the error ratio
+        # spike (the pods cannot have bound yet)
+        crowd_created.update({n: time.monotonic() for n in names})
+        metrics.inc(CROWD_COUNTERS[0], by=float(len(names)))
+
+    wl.on_crowd = _on_crowd
 
     def tracker():
         # one registry sweep: duplicate-binding ledger + crowd bind
@@ -323,6 +419,7 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                     if (name.startswith("crowd-")
                             and name not in crowd_bound):
                         crowd_bound[name] = now
+                        metrics.inc(CROWD_COUNTERS[1])
             time.sleep(0.03)
 
     def executor():
@@ -444,6 +541,7 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         dead: set = set()
         hpa_bad_run = 0
         for tick in range(plan.ticks):
+            tick_now[0] = tick
             wl.apply_tick(tick, deadline)
             if node_kill_fraction > 0 and tick == kill_tick:
                 result.killed = node_chaos.kill()
@@ -451,6 +549,16 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                 result.node_schedule_replayed = (
                     result.killed
                     == node_plan.schedule(fleet.node_names())["kill"])
+                if recorder is not None:
+                    recorder.dump("chaos-node-kill", scraper=scraper,
+                                  tracer=_obs_tracer(),
+                                  chaos={"tick": tick,
+                                         "victims": result.killed})
+            # scrape ON the tick axis, right after the tick's events
+            # applied: the sample index IS the tick, so the alert
+            # timeline replays across same-seed runs
+            if scraper is not None:
+                evaluator.observe(scraper.sample(t=float(tick)))
             time.sleep(tick_wall_s)
             # HPA tracking sample, against the pure curve
             try:
@@ -525,6 +633,21 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
 
         ok = wait_until(quiesced, deadline)
         result.converged = ok
+        # drain samples past the replay: a crowd landing on the final
+        # ticks must still get its CLEAR edge once binds settle (the
+        # quiesce wait above ensures they have)
+        if scraper is not None:
+            for extra in range(3):
+                evaluator.observe(
+                    scraper.sample(t=float(plan.ticks + extra)))
+            result.scrape_samples = len(scraper.series())
+            result.scrape_resets = scraper.resets_total
+            result.scrape_errors = scraper.errors_total
+            result.alerts = evaluator.events_dict()
+            if keep_series:
+                result.scrape_export = json.loads(scraper.export_json())
+        if recorder is not None:
+            result.flight_bundles = list(recorder.bundles)
         result.services_final = services_now() or []
         result.services_ok = result.services_final == expected_services
         result.jobs_completed = max(0, completed_jobs())

@@ -1,7 +1,7 @@
 """orchlint: AST invariant lint for the orchestrator's own contracts.
 
 The reference tree leans on `go vet` and the race detector in CI; this
-port's equivalents are conventions — and conventions rot. Four invariant
+port's equivalents are conventions — and conventions rot. Five invariant
 families are machine-checked here (stdlib `ast`, no dependencies), run
 as a tier-1 test so a violation fails the build:
 
@@ -34,6 +34,13 @@ as a tier-1 test so a violation fails the build:
                    is flagged: replaying an ambiguous POST duplicates
                    objects; retries belong in `RetryPolicy`, which
                    knows which verbs are safe.
+  metric-pinning   in `kubemark/`, a registry read (`counter_sum`,
+                   `summary_stats`, `histogram*`, ...) or an `SLODef`
+                   whose statically-resolvable metric name is not
+                   pinned in `utils/metrics.py` is flagged: a gate
+                   must not be one rename away from asserting on a
+                   counter nobody increments (the DURABILITY_COUNTERS
+                   no-drift contract, generalized).
 
 Pre-existing accepted sites live in `lint/baseline.toml` — explicit,
 counted, and with a reason each. A new violation is a hard error; so is
@@ -567,6 +574,143 @@ def check_api_idempotency(tree: ast.AST, path: str) -> List[Violation]:
     return v.out
 
 
+# -------------------------------------------------- rule: metric-pinning
+
+#: registry read methods whose first argument is a metric name — the
+#: calls a soak gate or SLO evaluation makes against a MetricsRegistry
+_METRIC_READERS = {"counter", "counter_sum", "summary", "summary_stats",
+                   "summary_samples", "histogram", "histogram_merged",
+                   "histogram_stats"}
+
+#: SLO-definition keyword args that carry metric names
+_SLO_METRIC_KWARGS = {"metric", "good_metric"}
+
+_PINNED_NAMES: Optional[frozenset] = None
+
+
+def pinned_metric_names() -> frozenset:
+    """The no-drift metric-name contract, read from utils/metrics.py
+    by AST (not import): every string pinned in a module-level
+    ALL_CAPS constant — bare string, tuple/list of strings, or dict
+    key (HISTOGRAM_BUCKETS). Cached for the lint run's lifetime."""
+    global _PINNED_NAMES
+    if _PINNED_NAMES is not None:
+        return _PINNED_NAMES
+    src = os.path.join(os.path.dirname(__file__), os.pardir,
+                       "utils", "metrics.py")
+    with open(src, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=src)
+    consts: Dict[str, str] = {}
+    names: set = set()
+
+    def _str(node) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        return None
+
+    for stmt in tree.body:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        if value is None or len(targets) != 1 \
+                or not isinstance(targets[0], ast.Name) \
+                or not targets[0].id.isupper():
+            continue
+        s = _str(value)
+        if s is not None:
+            consts[targets[0].id] = s
+            names.add(s)
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            names.update(s for s in map(_str, value.elts) if s)
+        elif isinstance(value, ast.Dict):
+            names.update(s for s in map(_str, value.keys) if s)
+    _PINNED_NAMES = frozenset(names)
+    return _PINNED_NAMES
+
+
+def _metrics_imports(tree: ast.AST) -> set:
+    """Local names bound by `from ...utils.metrics import X` (any
+    relative level — _import_table skips those). A name whose
+    provenance IS the pin module is pinned by construction."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[-1] == "metrics":
+            out.update(a.asname or a.name for a in node.names
+                       if a.name != "*")
+    return out
+
+
+class _MetricPinningVisitor(_ScopedVisitor):
+    """A soak gate or SLO definition that reads a metric name not
+    pinned in utils/metrics.py is one rename away from silently
+    gating on a counter nobody increments (the DURABILITY_COUNTERS
+    lesson, generalized). Names that cannot be resolved statically
+    (loop variables, f-strings) are skipped — the rule is a tripwire
+    for the common literal case, not a type system."""
+
+    RULE = "metric-pinning"
+
+    def __init__(self, path: str, imports: Dict[str, str],
+                 consts: Dict[str, str], from_pin_module: set):
+        super().__init__(path, imports)
+        self.consts = consts
+        self.from_pin_module = from_pin_module
+
+    def _metric_name(self, node: ast.AST) -> Optional[str]:
+        """Statically-resolved metric-name string, or None when the
+        arg is unresolvable or pinned by import provenance."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name) \
+                and node.id not in self.from_pin_module:
+            return self.consts.get(node.id)
+        return None
+
+    def _check(self, node: ast.AST, arg: ast.AST, what: str) -> None:
+        name = self._metric_name(arg)
+        if name is not None and name not in pinned_metric_names():
+            self.flag(self.RULE, node, "unpinned-metric-name",
+                      f"{what} reads metric {name!r}, which is not "
+                      f"pinned in utils/metrics.py; add it to a "
+                      f"module-level constant there (the no-drift "
+                      f"contract: gates and dashboards must share "
+                      f"one spelling)")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_READERS and node.args:
+            self._check(node, node.args[0], f".{node.func.attr}()")
+        callee = (_dotted(node.func) or "").split(".")[-1]
+        if callee == "SLODef":
+            for kw in node.keywords:
+                if kw.arg in _SLO_METRIC_KWARGS:
+                    self._check(node, kw.value, f"SLODef({kw.arg}=)")
+        self.generic_visit(node)
+
+
+def check_metric_pinning(tree: ast.AST, path: str) -> List[Violation]:
+    from_pin = _metrics_imports(tree)
+    consts: Dict[str, str] = {}
+    for stmt in getattr(tree, "body", []):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            if isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                consts[stmt.targets[0].id] = stmt.value.value
+            elif isinstance(stmt.value, ast.Name) \
+                    and stmt.value.id in from_pin:
+                # alias of a pin-module import keeps its provenance
+                from_pin.add(stmt.targets[0].id)
+    v = _MetricPinningVisitor(path, _import_table(tree), consts, from_pin)
+    v.visit(tree)
+    return v.out
+
+
 # ----------------------------------------------------------- the runner
 
 def _soak_file(name: str) -> bool:
@@ -593,6 +737,10 @@ def _rule_applies(rule: str, path: str) -> bool:
     if rule == "api-idempotency":
         return (path.startswith("kubernetes_tpu/")
                 and path != "kubernetes_tpu/api/retry.py")
+    if rule == "metric-pinning":
+        # where gates and SLO definitions live: the soak harnesses and
+        # the SLO module read metric names; everything else increments
+        return path.startswith("kubernetes_tpu/kubemark/")
     raise ValueError(f"unknown rule {rule!r}")
 
 
@@ -601,6 +749,7 @@ RULES = {
     "lock-discipline": check_lock_discipline,
     "jax-hygiene": check_jax_hygiene,
     "api-idempotency": check_api_idempotency,
+    "metric-pinning": check_metric_pinning,
 }
 
 

@@ -81,6 +81,12 @@ class LockWitness:
         self._held: Dict[int, List[list]] = {}
         #: lock name -> [acquisitions, max hold seconds]
         self._stats: Dict[str, list] = {}
+        #: fired (outside _mu) the first time an inversion is
+        #: recorded — the flight-recorder hook: a harness sets this to
+        #: dump a post-mortem bundle at the instant of the sighting,
+        #: when both stack's locks are still held and the span buffer
+        #: still shows who took them
+        self.on_inversion = None
 
     def wrap(self, lock, name: str) -> WitnessedLock:
         return WitnessedLock(lock, name, self)
@@ -91,6 +97,7 @@ class LockWitness:
         ident = threading.get_ident()
         tname = threading.current_thread().name
         now = time.monotonic()
+        first_inversion = False
         with self._mu:
             held = self._held.setdefault(ident, [])
             for entry in held:
@@ -103,10 +110,18 @@ class LockWitness:
                 self._edges.setdefault(edge, sighting)
                 rev = self._edges.get((name, prior))
                 if rev is not None:
+                    first_inversion = not self.inversions
                     self.inversions.append(((name, prior), rev,
                                             sighting))
             held.append([name, 1, now])
             self._stats.setdefault(name, [0, 0.0])[0] += 1
+        if first_inversion and self.on_inversion is not None:
+            # outside _mu: the hook dumps a bundle (file I/O) and may
+            # read report(), which takes _mu itself
+            try:
+                self.on_inversion()
+            except Exception:
+                pass  # a broken recorder must not break the workload
 
     def _released(self, name: str) -> None:
         now = time.monotonic()

@@ -26,6 +26,14 @@ compromise to a span with 30k parents.
 Disabled tracing is a few attribute reads per call site: `start_span`
 returns a shared no-op span and `end` returns immediately — the
 bench's tracing-off arm gates the overhead at <5% e2e throughput.
+
+Sibling modules (imported directly, not re-exported here, to keep
+this package's import graph flat): `obs.metricsplane` is the fleet
+metrics plane — deterministic scraper, merged pinned-bucket
+histograms, SLO burn-rate alerting over the exported time-series —
+and `obs.flightrec` is the flight recorder that snapshots series
+tail + span buffer + lock-witness graph + chaos position into a
+post-mortem bundle the instant something trips.
 """
 
 from __future__ import annotations

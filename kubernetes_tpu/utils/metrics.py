@@ -1,8 +1,18 @@
-"""Minimal Prometheus-style metrics: counters, gauges, summaries.
+"""Minimal Prometheus-style metrics: counters, gauges, summaries,
+histograms.
 
 Reference: the per-binary prometheus registries (pkg/apiserver/metrics,
 plugin/pkg/scheduler/metrics/metrics.go:30-80, pkg/kubelet/metrics) exposed
 on /metrics. We keep the same metric names so dashboards line up.
+
+Summaries hold a sliding sample window and answer quantiles for ONE
+process's ONE label set; they cannot be merged (a p99 of p99s is not a
+p99). Histograms hold counts in pinned buckets, so two histograms with
+the same boundaries merge by adding counts — across label sets, across
+processes, across scrape rounds. That is why the fleet scraper
+(obs/metricsplane.py) aggregates histograms, and why the bucket
+boundaries are pinned HERE per metric name (HISTOGRAM_BUCKETS): two
+registries that disagreed on boundaries would be unmergeable.
 """
 
 from __future__ import annotations
@@ -17,11 +27,30 @@ def _key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((labels or {}).items()))
 
 
+def escape_label_value(val: str) -> str:
+    """Prometheus text-format label-value escaping: backslash first
+    (the escape character itself), then quote and newline — the three
+    characters the exposition format reserves."""
+    return (str(val).replace("\\", "\\\\")
+                    .replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+
 def _fmt_labels(k: Tuple[Tuple[str, str], ...]) -> str:
     if not k:
         return ""
-    inner = ",".join(f'{name}="{val}"' for name, val in k)
+    inner = ",".join(f'{name}="{escape_label_value(val)}"'
+                     for name, val in k)
     return "{" + inner + "}"
+
+
+def _fmt_le(bound: float) -> str:
+    """Bucket upper-bound label value: '+Inf' for the overflow bucket,
+    otherwise Python's shortest round-trip float repr (byte-stable
+    across runs, exact through the scrape parser)."""
+    if bound == float("inf"):
+        return "+Inf"
+    return repr(float(bound))
 
 
 class _Summary:
@@ -52,12 +81,84 @@ class _Summary:
         return self._samples[idx]
 
 
+class Histogram:
+    """Cumulative-bucket histogram over pinned boundaries.
+
+    Buckets are per-observation counts keyed by upper bound; the +Inf
+    overflow bucket is implicit (counts[-1]). Unlike _Summary this is
+    a pure monoid: merge() of two histograms with identical bounds is
+    exact, associative, and commutative — the property the fleet
+    scraper leans on to fold per-process /metrics into one view.
+    """
+
+    __slots__ = ("bounds", "counts", "total", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        if tuple(bounds) != tuple(sorted(bounds)) or not bounds:
+            raise ValueError(f"bucket bounds must be sorted, non-empty: "
+                             f"{bounds!r}")
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +Inf last
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        # le is inclusive: v lands in the first bucket whose bound >= v
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.total += v
+        self.count += 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """-> new Histogram = self + other (bounds must match)."""
+        if self.bounds != other.bounds:
+            raise ValueError(
+                f"unmergeable histograms: bounds {self.bounds} != "
+                f"{other.bounds}")
+        out = Histogram(self.bounds)
+        out.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        out.total = self.total + other.total
+        out.count = self.count + other.count
+        return out
+
+    def cumulative(self) -> List[int]:
+        """Per-bucket counts folded into the cumulative counts the
+        _bucket{le=} exposition lines carry (last == count)."""
+        out, run = [], 0
+        for c in self.counts:
+            run += c
+            out.append(run)
+        return out
+
+    def quantile_le(self, le: float) -> int:
+        """Observations <= le, for any le that is a pinned bound —
+        what a latency SLO reads as its 'good events' counter."""
+        idx = bisect.bisect_left(self.bounds, le)
+        if idx >= len(self.bounds) or self.bounds[idx] != le:
+            raise ValueError(f"le={le} is not a pinned bound of "
+                             f"{self.bounds}")
+        return sum(self.counts[:idx + 1])
+
+    def to_dict(self) -> dict:
+        return {"bounds": list(self.bounds), "counts": list(self.counts),
+                "sum": self.total, "count": self.count}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        h = cls(tuple(d["bounds"]))
+        h.counts = [int(c) for c in d["counts"]]
+        h.total = float(d["sum"])
+        h.count = int(d["count"])
+        return h
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Dict[tuple, float]] = defaultdict(dict)
         self._gauges: Dict[str, Dict[tuple, float]] = defaultdict(dict)
         self._summaries: Dict[str, Dict[tuple, _Summary]] = defaultdict(dict)
+        self._histograms: Dict[str, Dict[tuple, Histogram]] = \
+            defaultdict(dict)
 
     def inc(self, name: str, labels: Optional[Dict[str, str]] = None,
             by: float = 1.0) -> None:
@@ -78,6 +179,34 @@ class MetricsRegistry:
             if s is None:
                 s = self._summaries[name][k] = _Summary()
             s.observe(value)
+            # dual-landing: any metric with pinned boundaries also
+            # feeds a histogram, so the hot-path call sites (tracer
+            # stage ends, apiserver service time, watch publish lag)
+            # grow a mergeable cross-process view without touching
+            # any call site
+            bounds = HISTOGRAM_BUCKETS.get(name)
+            if bounds is not None:
+                h = self._histograms[name].get(k)
+                if h is None:
+                    h = self._histograms[name][k] = Histogram(bounds)
+                h.observe(value)
+
+    def observe_histogram(self, name: str, value: float,
+                          labels: Optional[Dict[str, str]] = None) -> None:
+        """Histogram-only observation (no summary window). The bucket
+        boundaries MUST be pinned in HISTOGRAM_BUCKETS — an unpinned
+        name would mint boundaries nobody else can merge with."""
+        bounds = HISTOGRAM_BUCKETS.get(name)
+        if bounds is None:
+            raise ValueError(
+                f"histogram {name!r} has no pinned boundaries in "
+                f"utils.metrics.HISTOGRAM_BUCKETS")
+        k = _key(labels)
+        with self._lock:
+            h = self._histograms[name].get(k)
+            if h is None:
+                h = self._histograms[name][k] = Histogram(bounds)
+            h.observe(value)
 
     def summary_samples(self, name: str) -> Dict[tuple, List[float]]:
         """-> {labels_key: sorted sample window} — lets a reader merge
@@ -121,6 +250,34 @@ class MetricsRegistry:
         with self._lock:
             return self._summaries.get(name, {}).get(_key(labels))
 
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, str]] = None
+                  ) -> Optional[Histogram]:
+        """Snapshot copy of one histogram (safe to merge/read outside
+        the registry lock)."""
+        with self._lock:
+            h = self._histograms.get(name, {}).get(_key(labels))
+            return Histogram.from_dict(h.to_dict()) if h else None
+
+    def histogram_merged(self, name: str) -> Optional[Histogram]:
+        """One histogram folded across every label set — the exact
+        merge summaries cannot do (an all-traffic latency view)."""
+        with self._lock:
+            hists = list(self._histograms.get(name, {}).values())
+            if not hists:
+                return None
+            out = Histogram(hists[0].bounds)
+            for h in hists:
+                out = out.merge(h)
+        return out
+
+    def histogram_stats(self, name: str
+                        ) -> Dict[Tuple[Tuple[str, str], ...], dict]:
+        """-> {labels_key: Histogram.to_dict()} for one histogram."""
+        with self._lock:
+            return {k: h.to_dict()
+                    for k, h in self._histograms.get(name, {}).items()}
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out: List[str] = []
@@ -141,6 +298,16 @@ class MetricsRegistry:
                         out.append(f"{name}{_fmt_labels(_key(lbl))} {s.quantile(q)}")
                     out.append(f"{name}_sum{_fmt_labels(k)} {s.total}")
                     out.append(f"{name}_count{_fmt_labels(k)} {s.count}")
+            for name in sorted(self._histograms):
+                out.append(f"# TYPE {name} histogram")
+                for k, h in sorted(self._histograms[name].items()):
+                    cum = h.cumulative()
+                    for bound, c in zip(h.bounds + (float("inf"),), cum):
+                        lbl = dict(k); lbl["le"] = _fmt_le(bound)
+                        out.append(
+                            f"{name}_bucket{_fmt_labels(_key(lbl))} {c}")
+                    out.append(f"{name}_sum{_fmt_labels(k)} {h.total}")
+                    out.append(f"{name}_count{_fmt_labels(k)} {h.count}")
         return "\n".join(out) + "\n"
 
 
@@ -185,3 +352,53 @@ WORKLOAD_COUNTERS = (
     "job_backoff_requeues_total",  # Job syncs held back by failure
                                    # backoff (label: job)
 )
+
+#: Per-(verb, resource) apiserver service time in MICROSECONDS —
+#: observed in ApiServer.handle's finally block; the density SLO suite
+#: and the burn-rate evaluator both read this name (was a stray
+#: literal in kubemark/slo.py before the no-drift contract landed).
+APISERVER_LATENCY_SUMMARY = "apiserver_request_latencies_microseconds"
+
+#: Watch publish -> deliver lag in SECONDS: stamped when a commit's
+#: events enter the store publish queue, observed when the publisher
+#: drain hands them to watcher fan-out (core/store.py).
+WATCH_LAG_HISTOGRAM = "watch_publish_deliver_lag_seconds"
+
+#: Flash-crowd progress counters the workload soak's burn-rate SLO
+#: reads: created is incremented synchronously at crowd injection,
+#: bound when the tracker sees the crowd pod bind. error ratio =
+#: 1 - d(bound)/d(created) over a sample window.
+CROWD_COUNTERS = (
+    "crowd_pods_created_total",
+    "crowd_pods_bound_total",
+)
+
+#: Scraper-side bookkeeping (obs/metricsplane.py): counter resets seen
+#: while folding per-target samples (a crashed+restarted process's
+#: counters restart at 0; the scraper rebases so rates never go
+#: negative) and scrape errors (target unreachable that round).
+SCRAPE_COUNTERS = (
+    "scrape_counter_resets_total",
+    "scrape_errors_total",
+)
+
+#: Pinned per-metric histogram bucket boundaries. observe() dual-lands
+#: any of these names into a Histogram next to its summary; boundaries
+#: live HERE (not at call sites) because merging across processes
+#: requires every registry to agree on them. Units follow the metric
+#: name suffix.
+HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
+    # stage seconds: sub-ms ledger commits up to multi-second confirms
+    OBS_STAGE_SUMMARY: (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+    # apiserver service time, microseconds (ref gate: p99 < 1s = 1e6us)
+    APISERVER_LATENCY_SUMMARY: (
+        100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+        25000.0, 50000.0, 100000.0, 250000.0, 500000.0,
+        1000000.0, 2500000.0),
+    # watch publish lag, seconds: fan-out normally drains sub-ms
+    WATCH_LAG_HISTOGRAM: (
+        0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+        0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+}

@@ -1,4 +1,4 @@
-"""orchlint acceptance: the four rule families flag their seeded bad
+"""orchlint acceptance: the five rule families flag their seeded bad
 fixtures and pass their good ones, the baseline allows exactly what it
 counts (and fails on drift), the CLI exits non-zero per family, the
 lock-witness catches order inversions and hold-time regressions — and
@@ -414,6 +414,97 @@ class TestApiIdempotencyRule:
                                "kubernetes_tpu/api/retry.py")
         assert lint_source(textwrap.dedent(src),
                            "kubernetes_tpu/api/client.py")
+
+
+# ---------------------------------------- rule family: metric-pinning
+
+#: gates/SLOs reading names NOT pinned in utils/metrics.py — one
+#: rename away from asserting on a counter nobody increments
+PINNING_BAD = [
+    ("bespoke_reader",
+     "def gate(reg):\n"
+     "    return reg.counter_sum('bespoke_total')\n"),
+    ("typo_of_a_pinned_name",
+     "def gate(reg):\n"
+     "    return reg.counter_sum('wal_record_total')\n"),  # s dropped
+    ("histogram_reader",
+     "def gate(reg):\n"
+     "    return reg.histogram_merged('made_up_seconds')\n"),
+    ("slodef_metric_kwarg",
+     "from kubernetes_tpu.obs.metricsplane import SLODef\n"
+     "SLO = SLODef(name='x', metric='made_up_total')\n"),
+    ("slodef_good_metric_kwarg",
+     "from kubernetes_tpu.obs.metricsplane import SLODef\n"
+     "SLO = SLODef(name='x', metric='wal_records_total',\n"
+     "             good_metric='made_up_good_total')\n"),
+    ("local_alias_of_unpinned",
+     "BAD = 'made_up_total'\n"
+     "def gate(reg):\n"
+     "    return reg.counter(BAD)\n"),
+]
+
+PINNING_GOOD = [
+    ("pinned_literal",
+     "def gate(reg):\n"
+     "    return reg.counter_sum('wal_records_total')\n"),
+    ("pin_module_import",
+     "from kubernetes_tpu.utils.metrics import WATCH_LAG_HISTOGRAM\n"
+     "def gate(reg):\n"
+     "    return reg.histogram_merged(WATCH_LAG_HISTOGRAM)\n"),
+    ("relative_pin_module_import",
+     "from ..utils.metrics import APISERVER_LATENCY_SUMMARY\n"
+     "def gate(reg):\n"
+     "    return reg.summary_stats(APISERVER_LATENCY_SUMMARY)\n"),
+    ("alias_of_a_pin_import",
+     "from ..utils.metrics import APISERVER_LATENCY_SUMMARY\n"
+     "LATENCY_METRIC = APISERVER_LATENCY_SUMMARY\n"
+     "def gate(reg):\n"
+     "    return reg.summary_stats(LATENCY_METRIC)\n"),
+    ("local_alias_of_pinned_value",
+     "LAT = 'apiserver_request_latencies_microseconds'\n"
+     "def gate(reg):\n"
+     "    return reg.summary_stats(LAT)\n"),
+    ("unresolvable_is_skipped",
+     "def gate(reg, names):\n"
+     "    return [reg.counter_sum(n) for n in names]\n"),
+    ("increments_are_not_reads",
+     "def work(reg):\n"
+     "    reg.inc('anything_goes_total')\n"),
+]
+
+KUBEMARK = "kubernetes_tpu/kubemark/gates.py"
+
+
+@pytest.mark.lint
+class TestMetricPinningRule:
+    @pytest.mark.parametrize("name,src", PINNING_BAD,
+                             ids=[r[0] for r in PINNING_BAD])
+    def test_bad_is_flagged(self, name, src):
+        assert symbols(src, ["metric-pinning"], path=KUBEMARK) == \
+            ["unpinned-metric-name"]
+
+    @pytest.mark.parametrize("name,src", PINNING_GOOD,
+                             ids=[r[0] for r in PINNING_GOOD])
+    def test_good_passes(self, name, src):
+        assert symbols(src, ["metric-pinning"], path=KUBEMARK) == []
+
+    def test_scoped_to_kubemark(self):
+        # incrementers elsewhere are free to mint names; only the
+        # gate/SLO layer is under the no-drift contract
+        src = PINNING_BAD[0][1]
+        assert not lint_source(textwrap.dedent(src),
+                               "kubernetes_tpu/controllers/job.py")
+        assert lint_source(textwrap.dedent(src), KUBEMARK)
+
+    def test_pinned_names_cover_the_gate_constants(self):
+        from kubernetes_tpu.lint import pinned_metric_names
+        pinned = pinned_metric_names()
+        for name in ("wal_records_total", "crowd_pods_created_total",
+                     "crowd_pods_bound_total",
+                     "apiserver_request_latencies_microseconds",
+                     "watch_publish_deliver_lag_seconds",
+                     "pod_e2e_stage_seconds"):
+            assert name in pinned
 
 
 # ------------------------------------------------------------ the baseline

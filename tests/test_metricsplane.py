@@ -1,0 +1,445 @@
+"""The fleet metrics plane (ISSUE 14): histogram algebra, exposition
+escaping round-trips, counter-reset rebase math, the byte-identical
+same-seed series export, deterministic burn-rate alert edges, the
+shed-exempt /metrics contract, and the flight recorder's bundle
+layout.
+
+Reference: the reference's posture is an external Prometheus +
+Alertmanager; this plane runs the same scrape -> parse -> merge ->
+burn-rate pipeline in-process on the injectable clock so alert
+timelines replay (DIVERGENCES #30)."""
+
+import itertools
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServer
+from kubernetes_tpu.obs.flightrec import FlightRecorder
+from kubernetes_tpu.obs.metricsplane import (BurnRateEvaluator,
+                                             CallableTarget, FleetScraper,
+                                             HttpTarget, RegistryTarget,
+                                             SLODef, _CounterState,
+                                             _HistState, evaluate_series,
+                                             parse_exposition)
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.metrics import (APISERVER_LATENCY_SUMMARY,
+                                          CROWD_COUNTERS,
+                                          HISTOGRAM_BUCKETS,
+                                          WATCH_LAG_HISTOGRAM, Histogram,
+                                          MetricsRegistry,
+                                          escape_label_value)
+
+# ------------------------------------------------------ histogram algebra
+
+
+def _hist(bounds, values):
+    h = Histogram(tuple(bounds))
+    for v in values:
+        h.observe(v)
+    return h
+
+
+class TestHistogram:
+    BOUNDS = (0.001, 0.01, 0.1, 1.0)
+
+    def test_le_is_inclusive(self):
+        h = _hist(self.BOUNDS, [0.01])
+        # an observation ON the bound lands in that bucket, not above
+        assert h.counts[1] == 1
+        assert h.quantile_le(0.01) == 1
+
+    def test_overflow_bucket(self):
+        h = _hist(self.BOUNDS, [5.0, 99.0])
+        assert h.counts[-1] == 2
+        assert h.cumulative()[-1] == h.count == 2
+
+    def test_merge_commutative(self):
+        a = _hist(self.BOUNDS, [0.0005, 0.05, 2.0])
+        b = _hist(self.BOUNDS, [0.02, 0.02, 0.5])
+        ab, ba = a.merge(b), b.merge(a)
+        assert ab.to_dict() == ba.to_dict()
+        assert ab.count == 6
+
+    def test_merge_associative(self):
+        a = _hist(self.BOUNDS, [0.0005])
+        b = _hist(self.BOUNDS, [0.05, 0.07])
+        c = _hist(self.BOUNDS, [3.0, 0.009, 0.2])
+        left = a.merge(b).merge(c)
+        right = a.merge(b.merge(c))
+        # bucket counts are integers: exact under any association;
+        # the float running sum only to addition-order rounding
+        assert left.counts == right.counts
+        assert left.count == right.count
+        assert left.total == pytest.approx(right.total)
+
+    def test_merge_is_exact_across_simulated_processes(self):
+        """The mergeability story summaries cannot offer: shard one
+        observation stream across three 'process' histograms in every
+        order — each fold equals the single-process histogram."""
+        values = [0.0004, 0.002, 0.002, 0.05, 0.3, 0.3, 2.0, 7.0]
+        whole = _hist(self.BOUNDS, values)
+        shards = [_hist(self.BOUNDS, values[0:3]),
+                  _hist(self.BOUNDS, values[3:5]),
+                  _hist(self.BOUNDS, values[5:8])]
+        for perm in itertools.permutations(shards):
+            folded = perm[0]
+            for h in perm[1:]:
+                folded = folded.merge(h)
+            assert folded.counts == whole.counts
+            assert folded.count == whole.count
+            assert folded.total == pytest.approx(whole.total)
+
+    def test_mismatched_bounds_refuse_to_merge(self):
+        with pytest.raises(ValueError):
+            _hist((0.1, 1.0), []).merge(_hist((0.2, 1.0), []))
+
+    def test_unpinned_le_refused(self):
+        with pytest.raises(ValueError):
+            _hist(self.BOUNDS, [0.5]).quantile_le(0.05)
+
+    def test_dual_landing_from_observe(self):
+        """observe() on a name with pinned boundaries lands in BOTH
+        the summary and the histogram — no call-site changes."""
+        reg = MetricsRegistry()
+        reg.observe(WATCH_LAG_HISTOGRAM, 0.002)
+        reg.observe(WATCH_LAG_HISTOGRAM, 0.002)
+        h = reg.histogram_merged(WATCH_LAG_HISTOGRAM)
+        assert h is not None and h.count == 2
+        assert h.bounds == HISTOGRAM_BUCKETS[WATCH_LAG_HISTOGRAM]
+        assert reg.summary(WATCH_LAG_HISTOGRAM).count == 2
+
+    def test_observe_histogram_requires_pinned_name(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().observe_histogram("bespoke_seconds", 0.1)
+
+
+# --------------------------------------- exposition golden round-trips
+
+
+class TestExpositionRoundTrip:
+    def test_escape_order(self):
+        # backslash first: escaping '\n' must not double-escape the
+        # backslash the newline rule just wrote
+        assert escape_label_value('a\\b') == 'a\\\\b'
+        assert escape_label_value('say "hi"\n') == 'say \\"hi\\"\\n'
+
+    def test_nasty_label_values_round_trip(self):
+        """The satellite-1 golden test: every reserved character
+        through render() and back out of the scrape parser."""
+        reg = MetricsRegistry()
+        nasty = {'path': 'C:\\tmp\\x', 'msg': 'he said "no"\nthen left',
+                 'plain': 'ok'}
+        reg.inc("escape_roundtrip_total", nasty, by=3.0)
+        fams = parse_exposition(reg.render())
+        fam = fams["escape_roundtrip_total"]
+        assert fam.kind == "counter"
+        (labels, value), = fam.points.items()
+        assert dict(labels) == nasty
+        assert value == 3.0
+
+    def test_histogram_round_trips_buckets_exactly(self):
+        reg = MetricsRegistry()
+        for v in (0.0002, 0.003, 0.003, 0.8, 9.0):
+            reg.observe_histogram(WATCH_LAG_HISTOGRAM, v,
+                                  {"stream": "pods"})
+        before = reg.histogram(WATCH_LAG_HISTOGRAM, {"stream": "pods"})
+        fam = parse_exposition(reg.render())[WATCH_LAG_HISTOGRAM]
+        (labels, h), = fam.hists.items()
+        assert dict(labels) == {"stream": "pods"}
+        assert h.to_dict() == before.to_dict()
+
+    def test_render_emits_cumulative_buckets_and_inf(self):
+        reg = MetricsRegistry()
+        reg.observe_histogram(WATCH_LAG_HISTOGRAM, 0.0002)
+        reg.observe_histogram(WATCH_LAG_HISTOGRAM, 9.0)
+        text = reg.render()
+        assert f'{WATCH_LAG_HISTOGRAM}_bucket{{le="+Inf"}} 2' in text
+        assert f'# TYPE {WATCH_LAG_HISTOGRAM} histogram' in text
+        assert f'{WATCH_LAG_HISTOGRAM}_count 2' in text
+
+    def test_summary_survives_as_sum_count(self):
+        reg = MetricsRegistry()
+        reg.observe("plain_summary_seconds", 1.5)
+        reg.observe("plain_summary_seconds", 2.5)
+        fam = parse_exposition(reg.render())["plain_summary_seconds"]
+        assert fam.kind == "summary"
+        ((_, (total, count)),) = fam.sums.items()
+        assert (total, count) == (4.0, 2.0)
+
+
+# ------------------------------------------------- counter-reset rebase
+
+
+class TestCounterReset:
+    #: (raw sequence) -> (adjusted sequence, resets seen) — the rebase
+    #: must keep the adjusted track monotone through any crash pattern
+    CASES = [
+        ([5.0, 7.0, 9.0], [5.0, 7.0, 9.0], 0),           # no restart
+        ([5.0, 1.0], [5.0, 6.0], 1),                     # one restart
+        ([5.0, 0.0, 3.0], [5.0, 5.0, 8.0], 1),           # restart to 0
+        ([2.0, 1.0, 0.5], [2.0, 3.0, 3.5], 2),           # crash loop
+        ([0.0, 0.0, 4.0], [0.0, 0.0, 4.0], 0),           # idle start
+    ]
+
+    @pytest.mark.parametrize("raw,adjusted,resets", CASES)
+    def test_rebase_table(self, raw, adjusted, resets):
+        st = _CounterState()
+        out, seen = [], 0
+        for r in raw:
+            v, was_reset = st.adjust(r)
+            out.append(v)
+            seen += was_reset
+        assert out == adjusted
+        assert seen == resets
+        assert out == sorted(out), "adjusted counter went backwards"
+
+    def test_histogram_reset_banks_the_precrash_view(self):
+        bounds = (0.1, 1.0)
+        st = _HistState()
+        first = _hist(bounds, [0.05, 0.5, 0.5])
+        adj, reset = st.adjust(first, None)
+        assert not reset and adj.count == 3
+        # the process restarts: fresh histogram with fewer observations
+        fresh = _hist(bounds, [2.0])
+        adj, reset = st.adjust(fresh, first)
+        assert reset
+        # pre-crash counts are banked under the fresh ones
+        assert adj.count == 4
+        assert adj.counts == [1, 2, 1]
+
+    def test_scraper_rebases_through_a_restart(self):
+        """Swap the registry behind a target mid-series — the fleet
+        counter keeps climbing and the sample records the reset."""
+        reg = [MetricsRegistry()]
+        target = CallableTarget("comp", lambda: reg[0].render())
+        sc = FleetScraper([target], clock=FakeClock())
+        reg[0].inc("restart_probe_total", by=5.0)
+        assert sc.sample(t=0.0)["counters"][
+            "restart_probe_total"][""] == 5.0
+        reg[0] = MetricsRegistry()            # the crash
+        reg[0].inc("restart_probe_total", by=2.0)
+        smp = sc.sample(t=1.0)
+        assert smp["counters"]["restart_probe_total"][""] == 7.0
+        assert smp["resets"] == 1
+        assert sc.resets_total == 1
+
+    def test_scrape_error_is_counted_not_fatal(self):
+        def explode():
+            raise OSError("target down")
+        sc = FleetScraper([CallableTarget("down", explode)],
+                          clock=FakeClock())
+        smp = sc.sample(t=0.0)
+        assert smp["errors"] == 1 and sc.errors_total == 1
+
+
+# ------------------------------------- the byte-identical series export
+
+
+def _drive_scraper(seed):
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    sc = FleetScraper([RegistryTarget("fleet", reg)], clock=clock,
+                      cadence_s=1.0, jitter_s=0.5, seed=seed)
+    for t in range(8):
+        reg.inc(CROWD_COUNTERS[0], by=float(3 + (t % 2)))
+        reg.inc(CROWD_COUNTERS[1], by=3.0)
+        reg.observe(WATCH_LAG_HISTOGRAM, 0.001 * (t + 1),
+                    {"stream": "pods"})
+        reg.observe(APISERVER_LATENCY_SUMMARY, 500.0 * (t + 1),
+                    {"verb": "GET", "resource": "pods"})
+        clock.step(1.0)
+        sc.sample(t=float(t))
+    return sc.export_json()
+
+
+class TestDeterministicExport:
+    def test_same_seed_byte_identical_export(self):
+        a, b = _drive_scraper(7), _drive_scraper(7)
+        assert a == b  # byte-for-byte, the tier-1 contract
+        doc = json.loads(a)
+        assert len(doc["samples"]) == 8
+        assert doc["errors_total"] == 0
+
+    def test_export_is_sorted_compact_json(self):
+        out = _drive_scraper(7)
+        doc = json.loads(out)
+        assert out == json.dumps(doc, sort_keys=True,
+                                 separators=(",", ":"))
+
+    def test_seed_rides_the_artifact(self):
+        assert json.loads(_drive_scraper(1))["seed"] == 1
+        assert json.loads(_drive_scraper(2))["seed"] != 1
+
+
+# ----------------------------------------------- burn-rate alert edges
+
+
+def _synthetic_series(bad_samples):
+    """Cumulative crowd counters: 5 created per tick, 5 bound per tick
+    except the bad ticks (nothing binds)."""
+    series, created, bound = [], 0.0, 0.0
+    for t in range(12):
+        created += 5.0
+        bound += 0.0 if t in bad_samples else 5.0
+        series.append({
+            "t": float(t),
+            "counters": {CROWD_COUNTERS[0]: {"": created},
+                         CROWD_COUNTERS[1]: {"": bound}},
+            "gauges": {}, "histograms": {}, "resets": 0, "errors": 0})
+    return series
+
+
+CROWD_SLO = SLODef(name="crowd", metric=CROWD_COUNTERS[0],
+                   good_metric=CROWD_COUNTERS[1], objective=0.999,
+                   fast_window=2, slow_window=8,
+                   fast_burn=10.0, slow_burn=2.0)
+
+
+class TestBurnRateAlerts:
+    def test_trip_and_clear_at_pinned_samples(self):
+        events = evaluate_series([CROWD_SLO], _synthetic_series({4, 5}))
+        assert [(e.sample, e.action) for e in events] == \
+            [(4, "TRIP"), (7, "CLEAR")]
+        # CLEAR at 7, not 6: the 2-sample fast window still covers
+        # sample 5's errors at index 6
+
+    def test_clean_series_never_trips(self):
+        assert evaluate_series([CROWD_SLO], _synthetic_series(set())) == []
+
+    def test_single_bad_sample_is_a_flash(self):
+        events = evaluate_series([CROWD_SLO], _synthetic_series({3}))
+        trips = [e for e in events if e.action == "TRIP"]
+        assert len(trips) == 1 and trips[0].sample == 3
+        clears = [e for e in events if e.action == "CLEAR"]
+        assert clears and clears[0].sample <= 6
+
+    def test_same_series_same_edges(self):
+        a = evaluate_series([CROWD_SLO], _synthetic_series({4, 5}))
+        b = evaluate_series([CROWD_SLO], _synthetic_series({4, 5}))
+        assert [e.to_dict() for e in a] == [e.to_dict() for e in b]
+
+    def test_histogram_le_slo_reads_pinned_bound(self):
+        slo = SLODef(name="lat", metric=WATCH_LAG_HISTOGRAM,
+                     kind="histogram_le", threshold_le=0.01,
+                     objective=0.99, fast_window=1, slow_window=2,
+                     fast_burn=10.0, slow_burn=2.0)
+        reg = MetricsRegistry()
+        sc = FleetScraper([RegistryTarget("fleet", reg)],
+                          clock=FakeClock())
+        ev = BurnRateEvaluator([slo])
+        # round 1: all good (under the bound)
+        for _ in range(4):
+            reg.observe_histogram(WATCH_LAG_HISTOGRAM, 0.001)
+        ev.observe(sc.sample(t=0.0))
+        # round 2: everything over the bound -> burn spikes
+        for _ in range(40):
+            reg.observe_histogram(WATCH_LAG_HISTOGRAM, 2.0)
+        events = ev.observe(sc.sample(t=1.0))
+        assert [e.action for e in events] == ["TRIP"]
+
+    def test_callbacks_fire_on_edges(self):
+        seen = []
+        ev = BurnRateEvaluator([CROWD_SLO],
+                               on_trip=lambda e: seen.append(e.action),
+                               on_clear=lambda e: seen.append(e.action))
+        for smp in _synthetic_series({4, 5}):
+            ev.observe(smp)
+        assert seen == ["TRIP", "CLEAR"]
+
+
+# -------------------------------------------- the shed-exempt /metrics
+
+
+class TestMetricsEndpointUnderStorm:
+    def test_metrics_stays_readable_while_saturated(self):
+        """The satellite-2 chaos pin: with every in-flight slot held,
+        a normal GET sheds 429 but /metrics answers — Prometheus must
+        keep seeing a melting server (like /healthz for the breaker)."""
+        # private registry: the shed below must not land in
+        # global_metrics and pollute other tests' drop counters
+        srv = ApiServer(Registry(), port=0, max_in_flight=1,
+                        metrics=MetricsRegistry()).start()
+        assert srv._inflight.acquire(blocking=False)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/api/v1/pods",
+                                       timeout=5)
+            assert ei.value.code == 429
+            resp = urllib.request.urlopen(srv.url + "/metrics",
+                                          timeout=5)
+            assert resp.status == 200
+            assert resp.headers.get("Content-Type") == \
+                "text/plain; version=0.0.4"
+            fams = parse_exposition(resp.read().decode())
+            assert "apiserver_dropped_requests" in fams
+        finally:
+            srv._inflight.release()
+            srv.stop()
+
+    def test_http_target_scrapes_a_live_server(self):
+        srv = ApiServer(Registry(), port=0,
+                        metrics=MetricsRegistry()).start()
+        try:
+            # prime a request so service-time metrics exist
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+            sc = FleetScraper(
+                [HttpTarget("apiserver", srv.url + "/metrics")],
+                clock=FakeClock())
+            smp = sc.sample(t=0.0)
+            assert smp["errors"] == 0
+            assert any(n.startswith("apiserver_")
+                       for n in smp["counters"])
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------- the flight recorder
+
+
+class TestFlightRecorder:
+    def test_bundle_layout(self, tmp_path):
+        clock = FakeClock(start=5.0)
+        reg = MetricsRegistry()
+        sc = FleetScraper([RegistryTarget("fleet", reg)], clock=clock)
+        reg.inc("wal_records_total", by=2.0)
+        sc.sample(t=0.0)
+        rec = FlightRecorder(str(tmp_path), clock=clock)
+        path = rec.dump("slo-crowd-bind-availability", scraper=sc,
+                        chaos={"tick": 3}, extra={"fast_burn": 500.0})
+        assert path is not None
+        assert os.path.basename(path) == \
+            "bundle-0000-slo-crowd-bind-availability"
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert meta["reason"] == "slo-crowd-bind-availability"
+        assert meta["extra"] == {"fast_burn": 500.0}
+        assert meta["monotonic"] == 5.0
+        series = json.load(open(os.path.join(path, "series.json")))
+        assert len(series) == 1
+        assert series[0]["counters"]["wal_records_total"][""] == 2.0
+        chaos = json.load(open(os.path.join(path, "chaos.json")))
+        assert chaos == {"tick": 3}
+        assert rec.bundles == [path]
+
+    def test_capacity_caps_bundles(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), capacity=2)
+        assert rec.dump("a") and rec.dump("b")
+        assert rec.dump("c") is None
+        assert rec.dropped == 1 and len(rec.bundles) == 2
+
+    def test_broken_section_never_raises(self, tmp_path):
+        class Broken:
+            def tail(self, n):
+                raise RuntimeError("mid-crash")
+
+            def export_json(self):
+                raise RuntimeError("mid-crash")
+        rec = FlightRecorder(str(tmp_path))
+        path = rec.dump("chaos-kill", scraper=Broken(), tracer=Broken())
+        assert path is not None
+        assert os.path.exists(os.path.join(path, "meta.json"))
+        assert not os.path.exists(os.path.join(path, "series.json"))

@@ -10,6 +10,7 @@ the injectable Clock, so a same-seed run exports byte-identical
 trace-event JSON (the PR-10 determinism family)."""
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -287,11 +288,19 @@ class TestDebugTraceEndpoint:
         srv = ApiServer(registry, port=0).start()
         try:
             HttpClient(srv.url).create("pods", mkpod("dbg"))
-            with urllib.request.urlopen(
-                    srv.url + "/debug/trace") as resp:
-                events = json.loads(resp.read().decode())
-            assert any(e.get("name") == "apiserver POST pods"
-                       for e in events)
+            # the server seals the request span AFTER the response bytes
+            # go out, so an immediate read can race the append — poll
+            # briefly rather than assert on the first fetch
+            deadline = time.monotonic() + 5.0
+            while True:
+                with urllib.request.urlopen(
+                        srv.url + "/debug/trace") as resp:
+                    events = json.loads(resp.read().decode())
+                if any(e.get("name") == "apiserver POST pods"
+                       for e in events):
+                    break
+                assert time.monotonic() < deadline, events
+                time.sleep(0.02)
             with urllib.request.urlopen(
                     srv.url + "/debug/trace?format=spans") as resp:
                 spans = json.loads(resp.read().decode())

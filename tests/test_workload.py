@@ -146,10 +146,12 @@ class TestWorkloadChaosApplier:
 
 #: the tier-1 shape: small fleet, compressed trace, but the FULL gate
 #: set — 5% API faults + a 10% node-kill plan (the ISSUE-8 acceptance
-#: bar); seed 2's schedule covers every generator (bursts, a failing
-#: job wave, rollout steps, churn)
+#: bar) with the metrics plane scraping per tick (the ISSUE-14 bar:
+#: the crowd fast-burn alert must trip AND clear); seed 2's schedule
+#: covers every generator (bursts, a failing job wave, rollout steps,
+#: churn)
 FAST = dict(n_nodes=12, tick_wall_s=0.4, fault_rate=0.05,
-            node_kill_fraction=0.10, timeout=120.0)
+            node_kill_fraction=0.10, timeout=120.0, scrape=True)
 
 
 def _fast_plan():
@@ -178,6 +180,23 @@ class TestWorkloadSoak:
         assert r.services_ok
         # the failing wave actually exercised the Job failure backoff
         assert r.failing_waves > 0 and r.backoff_requeues > 0
+        # ---- the metrics plane rode the whole replay (ISSUE-14):
+        # per-tick samples + the crowd fast-burn alert timeline
+        assert r.scrape_samples >= r.ticks, (
+            f"scraper took {r.scrape_samples} samples over {r.ticks} "
+            f"ticks")
+        assert r.scrape_errors == 0, (
+            "scrape failed mid-replay: /metrics must stay readable "
+            "(shed-exempt) through the storm")
+        crowd_trips = [a for a in r.alerts
+                       if a["action"] == "TRIP"
+                       and a["slo"] == "crowd-bind-availability"]
+        assert crowd_trips, (
+            f"the flash crowds never tripped the fast-burn alert "
+            f"(alerts: {r.alerts})")
+        assert r.alerts_ok, (
+            f"a crowd alert failed to clear within "
+            f"{r.alert_clear_limit_ticks} ticks: {r.alerts}")
         assert r.slo_ok
 
 

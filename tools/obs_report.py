@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Obs report: burn-rate table + sparklines from a metrics-plane
+series, and the bench-trajectory regression gate (--against).
+
+The input is either artifact the plane produces, auto-detected:
+
+  - a FleetScraper series export (FleetScraper.export_json(), also
+    what a flight-recorder bundle's series.json holds a tail of)
+  - a bench.py artifact whose `metricsplane` section carries the same
+    export under "series" plus the recorded alert timeline
+    (python bench.py --timeseries > BENCH_rNN.json)
+
+The burn-rate table REPLAYS the evaluator over the series (the
+pinned kubemark/slo.py FLEET_SLOS) — on a bench artifact the replay
+is cross-checked against the alert timeline the run recorded, so a
+drifted evaluator shows up as a mismatch, not a silent pass.
+
+--against compares this artifact's headline scalars to a previous
+round's BENCH_r*.json (throughput up is good, p99/overhead up is
+bad) and exits 1 on any move beyond the noise band — the trajectory
+regression gate.
+
+Usage:
+  python tools/obs_report.py series.json
+  python tools/obs_report.py BENCH_r06.json --against BENCH_r05.json
+  python tools/obs_report.py BENCH_r06.json --band 0.15
+
+stdlib-only by design: it must run anywhere the repo does, including
+the bare soak containers.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubernetes_tpu.kubemark.slo import FLEET_SLOS
+from kubernetes_tpu.obs.metricsplane import BurnRateEvaluator
+
+#: 8-level block ramp; every sparkline row is normalized to its own max
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def load_doc(source: str) -> dict:
+    if source == "-":
+        return json.load(sys.stdin)
+    with open(source) as fh:
+        return json.load(fh)
+
+
+def split_doc(doc: dict):
+    """-> (series_export, bench_headline, recorded_alerts). Accepts a
+    bare scraper export, a bench headline dict, or the round-capture
+    wrapper the BENCH_r*.json files use ({"parsed": headline, ...})."""
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if "samples" in doc:               # bare FleetScraper export
+        return doc, None, []
+    mp = doc.get("metricsplane")
+    if isinstance(mp, dict):
+        return mp.get("series") or {"samples": []}, doc, \
+            list(mp.get("alerts") or [])
+    if "series" in doc:                # a bare metricsplane section
+        return doc.get("series") or {"samples": []}, None, \
+            list(doc.get("alerts") or [])
+    return {"samples": []}, doc, []
+
+
+# ------------------------------------------------------------ series
+
+
+def counter_track(samples, name):
+    """Cumulative fleet total per sample (summed across label sets)."""
+    return [sum(s.get("counters", {}).get(name, {}).values())
+            for s in samples]
+
+
+def hist_count_track(samples, name):
+    return [sum(d.get("count", 0)
+                for d in s.get("histograms", {}).get(name, {}).values())
+            for s in samples]
+
+
+def deltas(track):
+    return [max(0.0, b - a) for a, b in zip(track, track[1:])]
+
+
+def sparkline(vals) -> str:
+    top = max(vals) if vals else 0.0
+    if top <= 0:
+        return BLOCKS[0] * len(vals)
+    return "".join(BLOCKS[min(7, int(v / top * 7.999))] for v in vals)
+
+
+def series_report(export: dict, top: int) -> str:
+    samples = export.get("samples", [])
+    lines = [f"{len(samples)} samples, targets="
+             f"{','.join(export.get('targets', [])) or '?'}, "
+             f"cadence={export.get('cadence_s', '?')}s, "
+             f"resets={export.get('resets_total', 0)}, "
+             f"scrape_errors={export.get('errors_total', 0)}"]
+    if not samples:
+        return "\n".join(lines)
+    # per-sample rate sparklines, busiest families first (counters and
+    # histogram observation counts share one ranking)
+    names = {}
+    for s in samples:
+        for n in s.get("counters", {}):
+            names.setdefault(n, "counter")
+        for n in s.get("histograms", {}):
+            names.setdefault(n, "histogram")
+    rows = []
+    for n, kind in names.items():
+        track = (counter_track(samples, n) if kind == "counter"
+                 else hist_count_track(samples, n))
+        d = deltas(track)
+        rows.append((sum(d), n, kind, d, track[-1] if track else 0.0))
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    shown = rows[:top]
+    width = max((len(r[1]) for r in shown), default=10)
+    lines.append("")
+    lines.append(f"{'family':<{width}}  {'total':>12}  per-sample rate")
+    for _, n, kind, d, final in shown:
+        lines.append(f"{n:<{width}}  {final:>12.1f}  {sparkline(d)}")
+    if len(rows) > top:
+        lines.append(f"... {len(rows) - top} quieter families "
+                     f"elided (--top to widen)")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------- burn rates
+
+
+def burn_report(export: dict, recorded_alerts) -> str:
+    samples = export.get("samples", [])
+    ev = BurnRateEvaluator(list(FLEET_SLOS))
+    for s in samples:
+        ev.observe(s)
+    lines = [f"{'slo':<26} {'objective':>9} {'fast':>9} {'slow':>9} "
+             f"{'trips':>5} {'clears':>6} {'active':>6}"]
+    for slo in FLEET_SLOS:
+        mine = [e for e in ev.events if e.slo == slo.name]
+        fast = ev._burn(slo, slo.fast_window) if samples else 0.0
+        slow = ev._burn(slo, slo.slow_window) if samples else 0.0
+        lines.append(
+            f"{slo.name:<26} {slo.objective:>9} {fast:>9.2f} "
+            f"{slow:>9.2f} "
+            f"{sum(e.action == 'TRIP' for e in mine):>5} "
+            f"{sum(e.action == 'CLEAR' for e in mine):>6} "
+            f"{str(ev.active(slo.name)).lower():>6}")
+    if ev.events:
+        lines.append("")
+        lines.append("alert timeline (replayed from the series):")
+        for e in ev.events:
+            lines.append(f"  sample {e.sample:>3} t={e.t:<8g} "
+                         f"{e.action:<5} {e.slo} "
+                         f"(fast={e.fast_burn:.1f} slow={e.slow_burn:.1f})")
+    if recorded_alerts:
+        replayed = [[e.sample, e.slo, e.action] for e in ev.events]
+        recorded = [[a["sample"], a["slo"], a["action"]]
+                    for a in recorded_alerts]
+        lines.append("")
+        if replayed == recorded:
+            lines.append(f"recorded alert timeline matches the replay "
+                         f"({len(recorded)} edges) -- evaluator is "
+                         f"deterministic over this series")
+        else:
+            lines.append(f"MISMATCH: run recorded {recorded} but the "
+                         f"replay produced {replayed} -- the evaluator "
+                         f"or the series drifted")
+    return "\n".join(lines)
+
+
+# -------------------------------------------- the trajectory regression
+
+
+def _scalars(doc: dict) -> dict:
+    """Comparable headline scalars from a bench dict, any round's
+    shape (the slo section was flat before it grew density_points)."""
+    out = {}
+    if isinstance(doc.get("value"), (int, float)):
+        out["e2e_pods_per_sec"] = float(doc["value"])
+    if isinstance(doc.get("engine_only_pods_per_sec"), (int, float)):
+        out["engine_pods_per_sec"] = float(doc["engine_only_pods_per_sec"])
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        points = slo.get("density_points")
+        if isinstance(points, list):
+            for i, p in enumerate(points):
+                if isinstance(p.get("api_p99_ms"), (int, float)):
+                    out[f"slo[{i}].api_p99_ms"] = float(p["api_p99_ms"])
+        elif isinstance(slo.get("api_p99_ms"), (int, float)):
+            out["slo.api_p99_ms"] = float(slo["api_p99_ms"])
+    wl = doc.get("workload")
+    if isinstance(wl, dict) and isinstance(wl.get("bind_p99_s"),
+                                           (int, float)):
+        out["workload.bind_p99_s"] = float(wl["bind_p99_s"])
+    mp = doc.get("metricsplane")
+    if isinstance(mp, dict) and isinstance(mp.get("overhead_frac"),
+                                           (int, float)):
+        out["scrape.overhead_frac"] = float(mp["overhead_frac"])
+    return out
+
+
+def _recover_scalars(wrapper: dict) -> dict:
+    """Best-effort baseline recovery when a round's wrapper has
+    parsed:null (the driver's tail got truncated mid-JSON): fish the
+    headline throughput out of the raw tail text."""
+    tail = wrapper.get("tail") or ""
+    m = re.search(r'"value":\s*([0-9.]+)', tail)
+    if m:
+        return {"e2e_pods_per_sec": float(m.group(1))}
+    m = re.search(r'per_sec":\s*\[([^\]]+)\]', tail)
+    if m:
+        try:
+            runs = [float(x) for x in m.group(1).split(",")]
+            return {"e2e_pods_per_sec": max(runs)}
+        except ValueError:
+            pass
+    return {}
+
+
+#: direction per scalar: +1 means up is good (throughput), -1 means
+#: up is bad (latency, overhead)
+def _direction(name: str) -> int:
+    return 1 if name.endswith("pods_per_sec") else -1
+
+
+def against_report(current: dict, baseline_path: str,
+                   band: float):
+    base_doc = load_doc(baseline_path)
+    inner = base_doc.get("parsed") if isinstance(base_doc.get("parsed"),
+                                                 dict) else base_doc
+    base = _scalars(inner) if isinstance(inner, dict) else {}
+    if not base and "tail" in base_doc:
+        base = _recover_scalars(base_doc)
+    cur = _scalars(current)
+    shared = sorted(set(base) & set(cur))
+    lines = [f"trajectory vs {os.path.basename(baseline_path)} "
+             f"(noise band ±{band:.0%}):"]
+    if not shared:
+        lines.append("  no comparable scalars in both artifacts -- "
+                     "nothing to gate")
+        return "\n".join(lines), False
+    width = max(len(n) for n in shared)
+    regressed = False
+    for n in shared:
+        b, c = base[n], cur[n]
+        rel = (c - b) / b if b else 0.0
+        bad = _direction(n) * rel < -band
+        regressed |= bad
+        verdict = "REGRESSION" if bad else (
+            "improved" if _direction(n) * rel > band else "flat")
+        lines.append(f"  {n:<{width}}  {b:>12.2f} -> {c:>12.2f} "
+                     f"({rel:+7.1%})  {verdict}")
+    return "\n".join(lines), regressed
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="burn-rate table + sparklines from a metrics-plane "
+                    "series; --against gates the bench trajectory")
+    ap.add_argument("source", help="FleetScraper export or bench.py "
+                                   "artifact (BENCH_r*.json), '-' for "
+                                   "stdin")
+    ap.add_argument("--against", metavar="BENCH_rNN.json",
+                    help="previous round's artifact: compare headline "
+                         "scalars, exit 1 on a move beyond the band")
+    ap.add_argument("--band", type=float, default=0.25,
+                    help="relative noise band for --against (default "
+                         "0.25: the box shows ±20%% run-to-run)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="sparkline rows to show (busiest families "
+                         "first, default 12)")
+    args = ap.parse_args()
+
+    doc = load_doc(args.source)
+    export, bench, recorded_alerts = split_doc(doc)
+
+    print(series_report(export, args.top))
+    print()
+    print(burn_report(export, recorded_alerts))
+
+    if args.against:
+        if bench is None:
+            bench = {}
+        print()
+        text, regressed = against_report(bench, args.against, args.band)
+        print(text)
+        if regressed:
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,12 @@ one traced pass recording the pod-lifecycle stage decomposition —
 per-stage p50/p99 from pod_e2e_stage_seconds plus the
 stage-coverage-of-e2e-wall ratio, gated >=90% — and one tracing-off
 control pass gating the tracer's throughput cost at <5%), null
-unless requested.
+unless requested; r12 adds multichip (the --mesh-devices scaling
+ladder: engine-only passes on 1/2/4/../N virtual-device meshes with
+the node axis sharded, per-rung pods/s + per-chip scaling efficiency
++ the mesh-vs-single-device bit-equality gate, and with
+--density-ladder the 20k-node / 150k-pod density tier written to
+DENSITY_20K.json), null unless requested.
 """
 
 import argparse
@@ -176,19 +181,13 @@ def _tpu_section():
     return out
 
 
-def engine_only(n_nodes, n_pods, plain=False, speculative=None):
-    """Device scan throughput on a prebuilt snapshot (encode excluded:
-    the live pipeline encodes incrementally, measured by the e2e number).
-
-    plain=True drops the service so the batch runs the node-local tier —
-    the tier the live e2e pipeline actually executes (its bench pods
-    have no services/RCs) and the one where the speculative engine
-    engages; `speculative` pins the engine choice for A/B runs
-    (None = the engine's platform default)."""
+def _engine_snapshot(n_nodes, n_pods, plain=False):
+    """The engine-only fixture: kubemark-shape nodes + homogeneous web
+    pods, shared by engine_only() and the multichip ladder children so
+    every rung scores the same problem."""
     from kubernetes_tpu.core import types as api
     from kubernetes_tpu.core.quantity import Quantity
-    from kubernetes_tpu.sched.device import (BatchEngine, ClusterSnapshot,
-                                             encode_snapshot)
+    from kubernetes_tpu.sched.device import ClusterSnapshot
 
     gi = 1024 ** 3
     mi = 1024 ** 2
@@ -221,7 +220,22 @@ def engine_only(n_nodes, n_pods, plain=False, speculative=None):
         services = []
         for p in pods:
             p.metadata.labels = {}
-    snap = ClusterSnapshot(nodes=nodes, services=services, pending_pods=pods)
+    return ClusterSnapshot(nodes=nodes, services=services,
+                           pending_pods=pods)
+
+
+def engine_only(n_nodes, n_pods, plain=False, speculative=None):
+    """Device scan throughput on a prebuilt snapshot (encode excluded:
+    the live pipeline encodes incrementally, measured by the e2e number).
+
+    plain=True drops the service so the batch runs the node-local tier —
+    the tier the live e2e pipeline actually executes (its bench pods
+    have no services/RCs) and the one where the speculative engine
+    engages; `speculative` pins the engine choice for A/B runs
+    (None = the engine's platform default)."""
+    from kubernetes_tpu.sched.device import BatchEngine, encode_snapshot
+
+    snap = _engine_snapshot(n_nodes, n_pods, plain=plain)
     engine = BatchEngine(speculative=speculative)
     enc = encode_snapshot(snap, node_pad_to=engine.n_shards,
                           pod_pad_to=((n_pods + 8191) // 8192) * 8192)
@@ -235,6 +249,136 @@ def engine_only(n_nodes, n_pods, plain=False, speculative=None):
     elapsed = time.time() - t0
     n_bound = int((assigned[:enc.n_pods] >= 0).sum())
     return n_bound / elapsed, n_bound
+
+
+# the ladder rung shape: big enough that the scan dominates (not the
+# encode) and spans two 8192-pod tiles so the device-carry chain runs,
+# small enough that a 4-rung ladder stays in minutes on the cpu box
+_LADDER_NODES = 2000
+_LADDER_PODS = 16384
+
+
+def _mesh_ladder_child(n_devices, n_nodes, n_pods, tile=8192):
+    """Subprocess body for one multichip ladder rung: an engine-only
+    scoring pass with the node axis sharded over an n-device mesh
+    (virtual CPU devices forced by the parent's XLA_FLAGS), gated
+    bit-equal against the single-device engine at the same shape.
+    Prints one 'LADDER {json}' line for the parent to collect."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.sched.device import BatchEngine, encode_snapshot
+
+    snap = _engine_snapshot(n_nodes, n_pods)
+    if n_devices > 1:
+        mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("nodes",))
+        engine = BatchEngine(mesh=mesh)
+    else:
+        engine = BatchEngine()
+    enc = encode_snapshot(snap, node_pad_to=engine.n_shards,
+                          pod_pad_to=((n_pods + tile - 1) // tile) * tile)
+    engine.run_chunked(enc, tile)   # warmup compile
+    t0 = time.time()
+    assigned, _ = engine.run_chunked(enc, tile)
+    elapsed = time.time() - t0
+    a = np.asarray(assigned[:enc.n_pods])
+    out = {"n_devices": n_devices, "nodes": n_nodes, "pods": n_pods,
+           "bound": int((a >= 0).sum()),
+           "pods_per_sec": round(n_pods / elapsed, 1),
+           "elapsed_s": round(elapsed, 3)}
+    if n_devices > 1:
+        # the bit-equality gate: the sharded scan must bind every pod
+        # to the same node the single-device engine picks (the serial
+        # oracle is infeasible at the density tier; single-device is
+        # itself oracle-gated by tests/test_device_parity.py)
+        ref, _ = BatchEngine().run_chunked(enc, tile)
+        out["parity_vs_single_device"] = bool(
+            np.array_equal(a, np.asarray(ref[:enc.n_pods])))
+    print("LADDER " + json.dumps(out), flush=True)
+
+
+def _ladder_rung(n_devices, n_nodes, n_pods, timeout):
+    """Run one ladder rung in a subprocess with n forced host devices
+    (same virtual-device pattern as __graft_entry__.dryrun_multichip:
+    the parent process's jax is already initialized with one device, so
+    the count must be forced before the child's first jax import)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    prog = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        f"bench._mesh_ladder_child({n_devices}, {n_nodes}, {n_pods})\n")
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", prog], env=env, capture_output=True,
+            text=True, timeout=timeout, cwd=repo)
+    except subprocess.TimeoutExpired:
+        return {"n_devices": n_devices, "nodes": n_nodes, "pods": n_pods,
+                "error": f"timeout after {timeout}s"}
+    for line in reversed(res.stdout.splitlines()):
+        if line.startswith("LADDER "):
+            return json.loads(line[len("LADDER "):])
+    return {"n_devices": n_devices, "nodes": n_nodes, "pods": n_pods,
+            "error": (res.stdout + res.stderr)[-500:],
+            "rc": res.returncode}
+
+
+def _multichip_section(args):
+    """The --mesh-devices arm: the 1/2/4/../N virtual-device scaling
+    ladder at a fixed engine-only shape (per-rung pods/s, per-chip
+    scaling efficiency vs the 1-device rung, and the mesh-vs-single-
+    device bit-equality gate), plus — under --density-ladder — the
+    20k-node / 150k-pod density tier on the full mesh, written to
+    DENSITY_20K.json. Virtual devices share the one physical core, so
+    efficiency here measures partitioning overhead, not speedup; on
+    real chips the same ladder reads scaling."""
+    ladder_ns, n = [], 1
+    while n <= args.mesh_devices:
+        ladder_ns.append(n)
+        n *= 2
+    rungs = [_ladder_rung(n, _LADDER_NODES, _LADDER_PODS, timeout=900)
+             for n in ladder_ns]
+    base = next((r.get("pods_per_sec") for r in rungs
+                 if r.get("n_devices") == 1), None)
+    for r in rungs:
+        if base and r.get("pods_per_sec"):
+            # per-chip efficiency: 1.0 = perfect linear scaling
+            r["scaling_efficiency"] = round(
+                r["pods_per_sec"] / (r["n_devices"] * base), 3)
+    section = {
+        "ladder_nodes": _LADDER_NODES,
+        "ladder_pods": _LADDER_PODS,
+        "ladder": rungs,
+        "parity_ok": all(r.get("parity_vs_single_device", True)
+                         for r in rungs),
+        "density": None}
+    if args.density_ladder:
+        dn = max(2, args.mesh_devices)
+        density = _ladder_rung(dn, 20000, 150000, timeout=5400)
+        section["density"] = density
+        section["parity_ok"] = (section["parity_ok"] and
+                                density.get("parity_vs_single_device",
+                                            False))
+        if "error" not in density:
+            from kubernetes_tpu.kubemark.tpu_evidence import \
+                _atomic_write_json
+            repo = os.path.dirname(os.path.abspath(__file__))
+            _atomic_write_json(
+                os.path.join(repo, "DENSITY_20K.json"),
+                {"metric": "density_20k_nodes_150k_pods",
+                 "platform": "cpu-pinned virtual mesh",
+                 "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+                 **density})
+    return section
 
 
 def main():
@@ -329,6 +473,17 @@ def main():
                          "scrape-overhead control); records the "
                          "metricsplane section — feed the artifact to "
                          "tools/obs_report.py")
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="run the multichip scaling ladder: engine-only "
+                         "passes on 1/2/4/../N virtual-device meshes "
+                         "(node axis sharded, argmax over ICI), each "
+                         "mesh rung gated bit-equal to the single-"
+                         "device engine; records the multichip section")
+    ap.add_argument("--density-ladder", action="store_true",
+                    help="with --mesh-devices: add the 20k-node / "
+                         "150k-pod density tier on the full mesh "
+                         "(bit-equality gated) and write DENSITY_20K."
+                         "json")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -675,6 +830,14 @@ def main():
                   f"{base.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
+    multichip = None
+    if args.mesh_devices:
+        multichip = _multichip_section(args)
+        if args.verbose:
+            effs = [(g["n_devices"], g.get("scaling_efficiency"))
+                    for g in multichip["ladder"]]
+            print(f"# multichip parity_ok={multichip['parity_ok']} "
+                  f"efficiency={effs}", file=sys.stderr)
     pallas = _pallas_status(platform)
 
     import jax
@@ -787,6 +950,7 @@ def main():
         "durability": durability,
         "workload": workload,
         "metricsplane": metricsplane,
+        "multichip": multichip,
         "multihost": multihost,
         "lint": lint_section,
         "tpu": _tpu_section()}))

@@ -40,6 +40,10 @@ class BenchmarkResult:
     mode: str                 # "batch" | "serial"
     started_at: float = 0.0   # epoch of create-start (profilers scope
     #                           samples to [started_at, +elapsed_s])
+    # batch mode: the engine's host->device transfer accounting over the
+    # MEASURED window (warmup excluded) — full vs delta upload tiles and
+    # bytes; None in serial mode
+    upload_stats: Optional[dict] = None
 
 
 _BENCH_REQUESTS = {"cpu": parse_quantity("100m"),
@@ -95,7 +99,8 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
                              store_publish_inline: bool = False,
                              chaos_seed: Optional[int] = None,
                              chaos_error_rate: float = 0.01,
-                             txn_commit: bool = True
+                             txn_commit: bool = True,
+                             delta_uploads: bool = True
                              ) -> BenchmarkResult:
     """Stand up master + fleet + scheduler, blast pods from 30 writers,
     measure time until every pod is bound (and optionally Running).
@@ -115,7 +120,11 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     injector (chaos.ChaosClient at chaos_error_rate on all verbs) so
     the perf number is recorded UNDER fault load — the bench.py
     --chaos-seed arm. None (the default) leaves the hot path
-    untouched."""
+    untouched.
+
+    delta_uploads: False forces the engine to re-upload the full node
+    tables every tile (the pre-mirror behavior) — the control arm of
+    the delta-scatter A/B in tools/profile_e2e.py."""
     # GIL slice: r2 measured 1ms best (the scheduler thread parked
     # behind 30 writers at every dispatch); after r4's contention fixes
     # (thread-local uids, in-place rv stamping, informer-riding
@@ -147,6 +156,7 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
     if mode == "batch":
         sched = BatchScheduler(factory.create_batch(
             commit_chunk=0 if txn_commit else 1024)).run()
+        sched.config.engine.delta_uploads = delta_uploads
     elif mode == "serial":
         sched = Scheduler(factory.create()).run()
     else:
@@ -166,6 +176,12 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
             # in-process scheduler, scheduler_test.go:278), and compile
             # happens once per shape, not per tile
             _warmup_batch(sched, factory)
+            # transfer accounting restarts at the measured window (the
+            # warmup's uploads are compile-cache priming, not steady
+            # state; the device mirror itself stays warm, as in a live
+            # scheduler)
+            sched.config.engine.upload_stats = {
+                k: 0 for k in sched.config.engine.upload_stats}
 
         # the live-server GC posture (utils/gctune.py): the booted
         # fleet + node caches freeze out of the young generations and
@@ -266,7 +282,9 @@ def run_scheduling_benchmark(n_nodes: int = 1000, n_pods: int = 1000,
             n_nodes=n_nodes, n_pods=n_pods, scheduled=scheduled,
             running=running, elapsed_s=elapsed,
             pods_per_sec=scheduled / elapsed if elapsed > 0 else 0.0,
-            mode=mode, started_at=start)
+            mode=mode, started_at=start,
+            upload_stats=(dict(sched.config.engine.upload_stats)
+                          if mode == "batch" else None))
     finally:
         try:
             gc_ctx.__exit__(None, None, None)

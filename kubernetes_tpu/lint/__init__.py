@@ -1,7 +1,7 @@
 """orchlint: AST invariant lint for the orchestrator's own contracts.
 
 The reference tree leans on `go vet` and the race detector in CI; this
-port's equivalents are conventions — and conventions rot. Five invariant
+port's equivalents are conventions — and conventions rot. Six invariant
 families are machine-checked here (stdlib `ast`, no dependencies), run
 as a tier-1 test so a violation fails the build:
 
@@ -29,6 +29,15 @@ as a tier-1 test so a violation fails the build:
                    jitted functions and `lax.scan` bodies — each one is
                    a silent device->host round trip in the scan hot
                    path.
+  shard-sync       also in `sched/device/`: outputs of jitted dispatch
+                   (sharded `jax.Array`s under a mesh) pulled to host
+                   INSIDE a per-tile/per-chunk Python loop —
+                   `jax.device_get`, `np.asarray`/`.item()`/scalar
+                   casts on them, or Python branching on a per-shard
+                   value — each is a cross-shard gather + host sync
+                   per tile that serializes the async dispatch
+                   pipeline. Collect device references in the loop and
+                   transfer once after it.
   api-idempotency  a retry loop around a bare POST (`create`/`bind`
                    without an idempotency guard) outside `api/retry.py`
                    is flagged: replaying an ambiguous POST duplicates
@@ -711,6 +720,182 @@ def check_metric_pinning(tree: ast.AST, path: str) -> List[Violation]:
     return v.out
 
 
+# ------------------------------------------------------ rule: shard-sync
+
+#: call heads that PRODUCE a jitted dispatcher when assigned: the value
+#: bound is a compiled callable whose outputs live on device (sharded
+#: under a mesh)
+_JIT_PRODUCERS = ("jax.jit", "jax.pmap")
+
+#: attribute receivers that ARE jitted dispatchers on the engine
+_DISPATCH_ATTRS = ("self._run", "self._scatter")
+
+
+def _assigned_names(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def check_shard_sync(tree: ast.AST, path: str) -> List[Violation]:
+    """Cross-shard host syncs in the tile loop.
+
+    The live pipeline's contract: inside a per-tile/per-chunk Python
+    loop, outputs of jitted dispatch (sharded jax.Arrays under a mesh)
+    must stay on device — `jax.device_get`, `np.asarray`, `.item()`,
+    `float()`/`int()`/`bool()` on them force a cross-shard gather +
+    host sync per iteration, and a Python `if`/`while` on a per-shard
+    value blocks the async dispatch queue the same way. Collect device
+    references and pull ONCE after the loop (see
+    engine.run_chunked's multiproc concat).
+
+    Taint is name-level per scope: names bound from `jax.jit(...)` /
+    `self._get_run(...)` / `self._runs.get(...)` are dispatchers;
+    names bound from CALLING a dispatcher (tuple unpack included) are
+    device values, propagated through assignments and list appends.
+    `jax.device_get` inside a loop is flagged unconditionally — there
+    is no loop in this tree where a per-iteration device_get is not a
+    sync."""
+    imports = _import_table(tree)
+    out: List[Violation] = []
+
+    def iter_own(node: ast.AST):
+        """Descendants of `node`, not crossing into nested def/class
+        scopes (their taint sets are their own)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            yield child
+            yield from iter_own(child)
+
+    def process_scope(scope_node: ast.AST, scope: List[str]) -> None:
+        nodes = list(iter_own(scope_node))
+        jit_fns: set = set()
+        tainted: set = set()
+
+        def is_producer(call: ast.Call) -> bool:
+            name = _resolve(call.func, imports)
+            dotted = _dotted(call.func) or ""
+            return (name in _JIT_PRODUCERS
+                    or dotted.endswith("._get_run")
+                    or dotted == "self._runs.get")
+
+        def is_dispatch(call: ast.Call) -> bool:
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in jit_fns:
+                return True
+            return (_dotted(call.func) or "") in _DISPATCH_ATTRS
+
+        for _ in range(8):  # taint to a fixpoint (chains are short)
+            before = (len(jit_fns), len(tainted))
+            for n in nodes:
+                if isinstance(n, ast.Assign):
+                    targets: set = set()
+                    for t in n.targets:
+                        targets |= _assigned_names(t)
+                    calls = [c for c in ast.walk(n.value)
+                             if isinstance(c, ast.Call)]
+                    if any(is_producer(c) for c in calls):
+                        jit_fns |= targets
+                    elif any(is_dispatch(c) for c in calls) \
+                            or (_assigned_names(n.value) & tainted):
+                        tainted |= targets
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in ("append", "extend") \
+                        and isinstance(n.func.value, ast.Name) \
+                        and any(_assigned_names(a) & tainted
+                                for a in n.args):
+                    tainted.add(n.func.value.id)
+            if (len(jit_fns), len(tainted)) == before:
+                break
+
+        site = ".".join(scope) or "<module>"
+
+        def flag(node: ast.AST, symbol: str, message: str) -> None:
+            out.append(Violation(
+                rule="shard-sync", path=path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                site=site, symbol=symbol, message=message))
+
+        def touches(node: ast.AST) -> bool:
+            return bool(_assigned_names(node) & tainted)
+
+        for loop in (n for n in nodes if isinstance(n, (ast.For,
+                                                        ast.While))):
+            if isinstance(loop, ast.While) and touches(loop.test):
+                flag(loop, "branch-on-per-shard-value",
+                     "Python `while` on a device value syncs every "
+                     "shard to host per iteration; use host metadata "
+                     "or fold the predicate into the jitted step")
+            for n in iter_own(loop):
+                if isinstance(n, ast.If) and touches(n.test):
+                    flag(n, "branch-on-per-shard-value",
+                         "Python `if` on a device value inside the "
+                         "tile loop forces a cross-shard gather + "
+                         "host sync per tile; branch on host "
+                         "metadata or use jnp.where/lax.cond")
+                elif isinstance(n, ast.While) and touches(n.test):
+                    flag(n, "branch-on-per-shard-value",
+                         "Python `while` on a device value inside "
+                         "the tile loop syncs per iteration; use "
+                         "lax.while_loop or host metadata")
+                elif isinstance(n, ast.Call):
+                    resolved = _resolve(n.func, imports)
+                    if resolved == "jax.device_get":
+                        flag(n, "device-get-in-tile-loop",
+                             "jax.device_get inside the tile loop "
+                             "gathers every shard to host per "
+                             "iteration; collect device references "
+                             "and pull once after the loop")
+                    elif resolved in ("numpy.asarray", "numpy.array") \
+                            and any(touches(a) for a in n.args):
+                        flag(n, "host-pull-in-tile-loop",
+                             f"{resolved.replace('numpy', 'np')}() on "
+                             f"a device value inside the tile loop "
+                             f"is a cross-shard host pull per tile; "
+                             f"collect device references and "
+                             f"transfer once after the loop")
+                    elif resolved in ("float", "int", "bool") \
+                            and any(touches(a) for a in n.args):
+                        flag(n, "host-scalar-in-tile-loop",
+                             f"{resolved}() on a device value inside "
+                             f"the tile loop is a per-tile host "
+                             f"sync; keep the scalar on device or "
+                             f"pull after the loop")
+                    elif isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "item" \
+                            and touches(n.func.value):
+                        flag(n, "host-scalar-in-tile-loop",
+                             ".item() on a device value inside the "
+                             "tile loop is a per-tile cross-shard "
+                             "sync; keep the scalar on device or "
+                             "pull after the loop")
+
+    def walk(node: ast.AST, scope: List[str]) -> None:
+        process_scope(node, scope)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                walk(child, scope + [child.name])
+            elif not isinstance(child, (ast.For, ast.While, ast.If,
+                                        ast.With, ast.Try)):
+                continue
+            else:
+                walk_nested_defs(child, scope)
+
+    def walk_nested_defs(node: ast.AST, scope: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.ClassDef)):
+                walk(child, scope + [child.name])
+            else:
+                walk_nested_defs(child, scope)
+
+    walk(tree, [])
+    return out
+
+
 # ----------------------------------------------------------- the runner
 
 def _soak_file(name: str) -> bool:
@@ -734,6 +919,8 @@ def _rule_applies(rule: str, path: str) -> bool:
                         "kubernetes_tpu/core/wal.py")
     if rule == "jax-hygiene":
         return path.startswith("kubernetes_tpu/sched/device/")
+    if rule == "shard-sync":
+        return path.startswith("kubernetes_tpu/sched/device/")
     if rule == "api-idempotency":
         return (path.startswith("kubernetes_tpu/")
                 and path != "kubernetes_tpu/api/retry.py")
@@ -748,6 +935,7 @@ RULES = {
     "determinism": check_determinism,
     "lock-discipline": check_lock_discipline,
     "jax-hygiene": check_jax_hygiene,
+    "shard-sync": check_shard_sync,
     "api-idempotency": check_api_idempotency,
     "metric-pinning": check_metric_pinning,
 }

@@ -88,9 +88,14 @@ class BatchSchedulerConfig:
                  tile_size: int = 8192, min_pad: int = 64,
                  bulk_chunk: int = 1024, incremental: bool = True,
                  commit_chunk: int = 0,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 mesh=None):
         self.factory = factory
-        self.engine = engine or BatchEngine()
+        # mesh= shards the node axis of the live pipeline across devices
+        # (ignored when an explicit engine is passed — the engine's own
+        # mesh wins); the encoder below keeps slot capacity a multiple
+        # of the mesh size so shards stay block-aligned
+        self.engine = engine or BatchEngine(mesh=mesh)
         self.tile_size = tile_size
         # bind-commit sub-batch size: 0 commits the whole tile as ONE
         # multi-key store transaction (registry routes commit_txn — one
@@ -169,7 +174,9 @@ class BatchScheduler:
         if not self.config.incremental:
             return None
         if self._inc is None:
-            inc = IncrementalEncoder(policy=self.config.engine.policy)
+            inc = IncrementalEncoder(
+                policy=self.config.engine.policy,
+                mesh_devices=self.config.engine.n_shards)
             # narrowing must budget for a dispatched-but-unassumed tile
             inc.inflight_pad = self.config.tile_size
             self._inc = inc.attach(self.config.factory)

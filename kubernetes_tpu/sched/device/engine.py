@@ -612,6 +612,57 @@ def _make_spec_run(weights: Tuple[int, int, int],
     return run
 
 
+def _tree_nbytes(tree) -> int:
+    return sum(np.asarray(leaf).nbytes
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class _TableCache:
+    """Device-resident mirror of one incremental encoder's node tables
+    (NodeConst + State init), sharded under the engine's mesh.
+
+    `node_gen` / `state_gen` are the encoder generations (TableDelta
+    counter values) the two mirrors are current at: a tile whose encode
+    carries generation g needs only the rows whose dirty_gen exceeds
+    the mirror's gen scattered in. `sig` pins shapes, dtypes, and
+    mem_scale — any change (capacity growth, interner widening, a
+    narrowing flip) misses and reseeds with a full upload. `src` pins
+    the encoder INSTANCE (TableDelta.encoder_id): generations count one
+    encoder's private timeline, so a same-shaped tile from a different
+    encoder must miss — its low generations would otherwise read as
+    "nothing changed" against another encoder's rows."""
+
+    __slots__ = ("sig", "src", "node", "state", "node_gen", "state_gen")
+
+    def __init__(self, sig, src, node, state, node_gen, state_gen):
+        self.sig = sig
+        self.src = src
+        self.node = node
+        self.state = state
+        self.node_gen = node_gen
+        self.state_gen = state_gen
+
+
+# Per-slot (axis-0) fields of the two device tables — the only fields
+# the dirty-row scatter touches. Everything else is either slot-axis-1
+# ([G,N]/[T,N]/[S,N]) or scalar-shaped, and is a CONSTANT for
+# delta-eligible encodes (no spread groups, no affinity terms, no
+# service groups): zeros / -1 with shapes pinned by the cache signature.
+_NODE_ROW_FIELDS = ("valid", "sched_ok", "cpu_cap", "mem_cap", "pod_cap",
+                    "labels", "tie_rank", "exceed_cpu", "exceed_mem",
+                    "zone_id", "static_mask", "static_score")
+_STATE_ROW_FIELDS = ("cpu_used", "mem_used", "nz_cpu", "nz_mem",
+                     "pod_count", "port_bits", "disk_any", "disk_rw")
+
+
+def _scatter_rows_fn(tab, idx, rows):
+    """Jitted per-shard scatter: write the journaled dirty rows into the
+    donated device table columns (dict of axis-0 arrays; 2-D columns
+    take whole rows). Under a mesh XLA lowers the scatter per shard —
+    each device applies the row writes that land in its slot block."""
+    return {k: tab[k].at[idx].set(rows[k]) for k in tab}
+
+
 def _node_shardings(mesh: Mesh, axis: str):
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
@@ -672,6 +723,22 @@ class BatchEngine:
         # entirely rather than running on dummy [1, N] arrays every step
         self._runs = {}
         self._run = self._get_run(True, True)
+        # device-resident mirror of the incremental encoder's node tables
+        # (run_chunked's delta-upload path); the scatter donates the stale
+        # mirror buffers so XLA updates rows in place
+        self._table_cache: Optional[_TableCache] = None
+        self._scatter = jax.jit(_scatter_rows_fn, donate_argnums=(0,))
+        self.delta_uploads = True  # A/B knob: False forces full uploads
+        # host->device transfer accounting, read by tools/profile_e2e.py
+        # and the bench multichip section
+        self.upload_stats = {"full_tiles": 0, "delta_tiles": 0,
+                             "reuse_tiles": 0, "full_bytes": 0,
+                             "delta_bytes": 0, "pod_bytes": 0,
+                             # gauge, not a counter: host nbytes of one
+                             # full (NodeConst, State) pair at the last
+                             # fetch — what a full upload WOULD move,
+                             # even in a window that never moved one
+                             "table_bytes": 0}
 
     @property
     def speculative(self) -> bool:
@@ -779,6 +846,117 @@ class BatchEngine:
                      svc_member=pb.svc_member)
         return node, state, pods
 
+    def _table_sig(self, enc: EncodeResult):
+        """Shape/dtype signature of every array feeding NodeConst + State.
+        Any mismatch against the cached mirror (capacity growth, interner
+        word-count widening, an i32/i64 narrowing flip, a mem_scale
+        change) forces a full reseed — the dirty-row journal only covers
+        value changes at a fixed layout."""
+        nt, st = enc.node_tab, enc.init_state
+        arrs = (nt.valid, nt.sched_ok, nt.cpu_cap, nt.mem_cap, nt.pod_cap,
+                nt.label_words, nt.tie_rank, nt.exceed_cpu, nt.exceed_mem,
+                enc.offgrid_max, nt.aff_dom, nt.zone_id, nt.zone_scratch,
+                nt.static_mask, nt.static_score,
+                st.cpu_used, st.mem_used, st.nz_cpu, st.nz_mem,
+                st.pod_count, st.port_bits, st.disk_any, st.disk_rw,
+                st.spread, st.aff_count, st.aff_total, st.svc_count,
+                st.svc_total)
+        return (enc.mem_scale,) + tuple(
+            (np.asarray(a).shape, np.asarray(a).dtype.str) for a in arrs)
+
+    def _delta_eligible(self, enc: EncodeResult,
+                        flags: Tuple[bool, bool]) -> bool:
+        """The dirty-row scatter only rewrites per-slot (axis-0) columns,
+        so it applies exactly when every other table field is a canonical
+        constant: an incremental encode (journal present) with no
+        affinity terms, no spread groups, and no anti-affinity policy
+        (zone scratch tables). Same family as the chain-eligibility test
+        in sched/batch.py — the live pipeline's steady state."""
+        return (self.delta_uploads and enc.delta is not None
+                and flags == (False, False) and not enc.tile_groups
+                and self._anti_weight == 0)
+
+    def _scatter_table(self, dev_tab, fields, host_tab, rows):
+        """Scatter the journaled dirty rows of one table into its device
+        mirror. Row count pads to the next pow2 (one compiled scatter per
+        bucket, not per tile); the pad duplicates rows[0], and duplicate
+        .set writes of identical values are deterministic. Returns the
+        updated table and the host->device bytes moved."""
+        bucket = 1 << max(0, (int(rows.size) - 1).bit_length())
+        idx = np.empty(bucket, np.int64)
+        idx[:rows.size] = rows
+        idx[rows.size:] = rows[0]
+        sub = {f: getattr(dev_tab, f) for f in fields}
+        host_rows = {f: np.ascontiguousarray(
+            np.asarray(getattr(host_tab, f))[idx]) for f in fields}
+        out = self._scatter(sub, idx, host_rows)
+        moved = idx.nbytes + sum(r.nbytes for r in host_rows.values())
+        return dev_tab._replace(**out), moved
+
+    def _fetch_tables(self, enc: EncodeResult, node: NodeConst, state: State,
+                      flags: Tuple[bool, bool], state_needed: bool):
+        """Resolve the (NodeConst, State-init) run arguments through the
+        device-resident mirror. Hit: scatter only the rows the encoder's
+        journal marks dirty since the mirror's generation. Miss or
+        ineligible: full host upload (and reseed the mirror when
+        eligible). Single-process path only — multi-host placement goes
+        through _place_global.
+
+        A chained tile (state_needed=False) skips the State mirror: its
+        state_gen lags and the next unchained tile catches up by
+        scattering every row dirtied since."""
+        self.upload_stats["table_bytes"] = \
+            _tree_nbytes(node) + _tree_nbytes(state)
+        if not self._delta_eligible(enc, flags):
+            self._table_cache = None
+            self.upload_stats["full_tiles"] += 1
+            self.upload_stats["full_bytes"] += _tree_nbytes(node) + (
+                _tree_nbytes(state) if state_needed else 0)
+            return node, state
+        sig = self._table_sig(enc)
+        delta = enc.delta
+        cache = self._table_cache
+        if cache is not None and cache.sig == sig \
+                and cache.src == delta.encoder_id \
+                and delta.full_gen <= min(cache.node_gen, cache.state_gen):
+            moved = 0
+            node_rows = np.nonzero(
+                delta.node_dirty_gen > cache.node_gen)[0]
+            if node_rows.size:
+                cache.node, nb = self._scatter_table(
+                    cache.node, _NODE_ROW_FIELDS, node, node_rows)
+                moved += nb
+            cache.node_gen = delta.table_gen
+            if state_needed:
+                state_rows = np.nonzero(
+                    delta.state_dirty_gen > cache.state_gen)[0]
+                if state_rows.size:
+                    cache.state, sb = self._scatter_table(
+                        cache.state, _STATE_ROW_FIELDS, state, state_rows)
+                    moved += sb
+                cache.state_gen = delta.table_gen
+            if moved:
+                self.upload_stats["delta_tiles"] += 1
+                self.upload_stats["delta_bytes"] += moved
+            else:
+                self.upload_stats["reuse_tiles"] += 1
+            return cache.node, cache.state
+        # miss: seed the mirror with one full (sharded) upload
+        if self.mesh is not None:
+            node_sh, state_sh, _ = _node_shardings(self.mesh, self.node_axis)
+            node_dev = jax.device_put(node, node_sh)
+            state_dev = jax.device_put(state, state_sh)
+        else:
+            node_dev = jax.device_put(node)
+            state_dev = jax.device_put(state)
+        self._table_cache = _TableCache(sig, delta.encoder_id,
+                                        node_dev, state_dev,
+                                        delta.table_gen, delta.table_gen)
+        self.upload_stats["full_tiles"] += 1
+        self.upload_stats["full_bytes"] += \
+            _tree_nbytes(node) + _tree_nbytes(state)
+        return node_dev, state_dev
+
     def probe(self, enc: EncodeResult) -> Tuple[np.ndarray, np.ndarray]:
         """-> (mask bool[P, N], total i64[P, N]) of predicate fit and
         priority score per pending pod against the pre-batch state. The
@@ -878,7 +1056,9 @@ class BatchEngine:
         the final host transfer — dispatches are queued asynchronously
         and the returned assignment array materializes on first
         np.asarray."""
+        enc = self._ensure_safe_dtypes(enc)
         node, state, pods = self.device_args(enc)
+        flags = self._enc_flags(enc)
         multiproc = self.spans_processes
         if multiproc:
             # multi-host: chunks slice HOST pytrees, then each piece
@@ -889,10 +1069,15 @@ class BatchEngine:
             node = self._put_tree(node, node_sh)
             if state_override is None:
                 state = self._put_tree(state, state_sh)
+        else:
+            node, state = self._fetch_tables(
+                enc, node, state, flags,
+                state_needed=state_override is None)
         if state_override is not None:
             state = state_override
-        run = self._get_run(*self._enc_flags(enc))
+        run = self._get_run(*flags)
         p = pods.valid.shape[0]
+        self.upload_stats["pod_bytes"] += _tree_nbytes(pods)
         outs = []
         for lo in range(0, p, chunk):
             piece = jax.tree_util.tree_map(lambda a: a[lo:lo + chunk], pods)
@@ -906,12 +1091,13 @@ class BatchEngine:
             if multiproc:
                 piece = self._put_tree(piece, pods_sh)
             state, assigned = run(node, state, piece)
-            # replicated outputs are addressable per process; host
-            # concat avoids an out-of-jit op over global arrays
-            outs.append(np.asarray(assigned) if multiproc else assigned)
+            outs.append(assigned)
         if multiproc:
-            flat = (np.concatenate(outs)[:p] if outs
-                    else np.zeros(0, np.int32))
+            # replicated outputs are addressable per process; host concat
+            # (after the dispatch loop — one sync, not one per chunk)
+            # avoids an out-of-jit op over global arrays
+            flat = (np.concatenate([np.asarray(a) for a in outs])[:p]
+                    if outs else np.zeros(0, np.int32))
             return flat, state
         flat = jnp.concatenate(outs)[:p] if outs else jnp.zeros(0, jnp.int32)
         if block:

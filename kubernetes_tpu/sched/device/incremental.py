@@ -48,9 +48,9 @@ from ..modeler import ASSUMED_POD_TTL
 from ..predicates import get_resource_request, node_schedulable
 from ..priorities import get_nonzero_requests
 from .tables import (WORD, EncodeResult, NodeArrays, PodArrays, StateArrays,
-                     _disk_keys, _matching_services, _pod_spread_selectors,
-                     _selector_matches, _set_bit, _words,
-                     collect_affinity_terms)
+                     TableDelta, _disk_keys, _matching_services,
+                     _pod_spread_selectors, _selector_matches, _set_bit,
+                     _words, collect_affinity_terms)
 
 
 class NeedsFullEncode(Exception):
@@ -79,6 +79,12 @@ def _grow(arr: np.ndarray, axis: int, new_len: int) -> np.ndarray:
     pad = [(0, 0)] * arr.ndim
     pad[axis] = (0, new_len - arr.shape[axis])
     return np.pad(arr, pad)
+
+
+# process-wide encoder identity (TableDelta.encoder_id): never reused
+# within a process, unlike id()
+_ENCODER_ID_NEXT = 1
+_ENCODER_ID_LOCK = threading.Lock()
 
 
 class _GrowingInterner:
@@ -144,16 +150,28 @@ class _PodRecord:
 class IncrementalEncoder:
     """Persistent cluster arrays fed by pod/node watch deltas."""
 
-    def __init__(self, node_capacity: int = 64, policy=None):
+    def __init__(self, node_capacity: int = 64, policy=None,
+                 mesh_devices: int = 1):
         """policy: a DevicePolicy whose NODE-STATIC tiers (label
         presence/priorities) are maintained incrementally; the
         anti-affinity tier needs per-tile service groups and stays with
-        the full encoder (callers must not pass one that needs it)."""
+        the full encoder (callers must not pass one that needs it).
+
+        mesh_devices: shard count of the engine this encoder feeds. The
+        node capacity rounds up to a multiple of it — here and on every
+        growth — so the device node axis always splits evenly across
+        the mesh without a caller-side pad, and a slot's shard
+        assignment (block sharding over stable slots) never moves
+        except at a capacity growth, which invalidates the device
+        table cache wholesale anyway."""
         if policy is not None and policy.needs_anti_affinity:
             raise ValueError(
                 "IncrementalEncoder: anti-affinity policies need the "
                 "full per-tile encoder")
         self._policy = policy
+        self.mesh_devices = max(1, int(mesh_devices))
+        node_capacity = -(-max(1, node_capacity) // self.mesh_devices) \
+            * self.mesh_devices
         self._lock = threading.RLock()
         # interners shared across the encoder's life
         self.labels_dict = _GrowingInterner()
@@ -250,6 +268,46 @@ class IncrementalEncoder:
         # worst-case pods already in flight on device but not yet
         # assumed host-side: _narrow_params must budget for them
         self.inflight_pad = 0
+
+        # ---- dirty-slot journal for the engine's device-resident table
+        # cache (tables.TableDelta / engine._TableCache). _table_gen is a
+        # monotonic mutation counter; the two per-slot arrays record the
+        # counter value at each slot's last change, split by which device
+        # table the change lands in: NodeConst rows (caps, labels, tie
+        # rank, schedulability, misfit flags) move only on node events
+        # and misfits, while State rows (running sums, bitsets) move on
+        # every pod event — including assume_assigned's fast path, which
+        # deliberately does NOT bump state_epoch (the device carry
+        # already holds those updates) but DOES journal here (the cached
+        # State init mirror does not). _full_dirty_gen marks the last
+        # whole-table invalidation: capacity growth reshapes — and
+        # therefore re-shards — every array.
+        self._table_gen = 0
+        self._node_dirty_gen = np.zeros(self.n_cap, np.int64)
+        self._state_dirty_gen = np.zeros(self.n_cap, np.int64)
+        self._full_dirty_gen = 0
+        # instance token stamped into every TableDelta: generations from
+        # two encoders are incomparable (see tables.TableDelta), and
+        # id() can be recycled after gc — a process-wide counter cannot
+        with _ENCODER_ID_LOCK:
+            global _ENCODER_ID_NEXT
+            self._encoder_id = _ENCODER_ID_NEXT
+            _ENCODER_ID_NEXT += 1
+
+    def _mark_node(self, slots) -> None:
+        """Caller holds the lock. Journal NodeConst-side change(s) at a
+        fresh generation (scalar int or integer array)."""
+        self._table_gen += 1
+        self._node_dirty_gen[slots] = self._table_gen
+
+    def _mark_state(self, slots) -> None:
+        """Caller holds the lock. Journal State-side change(s)."""
+        self._table_gen += 1
+        self._state_dirty_gen[slots] = self._table_gen
+
+    def _mark_full(self) -> None:
+        self._table_gen += 1
+        self._full_dirty_gen = self._table_gen
 
     # ================================================== watch delta feed
 
@@ -405,6 +463,11 @@ class IncrementalEncoder:
                 return
             rows = np.asarray(fast_rows, np.int64)
             slots = assigned[rows].astype(np.int64)
+            # no state_epoch bump (the device carry already holds these
+            # updates) but the cached State init mirror does not: journal
+            # the touched slots so the next non-chained dispatch
+            # re-uploads exactly these rows
+            self._mark_state(slots)
             np.add.at(self.pod_count, slots, 1)
             np.add.at(self.cpu_used, slots, pb.req_cpu[rows])
             np.add.at(self.mem_used, slots,
@@ -480,6 +543,8 @@ class IncrementalEncoder:
             self.exceed_mem[slot] = False
             self._free_slots.append(slot)
             self._tie_dirty = True
+            self._mark_node(slot)
+            self._mark_state(slot)
 
     # ================================================== pod bookkeeping
 
@@ -580,6 +645,7 @@ class IncrementalEncoder:
         self.node_pods.setdefault(slot, []).append(key)
         if not rec.counted_res:
             return
+        self._mark_state(slot)
         self.pod_count[slot] += 1
         self.nz_cpu[slot] += rec.nz_cpu
         self.nz_mem[slot] += rec.nz_mem
@@ -597,9 +663,11 @@ class IncrementalEncoder:
         if not fits_cpu:
             self.exceed_cpu[slot] = True
             rec.misfit = "cpu"
+            self._mark_node(slot)  # exceed flags live in NodeConst
         elif not fits_mem:
             self.exceed_mem[slot] = True
             rec.misfit = "mem"
+            self._mark_node(slot)
         else:
             self.cpu_used[slot] += rec.req_cpu
             self.mem_used[slot] += rec.req_mem
@@ -632,6 +700,7 @@ class IncrementalEncoder:
             pass
         if not rec.counted_res:
             return
+        self._mark_state(slot)
         self.pod_count[slot] -= 1
         self.nz_cpu[slot] -= rec.nz_cpu
         self.nz_mem[slot] -= rec.nz_mem
@@ -648,6 +717,8 @@ class IncrementalEncoder:
     def _replay_node(self, slot: int) -> None:
         """Recompute one node's aggregate state from its pod ledger, in
         insertion order (the arrival-order replay)."""
+        self._mark_state(slot)
+        self._mark_node(slot)  # rewrites the exceed flags (NodeConst)
         self.cpu_used[slot] = 0
         self.mem_used[slot] = 0
         self.nz_cpu[slot] = 0
@@ -697,6 +768,7 @@ class IncrementalEncoder:
         new_node = slot is None
         if new_node:
             slot = self._alloc_slot(name)
+        self._mark_node(slot)
         cap_changed = (
             not new_node and (
                 self.cpu_cap[slot] != (node.status.capacity["cpu"].milli
@@ -811,10 +883,18 @@ class IncrementalEncoder:
 
     def _grow_nodes(self) -> None:
         self.state_epoch += 1
+        # growth is the ONE event that reshapes (and re-shards) the node
+        # axis: the device table cache invalidates wholesale
+        self._mark_full()
         # double while small, then step by 1024: a 5000-node cluster pads
         # to 5120 lanes (2% waste), not 8192 (64%) — every scan step pays
-        # for the full node axis width
+        # for the full node axis width. Rounded up to a mesh multiple so
+        # the sharded axis always splits evenly (slot->shard stays block
+        # sharding over stable slots).
         new_cap = self.n_cap * 2 if self.n_cap < 1024 else self.n_cap + 1024
+        new_cap = -(-new_cap // self.mesh_devices) * self.mesh_devices
+        self._node_dirty_gen = _grow(self._node_dirty_gen, 0, new_cap)
+        self._state_dirty_gen = _grow(self._state_dirty_gen, 0, new_cap)
         for attr in ("valid", "sched_ok", "cpu_cap", "mem_cap", "pod_cap",
                      "tie_rank",
                      "cpu_used", "mem_used", "nz_cpu", "nz_mem", "pod_count",
@@ -836,8 +916,15 @@ class IncrementalEncoder:
     def _recompute_tie_rank(self) -> None:
         # rank over ALL known names: relative order among valid nodes is
         # what the tie-break consumes, and a superset ranking preserves it
+        old = self.tie_rank.copy()
+        self.tie_rank[:] = -1
         for rank, name in enumerate(sorted(self.node_slot)):
             self.tie_rank[self.node_slot[name]] = rank
+        changed = np.nonzero(old != self.tie_rank)[0]
+        if changed.size:
+            # a node add/delete shifts the ranks of name-sorted
+            # neighbours: journal exactly the slots whose rank moved
+            self._mark_node(changed)
         self._tie_dirty = False
 
     # ================================================== group bookkeeping
@@ -1166,6 +1253,14 @@ class IncrementalEncoder:
                 svc_count=np.zeros((1, n_pad), np.int32),
                 svc_total=np.zeros(1, np.int32))
             pb = replace_pod_batch_dtypes(pb, narrow, mem_scale)
+            # dirty-slot journal snapshot, captured under the same lock
+            # as the host-array copies above so the generations are
+            # consistent with this encode's table contents
+            delta = TableDelta(table_gen=self._table_gen,
+                               node_dirty_gen=self._node_dirty_gen.copy(),
+                               state_dirty_gen=self._state_dirty_gen.copy(),
+                               full_gen=self._full_dirty_gen,
+                               encoder_id=self._encoder_id)
             return EncodeResult(
                 node_tab=nt, pod_batch=pb, init_state=st,
                 offgrid_max=offgrid_max,
@@ -1173,7 +1268,8 @@ class IncrementalEncoder:
                 n_nodes=len(self.node_slot), n_pods=p,
                 mem_scale=mem_scale if narrow else 1,
                 tile_groups=tile_groups,
-                state_epoch=self.state_epoch)
+                state_epoch=self.state_epoch,
+                delta=delta)
 
     # ================================================== wiring helpers
 

@@ -186,6 +186,38 @@ class StateArrays:
 
 
 @dataclass
+class TableDelta:
+    """Slot-granular change journal of one encode against the encoder's
+    persistent node tables, consumed by the engine's device-resident
+    table cache (engine._TableCache).
+
+    `table_gen` is the encoder's monotonic mutation counter at encode
+    time; `node_dirty_gen[slot]` / `state_dirty_gen[slot]` are the
+    counter values when that slot's NodeConst-side / State-side rows
+    last changed (captured under the encoder lock, so they are
+    consistent with the host arrays this encode copied); `full_gen` is
+    the counter at the last whole-table invalidation (capacity growth,
+    which reshapes and re-shards every array). A cache whose content is
+    current at generation g needs exactly the rows with dirty_gen > g
+    re-uploaded — and a full re-upload iff full_gen > g. The split
+    matters because State rows churn on every assumed pod while
+    NodeConst rows move only on node events: a steady pipeline scatters
+    a handful of NodeConst rows (or none) per tile.
+
+    `encoder_id` names the encoder INSTANCE whose mutation counter the
+    generations count. Generations from two encoders are incomparable
+    even at identical table shapes (each counts its own timeline), so
+    the engine's cache must also match on identity — otherwise a fresh
+    encoder's low generations would read as "nothing changed" against a
+    mirror holding another encoder's rows."""
+    table_gen: int
+    node_dirty_gen: np.ndarray   # i64[n_cap]
+    state_dirty_gen: np.ndarray  # i64[n_cap]
+    full_gen: int
+    encoder_id: int
+
+
+@dataclass
 class EncodeResult:
     node_tab: NodeArrays
     pod_batch: PodArrays
@@ -205,6 +237,10 @@ class EncodeResult:
     # (assume_assigned's fast path and the device-carry chain both
     # require no intervening mutations)
     state_epoch: int = -1
+    # incremental-encoder only: dirty-slot journal for the engine's
+    # device-resident table cache (None -> the encode has no generation
+    # tracking and the engine always uploads the full tables)
+    delta: Optional[TableDelta] = None
 
 
 _I32_BOUND = 1 << 30  # slack below 2^31 for the x10 score scaling

@@ -334,6 +334,157 @@ def test_mesh_chained_pipeline_matches_single_run():
     assert int(inc.pod_count.sum()) == 32
 
 
+def _mk_inc_pods(tag, n, cpu=100, mem=64):
+    return [api.Pod(
+        metadata=api.ObjectMeta(name=f"p-{tag}-{j:04d}",
+                                namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(requests={
+                "cpu": mq(cpu), "memory": bq(mem * MI)}))]))
+        for j in range(n)]
+
+
+def _drive_pipeline(engine, inc, ticks, churn):
+    """Replay the live pipeline's chain discipline: carry the device
+    state between tiles while the encoder's epoch holds, drop the carry
+    when churn bumps it (exactly sched/batch.py's eligibility test).
+    churn[tick] runs against the encoder AFTER the tile's assume."""
+    import numpy as np
+    hosts, prev, prev_epoch = [], None, -1
+    for tick, pods in enumerate(ticks):
+        e = inc.encode_tile(pods, [], [], pad_to=16)
+        chain = prev if prev is not None \
+            and e.state_epoch == prev_epoch else None
+        a, s = engine.run_chunked(e, 16, state_override=chain,
+                                  block=False)
+        a = np.asarray(a)
+        hosts.append([e.node_names[i] if i >= 0 else None
+                      for i in a[:len(pods)]])
+        inc.assume_assigned(e, pods, a)
+        prev, prev_epoch = s, e.state_epoch
+        if tick in churn:
+            churn[tick](inc)
+    return hosts
+
+
+def test_mesh_chained_churn_parity():
+    """Sharded incremental parity under churn: node add, delete, and
+    condition-flip land mid-carry, and the mesh pipeline (device-resident
+    tables + delta scatters + chained State) must stay bit-identical to
+    the single-device pipeline fed the same watch history."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engines = {"mesh": BatchEngine(mesh=mesh), "single": BatchEngine()}
+    churn = {
+        0: lambda inc: inc.on_node_add(
+            make_node("n-new", 4000, 4 * 1024 * MI, 40)),
+        1: lambda inc: inc.on_node_delete(
+            make_node("n-003", 4000, 4 * 1024 * MI, 40)),
+        2: lambda inc: inc.on_node_update(
+            make_node("n-005", 4000, 4 * 1024 * MI, 40),
+            api.Node(metadata=api.ObjectMeta(name="n-005"),
+                     status=api.NodeStatus(
+                         capacity={"cpu": mq(4000),
+                                   "memory": bq(4 * 1024 * MI),
+                                   "pods": bq(40)},
+                         conditions=[api.NodeCondition(
+                             type="Ready", status="False")]))),
+    }
+    ticks = [_mk_inc_pods(t, 12) for t in range(5)]
+    results = {}
+    for kind, engine in engines.items():
+        inc = IncrementalEncoder(mesh_devices=engine.n_shards)
+        for i in range(21):  # deliberately not a device-count multiple
+            inc.on_node_add(make_node(f"n-{i:03d}", 4000,
+                                      4 * 1024 * MI, 40))
+        results[kind] = _drive_pipeline(engine, inc, ticks, churn)
+    assert results["mesh"] == results["single"]
+    # the delta path actually engaged on the mesh arm (not full uploads
+    # every tile)
+    stats = engines["mesh"].upload_stats
+    assert stats["delta_tiles"] + stats["reuse_tiles"] >= 2, stats
+
+
+def test_mesh_capacity_growth_across_shard_boundary():
+    """Capacity growth mid-pipeline re-lays the slot axis across shards
+    (the one sanctioned reshuffle). The mirror must reseed (sig miss)
+    and parity with the single-device arm must hold through the
+    boundary."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engines = {"mesh": BatchEngine(mesh=mesh), "single": BatchEngine()}
+    n_dev = engines["mesh"].n_shards
+
+    def add_fleet(inc, lo, n):
+        for i in range(lo, lo + n):
+            inc.on_node_add(make_node(f"g-{i:03d}", 4000,
+                                      4 * 1024 * MI, 40))
+
+    churn = {1: lambda inc: add_fleet(inc, 6, 14)}  # forces growth
+    ticks = [_mk_inc_pods(t, 10) for t in range(4)]
+    results, incs = {}, {}
+    for kind, engine in engines.items():
+        inc = IncrementalEncoder(node_capacity=n_dev,
+                                 mesh_devices=engine.n_shards)
+        add_fleet(inc, 0, 6)
+        results[kind] = _drive_pipeline(engine, inc, ticks, churn)
+        incs[kind] = inc
+    assert results["mesh"] == results["single"]
+    grown = incs["mesh"]
+    assert grown.n_cap > n_dev  # the boundary was actually crossed
+    assert grown.n_cap % n_dev == 0  # and shards stayed block-aligned
+    # growth invalidated the mirror exactly once more (reseed, not drift)
+    assert engines["mesh"].upload_stats["full_tiles"] >= 2
+
+
+@pytest.mark.slow
+def test_mesh_density_medium_parity():
+    """Big-shape arm of the churn parity: a 1500-node fleet and 4k pods
+    across chained tiles, mesh == single-device bit-equality (the
+    density-tier gate at a CI-tractable shape; bench.py --density-ladder
+    runs the full 20k x 150k)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engines = {"mesh": BatchEngine(mesh=mesh), "single": BatchEngine()}
+    results = {}
+    for kind, engine in engines.items():
+        inc = IncrementalEncoder(mesh_devices=engine.n_shards)
+        for i in range(1500):
+            inc.on_node_add(make_node(f"d-{i:05d}", 8000,
+                                      16 * 1024 * MI, 110))
+        hosts, prev, prev_epoch = [], None, -1
+        for tick in range(4):
+            pods = _mk_inc_pods(f"big{tick}", 1000, cpu=50, mem=32)
+            e = inc.encode_tile(pods, [], [], pad_to=1024)
+            chain = prev if prev is not None \
+                and e.state_epoch == prev_epoch else None
+            a, s = engine.run_chunked(e, 1024, state_override=chain,
+                                      block=False)
+            a = np.asarray(a)
+            hosts.append([e.node_names[i] if i >= 0 else None
+                          for i in a[:1000]])
+            inc.assume_assigned(e, pods, a)
+            prev, prev_epoch = s, e.state_epoch
+        results[kind] = hosts
+    assert results["mesh"] == results["single"]
+
+
 # ---------------------------------------------------------------------------
 # Speculative parallel-assign + conflict-repair engine (engine._make_spec_run,
 # SURVEY.md section 7 step 4's second branch): must be BIT-IDENTICAL to the

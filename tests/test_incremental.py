@@ -481,6 +481,108 @@ def test_node_slot_reclaim_under_name_churn():
     del slots_before
 
 
+@pytest.mark.parametrize("n_nodes,devs", [(5, 8), (13, 8), (63, 8),
+                                          (7, 4), (5000, 8)])
+def test_mesh_capacity_rounds_to_device_multiple(n_nodes, devs):
+    """Satellite regression: slot capacity always rounds UP to a multiple
+    of the mesh device count, so block sharding never needs a caller-side
+    pad — at construction, through every emitted tile, and across
+    growth. The 5000-on-8 case checks the shape math without feeding
+    nodes (the ISSUE's off-by-one example)."""
+    inc = IncrementalEncoder(node_capacity=n_nodes, mesh_devices=devs)
+    assert inc.n_cap % devs == 0 and inc.n_cap >= n_nodes
+    if n_nodes > 100:
+        return  # shape math only for the big case
+    for i in range(n_nodes):
+        inc.on_node_add(mk_node(f"n-{i:04d}"))
+    enc = inc.encode_tile([mk_pod("p", phase="Pending")], [], [])
+    assert enc.node_tab.valid.shape[0] % devs == 0
+    assert enc.init_state.cpu_used.shape[0] % devs == 0
+    # growth crosses a shard boundary and stays aligned
+    extra = inc.n_cap + 1 - n_nodes
+    for i in range(n_nodes, n_nodes + max(extra, 1)):
+        inc.on_node_add(mk_node(f"n-{i:04d}"))
+    assert inc.n_cap % devs == 0
+    assert inc.n_cap >= len(inc.node_slot)
+
+
+def test_encode_snapshot_node_pad_rounds_to_multiple():
+    """The one-shot path's half of the same contract: node_pad_to= is a
+    shard-count pad, 5 nodes on 8 devices encodes an 8-row table."""
+    nodes = [mk_node(f"n-{i}") for i in range(5)]
+    snap = ClusterSnapshot(nodes=nodes,
+                           pending_pods=[mk_pod("p", phase="Pending")])
+    enc = encode_snapshot(snap, node_pad_to=8)
+    assert enc.node_tab.valid.shape[0] % 8 == 0
+
+
+def test_delta_uploads_bit_equal_to_full_uploads_under_churn():
+    """The tentpole's A/B at test scale: the engine's device-resident
+    mirror + dirty-row scatter must bind bit-identically to the
+    full-upload arm across ticks with churn in between, while actually
+    moving fewer host->device bytes."""
+    import numpy as np
+    inc = IncrementalEncoder()
+    for i in range(50):
+        inc.on_node_add(mk_node(f"n-{i:03d}"))
+    delta_arm = BatchEngine()
+    full_arm = BatchEngine()
+    full_arm.delta_uploads = False
+    for tick in range(5):
+        pods = [mk_pod(f"p-{tick}-{j}", phase="Pending")
+                for j in range(20)]
+        enc = inc.encode_tile(pods, [], [])
+        a_delta, _ = delta_arm.run_chunked(enc, 32)
+        a_full, _ = full_arm.run_chunked(enc, 32)
+        assert np.array_equal(a_delta, a_full), tick
+        inc.assume_assigned(enc, pods, a_delta)
+        if tick == 1:  # condition flip mid-stream
+            inc.on_node_update(mk_node("n-003"),
+                               mk_node("n-003", ready=False))
+        if tick == 2:  # node arrival mid-stream
+            inc.on_node_add(mk_node("n-060"))
+    ds, fs = delta_arm.upload_stats, full_arm.upload_stats
+    assert ds["full_tiles"] <= 2, ds          # seed (+growth at most)
+    assert ds["delta_tiles"] + ds["reuse_tiles"] >= 3, ds
+    assert fs["full_tiles"] == 5, fs          # the control arm
+    assert ds["full_bytes"] + ds["delta_bytes"] \
+        < fs["full_bytes"] / 2, (ds, fs)
+
+
+def test_table_cache_misses_across_encoder_instances():
+    """Generations count one encoder's private timeline: a same-shaped
+    tile from a SECOND encoder must miss the device mirror, not read
+    its low generations as \"nothing changed\" against the first
+    encoder's rows (caught live by dryrun_multichip: tile-1 assumptions
+    from encoder A leaked into a fresh encoder B's unchained run)."""
+    import numpy as np
+
+    def fresh_encoder():
+        inc = IncrementalEncoder()
+        for i in range(16):
+            inc.on_node_add(mk_node(f"n-{i:03d}"))
+        return inc
+
+    engine = BatchEngine()
+    inc_a = fresh_encoder()
+    pods = [mk_pod(f"p-{j}", cpu=1000, phase="Pending") for j in range(8)]
+    enc_a = inc_a.encode_tile(pods, [], [])
+    a_first, _ = engine.run_chunked(enc_a, 8)
+    # bake tile 1 into encoder A's tables (and the engine's mirror on
+    # the next scatter) — encoder B below must not see any of it
+    inc_a.assume_assigned(enc_a, pods, a_first)
+    enc_a2 = inc_a.encode_tile(pods, [], [])
+    engine.run_chunked(enc_a2, 8)
+
+    inc_b = fresh_encoder()
+    enc_b = inc_b.encode_tile(pods, [], [])
+    a_b, _ = engine.run_chunked(enc_b, 8)
+    ref, _ = BatchEngine().run_chunked(enc_b, 8)
+    assert np.array_equal(np.asarray(a_b), np.asarray(ref)), \
+        "encoder B's tile ran against encoder A's device mirror"
+    assert engine.upload_stats["full_tiles"] >= 2, engine.upload_stats
+
+
 def test_delete_racing_ahead_of_assume_does_not_leak_ledger():
     """The 5k soak's leak: a pod bound, confirmed AND deleted before the
     committer's assume runs — the DELETED event pops nothing (no record

@@ -1,4 +1,4 @@
-"""orchlint acceptance: the five rule families flag their seeded bad
+"""orchlint acceptance: the six rule families flag their seeded bad
 fixtures and pass their good ones, the baseline allows exactly what it
 counts (and fails on drift), the CLI exits non-zero per family, the
 lock-witness catches order inversions and hold-time regressions — and
@@ -317,6 +317,132 @@ class TestJaxHygieneRule:
         assert not lint_source(src, "kubernetes_tpu/sched/batch.py")
 
 
+# ------------------------------------------------- rule family: shard-sync
+
+SHARD_BAD = [
+    ("asarray_on_dispatch_output_in_loop", """
+        import numpy as np
+        class Engine:
+            def drain(self, node, state, tiles):
+                run = self._get_run(True, True)
+                outs = []
+                for piece in tiles:
+                    state, assigned = run(node, state, piece)
+                    outs.append(np.asarray(assigned))
+                return outs
+        """, "host-pull-in-tile-loop"),
+    ("device_get_in_loop", """
+        import jax
+        def drain(tiles):
+            out = []
+            for t in tiles:
+                out.append(jax.device_get(t))
+            return out
+        """, "device-get-in-tile-loop"),
+    ("item_on_dispatch_output_in_loop", """
+        import jax
+        def drain(step, node, state, tiles):
+            run = jax.jit(step)
+            found = []
+            for piece in tiles:
+                state, assigned = run(node, state, piece)
+                found.append(assigned.item())
+            return found
+        """, "host-scalar-in-tile-loop"),
+    ("int_cast_via_alias_in_loop", """
+        import jax
+        def drain(step, node, state, tiles):
+            run = jax.jit(step)
+            total = 0
+            for piece in tiles:
+                state, out = run(node, state, piece)
+                head = out
+                total += int(head)
+            return total
+        """, "host-scalar-in-tile-loop"),
+    ("branch_on_per_shard_value", """
+        class Engine:
+            def drain(self, key, node, state, tiles):
+                run = self._runs.get(key)
+                for piece in tiles:
+                    state, assigned = run(node, state, piece)
+                    if assigned[0] < 0:
+                        break
+                return state
+        """, "branch-on-per-shard-value"),
+    ("while_on_per_shard_value", """
+        class Engine:
+            def pump(self, node, state, piece):
+                run = self._get_run(True, False)
+                state, assigned = run(node, state, piece)
+                while assigned[0] < 0:
+                    state, assigned = run(node, state, piece)
+                return state
+        """, "branch-on-per-shard-value"),
+]
+
+SHARD_GOOD = [
+    # the sanctioned shape: collect device refs, pull ONCE after the loop
+    ("pull_after_loop", """
+        import numpy as np
+        class Engine:
+            def drain(self, node, state, tiles):
+                run = self._get_run(True, True)
+                outs = []
+                for piece in tiles:
+                    state, assigned = run(node, state, piece)
+                    outs.append(assigned)
+                return np.concatenate([np.asarray(a) for a in outs])
+        """),
+    # np on HOST arrays in the loop is free — taint needs dispatch
+    # provenance, not just "came from a loop"
+    ("host_array_slicing_in_loop", """
+        import numpy as np
+        def drain(run, node, state, pods, chunk):
+            for lo in range(0, len(pods), chunk):
+                piece = np.asarray(pods[lo:lo + chunk])
+                state, assigned = run(node, state, piece)
+            return state
+        """),
+    ("device_get_outside_loop", """
+        import jax
+        def finish(dev_refs):
+            return jax.device_get(dev_refs)
+        """),
+    ("branch_on_host_metadata_in_loop", """
+        class Engine:
+            def drain(self, node, state, tiles):
+                run = self._get_run(True, True)
+                for piece in tiles:
+                    if piece.shape[0] == 0:
+                        continue
+                    state, assigned = run(node, state, piece)
+                return state
+        """),
+]
+
+SHARD_PATH = "kubernetes_tpu/sched/device/engine.py"
+
+
+@pytest.mark.lint
+class TestShardSyncRule:
+    @pytest.mark.parametrize("name,src,symbol", SHARD_BAD,
+                             ids=[r[0] for r in SHARD_BAD])
+    def test_bad_is_flagged(self, name, src, symbol):
+        assert symbol in symbols(src, ["shard-sync"], SHARD_PATH)
+
+    @pytest.mark.parametrize("name,src", SHARD_GOOD,
+                             ids=[r[0] for r in SHARD_GOOD])
+    def test_good_passes(self, name, src):
+        assert symbols(src, ["shard-sync"], SHARD_PATH) == []
+
+    def test_scoped_to_device_dir(self):
+        src = ("import jax\ndef f(ts):\n    for t in ts:\n"
+               "        x = jax.device_get(t)\n")
+        assert lint_source(src, "kubernetes_tpu/sched/device/engine.py")
+        assert not lint_source(src, "kubernetes_tpu/sched/batch.py")
+
+
 # -------------------------------------------- rule family: api-idempotency
 
 IDEMPOTENCY_BAD = [
@@ -610,6 +736,11 @@ FIXTURE_TREES = {
     "jax-hygiene": ("kubernetes_tpu/sched/device/bad.py",
                     "import jax\n@jax.jit\ndef f(x):\n"
                     "    return x.item()\n"),
+    "shard-sync": ("kubernetes_tpu/sched/device/bad_loop.py",
+                   "import jax\ndef drain(tiles):\n"
+                   "    out = []\n    for t in tiles:\n"
+                   "        out.append(jax.device_get(t))\n"
+                   "    return out\n"),
     "api-idempotency": ("kubernetes_tpu/api/bad.py",
                         "def ensure(client, rc):\n    while True:\n"
                         "        try:\n"

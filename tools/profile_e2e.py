@@ -105,6 +105,9 @@ def main():
     ap.add_argument("--backend", default=None,
                     help="pass 'native' to run the native kv store")
     ap.add_argument("--out", default=os.path.join(REPO, "PROFILE_e2e.md"))
+    ap.add_argument("--full-uploads", action="store_true",
+                    help="disable delta scatters: re-upload the full node "
+                         "tables every tile (control arm of the A/B)")
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -123,7 +126,8 @@ def main():
     s = Sampler(args.interval)
     s.start()
     r = run_scheduling_benchmark(args.nodes, args.pods, "batch",
-                                 registry=registry)
+                                 registry=registry,
+                                 delta_uploads=not args.full_uploads)
     s.stop_ev.set()
     s.join(timeout=2)
 
@@ -266,6 +270,44 @@ consecutive in-lock ticks per committer (~one lock-hold window,
                     f"{1000 * pctile(runs_g, 0.50):.1f}ms | "
                     f"{1000 * pctile(runs_g, 0.99):.1f}ms | "
                     f"{1000 * max(runs_g) * tick_s:.1f}ms |\n")
+        us = r.upload_stats or {}
+        n_full = us.get("full_tiles", 0)
+        n_delta = us.get("delta_tiles", 0)
+        n_reuse = us.get("reuse_tiles", 0)
+        n_tiles = max(1, n_full + n_delta + n_reuse)
+        # price of one full upload: measured if the window moved any,
+        # else the engine's table-size gauge (a steady delta-arm window
+        # moves none — that's the point)
+        per_full = (us.get("full_bytes", 0) / n_full if n_full
+                    else us.get("table_bytes", 0))
+        per_delta = (us.get("delta_bytes", 0) / n_delta
+                     if n_delta else 0.0)
+        per_pod = us.get("pod_bytes", 0) / n_tiles
+        arm = "full-upload (control)" if args.full_uploads else "delta-scatter"
+        f.write(f"""
+## Host->device transfer per tile ({arm} arm)
+
+Node-table bytes moved host->device per scheduling tile, from the
+engine's upload counters (measured window + warmup resets excluded).
+A *full* tile re-uploads both sharded tables; a *delta* tile scatters
+only rows whose dirty generation advanced; a *reuse* tile touches the
+device mirror not at all (chained tiles carrying State on device).
+The pod stream (P-sized pending-pod arrays) is uploaded every tile in
+both arms and is listed separately. Run with `--full-uploads` for the
+control arm.
+
+| metric | value |
+|---|---|
+| full-upload tiles | {n_full} |
+| delta-scatter tiles | {n_delta} |
+| mirror-reuse tiles | {n_reuse} |
+| bytes per full upload | {per_full:,.0f} |
+| bytes per delta tile | {per_delta:,.0f} |
+| pod-stream bytes per tile | {per_pod:,.0f} |
+| node-table bytes, total | {us.get("full_bytes", 0) + us.get("delta_bytes", 0):,} |
+| vs all-full tiles (est.) | {n_tiles * per_full:,.0f} |
+| node-table reduction | {(f"{n_tiles * per_full / (us['full_bytes'] + us['delta_bytes']):.1f}x" if us.get("full_bytes", 0) + us.get("delta_bytes", 0) else "every tile reused the mirror (0 bytes)")} |
+""")
         f.write(f"""
 ## Top leaf lines
 
@@ -286,6 +328,7 @@ consecutive in-lock ticks per committer (~one lock-hold window,
                       "overlap_ticks": overlap_ticks,
                       "device_ticks": device_ticks,
                       "ledger_ticks": ledger_ticks,
+                      "upload_stats": r.upload_stats,
                       "out": args.out}))
 
 

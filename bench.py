@@ -585,6 +585,38 @@ def main():
             print(f"# txn A/B chunked {tc.pods_per_sec:.0f} vs "
                   f"txn {r.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
+        # native arm (ISSUE 17): the same txn tile served end-to-end by
+        # the C++ engine — kv_commit_txn ledger window + publish ring
+        # draining on the engine's own thread — A/B'd against the
+        # native store with the ring off (events publish inline under
+        # the engine mutex, on the committer's thread). The delta IS
+        # the off-GIL publish. Skipped without a toolchain.
+        from kubernetes_tpu.core.native_store import (NativeStore,
+                                                      native_available)
+        if native_available():
+            from kubernetes_tpu.api.registry import Registry
+            nst = NativeStore(native_publish=True)
+            nr = run_scheduling_benchmark(args.nodes, args.pods, "batch",
+                                          registry=Registry(store=nst))
+            nstats = nst.publish_stats()
+            ctl = run_scheduling_benchmark(
+                args.nodes, args.pods, "batch",
+                registry=Registry(store=NativeStore(
+                    native_publish=False)))
+            pipeline["native_publish_pods_per_sec"] = round(
+                nr.pods_per_sec, 1)
+            pipeline["native_publish_elapsed_s"] = round(nr.elapsed_s, 2)
+            pipeline["native_inline_pods_per_sec"] = round(
+                ctl.pods_per_sec, 1)
+            pipeline["native_inline_elapsed_s"] = round(ctl.elapsed_s, 2)
+            pipeline["native_speedup"] = (
+                round(nr.pods_per_sec / ctl.pods_per_sec, 3)
+                if ctl.pods_per_sec else None)
+            pipeline["native_publish_stats"] = nstats
+            if args.verbose:
+                print(f"# native A/B inline {ctl.pods_per_sec:.0f} vs "
+                      f"ring {nr.pods_per_sec:.0f} pods/s",
+                      file=sys.stderr)
     obs_section = None
     if args.trace:
         # the causal-tracing arm (ISSUE 13): a traced pass decomposes

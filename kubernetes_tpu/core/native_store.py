@@ -31,11 +31,13 @@ import os
 import struct
 import subprocess
 import threading
+import time as _time
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
 from .scheme import Scheme, default_scheme
+from .wal import record_payload, txn_payload
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -47,6 +49,10 @@ ERR_EXISTS = -2
 ERR_CONFLICT = -3
 ERR_TOO_SMALL = -4
 ERR_EXPIRED = -5
+# kv_commit_txn only: the pre-assigned revision window raced another
+# writer — restage and retry. Distinct from ERR_CONFLICT so a genuine
+# CAS failure still surfaces as Conflict to the caller.
+ERR_RACED = -6
 # Buffer size hints come back as -(required + SIZE_HINT_BASE): a range
 # disjoint from the error codes so a tiny required size can't alias them
 # (kvstore.cc SIZE_HINT_BASE).
@@ -165,6 +171,45 @@ def _load_library() -> ctypes.CDLL:
             lib.has_txn_replay = True
         except AttributeError:
             lib.has_txn_replay = False
+        # Native commit path (kv_commit_txn + publish ring + WAL
+        # appender, ISSUE 17). Absent only in a stale prebuilt library
+        # — NativeStore then falls back to the kv_batch delegate and
+        # refuses wal_dir (the fallback README documents).
+        try:
+            lib.kv_commit_txn.restype = ctypes.c_int64
+            lib.kv_commit_txn.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.kv_publish_start.restype = ctypes.c_int64
+            lib.kv_publish_start.argtypes = [ctypes.c_void_p]
+            lib.kv_publish_flush.restype = ctypes.c_uint64
+            lib.kv_publish_flush.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_double]
+            lib.kv_shutdown.restype = None
+            lib.kv_shutdown.argtypes = [ctypes.c_void_p]
+            lib.kv_wal_attach.restype = ctypes.c_int64
+            lib.kv_wal_attach.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_uint64]
+            lib.kv_get_ex.restype = ctypes.c_int64
+            lib.kv_get_ex.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.POINTER(ctypes.c_double)]
+            lib.kv_stats.restype = None
+            lib.kv_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+            lib.has_commit_path = True
+        except AttributeError:
+            lib.has_commit_path = False
         _lib = lib
         return lib
 
@@ -182,12 +227,46 @@ class NativeStore:
 
     def __init__(self, window: int = 100_000,
                  scheme: Scheme = default_scheme,
-                 decode_cache_size: int = 200_000):
+                 decode_cache_size: int = 200_000,
+                 native_publish: bool = True,
+                 wal_dir: Optional[str] = None,
+                 fsync_policy: str = "batch",
+                 segment_records: int = 10_000):
         self._lib = _load_library()
         self._h = self._lib.kv_open(window)
         self.scheme = scheme
         self._watch_threads: List[threading.Thread] = []
+        self._watchers: List[Any] = []
         self._closed = False
+        # native commit path: ring publisher + pre-assigned-window
+        # commits (kv_commit_txn). native_publish=False is the control
+        # arm (mirrors Store(publish_inline=True) / Registry(
+        # txn_commit=False)): commit_txn falls back to the kv_batch
+        # delegate and events publish inline under the engine mutex.
+        # A stale prebuilt .so without the ABI degrades the same way.
+        self._native_publish = (native_publish
+                                and getattr(self._lib, "has_commit_path",
+                                            False))
+        self._wal_on = False
+        if self._native_publish:
+            self._lib.kv_publish_start(self._h)
+        if wal_dir is not None:
+            from .wal import FSYNC_POLICIES, WalError
+            if not self._native_publish:
+                raise WalError(
+                    "NativeStore(wal_dir=...) requires the native "
+                    "commit path (native_publish=True and a current "
+                    "libkvstore build): journaling routes every write "
+                    "through kv_commit_txn")
+            if fsync_policy not in FSYNC_POLICIES:
+                raise WalError(
+                    f"fsync_policy must be one of {FSYNC_POLICIES}, "
+                    f"got {fsync_policy!r}")
+            os.makedirs(wal_dir, exist_ok=True)
+            self._lib.kv_wal_attach(
+                self._h, wal_dir.encode(),
+                1 if fsync_policy == "always" else 0, segment_records)
+            self._wal_on = True
         # (key, rev) -> decoded object. Plays the watch cache's decoded-
         # object role in front of "etcd" (cacher.go): objects are frozen
         # by the store contract, so sharing decoded instances is safe —
@@ -200,11 +279,38 @@ class NativeStore:
 
     def __del__(self):
         try:
-            if not self._closed and self._h:
+            if self._h:
+                h, self._h = self._h, None
                 self._closed = True
-                self._lib.kv_close(self._h)
+                self._lib.kv_close(h)
         except Exception:
             pass
+
+    # -------------------------------------------------------- lifecycle
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop the store the way a process kill would look to its
+        clients: wake every watcher thread parked in kv_wait
+        (kv_shutdown drains the publish ring, seals the WAL and breaks
+        the native wait), stop the delivered watchers so consumers
+        blocked in next() return, and join the pump threads. The
+        engine handle stays alive until __del__ so a straggler pump
+        can never touch freed memory."""
+        if self._closed:
+            return
+        self._closed = True
+        if getattr(self._lib, "has_commit_path", False):
+            self._lib.kv_shutdown(self._h)
+        for w in self._watchers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+        for t in self._watch_threads:
+            t.join(timeout=timeout)
+
+    # the apiserver restart path calls stop() on whatever store it has
+    stop = close
 
     # --------------------------------------------------------- serde
 
@@ -243,6 +349,101 @@ class NativeStore:
     @property
     def current_revision(self) -> int:
         return int(self._lib.kv_current_rev(self._h))
+
+    # ------------------------------------------- native commit path
+
+    def _kv_commit(self, first_rev: int, staged: List[tuple],
+                   payloads: List[bytes]) -> int:
+        """One kv_commit_txn call. staged entries are
+        (type_code, key, val_bytes, expect_rev, expiry_abs); payloads
+        are unframed WAL payload bytes (the engine frames them)."""
+        n = len(staged)
+        types = (ctypes.c_uint8 * n)(*[s[0] for s in staged])
+        keys = (ctypes.c_char_p * n)(*[s[1].encode() for s in staged])
+        vals = (ctypes.c_char_p * n)(*[s[2] for s in staged])
+        val_lens = (ctypes.c_uint64 * n)(*[len(s[2]) for s in staged])
+        expects = (ctypes.c_uint64 * n)(*[s[3] for s in staged])
+        expiries = (ctypes.c_double * n)(
+            *[float(s[4] or 0.0) for s in staged])
+        nf = len(payloads)
+        frames = (ctypes.c_char_p * nf)(*payloads) if nf else None
+        frame_lens = ((ctypes.c_uint64 * nf)(*[len(p) for p in payloads])
+                      if nf else None)
+        return int(self._lib.kv_commit_txn(
+            self._h, n, first_rev, types, keys, vals, val_lens,
+            expects, expiries, nf, frames, frame_lens))
+
+    def _txn_commit_native(self, ops, flat: bool) -> List[Any]:
+        """Shared staging loop for commit_txn (one TXN frame) and the
+        journaled batch() (flat frames): pre-assign the revision
+        window, run the update fns against it, stamp + encode once,
+        build the WAL payload(s) through core/wal.py's codec, and
+        commit through kv_commit_txn — ledger mutation, WAL framing
+        and the publish handoff all native. ERR_RACED (another writer
+        claimed the window) and ERR_CONFLICT (a staged key moved)
+        restage the whole tile, mirroring batch()'s retry contract."""
+        if not ops:
+            return []
+        modified = watchpkg.MODIFIED
+        for _ in range(10):
+            first = self.current_revision + 1
+            rev = first - 1
+            staged: List[tuple] = []
+            records: List[list] = []
+            outs: List[Tuple[str, Any]] = []
+            for key, fn in ops:
+                raw, mod_rev, expiry = self._get_raw_ex(key)
+                rev += 1
+                cur = self._decode(raw, mod_rev, key)
+                if getattr(fn, "wants_rv", False):
+                    new_obj = fn(cur, str(rev))
+                else:
+                    new_obj = self._stamp(fn(cur), rev)
+                wire = self.scheme.encode_dict(new_obj)
+                val = _json.dumps(wire).encode()
+                staged.append((1, key, val, mod_rev, expiry))
+                if self._wal_on:
+                    records.append([rev, modified, key,
+                                    expiry if expiry else None, wire])
+                outs.append((key, new_obj))
+            if self._wal_on:
+                payloads = ([record_payload(*r) for r in records]
+                            if flat else [txn_payload(records)])
+            else:
+                payloads = []
+            r = self._kv_commit(first, staged, payloads)
+            if r in (ERR_RACED, ERR_CONFLICT, ERR_NOT_FOUND):
+                # raced (window claimed / key moved / key vanished):
+                # restage — a vanished key raises NotFound with its
+                # precise name from the next _get_raw_ex probe
+                continue
+            out = []
+            for i, (key, obj) in enumerate(outs):
+                self._cache_put(key, first + i, obj)
+                out.append(obj)
+            return out
+        raise Conflict("commit_txn: too many retries")
+
+    def publish_stats(self) -> dict:
+        """Engine-side ledger/publish counters (kv_stats): the native
+        commit-path split the Python sampler cannot observe."""
+        if not getattr(self._lib, "has_commit_path", False):
+            return {}
+        out = (ctypes.c_uint64 * 8)()
+        self._lib.kv_stats(self._h, out)
+        return {"commits": int(out[0]), "ledger_ns": int(out[1]),
+                "published_batches": int(out[2]),
+                "publish_ns": int(out[3]), "wal_frames": int(out[4]),
+                "wal_bytes": int(out[5]), "revision": int(out[6]),
+                "published_rev": int(out[7])}
+
+    def publish_flush(self, timeout: float = 5.0) -> int:
+        """Block until the native publisher has drained the ring (the
+        committer's drain barrier: 'drained' must keep meaning
+        'visible to watchers'). Returns the watch-visible revision."""
+        if not getattr(self._lib, "has_commit_path", False):
+            return self.current_revision
+        return int(self._lib.kv_publish_flush(self._h, float(timeout)))
 
     # ----------------------------------------------------- durability
 
@@ -333,6 +534,25 @@ class NativeStore:
         return st
 
     def create(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        if self._wal_on:
+            for _ in range(16):
+                rev = self.current_revision + 1
+                expiry = (_time.time() + ttl) if ttl else None
+                stamped = self._stamp(obj, rev)
+                wire = self.scheme.encode_dict(stamped)
+                val = _json.dumps(wire).encode()
+                r = self._kv_commit(
+                    rev, [(0, key, val, 0, expiry)],
+                    [record_payload(rev, watchpkg.ADDED, key, expiry,
+                                    wire)])
+                if r == ERR_RACED:
+                    continue
+                if r == ERR_EXISTS:
+                    kind, name = self._key_name(key)
+                    raise AlreadyExists(kind=kind, name=name)
+                self._cache_put(key, rev, stamped)
+                return stamped
+            raise Conflict(f"create {key}: revision window kept racing")
         raw = self._encode(obj)
         rev = self._lib.kv_create(self._h, key.encode(), raw, len(raw),
                                   float(ttl or 0))
@@ -354,6 +574,8 @@ class NativeStore:
         place instead of a replace-clone pair per object."""
         if not entries:
             return []
+        if self._wal_on:
+            return self._create_batch_walled(entries, owned_meta)
         encoded = [(k, self._encode(o), ttl) for k, o, ttl in entries]
         n = len(encoded)
         keys = (ctypes.c_char_p * n)(*[k.encode() for k, _v, _t in encoded])
@@ -386,7 +608,73 @@ class NativeStore:
             out.append(stamped)
         return out
 
+    def _create_batch_walled(self, entries, owned_meta: bool) -> List[Any]:
+        """create_batch through the native commit path: one
+        kv_commit_txn window, n flat ADDED records journaled — the
+        same per-record framing Store.create_batch writes."""
+        for _ in range(10):
+            first = self.current_revision + 1
+            now = _time.time()
+            staged: List[tuple] = []
+            payloads: List[bytes] = []
+            outs: List[Tuple[str, Any]] = []
+            for i, (key, obj, ttl) in enumerate(entries):
+                rev = first + i
+                expiry = (now + ttl) if ttl else None
+                if owned_meta:
+                    obj.metadata.resource_version = str(rev)
+                    stamped = obj
+                else:
+                    stamped = self._stamp(obj, rev)
+                wire = self.scheme.encode_dict(stamped)
+                val = _json.dumps(wire).encode()
+                staged.append((0, key, val, 0, expiry))
+                payloads.append(record_payload(rev, watchpkg.ADDED, key,
+                                               expiry, wire))
+                outs.append((key, stamped))
+            r = self._kv_commit(first, staged, payloads)
+            if r == ERR_RACED:
+                continue
+            if r == ERR_EXISTS:
+                for key, _obj, _ttl in entries:
+                    try:
+                        self._get_raw(key)
+                    except NotFound:
+                        continue
+                    kind, name = self._key_name(key)
+                    raise AlreadyExists(kind=kind, name=name)
+                kind, name = self._key_name(entries[0][0])
+                raise AlreadyExists(kind=kind, name=name)
+            out = []
+            for i, (key, obj) in enumerate(outs):
+                self._cache_put(key, first + i, obj)
+                out.append(obj)
+            return out
+        raise Conflict("create_batch: revision window kept racing")
+
     def set(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        if self._wal_on:
+            for _ in range(16):
+                rev = self.current_revision + 1
+                try:
+                    self._get_raw_ex(key)
+                    existed = True
+                except NotFound:
+                    existed = False
+                expiry = (_time.time() + ttl) if ttl else None
+                stamped = self._stamp(obj, rev)
+                wire = self.scheme.encode_dict(stamped)
+                val = _json.dumps(wire).encode()
+                etype = (watchpkg.MODIFIED if existed
+                         else watchpkg.ADDED)
+                r = self._kv_commit(
+                    rev, [(1 if existed else 0, key, val, 0, expiry)],
+                    [record_payload(rev, etype, key, expiry, wire)])
+                if r in (ERR_RACED, ERR_EXISTS, ERR_NOT_FOUND):
+                    continue  # raced, or existence flipped: re-probe
+                self._cache_put(key, rev, stamped)
+                return stamped
+            raise Conflict(f"set {key}: revision window kept racing")
         raw = self._encode(obj)
         rev = self._lib.kv_set(self._h, key.encode(), raw, len(raw),
                                float(ttl or 0))
@@ -395,9 +683,34 @@ class NativeStore:
         return out
 
     def update(self, key: str, obj: Any) -> Any:
-        raw = self._encode(obj)
         rv = obj.metadata.resource_version
         expect = int(rv) if rv else 0
+        if self._wal_on:
+            for _ in range(16):
+                _old, mod_rev, expiry = self._get_raw_ex(key)
+                rev = self.current_revision + 1
+                stamped = self._stamp(obj, rev)
+                wire = self.scheme.encode_dict(stamped)
+                val = _json.dumps(wire).encode()
+                r = self._kv_commit(
+                    rev,
+                    # TTL carries over, like kv_update / Store.update
+                    [(1, key, val, expect or mod_rev, expiry)],
+                    [record_payload(rev, watchpkg.MODIFIED, key,
+                                    expiry if expiry else None, wire)])
+                if r == ERR_RACED:
+                    continue
+                if r == ERR_CONFLICT:
+                    if expect:
+                        raise Conflict(f"operation on {key} failed: "
+                                       f"object was modified")
+                    continue  # raced an unconditional update: re-read
+                if r == ERR_NOT_FOUND:
+                    raise NotFound(name=key)
+                self._cache_put(key, rev, stamped)
+                return stamped
+            raise Conflict(f"update {key}: revision window kept racing")
+        raw = self._encode(obj)
         rev = self._lib.kv_update(self._h, key.encode(), raw, len(raw),
                                   expect)
         if rev == ERR_NOT_FOUND:
@@ -411,6 +724,8 @@ class NativeStore:
 
     def guaranteed_update(self, key: str, fn: Callable[[Any], Any],
                           retries: int = 10) -> Any:
+        if self._wal_on:
+            return self._txn_commit_native([(key, fn)], flat=True)[0]
         for _ in range(retries):
             raw, mod_rev = self._get_raw(key)
             new_obj = fn(self._decode(raw, mod_rev, key))
@@ -431,6 +746,25 @@ class NativeStore:
             raw, mod_rev = self._get_raw(key)
             if expect_rv and int(expect_rv) != mod_rev:
                 raise Conflict(f"delete {key}: revision mismatch")
+            if self._wal_on:
+                rev = self.current_revision + 1
+                wire = _json.loads(raw)
+                r = self._kv_commit(
+                    rev,
+                    [(2, key, raw,
+                      mod_rev if not expect_rv else int(expect_rv),
+                      0.0)],
+                    [record_payload(rev, watchpkg.DELETED, key, None,
+                                    wire)])
+                if r == ERR_RACED:
+                    continue
+                if r == ERR_NOT_FOUND:
+                    raise NotFound(name=key)
+                if r == ERR_CONFLICT:
+                    if expect_rv:
+                        raise Conflict(f"delete {key}: revision mismatch")
+                    continue  # raced an unconditional delete: re-read
+                return self._decode(raw, mod_rev, key)
             rev = self._lib.kv_delete(self._h, key.encode(),
                                       mod_rev if not expect_rv
                                       else int(expect_rv))
@@ -443,6 +777,11 @@ class NativeStore:
     def batch(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
               ) -> List[Any]:
         ops = list(ops)
+        if self._wal_on:
+            # journaled stores route the chunked control arm through
+            # the commit path too (flat frames, exactly the per-record
+            # framing Store.batch journals) — kv_batch has no WAL hook
+            return self._txn_commit_native(ops, flat=True)
         for _ in range(10):
             staged: List[Tuple[str, Any, bytes, int]] = []
             for key, fn in ops:
@@ -473,13 +812,20 @@ class NativeStore:
 
     def commit_txn(self, ops: Iterable[Tuple[str, Callable[[Any], Any]]]
                    ) -> List[Any]:
-        """Multi-key transaction: kv_batch already commits the whole op
-        list as ONE mutex window with consecutive revisions
-        (all-or-nothing CAS), so the engine-side txn verb IS batch.
-        WAL framing parity with Store.commit_txn lives in recover():
-        read_wal expands TXN frames to flat records and kv_replay_txn
-        replays each frame's window in one engine call."""
-        return self.batch(ops)
+        """Multi-key transaction through the native commit path
+        (kv_commit_txn): Python pre-assigns the revision window and
+        stages the encoded batch; the engine validates the window,
+        applies the whole op list under one mutex window with
+        consecutive revisions (all-or-nothing CAS), appends the WAL
+        TXN frame when journaling, and hands the ordered event batch
+        to the native publisher ring — ledger + publish off the GIL.
+
+        native_publish=False (or a stale prebuilt library) is the
+        control arm: kv_batch delegate, inline publish under the
+        engine mutex — the same events, on the caller's thread."""
+        if not self._native_publish:
+            return self.batch(ops)
+        return self._txn_commit_native(list(ops), flat=False)
 
     # --------------------------------------------------------- reads
 
@@ -496,6 +842,29 @@ class NativeStore:
                 size *= 4
                 continue
             return buf.raw[:n], int(mod_rev.value)
+
+    def _get_raw_ex(self, key: str, initial: int = 1 << 16
+                    ) -> Tuple[bytes, int, float]:
+        """_get_raw plus the entry's absolute expiry (kv_get_ex), so
+        the commit path can carry TTLs over exactly like Store.update.
+        Stale prebuilt library: degrade to (raw, mod_rev, 0.0)."""
+        if not getattr(self._lib, "has_commit_path", False):
+            raw, mod_rev = self._get_raw(key, initial)
+            return raw, mod_rev, 0.0
+        size = initial
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            mod_rev = ctypes.c_uint64()
+            expiry = ctypes.c_double()
+            n = self._lib.kv_get_ex(self._h, key.encode(), buf, size,
+                                    ctypes.byref(mod_rev),
+                                    ctypes.byref(expiry))
+            if n == ERR_NOT_FOUND:
+                raise NotFound(name=key)
+            if n == ERR_TOO_SMALL:
+                size *= 4
+                continue
+            return buf.raw[:n], int(mod_rev.value), float(expiry.value)
 
     def get(self, key: str) -> Any:
         raw, mod_rev = self._get_raw(key)
@@ -660,4 +1029,5 @@ class NativeStore:
                              name="native-store-watch")
         t.start()
         self._watch_threads.append(t)
+        self._watchers.append(w)
         return w

@@ -103,20 +103,39 @@ def _snapshots(dirpath: str) -> List[Tuple[int, str]]:
     return out
 
 
+def record_payload(rev: int, etype: str, key: str,
+                   expiry: Optional[float], obj_wire: Any) -> bytes:
+    """Unframed payload bytes of one flat record. The payload/frame
+    split is the parity contract with the native appender
+    (kvstore.cc kv_commit_txn): Python builds the payload, whichever
+    side owns the file adds the <u32 len><u32 crc32> frame — so both
+    writers produce byte-identical segments from the same records."""
+    return json.dumps([rev, etype, key, expiry, obj_wire],
+                      separators=(",", ":")).encode()
+
+
+def txn_payload(records: List[list]) -> bytes:
+    """Unframed payload of a whole multi-key transaction (see TXN)."""
+    return json.dumps([records[0][0], TXN, records],
+                      separators=(",", ":")).encode()
+
+
+def frame(payload: bytes) -> bytes:
+    """<u32 len><u32 crc32(payload)> + payload — the one on-disk frame
+    shape; kvstore.cc reimplements exactly this (same CRC-32/IEEE)."""
+    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
 def encode_record(rev: int, etype: str, key: str,
                   expiry: Optional[float], obj_wire: Any) -> bytes:
-    payload = json.dumps([rev, etype, key, expiry, obj_wire],
-                         separators=(",", ":")).encode()
-    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+    return frame(record_payload(rev, etype, key, expiry, obj_wire))
 
 
 def encode_txn(records: List[list]) -> bytes:
     """One frame for a whole multi-key transaction (see TXN above).
     `records` are ordinary [rev, etype, key, expiry, obj_wire] lists
     with consecutive revisions; the first one names the frame."""
-    payload = json.dumps([records[0][0], TXN, records],
-                         separators=(",", ":")).encode()
-    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+    return frame(txn_payload(records))
 
 
 def _read_segment(path: str, last: bool) -> Tuple[List[list], bool]:

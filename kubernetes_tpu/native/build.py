@@ -2,15 +2,19 @@
 
 One implementation of the build-and-cache-next-to-source pattern so the
 error-handling contract cannot drift between call sites: stale outputs
-rebuild (source newer than artifact), concurrent builders compile to
-per-process temp names and install atomically, any failure — missing
-toolchain, unwritable directory, compile error — degrades to None (the
-caller picks its fallback), and a prebuilt artifact with no shipped
-source is used as-is.
+rebuild (source CONTENT changed since the artifact was built — tracked
+through a hash sidecar, because mtime comparison silently serves a
+stale artifact when an edit lands within the same second as the last
+build), concurrent builders compile to per-process temp names and
+install atomically, any failure — missing toolchain, unwritable
+directory, compile error — degrades to None (the caller picks its
+fallback), and a prebuilt artifact with no shipped source is used
+as-is.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -20,6 +24,35 @@ from typing import List, Optional, Sequence
 _lock = threading.Lock()
 
 
+def _sidecar(out: str) -> str:
+    return out + ".src.sha256"
+
+
+def _src_digest(src: str) -> Optional[str]:
+    try:
+        with open(src, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _fresh(src: str, out: str, digest: Optional[str]) -> bool:
+    """Is the cached artifact current for this source? Content hash
+    against the sidecar when possible; when hashing fails (unreadable
+    source) fall back to STRICT mtime `<` — equal timestamps rebuild,
+    the direction that can only waste a compile, never serve stale."""
+    if digest is not None:
+        try:
+            with open(_sidecar(out)) as f:
+                return f.read().strip() == digest
+        except OSError:
+            return False  # no sidecar: unknown provenance, rebuild
+    try:
+        return os.path.getmtime(src) < os.path.getmtime(out)
+    except OSError:
+        return False
+
+
 def build_native(src: str, out: str,
                  flag_sets: Sequence[List[str]]) -> Optional[str]:
     """-> `out` when a usable artifact exists (built now or before),
@@ -27,8 +60,10 @@ def build_native(src: str, out: str,
     with _lock:
         have = os.path.exists(out)
         try:
-            if have and (not os.path.exists(src)
-                         or os.path.getmtime(src) <= os.path.getmtime(out)):
+            if have and not os.path.exists(src):
+                return out  # prebuilt artifact, no shipped source
+            digest = _src_digest(src) if os.path.exists(src) else None
+            if have and os.path.exists(src) and _fresh(src, out, digest):
                 return out
             if not os.path.exists(src):
                 return None
@@ -44,6 +79,12 @@ def build_native(src: str, out: str,
                     subprocess.run([*flags, src, "-o", tmp],
                                    check=True, capture_output=True)
                     os.replace(tmp, out)
+                    if digest is not None:
+                        try:
+                            with open(_sidecar(out), "w") as f:
+                                f.write(digest + "\n")
+                        except OSError:
+                            pass  # sidecar is advisory; mtime fallback
                     return out
                 except (OSError, subprocess.CalledProcessError):
                     continue

@@ -293,6 +293,21 @@ class BatchScheduler:
             if item is None:
                 return
             if isinstance(item, threading.Event):
+                # drain barrier: every commit before it has RETURNED —
+                # but under NativeStore's publish ring "committed" only
+                # means enqueued, so flush the native publisher before
+                # firing: drained must keep meaning visible to watchers
+                # (in-proc client only; over HTTP there is no handle,
+                # and no in-proc snapshot to go stale either)
+                store = getattr(getattr(getattr(
+                    self.config.factory, "client", None),
+                    "registry", None), "store", None)
+                flush = getattr(store, "publish_flush", None)
+                if flush is not None:
+                    try:
+                        flush(timeout=5.0)
+                    except Exception:
+                        pass  # barrier still fires; epoch guard covers
                 item.set()  # drain barrier: everything before it landed
                 continue
             if self._killed:

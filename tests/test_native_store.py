@@ -2,6 +2,8 @@
 windowed watch) plus a registry smoke test proving it's a drop-in backend
 (ref: the external-etcd role, pkg/storage/etcd)."""
 
+import os
+import sys
 import threading
 import time
 
@@ -243,3 +245,72 @@ def test_native_create_batch_atomic():
             break
     assert {f"cb-{i}" for i in range(4)} <= seen
     w.stop()
+
+
+class TestBuildStaleness:
+    """native/build.py rebuild contract (ISSUE 17 satellite): an edit
+    to the source must rebuild even when it lands within the same
+    mtime tick as the previous build — content hash, not timestamps,
+    decides freshness."""
+
+    def _fake_compiler(self, tmp_path):
+        """A 'compiler' that copies src to the -o target and logs each
+        invocation, so the test can count real rebuilds."""
+        log = tmp_path / "compiles.log"
+        script = (
+            "import sys, shutil\n"
+            "src, out = sys.argv[1], sys.argv[3]\n"
+            f"open({str(log)!r}, 'a').write(src + '\\n')\n"
+            "shutil.copyfile(src, out)\n")
+        return [sys.executable, "-c", script], log
+
+    def test_rebuild_on_same_second_edit(self, tmp_path):
+        from kubernetes_tpu.native.build import build_native
+        flags, log = self._fake_compiler(tmp_path)
+        src = tmp_path / "x.cc"
+        out = tmp_path / "x.so"
+        src.write_text("v1")
+        assert build_native(str(src), str(out), [flags]) == str(out)
+        assert out.read_text() == "v1"
+        # the regression: edit + pin BOTH mtimes to the same second —
+        # the old `<=` check would have served the stale artifact
+        src.write_text("v2")
+        now = os.path.getmtime(out)
+        os.utime(src, (now, now))
+        os.utime(out, (now, now))
+        assert build_native(str(src), str(out), [flags]) == str(out)
+        assert out.read_text() == "v2"
+        assert len(log.read_text().splitlines()) == 2
+
+    def test_unchanged_source_does_not_recompile(self, tmp_path):
+        from kubernetes_tpu.native.build import build_native
+        flags, log = self._fake_compiler(tmp_path)
+        src = tmp_path / "x.cc"
+        out = tmp_path / "x.so"
+        src.write_text("v1")
+        build_native(str(src), str(out), [flags])
+        # touch the source NEWER than the artifact: under the old
+        # mtime rule this would rebuild; the hash says it's current
+        os.utime(src, None)
+        build_native(str(src), str(out), [flags])
+        assert len(log.read_text().splitlines()) == 1
+
+    def test_missing_sidecar_rebuilds(self, tmp_path):
+        from kubernetes_tpu.native.build import build_native
+        flags, log = self._fake_compiler(tmp_path)
+        src = tmp_path / "x.cc"
+        out = tmp_path / "x.so"
+        src.write_text("v1")
+        build_native(str(src), str(out), [flags])
+        os.unlink(str(out) + ".src.sha256")  # unknown provenance
+        build_native(str(src), str(out), [flags])
+        assert len(log.read_text().splitlines()) == 2
+
+    def test_prebuilt_without_source_used_as_is(self, tmp_path):
+        from kubernetes_tpu.native.build import build_native
+        flags, _log = self._fake_compiler(tmp_path)
+        out = tmp_path / "x.so"
+        out.write_text("prebuilt")
+        assert build_native(str(tmp_path / "gone.cc"), str(out),
+                            [flags]) == str(out)
+        assert out.read_text() == "prebuilt"

@@ -43,7 +43,17 @@ STORE_PUBLISH_FRAMES = {"store.py:_drain_publish", "store.py:_fanout",
 STORE_COMMIT_FRAMES = {"store.py:create", "store.py:create_batch",
                        "store.py:set", "store.py:update",
                        "store.py:guaranteed_update", "store.py:delete",
-                       "store.py:batch", "store.py:commit_txn"}
+                       "store.py:batch", "store.py:commit_txn",
+                       # native commit path: these frames are the
+                       # Python-side STAGING half (decode/apply/encode
+                       # + the kv_commit_txn call, which releases the
+                       # GIL for the mutex window). The publish half
+                       # runs on the engine's own publisher thread —
+                       # invisible to sys._current_frames by design;
+                       # its cost comes from kv_stats (section below).
+                       "native_store.py:_txn_commit_native",
+                       "native_store.py:_kv_commit",
+                       "native_store.py:_create_batch_walled"}
 
 # Device-execution frames: a tick with one thread inside these AND
 # another thread inside a ledger commit is the async bind pipeline
@@ -118,10 +128,12 @@ def main():
 
     from kubernetes_tpu.kubemark.benchmark import run_scheduling_benchmark
     registry = None
+    native_store = None
     if args.backend == "native":
         from kubernetes_tpu.api.registry import Registry
         from kubernetes_tpu.core.native_store import NativeStore
-        registry = Registry(store=NativeStore())
+        native_store = NativeStore()
+        registry = Registry(store=native_store)
 
     s = Sampler(args.interval)
     s.start()
@@ -130,6 +142,11 @@ def main():
                                  delta_uploads=not args.full_uploads)
     s.stop_ev.set()
     s.join(timeout=2)
+    # engine-side split for the native commit path: the publisher runs
+    # off the GIL, so the sampler cannot see it — the engine's own
+    # nanosecond counters (kv_stats) are the only instrument
+    nstats = (native_store.publish_stats() if native_store is not None
+              else {})
 
     t0, t1 = r.started_at, r.started_at + r.elapsed_s
     window = [(ts, snap) for ts, snap in s.ticks if t0 <= ts <= t1]
@@ -239,6 +256,34 @@ in-lock share is what the three committers still serialize on.
             tot = led + pub
             f.write(f"| {g} | {led} | {pub} | "
                     f"{100 * led / max(1, tot):.0f}% |\n")
+        if nstats:
+            commits = max(1, nstats.get("commits", 0))
+            batches = max(1, nstats.get("published_batches", 0))
+            led_ms = nstats.get("ledger_ns", 0) / 1e6
+            pub_ms = nstats.get("publish_ns", 0) / 1e6
+            f.write(f"""
+## Native commit path: engine-side ledger vs publish (kv_stats)
+
+The sampler above only sees the Python STAGING half of a native
+commit — the engine mutex window and the publisher thread run with
+the GIL released, so their cost comes from the engine's own
+monotonic-clock counters. `ledger` is kv_commit_txn's in-mutex window
+(validate + apply + WAL frame); `publish` is the ring drain on the
+native publisher thread — the half that used to hold the Python
+ledger lock and now runs concurrently with the next tile's staging.
+
+| counter | value |
+|---|---|
+| commits (kv_commit_txn calls) | {nstats.get("commits", 0)} |
+| ledger time, total | {led_ms:.1f}ms |
+| ledger time per commit | {1000 * led_ms / commits:.1f}us |
+| published batches | {nstats.get("published_batches", 0)} |
+| publish time, total | {pub_ms:.1f}ms |
+| publish time per batch | {1000 * pub_ms / batches:.1f}us |
+| publish / (ledger+publish) | {100 * pub_ms / max(1e-9, led_ms + pub_ms):.0f}% |
+| WAL frames / bytes | {nstats.get("wal_frames", 0)} / {nstats.get("wal_bytes", 0):,} |
+| revision / published_rev | {nstats.get("revision", 0)} / {nstats.get("published_rev", 0)} |
+""")
         tick_s = r.elapsed_s / max(1, n_ticks)
 
         def pctile(xs, p):
@@ -329,6 +374,7 @@ control arm.
                       "device_ticks": device_ticks,
                       "ledger_ticks": ledger_ticks,
                       "upload_stats": r.upload_stats,
+                      "native_publish_stats": nstats or None,
                       "out": args.out}))
 
 

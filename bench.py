@@ -54,7 +54,12 @@ ladder: engine-only passes on 1/2/4/../N virtual-device meshes with
 the node axis sharded, per-rung pods/s + per-chip scaling efficiency
 + the mesh-vs-single-device bit-equality gate, and with
 --density-ladder the 20k-node / 150k-pod density tier written to
-DENSITY_20K.json), null unless requested.
+DENSITY_20K.json), null unless requested; r13 adds serving (the
+--watch-fanout arm: the N-worker apiserver fan-out storm —
+create-storm throughput, per-worker delivery lag p50/p99, the
+watch-deliver burn-rate SLO verdict, and the 1-vs-N scaling readout
+with its 1-core overlap-witness caveat — with the SLO timeline also
+written to SLO_10KWATCH.json), null unless requested.
 """
 
 import argparse
@@ -473,6 +478,18 @@ def main():
                          "scrape-overhead control); records the "
                          "metricsplane section — feed the artifact to "
                          "tools/obs_report.py")
+    ap.add_argument("--watch-fanout", type=int, default=None,
+                    help="run the serving-plane fan-out soak: this "
+                         "many concurrent watchers sharded across "
+                         "--fanout-workers apiserver workers over one "
+                         "shared store, under a pod create-storm "
+                         "(kubemark/fanout_soak.py); records the "
+                         "serving section and writes the watch-deliver "
+                         "SLO timeline to SLO_10KWATCH.json")
+    ap.add_argument("--fanout-workers", type=int, default=4,
+                    help="worker count for the --watch-fanout arm "
+                         "(a 1-worker baseline arm of the same storm "
+                         "runs first for the scaling readout)")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="run the multichip scaling ladder: engine-only "
                          "passes on 1/2/4/../N virtual-device meshes "
@@ -861,6 +878,43 @@ def main():
                   f"scraped {scraped.pods_per_sec:.0f} vs "
                   f"{base.pods_per_sec:.0f} pods/s",
                   file=sys.stderr)
+    serving = None
+    if args.watch_fanout:
+        # the serving-plane arm (ISSUE 18): the fan-out storm against
+        # the N-worker pool — the recorded numbers are the delivery
+        # story (create-storm throughput, per-worker lag percentiles,
+        # the watch-deliver burn-rate verdict) plus the 1-vs-N scaling
+        # readout; on a 1-core box the wall-clock ratio can't show
+        # scaling, so the multi-consumer overlap witness gates and the
+        # caveat rides the artifact instead of a flattering number
+        from kubernetes_tpu.kubemark.fanout_soak import run_fanout_soak
+        fr = run_fanout_soak(n_watchers=args.watch_fanout,
+                             workers=args.fanout_workers)
+        serving = fr.as_dict()
+        from kubernetes_tpu.kubemark.tpu_evidence import _atomic_write_json
+        here = os.path.dirname(os.path.abspath(__file__))
+        _atomic_write_json(
+            os.path.join(here, "SLO_10KWATCH.json"),
+            {"metric": "watch_fanout_slo",
+             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+             "n_watchers": fr.n_watchers, "workers": fr.workers,
+             "slo": "watch-deliver-250ms",
+             "watch_slo_ok": fr.arm.watch_slo_ok,
+             "lag_p50_ms": fr.arm.lag_p50_ms,
+             "lag_p99_ms": fr.arm.lag_p99_ms,
+             "alerts": fr.arm.alerts,
+             "per_worker": fr.arm.per_worker,
+             "overlap": fr.arm.overlap,
+             "scaling": {"ratio": fr.scaling_ratio,
+                         "gate": fr.scaling_gate,
+                         "ok": fr.scaling_ok,
+                         "caveat": fr.caveat}})
+        if args.verbose:
+            print(f"# serving[{fr.n_watchers} watchers x "
+                  f"{fr.workers} workers] ok={fr.ok} "
+                  f"p99={fr.arm.lag_p99_ms}ms "
+                  f"scaling={fr.scaling_ratio}x via {fr.scaling_gate}",
+                  file=sys.stderr)
     engine_rate, engine_bound = engine_only(args.nodes, args.pods)
     multichip = None
     if args.mesh_devices:
@@ -982,6 +1036,7 @@ def main():
         "durability": durability,
         "workload": workload,
         "metricsplane": metricsplane,
+        "serving": serving,
         "multichip": multichip,
         "multihost": multihost,
         "lint": lint_section,

@@ -1301,7 +1301,7 @@ class Registry:
 
     def watch(self, resource: str, namespace: str = "",
               since_rev: Optional[int] = None, label_selector: str = "",
-              field_selector: str = "") -> Watcher:
+              field_selector: str = "", shard: Any = None) -> Watcher:
         if resource == "componentstatuses":
             # computed per request, not stored: a watch would hang
             # forever with zero events (the reference rejects it too)
@@ -1353,6 +1353,13 @@ class Registry:
                 return True
         if not self.info(resource).namespaced:
             namespace = ""  # cluster-scoped (same rule as list)
+        if shard is not None:
+            # worker fan-out shard routing (Fleet serving): the watcher
+            # joins the serving worker's partition and is delivered by
+            # that worker's pump. Passed through only when set, so any
+            # duck-typed store without shard support keeps working.
+            return self.store.watch(self.prefix(resource, namespace),
+                                    since_rev, predicate=pred, shard=shard)
         return self.store.watch(self.prefix(resource, namespace), since_rev,
                                 predicate=pred)
 

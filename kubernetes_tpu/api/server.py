@@ -39,7 +39,8 @@ from ..core.errors import (ApiError, BadGateway, BadRequest, Forbidden,
                            TooManyRequests, Unauthorized)
 from ..core import types as api_types
 from ..core.scheme import Scheme, default_scheme
-from ..utils.metrics import MetricsRegistry, global_metrics
+from ..utils.metrics import (APISERVER_WORKER_REQUESTS, MetricsRegistry,
+                             global_metrics)
 from .registry import RESOURCES, Registry
 
 WATCH_HEARTBEAT_SECONDS = 30.0
@@ -94,7 +95,8 @@ class ApiServer:
                  tls_cert_file: str = "", tls_key_file: str = "",
                  tls_client_ca_file: str = "",
                  runtime_config: Optional[dict] = None,
-                 shed_retry_after: float = 1.0):
+                 shed_retry_after: float = 1.0,
+                 worker_index: int = 0, fanout_shard=None):
         """tls_cert_file/tls_key_file: serve HTTPS (the reference's
         --tls-cert-file/--tls-private-key-file secure port).
         tls_client_ca_file: additionally request client certificates
@@ -110,8 +112,18 @@ class ApiServer:
         resource; `api/all=false` turns every version off except those
         explicitly re-enabled. Disabled surfaces 404 and vanish from
         discovery. `api/legacy` is accepted (no pre-v1 wire versions
-        exist here to govern)."""
+        exist here to govern).
+
+        worker_index/fanout_shard: Fleet serving (ApiServerPool). The
+        shard is this worker's delivery partition over the shared
+        store's publish ring — watches served by this worker register
+        on it and are pumped by its drain thread, so delivery work is
+        split across workers instead of queuing behind one publisher.
+        fanout_shard=None keeps the classic single-plane behavior
+        (watches ride the store's committer-drained default shard)."""
         self.registry = registry
+        self.worker_index = worker_index
+        self._shard = fanout_shard
         rc = dict(runtime_config or {})
         all_default = rc.get("api/all", True)
         self._v1_enabled = rc.get("api/v1", all_default)
@@ -220,19 +232,33 @@ class ApiServer:
         return f"{scheme}://{self.host}:{self.port}"
 
     def start(self) -> "ApiServer":
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"apiserver-{self.worker_index}")
         self._thread.start()
+        if self._shard is not None:
+            self._shard.start()
         return self
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        if self._shard is not None:
+            # joins the pump and 410s this worker's watchers (clients
+            # re-list against a surviving worker)
+            self._shard.stop()
         with self._watchers_lock:
             live = list(self._live_watchers)
             self._live_watchers.clear()
         for w in live:
             w.stop()  # handler threads write their final chunk and exit
         self.httpd.server_close()
+        # thread-lifecycle audit: serve_forever returns after shutdown();
+        # join so a stopped server leaves NO live accept thread behind
+        # (the restart chaos tests assert this)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
 
     # ------------------------------------------------------------- dispatch
 
@@ -375,6 +401,8 @@ class ApiServer:
                     (time.monotonic() - start) * 1e6,
                     {"verb": method, "resource": res_label})
             self.metrics.inc("apiserver_request_count", {"verb": method})
+            self.metrics.inc(APISERVER_WORKER_REQUESTS,
+                             {"worker": str(self.worker_index)})
 
     def _route(self, h, method: str, path: str, query: dict) -> None:
         if path == "/healthz" or path == "/healthz/ping":
@@ -1233,7 +1261,8 @@ class ApiServer:
         deadline = self._watch_deadline(query)
         watcher = self.registry.watch(resource, namespace, since_rev,
                                       query.get("labelSelector", ""),
-                                      query.get("fieldSelector", ""))
+                                      query.get("fieldSelector", ""),
+                                      shard=self._shard)
         self.metrics.inc("apiserver_watch_count", {"resource": resource})
         if self._wants_websocket(h):
             return self._serve_watch_websocket(h, watcher,
@@ -1464,3 +1493,94 @@ class ApiServer:
             h.send_header(k, v)
         h.end_headers()
         h.wfile.write(payload)
+
+
+class ApiServerPool:
+    """N apiserver workers over ONE shared store — the horizontally-
+    scaled serving plane (Fleet serving, README). Each worker is a full
+    ApiServer on its own port with its own fan-out shard from the
+    shared store (attach_fanout_shard), so the watchers a worker serves
+    are pumped by that worker's own drain thread: delivery parallelism
+    scales with workers instead of queuing behind the single committer-
+    drained publisher. Reads and writes all land on the same store
+    (one revision stream, one watch history), so any worker can serve
+    any client — the in-proc stand-in for N apiserver processes behind
+    a load balancer over shared etcd (DIVERGENCES #33).
+
+    Stores without shard support (anything duck-typed that lacks
+    attach_fanout_shard) still pool fine: those workers serve watches
+    off the store's default delivery path.
+
+    restart(i) models one apiserver process bouncing behind the LB:
+    the old worker's watchers get 410 (ERROR + close, via shard.stop),
+    and the replacement binds the SAME port — in-flight connections
+    queue in the listen backlog instead of landing refused, so a
+    scraper or client that retries sees a blip, not an outage."""
+
+    def __init__(self, registry: Registry, n_workers: int = 2,
+                 host: str = "127.0.0.1",
+                 metrics: Optional[MetricsRegistry] = None,
+                 **server_kwargs):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.registry = registry
+        self.host = host
+        self.metrics = metrics
+        self._server_kwargs = dict(server_kwargs)
+        self.workers: list = []
+        for i in range(n_workers):
+            self.workers.append(self._build(i, port=0))
+
+    def _build(self, index: int, port: int) -> ApiServer:
+        store = self.registry.store
+        shard = (store.attach_fanout_shard(f"worker-{index}")
+                 if hasattr(store, "attach_fanout_shard") else None)
+        return ApiServer(self.registry, host=self.host, port=port,
+                         metrics=self.metrics, worker_index=index,
+                         fanout_shard=shard, **self._server_kwargs)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "ApiServerPool":
+        for w in self.workers:
+            w.start()
+        return self
+
+    def stop(self) -> None:
+        for w in self.workers:
+            w.stop()
+
+    def restart(self, index: int) -> ApiServer:
+        """Bounce worker `index` in place (rolling-restart chaos): stop
+        the old instance (accept thread joined, shard pump joined,
+        watchers 410'd), then bind a fresh instance — fresh shard
+        cursor, fresh handler state — on the SAME port."""
+        old = self.workers[index]
+        port = old.port
+        old.stop()
+        neu = self._build(index, port=port)
+        self.workers[index] = neu
+        neu.start()
+        return neu
+
+    # ------------------------------------------------------------- helpers
+
+    def urls(self) -> list:
+        return [w.url for w in self.workers]
+
+    def shards(self) -> list:
+        return [w._shard for w in self.workers if w._shard is not None]
+
+    def alive_threads(self) -> list:
+        """Every live thread the pool owns (restart chaos asserts this
+        is empty after stop): accept threads + shard pumps."""
+        out = []
+        for w in self.workers:
+            t = w._thread
+            if t is not None and t.is_alive():
+                out.append(t)
+            sh = w._shard
+            if sh is not None and sh._thread is not None \
+                    and sh._thread.is_alive():
+                out.append(sh._thread)
+        return out

@@ -237,6 +237,9 @@ class NativeStore:
         self.scheme = scheme
         self._watch_threads: List[threading.Thread] = []
         self._watchers: List[Any] = []
+        # worker fan-out shards (attach_fanout_shard); copy-on-write
+        self._shards: List["_NativeShard"] = []
+        self._shards_lock = threading.Lock()
         self._closed = False
         # native commit path: ring publisher + pre-assigned-window
         # commits (kv_commit_txn). native_publish=False is the control
@@ -301,6 +304,11 @@ class NativeStore:
         self._closed = True
         if getattr(self._lib, "has_commit_path", False):
             self._lib.kv_shutdown(self._h)
+        for sh in list(self._shards):
+            try:
+                sh.stop(timeout=timeout)
+            except Exception:
+                pass
         for w in self._watchers:
             try:
                 w.stop()
@@ -906,7 +914,7 @@ class NativeStore:
     # --------------------------------------------------------- watch
 
     def _events_since(self, since_rev: int, prefix: str
-                      ) -> List[Tuple[int, str, Any]]:
+                      ) -> List[Tuple[int, str, str, Any]]:
         size = 1 << 20
         while True:
             buf = ctypes.create_string_buffer(size)
@@ -938,14 +946,18 @@ class NativeStore:
             pos += 8
             (vlen,) = struct.unpack_from("<I", data, pos)
             pos += 4
-            out.append((rev, etype,
+            out.append((rev, etype, k,
                         self._decode(data[pos:pos + vlen], obj_rev, k)))
             pos += vlen
         return out
 
     def watch(self, prefix: str, since_rev: Optional[int] = None,
               capacity: int = 100_000,
-              predicate=None) -> watchpkg.Watcher:
+              predicate=None,
+              shard: Optional["_NativeShard"] = None) -> watchpkg.Watcher:
+        if shard is not None:
+            return self._watch_on_shard(prefix, since_rev, capacity,
+                                        predicate, shard)
         start_rev = (self.current_revision if since_rev is None
                      else since_rev)
         # Membership snapshot for the filter seed, taken BEFORE the
@@ -971,7 +983,7 @@ class NativeStore:
         known: dict = {}
         if predicate is not None:
             touched = {(o.metadata.namespace, o.metadata.name)
-                       for _rev, _etype, o in replay}
+                       for _rev, _etype, _k, o in replay}
             for obj in snapshot:
                 k = (obj.metadata.namespace, obj.metadata.name)
                 if k not in touched:
@@ -998,7 +1010,7 @@ class NativeStore:
             return watchpkg.Event(watchpkg.DELETED, obj)
 
         last = start_rev
-        for rev, etype, obj in replay:
+        for rev, etype, _k, obj in replay:
             ev = mapped(etype, obj)
             if ev is not None:
                 w.send(ev)
@@ -1013,15 +1025,14 @@ class NativeStore:
                 try:
                     events = self._events_since(last_rev, prefix)
                 except Expired:
-                    w.send(watchpkg.Event(
-                        watchpkg.ERROR,
-                        Expired("watch window overrun")))
-                    w.stop()
+                    w.fail(Expired("watch window overrun"))
                     return
-                for rev, etype, obj in events:
+                for rev, etype, _k, obj in events:
                     ev = mapped(etype, obj)
                     if ev is not None and not w.send(ev):
-                        w.stop()
+                        w.fail(Expired(
+                            f"watch delivery queue overrun (capacity "
+                            f"{w.capacity}); re-list and re-watch"))
                         return
                     last_rev = rev
 
@@ -1031,3 +1042,200 @@ class NativeStore:
         self._watch_threads.append(t)
         self._watchers.append(w)
         return w
+
+    # --------------------------------------------- worker fan-out shards
+
+    def _build_filter(self, prefix: str, predicate):
+        """Per-watcher event filter for shard delivery: the same
+        filtered-watch transition closure the dedicated-pump path
+        builds, seeded from a membership snapshot. Returns
+        mapped(etype, obj) -> Optional[Event]. Caller must hold the
+        shard lock from before the snapshot until the watcher is
+        registered (the closure's `known` dict is pump-thread-only
+        after that)."""
+        if predicate is None:
+            return lambda etype, obj: watchpkg.Event(etype, obj)
+        known: dict = {}
+        for obj in self.list(prefix)[0]:
+            known[(obj.metadata.namespace, obj.metadata.name)] = \
+                predicate(obj)
+
+        def mapped(etype: str, obj):
+            key = (obj.metadata.namespace, obj.metadata.name)
+            was = known.get(key)
+            if etype == watchpkg.DELETED:
+                known.pop(key, None)
+                return None if was is False else watchpkg.Event(etype, obj)
+            match_new = predicate(obj)
+            known[key] = match_new
+            if match_new:
+                if was is True and etype != watchpkg.ADDED:
+                    return watchpkg.Event(watchpkg.MODIFIED, obj)
+                return watchpkg.Event(watchpkg.ADDED, obj)
+            if was is False:
+                return None
+            if was is None and etype == watchpkg.ADDED:
+                return None
+            return watchpkg.Event(watchpkg.DELETED, obj)
+
+        return mapped
+
+    def _watch_on_shard(self, prefix: str, since_rev: Optional[int],
+                        capacity: int, predicate,
+                        shard: "_NativeShard") -> watchpkg.Watcher:
+        """Register a watcher on a worker shard. Under the shard lock
+        its cursor is frozen (the pump advances it only while holding
+        the lock), so replay-up-to-cursor + floor = max(since, cursor)
+        is exactly-once across the replay->live handoff: events at
+        rev <= cursor come from history now, events above arrive on
+        the shard pump. Predicate watchers are duplicate-tolerant in
+        one direction: a key committed after the membership snapshot
+        but before the cursor advances may surface once in the seed
+        AND once as a live ADDED (reflector-safe; the reference's
+        watch cache has the same bias replaying its window as init
+        ADDED events)."""
+        with shard.lock:
+            cursor = shard.cursor_rev
+            start_rev = cursor if since_rev is None else since_rev
+            mapped = self._build_filter(prefix, predicate)
+            replay = [e for e in self._events_since(start_rev, prefix)
+                      if e[0] <= cursor]           # raises Expired
+            w = watchpkg.Watcher(max(capacity, len(replay) + 16))
+            for _rev, etype, _k, obj in replay:
+                ev = mapped(etype, obj)
+                if ev is not None:
+                    w.send(ev)
+            floor = max(start_rev, cursor)
+            shard.watchers.append((prefix, mapped, w, floor))
+        return w
+
+    def attach_fanout_shard(self, name: str = "") -> "_NativeShard":
+        """Create a worker delivery shard (one pump thread fanning the
+        native event log out to that worker's watchers). Caller starts
+        it (shard.start()) and must stop() it on teardown; close()
+        sweeps stragglers."""
+        sh = _NativeShard(self, name or f"shard-{len(self._shards)}")
+        with self._shards_lock:
+            self._shards = self._shards + [sh]
+        return sh
+
+    def detach_fanout_shard(self, shard: "_NativeShard") -> None:
+        with self._shards_lock:
+            self._shards = [s for s in self._shards if s is not shard]
+        shard.detached = True
+
+    def fanout_shards(self) -> List["_NativeShard"]:
+        return list(self._shards)
+
+
+class _NativeShard:
+    """One apiserver worker's delivery partition over the native event
+    log: a cursor revision plus the watchers registered through that
+    worker, drained by ONE pump thread parked in kv_wait. Where the
+    dedicated-pump watch() path spends a thread per watcher, a shard
+    spends one thread per WORKER — the shape the 10k-watcher plane
+    needs — at the cost of serializing that worker's fan-out (which is
+    the point: delivery parallelism comes from adding workers).
+
+    Lock contract: `lock` freezes (cursor_rev, watchers) for
+    registration; the pump holds it across consume+fanout of a batch,
+    so a watcher registering mid-batch either replays those events
+    from history (cursor not yet advanced) or receives them live
+    (already in the watcher list) — never both, never neither."""
+
+    def __init__(self, store: "NativeStore", name: str):
+        self._store = store
+        self.name = name
+        self.lock = threading.Lock()
+        self.cursor_rev = store.current_revision
+        # entries: (prefix, mapped, watcher, floor_rev)
+        self.watchers: List[tuple] = []
+        self.delivered_events = 0
+        self.delivered_batches = 0
+        self.detached = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def pending(self) -> int:
+        return max(0, self._store.current_revision - self.cursor_rev)
+
+    def drain(self) -> int:
+        """Advance the cursor over newly-committed events and fan them
+        out to this shard's watchers (prefix + floor + filter closure
+        per watcher). Returns the number of events delivered. A
+        watcher that can't absorb the batch takes the 410 path
+        (Watcher.fail) and is dropped; a window overrun fails the
+        whole shard's watchers the same way and jumps the cursor to
+        head (everything between is unrecoverable from the log)."""
+        delivered = 0
+        with self.lock:
+            try:
+                events = self._store._events_since(self.cursor_rev, "")
+            except Expired:
+                for _p, _m, w, _f in self.watchers:
+                    w.fail(Expired(
+                        "watch window overrun; re-list and re-watch"))
+                self.watchers = []
+                self.cursor_rev = self._store.current_revision
+                return 0
+            if not events:
+                return 0
+            self.cursor_rev = events[-1][0]
+            alive = []
+            for prefix, mapped, w, floor in self.watchers:
+                if w.stopped:
+                    continue
+                ok = True
+                for rev, etype, key, obj in events:
+                    if rev <= floor or not key.startswith(prefix):
+                        continue
+                    ev = mapped(etype, obj)
+                    if ev is None:
+                        continue
+                    if not w.send(ev):
+                        w.fail(Expired(
+                            f"watch delivery queue overrun (capacity "
+                            f"{w.capacity}); re-list and re-watch"))
+                        ok = False
+                        break
+                    delivered += 1
+                if ok:
+                    alive.append((prefix, mapped, w, floor))
+            self.watchers = alive
+            self.delivered_batches += 1
+            self.delivered_events += delivered
+        return delivered
+
+    def start(self) -> "_NativeShard":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"native-fanout-{self.name}")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # parks in native code (GIL released); kv_shutdown breaks it
+            self._store._lib.kv_wait(
+                self._store._h, self.cursor_rev, 0.5)
+            if self._stop.is_set() or self._store._closed:
+                return
+            self.drain()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Join the pump, 410 any still-registered watchers (a worker
+        going away mid-stream must be visible — clients re-list
+        against a surviving worker), detach from the store."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        with self.lock:
+            for _p, _m, w, _f in self.watchers:
+                w.fail(Expired(
+                    "apiserver worker shutting down; "
+                    "re-list and re-watch"))
+            self.watchers = []
+        self._store.detach_fanout_shard(self)

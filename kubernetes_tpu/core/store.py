@@ -42,6 +42,18 @@ revision order no matter which thread fans them out. A watcher registering
 mid-flight replays the history window only up to the last PUBLISHED
 revision and carries a per-watcher floor for live delivery, so the
 replay->live handoff has no duplicates and no gaps (see watch()).
+
+Fleet serving (the multi-consumer ring): the publish queue is a RING of
+sequence-numbered batches that more than one delivery shard may consume.
+The default shard (shard 0) is the classic committer-drained path above —
+its lock IS _pub_lock, its high-water mark IS _published_rev, byte-for-byte
+the old behavior when no worker shards exist. `attach_fanout_shard()` adds
+an independent consumer: its own watcher partition, its own delivery
+cursor over the ring, its own pump thread — so N apiserver workers fan out
+in parallel instead of queuing behind one publisher. A batch is retained
+until EVERY shard's cursor passes it (trim at min-cursor); per-shard
+registration freezes that shard's published_rev under its shard lock, so
+the exactly-once replay->live handoff holds per worker.
 """
 
 from __future__ import annotations
@@ -55,8 +67,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import obs
 from ..utils.clock import REAL, Clock
-from ..utils.metrics import (WATCH_LAG_HISTOGRAM, MetricsRegistry,
-                             global_metrics)
+from ..utils.metrics import (FANOUT_QUEUE_DEPTH_GAUGE, WATCH_LAG_HISTOGRAM,
+                             MetricsRegistry, global_metrics)
 from . import watch as watchpkg
 from .errors import AlreadyExists, Conflict, Expired, NotFound
 from .types import fast_replace
@@ -65,6 +77,158 @@ from .types import fast_replace
 def _with_rv(obj: Any, rev: int) -> Any:
     meta = fast_replace(obj.metadata, resource_version=str(rev))
     return fast_replace(obj, metadata=meta)
+
+
+class _DrainOverlap:
+    """Witness of concurrent ring drains. On a 1-core box the 1->N
+    worker wall-clock win can vanish under the GIL while the
+    architecture is still correct; this counts how often two or more
+    shards were mid-fanout at once, which is the gate the fan-out
+    bench falls back to (PROFILE-style honesty, see ISSUE 18)."""
+
+    __slots__ = ("_mu", "_active", "max_concurrent", "entries",
+                 "overlapped")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._active = 0
+        self.max_concurrent = 0
+        self.entries = 0        # batches drained, all shards
+        self.overlapped = 0     # drains entered while another ran
+
+    def enter(self) -> None:
+        with self._mu:
+            self._active += 1
+            self.entries += 1
+            if self._active > 1:
+                self.overlapped += 1
+            if self._active > self.max_concurrent:
+                self.max_concurrent = self._active
+
+    def exit(self) -> None:
+        with self._mu:
+            self._active -= 1
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"entries": self.entries,
+                    "overlapped": self.overlapped,
+                    "max_concurrent": self.max_concurrent,
+                    "overlap_frac": (round(self.overlapped
+                                           / self.entries, 4)
+                                     if self.entries else 0.0)}
+
+
+class FanoutShard:
+    """One delivery partition of the store's publish ring.
+
+    A shard owns a slice of the watcher population, a cursor over the
+    shared ring, and (once start()ed) the pump thread that drains it —
+    the unit an apiserver worker holds so N workers deliver watch
+    events in parallel instead of queuing behind one publisher
+    (reference: one cacher per apiserver process over one etcd,
+    pkg/storage/cacher.go; ours shares one ledger in-proc).
+
+    Locking: `lock` freezes this shard's (cursor, published_rev,
+    watchers) — registration takes it, then the ledger lock, mirroring
+    Store._watch_register's publish->ledger order. The pump holds it
+    across consuming ONE ring entry and fans out under it, so delivery
+    order per shard is revision order and a mid-flight registration's
+    floor filters exactly the batches it already replayed."""
+
+    def __init__(self, store: "Store", name: str):
+        self._store = store
+        self.name = name
+        self.lock = threading.Lock()
+        self.watchers: List[Tuple[str, Optional[Callable[[Any], bool]],
+                                  "watchpkg.Watcher", int]] = []
+        self.published_rev = 0   # set at attach, under the ledger lock
+        self.cursor = 0          # next ring seq this shard consumes
+        self.wake = threading.Event()
+        self.delivered_batches = 0
+        self.delivered_events = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.detached = False
+
+    # ------------------------------------------------------- delivery
+
+    def drain(self, max_batches: Optional[int] = None) -> int:
+        """Consume ring entries at this shard's cursor; returns batches
+        delivered. Runs on the pump thread (or inline from tests)."""
+        store = self._store
+        n = 0
+        while max_batches is None or n < max_batches:
+            with self.lock:
+                entry = store._ring_next(self.cursor)
+                if entry is None:
+                    break
+                seq, t_enq, items = entry
+                # pending depth BEFORE consuming this entry: the
+                # backlog a stalled worker shows on dashboards
+                store._metrics.set_gauge(
+                    FANOUT_QUEUE_DEPTH_GAUGE,
+                    float(store._pub_seq - self.cursor),
+                    {"shard": self.name})
+                store._metrics.observe(
+                    WATCH_LAG_HISTOGRAM,
+                    store._clock.monotonic() - t_enq,
+                    {"shard": self.name})
+                store._drain_overlap.enter()
+                try:
+                    store._fanout(items, self.watchers)
+                finally:
+                    store._drain_overlap.exit()
+                self.published_rev = items[-1][0]
+                self.cursor = seq + 1
+                self.delivered_batches += 1
+                self.delivered_events += len(items)
+            n += 1
+        if n:
+            store._ring_trim()
+        return n
+
+    def pending(self) -> int:
+        """Ring batches staged but not yet delivered by this shard."""
+        return max(0, self._store._pub_seq - self.cursor)
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "FanoutShard":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def pump() -> None:
+            while not self._stop.is_set():
+                self.wake.wait(0.2)
+                self.wake.clear()   # before drain: a set during the
+                self.drain()        # drain forces one more pass
+            self.drain()            # deliver anything staged pre-stop
+
+        self._thread = threading.Thread(
+            target=pump, daemon=True, name=f"fanout-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Join the pump, fail remaining watchers (they must re-list —
+        their worker is gone), and detach from the ring so a dead
+        cursor can't pin retention."""
+        self._stop.set()
+        self.wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        with self.lock:
+            doomed = self.watchers
+            self.watchers = []
+        for _prefix, _pred, w, _floor in doomed:
+            if not w.stopped:
+                w.fail(Expired("apiserver worker shutting down; "
+                               "re-list and re-watch"))
+        self._store.detach_fanout_shard(self)
 
 
 class Store:
@@ -97,14 +261,32 @@ class Store:
         # ledger lock: only the publish phase touches watchers.
         self._watchers: List[Tuple[str, Optional[Callable[[Any], bool]],
                                    "watchpkg.Watcher", int]] = []
-        # publish pipeline: (enqueue_monotonic, batch) pairs — batches
-        # of (rev, key, event, prev) — appended under the ledger lock
-        # (FIFO order = revision order) and fanned out under _pub_lock
-        # after the ledger lock is released. The enqueue stamp feeds
-        # the watch publish->deliver lag histogram: how long a
+        # publish pipeline: a multi-consumer RING of (seq,
+        # enqueue_monotonic, batch) triples — batches of (rev, key,
+        # event, prev) — appended under the ledger lock (FIFO order =
+        # revision order, seq contiguous) and consumed per delivery
+        # shard: shard 0 is drained by committers under _pub_lock
+        # exactly as before; worker shards (attach_fanout_shard) drain
+        # on their own pump threads at their own cursors. An entry is
+        # retained until every cursor has passed it. The enqueue stamp
+        # feeds the watch publish->deliver lag histogram: how long a
         # committed event sat queued before watcher fan-out began.
         self._pub_queue: deque = deque()
         self._pub_lock = threading.Lock()
+        # leaf lock guarding ring mutation vs cursor-indexed reads
+        # (append runs under the ledger lock, trim/reads run under a
+        # shard lock; neither store lock is ever taken under it)
+        self._ring_lock = threading.Lock()
+        self._pub_seq = 0      # next ring sequence number to assign
+        self._pub_cursor = 0   # shard 0's cursor (next seq to consume)
+        # worker delivery shards (copy-on-write list: _stage_publish
+        # iterates it without a lock to wake pumps)
+        self._shards: List["FanoutShard"] = []
+        self._shards_lock = threading.Lock()
+        # multi-consumer overlap witness: how parallel delivery
+        # actually ran (the honest readout a 1-core box gates on when
+        # wall-clock scaling can't show)
+        self._drain_overlap = _DrainOverlap()
         self._metrics = metrics or global_metrics
         # highest revision whose events have been handed to watchers;
         # watch() replays history only up to here (the rest arrives live)
@@ -330,14 +512,16 @@ class Store:
             return watchpkg.Event(watchpkg.DELETED, ev.object)
         return None
 
-    def _fanout(self, items: List[Tuple[int, str, watchpkg.Event, Any]]
-                ) -> None:
+    def _fanout(self, items: List[Tuple[int, str, watchpkg.Event, Any]],
+                watchers: Optional[list] = None) -> None:
         """Publish phase: deliver one committed batch to watchers — one
         send per watcher when the batch has more than one event — and
-        sweep the dead. Runs under _pub_lock (never the ledger lock):
-        the publisher is the only reader/writer of _watchers, and
-        serializing on _pub_lock is what keeps delivery in revision
-        order across committer threads.
+        sweep the dead. Runs under the owning shard's lock (never the
+        ledger lock): default shard 0 passes _watchers under _pub_lock,
+        a worker FanoutShard passes its own partition under its own
+        lock — in both cases that lock's holder is the only
+        reader/writer of the list, which is what keeps delivery in
+        revision order per shard across committer threads.
 
         Per-watcher floors: an event with rev <= floor was already
         replayed to that watcher from history at registration time (or
@@ -350,10 +534,12 @@ class Store:
         event on a 30k-binding tile)."""
         if not items:
             return
+        if watchers is None:
+            watchers = self._watchers
         dead = []
         if len(items) == 1:
             rev, key, ev, prev = items[0]
-            for i, (prefix, pred, w, floor) in enumerate(self._watchers):
+            for i, (prefix, pred, w, floor) in enumerate(watchers):
                 if w.stopped:
                     dead.append(i)
                     continue
@@ -364,10 +550,11 @@ class Store:
                 if mapped is None:
                     continue
                 if not w.send(mapped):
-                    w.stop()
+                    w.fail(Expired("watch delivery queue overrun "
+                                   f"(capacity {w.capacity}); re-list "
+                                   "and re-watch"))
                     dead.append(i)
         else:
-            watchers = self._watchers
             per_w: List[Optional[list]] = [None] * len(watchers)
             for i, (_prefix, _pred, w, _floor) in enumerate(watchers):
                 if w.stopped:
@@ -421,20 +608,32 @@ class Store:
                 ok = (w.send(evs[0]) if len(evs) == 1
                       else w.send_many(evs, owned=True))
                 if not ok:
-                    w.stop()
+                    # the laggard path: a silent stop() here is
+                    # indistinguishable from a clean close, so the
+                    # client would never re-list — fail() delivers the
+                    # cacher's 410-Gone ERROR past the bound instead
+                    w.fail(Expired("watch delivery queue overrun "
+                                   f"(capacity {w.capacity}); re-list "
+                                   "and re-watch"))
                     dead.append(i)
         # dead may interleave stopped-sweep and failed-send indices:
         # delete in strictly descending order
         for i in sorted(dead, reverse=True):
-            del self._watchers[i]
+            del watchers[i]
 
     def _stage_publish(self, items: List[Tuple[int, str, watchpkg.Event,
                                                Any]]) -> None:
-        """Hand one committed batch to the publisher (caller holds the
-        ledger lock, so queue order is revision order) — the caller MUST
-        call _drain_publish() after releasing the lock."""
+        """Hand one committed batch to the ring (caller holds the
+        ledger lock, so append order is revision order) — the caller
+        MUST call _drain_publish() after releasing the lock. Worker
+        shard pumps are woken here; they drain at their own cursors."""
         if items:
-            self._pub_queue.append((self._clock.monotonic(), items))
+            with self._ring_lock:
+                self._pub_queue.append(
+                    (self._pub_seq, self._clock.monotonic(), items))
+                self._pub_seq += 1
+            for sh in self._shards:
+                sh.wake.set()
 
     def _emit(self, rev: int, etype: str, key: str, obj: Any,
               prev: Any) -> None:
@@ -443,33 +642,69 @@ class Store:
         self._stage_publish(
             [(rev, key, self._record(rev, etype, key, obj, prev), prev)])
 
+    def _ring_next(self, cursor: int) -> Optional[tuple]:
+        """(seq, t_enq, items) at seq == cursor, or None when the ring
+        holds nothing at or past it. Seqs are contiguous, so the entry
+        sits at a computed offset from the ring head; the ring lock
+        pins the head against a concurrent append/trim for the read."""
+        with self._ring_lock:
+            q = self._pub_queue
+            if not q:
+                return None
+            idx = cursor - q[0][0]
+            if idx >= len(q):
+                return None
+            return q[idx]
+
+    def _ring_trim(self) -> None:
+        """Drop ring entries every consumer has passed (min-cursor).
+        Cursors only grow, so a racy read of another shard's cursor is
+        conservative — an entry lives at most one round longer."""
+        with self._ring_lock:
+            q = self._pub_queue
+            if not q:
+                return
+            low = self._pub_cursor
+            for sh in self._shards:
+                if sh.cursor < low:
+                    low = sh.cursor
+            while q and q[0][0] < low:
+                q.popleft()
+
     def _drain_publish(self) -> None:
-        """Publish every queued batch, in order, outside the ledger
-        lock. The non-blocking acquire hands a busy publisher the work
-        instead of parking this committer behind another thread's
-        fan-out; the outer re-check after release closes the
-        enqueue-after-empty window (a batch queued while the previous
-        drainer was exiting is picked up here, never stranded)."""
-        q = self._pub_queue
-        while q:
+        """Publish every staged batch to the DEFAULT shard, in order,
+        outside the ledger lock. The non-blocking acquire hands a busy
+        publisher the work instead of parking this committer behind
+        another thread's fan-out; the outer re-check after release
+        closes the stage-after-empty window (a batch staged while the
+        previous drainer was exiting is picked up here, never
+        stranded). Worker shards consume the same ring on their own
+        pump threads — this path neither waits for nor wakes them."""
+        while self._pub_seq > self._pub_cursor:
             if not self._pub_lock.acquire(blocking=False):
                 return  # the live publisher drains our batch in order
             try:
                 while True:
-                    try:
-                        t_enq, items = q.popleft()
-                    except IndexError:
+                    entry = self._ring_next(self._pub_cursor)
+                    if entry is None:
                         break
+                    seq, t_enq, items = entry
                     # publish->deliver lag, observed OUTSIDE the ledger
                     # lock (metrics take their own registry lock; the
                     # histogram dual-lands via the pinned boundaries)
                     self._metrics.observe(
                         WATCH_LAG_HISTOGRAM,
                         self._clock.monotonic() - t_enq)
-                    self._fanout(items)
+                    self._drain_overlap.enter()
+                    try:
+                        self._fanout(items)
+                    finally:
+                        self._drain_overlap.exit()
                     self._published_rev = items[-1][0]
+                    self._pub_cursor = seq + 1
             finally:
                 self._pub_lock.release()
+            self._ring_trim()
 
     def _gc_expired(self, now: Optional[float] = None) -> None:
         """Lazily delete TTL-expired entries (reference: etcd event TTL)."""
@@ -1004,7 +1239,8 @@ class Store:
 
     def watch(self, prefix: str, since_rev: Optional[int] = None,
               capacity: int = 100_000,
-              predicate: Optional[Callable[[Any], bool]] = None
+              predicate: Optional[Callable[[Any], bool]] = None,
+              shard: Optional["FanoutShard"] = None
               ) -> watchpkg.Watcher:
         """Stream events for keys under prefix with rev > since_rev.
 
@@ -1021,28 +1257,36 @@ class Store:
         are mapped through the reference's filtered-watch transition
         semantics — see _filtered_event.
 
+        shard: a FanoutShard from attach_fanout_shard() — the watcher
+        joins that worker's partition and its events arrive on the
+        worker's pump thread. None = the default committer-drained
+        shard (every pre-existing caller).
+
         Mid-flight registration (commits in their publish phase): under
-        _pub_lock the publisher is quiescent and _published_rev frozen.
-        History is replayed only up to _published_rev; anything already
-        committed to the ledger but not yet fanned out is delivered by
-        the publisher, because this watcher registers (with floor =
-        max(since_rev, _published_rev)) before _pub_lock is released.
-        Exactly-once across the replay->live handoff, in revision order.
+        the shard's lock its publisher is quiescent and its
+        published_rev frozen. History is replayed only up to that
+        published_rev; anything already committed to the ledger but not
+        yet fanned out is delivered by the shard's drain, because this
+        watcher registers (with floor = max(since_rev, published_rev))
+        before the shard lock is released. Exactly-once across the
+        replay->live handoff, in revision order — per shard.
         """
         try:
             return self._watch_register(prefix, since_rev, capacity,
-                                        predicate)
+                                        predicate, shard)
         finally:
-            # batches committed while registration held _pub_lock
-            # skipped their drain (non-blocking acquire): flush them
-            # even when registration raises Expired
+            # batches committed while registration held the default
+            # shard's lock skipped their drain (non-blocking acquire):
+            # flush them even when registration raises Expired
             self._drain_publish()
 
     def _watch_register(self, prefix: str, since_rev: Optional[int],
                         capacity: int,
-                        predicate: Optional[Callable[[Any], bool]]
+                        predicate: Optional[Callable[[Any], bool]],
+                        shard: Optional["FanoutShard"] = None
                         ) -> watchpkg.Watcher:
-        with self._pub_lock:
+        lock = self._pub_lock if shard is None else shard.lock
+        with lock:
             with self._lock:
                 replay = []
                 if since_rev is None:
@@ -1054,7 +1298,8 @@ class Store:
                         raise Expired(
                             f"resourceVersion {since_rev} is too old "
                             f"(oldest available {self._oldest_rev})")
-                    published = self._published_rev
+                    published = (self._published_rev if shard is None
+                                 else shard.published_rev)
                     floor = max(since_rev, published)
                     for rev, etype, key, obj, prev in self._history:
                         if rev <= since_rev or rev > published \
@@ -1074,8 +1319,41 @@ class Store:
             w = watchpkg.Watcher(max(capacity, len(replay) + 16))
             if replay:
                 w.send_many(replay, owned=True)
-            self._watchers.append((prefix, predicate, w, floor))
+            (self._watchers if shard is None
+             else shard.watchers).append((prefix, predicate, w, floor))
         return w
+
+    def attach_fanout_shard(self, name: str = "") -> FanoutShard:
+        """Create a worker delivery shard over the publish ring. Its
+        cursor starts at the ring's END and its published_rev at the
+        ledger head — both snapshotted under the ledger lock, so a
+        watcher registering on the fresh shard replays history up to
+        exactly the point live delivery takes over (pending ring
+        entries it skips are inside its replay window). Caller starts
+        the pump (shard.start()) and must stop() it on teardown."""
+        with self._shards_lock:
+            sh = FanoutShard(self, name or f"shard-{len(self._shards)}")
+            with self._lock:
+                sh.cursor = self._pub_seq
+                sh.published_rev = self._rev
+                # copy-on-write: _stage_publish iterates lock-free
+                self._shards = self._shards + [sh]
+        return sh
+
+    def detach_fanout_shard(self, shard: "FanoutShard") -> None:
+        """Remove a shard from ring retention (idempotent; called by
+        FanoutShard.stop)."""
+        with self._shards_lock:
+            self._shards = [s for s in self._shards if s is not shard]
+        shard.detached = True
+        self._ring_trim()
+
+    def fanout_shards(self) -> List["FanoutShard"]:
+        return list(self._shards)
+
+    def drain_overlap(self) -> dict:
+        """The multi-consumer concurrency witness (see _DrainOverlap)."""
+        return self._drain_overlap.snapshot()
 
     def watcher_count(self) -> int:
         with self._pub_lock:
@@ -1083,6 +1361,11 @@ class Store:
                               if not e[2].stopped]
             n = len(self._watchers)
         self._drain_publish()  # flush batches parked while we held the lock
+        for sh in self._shards:
+            with sh.lock:
+                sh.watchers = [e for e in sh.watchers
+                               if not e[2].stopped]
+                n += len(sh.watchers)
         return n
 
     # -------------------------------------------------------- durability

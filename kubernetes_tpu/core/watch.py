@@ -84,6 +84,27 @@ class Watcher:
             self._cond.notify()
         return True
 
+    def fail(self, err: Any) -> None:
+        """Terminate the stream with a visible ERROR event, then stop.
+
+        The laggard path (the cacher's 410-Gone semantics,
+        pkg/storage/cacher.go terminateAllWatchers): a watcher whose
+        queue overran gets ONE final ERROR carrying the ApiError — past
+        the capacity bound, deliberately, because the bound exists to
+        limit data events, and a silent stop() here looks identical to
+        a clean server-side close, so the client would never know to
+        re-list. Consumers drain the backlog, see the ERROR, and
+        recover via list + re-watch. Idempotent after stop()."""
+        if self._stopped.is_set():
+            return
+        with self._cond:
+            if self._stopped.is_set():
+                return
+            self._count += 1
+            self._dq.append(Event(ERROR, err))
+            self._cond.notify()
+        self.stop()
+
     def stop(self) -> None:
         if self._stopped.is_set():
             return
@@ -117,6 +138,23 @@ class Watcher:
                 self._pending.extend(item)
             else:
                 yield item
+
+    def take_all(self) -> List[Event]:
+        """Drain everything queued right now, without blocking — one
+        lock hold for the whole backlog. The consumer-side counterpart
+        of send_many: a 10k-watcher fan-out bench popping events one
+        next() at a time would spend its wall-clock on lock churn
+        instead of delivery."""
+        out: List[Event] = list(self._pending)
+        self._pending.clear()
+        with self._cond:
+            while self._dq:
+                item = self._take()
+                if isinstance(item, list):
+                    out.extend(item)
+                else:
+                    out.append(item)
+        return out
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         """Blocking pop with timeout; None on timeout or stop."""

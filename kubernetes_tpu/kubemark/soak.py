@@ -237,6 +237,19 @@ def run_soak(duration_s: float = 600.0, n_nodes: int = 200,
                 sample()
                 threading.Thread(target=sampler, daemon=True).start()
                 sampler_started = True
+        # all_gone above proved the DELETEs committed to the STORE,
+        # but the scheduler's incremental encoder drains them from its
+        # own watch stream — on a loaded box that drain can trail the
+        # final sample and read as ledger growth. Settle it (bounded):
+        # a genuine leak never drains and still fails the gate.
+        inc = sched._inc
+        if inc is not None and cycles:
+
+            def ledger_drained():
+                with inc._lock:
+                    return not any(n in inc.pods for n in names)
+
+            wait_until(ledger_drained, timeout_s=15.0)
         sample()  # final
     finally:
         stop_sampler.set()

@@ -63,7 +63,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api.client import HttpClient, InProcClient
 from ..api.registry import Registry
-from ..api.server import ApiServer
+from ..api.server import ApiServer, ApiServerPool
 from ..chaos import (ChaosClient, FaultPlan, NodeChaos, NodeFaultPlan,
                      WorkloadChaos, WorkloadPlan)
 from ..controllers.daemon import DaemonSetController
@@ -91,6 +91,10 @@ from .slo import CROWD_BIND_SLO, FLEET_SLOS
 UNITS_PER_REPLICA = 4
 HPA_TARGET_PCT = 50
 HPA_MAX_REPLICAS = 60
+
+#: pod name of the watch-audit delivery barrier (never scheduled; its
+#: ADDED is the only post-quiesce pods write — see the audit readout)
+_AUDIT_SENTINEL = "watch-audit-sentinel"
 
 #: pinned spelling (the metric-pinning lint contract)
 LATENCY_METRIC = APISERVER_LATENCY_SUMMARY
@@ -178,6 +182,28 @@ class WorkloadSoakResult:
     #: renders; popped from as_dict() so the workload section stays
     #: verdict-sized
     scrape_export: Optional[Dict] = None
+    # ---- Fleet serving (apiserver_workers > 0): the multi-worker
+    # plane with rolling restarts mid-replay, audited by one watch
+    # stream per worker shard + a default-shard truth stream
+    apiserver_workers: int = 0
+    worker_restarts: int = 0
+    #: truth events a worker stream never delivered (must be 0)
+    watch_audit_missed: int = 0
+    #: events delivered twice within one registration past the resume
+    #: floor — protocol duplicates, not resume replay (must be 0)
+    watch_audit_dups: int = 0
+    #: events a worker stream saw that truth never did (must be 0)
+    watch_audit_extra: int = 0
+    #: at-least-once resume artifacts (reflector-deduped, reported
+    #: honestly: a DELETED tombstone carries the pre-delete rv, so a
+    #: client resuming from its last-seen resourceVersion replays
+    #: across a trailing delete — the reference has the same bias)
+    watch_audit_redelivered: int = 0
+    watch_audit_streams: int = 0
+    #: the actual (stream, type, name, rv) records behind missed/extra
+    #: — empty on a clean audit; kept so a failed gate names the
+    #: events instead of just counting them
+    watch_audit_diff: List = field(default_factory=list)
     detail: str = ""
 
     @property
@@ -219,6 +245,19 @@ class WorkloadSoakResult:
         return True
 
     @property
+    def watch_audit_ok(self) -> Optional[bool]:
+        """The multi-worker watch contract (apiserver_workers runs
+        only): every pods event the default-shard truth stream saw
+        was delivered by every worker shard exactly once per
+        registration — across rolling restarts — with no inventions.
+        None when the pool was off."""
+        if self.watch_audit_streams == 0:
+            return None
+        return (self.watch_audit_missed == 0
+                and self.watch_audit_dups == 0
+                and self.watch_audit_extra == 0)
+
+    @property
     def slo_ok(self) -> bool:
         """Every gate at once — what the soak test asserts and the
         bench artifact records."""
@@ -227,6 +266,7 @@ class WorkloadSoakResult:
                     and self.bind_p99_ok is not False
                     and self.hpa_ok
                     and self.alerts_ok is not False
+                    and self.watch_audit_ok is not False
                     and self.duplicate_bindings == 0
                     and self.dead_bound == 0
                     and self.jobs_completed >= self.jobs_expected
@@ -255,6 +295,7 @@ class WorkloadSoakResult:
         d["bind_p99_ok"] = self.bind_p99_ok
         d["hpa_ok"] = self.hpa_ok
         d["alerts_ok"] = self.alerts_ok
+        d["watch_audit_ok"] = self.watch_audit_ok
         d["slo_ok"] = self.slo_ok
         d["hpa_track"] = [list(t) for t in self.hpa_track]
         d.pop("scrape_export", None)
@@ -280,7 +321,9 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                       scrape: bool = False,
                       alert_clear_limit_ticks: int = 6,
                       keep_series: bool = False,
-                      flight_dir: Optional[str] = None
+                      flight_dir: Optional[str] = None,
+                      apiserver_workers: int = 0,
+                      worker_restarts: bool = True
                       ) -> WorkloadSoakResult:
     """One seeded trace replay; see the module docstring for the
     scenario. Timing knobs default to soak-compressed values.
@@ -291,7 +334,16 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
     runs the pinned FLEET_SLOS over the samples — the crowd fast-burn
     alert timeline becomes a gate (alerts_ok). flight_dir additionally
     arms a FlightRecorder: SLO trips and node-kill chaos dump
-    post-mortem bundles there."""
+    post-mortem bundles there.
+
+    apiserver_workers > 0 replaces the single apiserver with an
+    ApiServerPool of that many workers over the shared store (Fleet
+    serving). Chaos traffic and the scraper ride worker 0 (its port
+    survives restarts); one audit watch stream per worker shard plus
+    a default-shard truth stream gate the watch contract
+    (watch_audit_ok). worker_restarts additionally bounces one worker
+    at each quarter-point tick — the rolling-restart chaos the
+    acceptance replay runs."""
     clock = clock or REAL
     plan = plan or WorkloadPlan(seed=seed)
     seed = plan.seed
@@ -305,7 +357,13 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
 
     metrics = MetricsRegistry()
     registry = registry or Registry()
-    server = ApiServer(registry, port=0, metrics=metrics).start()
+    pool = None
+    if apiserver_workers > 0:
+        pool = ApiServerPool(registry, n_workers=apiserver_workers,
+                             metrics=metrics).start()
+        server = pool.workers[0]
+    else:
+        server = ApiServer(registry, port=0, metrics=metrics).start()
     chaos = ChaosClient(HttpClient(server.url), fault_plan)
     inproc = InProcClient(registry)
 
@@ -313,12 +371,14 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         converged=False, n_nodes=n_nodes, seed=seed, ticks=plan.ticks,
         bind_p99_limit_s=bind_p99_limit_s,
         hpa_lag_limit_ticks=hpa_lag_limit,
-        alert_clear_limit_ticks=alert_clear_limit_ticks)
+        alert_clear_limit_ticks=alert_clear_limit_ticks,
+        apiserver_workers=apiserver_workers)
 
     # ---- metrics plane: scraper + burn-rate evaluator + recorder
     recorder = (FlightRecorder(flight_dir, clock=clock)
                 if flight_dir else None)
     tick_now = [0]  # current replay tick, for bundle metadata
+    sampled_tick = [-1]  # last tick sampled in-crowd (see _on_crowd)
 
     def _on_trip(ev):
         if recorder is not None:
@@ -380,6 +440,7 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
     bound_to: Dict[str, str] = {}            # pod uid -> node
     duplicates: List[Tuple[str, str, str]] = []
     crowd_created: Dict[str, float] = {}
+    crowd_tick: Dict[str, int] = {}          # pod name -> landing tick
     crowd_bound: Dict[str, float] = {}
     bind_stamps: List[float] = []            # all binds, for phases
     stop_threads = threading.Event()
@@ -390,7 +451,17 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         # sample at this tick deterministically sees the error ratio
         # spike (the pods cannot have bound yet)
         crowd_created.update({n: time.monotonic() for n in names})
+        crowd_tick.update({n: tick_now[0] for n in names})
         metrics.inc(CROWD_COUNTERS[0], by=float(len(names)))
+        # take THIS tick's sample right here, synchronously after the
+        # created counter moved: the scheduler cannot have bound any
+        # of the crowd yet, so the sample deterministically shows the
+        # whole crowd outstanding and the TRIP edge replays — scraping
+        # later from the tick loop races the binder (a slow apply_tick
+        # under multi-worker contention let fast binds erase the TRIP)
+        if scraper is not None and sampled_tick[0] != tick_now[0]:
+            sampled_tick[0] = tick_now[0]
+            evaluator.observe(scraper.sample(t=float(tick_now[0])))
 
     wl.on_crowd = _on_crowd
 
@@ -452,6 +523,73 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                                 name="workload-executor")]
     for t in threads:
         t.start()
+
+    # ---- Fleet serving watch audit: one stream per worker shard plus
+    # a default-shard truth stream, all watching pods since rev 0. A
+    # restarted worker 410s its stream (ERROR), and the audit resumes
+    # on the replacement shard from its last-seen resourceVersion —
+    # exactly the re-list-and-re-watch loop a real client runs.
+    audit_lock = threading.Lock()
+    audit_states: List[dict] = []
+    truth_st: dict = {}
+
+    def _audit_drain(st: dict) -> None:
+        for ev in st["watcher"]:
+            if ev.type == "ERROR":
+                return  # worker restarting: the tick loop re-registers
+            o = ev.object
+            rec = (ev.type, o.metadata.name,
+                   int(o.metadata.resource_version))
+            with audit_lock:
+                if rec[1] == _AUDIT_SENTINEL:
+                    # the readout's delivery barrier (see there):
+                    # advances the frontier, excluded from the
+                    # compared event sets
+                    st["last"] = max(st["last"], rec[2])
+                    continue
+                if rec in st["seen"]:
+                    if rec[2] <= st["floor"]:
+                        # resume replay across a DELETED tail: the
+                        # tombstone carries the pre-delete rv, so
+                        # resuming from last-seen rv is at-least-once
+                        # there — the reflector dedup every real
+                        # client runs, reported but not gated
+                        st["redelivered"] += 1
+                    else:
+                        st["dups"] += 1   # protocol duplicate: gates
+                    continue
+                st["seen"].add(rec)
+                st["last"] = max(st["last"], rec[2])
+
+    def _audit_register(st: dict, shard, since: int) -> None:
+        st["floor"] = since
+        st["watcher"] = registry.watch("pods", "default",
+                                       since_rev=since, shard=shard)
+        t = threading.Thread(target=_audit_drain, args=(st,),
+                             daemon=True,
+                             name=f"watch-audit-{st['name']}")
+        st["thread"] = t
+        t.start()
+
+    def _audit_state(name: str) -> dict:
+        return {"name": name, "seen": set(), "last": 0, "floor": 0,
+                "dups": 0, "redelivered": 0, "watcher": None,
+                "thread": None}
+
+    restart_at: Dict[int, int] = {}
+    if pool is not None:
+        truth_st = _audit_state("truth")
+        _audit_register(truth_st, None, 0)
+        for i, wkr in enumerate(pool.workers):
+            st = _audit_state(f"w{i}")
+            _audit_register(st, wkr._shard, 0)
+            audit_states.append(st)
+        if worker_restarts and plan.ticks >= 8:
+            # quarter-point ticks, round-robin victims: deterministic
+            # restart schedule (same seed => same bounce timeline)
+            for j, at in enumerate((plan.ticks // 4, plan.ticks // 2,
+                                    (3 * plan.ticks) // 4)):
+                restart_at[at] = j % apiserver_workers
 
     def wait_until(cond, deadline):
         while clock.monotonic() < deadline:
@@ -542,6 +680,40 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         hpa_bad_run = 0
         for tick in range(plan.ticks):
             tick_now[0] = tick
+            # crowds that landed on EARLIER ticks must be bound before
+            # this tick's sample, or the CLEAR edge races the scrape
+            # on a loaded box (a worker restart this tick makes the
+            # race wall-clock-sized); bounded wait BEFORE the tick
+            # applies so the in-crowd sample below also sees them
+            # settled — a genuinely stuck bind path still reads as a
+            # late clear and fails the alert-lag gate
+            if scraper is not None:
+                due = [n for n, t0 in crowd_tick.items() if t0 < tick]
+
+                def _crowds_quiesced():
+                    with lock:
+                        return all(n in crowd_bound for n in due)
+
+                # the cap must dominate a loaded box's bind latency
+                # (~2s seen with 3 workers + restart on one core) or
+                # the timeline goes non-deterministic again; in a
+                # healthy run the wait returns in well under a tick
+                wait_until(_crowds_quiesced,
+                           clock.monotonic() + max(5.0,
+                                                   4.0 * tick_wall_s))
+            if tick in restart_at:
+                # rolling worker restart (same port, fresh shard);
+                # BEFORE this tick's scrape so the blip and the
+                # re-registration land on a deterministic tick axis
+                idx = restart_at[tick]
+                pool.restart(idx)
+                result.worker_restarts += 1
+                st = audit_states[idx]
+                if st["thread"] is not None:
+                    st["thread"].join(timeout=5.0)  # exits on ERROR
+                with audit_lock:
+                    since = st["last"]
+                _audit_register(st, pool.workers[idx]._shard, since)
             wl.apply_tick(tick, deadline)
             if node_kill_fraction > 0 and tick == kill_tick:
                 result.killed = node_chaos.kill()
@@ -556,8 +728,9 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                                          "victims": result.killed})
             # scrape ON the tick axis, right after the tick's events
             # applied: the sample index IS the tick, so the alert
-            # timeline replays across same-seed runs
-            if scraper is not None:
+            # timeline replays across same-seed runs (a crowd tick
+            # already took its sample inside _on_crowd — see there)
+            if scraper is not None and sampled_tick[0] != tick:
                 evaluator.observe(scraper.sample(t=float(tick)))
             time.sleep(tick_wall_s)
             # HPA tracking sample, against the pure curve
@@ -648,6 +821,67 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
                 result.scrape_export = json.loads(scraper.export_json())
         if recorder is not None:
             result.flight_bundles = list(recorder.bundles)
+
+        # ---- Fleet serving watch audit readout: the system is
+        # quiesced (no pods writes in flight), so after a short
+        # pump-settle every worker stream must hold exactly the truth
+        # stream's event set
+        if pool is not None:
+            # Delivery barrier: frontier comparisons alone CANNOT see a
+            # trailing DELETE — its tombstone carries the pre-delete
+            # rv, so it advances no stream's `last`, and a worker pump
+            # still holding the final delete batch passes any
+            # frontier-based settle (seen live: three streams each
+            # missing the same trailing DELETED). Creating one marker
+            # pod AFTER quiesce closes it: per-stream delivery is
+            # revision-ordered, so a stream that has consumed the
+            # sentinel's ADDED (whose rec rv DOES advance the
+            # frontier) has consumed every earlier event. The sentinel
+            # carries a nodeSelector no hollow node matches — it never
+            # schedules, so its ADDED is the last pods event of the
+            # run — and _audit_drain excludes it from the compared
+            # sets. Snapshot in the SAME lock hold the barrier check
+            # passes in (a second hold would reopen the window).
+            sentinel = api.Pod(
+                metadata=api.ObjectMeta(name=_AUDIT_SENTINEL,
+                                        namespace="default"),
+                spec=api.PodSpec(
+                    node_selector={"watch-audit": "barrier"},
+                    containers=[api.Container(
+                        name="c", image="img",
+                        resources=api.ResourceRequirements(
+                            requests={"cpu": parse_quantity("1m"),
+                                      "memory": parse_quantity("1Mi")}
+                        ))]))
+            barrier_rev = int(
+                inproc.create("pods", sentinel)
+                .metadata.resource_version)
+            audit_deadline = clock.monotonic() + 5.0
+            while True:
+                with audit_lock:
+                    settled = (truth_st["last"] >= barrier_rev
+                               and all(st["last"] >= barrier_rev
+                                       for st in audit_states))
+                    if settled or clock.monotonic() >= audit_deadline:
+                        truth = set(truth_st["seen"])
+                        for st in audit_states:
+                            missing = truth - st["seen"]
+                            extra = st["seen"] - truth
+                            result.watch_audit_missed += len(missing)
+                            result.watch_audit_extra += len(extra)
+                            result.watch_audit_dups += st["dups"]
+                            result.watch_audit_redelivered += (
+                                st["redelivered"])
+                            for rec in sorted(missing):
+                                result.watch_audit_diff.append(
+                                    (st["name"], "missed") + rec)
+                            for rec in sorted(extra):
+                                result.watch_audit_diff.append(
+                                    (st["name"], "extra") + rec)
+                        break
+                clock.sleep(0.02)
+            result.watch_audit_streams = len(audit_states)
+
         result.services_final = services_now() or []
         result.services_ok = result.services_final == expected_services
         result.jobs_completed = max(0, completed_jobs())
@@ -722,4 +956,10 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
         sched.stop()
         factory.stop()
         fleet.stop()
-        server.stop()
+        for st in ([truth_st] if truth_st else []) + audit_states:
+            if st.get("watcher") is not None:
+                st["watcher"].stop()
+        if pool is not None:
+            pool.stop()
+        else:
+            server.stop()

@@ -238,6 +238,14 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, {}).get(_key(labels), 0.0)
 
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Last value set_gauge recorded for one label set (None if the
+        gauge was never set) — what a bench reads back for a depth
+        gauge like watch_fanout_queue_depth."""
+        with self._lock:
+            return self._gauges.get(name, {}).get(_key(labels))
+
     def counter_sum(self, name: str) -> float:
         """Total across every label set of one counter — what a gate
         asserts when it cares that the thing happened, not which label
@@ -360,9 +368,21 @@ WORKLOAD_COUNTERS = (
 APISERVER_LATENCY_SUMMARY = "apiserver_request_latencies_microseconds"
 
 #: Watch publish -> deliver lag in SECONDS: stamped when a commit's
-#: events enter the store publish queue, observed when the publisher
-#: drain hands them to watcher fan-out (core/store.py).
+#: events enter the store publish ring, observed when a consumer's
+#: drain hands them to watcher fan-out (core/store.py). The default
+#: committer-drained shard observes unlabeled; worker fan-out shards
+#: observe with {shard=...} (burn-rate evaluation sums label sets).
 WATCH_LAG_HISTOGRAM = "watch_publish_deliver_lag_seconds"
+
+#: Publish-ring backlog per fan-out shard (pub_seq head minus the
+#: shard's delivery cursor), set by FanoutShard.drain with
+#: {shard=...}. A shard stuck behind a slow fan-out shows here before
+#: its watchers overrun and take the 410 path.
+FANOUT_QUEUE_DEPTH_GAUGE = "watch_fanout_queue_depth"
+
+#: Requests served per apiserver worker (label: worker). The serving
+#: bench and fanout soak read this to show spread across the pool.
+APISERVER_WORKER_REQUESTS = "apiserver_worker_requests"
 
 #: Flash-crowd progress counters the workload soak's burn-rate SLO
 #: reads: created is incremented synchronously at crowd injection,
@@ -397,8 +417,11 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
         100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
         25000.0, 50000.0, 100000.0, 250000.0, 500000.0,
         1000000.0, 2500000.0),
-    # watch publish lag, seconds: fan-out normally drains sub-ms
+    # watch publish lag, seconds: fan-out normally drains sub-ms; the
+    # 5/10s tail buckets exist for the 10k-watcher fan-out storm
+    # (a GIL-bound worker pump behind 10k sends can stall whole
+    # seconds — the SLO needs to see that tail, not clip it)
     WATCH_LAG_HISTOGRAM: (
         0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
-        0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+        0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
 }

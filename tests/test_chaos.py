@@ -658,3 +658,60 @@ def test_crash_soak_reproducible_across_invocations():
         assert r.recovery["live_set_match"], r.recovery
     a, b = results
     assert a.killed == b.killed == a.schedule
+
+
+@pytest.mark.chaos
+@pytest.mark.serving
+def test_pool_rolling_restart_no_dead_threads():
+    """The kill/restart dead-thread gate extended to the multi-worker
+    serving plane (ISSUE 18 satellite): rolling restarts across an
+    ApiServerPool — each bounce must join the old worker's accept
+    thread AND its fan-out shard pump, 410 its watchers, and rebind
+    the SAME port; after pool.stop() not one pool-owned thread
+    survives."""
+    from kubernetes_tpu.api.server import ApiServerPool
+    from kubernetes_tpu.core import watch as watchpkg
+    from kubernetes_tpu.core.errors import Expired
+
+    registry = Registry()
+    pool = ApiServerPool(registry, n_workers=3).start()
+    try:
+        ports = [w.port for w in pool.workers]
+        watchers = [registry.watch("pods", "default", shard=w._shard)
+                    for w in pool.workers]
+        InProcClient(registry).create("pods", mkpod("pre"))
+        for w in watchers:
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.object.metadata.name == "pre"
+
+        for i in range(len(pool.workers)):
+            old = pool.workers[i]
+            old_accept, old_pump = old._thread, old._shard._thread
+            pool.restart(i)
+            # dead-thread assertion: the bounced worker's accept loop
+            # and shard pump both exited (not merely abandoned)
+            for t in (old_accept, old_pump):
+                if t is not None:
+                    t.join(timeout=2.0)
+                    assert not t.is_alive(), t.name
+            assert pool.workers[i].port == ports[i]   # same port
+            # its watchers got the visible 410, never a silent close
+            assert watchers[i].stopped
+            evs = list(watchers[i])
+            assert evs and evs[-1].type == watchpkg.ERROR
+            assert isinstance(evs[-1].object, Expired)
+
+        # the replacement workers serve: a fresh watcher on a fresh
+        # shard sees the next commit, and HTTP lands on the same port
+        w2 = registry.watch("pods", "default",
+                            shard=pool.workers[0]._shard)
+        InProcClient(registry).create("pods", mkpod("post"))
+        ev = w2.next(timeout=5)
+        assert ev is not None and ev.object.metadata.name == "post"
+        w2.stop()
+        items, _rev = HttpClient(pool.workers[1].url).list(
+            "pods", namespace="default")
+        assert {p.metadata.name for p in items} == {"pre", "post"}
+    finally:
+        pool.stop()
+    assert pool.alive_threads() == []

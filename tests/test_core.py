@@ -929,3 +929,204 @@ def test_native_close_wakes_parked_watchers():
         assert not t.is_alive()
     for w in watchers:
         assert w.stopped
+
+# ---------------------------------------------------------------------------
+# Fan-out shards (ISSUE 18): per-worker delivery partitions over the
+# shared publish ring. Each apiserver worker owns one FanoutShard —
+# its own watcher slice, ring cursor, and pump — so these tests pin
+# the same exactly-once replay->live contract the single-publisher
+# tests above pin, but across an INDEPENDENT consumer's cursor, plus
+# the slow-watcher 410 backpressure path.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+def test_fanout_shard_replay_plus_live_handoff():
+    """A watcher registering on a worker shard whose cursor lags the
+    ledger: replay covers exactly the shard's published prefix, the
+    floor filters the already-staged suffix out of replay, and the
+    shard's own drain delivers it live — no duplicate, no gap."""
+    s = Store()
+    sh = s.attach_fanout_shard("t0")   # not started: drained inline
+    s.create(pod_key("default", "r0"), make_pod("r0"))
+    sh.drain()
+    assert sh.published_rev == 1
+    s.create(pod_key("default", "r1"), make_pod("r1"))   # staged,
+    s.create(pod_key("default", "r2"), make_pod("r2"))   # not consumed
+    w = s.watch("/registry/pods/", since_rev=0, shard=sh)
+    sh.drain()
+    evs = [w.next(timeout=1) for _ in range(3)]
+    assert [e.object.metadata.name for e in evs] == ["r0", "r1", "r2"]
+    assert [int(e.object.metadata.resource_version) for e in evs] == \
+        [1, 2, 3]
+    assert w.next(timeout=0.1) is None
+    w.stop()
+    sh.stop()
+
+
+@pytest.mark.serving
+def test_fanout_shard_cursors_are_independent():
+    """One slow worker must not gate another: shard B delivers at its
+    own pace while shard A sits unconsumed, and the ring retains A's
+    backlog until A finally drains it (trim is at the min cursor)."""
+    s = Store()
+    a = s.attach_fanout_shard("a")
+    b = s.attach_fanout_shard("b")
+    wa = s.watch("/registry/pods/", since_rev=0, shard=a)
+    wb = s.watch("/registry/pods/", since_rev=0, shard=b)
+    for i in range(5):
+        s.create(pod_key("default", f"p{i}"), make_pod(f"p{i}"))
+    b.drain()
+    assert [int(e.object.metadata.resource_version)
+            for e in (wb.next(timeout=1) for _ in range(5))] == \
+        [1, 2, 3, 4, 5]
+    assert wa.next(timeout=0.05) is None     # A consumed nothing yet
+    assert a.pending() == 5
+    a.drain()
+    assert [int(e.object.metadata.resource_version)
+            for e in (wa.next(timeout=1) for _ in range(5))] == \
+        [1, 2, 3, 4, 5]
+    wa.stop(); wb.stop()
+    a.stop(); b.stop()
+
+
+@pytest.mark.serving
+def test_fanout_shard_churn_storm_no_dup_no_gap():
+    """Watcher register/cancel churn racing committers, per shard: the
+    watchers that survive the churn each see every commit exactly once
+    in strict revision order, through live pumps (started shards), with
+    cancels landing mid-storm on the same shard lock."""
+    s = Store()
+    shards = [s.attach_fanout_shard(f"w{i}").start() for i in range(2)]
+    n_writers, per_writer = 3, 60
+    stop_churn = threading.Event()
+    kept = [[], []]
+
+    def creator(wid):
+        for lo in range(0, per_writer, 5):
+            s.create_batch([
+                (pod_key("default", f"c{wid}-{lo + j}"),
+                 make_pod(f"c{wid}-{lo + j}"), None)
+                for j in range(5)])
+            time.sleep(0.001)
+
+    def churner(si):
+        n = 0
+        while not stop_churn.is_set():
+            w = s.watch("/registry/pods/", since_rev=0,
+                        shard=shards[si])
+            if n % 3 == 0 and len(kept[si]) < 6:
+                kept[si].append(w)
+            else:
+                w.stop()          # cancel racing the pump's fan-out
+            n += 1
+            time.sleep(0.001)
+
+    threads = ([threading.Thread(target=creator, args=(wid,))
+                for wid in range(n_writers)]
+               + [threading.Thread(target=churner, args=(si,))
+                  for si in range(2)])
+    for t in threads:
+        t.start()
+    for t in threads[:n_writers]:
+        t.join()
+    stop_churn.set()
+    for t in threads[n_writers:]:
+        t.join()
+
+    total = n_writers * per_writer
+    assert s.current_revision == total
+    deadline = time.monotonic() + 5.0
+    while (any(sh.pending() for sh in shards)
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert all(len(ws) >= 2 for ws in kept)  # survivors on both shards
+    for ws in kept:
+        for w in ws:
+            revs = []
+            while len(revs) < total:
+                ev = w.next(timeout=5)
+                assert ev is not None, \
+                    f"watcher starved at {len(revs)}/{total}"
+                revs.append(int(ev.object.metadata.resource_version))
+            assert revs == list(range(1, total + 1))
+            assert w.next(timeout=0.05) is None
+            w.stop()
+    for sh in shards:
+        sh.stop()
+
+
+@pytest.mark.serving
+def test_slow_watcher_backpressure_error_then_relist():
+    """The bounded-queue backpressure contract: a watcher that stops
+    draining gets ONE terminal ERROR event carrying Expired (the 410
+    the cacher sends, terminateAllWatchers) past its capacity bound —
+    never a silent close — and recovers via the standard list +
+    re-watch-from-list-revision loop with no duplicate and no gap."""
+    s = Store()
+    sh = s.attach_fanout_shard("bp").start()
+    w = s.watch("/registry/pods/", shard=sh, capacity=4)
+    for i in range(40):
+        s.create(pod_key("default", f"s{i}"), make_pod(f"s{i}"))
+    deadline = time.monotonic() + 5.0
+    while not w.stopped and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert w.stopped, "overrun watcher was never terminated"
+    evs = list(w)
+    assert evs, "backpressure must be visible, not a silent close"
+    assert evs[-1].type == watchpkg.ERROR
+    assert isinstance(evs[-1].object, Expired)
+    data_revs = [int(e.object.metadata.resource_version)
+                 for e in evs[:-1]]
+    assert data_revs == sorted(set(data_revs))   # whatever arrived, once
+
+    # 410 recovery: list (state + revision), then watch from that rev
+    objs, rev = s.list("/registry/pods/")
+    assert len(objs) == 40 and rev == s.current_revision
+    w2 = s.watch("/registry/pods/", since_rev=rev, shard=sh)
+    s.create(pod_key("default", "after"), make_pod("after"))
+    deadline = time.monotonic() + 5.0
+    ev = None
+    while ev is None and time.monotonic() < deadline:
+        ev = w2.next(timeout=0.25)
+    assert ev is not None and ev.object.metadata.name == "after"
+    assert int(ev.object.metadata.resource_version) == rev + 1
+    w2.stop()
+    sh.stop()
+
+
+@pytest.mark.serving
+def test_watcher_fail_is_terminal_and_idempotent():
+    """Watcher.fail delivers exactly one ERROR even when called twice,
+    and admits it past a full queue (the bound limits data events; the
+    death notice must always fit)."""
+    w = watchpkg.Watcher(capacity=2)
+    assert w.send(watchpkg.Event(watchpkg.ADDED, 1))
+    assert w.send(watchpkg.Event(watchpkg.ADDED, 2))
+    assert not w.send(watchpkg.Event(watchpkg.ADDED, 3))
+    w.fail(Expired("re-list"))
+    w.fail(Expired("re-list again"))     # idempotent after stop
+    evs = list(w)
+    assert [e.type for e in evs] == \
+        [watchpkg.ADDED, watchpkg.ADDED, watchpkg.ERROR]
+    assert w.stopped
+
+
+@pytest.mark.serving
+def test_shard_stop_410s_watchers_and_joins_pump():
+    """Worker shutdown: the shard's pump joins, every watcher it owned
+    gets the terminal ERROR (go re-list on another worker), and the
+    detached cursor no longer pins ring retention."""
+    s = Store()
+    sh = s.attach_fanout_shard("dead").start()
+    ws = [s.watch("/registry/pods/", shard=sh) for _ in range(3)]
+    s.create(pod_key("default", "p0"), make_pod("p0"))
+    pump = sh._thread
+    sh.stop()
+    assert pump is not None and not pump.is_alive()
+    assert sh.detached
+    for w in ws:
+        assert w.stopped
+        evs = list(w)
+        assert evs and evs[-1].type == watchpkg.ERROR
+        assert isinstance(evs[-1].object, Expired)
+    assert sh not in s.fanout_shards()

@@ -1,0 +1,281 @@
+"""The fleet serving plane (ISSUE 18): N apiserver workers over ONE
+shared store, watch fan-out sharded per worker.
+
+What these gates pin: any worker serves any client (one revision
+stream behind the whole pool), each worker's fan-out shard delivers
+its watcher slice exactly once through replay->live handoff and
+rolling restarts, the slow-watcher backpressure is a VISIBLE 410 (the
+core-level contract lives in tests/test_core.py; here it rides the
+full soak), and the fast fan-out storm passes the watch-deliver SLO
+accounting end to end. The 10k-watcher storm itself is the slow
+shape; tier-1 runs the same machinery at a compressed width.
+
+Reference: N apiserver processes behind a load balancer over shared
+etcd, each with its own watch cache (pkg/storage/cacher.go) —
+DIVERGENCES #33 records the in-proc worker-pool stand-in."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api.client import HttpClient, InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.api.server import ApiServerPool
+from kubernetes_tpu.chaos import WorkloadPlan
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core import watch as watchpkg
+from kubernetes_tpu.core.errors import Expired
+from kubernetes_tpu.core.quantity import parse_quantity
+from kubernetes_tpu.utils.metrics import (APISERVER_WORKER_REQUESTS,
+                                          FANOUT_QUEUE_DEPTH_GAUGE,
+                                          WATCH_LAG_HISTOGRAM,
+                                          MetricsRegistry)
+
+
+def mkpod(name):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": parse_quantity("100m"),
+                          "memory": parse_quantity("64Mi")}))]))
+
+
+# ----------------------------------------------------------- pool wiring
+
+@pytest.mark.serving
+class TestApiServerPool:
+    def test_any_worker_serves_any_client(self):
+        """One shared store behind N ports: a create through worker 0
+        is immediately visible to a list through worker 2 and lands on
+        a watch served by worker 1 — and each worker's request counter
+        ticks under its own label."""
+        m = MetricsRegistry()
+        registry = Registry()
+        pool = ApiServerPool(registry, n_workers=3, metrics=m).start()
+        try:
+            c0 = HttpClient(pool.workers[0].url)
+            c2 = HttpClient(pool.workers[2].url)
+            w1 = c2  # readability: list via 2, watch via 1
+            w = HttpClient(pool.workers[1].url).watch(
+                "pods", namespace="default")
+            time.sleep(0.1)  # let the watch stream establish
+            c0.create("pods", mkpod("x"))
+            ev = w.next(timeout=5)
+            assert ev is not None and ev.type == watchpkg.ADDED
+            assert ev.object.metadata.name == "x"
+            items, rev = c2.list("pods", namespace="default")
+            assert [p.metadata.name for p in items] == ["x"]
+            assert rev == registry.store.current_revision
+            w.stop()
+            # the counter lands in the handler's finally, which can run
+            # a beat after the client finishes reading — poll briefly
+            def _counted():
+                return all(m.counter(APISERVER_WORKER_REQUESTS,
+                                     {"worker": str(i)}) >= 1
+                           for i in (0, 2))
+            deadline = time.monotonic() + 2.0
+            while not _counted() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert _counted(), {
+                i: m.counter(APISERVER_WORKER_REQUESTS,
+                             {"worker": str(i)}) for i in (0, 1, 2)}
+        finally:
+            pool.stop()
+        assert pool.alive_threads() == []
+
+    def test_worker_shards_pump_their_own_watchers(self):
+        """In-proc watchers routed to different workers' shards each
+        see the same commits, delivered by their OWN worker's pump —
+        and both shards land per-shard lag + queue-depth metrics."""
+        m = MetricsRegistry()
+        registry = Registry(metrics=m) if "metrics" in \
+            Registry.__init__.__code__.co_varnames else Registry()
+        pool = ApiServerPool(registry, n_workers=2, metrics=m).start()
+        try:
+            # the shard metrics land on the STORE's registry
+            store_metrics = registry.store._metrics
+            ws = [registry.watch("pods", "default", shard=wk._shard)
+                  for wk in pool.workers]
+            InProcClient(registry).create("pods", mkpod("y"))
+            for w in ws:
+                ev = w.next(timeout=5)
+                assert ev is not None
+                assert ev.object.metadata.name == "y"
+                w.stop()
+            deadline = time.monotonic() + 5.0
+            while (any(sh.pending() for sh in pool.shards())
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            for wk in pool.workers:
+                name = wk._shard.name
+                assert wk._shard.delivered_events >= 1
+                stats = store_metrics.summary_stats(WATCH_LAG_HISTOGRAM)
+                assert any(dict(k).get("shard") == name
+                           for k in stats), (name, list(stats))
+                assert store_metrics.gauge(
+                    FANOUT_QUEUE_DEPTH_GAUGE,
+                    {"shard": name}) is not None
+        finally:
+            pool.stop()
+        assert pool.alive_threads() == []
+
+    def test_pool_over_native_store_shards(self):
+        """The native arm: worker shards over the C++ store get their
+        own kv_wait pump each; restart 410s that worker's watchers and
+        joins its pump; close leaves no thread behind."""
+        from kubernetes_tpu.core.native_store import (NativeStore,
+                                                      native_available)
+        if not native_available():
+            pytest.skip("no native toolchain")
+        store = NativeStore(native_publish=True)
+        registry = Registry(store=store)
+        pool = ApiServerPool(registry, n_workers=2).start()
+        try:
+            ws = [registry.watch("pods", "default", shard=wk._shard)
+                  for wk in pool.workers]
+            InProcClient(registry).create("pods", mkpod("n0"))
+            for w in ws:
+                ev = w.next(timeout=5)
+                assert ev is not None
+                assert ev.object.metadata.name == "n0"
+            old_pump = pool.workers[0]._shard._thread
+            pool.restart(0)
+            if old_pump is not None:
+                old_pump.join(timeout=2.0)
+                assert not old_pump.is_alive()
+            assert ws[0].stopped
+            evs = list(ws[0])
+            assert evs and evs[-1].type == watchpkg.ERROR
+            assert isinstance(evs[-1].object, Expired)
+            # the surviving worker's watcher rides on, exactly once
+            InProcClient(registry).create("pods", mkpod("n1"))
+            ev = ws[1].next(timeout=5)
+            assert ev is not None and ev.object.metadata.name == "n1"
+            ws[1].stop()
+        finally:
+            pool.stop()
+            store.close()
+        assert pool.alive_threads() == []
+
+
+# -------------------------------------------------------- fan-out soak
+
+@pytest.mark.serving
+class TestFanoutSoak:
+    def test_fast_fanout_storm_gate(self):
+        """The tier-1 shape of the 10k storm: 2k watchers x 2 workers
+        under a create-storm — exact delivery accounting (creates x
+        watchers, no drop, no dup), the watch-deliver SLO never stays
+        tripped, every worker reports per-shard lag, and the
+        multi-consumer overlap witness proves the shards genuinely
+        drained concurrently."""
+        from kubernetes_tpu.kubemark.fanout_soak import run_fanout_soak
+        r = run_fanout_soak(n_watchers=2000, workers=2, storm_steps=3,
+                            creates_per_step=60, batch=30,
+                            http_watchers=2, settle_timeout_s=30.0,
+                            compare_single=False)
+        assert r.arm.delivered_ok, (
+            f"drained {r.arm.drained_events_total} != expected "
+            f"{r.arm.drained_expected}")
+        assert r.arm.watch_slo_ok, r.arm.alerts
+        assert r.arm.cross_worker_ok, r.arm.cross_worker_lists
+        assert r.ok
+        assert set(r.arm.per_worker) == {"worker-0", "worker-1"}
+        for name, d in r.arm.per_worker.items():
+            assert d["lag_samples"] > 0, name
+            assert d["delivered"] == r.arm.creates_total, name
+        assert r.arm.http_events > 0
+        ov = r.arm.overlap
+        assert ov["max_concurrent"] >= 2 and ov["overlapped"] > 0, ov
+
+    @pytest.mark.slow
+    def test_10k_watcher_storm(self):
+        """The headline shape (SLO_10KWATCH.json): 10k watchers x 4
+        workers with the 1-worker baseline arm — the full acceptance
+        gate including the scaling readout (wall-clock ratio or, on a
+        1-core box, the overlap-witness fallback with its recorded
+        caveat)."""
+        from kubernetes_tpu.kubemark.fanout_soak import run_fanout_soak
+        r = run_fanout_soak(n_watchers=10_000, workers=4)
+        assert r.arm.delivered_ok
+        assert r.arm.watch_slo_ok, r.arm.alerts
+        assert r.scaling_ok, (r.scaling_ratio, r.arm.overlap)
+        assert r.ok
+        if r.scaling_gate == "overlap":
+            assert r.caveat  # the honest record rides the result
+
+
+# --------------------------------------- the replayed production day
+
+# test_workload.py's canonical FAST shape, with head-room for the
+# multi-worker plane on one core: wider ticks (3 shard pumps + audit
+# drains share the core with the committers) and the day-replay
+# shape's 8s burst-bind limit (the same knob test_workload.py's
+# 1k-node arm relaxes, for the same contention reason — the gate
+# still fails a stuck bind path, it just tolerates a loaded box)
+FAST = dict(n_nodes=12, tick_wall_s=0.5, fault_rate=0.05,
+            node_kill_fraction=0.10, timeout=120.0, scrape=True,
+            bind_p99_limit_s=8.0)
+
+
+def _assert_day_gates(r):
+    """The full per-run gate set for the multi-worker replayed day."""
+    assert r.apiserver_workers == 3
+    assert r.worker_restarts >= 3, (
+        f"only {r.worker_restarts} rolling restarts happened")
+    assert r.converged, r.detail
+    assert r.schedule_replayed and r.node_schedule_replayed
+    assert r.bind_p99_ok is not False, (
+        f"bind p99 {r.bind_p99_s}s over {r.bind_p99_limit_s}s")
+    assert r.hpa_ok, f"HPA lag {r.hpa_max_lag_ticks} ticks"
+    assert r.alerts_ok is not False, r.alerts
+    assert r.jobs_completed >= r.jobs_expected
+    assert r.services_ok
+    assert r.dead_bound == 0
+    assert r.slo_ok, r.detail
+    assert r.duplicate_bindings == 0
+    assert r.watch_audit_streams == 3  # one per worker
+    assert r.watch_audit_ok, (
+        f"missed={r.watch_audit_missed} "
+        f"dups={r.watch_audit_dups} extra={r.watch_audit_extra}")
+    assert r.scrape_errors == 0, (
+        "same-port rebind must look like a blip, not an outage")
+
+
+@pytest.mark.serving
+@pytest.mark.workload
+class TestMultiWorkerDayReplay:
+    def test_day_replay_with_rolling_restarts_exactly_once(self):
+        """The PR-8 replayed day against the multi-worker plane with
+        rolling worker restarts (ISSUE 18 acceptance): every SLO gate
+        passes with zero duplicate bindings, and the per-worker watch
+        audits prove exactly-once delivery across the restarts (zero
+        missed events, zero protocol dups)."""
+        from kubernetes_tpu.kubemark.workload_soak import run_workload_soak
+        r = run_workload_soak(plan=WorkloadPlan(seed=2, ticks=12),
+                              apiserver_workers=3, **FAST)
+        _assert_day_gates(r)
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_same_seed_same_day_multiworker(self):
+        """Two same-seed invocations against the multi-worker plane
+        produce byte-identical final state — the ISSUE 18 extension of
+        TestWorkloadReproducibility. Marked slow for the same reason as
+        the single-worker gate: whether a flash crowd trips the
+        fast-burn alert depends on wall-clock bind latency, so the
+        cross-run alert-timeline comparison needs an otherwise-idle
+        box (the per-run alert gates above are load-tolerant and stay
+        tier-1)."""
+        from kubernetes_tpu.kubemark.workload_soak import run_workload_soak
+        a = run_workload_soak(plan=WorkloadPlan(seed=2, ticks=12),
+                              apiserver_workers=3, **FAST)
+        b = run_workload_soak(plan=WorkloadPlan(seed=2, ticks=12),
+                              apiserver_workers=3, **FAST)
+        for r in (a, b):
+            _assert_day_gates(r)
+        assert a.killed == b.killed
+        assert a.state_summary() == b.state_summary()

@@ -203,6 +203,16 @@ def _scalars(doc: dict) -> dict:
     if isinstance(mp, dict) and isinstance(mp.get("overhead_frac"),
                                            (int, float)):
         out["scrape.overhead_frac"] = float(mp["overhead_frac"])
+    sv = doc.get("serving")
+    if isinstance(sv, dict):
+        arm = sv.get("arm")
+        if isinstance(arm, dict):
+            if isinstance(arm.get("deliver_events_per_sec"),
+                          (int, float)):
+                out["serving.deliver_events_per_sec"] = float(
+                    arm["deliver_events_per_sec"])
+            if isinstance(arm.get("lag_p99_ms"), (int, float)):
+                out["serving.lag_p99_ms"] = float(arm["lag_p99_ms"])
     return out
 
 
@@ -227,7 +237,7 @@ def _recover_scalars(wrapper: dict) -> dict:
 #: direction per scalar: +1 means up is good (throughput), -1 means
 #: up is bad (latency, overhead)
 def _direction(name: str) -> int:
-    return 1 if name.endswith("pods_per_sec") else -1
+    return 1 if name.endswith("_per_sec") else -1
 
 
 def against_report(current: dict, baseline_path: str,

@@ -987,16 +987,19 @@ def main():
 
     # regenerate the multi-host DCN-path proof every round (4 procs x 2
     # virtual CPU devices, bindings asserted bit-equal across
-    # processes) — a standing artifact, not a one-time capture
+    # processes) — a standing artifact, not a one-time capture.
+    # --fail-shard adds the shard-failure gate: wedged-worker detection
+    # + survivor-shape relaunch parity + the in-process shard-kill
+    # soak's lease/epoch/replay verdicts (ISSUE 19)
     multihost = None
     repo = os.path.dirname(os.path.abspath(__file__))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(repo, "tools",
                                           "dryrun_multihost.py"),
-             "--procs", "4", "--out",
+             "--procs", "4", "--fail-shard", "--out",
              os.path.join(repo, "MULTIHOST.json")],
-            capture_output=True, text=True, timeout=600, cwd=repo)
+            capture_output=True, text=True, timeout=900, cwd=repo)
         for line in reversed(proc.stdout.splitlines()):
             line = line.strip()
             if line.startswith("{"):

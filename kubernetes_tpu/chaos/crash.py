@@ -96,3 +96,83 @@ class CrashChaos:
         """Kill points actually applied — a run is reproducible when
         this equals plan.schedule(total) for every fired target."""
         return dict(self._trace)
+
+
+@dataclass
+class ShardKillPlan:
+    """Seeded MESH-shard kill schedule: which shard owners die, and at
+    which bound-pod count — the data-plane sibling of CrashPlan's
+    control-plane kills, under the identical determinism contract.
+
+    Each shard owns an independent stream seeded from
+    `(seed, "shard", index)`, and that stream is drawn from exactly
+    ONCE; the single uniform serves both decisions. Victim selection:
+    the `kills` shards with the SMALLEST draws (ties by index) die —
+    every shard's fate is a pure function of (seed, n_shards, kills),
+    independent of interleaving. Kill point: the victim's same draw
+    maps into the progress window, measured in BOUND PODS like every
+    other plan (replays exactly; wall time never would).
+    `schedule(total)` is the pure replay the shard-kill soak
+    (kubemark/shard_soak.py) gates a live trace against."""
+
+    seed: int = 0
+    n_shards: int = 4
+    kills: int = 1
+    #: each kill point lands in [window[0], window[1]) of the workload
+    window: Tuple[float, float] = (0.25, 0.8)
+
+    def stream(self, shard: int) -> random.Random:
+        # str seeding hashes via sha512 — stable across processes
+        return random.Random(f"{self.seed}:shard:{shard}")
+
+    def draw(self, shard: int) -> float:
+        """The shard's ONE uniform draw, always."""
+        return self.stream(shard).random()
+
+    def victims(self) -> Tuple[int, ...]:
+        """The shards that die: smallest draws first, ties by index,
+        ascending shard order in the result."""
+        k = max(0, min(self.kills, self.n_shards - 1))
+        ranked = sorted(range(self.n_shards),
+                        key=lambda s: (self.draw(s), s))
+        return tuple(sorted(ranked[:k]))
+
+    def fraction(self, shard: int) -> float:
+        lo, hi = self.window
+        return lo + self.draw(shard) * (hi - lo)
+
+    def kill_point(self, shard: int, total: int) -> int:
+        """Bound-pod count at which the shard's owner dies. Clamped
+        inside (0, total) so the kill observably interrupts the run."""
+        return min(max(int(self.fraction(shard) * total), 1), total - 1)
+
+    def schedule(self, total: int) -> Dict[int, int]:
+        """What a live run with this seed MUST select."""
+        return {s: self.kill_point(s, total) for s in self.victims()}
+
+    def order(self, total: int) -> List[Tuple[int, int]]:
+        """Kill events sorted by firing point (ties by shard index)."""
+        return sorted((p, s) for s, p in self.schedule(total).items())
+
+
+class ShardKillChaos:
+    """Apply a ShardKillPlan, recording a trace of what actually fired
+    — same reproducibility gate shape as CrashChaos."""
+
+    def __init__(self, plan: ShardKillPlan, total: int):
+        self.plan = plan
+        self.total = total
+        self._trace: Dict[int, int] = {}
+
+    def pending(self) -> List[Tuple[int, int]]:
+        """Kill events not yet applied, in firing order."""
+        return [(p, s) for p, s in self.plan.order(self.total)
+                if s not in self._trace]
+
+    def record(self, shard: int, point: int) -> None:
+        self._trace[shard] = point
+
+    def trace(self) -> Dict[int, int]:
+        """Kill points actually applied — reproducible when equal to
+        plan.schedule(total) for every fired shard."""
+        return dict(self._trace)

@@ -908,10 +908,14 @@ def _rule_applies(rule: str, path: str) -> bool:
         # obs/ is in the family: span IDs must stay a pure function of
         # (seed, counter) and timestamps must ride the injectable Clock
         # — wall-clock or process RNG there breaks the byte-identical
-        # same-seed trace-export contract
+        # same-seed trace-export contract. leaderelection rides along
+        # since the shard-lease protocol (sched/device/shardfail.py)
+        # made lease liveness chaos-replayed state: a wall-clock read
+        # there would break the FakeClock-driven expiry replay
         return (path.startswith("kubernetes_tpu/chaos/")
                 or path.startswith("kubernetes_tpu/sched/")
                 or path.startswith("kubernetes_tpu/obs/")
+                or path == "kubernetes_tpu/utils/leaderelection.py"
                 or (path.startswith("kubernetes_tpu/kubemark/")
                     and _soak_file(path.rsplit("/", 1)[-1])))
     if rule == "lock-discipline":
@@ -920,7 +924,11 @@ def _rule_applies(rule: str, path: str) -> bool:
     if rule == "jax-hygiene":
         return path.startswith("kubernetes_tpu/sched/device/")
     if rule == "shard-sync":
-        return path.startswith("kubernetes_tpu/sched/device/")
+        # the shard-kill soak drives the tile loop directly (dispatch,
+        # epoch fence, reshard) — exactly where a per-tile host sync
+        # would hide, so it joins the device modules in scope
+        return (path.startswith("kubernetes_tpu/sched/device/")
+                or path == "kubernetes_tpu/kubemark/shard_soak.py")
     if rule == "api-idempotency":
         return (path.startswith("kubernetes_tpu/")
                 and path != "kubernetes_tpu/api/retry.py")

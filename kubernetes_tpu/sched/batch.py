@@ -56,6 +56,11 @@ class _Inflight:
     flags: Tuple[bool, bool]  # (has_aff, has_spread)
     t_start: float
     t_dev: float
+    # encoder shard-epoch vector at encode time (TableDelta.shard_epochs;
+    # None on the full-encode path): _finalize fences on it — a tile
+    # whose vector no longer matches the encoder's was dispatched
+    # against a mesh that lost a shard, and is dropped whole
+    shard_epochs: Optional[Tuple[int, ...]] = None
     # set once _finalize has handed the tile's bindings over (commit
     # queued or committed) — the drain_commits barrier rides behind it
     landed: threading.Event = field(default_factory=threading.Event)
@@ -89,8 +94,13 @@ class BatchSchedulerConfig:
                  bulk_chunk: int = 1024, incremental: bool = True,
                  commit_chunk: int = 0,
                  metrics: Optional[MetricsRegistry] = None,
-                 mesh=None):
+                 mesh=None, shard_monitor=None):
         self.factory = factory
+        # shard-failure tolerance (sched/device/shardfail.py): a
+        # ShardLeaseMonitor polled between tiles. An expired shard
+        # lease triggers fence -> survivor re-shard -> in-flight drop;
+        # None (the default) keeps the mesh un-monitored.
+        self.shard_monitor = shard_monitor
         # mesh= shards the node axis of the live pipeline across devices
         # (ignored when an explicit engine is passed — the engine's own
         # mesh wins); the encoder below keeps slot capacity a multiple
@@ -439,6 +449,11 @@ class BatchScheduler:
         """Returns True if any pods were processed."""
         c = self.config
         f = c.factory
+        if c.shard_monitor is not None:
+            # between-tile shard failure detection: the scan itself is
+            # never interrupted — an expired shard lease is observed
+            # HERE, before the next dispatch
+            self._check_shards()
         # with a tile in flight, don't park on the FIFO — an empty drain
         # must fall through so the idle path can finalize promptly
         pods = self._drain_tile(0 if self._prev is not None else 0.5)
@@ -594,7 +609,10 @@ class BatchScheduler:
                       {"chained": str(chained).lower()})
         self._prev = _Inflight(pods=pods, enc=enc, assigned=assigned,
                                state=state, epoch=enc.state_epoch,
-                               flags=flags, t_start=start, t_dev=t_dev)
+                               flags=flags, t_start=start, t_dev=t_dev,
+                               shard_epochs=(enc.delta.shard_epochs
+                                             if enc.delta is not None
+                                             else None))
         tr = obs.tracer()
         if tr.enabled:
             # "schedule" stage ends at device dispatch; the matching
@@ -643,6 +661,32 @@ class BatchScheduler:
         it's what drain_commits and _ledger_current key off."""
         c = self.config
         f = c.factory
+        inc = self._inc
+        delta = getattr(fl.enc, "delta", None)
+        if (inc is not None and delta is not None
+                and fl.shard_epochs is not None
+                and delta.encoder_id == inc.encoder_id
+                and inc.shard_epochs() != fl.shard_epochs):
+            # shard-epoch fence: a shard owner died (and the mesh
+            # re-sharded) after this tile's dispatch. Its assignments
+            # were computed against the dead shard's slot mapping —
+            # none may bind. Drop the tile whole; its pods requeue
+            # FIFO and re-schedule against the survivor mesh. Epochs
+            # are compared only within ONE encoder instance
+            # (encoder_id): a failover successor's vector is
+            # incomparable, and those tiles keep the existing
+            # bind-then-reconcile semantics.
+            try:
+                for pod in fl.pods:
+                    try:
+                        self._requeue(pod, "mesh",
+                                      "re-sharded since dispatch")
+                    except Exception:
+                        logger.exception("requeue of %s failed",
+                                         pod.metadata.name)
+            finally:
+                fl.landed.set()
+            return
         try:
             try:
                 assigned = np.asarray(fl.assigned)
@@ -732,6 +776,44 @@ class BatchScheduler:
                 self._error(pod, e)
             except Exception:
                 logger.exception("error-routing pod failed")
+
+    def _check_shards(self) -> None:
+        """Shard-failure recovery, scheduler-thread only: poll the
+        shard lease monitor; on expiry, fence the dead owner (CAS
+        takeover advancing lease_transitions — a resurrecting owner
+        loses every subsequent CAS), re-shard the slot mapping onto the
+        survivors (encoder re-journals + re-epochs, engine rebuilds
+        over the survivor mesh), and drop the in-flight tile — it was
+        dispatched against the dead shard's epoch, so its assignments
+        must never bind. Its pods requeue FIFO, the same immediate
+        no-backoff path as the commit-time health gate (PR 5), now at
+        shard granularity."""
+        from .device.shardfail import reshard_survivors
+        c = self.config
+        dead = c.shard_monitor.poll()
+        if not dead:
+            return
+        res = reshard_survivors(dead, c.shard_monitor, encoder=self._inc,
+                                engine=c.engine, metrics=c.metrics)
+        if res is None:
+            return  # every fence lost: the owners renewed after all
+        logger.warning("shard(s) %s expired: fenced (terms %s), "
+                       "re-sharded onto %d survivors, %d rows replayed",
+                       res.dead, res.fence_terms, res.survivors,
+                       res.replay_rows)
+        fl = self._prev
+        self._prev = None
+        if fl is not None:
+            try:
+                for pod in fl.pods:
+                    try:
+                        self._requeue(pod, f"shard-{res.dead[0]}",
+                                      "lease expired mid-tile")
+                    except Exception:
+                        logger.exception("requeue of %s failed",
+                                         pod.metadata.name)
+            finally:
+                fl.landed.set()
 
     def _target_alive(self, host: str) -> bool:
         """Is the bind target still a live node RIGHT NOW, per the node

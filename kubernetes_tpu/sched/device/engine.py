@@ -630,13 +630,24 @@ class _TableCache:
     the encoder INSTANCE (TableDelta.encoder_id): generations count one
     encoder's private timeline, so a same-shaped tile from a different
     encoder must miss — its low generations would otherwise read as
-    "nothing changed" against another encoder's rows."""
+    "nothing changed" against another encoder's rows.
 
-    __slots__ = ("sig", "src", "node", "state", "node_gen", "state_gen")
+    `epochs` pins the encoder's shard-epoch vector
+    (TableDelta.shard_epochs) the mirror was seeded under. A survivor
+    re-shard replaces that vector (the slot->shard block partition
+    moved), so a mirror seeded before it holds rows placed on the OLD
+    owners — possibly a dead device. Any vector difference misses and
+    reseeds, which IS the journal replay materialized: every row
+    re-journaled by the reshard lands on its new owner in one sharded
+    upload."""
 
-    def __init__(self, sig, src, node, state, node_gen, state_gen):
+    __slots__ = ("sig", "src", "epochs", "node", "state",
+                 "node_gen", "state_gen")
+
+    def __init__(self, sig, src, epochs, node, state, node_gen, state_gen):
         self.sig = sig
         self.src = src
+        self.epochs = epochs
         self.node = node
         self.state = state
         self.node_gen = node_gen
@@ -781,6 +792,19 @@ class BatchEngine:
     def n_shards(self) -> int:
         return 1 if self.mesh is None else self.mesh.devices.size
 
+    def reshard(self, mesh: Optional[Mesh]) -> None:
+        """Rebuild the engine over a survivor mesh after a shard owner
+        died. Every compiled program's in/out shardings named the old
+        mesh and the table mirror's rows live on its block partition
+        (including the dead device), so both drop; the next dispatch
+        recompiles against the new mesh and reseeds the mirror with one
+        full sharded upload — the journal replay landing every row on
+        its new owner."""
+        self.mesh = mesh
+        self._runs = {}
+        self._run = self._get_run(True, True)
+        self._table_cache = None
+
     def _ensure_safe_dtypes(self, enc: EncodeResult) -> EncodeResult:
         """The encoder narrows with a conservative default weight bound;
         an engine configured with larger policy weights must re-widen or
@@ -918,6 +942,7 @@ class BatchEngine:
         cache = self._table_cache
         if cache is not None and cache.sig == sig \
                 and cache.src == delta.encoder_id \
+                and cache.epochs == delta.shard_epochs \
                 and delta.full_gen <= min(cache.node_gen, cache.state_gen):
             moved = 0
             node_rows = np.nonzero(
@@ -950,6 +975,7 @@ class BatchEngine:
             node_dev = jax.device_put(node)
             state_dev = jax.device_put(state)
         self._table_cache = _TableCache(sig, delta.encoder_id,
+                                        delta.shard_epochs,
                                         node_dev, state_dev,
                                         delta.table_gen, delta.table_gen)
         self.upload_stats["full_tiles"] += 1

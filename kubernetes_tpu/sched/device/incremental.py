@@ -286,6 +286,15 @@ class IncrementalEncoder:
         self._node_dirty_gen = np.zeros(self.n_cap, np.int64)
         self._state_dirty_gen = np.zeros(self.n_cap, np.int64)
         self._full_dirty_gen = 0
+        # epoch-per-shard: one counter per mesh shard, stamped into
+        # every TableDelta. The slot->shard mapping is block sharding
+        # over stable slots, so an epoch moves ONLY when that mapping
+        # moves — reshard() (survivor re-shard after a shard owner
+        # dies) replaces the vector wholesale. The engine's table cache
+        # and the batch scheduler's in-flight fencing both compare the
+        # whole vector: a tile encoded against a dead shard's epoch can
+        # neither reuse the mirror nor commit its bindings.
+        self._shard_epochs: Tuple[int, ...] = (0,) * self.mesh_devices
         # instance token stamped into every TableDelta: generations from
         # two encoders are incomparable (see tables.TableDelta), and
         # id() can be recycled after gc — a process-wide counter cannot
@@ -883,8 +892,8 @@ class IncrementalEncoder:
 
     def _grow_nodes(self) -> None:
         self.state_epoch += 1
-        # growth is the ONE event that reshapes (and re-shards) the node
-        # axis: the device table cache invalidates wholesale
+        # growth reshapes (and re-shards) the node axis: the device
+        # table cache invalidates wholesale
         self._mark_full()
         # double while small, then step by 1024: a 5000-node cluster pads
         # to 5120 lanes (2% waste), not 8192 (64%) — every scan step pays
@@ -893,6 +902,11 @@ class IncrementalEncoder:
         # sharding over stable slots).
         new_cap = self.n_cap * 2 if self.n_cap < 1024 else self.n_cap + 1024
         new_cap = -(-new_cap // self.mesh_devices) * self.mesh_devices
+        self._grow_to(new_cap)
+
+    def _grow_to(self, new_cap: int) -> None:
+        """Caller holds the lock and has journaled the invalidation.
+        Widen every slot-axis array to `new_cap` lanes in place."""
         self._node_dirty_gen = _grow(self._node_dirty_gen, 0, new_cap)
         self._state_dirty_gen = _grow(self._state_dirty_gen, 0, new_cap)
         for attr in ("valid", "sched_ok", "cpu_cap", "mem_cap", "pod_cap",
@@ -912,6 +926,58 @@ class IncrementalEncoder:
         self.node_names.extend([""] * (new_cap - self.n_cap))
         self.node_labels.extend({} for _ in range(new_cap - self.n_cap))
         self.n_cap = new_cap
+
+    # ================================================ shard epoch / reshard
+
+    @property
+    def encoder_id(self) -> int:
+        """The instance token stamped into every TableDelta. Two
+        encoders' generations AND shard epochs are incomparable; any
+        cross-instance comparison must check this first."""
+        return self._encoder_id
+
+    def shard_epochs(self) -> Tuple[int, ...]:
+        """Current epoch vector (one entry per mesh shard). Compare to
+        a dispatched tile's TableDelta.shard_epochs to fence stale
+        in-flight work after a reshard (sched/batch.py _finalize)."""
+        with self._lock:
+            return self._shard_epochs
+
+    def reshard(self, survivors: int) -> int:
+        """Re-shard the stable slot->device mapping onto `survivors`
+        shards after a shard owner's lease expired.
+
+        The slot axis keeps its stable indices — no row moves WITHIN
+        the host truth — but the block partition over devices changes,
+        so every device-resident row is on the wrong owner: capacity
+        re-rounds to a multiple of the survivor count (growth only; the
+        rounded-up cap never shrinks below the occupied high-water
+        mark), every occupied slot re-journals at fresh generations,
+        full_gen advances (whole-mirror invalidation), state_epoch
+        bumps (no device carry survives the mesh change), and the epoch
+        vector is replaced — new length, every entry past the old
+        maximum, so ANY tile or mirror stamped with the old vector is
+        detectably stale. Returns the number of occupied slots the
+        journal replay rebuilds on the survivors (the caller feeds
+        shard_replay_rows_total)."""
+        survivors = max(1, int(survivors))
+        with self._lock:
+            self.state_epoch += 1
+            self._mark_full()
+            self.mesh_devices = survivors
+            new_cap = -(-self.n_cap // survivors) * survivors
+            if new_cap != self.n_cap:
+                self._grow_to(new_cap)
+            occupied = np.nonzero(self.valid)[0]
+            if occupied.size:
+                # re-journal every surviving row: the replay the new
+                # owners consume (TableDelta.replay_slots from the
+                # pre-failure full_gen returns exactly this set)
+                self._mark_node(occupied)
+                self._mark_state(occupied)
+            nxt = max(self._shard_epochs, default=0) + 1
+            self._shard_epochs = (nxt,) * survivors
+            return int(occupied.size)
 
     def _recompute_tie_rank(self) -> None:
         # rank over ALL known names: relative order among valid nodes is
@@ -1260,7 +1326,8 @@ class IncrementalEncoder:
                                node_dirty_gen=self._node_dirty_gen.copy(),
                                state_dirty_gen=self._state_dirty_gen.copy(),
                                full_gen=self._full_dirty_gen,
-                               encoder_id=self._encoder_id)
+                               encoder_id=self._encoder_id,
+                               shard_epochs=self._shard_epochs)
             return EncodeResult(
                 node_tab=nt, pod_batch=pb, init_state=st,
                 offgrid_max=offgrid_max,

@@ -209,12 +209,33 @@ class TableDelta:
     even at identical table shapes (each counts its own timeline), so
     the engine's cache must also match on identity — otherwise a fresh
     encoder's low generations would read as "nothing changed" against a
-    mirror holding another encoder's rows."""
+    mirror holding another encoder's rows.
+
+    `shard_epochs[s]` is the encoder's epoch for mesh shard s at encode
+    time. An epoch moves only when the slot->shard mapping moves — a
+    survivor re-shard after a shard owner's lease expires rewrites the
+    whole vector (length changes to the survivor count, every entry
+    bumps past the old maximum). A cached device mirror is only valid
+    for a delta carrying the SAME vector: any difference means the rows
+    it holds live on the wrong devices (or on a dead one), so the cache
+    must miss and reseed from host truth — the materialized journal
+    replay. Epochs are scoped to one encoder_id; across instances they
+    are incomparable, exactly like the generations."""
     table_gen: int
     node_dirty_gen: np.ndarray   # i64[n_cap]
     state_dirty_gen: np.ndarray  # i64[n_cap]
     full_gen: int
     encoder_id: int
+    shard_epochs: Tuple[int, ...] = (0,)
+
+    def replay_slots(self, from_gen: int) -> np.ndarray:
+        """Slots journaled on EITHER side since `from_gen` — the rows a
+        mirror current at that generation must replay to catch up. A
+        re-shard re-journals every occupied slot at fresh generations,
+        so replay_slots(pre-failure full_gen) is exactly the row set
+        rebuilt on the survivors (shard_replay_rows_total counts it)."""
+        return np.nonzero((self.node_dirty_gen > from_gen)
+                          | (self.state_dirty_gen > from_gen))[0]
 
 
 @dataclass

@@ -247,3 +247,28 @@ class LeaderElector:
                             c.identity, c.lease_name)
                         self._demote()
                         break
+
+
+def fence_lease(client, lease_name: str, identity: str,
+                namespace: str = "kube-system") -> int:
+    """One CAS takeover of a lease the caller has ALREADY judged
+    expired on its own monotonic clock: write `identity` as holder and
+    advance `lease_transitions` — the fencing term. The dead owner's
+    next renew (if it resurrects) carries a stale resourceVersion and
+    loses the CAS, so no action taken under the old term can land
+    after this returns. Returns the new term; raises Conflict when the
+    CAS loses (the holder renewed after all — NOT expired) and
+    NotFound when the lease never existed.
+
+    This is the reshard coordinator's half of the shard-lease protocol
+    (sched/device/shardfail.py): shard owners run ordinary
+    LeaderElectors, the coordinator fences a dead shard before
+    re-sharding its slots onto the survivors."""
+    lease = client.get("leases", lease_name, namespace)
+    wall = api.now_rfc3339()
+    updated = replace(lease, spec=replace(
+        lease.spec, holder_identity=identity, acquire_time=wall,
+        renew_time=wall,
+        lease_transitions=lease.spec.lease_transitions + 1))
+    out = client.update("leases", updated, namespace)
+    return out.spec.lease_transitions

@@ -334,6 +334,18 @@ DURABILITY_COUNTERS = (
     "lease_renew_failures_total",  # failed renew attempts (label: name)
 )
 
+#: Shard-failure counters (sched/device/shardfail.py): the shard-kill
+#: soak (kubemark/shard_soak.py) gates on these moving, so the names
+#: are pinned with the same no-drift contract as DURABILITY_COUNTERS.
+SHARD_COUNTERS = (
+    "shard_lease_transitions_total",  # dead-shard fencing takeovers
+                                      # (label: lease) — the CAS that
+                                      # advances lease_transitions
+    "shard_reshards_total",           # survivor re-shards applied
+    "shard_replay_rows_total",        # journaled rows replayed onto
+                                      # survivors across all reshards
+)
+
 #: Pod-lifecycle stage model (the obs tracing layer): every span that
 #: carries a stage tag lands one observation in this summary, so
 #: render() exposes the spans-derived decomposition under ONE stable

@@ -3,21 +3,59 @@ processes joined by jax.distributed (gloo collectives on CPU — the
 same jax.distributed + Mesh code path multi-host TPU pods use, with
 ICI/DCN as the transport). Complements dryrun_multichip's
 single-process virtual mesh: here the argmax genuinely reduces across
-process boundaries and bindings must stay bit-equal."""
+process boundaries and bindings must stay bit-equal.
 
+The --fail-shard half (marked slow) is the DCN-shape end of the
+shard-failure gate: a wedged worker — a dead host — must be detected
+by the launcher's bounded join and the whole set reaped, a relaunch at
+the surviving process shape must pass binding parity, and the
+in-process shard-kill soak's verdicts ride along (the single-process
+gates live in test_shard_failure.py)."""
+
+import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.multihost
+
+
+def _dryrun(*extra, timeout):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "dryrun_multihost.py"), *extra],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO})
 
 
 def test_two_process_mesh_binding_parity():
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools",
-                                      "dryrun_multihost.py"),
-         "--procs", "2"],
-        capture_output=True, text=True, timeout=360, cwd=REPO,
-        env={**os.environ, "PYTHONPATH": REPO})
+    out = _dryrun("--procs", "2", timeout=360)
     assert out.returncode == 0, out.stderr[-2000:]
     assert '"multihost_dryrun_ok": true' in out.stdout, out.stdout
+
+
+@pytest.mark.slow
+def test_shard_failure_gate_wedge_reap_and_survivor_parity():
+    """--fail-shard: wedge detection + reap, survivor-shape relaunch
+    parity, and the embedded soak's verdicts — the fields bench.py
+    publishes into MULTIHOST.json."""
+    out = _dryrun("--procs", "3", "--fail-shard", timeout=600)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    doc = json.loads(out.stdout.splitlines()[-1])
+    assert doc["multihost_dryrun_ok"] is True
+    gate = doc["shard_failure"]
+    assert gate["gate_ok"] is True
+    assert gate["wedge"]["detected"] is True
+    assert gate["wedge"]["survivors_reaped"] is True
+    assert gate["wedge"]["launcher_exit_nonzero"] is True
+    assert gate["survivor_shape"]["processes"] == 2
+    assert gate["survivor_shape"]["parity_ok"] is True
+    soak = gate["soak"]
+    assert soak["converged"] is True
+    assert soak["parity_ok"] is True
+    assert soak["duplicate_bindings"] == 0
+    assert soak["stale_epoch_bindings"] == 0

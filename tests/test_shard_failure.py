@@ -1,0 +1,376 @@
+"""Shard-failure tolerance gates (the ISSUE-19 tentpole).
+
+Every layer of the recovery protocol gets its own fast tier-1 gate on
+the virtual mesh (conftest forces 8 CPU devices): the seeded
+ShardKillPlan's one-draw determinism contract, the lease
+expiry/fence/term machinery over the in-proc apiserver under a
+FakeClock, the encoder's epoch-per-shard re-journal (TableDelta
+journal replay), the engine cache's epoch fence, the detach()/
+successor epoch-incomparability rule (extending PR-15's
+test_table_cache_misses_across_encoder_instances), and the full
+shard-kill soak with its bit-exact survivor parity gate. The
+multi-process half (wedged-host detection, survivor-shape relaunch)
+lives in test_multihost.py marked slow.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.client import InProcClient
+from kubernetes_tpu.api.registry import Registry
+from kubernetes_tpu.chaos.crash import ShardKillChaos, ShardKillPlan
+from kubernetes_tpu.core import types as api
+from kubernetes_tpu.core.quantity import Quantity
+from kubernetes_tpu.kubemark.shard_soak import run_shard_kill_soak
+from kubernetes_tpu.sched.device import BatchEngine
+from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+from kubernetes_tpu.sched.device.shardfail import (ShardLeaseMonitor,
+                                                   ShardLeaseSet,
+                                                   reshard_survivors,
+                                                   shard_lease_name,
+                                                   survivor_mesh)
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.metrics import SHARD_COUNTERS, MetricsRegistry
+
+pytestmark = pytest.mark.multihost
+
+MI = 1024 * 1024
+
+
+def mk_node(name, cpu=4000, mem=1024):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        status=api.NodeStatus(
+            capacity={"cpu": Quantity(cpu),
+                      "memory": Quantity(mem * MI * 1000),
+                      "pods": Quantity(110 * 1000)},
+            conditions=[api.NodeCondition(type=api.NODE_READY,
+                                          status=api.CONDITION_TRUE)]))
+
+
+def mk_pod(name, cpu=100):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(
+                requests={"cpu": Quantity(cpu),
+                          "memory": Quantity(64 * MI * 1000)}))]),
+        status=api.PodStatus(phase="Pending"))
+
+
+# ---------------------------------------------------------------- plan
+
+
+def test_shard_kill_plan_one_draw_contract():
+    """Each shard's stream is drawn from exactly ONCE: victimhood and
+    kill point are both pure functions of that single uniform, so any
+    consumer replaying the stream sees the same fate."""
+    plan = ShardKillPlan(seed=7, n_shards=6, kills=2)
+    for s in range(6):
+        d = plan.draw(s)
+        assert d == plan.draw(s)                 # fresh stream per call
+        assert d == plan.stream(s).random()      # and it IS the stream's
+        lo, hi = plan.window
+        assert plan.fraction(s) == lo + d * (hi - lo)
+    assert plan.victims() == \
+        ShardKillPlan(seed=7, n_shards=6, kills=2).victims()
+    assert plan.schedule(100) == plan.schedule(100)
+    for s, p in plan.schedule(100).items():
+        assert 0 < p < 100, (s, p)              # observably mid-run
+    # the whole mesh can never die: kills clamps to n_shards - 1
+    assert len(ShardKillPlan(seed=1, n_shards=4, kills=9).victims()) == 3
+    assert ShardKillPlan(seed=1, n_shards=4, kills=0).victims() == ()
+
+
+def test_shard_kill_plan_seed_sensitivity():
+    picks = {ShardKillPlan(seed=s, n_shards=8, kills=1).victims()
+             for s in range(16)}
+    assert len(picks) > 1, "victim selection ignores the seed"
+
+
+def test_shard_kill_chaos_trace_is_pure_replay():
+    plan = ShardKillPlan(seed=3, n_shards=4, kills=2)
+    chaos = ShardKillChaos(plan, total=40)
+    fired = []
+    while chaos.pending():
+        point, shard = chaos.pending()[0]
+        chaos.record(shard, point)
+        fired.append((point, shard))
+    assert chaos.trace() == plan.schedule(40)
+    assert fired == plan.order(40)
+    assert not chaos.pending()
+
+
+# -------------------------------------------------------------- leases
+
+
+def test_shard_lease_expiry_fence_and_resurrection_loses():
+    """A killed owner's lease record freezes; the monitor's observation
+    clock ages it to expiry (no survivor ever expires because their rv
+    keeps moving); the fence CAS advances lease_transitions — and the
+    resurrecting owner, seeing a moved record, cannot retake the
+    shard."""
+    clock = FakeClock()
+    client = InProcClient(Registry())
+    metrics = MetricsRegistry()
+    leases = ShardLeaseSet(client, 3, clock=clock, lease_duration=3.0,
+                           renew_deadline=2.0, retry_period=1.0,
+                           metrics=metrics)
+    assert leases.acquire_all()
+    monitor = ShardLeaseMonitor(client, leases.lease_names(),
+                                clock=clock, lease_duration=3.0,
+                                metrics=metrics)
+    assert monitor.poll() == []
+
+    leases.kill(1)
+    dead = []
+    for _ in range(5):
+        leases.renew(skip=[1])
+        clock.step(1.0)
+        dead = monitor.poll()
+        if dead:
+            break
+    assert dead == [1], "only the killed shard may expire"
+
+    base = monitor.term(1)
+    term = monitor.fence(1)
+    assert term == base + 1, "fence must advance the transitions term"
+    assert metrics.counter(
+        "shard_lease_transitions_total",
+        {"lease": shard_lease_name(1)}) == 1.0
+
+    # the zombie wakes up: its renew observes a MOVED record held by
+    # the coordinator and loses — nothing it does lands under the old
+    # term (the fencing-token property)
+    assert leases.electors[1].try_acquire_or_renew() is False
+
+    monitor.retire([1])
+    assert monitor.n_shards == 2
+    assert monitor.poll() == [], "survivors stay live after retire"
+
+
+def test_fence_on_missing_lease_returns_none():
+    clock = FakeClock()
+    client = InProcClient(Registry())
+    monitor = ShardLeaseMonitor(client, ["mesh-shard-0"], clock=clock,
+                                lease_duration=3.0,
+                                metrics=MetricsRegistry())
+    assert monitor.fence(0) is None
+
+
+# ------------------------------------------------------------- reshard
+
+
+def test_reshard_rejournals_every_occupied_slot():
+    """IncrementalEncoder.reshard(): capacity re-rounds to a survivor
+    multiple, every occupied slot re-journals past the pre-failure
+    generation (TableDelta.replay_slots is exactly that row set), and
+    the epoch vector is replaced wholesale — survivor-count length,
+    every entry past the old maximum."""
+    inc = IncrementalEncoder(node_capacity=8, mesh_devices=4)
+    for i in range(8):
+        inc.on_node_add(mk_node(f"n-{i}"))
+    pods = [mk_pod(f"p-{j}") for j in range(4)]
+    enc = inc.encode_tile(pods, [], [])
+    inc.assume_assigned(
+        enc, pods, np.asarray(BatchEngine().run_chunked(enc, 8)[0]))
+    pre = inc.encode_tile([], [], [])
+    pre_gen = pre.delta.table_gen
+    old_epochs = inc.shard_epochs()
+    assert len(old_epochs) == 4
+
+    replayed = inc.reshard(3)
+    assert replayed == 8, "every occupied slot re-journals"
+    assert inc.mesh_devices == 3
+    assert inc.n_cap % 3 == 0
+    epochs = inc.shard_epochs()
+    assert len(epochs) == 3
+    assert min(epochs) > max(old_epochs), \
+        "new epochs must be unambiguously past every old one"
+
+    post = inc.encode_tile([], [], [])
+    assert post.delta.shard_epochs == epochs
+    slots = post.delta.replay_slots(pre_gen)
+    assert set(slots.tolist()) >= set(range(8)), \
+        "journal replay from the pre-failure generation misses rows"
+
+
+def test_survivor_mesh_preserves_device_order():
+    import jax
+    from jax.sharding import Mesh
+    devs = list(jax.devices())[:4]
+    mesh = Mesh(np.array(devs), ("nodes",))
+    sm = survivor_mesh(mesh, [1])
+    assert list(sm.devices.reshape(-1)) == [devs[0], devs[2], devs[3]]
+    assert survivor_mesh(mesh, [0, 1, 2, 3]) is None
+
+
+def test_reshard_survivors_end_to_end_over_leases():
+    """The coordinator path: expired shard -> fence -> encoder
+    re-journal -> engine rebuild -> monitor retire, with the pinned
+    counters moving."""
+    import jax
+    from jax.sharding import Mesh
+    clock = FakeClock()
+    client = InProcClient(Registry())
+    metrics = MetricsRegistry()
+    n = 4
+    leases = ShardLeaseSet(client, n, clock=clock, lease_duration=3.0,
+                           renew_deadline=2.0, retry_period=1.0,
+                           metrics=metrics)
+    assert leases.acquire_all()
+    monitor = ShardLeaseMonitor(client, leases.lease_names(),
+                                clock=clock, lease_duration=3.0,
+                                metrics=metrics)
+    monitor.poll()
+
+    inc = IncrementalEncoder(node_capacity=8, mesh_devices=n)
+    for i in range(8):
+        inc.on_node_add(mk_node(f"n-{i}"))
+    devs = list(jax.devices())[:n]
+    engine = BatchEngine(mesh=Mesh(np.array(devs), ("nodes",)))
+
+    leases.kill(2)
+    dead = []
+    for _ in range(5):
+        leases.renew(skip=[2])
+        clock.step(1.0)
+        dead = monitor.poll()
+        if dead:
+            break
+    assert dead == [2]
+
+    res = reshard_survivors(dead, monitor, encoder=inc, engine=engine,
+                            metrics=metrics)
+    assert res is not None
+    assert res.dead == (2,)
+    assert res.survivors == 3
+    assert res.replay_rows == 8
+    assert res.shard_epochs == inc.shard_epochs()
+    assert engine.mesh is not None and engine.mesh.devices.size == 3
+    assert monitor.n_shards == 3
+    assert metrics.counter("shard_reshards_total") == 1.0
+    assert metrics.counter("shard_replay_rows_total") == 8.0
+
+    # the survivor mesh schedules: the replayed journal reseeds the
+    # mirror with one full sharded upload on the next dispatch
+    pods = [mk_pod(f"p-{j}") for j in range(4)]
+    enc = inc.encode_tile(pods, [], [], pad_to=4)
+    assigned, _ = engine.run_chunked(enc, 4)
+    assert int((np.asarray(assigned)[:4] >= 0).sum()) == 4
+    assert engine.upload_stats["full_tiles"] >= 1
+
+
+def test_shard_counters_pinned():
+    assert SHARD_COUNTERS == ("shard_lease_transitions_total",
+                              "shard_reshards_total",
+                              "shard_replay_rows_total")
+
+
+# --------------------------------------------- epoch fence (satellite 3)
+
+
+def test_table_cache_misses_after_reshard_same_encoder():
+    """Same encoder instance, epoch vector replaced by reshard(): a
+    same-shaped tile must MISS the engine's device mirror and reseed
+    via a full upload — the cached rows live on the wrong shards."""
+    inc = IncrementalEncoder(node_capacity=16, mesh_devices=1)
+    for i in range(16):
+        inc.on_node_add(mk_node(f"n-{i:03d}"))
+    engine = BatchEngine()
+    pods = [mk_pod(f"p-{j}") for j in range(8)]
+    enc1 = inc.encode_tile(pods, [], [])
+    engine.run_chunked(enc1, 8)
+    full_before = engine.upload_stats["full_tiles"]
+
+    inc.reshard(1)  # same shard count: ONLY the epochs move
+    enc2 = inc.encode_tile(pods, [], [])
+    assert enc2.delta.shard_epochs != enc1.delta.shard_epochs
+    a2, _ = engine.run_chunked(enc2, 8)
+    assert engine.upload_stats["full_tiles"] > full_before, \
+        "stale-epoch mirror was reused instead of reseeding"
+    ref, _ = BatchEngine().run_chunked(enc2, 8)
+    assert np.array_equal(np.asarray(a2), np.asarray(ref))
+
+
+def test_detached_encoder_epochs_incomparable_to_successor():
+    """The PR-15 encoder_id gate extended to epochs: a failover
+    successor starts at the same numeric epoch vector as its detached
+    predecessor, and that equality must mean NOTHING — the engine cache
+    keys on (encoder_id, epochs), so the successor's first tile misses
+    the predecessor's mirror; and the batch fence's encoder_id guard
+    means a predecessor tile is never dropped against the successor's
+    vector (those tiles keep bind-then-reconcile semantics)."""
+    def fresh():
+        inc = IncrementalEncoder(node_capacity=16, mesh_devices=1)
+        for i in range(16):
+            inc.on_node_add(mk_node(f"n-{i:03d}"))
+        return inc
+
+    engine = BatchEngine()
+    pods = [mk_pod(f"p-{j}") for j in range(8)]
+
+    inc_a = fresh()
+    enc_a = inc_a.encode_tile(pods, [], [])
+    a_first, _ = engine.run_chunked(enc_a, 8)
+    inc_a.assume_assigned(enc_a, pods, np.asarray(a_first))
+    engine.run_chunked(inc_a.encode_tile(pods, [], []), 8)
+    inc_a.detach()
+
+    inc_b = fresh()
+    # numerically EQUAL vectors, different instances
+    assert inc_a.shard_epochs() == inc_b.shard_epochs()
+    assert enc_a.delta.shard_epochs == inc_b.shard_epochs()
+    assert enc_a.delta.encoder_id != inc_b.encoder_id
+
+    # engine side: B's tile must not read A's mirror as current
+    enc_b = inc_b.encode_tile(pods, [], [])
+    a_b, _ = engine.run_chunked(enc_b, 8)
+    ref, _ = BatchEngine().run_chunked(enc_b, 8)
+    assert np.array_equal(np.asarray(a_b), np.asarray(ref)), \
+        "successor's tile ran against the detached encoder's mirror"
+
+    # batch-fence side: the exact predicate sched/batch.py _finalize
+    # applies. A predecessor tile against the successor: encoder_id
+    # differs -> NOT fenced (incomparable, not stale). The successor's
+    # own pre-reshard tile after reshard(): same id, moved vector ->
+    # fenced.
+    def fenced(delta, live):
+        return (delta.encoder_id == live.encoder_id
+                and live.shard_epochs() != delta.shard_epochs)
+
+    assert not fenced(enc_a.delta, inc_b)
+    inc_b.reshard(1)
+    assert fenced(enc_b.delta, inc_b)
+    assert not fenced(enc_a.delta, inc_b)
+
+
+# ---------------------------------------------------------------- soak
+
+
+def test_shard_kill_soak_converges(tmp_path):
+    """The full acceptance soak at the tier-1 shape: seeded kill
+    mid-tile, lease expiry on the FakeClock, fence, survivor re-shard,
+    journal replay, epoch-fenced drop + head-of-line requeue, and
+    bit-exact parity with an unfailed run of the surviving shape."""
+    metrics = MetricsRegistry()
+    res = run_shard_kill_soak(flight_dir=str(tmp_path), metrics=metrics)
+    assert res.converged, res.as_dict()
+    assert res.schedule_replayed
+    assert res.lease_expiry_detected
+    assert res.fence_terms and all(t >= 2 for t in res.fence_terms)
+    assert res.survivors == res.n_shards - len(res.victims)
+    assert res.journal_replayed
+    assert res.replay_rows == res.n_nodes
+    assert res.stale_epoch_drops >= 1, "the kill never landed mid-tile"
+    assert res.stale_epoch_bindings == 0
+    assert res.duplicate_bindings == 0
+    assert res.bound == res.n_pods
+    assert res.parity_ok
+    assert res.flight_bundle == "", "no gate violation, no bundle"
+    # the pinned counters moved exactly once / exactly replay_rows
+    assert metrics.counter("shard_reshards_total") == 1.0
+    assert metrics.counter("shard_replay_rows_total") == res.replay_rows
+    assert metrics.counter_sum("shard_lease_transitions_total") == \
+        len(res.victims)

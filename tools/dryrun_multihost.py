@@ -11,14 +11,26 @@ jax.distributed + Mesh code path is identical, only the transport
 differs). Bindings are asserted bit-equal to a single-process run of
 the same encode.
 
+The launcher's join is BOUNDED (a wedged worker can no longer hang it
+forever): one overall deadline covers the whole worker set, the first
+worker failure kills every survivor immediately, and any failure path
+exits nonzero.
+
+--fail-shard adds the shard-failure gate (ISSUE 19): a deliberately
+wedged worker must be detected within the deadline and the whole set
+reaped; a relaunch at the SURVIVING process shape must pass binding
+parity (the survivor-restart story of a real pod losing a host); and
+the in-process shard-kill soak (kubemark/shard_soak.py — lease expiry,
+fence, survivor re-shard, journal replay, epoch-fenced in-flight drop)
+runs in a subprocess with its verdicts embedded. MULTIHOST.json then
+carries the failure-gate fields; bench.py regenerates it every round.
+
 Launcher:  python tools/dryrun_multihost.py [--procs 4]
                [--devices-per-proc 2] [--out MULTIHOST.json]
+               [--fail-shard]
 Worker:    python tools/dryrun_multihost.py --worker <id> --procs N \
-               --port P   (spawned by the launcher)
-
-The launcher writes MULTIHOST.json so the DCN-path proof is a standing
-per-round artifact (bench.py regenerates it every round), not a
-one-time capture.
+               --port P   (spawned by the launcher; --wedge hangs it,
+               emulating a dead host for the detection gate)
 """
 
 import argparse
@@ -26,15 +38,27 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEVICES_PER_PROC = 2
+#: overall worker-set deadline for a NORMAL run (compile + collectives
+#: on a loaded box), and the much shorter one for the wedge-detection
+#: gate (nothing useful can happen once a worker is wedged)
+JOIN_DEADLINE = 300.0
+WEDGE_DEADLINE = 30.0
 
 
 def worker(proc_id: int, nprocs: int, port: int,
-           devices_per_proc: int = DEVICES_PER_PROC) -> None:
+           devices_per_proc: int = DEVICES_PER_PROC,
+           wedge: bool = False) -> None:
+    if wedge:
+        # a dead host: never joins the collective, never exits — the
+        # launcher's bounded join must detect and reap the whole set
+        while True:
+            time.sleep(60)
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = \
         f"--xla_force_host_platform_device_count={devices_per_proc}"
@@ -88,48 +112,155 @@ def worker(proc_id: int, nprocs: int, port: int,
           f"{json.dumps(assigned.tolist())}", flush=True)
 
 
-def launch(nprocs: int, devices_per_proc: int = DEVICES_PER_PROC,
-           out_path: str = "") -> int:
+def _spawn_workers(nprocs: int, devices_per_proc: int,
+                   wedge_worker: int = -1) -> list:
     import socket
-    import time
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--worker",
-             str(i), "--procs", str(nprocs), "--port", str(port),
-             "--devices-per-proc", str(devices_per_proc)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
-        for i in range(nprocs)]
-    outs = []
-    ok = True
-    for i, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
+    procs = []
+    for i in range(nprocs):
+        argv = [sys.executable, os.path.abspath(__file__), "--worker",
+                str(i), "--procs", str(nprocs), "--port", str(port),
+                "--devices-per-proc", str(devices_per_proc)]
+        if i == wedge_worker:
+            argv.append("--wedge")
+        procs.append(subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO}))
+    return procs
+
+
+def _reap(procs: list) -> None:
+    for p in procs:
+        if p.poll() is None:
             p.kill()
-            out, err = p.communicate()
-            ok = False
+
+
+def _join_bounded(procs: list, deadline: float):
+    """Join the whole worker set under ONE deadline. The first worker
+    that exits NONZERO kills every survivor on the spot (they are
+    blocked in a collective their peer will never join); hitting the
+    deadline kills the whole set. Returns (outs, errs, ok, timed_out)
+    — outs/errs always fully collected post-kill, never blocking."""
+    t0 = time.monotonic()
+    ok = True
+    timed_out = False
+    live = list(range(len(procs)))
+    while live:
+        if time.monotonic() - t0 >= deadline:
+            timed_out = ok = False
+            _reap(procs)
+            break
+        for i in list(live):
+            rc = procs[i].poll()
+            if rc is None:
+                continue
+            live.remove(i)
+            if rc != 0:
+                # one dead worker wedges the rest mid-collective:
+                # reap them now instead of waiting out the deadline
+                ok = False
+                _reap(procs)
+        time.sleep(0.05)
+    outs, errs = [], []
+    for p in procs:
+        out, err = p.communicate()
         outs.append(out)
-        if p.returncode != 0 or f"WORKER-{i}-PARITY-OK" not in out:
+        errs.append(err)
+        if p.returncode != 0:
             ok = False
-            print(f"worker {i} rc={p.returncode}\n{err[-2000:]}",
+    return outs, errs, ok, timed_out
+
+
+def _parity_run(nprocs: int, devices_per_proc: int,
+                deadline: float = JOIN_DEADLINE) -> dict:
+    """One full worker-set run; every process must report parity and
+    agree on the bindings (the scan's argmax reduced across processes —
+    divergence means a broken collective)."""
+    procs = _spawn_workers(nprocs, devices_per_proc)
+    outs, errs, ok, timed_out = _join_bounded(procs, deadline)
+    for i, p in enumerate(procs):
+        if p.returncode != 0 or f"WORKER-{i}-PARITY-OK" not in outs[i]:
+            ok = False
+            print(f"worker {i} rc={p.returncode}\n{errs[i][-2000:]}",
                   file=sys.stderr)
-    # every process must agree on the bindings (the scan's argmax
-    # reduced across processes — divergence means a broken collective)
     lines = [line for out in outs for line in out.splitlines()
              if "PARITY-OK" in line]
     payloads = {line.split(" ", 1)[1] for line in lines}
     if len(payloads) != 1:
         ok = False
         print(f"processes disagree: {payloads}", file=sys.stderr)
+    return {"ok": ok, "timed_out": timed_out,
+            "bindings_agree_across_processes": len(payloads) == 1,
+            "processes": nprocs}
+
+
+def _wedge_gate(nprocs: int, devices_per_proc: int) -> dict:
+    """Kill-detection gate: worker nprocs-1 wedges (a dead host), the
+    rest block in jax.distributed waiting for it. The bounded join
+    must detect the hang within WEDGE_DEADLINE and reap the whole set."""
+    t0 = time.monotonic()
+    procs = _spawn_workers(nprocs, devices_per_proc,
+                           wedge_worker=nprocs - 1)
+    _outs, _errs, ok, timed_out = _join_bounded(procs, WEDGE_DEADLINE)
+    reaped = all(p.poll() is not None for p in procs)
+    return {"wedged_worker": nprocs - 1,
+            "detected": (not ok),
+            "detected_within_s": round(time.monotonic() - t0, 1),
+            "deadline_s": WEDGE_DEADLINE,
+            "survivors_reaped": reaped,
+            "launcher_exit_nonzero": not ok}
+
+
+def _embedded_soak() -> dict:
+    """The in-process shard-kill soak (virtual 8-device mesh, FakeClock
+    lease expiry) in a subprocess with a controlled device env; its
+    verdicts are the lease/epoch/replay half of the failure gate."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.kubemark.shard_soak"],
+            capture_output=True, text=True, timeout=JOIN_DEADLINE,
+            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"converged": False, "detail": proc.stderr[-500:]}
+    except Exception as e:
+        return {"converged": False, "detail": str(e)[:500]}
+
+
+def launch(nprocs: int, devices_per_proc: int = DEVICES_PER_PROC,
+           out_path: str = "", fail_shard: bool = False) -> int:
+    run = _parity_run(nprocs, devices_per_proc)
+    ok = run["ok"]
     doc = {"multihost_dryrun_ok": ok, "processes": nprocs,
            "devices_per_proc": devices_per_proc,
            "global_devices": nprocs * devices_per_proc,
-           "bindings_agree_across_processes": len(payloads) == 1,
+           "bindings_agree_across_processes":
+               run["bindings_agree_across_processes"],
            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    if fail_shard:
+        wedge = _wedge_gate(nprocs, devices_per_proc)
+        # a host died: the launcher relaunches at the surviving shape —
+        # the mesh-size-invariance parity inside each worker is exactly
+        # the re-shard parity claim at DCN scale
+        survivor = _parity_run(max(1, nprocs - 1), devices_per_proc)
+        soak = _embedded_soak()
+        gate_ok = (wedge["detected"] and wedge["survivors_reaped"]
+                   and survivor["ok"]
+                   and bool(soak.get("converged")))
+        doc["shard_failure"] = {
+            "gate_ok": gate_ok,
+            "wedge": wedge,
+            "survivor_shape": {
+                "processes": survivor["processes"],
+                "parity_ok": survivor["ok"],
+                "bindings_agree_across_processes":
+                    survivor["bindings_agree_across_processes"]},
+            "soak": soak}
+        ok = doc["multihost_dryrun_ok"] = ok and gate_ok
     if out_path:
         from kubernetes_tpu.kubemark.tpu_evidence import _atomic_write_json
         _atomic_write_json(out_path, doc)
@@ -145,13 +276,18 @@ def main() -> int:
     ap.add_argument("--devices-per-proc", type=int,
                     default=DEVICES_PER_PROC)
     ap.add_argument("--out", default="")
+    ap.add_argument("--wedge", action="store_true")
+    ap.add_argument("--fail-shard", action="store_true")
     args = ap.parse_args()
     if args.worker is not None:
         worker(args.worker, args.procs, args.port,
-               args.devices_per_proc)
+               args.devices_per_proc, wedge=args.wedge)
         return 0
-    return launch(args.procs, args.devices_per_proc, args.out)
+    return launch(args.procs, args.devices_per_proc, args.out,
+                  fail_shard=args.fail_shard)
 
 
 if __name__ == "__main__":
-    main()
+    # the satellite-1 contract: any failure path exits NONZERO (the old
+    # entry dropped main()'s status on the floor)
+    raise SystemExit(main())

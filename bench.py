@@ -469,6 +469,19 @@ def main():
                          "'fast' = 12 ticks on a small fleet (the "
                          "tier-1 gate's shape), 'day' = 48 ticks on "
                          "a 1k-node fleet (the slow gate's shape)")
+    ap.add_argument("--flash-drain", action="store_true",
+                    help="run the flash-crowd drain soak (ISSUE 20): "
+                         "low-priority batch fills saturate a small "
+                         "fleet, then a high-priority surge lands and "
+                         "must preempt them — under 5%% API faults + "
+                         "a 10%% node kill; records the preemption "
+                         "section (surge bind p50/p99, victims, the "
+                         "post-hoc wrongful-eviction audit and the "
+                         "replayable surge TRIP/CLEAR timeline)")
+    ap.add_argument("--flash-drain-seed", type=int, default=3,
+                    help="seed for the --flash-drain arm (plan, "
+                         "faults, kill set and preemption backoff "
+                         "jitter all derive from it)")
     ap.add_argument("--timeseries", action="store_true",
                     help="run the metrics-plane arm: the fast workload "
                          "soak with the deterministic FleetScraper + "
@@ -817,6 +830,26 @@ def main():
                   f"lag={wr.hpa_max_lag_ticks} ticks "
                   f"phases={[p['binds'] for p in wr.phases]}",
                   file=sys.stderr)
+    preemption = None
+    if args.flash_drain:
+        # the priority/preemption arm (ISSUE 20): the exact invariants
+        # tests/test_preemption.py's soak gate enforces — zero wrongful
+        # evictions (oracle-audited), zero duplicate bindings, every
+        # surge pod bound under the fast-bind limit — recorded so the
+        # artifact carries the drain story end to end
+        from kubernetes_tpu.kubemark.workload_soak import \
+            run_flash_drain_soak
+        fd = run_flash_drain_soak(seed=args.flash_drain_seed)
+        preemption = fd.as_dict()
+        if args.verbose:
+            edges = [(a["sample"], a["action"]) for a in fd.alerts
+                     if a["slo"] == "surge-bind-availability"]
+            print(f"# preemption[seed={args.flash_drain_seed}] "
+                  f"surge {fd.surge_bound}/{fd.surge_pods} bound "
+                  f"p99={fd.surge_bind_p99_s}s "
+                  f"victims={fd.victims_evicted} "
+                  f"wrongful={fd.wrongful_evictions} alerts={edges}",
+                  file=sys.stderr)
     metricsplane = None
     if args.timeseries:
         # the metrics-plane arm (ISSUE 14): one fast trace replay with
@@ -1039,6 +1072,7 @@ def main():
         "durability": durability,
         "workload": workload,
         "metricsplane": metricsplane,
+        "preemption": preemption,
         "serving": serving,
         "multichip": multichip,
         "multihost": multihost,

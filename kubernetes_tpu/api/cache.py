@@ -89,7 +89,14 @@ class ObjectCache:
 class FIFO:
     """Coalescing object queue; Pop blocks (ref: fifo.go). Replace/add/update
     key by ns/name; a popped object is gone (no processing set — matches the
-    reference FIFO, not DeltaFIFO)."""
+    reference FIFO, not DeltaFIFO).
+
+    Pop order is priority-then-FIFO: objects carrying `spec.priority`
+    (pods) pop highest-priority first, insertion order within a
+    priority — the scheduler's pending queue must hand a preempting pod
+    the capacity its evictions freed before any lower-priority backlog
+    can steal it (the reference's priority scheduling queue; objects
+    without the field all rank 0, which degenerates to plain FIFO)."""
 
     def __init__(self) -> None:
         self._cond = threading.Condition()
@@ -126,17 +133,36 @@ class FIFO:
             # key stays in deque; pop skips dead keys (add() may re-queue the
             # same key later — pop's items-membership check dedupes)
 
+    @staticmethod
+    def _priority_of(obj: Any) -> int:
+        spec = getattr(obj, "spec", None)
+        return getattr(spec, "priority", 0) or 0
+
     def pop(self, timeout: Optional[float] = None) -> Optional[Any]:
         with self._cond:
             while True:
+                # one sweep: compact dead keys out of the deque and pick
+                # the highest-priority live key (first-seen wins a tie,
+                # so an all-default queue pops in insertion order)
+                best_key = None
+                best_prio = 0
+                live: deque = deque()
                 while self._queue:
                     key = self._queue.popleft()
-                    if key in self._items:
-                        stamp = self._stamps.pop(key, None)
-                        self.last_pop_wait = (
-                            time.monotonic() - stamp
-                            if stamp is not None else 0.0)
-                        return self._items.pop(key)
+                    if key not in self._items:
+                        continue  # deleted while queued
+                    live.append(key)
+                    prio = self._priority_of(self._items[key])
+                    if best_key is None or prio > best_prio:
+                        best_key, best_prio = key, prio
+                self._queue = live
+                if best_key is not None:
+                    self._queue.remove(best_key)
+                    stamp = self._stamps.pop(best_key, None)
+                    self.last_pop_wait = (
+                        time.monotonic() - stamp
+                        if stamp is not None else 0.0)
+                    return self._items.pop(best_key)
                 if self._closed:
                     return None
                 if not self._cond.wait(timeout):

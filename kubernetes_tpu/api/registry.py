@@ -121,6 +121,13 @@ def validate_pod(pod: api.Pod) -> None:
         if v.name in vol_names:
             raise Invalid("spec.volumes[].name: duplicate volume name")
         vol_names.add(v.name)
+    # priority is a flat integer (DIVERGENCES #35); bound |p| <= 1e9 so
+    # the device's composite victim score stays exact in int64
+    prio = pod.spec.priority
+    if type(prio) is not int:
+        raise Invalid("spec.priority: must be an integer")
+    if abs(prio) > 1_000_000_000:
+        raise Invalid("spec.priority: must satisfy |priority| <= 1e9")
 
 
 def validate_node(node: api.Node) -> None:

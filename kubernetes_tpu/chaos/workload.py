@@ -19,7 +19,7 @@ compression factor. Wall timing — how long the apiserver takes, where
 the GIL slices land — is explicitly outside the contract, exactly like
 NodeFaultPlan's flap-toggle phase (see DIVERGENCES.md).
 
-The five generators model the heterogeneous-workload regime Gavel
+The six generators model the heterogeneous-workload regime Gavel
 (PAPERS.md) argues schedulers must be evaluated under:
 
   diurnal   a sinusoid of per-Deployment demand (user traffic) that
@@ -32,6 +32,11 @@ The five generators model the heterogeneous-workload regime Gavel
   rollout   Deployment template bumps (hash-based rolling update) and
             DaemonSet retargeting steps
   churn     Service create/delete churn against a fixed name pool
+  drain     low-priority batch fill waves that saturate the fleet,
+            then ONE high-priority surge (drawn tick in the second
+            half of the day) — the flash-crowd drain scenario the
+            preemption soak gates on (surge pods bind by evicting
+            fill pods; sched/preemption.py)
 
 Reference: the reference grows this as test/e2e's load/density
 generators (RunRC + load.go's traffic shapes); v1.1 has no equivalent
@@ -49,7 +54,7 @@ from ..utils.clock import REAL, Clock
 
 #: generator evaluation order inside one tick (ties in the merged
 #: stream break by this order, deterministically)
-GENERATORS = ("diurnal", "burst", "jobwave", "rollout", "churn")
+GENERATORS = ("diurnal", "burst", "jobwave", "rollout", "churn", "drain")
 
 
 @dataclass(frozen=True)
@@ -94,6 +99,21 @@ class WorkloadPlan:
     # ---- churn: Service create/delete against a fixed pool
     churn_rate: float = 0.5
     service_pool: int = 6
+    # ---- drain: low-priority batch fill waves + one high-priority
+    # surge. Defaults are crowd-pod sized (10m/16Mi) so the generator
+    # rides along in every workload soak without saturating anything;
+    # the flash-drain soak passes fleet-saturating requests explicitly.
+    drain_fill_rate: float = 0.3
+    drain_fill_min: int = 4
+    drain_fill_max: int = 12
+    drain_fill_priority: int = -100
+    drain_fill_cpu_milli: int = 10
+    drain_fill_mem_mi: int = 16
+    drain_surge_min: int = 4
+    drain_surge_max: int = 12
+    drain_surge_priority: int = 1000
+    drain_surge_cpu_milli: int = 10
+    drain_surge_mem_mi: int = 16
 
     def stream(self, generator: str) -> random.Random:
         # str seeding hashes via sha512 — stable across processes
@@ -194,6 +214,46 @@ class WorkloadPlan:
                     target=f"svc-{idx}"))
         return out
 
+    def _drain(self) -> List[WorkloadEvent]:
+        """1 setup draw (surge tick) + 3 draws/tick (fill?, fill size,
+        surge size). Event params carry (priority, cpu_milli, mem_mi)
+        so the trace pins the exact pods a replay must create."""
+        rng = self.stream("drain")
+        half = self.ticks // 2
+        span_t = max(1, self.ticks - half)
+        surge_tick = half + int(rng.random() * span_t) % span_t
+        out = []
+        for t in range(self.ticks):
+            r_fill, r_fsize, r_ssize = (rng.random(), rng.random(),
+                                        rng.random())
+            if r_fill < self.drain_fill_rate:
+                span = self.drain_fill_max - self.drain_fill_min + 1
+                out.append(WorkloadEvent(
+                    tick=t, generator="drain", action="batch_fill",
+                    target=f"fill-{t:03d}",
+                    value=self.drain_fill_min + int(r_fsize * span) % span,
+                    params=(self.drain_fill_priority,
+                            self.drain_fill_cpu_milli,
+                            self.drain_fill_mem_mi)))
+            if t == surge_tick:
+                span = self.drain_surge_max - self.drain_surge_min + 1
+                out.append(WorkloadEvent(
+                    tick=t, generator="drain", action="surge",
+                    target=f"surge-{t:03d}",
+                    value=self.drain_surge_min + int(r_ssize * span) % span,
+                    params=(self.drain_surge_priority,
+                            self.drain_surge_cpu_milli,
+                            self.drain_surge_mem_mi)))
+        return out
+
+    def surge_tick(self) -> int:
+        """The tick the drain surge lands at (pure) — the flash-drain
+        soak keys its SLO trip window off it."""
+        for ev in self._drain():
+            if ev.action == "surge":
+                return ev.tick
+        return self.ticks  # unreachable for ticks >= 1
+
     # ----------------------------------------------------------- replay
 
     def schedule(self) -> Dict[str, List[WorkloadEvent]]:
@@ -201,7 +261,7 @@ class WorkloadPlan:
         this seed MUST apply, per generator stream."""
         return {"diurnal": self._diurnal(), "burst": self._burst(),
                 "jobwave": self._jobwave(), "rollout": self._rollout(),
-                "churn": self._churn()}
+                "churn": self._churn(), "drain": self._drain()}
 
     def events(self) -> List[WorkloadEvent]:
         """The merged stream, ordered by (tick, generator order) — the
@@ -270,6 +330,12 @@ class WorkloadChaos:
         #: the soak stamps burst-pod creation times here, so the
         #: bind-latency SLO clock starts at the POST, not at a poll
         self.on_crowd = None
+        #: drain-generator state, same shape: fill pods and surge pods
+        #: in creation order, plus the surge hook the flash-drain soak
+        #: stamps surge-bind SLO clocks with
+        self.drain_pods: List[str] = []
+        self.surge_pods: List[str] = []
+        self.on_surge = None
 
     def trace(self) -> Dict[str, List[WorkloadEvent]]:
         """Events actually applied, per generator, in apply order — a
@@ -277,14 +343,20 @@ class WorkloadChaos:
         tick the run replayed."""
         return {g: list(evs) for g, evs in self._trace.items()}
 
-    def apply_tick(self, tick: int, deadline: float) -> List[WorkloadEvent]:
+    def apply_tick(self, tick: int, deadline: float,
+                   generators=None) -> List[WorkloadEvent]:
         """Apply every event of one tick, in merged-stream order. Each
         apply retries through injected faults until it lands or the
         deadline (on this applier's clock.monotonic() axis) passes —
         an event that never lands leaves the trace short, which the
-        schedule-replay gate then correctly fails."""
+        schedule-replay gate then correctly fails. `generators`
+        restricts the replay to a subset of streams (the flash-drain
+        soak replays only "drain"; its reproducibility gate then
+        compares only that stream's trace)."""
         applied = []
         for ev in self._by_tick.get(tick, ()):
+            if generators is not None and ev.generator not in generators:
+                continue
             while True:
                 try:
                     self._apply(ev)
@@ -351,6 +423,21 @@ class WorkloadChaos:
             self.client.update("daemonsets", replace(
                 ds, spec=replace(ds.spec, template=replace(
                     tpl, spec=replace(tpl.spec, node_selector=sel)))), ns)
+        elif ev.action in ("batch_fill", "surge"):
+            prio, cpu_m, mem_mi = ev.params
+            surge = ev.action == "surge"
+            seen_list = self.surge_pods if surge else self.drain_pods
+            seen = set(seen_list)
+            names = [f"{ev.target}-{i:03d}" for i in range(ev.value)]
+            labels = {"surge": "1"} if surge else {"drain": "1"}
+            pods = [self._drain_pod(n, prio, cpu_m, mem_mi, labels)
+                    for n in names if n not in seen]
+            if pods:
+                self.client.create_batch("pods", pods, ns)
+            created = [n for n in names if n not in seen]
+            seen_list.extend(created)
+            if surge and self.on_surge and created:
+                self.on_surge(created)
         elif ev.action == "svc_create":
             try:
                 self.client.create("services", api.Service(
@@ -381,6 +468,20 @@ class WorkloadChaos:
                 resources=api.ResourceRequirements(
                     requests={"cpu": parse_quantity("10m"),
                               "memory": parse_quantity("16Mi")}))]),
+            status=api.PodStatus(phase="Pending"))
+
+    def _drain_pod(self, name: str, prio: int, cpu_m: int, mem_mi: int,
+                   labels: Dict[str, str]):
+        from ..core import types as api
+        from ..core.quantity import parse_quantity
+        return api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace=self.namespace,
+                                    labels=dict(labels)),
+            spec=api.PodSpec(priority=prio, containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": parse_quantity(f"{cpu_m}m"),
+                              "memory": parse_quantity(f"{mem_mi}Mi")}))]),
             status=api.PodStatus(phase="Pending"))
 
     def _tiny_pod_spec(self):

@@ -505,6 +505,12 @@ class PodSpec:
     image_pull_secrets: List[LocalObjectReference] = field(
         default_factory=list)
     affinity: Optional[Affinity] = None
+    # flat integer scheduling priority (higher preempts lower; default 0).
+    # DIVERGENCES #35: the reference models this as PriorityClass objects
+    # resolved at admission plus a nominatedNodeName protocol; here the
+    # resolved integer lives directly on the spec so the device tables
+    # can carry it as one i64 column.
+    priority: int = 0
 
 
 @dataclass

@@ -36,7 +36,8 @@ from ..obs.metricsplane import SLODef
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
 from ..utils.metrics import (APISERVER_LATENCY_SUMMARY, CROWD_COUNTERS,
-                             WATCH_LAG_HISTOGRAM, MetricsRegistry)
+                             SURGE_COUNTERS, WATCH_LAG_HISTOGRAM,
+                             MetricsRegistry)
 from .benchmark import _bench_pod
 from .fleet import HollowFleet
 
@@ -102,8 +103,25 @@ WATCH_DELIVER_SLO = SLODef(
     fast_window=2, slow_window=8,
     fast_burn=10.0, slow_burn=2.0)
 
+#: surge bind under preemption: of the high-priority surge pods
+#: created, what fraction bound within the fast-bind limit (the
+#: SURGE_BIND_HISTOGRAM 5s bucket edge)? Same timeline semantics as
+#: CROWD_BIND_SLO — the surge injection drives the ratio to ~1 at the
+#: surge tick (victims must drain first), so trip/clear ARE the
+#: flash-drain timeline; in soaks that never inject a surge both
+#: counters stay 0 and the burn is 0 (never trips).
+SURGE_BIND_SLO = SLODef(
+    name="surge-bind-availability",
+    metric=SURGE_COUNTERS[0],        # surge_pods_created_total
+    good_metric=SURGE_COUNTERS[1],   # surge_pods_bound_fast_total
+    kind="ratio",
+    objective=0.999,
+    fast_window=2, slow_window=8,
+    fast_burn=10.0, slow_burn=2.0)
+
 #: the pinned fleet SLO set the soaks evaluate every sample
-FLEET_SLOS = (CROWD_BIND_SLO, API_LATENCY_SLO, WATCH_DELIVER_SLO)
+FLEET_SLOS = (CROWD_BIND_SLO, API_LATENCY_SLO, WATCH_DELIVER_SLO,
+              SURGE_BIND_SLO)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:

@@ -19,6 +19,10 @@ component client behind the seeded API-fault injector, then replays a
   rollout   Deployment image bumps (hash rollout under the
             maxUnavailable invariant) and DaemonSet retargeting
   churn     Service create/delete against a fixed pool
+  drain     low-priority batch fill waves + one high-priority surge
+            (rides along inert here; `run_flash_drain_soak` below
+            replays it alone with fleet-saturating requests — the
+            priority-preemption acceptance scenario)
 
 Optionally a seeded `NodeFaultPlan` hard-kills a fraction of the fleet
 at `kill_tick` — the replay then proves the whole recovery chain under
@@ -80,11 +84,14 @@ from ..obs.metricsplane import (BurnRateEvaluator, FleetScraper,
                                 HttpTarget, RegistryTarget)
 from ..sched.batch import BatchScheduler
 from ..sched.factory import ConfigFactory
+from ..sched.preemption import PreemptionPass
 from ..utils.clock import REAL, Clock
 from ..utils.metrics import (APISERVER_LATENCY_SUMMARY, CROWD_COUNTERS,
-                             MetricsRegistry, global_metrics)
+                             MetricsRegistry, PREEMPTION_COUNTERS,
+                             SURGE_BIND_HISTOGRAM, SURGE_COUNTERS,
+                             global_metrics)
 from .fleet import HollowFleet
-from .slo import CROWD_BIND_SLO, FLEET_SLOS
+from .slo import CROWD_BIND_SLO, FLEET_SLOS, SURGE_BIND_SLO
 
 #: demand units one replica serves at exactly the HPA target — the
 #: pure demand->replicas mapping the convergence gate compares against
@@ -963,3 +970,419 @@ def run_workload_soak(n_nodes: int = 12, seed: int = 0,
             pool.stop()
         else:
             server.stop()
+
+
+# ------------------------------------------------------------ flash drain
+
+@dataclass
+class FlashDrainResult:
+    """`run_flash_drain_soak` verdict — the priority-preemption
+    acceptance scenario: a fleet saturated with low-priority batch
+    fill, one high-priority surge, 5% API faults and a node kill, all
+    gated on the surge-bind burn-rate timeline and a post-hoc oracle
+    audit of every eviction."""
+
+    converged: bool
+    n_nodes: int
+    seed: int
+    ticks: int
+    #: the tick the surge landed at (pure per seed)
+    surge_tick: int = -1
+    #: applied drain trace == plan.schedule()["drain"]
+    schedule_replayed: bool = False
+    node_schedule_replayed: bool = True
+    events_applied: int = 0
+    events_expected: int = 0
+    killed: List[str] = field(default_factory=list)
+    # ---- fill (low-priority batch) population
+    fill_pods: int = 0
+    fill_bound: int = 0
+    # ---- surge bind SLO (injection -> spec.nodeName observed)
+    surge_pods: int = 0
+    surge_bound: int = 0
+    surge_bound_fast: int = 0
+    surge_bind_p50_s: float = 0.0
+    surge_bind_p99_s: float = 0.0
+    surge_bind_limit_s: float = 5.0
+    # ---- preemption ledger (counter deltas over this run)
+    preemption_rounds: int = 0
+    victims_evicted: int = 0
+    #: post-hoc oracle audit violations (MUST be 0): evicted a
+    #: >=-priority victim, evicted when a feasible non-preempting node
+    #: existed, or diverged from the oracle's minimal victim set
+    wrongful_evictions: int = 0
+    wrongful_detail: List[str] = field(default_factory=list)
+    duplicate_bindings: int = 0
+    dead_bound: int = 0
+    # ---- burn-rate alert timeline (replayable TRIP/CLEAR)
+    scrape_samples: int = 0
+    alerts: List[Dict] = field(default_factory=list)
+    alert_clear_limit_ticks: int = 8
+    flight_bundles: List[str] = field(default_factory=list)
+    detail: str = ""
+
+    @property
+    def surge_bind_ok(self) -> Optional[bool]:
+        if self.surge_pods == 0:
+            return None  # the plan drew no surge: nothing to gate
+        return (self.surge_bound >= self.surge_pods
+                and self.surge_bind_p99_s < self.surge_bind_limit_s)
+
+    @property
+    def alerts_ok(self) -> Optional[bool]:
+        """Surge TRIP/CLEAR gate, same semantics as the workload
+        soak's crowd gate: the surge cannot bind in the tick it lands
+        (victims must drain first), so it MUST trip the surge
+        fast-burn alert, and every TRIP must CLEAR within
+        alert_clear_limit_ticks samples once preemption frees capacity
+        and the surge binds."""
+        if self.scrape_samples == 0 or self.surge_pods == 0:
+            return None
+        surge = [a for a in self.alerts
+                 if a["slo"] == SURGE_BIND_SLO.name]
+        trips = [a for a in surge if a["action"] == "TRIP"]
+        if not trips:
+            return False
+        for i, a in enumerate(surge):
+            if a["action"] != "TRIP":
+                continue
+            clear = next((b for b in surge[i + 1:]
+                          if b["action"] == "CLEAR"), None)
+            if clear is None or (clear["sample"] - a["sample"]
+                                 > self.alert_clear_limit_ticks):
+                return False
+        return True
+
+    @property
+    def slo_ok(self) -> bool:
+        return bool(self.converged and self.schedule_replayed
+                    and self.node_schedule_replayed
+                    and self.surge_bind_ok is not False
+                    and self.alerts_ok is not False
+                    and self.wrongful_evictions == 0
+                    and self.duplicate_bindings == 0
+                    and self.dead_bound == 0)
+
+    def state_summary(self) -> Dict:
+        """The canonical deterministic projection — what two same-seed
+        invocations are compared on. Wall-clock latencies and the
+        exact victim pods are OUT (eviction order races fill arrival
+        within a tick); the surge population, kill set, audit verdict
+        and the surge alert timeline are IN."""
+        return {
+            "surge_tick": self.surge_tick,
+            "surge_pods": self.surge_pods,
+            "surge_bound": self.surge_bound,
+            "fill_pods": self.fill_pods,
+            "killed": list(self.killed),
+            "wrongful_evictions": self.wrongful_evictions,
+            "duplicate_bindings": self.duplicate_bindings,
+            "converged": self.converged,
+            "surge_alerts": [[a["sample"], a["action"]]
+                             for a in self.alerts
+                             if a["slo"] == SURGE_BIND_SLO.name],
+        }
+
+    def as_dict(self) -> Dict:
+        d = asdict(self)
+        d["surge_bind_ok"] = self.surge_bind_ok
+        d["alerts_ok"] = self.alerts_ok
+        d["slo_ok"] = self.slo_ok
+        return d
+
+
+def run_flash_drain_soak(n_nodes: int = 10, seed: int = 0,
+                         plan: Optional[WorkloadPlan] = None,
+                         tick_wall_s: float = 0.4,
+                         fault_rate: float = 0.05,
+                         node_kill_fraction: float = 0.10,
+                         kill_tick: Optional[int] = None,
+                         surge_bind_limit_s: float = 5.0,
+                         timeout: float = 180.0,
+                         heartbeat_interval: float = 0.5,
+                         monitor_period: float = 0.1,
+                         monitor_grace_period: float = 1.5,
+                         pod_eviction_timeout: float = 0.3,
+                         alert_clear_limit_ticks: int = 8,
+                         flight_dir: Optional[str] = None,
+                         clock: Optional[Clock] = None
+                         ) -> FlashDrainResult:
+    """Flash-crowd drain replay: ONLY the drain generator's stream,
+    with fleet-saturating requests (900m fills on 4-CPU hollow nodes:
+    4 slots per node; the default plan's fill volume saturates the
+    post-kill fleet well before the surge can land), under the same
+    5% API-fault injection as the workload soak plus a 10% node kill
+    at `kill_tick` (defaults to the quarter point — BEFORE the surge,
+    which lands in the second half, so the surge hits a fleet that
+    already lost capacity).
+
+    The surge pods are strictly higher priority than the fill; binding
+    them requires the scheduler's preemption pass (sched/preemption.py)
+    to evict minimal fill victim sets, and the priority-ordered pending
+    queue to hand the freed capacity to the surge rather than the fill
+    backlog. Gates: the surge-bind burn-rate alert must TRIP at the
+    surge tick and CLEAR within `alert_clear_limit_ticks` samples,
+    every surge pod must bind with p99 under `surge_bind_limit_s`,
+    zero duplicate bindings, zero pods on dead nodes, and the post-hoc
+    serial-oracle audit of every recorded preemption round must come
+    back empty (zero wrongful evictions — each violation increments
+    the pinned wrongful counter and flight-dumps when a recorder is
+    armed)."""
+    clock = clock or REAL
+    if plan is None:
+        plan = WorkloadPlan(
+            seed=seed,
+            drain_fill_rate=0.9, drain_fill_min=5, drain_fill_max=8,
+            drain_fill_cpu_milli=900, drain_fill_mem_mi=64,
+            drain_surge_cpu_milli=900, drain_surge_mem_mi=64)
+    seed = plan.seed
+    drain_pure = plan.schedule()["drain"]
+    fault_plan = FaultPlan(seed=seed, error_rate=fault_rate)
+    node_plan = NodeFaultPlan(seed=seed,
+                              kill_fraction=node_kill_fraction)
+    kill_tick = (plan.ticks // 4 if kill_tick is None else kill_tick)
+
+    metrics = MetricsRegistry()
+    registry = Registry()
+    server = ApiServer(registry, port=0, metrics=metrics).start()
+    chaos = ChaosClient(HttpClient(server.url), fault_plan)
+
+    result = FlashDrainResult(
+        converged=False, n_nodes=n_nodes, seed=seed, ticks=plan.ticks,
+        surge_tick=plan.surge_tick(),
+        surge_bind_limit_s=surge_bind_limit_s,
+        alert_clear_limit_ticks=alert_clear_limit_ticks,
+        events_expected=len(drain_pure))
+
+    recorder = (FlightRecorder(flight_dir, clock=clock)
+                if flight_dir else None)
+    tick_now = [0]
+    sampled_tick = [-1]
+
+    def _on_trip(ev):
+        if recorder is not None:
+            recorder.dump(f"slo-{ev.slo}", scraper=scraper,
+                          tracer=_obs_tracer(),
+                          chaos={"tick": tick_now[0]},
+                          extra=ev.to_dict())
+
+    scraper = FleetScraper(
+        [HttpTarget("apiserver", server.url + "/metrics"),
+         RegistryTarget("fleet", global_metrics)],
+        clock=clock, cadence_s=tick_wall_s, seed=seed)
+    evaluator = BurnRateEvaluator(list(FLEET_SLOS), on_trip=_on_trip)
+    rounds_base = global_metrics.counter_sum(PREEMPTION_COUNTERS[0])
+    victims_base = global_metrics.counter_sum(PREEMPTION_COUNTERS[1])
+
+    fleet = HollowFleet(chaos, n_nodes,
+                        heartbeat_interval=heartbeat_interval,
+                        jitter_seed=seed).run()
+    factory = ConfigFactory(chaos, rate_limit=False).start()
+    pre = PreemptionPass(seed=seed)
+    sched = BatchScheduler(factory.create_batch(preemption=pre)).run()
+    node_ctl = NodeController(
+        chaos, monitor_period=monitor_period,
+        monitor_grace_period=monitor_grace_period,
+        pod_eviction_timeout=pod_eviction_timeout,
+        eviction_qps=1000.0, eviction_burst=1000).run()
+
+    wl = WorkloadChaos(chaos, plan, clock=clock)
+    node_chaos = NodeChaos(fleet, node_plan)
+
+    lock = threading.Lock()
+    bound_to: Dict[str, str] = {}
+    duplicates: List[Tuple[str, str, str]] = []
+    surge_created: Dict[str, float] = {}
+    surge_tick_of: Dict[str, int] = {}
+    surge_bound: Dict[str, float] = {}       # name -> bind latency s
+    fill_bound: Dict[str, float] = {}
+    stop_threads = threading.Event()
+
+    def _on_surge(names):
+        # synchronous with apply_tick (the _on_crowd pattern): the
+        # created counter and THIS tick's scrape sample both move
+        # before the scheduler can have bound anything, so the TRIP
+        # edge replays deterministically at the surge tick
+        surge_created.update({n: time.monotonic() for n in names})
+        surge_tick_of.update({n: tick_now[0] for n in names})
+        metrics.inc(SURGE_COUNTERS[0], by=float(len(names)))
+        if sampled_tick[0] != tick_now[0]:
+            sampled_tick[0] = tick_now[0]
+            evaluator.observe(scraper.sample(t=float(tick_now[0])))
+
+    wl.on_surge = _on_surge
+
+    def tracker():
+        # registry sweep: duplicate-binding ledger + surge bind stamps
+        # (server-side truth — spec.nodeName in the store); a surge
+        # bind inside the fast limit moves the good counter the
+        # burn-rate CLEAR rides on
+        while not stop_threads.is_set():
+            try:
+                pods, _ = registry.list("pods", "default")
+            except Exception:
+                time.sleep(0.03)
+                continue
+            now = time.monotonic()
+            with lock:
+                for p in pods:
+                    node = p.spec.node_name
+                    if not node:
+                        continue
+                    prev = bound_to.get(p.metadata.uid)
+                    if prev is not None and prev != node:
+                        duplicates.append((p.metadata.uid, prev, node))
+                    bound_to[p.metadata.uid] = node
+                    name = p.metadata.name
+                    if (name.startswith("surge-")
+                            and name in surge_created
+                            and name not in surge_bound):
+                        lat = now - surge_created[name]
+                        surge_bound[name] = lat
+                        metrics.observe(SURGE_BIND_HISTOGRAM, lat)
+                        if lat <= surge_bind_limit_s:
+                            metrics.inc(SURGE_COUNTERS[1])
+                    elif (name.startswith("fill-")
+                          and name not in fill_bound):
+                        fill_bound[name] = now
+            time.sleep(0.03)
+
+    threading.Thread(target=tracker, daemon=True,
+                     name="flash-drain-tracker").start()
+
+    def wait_until(cond, deadline):
+        while clock.monotonic() < deadline:
+            if cond():
+                return True
+            clock.sleep(0.05)
+        return cond()
+
+    try:
+        deadline = clock.monotonic() + timeout
+        if not wait_until(
+                lambda: len(factory.node_lister.list()) >= n_nodes,
+                deadline):
+            result.detail = "fleet never registered"
+            return result
+        # warm the engine's compile caches (including the preemption
+        # kernel via the scheduler's first victim search shapes) while
+        # idle — an XLA compile inside the replay would bill compiler
+        # seconds to the surge's bind latency
+        from .benchmark import _warmup_batch
+        _warmup_batch(sched, factory)
+
+        dead: set = set()
+        for tick in range(plan.ticks):
+            tick_now[0] = tick
+            # surges that landed on EARLIER ticks must be bound before
+            # this tick's sample or the CLEAR edge races the scrape
+            # (the workload soak's crowd-quiesce rule); a preempted
+            # bind pays victim grace plus a requeue round, so the cap
+            # dominates a couple of eviction rounds
+            due = [n for n, t0 in surge_tick_of.items() if t0 < tick]
+            if due:
+                def _surges_quiesced():
+                    with lock:
+                        return all(n in surge_bound for n in due)
+                wait_until(_surges_quiesced,
+                           clock.monotonic() + max(8.0,
+                                                   4.0 * tick_wall_s))
+            wl.apply_tick(tick, deadline, generators=("drain",))
+            if node_kill_fraction > 0 and tick == kill_tick:
+                result.killed = node_chaos.kill()
+                dead = set(result.killed)
+                result.node_schedule_replayed = (
+                    result.killed
+                    == node_plan.schedule(fleet.node_names())["kill"])
+                if recorder is not None:
+                    recorder.dump("chaos-node-kill", scraper=scraper,
+                                  tracer=_obs_tracer(),
+                                  chaos={"tick": tick,
+                                         "victims": result.killed})
+            if sampled_tick[0] != tick:
+                evaluator.observe(scraper.sample(t=float(tick)))
+            time.sleep(tick_wall_s)
+
+        # ---- quiesce: every surge pod bound, nothing on dead nodes
+        # (the fill backlog is EXPECTED to stay pending — the fleet is
+        # sized so the drain saturates it; fills are reported, not
+        # gated)
+        def surge_settled():
+            with lock:
+                return len(surge_bound) >= len(wl.surge_pods)
+
+        def dead_bound_count():
+            try:
+                pods, _ = registry.list("pods", "default")
+            except Exception:
+                return -1
+            return sum(1 for p in pods if p.spec.node_name in dead)
+
+        ok = wait_until(lambda: surge_settled()
+                        and dead_bound_count() == 0, deadline)
+        result.converged = ok
+
+        # drain samples past the replay: the surge can land on the
+        # final tick; its CLEAR edge needs samples after binds settle
+        for extra in range(6):
+            evaluator.observe(
+                scraper.sample(t=float(plan.ticks + extra)))
+        result.scrape_samples = len(scraper.series())
+        result.alerts = evaluator.events_dict()
+
+        # ---- the wrongful-eviction gate: every recorded round
+        # replayed through the serial oracle post hoc; any divergence
+        # is counted on the pinned counter and flight-dumped
+        result.wrongful_detail = pre.audit()
+        result.wrongful_evictions = len(result.wrongful_detail)
+        for _ in result.wrongful_detail:
+            global_metrics.inc(PREEMPTION_COUNTERS[2])
+        result.preemption_rounds = int(
+            global_metrics.counter_sum(PREEMPTION_COUNTERS[0])
+            - rounds_base)
+        result.victims_evicted = int(
+            global_metrics.counter_sum(PREEMPTION_COUNTERS[1])
+            - victims_base)
+
+        with lock:
+            result.duplicate_bindings = len(duplicates)
+            lats = sorted(surge_bound.values())
+            result.surge_bound = len(surge_bound)
+            result.surge_bound_fast = sum(
+                1 for v in lats if v <= surge_bind_limit_s)
+            result.fill_bound = len(fill_bound)
+        result.surge_pods = len(wl.surge_pods)
+        result.fill_pods = len(wl.drain_pods)
+        result.surge_bind_p50_s = round(_percentile(lats, 0.50), 4)
+        result.surge_bind_p99_s = round(_percentile(lats, 0.99), 4)
+        result.dead_bound = max(0, dead_bound_count())
+
+        trace = wl.trace()
+        result.events_applied = len(trace["drain"])
+        result.schedule_replayed = trace["drain"] == drain_pure
+
+        if recorder is not None:
+            if result.wrongful_evictions or result.duplicate_bindings:
+                recorder.dump(
+                    "preemption-violation", scraper=scraper,
+                    tracer=_obs_tracer(),
+                    chaos={"wrongful": result.wrongful_detail,
+                           "duplicates": [list(d) for d in duplicates]})
+            result.flight_bundles = list(recorder.bundles)
+
+        if not ok:
+            result.detail = (
+                f"surge {result.surge_bound}/{result.surge_pods} "
+                f"bound, fills {result.fill_bound}/{result.fill_pods},"
+                f" dead_bound={result.dead_bound}, "
+                f"rounds={result.preemption_rounds} "
+                f"victims={result.victims_evicted}")
+        return result
+    finally:
+        stop_threads.set()
+        node_chaos.stop()
+        node_ctl.stop()
+        sched.stop()
+        factory.stop()
+        fleet.stop()
+        server.stop()

@@ -697,6 +697,59 @@ def _node_shardings(mesh: Mesh, axis: str):
     return node, state, pods
 
 
+def _make_preempt():
+    """Dense masked victim search over one VictimTable (the device half
+    of sched/preemption.py — the docstring there is the spec; this is
+    the same rule as oracle_find_victims, expressed as prefix sums and
+    one composite argmax so the whole search is a single dispatch).
+
+    Victims arrive pre-sorted (priority asc, insertion asc), so the
+    minimal eviction set on a node is a PREFIX: the per-node search is
+    a cumulative sum of released cpu/mem over the victim axis, a
+    [N, V+1] feasibility matrix (column k = "evict the k-prefix"), and
+    a first-True argmax for k*. Node choice is the injective int64
+    composite (fewest evictions, lowest senior victim priority,
+    tie_rank), matching preemption.composite_score exactly — under a
+    mesh the final argmax reduces over ICI like the scan's per-step
+    argmax. Everything is int64 end-to-end (ensure_x64): priorities
+    are bounded |p| <= PMAX by validation, so no term can wrap."""
+    from ..preemption import PMAX, SCORE_STRIDE, SENIOR_NONE
+
+    def kernel(cand, cpu_cap, mem_cap, pod_cap, cpu_used, mem_used,
+               pod_count, tie_rank, v_prio, v_cpu, v_mem, v_valid,
+               prio, req_cpu, req_mem, zero_req):
+        n, v = v_prio.shape
+        vm = v_valid & (v_prio < prio)
+        nv = jnp.sum(vm.astype(jnp.int64), axis=1)
+        zero_col = jnp.zeros((n, 1), jnp.int64)
+        rc = jnp.concatenate(
+            [zero_col, jnp.cumsum(jnp.where(vm, v_cpu, 0), axis=1)], axis=1)
+        rm = jnp.concatenate(
+            [zero_col, jnp.cumsum(jnp.where(vm, v_mem, 0), axis=1)], axis=1)
+        k = jnp.arange(v + 1, dtype=jnp.int64)[None, :]
+        k_ok = k <= nv[:, None]
+        fits_count = (pod_count[:, None] - k) < pod_cap[:, None]
+        free_cpu = (cpu_cap[:, None] == 0) | (
+            cpu_cap[:, None] - (cpu_used[:, None] - rc) >= req_cpu)
+        free_mem = (mem_cap[:, None] == 0) | (
+            mem_cap[:, None] - (mem_used[:, None] - rm) >= req_mem)
+        res_ok = jnp.where(zero_req, fits_count,
+                           fits_count & free_cpu & free_mem)
+        feas = cand[:, None] & k_ok & res_ok
+        any_k = jnp.any(feas, axis=1)
+        kstar = jnp.argmax(feas, axis=1).astype(jnp.int64)  # first True
+        senior = jnp.take_along_axis(
+            v_prio, jnp.maximum(kstar - 1, 0)[:, None], axis=1)[:, 0]
+        senior = jnp.where(kstar > 0, senior, SENIOR_NONE)
+        score = ((v - kstar) * SCORE_STRIDE + (PMAX - senior)) * n \
+            + tie_rank
+        score = jnp.where(any_k, score, jnp.int64(-1))
+        pick = jnp.argmax(score)
+        return pick, kstar, score
+
+    return kernel
+
+
 class BatchEngine:
     """Compiled batch scheduler. With a mesh, the node axis shards across
     devices and the per-step argmax reduces over ICI; without, single-chip.
@@ -804,6 +857,44 @@ class BatchEngine:
         self._runs = {}
         self._run = self._get_run(True, True)
         self._table_cache = None
+
+    def find_victims(self, table):
+        """Run the preemption victim search for one VictimTable
+        (incremental.victim_table). Returns an OracleResult whose
+        fields must be bit-equal to sched.preemption.
+        oracle_find_victims(table) at every shape — the parity suite's
+        contract. One dispatch, one host pull after it (no per-tile
+        loop, so no per-shard sync)."""
+        from ..preemption import OracleResult
+        fn = self._runs.get("preempt")
+        if fn is None:
+            kernel = _make_preempt()
+            if self.mesh is not None:
+                def s(*spec):
+                    return NamedSharding(self.mesh, P(*spec))
+                row, mat, rep = s(self.node_axis), \
+                    s(self.node_axis, None), s()
+                fn = jax.jit(
+                    kernel,
+                    in_shardings=(row, row, row, row, row, row, row,
+                                  row, mat, mat, mat, mat,
+                                  rep, rep, rep, rep),
+                    out_shardings=(rep, row, row))
+            else:
+                fn = jax.jit(kernel)
+            self._runs["preempt"] = fn
+        pick, kstar, score = fn(
+            table.cand, table.cpu_cap, table.mem_cap, table.pod_cap,
+            table.cpu_used, table.mem_used, table.pod_count,
+            table.tie_rank, table.v_prio, table.v_cpu, table.v_mem,
+            table.v_valid, np.int64(table.prio), np.int64(table.req_cpu),
+            np.int64(table.req_mem), np.bool_(table.zero_req))
+        pick, kstar, score = jax.device_get((pick, kstar, score))
+        pick = int(pick)
+        return OracleResult(pick=pick, kstar=int(kstar[pick]),
+                            feasible=bool(score[pick] >= 0),
+                            node_kstar=np.asarray(kstar, np.int64),
+                            node_score=np.asarray(score, np.int64))
 
     def _ensure_safe_dtypes(self, enc: EncodeResult) -> EncodeResult:
         """The encoder narrows with a conservative default weight bound;

@@ -414,6 +414,28 @@ SCRAPE_COUNTERS = (
     "scrape_errors_total",
 )
 
+#: Priority-preemption counters (sched/batch.py _try_preempt + the
+#: flash-drain soak's post-hoc oracle audit): attempts counts victim
+#: searches run, victims counts uid-preconditioned evictions issued,
+#: wrongful counts audit violations — the soak gates on wrongful == 0.
+PREEMPTION_COUNTERS = (
+    "preemption_attempts_total",
+    "preemption_victims_total",
+    "preemption_wrongful_total",
+)
+
+#: Surge progress counters the flash-drain soak's burn-rate SLO reads
+#: (same shape as CROWD_COUNTERS): created is incremented synchronously
+#: at surge injection, bound_fast when the tracker sees the surge pod
+#: bind within the fast-bind limit.
+SURGE_COUNTERS = (
+    "surge_pods_created_total",
+    "surge_pods_bound_fast_total",
+)
+
+#: Surge bind latency (injection -> observed binding), seconds.
+SURGE_BIND_HISTOGRAM = "preemption_surge_bind_seconds"
+
 #: Pinned per-metric histogram bucket boundaries. observe() dual-lands
 #: any of these names into a Histogram next to its summary; boundaries
 #: live HERE (not at call sites) because merging across processes
@@ -436,4 +458,9 @@ HISTOGRAM_BUCKETS: Dict[str, Tuple[float, ...]] = {
     WATCH_LAG_HISTOGRAM: (
         0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
         0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+    # surge bind latency, seconds: the 5s bucket edge is the soak's
+    # fast-bind limit (a preempted-then-bound surge pod pays victim
+    # grace + one requeue round trip, normally well under it)
+    SURGE_BIND_HISTOGRAM: (
+        0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
 }

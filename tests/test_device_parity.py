@@ -592,3 +592,200 @@ def test_speculative_falls_back_on_affinity():
     eng.schedule(snap)[0]
     assert not any(k[0] == "spec" for k in eng._runs
                    if isinstance(k, tuple))
+
+
+# --------------------------------------------------- preemption parity
+
+def _bound_pod(name, node, prio, cpu, mem):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                uid=f"uid-{name}"),
+        spec=api.PodSpec(
+            containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(requests={
+                    "cpu": mq(cpu), "memory": bq(mem * MI)}))],
+            node_name=node, priority=prio))
+
+
+def _preemptor(name="surge", prio=1000, cpu=1000, mem=64):
+    requests = {}
+    if cpu or mem:
+        requests = {"cpu": mq(cpu), "memory": bq(mem * MI)}
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                uid=f"uid-{name}"),
+        spec=api.PodSpec(
+            containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(requests=requests))],
+            priority=prio))
+
+
+def _assert_victims_bitequal(engine, table):
+    """The tentpole contract: the device victim search must be
+    bit-equal to the serial oracle — pick, k*, feasibility AND the full
+    per-node arrays, at every shape."""
+    import numpy as np
+    from kubernetes_tpu.sched.preemption import oracle_find_victims
+    dev = engine.find_victims(table)
+    ora = oracle_find_victims(table)
+    assert (dev.pick, dev.kstar, dev.feasible) == \
+        (ora.pick, ora.kstar, ora.feasible)
+    assert np.array_equal(dev.node_kstar, ora.node_kstar)
+    assert np.array_equal(dev.node_score, ora.node_score)
+    assert dev.victim_keys(table) == ora.victim_keys(table)
+    return dev
+
+
+def _drain_encoder(n_nodes=6, node_capacity=8, mesh_devices=None):
+    from kubernetes_tpu.sched.device.incremental import IncrementalEncoder
+    if mesh_devices is not None:
+        inc = IncrementalEncoder(mesh_devices=mesh_devices)
+    else:
+        inc = IncrementalEncoder(node_capacity=node_capacity)
+    for i in range(n_nodes):
+        inc.on_node_add(make_node(f"n{i:03d}", 4000, 1024 * MI, 8))
+    return inc
+
+
+@pytest.mark.preemption
+def test_preempt_parity_mixed_priorities():
+    inc = _drain_encoder()
+    k = 0
+    for i in range(6):
+        for prio, cpu in [(-100, 900), (-100, 900), (-50, 900),
+                          (0, 900)]:
+            inc.on_pod_add(_bound_pod(f"b{k:03d}", f"n{i:03d}",
+                                      prio, cpu, 64))
+            k += 1
+    table = inc.victim_table(_preemptor(prio=100, cpu=1000))
+    dev = _assert_victims_bitequal(BatchEngine(), table)
+    assert dev.feasible and dev.kstar > 0  # the search actually evicts
+    # the chosen set is the lowest-priority prefix
+    picked = dev.victim_keys(table)
+    assert picked == table.victims[dev.pick][: dev.kstar]
+
+
+@pytest.mark.preemption
+def test_preempt_parity_identical_nodes_tie():
+    inc = _drain_encoder()
+    for i in range(6):
+        inc.on_pod_add(_bound_pod(f"t{i}", f"n{i:03d}", -100, 3600, 64))
+    table = inc.victim_table(_preemptor(cpu=1000))
+    dev = _assert_victims_bitequal(BatchEngine(), table)
+    assert dev.feasible and dev.kstar == 1
+
+
+@pytest.mark.preemption
+def test_preempt_parity_no_feasible_victims():
+    inc = _drain_encoder()
+    # every node full of pods the preemptor CANNOT evict (>= priority)
+    for i in range(6):
+        inc.on_pod_add(_bound_pod(f"h{i}", f"n{i:03d}", 1000, 3600, 64))
+    table = inc.victim_table(_preemptor(prio=100, cpu=1000))
+    dev = _assert_victims_bitequal(BatchEngine(), table)
+    assert not dev.feasible
+    assert dev.victim_keys(table) == []
+
+
+@pytest.mark.preemption
+def test_preempt_parity_zero_request_counts_only():
+    inc = _drain_encoder()
+    # saturate the pod-count axis (cap 8), cpu irrelevant
+    for i in range(6):
+        for j in range(8):
+            inc.on_pod_add(_bound_pod(f"z{i}-{j}", f"n{i:03d}",
+                                      -100, 10, 1))
+    table = inc.victim_table(_preemptor(cpu=0, mem=0))
+    assert table.zero_req
+    dev = _assert_victims_bitequal(BatchEngine(), table)
+    assert dev.feasible and dev.kstar == 1  # one count slot suffices
+
+
+@pytest.mark.preemption
+def test_preempt_parity_free_node_wins():
+    inc = _drain_encoder()
+    for i in range(5):  # n005 left empty
+        inc.on_pod_add(_bound_pod(f"f{i}", f"n{i:03d}", -100, 3600, 64))
+    table = inc.victim_table(_preemptor(cpu=1000))
+    dev = _assert_victims_bitequal(BatchEngine(), table)
+    assert dev.feasible and dev.kstar == 0
+    assert table.node_names[dev.pick] == "n005"
+
+
+@pytest.mark.preemption
+def test_preempt_parity_mid_tile_node_death():
+    """A node dying between two victim-table cuts: the second cut must
+    drop it from the candidate set, stay bit-equal, and carry a bumped
+    fencing epoch so batch.py can detect the stale first cut."""
+    engine = BatchEngine()
+    inc = _drain_encoder()
+    for i in range(6):
+        inc.on_pod_add(_bound_pod(f"d{i}", f"n{i:03d}", -100, 3600, 64))
+    pod = _preemptor(cpu=1000)
+    before = inc.victim_table(pod)
+    dev = _assert_victims_bitequal(engine, before)
+    victim_node = before.node_names[dev.pick]
+    inc.on_node_delete(make_node(victim_node, 4000, 1024 * MI, 8))
+    after = inc.victim_table(pod)
+    assert after.state_epoch > before.state_epoch  # the fence moved
+    dead_slot = before.node_names.index(victim_node)
+    assert not after.cand[dead_slot]
+    dev2 = _assert_victims_bitequal(engine, after)
+    assert dev2.feasible
+    assert after.node_names[dev2.pick] != victim_node
+
+
+@pytest.mark.preemption
+def test_preempt_parity_sharded_mesh():
+    """The acceptance bar's hardest shape: the victim search sharded
+    row-wise over the mesh must be bit-equal to the oracle AND to the
+    single-device engine — the final argmax reduces over ICI."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("nodes",))
+    engines = {"mesh": BatchEngine(mesh=mesh), "single": BatchEngine()}
+    results = {}
+    for kind, engine in engines.items():
+        inc = _drain_encoder(n_nodes=21, mesh_devices=engine.n_shards)
+        rng = random.Random(13)
+        k = 0
+        for i in range(21):
+            for _ in range(rng.randrange(1, 5)):
+                inc.on_pod_add(_bound_pod(
+                    f"m{k:03d}", f"n{i:03d}",
+                    rng.choice([-100, -50, 0, 50]),
+                    rng.choice([400, 800, 900]), 64))
+                k += 1
+        table = inc.victim_table(_preemptor(prio=100, cpu=2000))
+        dev = _assert_victims_bitequal(engine, table)
+        results[kind] = (dev.pick, dev.kstar, dev.feasible,
+                         dev.victim_keys(table))
+    assert results["mesh"] == results["single"]
+
+
+@pytest.mark.preemption
+def test_preempt_parity_random_sweep():
+    """Randomized clusters x random preemptors: every shape the soak
+    can produce must hold the bit-equality contract."""
+    engine = BatchEngine()
+    for seed in range(6):
+        rng = random.Random(seed)
+        inc = _drain_encoder(n_nodes=rng.randrange(3, 9),
+                             node_capacity=16)
+        k = 0
+        for i in range(len(inc.node_slot)):
+            for _ in range(rng.randrange(0, 7)):
+                inc.on_pod_add(_bound_pod(
+                    f"r{seed}-{k:03d}", f"n{i:03d}",
+                    rng.randrange(-200, 200),
+                    rng.choice([0, 100, 500, 900, 1200]),
+                    rng.choice([16, 64, 128])))
+                k += 1
+        pod = _preemptor(prio=rng.randrange(-100, 1001),
+                         cpu=rng.choice([0, 500, 1000, 2000]),
+                         mem=rng.choice([0, 64, 256]))
+        _assert_victims_bitequal(engine, inc.victim_table(pod))

@@ -629,7 +629,14 @@ class TestMetricPinningRule:
                      "crowd_pods_bound_total",
                      "apiserver_request_latencies_microseconds",
                      "watch_publish_deliver_lag_seconds",
-                     "pod_e2e_stage_seconds"):
+                     "pod_e2e_stage_seconds",
+                     # the preemption soak's reads (ISSUE 20)
+                     "preemption_attempts_total",
+                     "preemption_victims_total",
+                     "preemption_wrongful_total",
+                     "preemption_surge_bind_seconds",
+                     "surge_pods_created_total",
+                     "surge_pods_bound_fast_total"):
             assert name in pinned
 
 

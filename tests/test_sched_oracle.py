@@ -514,6 +514,229 @@ def test_service_affinity_inherits_peer_node_labels():
     assert not check(pinned, [], nodes["r1-node"])[0]
 
 
+# ------------------------------------------------- preemption oracle
+
+def _vt(nodes, prio=100, req_cpu=1000, req_mem=0, pod_key=("default", "s")):
+    """Hand-build a preemption VictimTable (the oracle's only input).
+    `nodes` is a list of dicts: cpu_cap/cpu_used (milli), mem_cap/
+    mem_used, pod_cap/pod_count, victims=[(prio, cpu, mem), ...]
+    (already (priority asc, insertion asc) — the encoder's contract),
+    cand (default True). Victim identities are synthesized per slot."""
+    import numpy as np
+    from kubernetes_tpu.sched.preemption import PMAX, VictimTable
+    n = len(nodes)
+    max_v = max((len(nd.get("victims", ())) for nd in nodes), default=0)
+    v_pad = 1
+    while v_pad < max_v:
+        v_pad *= 2
+    v_prio = np.full((n, v_pad), PMAX + 1, np.int64)
+    v_cpu = np.zeros((n, v_pad), np.int64)
+    v_mem = np.zeros((n, v_pad), np.int64)
+    v_valid = np.zeros((n, v_pad), bool)
+    victims = []
+    for j, nd in enumerate(nodes):
+        ids = []
+        for i, (p, c, m) in enumerate(nd.get("victims", ())):
+            v_prio[j, i], v_cpu[j, i], v_mem[j, i] = p, c, m
+            v_valid[j, i] = True
+            ids.append(("default", f"v{j}-{i}", f"uid-{j}-{i}"))
+        victims.append(ids)
+    col = lambda k, d=0: np.array([nd.get(k, d) for nd in nodes], np.int64)
+    return VictimTable(
+        pod_key=pod_key, pod_uid="uid-s", prio=prio,
+        req_cpu=req_cpu, req_mem=req_mem,
+        zero_req=(req_cpu == 0 and req_mem == 0),
+        cand=np.array([nd.get("cand", True) for nd in nodes], bool),
+        cpu_cap=col("cpu_cap"), mem_cap=col("mem_cap"),
+        pod_cap=col("pod_cap", 110),
+        cpu_used=col("cpu_used"), mem_used=col("mem_used"),
+        pod_count=col("pod_count"),
+        tie_rank=np.arange(n, dtype=np.int64),
+        v_prio=v_prio, v_cpu=v_cpu, v_mem=v_mem, v_valid=v_valid,
+        victims=victims, node_names=[f"n{j}" for j in range(n)])
+
+
+@pytest.mark.preemption
+class TestPreemptionOracle:
+    def test_prefers_fewest_evictions(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([
+            # needs 2 evictions to free 1000m
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 500, 0), (-100, 500, 0)]),
+            # needs 1
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+        ])
+        r = oracle_find_victims(t)
+        assert r.feasible and (r.pick, r.kstar) == (1, 1)
+        assert r.victim_keys(t) == [("default", "v1-0", "uid-1-0")]
+
+    def test_lowest_senior_priority_breaks_eviction_ties(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(50, 1000, 0)]),
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+        ])
+        r = oracle_find_victims(t)
+        assert (r.pick, r.kstar) == (1, 1)  # evict the -100, not the 50
+
+    def test_tie_rank_is_the_final_tiebreak(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        same = dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                    victims=[(-100, 1000, 0)])
+        r = oracle_find_victims(_vt([dict(same), dict(same), dict(same)]))
+        # identical nodes: the injective composite adds tie_rank, so
+        # argmax lands on the highest rank — deterministic, not first
+        assert (r.pick, r.kstar) == (2, 1)
+
+    def test_no_feasible_victim_set(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([
+            # even evicting everything leaves only 500m free
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 500, 0)]),
+            # equal-priority pod is NOT a victim (strictly-lower only)
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(100, 4000, 0)]),
+        ])
+        r = oracle_find_victims(t)
+        assert not r.feasible
+        assert r.victim_keys(t) == []
+
+    def test_free_node_means_no_eviction(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+            dict(cpu_cap=4000, cpu_used=1000, pod_count=2),  # free
+        ])
+        r = oracle_find_victims(t)
+        # k*=0 always outranks any eviction: SENIOR_NONE beats every
+        # real priority at the (v - k) tier
+        assert r.feasible and (r.pick, r.kstar) == (1, 0)
+        assert r.victim_keys(t) == []
+
+    def test_zero_request_checks_only_the_count(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([dict(cpu_cap=1000, cpu_used=1000, pod_count=4,
+                      pod_cap=4, victims=[(-100, 250, 0)])],
+                req_cpu=0, req_mem=0)
+        r = oracle_find_victims(t)
+        # cpu-saturated is irrelevant; one eviction frees a count slot
+        assert r.feasible and (r.pick, r.kstar) == (0, 1)
+
+    def test_pod_cap_zero_is_not_unlimited(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        # the count predicate has NO zero-unlimited convention (unlike
+        # cpu/mem): pod_cap 0 admits nothing, evictions or not
+        t = _vt([dict(cpu_cap=4000, cpu_used=100, pod_count=1,
+                      pod_cap=0, victims=[(-100, 100, 0)])])
+        assert not oracle_find_victims(t).feasible
+
+    def test_memory_prefix_released_with_cpu(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([dict(cpu_cap=4000, mem_cap=1024, cpu_used=1000,
+                      mem_used=1024, pod_count=4,
+                      victims=[(-100, 0, 256), (-50, 0, 256)])],
+                req_cpu=100, req_mem=400)
+        r = oracle_find_victims(t)
+        # one victim frees 256Mi < 400Mi; the prefix of two frees 512
+        assert r.feasible and (r.pick, r.kstar) == (0, 2)
+        assert len(r.victim_keys(t)) == 2
+
+    def test_non_candidate_nodes_never_picked(self):
+        from kubernetes_tpu.sched.preemption import oracle_find_victims
+        t = _vt([
+            dict(cand=False, cpu_cap=4000, cpu_used=0, pod_count=0),
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+        ])
+        r = oracle_find_victims(t)
+        assert (r.pick, r.kstar) == (1, 1)
+
+
+@pytest.mark.preemption
+class TestPreemptionAudit:
+    def _decision(self, t, r, victims=None, evicted=None):
+        from kubernetes_tpu.sched.preemption import PreemptionDecision
+        v = r.victim_keys(t) if victims is None else victims
+        return PreemptionDecision(
+            pod_key=t.pod_key, pod_uid=t.pod_uid, prio=t.prio,
+            node=t.node_names[r.pick], pick=r.pick, kstar=r.kstar,
+            score=int(r.node_score[r.pick]), victims=v, table=t,
+            state_epoch=t.state_epoch, shard_epochs=t.shard_epochs,
+            evicted=len(v) if evicted is None else evicted)
+
+    def test_clean_decision_passes(self):
+        from kubernetes_tpu.sched.preemption import (audit_decision,
+                                                     oracle_find_victims)
+        t = _vt([dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                      victims=[(-100, 1000, 0)])])
+        r = oracle_find_victims(t)
+        assert audit_decision(self._decision(t, r)) == []
+
+    def test_detects_eviction_when_free_node_existed(self):
+        from kubernetes_tpu.sched.preemption import (PreemptionDecision,
+                                                     audit_decision)
+        t = _vt([
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+            dict(cpu_cap=4000, cpu_used=0, pod_count=0),  # free!
+        ])
+        # a buggy pass evicted on node 0 anyway — wrongful rule 2
+        d = PreemptionDecision(
+            pod_key=t.pod_key, pod_uid=t.pod_uid, prio=t.prio,
+            node="n0", pick=0, kstar=1,
+            score=0, victims=[("default", "v0-0", "uid-0-0")], table=t,
+            state_epoch=0, shard_epochs=None, evicted=1)
+        out = audit_decision(d)
+        assert any("non-preempting node" in v or "oracle" in v
+                   for v in out), out
+
+    def test_detects_device_divergence(self):
+        from kubernetes_tpu.sched.preemption import (audit_decision,
+                                                     oracle_find_victims)
+        t = _vt([
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 500, 0), (-100, 500, 0)]),
+            dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                 victims=[(-100, 1000, 0)]),
+        ])
+        r = oracle_find_victims(t)
+        d = self._decision(t, r)
+        d.pick, d.kstar = 0, 2          # claim the 2-eviction node
+        d.node = "n0"
+        d.victims = list(t.victims[0][:2])
+        out = audit_decision(d)
+        assert any("oracle node" in v for v in out), out
+
+    def test_detects_high_priority_victim(self):
+        from kubernetes_tpu.sched.preemption import (audit_decision,
+                                                     oracle_find_victims)
+        t = _vt([dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                      victims=[(-100, 1000, 0)])])
+        r = oracle_find_victims(t)
+        d = self._decision(t, r)
+        # corrupt the recorded table: the evicted slot now claims a
+        # priority above the preemptor — wrongful rule 1 (the replayed
+        # oracle no longer agrees with the recorded eviction)
+        d.table.v_prio[0, 0] = d.prio + 5
+        assert audit_decision(d), "high-priority victim went undetected"
+
+    def test_detects_non_prefix_victim_set(self):
+        from kubernetes_tpu.sched.preemption import (audit_decision,
+                                                     oracle_find_victims)
+        t = _vt([dict(cpu_cap=4000, cpu_used=4000, pod_count=8,
+                      victims=[(-100, 600, 0), (-90, 600, 0)])])
+        r = oracle_find_victims(t)
+        d = self._decision(t, r, victims=[t.victims[0][1]])  # skipped v0
+        out = audit_decision(d)
+        assert any("!= oracle" in v for v in out), out
+
+
 def test_scheduler_loop_idles_when_queue_closed():
     import time as _time
     from kubernetes_tpu.api.cache import FIFO

@@ -25,6 +25,7 @@ _CRANK = {
     "jobwave": {"jobwave_rate": 0.95, "jobwave_fail_fraction": 0.9},
     "rollout": {"rollout_rate": 0.95, "n_zones": 9},
     "churn": {"churn_rate": 0.95, "service_pool": 17},
+    "drain": {"drain_fill_rate": 0.95, "drain_fill_max": 20},
 }
 
 
@@ -128,6 +129,8 @@ class TestWorkloadChaosApplier:
         assert repr(a.trace()) == repr(b.trace())
         assert a.crowd_pods == b.crowd_pods
         assert a.jobs == b.jobs
+        assert a.drain_pods == b.drain_pods
+        assert a.surge_pods == b.surge_pods
 
     def test_applier_state_follows_the_plan(self):
         plan, wl = self._replay(seed=2)
@@ -136,6 +139,11 @@ class TestWorkloadChaosApplier:
                                          for ev in sched["burst"])
         assert sorted(wl.jobs) == sorted(ev.target
                                          for ev in sched["jobwave"])
+        assert len(wl.drain_pods) == sum(
+            ev.value for ev in sched["drain"]
+            if ev.action == "batch_fill")
+        assert len(wl.surge_pods) == sum(
+            ev.value for ev in sched["drain"] if ev.action == "surge")
         # the cluster's service set equals the pure churn fold
         svcs, _ = wl.client.list("services", "default")
         assert sorted(s.metadata.name for s in svcs) == \

@@ -203,6 +203,11 @@ def _scalars(doc: dict) -> dict:
     if isinstance(mp, dict) and isinstance(mp.get("overhead_frac"),
                                            (int, float)):
         out["scrape.overhead_frac"] = float(mp["overhead_frac"])
+    pr = doc.get("preemption")
+    if isinstance(pr, dict) and isinstance(pr.get("surge_bind_p99_s"),
+                                           (int, float)):
+        # no _per_sec suffix -> lower-is-better in the trajectory gate
+        out["preemption.surge_bind_p99_s"] = float(pr["surge_bind_p99_s"])
     sv = doc.get("serving")
     if isinstance(sv, dict):
         arm = sv.get("arm")
